@@ -1,0 +1,49 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle Fluid's
+capabilities, built from scratch on JAX/XLA/Pallas/pjit idioms.
+
+Capability map (reference: /root/reference, PaddlePaddle Fluid 1.5):
+  - Program/Block/Op/Var serialized IR built by a Python front-end
+    (reference: paddle/fluid/framework/framework.proto, python/paddle/fluid/framework.py)
+  - Executor with scope/feed/fetch semantics, plus a whole-program compiled path
+    (reference: paddle/fluid/framework/executor.cc, parallel_executor.cc)
+  - Autodiff and optimizers as IR transformations
+    (reference: python/paddle/fluid/backward.py, optimizer.py)
+  - Distribution via jax.sharding Mesh + XLA collectives rather than NCCL/gRPC
+    (reference: paddle/fluid/operators/distributed*, platform/nccl_helper.h)
+
+The TPU-first design difference: ops are registered as pure JAX compute
+functions, so shape inference (jax.eval_shape), autodiff (jax.vjp-derived grad
+ops) and whole-program XLA compilation all derive from one definition instead
+of the reference's hand-written InferShape/GradOpMaker/CPU/CUDA kernels.
+"""
+
+from paddle_tpu.core.types import VarType, CPUPlace, TPUPlace, CUDAPlace
+from paddle_tpu.core.program import Program, Block, OpDesc, VarDesc
+from paddle_tpu.core.scope import Scope, Variable, global_scope
+from paddle_tpu.core.executor import Executor
+from paddle_tpu.core.compiler import CompiledProgram
+from paddle_tpu.framework import (
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    name_scope,
+    switch_main_program,
+    in_dygraph_mode,
+)
+from paddle_tpu import ops  # registers all ops
+from paddle_tpu import layers
+from paddle_tpu import initializer
+from paddle_tpu import optimizer
+from paddle_tpu import regularizer
+from paddle_tpu import clip
+from paddle_tpu import backward
+from paddle_tpu import io
+from paddle_tpu import reader
+from paddle_tpu import metrics
+from paddle_tpu import nets
+from paddle_tpu import unique_name
+from paddle_tpu import parallel
+from paddle_tpu import profiler
+from paddle_tpu.data_feeder import DataFeeder
+
+__version__ = "0.1.0"

@@ -1,0 +1,221 @@
+"""append_backward: autodiff as a program transformation.
+
+Reference parity: /root/reference/python/paddle/fluid/backward.py:432
+(append_backward), :45 (_create_op_desc_ via C++ GradOpMaker), :135
+(_addup_repetitive_outputs_ sum-dedup), :211 (no-grad pruning).
+
+TPU-first difference: the reference needs a hand-written C++ GradOpMaker per
+op; here the '<type>_grad' op is synthesized from the forward compute via
+jax.vjp (core/registry.py _generic_grad_def), and ops may override with an
+IR-level grad_maker when the vjp shape is wrong (e.g. sparse embedding
+grads).  The resulting backward ops are ordinary IR ops: they serialize,
+transpile, and compile like any other — same capability as the reference.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.program import BACKWARD, OpDesc, VarDesc
+from paddle_tpu.core.registry import GRAD_SUFFIX, get_op_def, has_op_def
+from paddle_tpu import unique_name
+
+
+def _grad_name(name: str, suffix: str = "") -> str:
+    return name + GRAD_SUFFIX + suffix
+
+
+def _needs_grad(block, name, no_grad_set):
+    if name in no_grad_set:
+        return False
+    try:
+        v = block.var(name)
+    except KeyError:
+        return False
+    if v.stop_gradient:
+        return False
+    if v.dtype is not None and not any(
+        v.dtype.startswith(p) for p in ("float", "bfloat", "complex")
+    ):
+        return False
+    return True
+
+
+def _create_grad_var(block, fwd_name, grad_name):
+    try:
+        fv = block.var(fwd_name)
+        shape, dtype = fv.shape, fv.dtype
+    except KeyError:
+        shape, dtype = None, "float32"
+    if grad_name not in block.vars:
+        block.create_var(name=grad_name, shape=shape, dtype=dtype,
+                         stop_gradient=True)
+    return block.vars[grad_name]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Appends grad ops for every op contributing to `loss`; returns
+    [(param, grad_var)] for trainable params."""
+    block = loss.block
+    program = block.program
+    no_grad_set = set(no_grad_set or ())
+
+    # mark boundary: ops present before backward
+    fwd_ops = list(block.ops)
+
+    # seed: d loss / d loss = 1
+    loss_grad = _grad_name(loss.name)
+    _create_grad_var(block, loss.name, loss_grad)
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": loss_grad},
+        attrs={"shape": list(loss.shape or []), "dtype": loss.dtype,
+               "value": 1.0},
+        op_role=BACKWARD,
+    )
+
+    # var -> list of partial-grad var names produced so far
+    grad_map: dict = {loss.name: [loss_grad]}
+
+    def merged_grad(var_name):
+        """Return the canonical grad var for var_name, inserting a sum op if
+        multiple partials exist (reference _addup_repetitive_outputs_)."""
+        parts = grad_map.get(var_name)
+        if not parts:
+            return None
+        if len(parts) == 1:
+            return parts[0]
+        out = _grad_name(var_name)
+        if out in parts:
+            # canonical name is one of the partials; rename it first
+            renamed = _grad_name(var_name, "@RENAME")
+            block.vars[renamed] = block.vars.pop(out)
+            block.vars[renamed].name = renamed
+            for op in block.ops:
+                for slot, names in list(op.outputs.items()):
+                    op.outputs[slot] = [renamed if n == out else n
+                                        for n in names]
+                for slot, names in list(op.inputs.items()):
+                    op.inputs[slot] = [renamed if n == out else n
+                                       for n in names]
+            parts = [renamed if p == out else p for p in parts]
+        _create_grad_var(block, var_name, out)
+        block.append_op(type="sum", inputs={"X": parts},
+                        outputs={"Out": out}, op_role=BACKWARD,
+                        infer_shape=False)
+        grad_map[var_name] = [out]
+        return out
+
+    for op in reversed(fwd_ops):
+        if not has_op_def(op.type):
+            continue
+        op_def = get_op_def(op.type)
+        if not op_def.differentiable or op_def.host_only:
+            continue
+        # does any output carry gradient?
+        out_has_grad = {
+            slot: [n in grad_map for n in names]
+            for slot, names in op.outputs.items()
+        }
+        if not any(any(v) for v in out_has_grad.values()):
+            continue
+        # which inputs need gradients?
+        grad_out_slots = {}
+        for slot, names in op.outputs.items():
+            gnames = []
+            any_grad = any(n in grad_map for n in names)
+            if not any_grad:
+                continue
+            for n in names:
+                g = merged_grad(n)
+                if g is None:
+                    # sibling output without upstream grad: explicit zeros
+                    # to keep duplicable slots aligned
+                    z = _grad_name(n, "@ZERO")
+                    if z not in block.vars:
+                        _create_grad_var(block, n, z)
+                        block.append_op(
+                            type="fill_zeros_like", inputs={"X": n},
+                            outputs={"Out": z}, op_role=BACKWARD,
+                            infer_shape=False)
+                    g = z
+                gnames.append(g)
+            grad_out_slots[slot + GRAD_SUFFIX] = gnames
+
+        if op_def.grad_maker is not None:
+            new_ops = op_def.grad_maker(op, grad_out_slots, block, grad_map)
+            for nop in new_ops:
+                nop.op_role = BACKWARD
+                block.ops.append(nop)
+            continue
+
+        grad_inputs = dict(grad_out_slots)
+        for slot, names in op.inputs.items():
+            grad_inputs[slot] = list(names)
+        grad_outputs = {}
+        for slot, names in op.inputs.items():
+            gnames = []
+            slot_any = False
+            for n in names:
+                if _needs_grad(block, n, no_grad_set):
+                    slot_any = True
+                if n in grad_map or not _needs_grad(block, n, no_grad_set):
+                    g = _grad_name(
+                        n, "@" + unique_name.generate("p"))
+                else:
+                    g = _grad_name(n)
+                gnames.append(g)
+            if not slot_any:
+                continue
+            for n, g in zip(names, gnames):
+                if _needs_grad(block, n, no_grad_set):
+                    _create_grad_var(block, n, g)
+                    grad_map.setdefault(n, []).append(g)
+                else:
+                    _create_grad_var(block, n, g)
+            grad_outputs[slot + GRAD_SUFFIX] = gnames
+        if not grad_outputs:
+            continue
+        gop = OpDesc(op.type + "_grad", grad_inputs, grad_outputs,
+                     dict(op.attrs), BACKWARD)
+        block.ops.append(gop)
+
+    # merge leaf grads (params & data) to canonical names
+    params = (
+        [block.program.global_block().var(p) if isinstance(p, str) else p
+         for p in parameter_list]
+        if parameter_list
+        else program.all_parameters()
+    )
+    params_grads = []
+    for p in params:
+        if p.name in no_grad_set or not p.trainable:
+            continue
+        g = merged_grad(p.name)
+        if g is None:
+            continue
+        if g != _grad_name(p.name):
+            canonical = _grad_name(p.name)
+            _create_grad_var(block, p.name, canonical)
+            block.append_op(type="assign", inputs={"X": g},
+                            outputs={"Out": canonical},
+                            op_role=BACKWARD, infer_shape=False)
+            g = canonical
+        params_grads.append((p, block.var(g)))
+    return params_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference backward.py gradients(): grads of targets w.r.t. inputs."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    loss = targets[0]
+    pg = append_backward(
+        loss, parameter_list=None, no_grad_set=no_grad_set)
+    block = loss.block
+    outs = []
+    for x in inputs:
+        gname = _grad_name(x.name)
+        outs.append(block.vars.get(gname))
+    return outs

@@ -1,0 +1,64 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py:42
+ErrorClipByValue, :233 GradientClipByValue/Norm/GlobalNorm)."""
+
+from __future__ import annotations
+
+
+class GradientClipBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        from paddle_tpu import layers
+
+        return [(p, layers.clip(g, self.min, self.max))
+                for p, g in params_grads]
+
+
+class GradientClipByNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        from paddle_tpu import layers
+
+        return [(p, layers.clip_by_norm(g, self.clip_norm))
+                for p, g in params_grads]
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        from paddle_tpu import layers
+
+        sq = [layers.reduce_sum(layers.square(g)) for _, g in params_grads]
+        total = layers.sums(sq) if len(sq) > 1 else sq[0]
+        gn = layers.sqrt(total)
+        clip = layers.fill_constant([], "float32", self.clip_norm)
+        denom = layers.elementwise_max(gn, clip)
+        scale = layers.elementwise_div(clip, denom)
+        return [(p, layers.elementwise_mul(g, scale))
+                for p, g in params_grads]
+
+
+# reference helper: set_gradient_clip attaches clip to params
+def set_gradient_clip(clip, param_list=None, program=None):
+    from paddle_tpu.framework import default_main_program
+
+    program = program or default_main_program()
+    params = param_list or program.all_parameters()
+    for p in params:
+        if isinstance(p, str):
+            p = program.global_block().var(p)
+        p.gradient_clip = clip
+
+
+ErrorClipByValue = GradientClipByValue

@@ -1,0 +1,6 @@
+from paddle_tpu.core.types import VarType, CPUPlace, TPUPlace, CUDAPlace
+from paddle_tpu.core.program import Program, Block, OpDesc, VarDesc
+from paddle_tpu.core.scope import Scope, Variable, global_scope
+from paddle_tpu.core.registry import OpDef, register_op, get_op_def, has_op_def
+from paddle_tpu.core.executor import Executor
+from paddle_tpu.core.compiler import CompiledProgram

@@ -1,0 +1,187 @@
+"""Serial op-by-op executor — the debug/eager path.
+
+Reference parity:
+  - Executor::Run/Prepare/RunPreparedContext:
+    /root/reference/paddle/fluid/framework/executor.cc:150,327,375-438
+    (hot loop :416 "for op in ops: op->Run(scope, place)")
+  - feed/fetch: framework/feed_fetch_method.cc; python feed injection
+    python/paddle/fluid/executor.py:397
+  - python Executor.run: python/paddle/fluid/executor.py:566
+
+TPU-first difference: each op's compute is a JAX function dispatched eagerly;
+there is no kernel-choice/data-transfer machinery (operator.cc:916-940)
+because XLA owns placement.  The performance path is CompiledProgram
+(compiler.py), which traces the same IR into one XLA module — this
+interpreter exists for debugging, host-only ops, and numeric cross-checks
+(the reference's OpTest dual-run pattern).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.program import BlockRef, Program
+from paddle_tpu.core.registry import get_op_def
+from paddle_tpu.core.scope import Scope, SelectedRows, global_scope
+from paddle_tpu.core.types import CPUPlace, Place
+
+# op types executed by a python handler instead of a registry compute
+# (control flow, feed/fetch, readers, host IO).
+_SPECIAL_OPS: dict = {}
+
+
+def register_special_op(type: str):
+    def deco(fn):
+        _SPECIAL_OPS[type] = fn
+        return fn
+
+    return deco
+
+
+class RuntimeCtx:
+    """Handed to special-op handlers so control-flow ops can run sub-blocks."""
+
+    def __init__(self, executor, program, scope, place, feed, fetch_results):
+        self.executor = executor
+        self.program = program
+        self.scope = scope
+        self.place = place
+        self.feed = feed or {}
+        self.fetch_results = fetch_results
+
+    def run_block(self, block_idx: int, scope: Scope):
+        block = self.program.blocks[block_idx]
+        self.executor._run_block(block, scope, self)
+
+
+class Executor:
+    """reference: python/paddle/fluid/executor.py:294"""
+
+    def __init__(self, place: Place = None):
+        self.place = place if place is not None else CPUPlace()
+
+    # ------------------------------------------------------------------ public
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        scope=None,
+        return_numpy=True,
+    ):
+        from paddle_tpu import framework
+        from paddle_tpu.core.compiler import CompiledProgram
+
+        if program is None:
+            program = framework.default_main_program()
+        if scope is None:
+            scope = global_scope()
+        if isinstance(program, CompiledProgram):
+            return program._run(self, feed or {}, fetch_list or [], scope,
+                                return_numpy)
+        return self._run_interpreted(
+            program, feed or {}, fetch_list or [], scope, return_numpy
+        )
+
+    # -------------------------------------------------------------- internals
+    def _run_interpreted(self, program: Program, feed, fetch_list, scope,
+                         return_numpy):
+        self._feed_data(program, feed, scope)
+        fetch_results = {}
+        ctx = RuntimeCtx(self, program, scope, self.place, feed,
+                         fetch_results)
+        self._run_block(program.global_block(), scope, ctx)
+        return self._fetch(fetch_list, scope, return_numpy)
+
+    def _feed_data(self, program, feed, scope):
+        import jax.numpy as jnp
+
+        block = program.global_block()
+        for name, value in feed.items():
+            if hasattr(value, "__array__") or isinstance(
+                value, (list, tuple, int, float)
+            ):
+                arr = np.asarray(value)
+                if block.has_var(name):
+                    v = block.var(name)
+                    if v.dtype is not None and arr.dtype != np.dtype(v.dtype):
+                        arr = arr.astype(v.dtype)
+                value = jnp.asarray(arr)
+            scope.var(name).set(value)
+
+    def _run_block(self, block, scope: Scope, ctx: RuntimeCtx):
+        for op in block.ops:
+            self._run_op(op, block, scope, ctx)
+
+    def _run_op(self, op, block, scope: Scope, ctx: RuntimeCtx):
+        special = _SPECIAL_OPS.get(op.type)
+        if special is not None:
+            special(op, block, scope, ctx)
+            return
+        op_def = get_op_def(op.type)
+        ins = {}
+        for slot, names in op.inputs.items():
+            vals = []
+            for n in names:
+                var = scope.find_var(n)
+                if var is None or var.get() is None:
+                    vals.append(None)
+                else:
+                    vals.append(var.get())
+            if slot in op_def.duplicable:
+                if any(v is None for v in vals):
+                    if slot in op_def.optional:
+                        continue
+                    missing = [
+                        n for n, v in zip(names, vals) if v is None
+                    ]
+                    raise RuntimeError(
+                        f"op {op.type}: input slot {slot} vars {missing}"
+                        " are unset"
+                    )
+                ins[slot] = vals
+            else:
+                val = vals[0] if vals else None
+                if val is None:
+                    if slot in op_def.optional or not names:
+                        continue
+                    raise RuntimeError(
+                        f"op {op.type}: input '{names[0]}' (slot {slot})"
+                        " is unset"
+                    )
+                ins[slot] = val
+        try:
+            outs = op_def.compute(ins, op.attrs)
+        except Exception as e:
+            raise RuntimeError(
+                f"error running op {op.type} ({op!r}): {e}"
+            ) from e
+        if outs is None:
+            outs = {}
+        for slot, names in op.outputs.items():
+            if slot not in outs:
+                continue
+            vals = outs[slot]
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for n, v in zip(names, vals):
+                scope.var(n).set(v)
+
+    def _fetch(self, fetch_list, scope, return_numpy):
+        results = []
+        for f in fetch_list:
+            name = f if isinstance(f, str) else f.name
+            var = scope.find_var(name)
+            if var is None:
+                raise RuntimeError(f"fetch variable '{name}' not found")
+            val = var.get()
+            if return_numpy:
+                if isinstance(val, SelectedRows):
+                    val = np.asarray(val.to_dense())
+                else:
+                    val = np.asarray(val)
+            results.append(val)
+        return results
+
+    def close(self):
+        pass

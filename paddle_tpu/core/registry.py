@@ -1,0 +1,265 @@
+"""Op registry: one pure-JAX compute function per op type.
+
+Reference parity:
+  - OpRegistry / OpInfoMap / REGISTER_OPERATOR:
+    /root/reference/paddle/fluid/framework/op_registry.h:66,197
+  - OpProtoAndCheckerMaker attribute checking:
+    /root/reference/paddle/fluid/framework/op_proto_maker.cc
+  - GradOpDescMakerBase: /root/reference/paddle/fluid/framework/grad_op_desc_maker.h:36
+  - InferShape: /root/reference/paddle/fluid/framework/shape_inference.h
+
+TPU-first difference: the reference registers, per op, separate C++ classes
+for proto/checker, InferShape, GradOpMaker, and per-device kernels.  Here a
+single pure JAX function yields all of them:
+  * kernels  -> the function itself, traced by XLA for any backend;
+  * InferShape -> jax.eval_shape over the function;
+  * grad ops -> jax.vjp over the function (overridable per-op).
+
+compute signature: ``compute(ins: dict, attrs: dict) -> dict``
+  - ``ins[slot]`` is a jax array for plain slots, a list for duplicable slots;
+    optional slots may be missing from the dict.
+  - returns ``{out_slot: array_or_list}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GRAD_SUFFIX = "@GRAD"
+
+
+@dataclasses.dataclass
+class OpDef:
+    type: str
+    inputs: tuple                      # slot names
+    outputs: tuple
+    compute: Callable                  # (ins, attrs) -> outs
+    attrs: dict                        # name -> default (REQUIRED sentinel if mandatory)
+    duplicable: frozenset              # slots holding lists of vars
+    optional: frozenset                # slots that may be absent
+    # IR-level custom grad maker: fn(op_desc, grad_out_names, grad_in_names, block)
+    # -> list[OpDesc].  None => generic vjp grad.
+    grad_maker: Optional[Callable] = None
+    # compute for the synthesized "<type>_grad" op when generic vjp is used
+    # (filled lazily).
+    differentiable: bool = True
+    # stateful ops (optimizers, assigns) write one of their inputs; outputs may
+    # alias inputs.  Purely informational for passes.
+    in_place: dict = dataclasses.field(default_factory=dict)
+    # host ops run outside jit (readers, prints, saves)
+    host_only: bool = False
+
+    def canonical_attrs(self, attrs: dict) -> dict:
+        out = {}
+        for name, default in self.attrs.items():
+            if name in attrs:
+                out[name] = attrs[name]
+            elif default is REQUIRED:
+                raise ValueError(
+                    f"op {self.type}: required attr '{name}' missing"
+                )
+            else:
+                out[name] = default
+        extra = set(attrs) - set(self.attrs)
+        if extra:
+            raise ValueError(f"op {self.type}: unknown attrs {sorted(extra)}")
+        return out
+
+
+class _Required:
+    def __repr__(self):
+        return "<REQUIRED>"
+
+
+REQUIRED = _Required()
+
+_REGISTRY: dict = {}
+
+
+def register_op(
+    type: str,
+    inputs: Sequence[str] = (),
+    outputs: Sequence[str] = ("Out",),
+    attrs: Optional[dict] = None,
+    duplicable: Sequence[str] = (),
+    optional: Sequence[str] = (),
+    grad_maker: Optional[Callable] = None,
+    differentiable: bool = True,
+    in_place: Optional[dict] = None,
+    host_only: bool = False,
+):
+    """Decorator registering ``compute`` as op ``type``."""
+
+    def deco(compute):
+        if type in _REGISTRY:
+            raise ValueError(f"op '{type}' registered twice")
+        _REGISTRY[type] = OpDef(
+            type=type,
+            inputs=tuple(inputs),
+            outputs=tuple(outputs),
+            compute=compute,
+            attrs=dict(attrs or {}),
+            duplicable=frozenset(duplicable),
+            optional=frozenset(optional),
+            grad_maker=grad_maker,
+            differentiable=differentiable,
+            in_place=dict(in_place or {}),
+            host_only=host_only,
+        )
+        return compute
+
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    try:
+        return _REGISTRY[type]
+    except KeyError:
+        if type.endswith("_grad") and type[: -len("_grad")] in _REGISTRY:
+            return _generic_grad_def(type[: -len("_grad")])
+        raise KeyError(f"op '{type}' is not registered") from None
+
+
+def has_op_def(type: str) -> bool:
+    if type in _REGISTRY:
+        return True
+    return type.endswith("_grad") and type[: -len("_grad")] in _REGISTRY
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
+
+
+def _is_diff_leaf(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+
+
+def _slot_is_diff(val) -> bool:
+    leaves = jax.tree_util.tree_leaves(val)
+    return bool(leaves) and all(_is_diff_leaf(x) for x in leaves)
+
+
+@functools.lru_cache(maxsize=None)
+def _generic_grad_def(fwd_type: str) -> OpDef:
+    """Synthesize '<fwd>_grad' from the forward compute via jax.vjp.
+
+    The grad op's inputs are the forward inputs plus '<out_slot>@GRAD' for
+    each forward output that has an upstream gradient; its outputs are
+    '<in_slot>@GRAD' for differentiable inputs.  This mirrors the reference's
+    DefaultGradOpDescMaker (grad_op_desc_maker.h:36) but derives the kernel
+    from the forward one instead of requiring a hand-written grad kernel.
+
+    Note: the vjp re-traces the forward op.  Under the compiled (whole
+    program) executor XLA CSEs the duplicated forward; in interpreter mode it
+    is a per-op recompute, the debug path where that cost is acceptable.
+    """
+    fwd = get_op_def(fwd_type)
+    if not fwd.differentiable:
+        raise KeyError(f"op '{fwd_type}' is not differentiable")
+
+    def grad_compute(ins, attrs):
+        fwd_ins = {s: ins[s] for s in fwd.inputs if s in ins}
+        diff = {k: v for k, v in fwd_ins.items() if _slot_is_diff(v)}
+        nondiff = {k: v for k, v in fwd_ins.items() if k not in diff}
+
+        def f(d):
+            outs = fwd.compute({**d, **nondiff}, attrs)
+            return {s: outs[s] for s in fwd.outputs if s in outs}
+
+        primal_outs, vjp = jax.vjp(f, diff)
+        cts = jax.tree_util.tree_map(jnp.zeros_like, primal_outs)
+        for slot in list(primal_outs):
+            g = ins.get(slot + GRAD_SUFFIX)
+            if g is not None:
+                cts[slot] = g
+        (d_in,) = vjp(cts)
+        return {k + GRAD_SUFFIX: v for k, v in d_in.items()}
+
+    grad_inputs = tuple(fwd.inputs) + tuple(
+        s + GRAD_SUFFIX for s in fwd.outputs
+    )
+    grad_dup = frozenset(
+        list(fwd.duplicable)
+        + [s + GRAD_SUFFIX for s in fwd.outputs if s in fwd.duplicable]
+    )
+    return OpDef(
+        type=fwd_type + "_grad",
+        inputs=grad_inputs,
+        outputs=tuple(s + GRAD_SUFFIX for s in fwd.inputs),
+        compute=grad_compute,
+        attrs=dict(fwd.attrs),
+        duplicable=grad_dup,
+        optional=frozenset(grad_inputs) | frozenset(fwd.optional),
+        differentiable=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Shape/dtype inference via eval_shape (reference: runtime InferShape,
+# framework/operator.cc:936).  Unknown dims (-1) are substituted with
+# distinct dummy extents so they survive elementwise/matmul style ops and are
+# mapped back to -1 afterwards; if substitution misleads an op (e.g. reshape
+# arithmetic) the caller treats the failure as "shape unknown".
+# ---------------------------------------------------------------------------
+
+_DUMMY_DIMS = (1201, 1301, 1409, 1511, 1601, 1709, 1801, 1901, 2003, 2111)
+
+
+def infer_shapes(op_def: OpDef, ins_specs: dict, attrs: dict):
+    """ins_specs: slot -> ShapeDtypeStruct or list thereof (shapes may have -1).
+
+    Returns {out_slot: ShapeDtypeStruct-or-list with -1 restored} or None if
+    inference failed.
+    """
+    used = {}
+    counter = [0]
+
+    def sub(spec):
+        shape = []
+        for d in spec.shape:
+            if d is None or d < 0:
+                dummy = _DUMMY_DIMS[counter[0] % len(_DUMMY_DIMS)] + 10 * (
+                    counter[0] // len(_DUMMY_DIMS)
+                )
+                counter[0] += 1
+                used[dummy] = True
+                shape.append(dummy)
+            else:
+                shape.append(d)
+        return jax.ShapeDtypeStruct(tuple(shape), spec.dtype)
+
+    def sub_tree(v):
+        if isinstance(v, (list, tuple)):
+            return [sub_tree(x) for x in v]
+        return sub(v)
+
+    try:
+        shaped = {k: sub_tree(v) for k, v in ins_specs.items()}
+        out = jax.eval_shape(
+            lambda i: op_def.compute(i, attrs), shaped
+        )
+    except Exception:
+        return None
+
+    def unsub(spec):
+        shape = tuple(-1 if d in used else d for d in spec.shape)
+        return jax.ShapeDtypeStruct(shape, spec.dtype)
+
+    def unsub_tree(v):
+        if isinstance(v, (list, tuple)):
+            return [unsub_tree(x) for x in v]
+        return unsub(v)
+
+    return {k: unsub_tree(v) for k, v in out.items()}
+
+
+def np_dtype(dtype) -> np.dtype:
+    import jax.numpy as jnp  # noqa
+
+    return np.dtype(jnp.dtype(dtype))

@@ -1,0 +1,127 @@
+"""Scope / Variable: hierarchical name -> value store for execution.
+
+Reference parity:
+  - Scope: /root/reference/paddle/fluid/framework/scope.h:45 (Var/FindVar/NewScope)
+  - Variable: /root/reference/paddle/fluid/framework/variable.h:26 (any-type holder)
+
+Values held are jax.Arrays (DENSE_TENSOR), SelectedRows, TensorArray (python
+list of arrays), or arbitrary host objects (readers etc.).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class SelectedRows:
+    """Sparse rows analog (reference framework/selected_rows.h:32): a set of
+    row indices into a logically tall tensor plus the dense values for just
+    those rows.  On TPU the consumer ops densify via segment_sum."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows          # int array [n]
+        self.values = values      # [n, ...] dense values
+        self.height = height      # logical number of rows
+
+    def to_dense(self):
+        import jax.numpy as jnp
+
+        out_shape = (self.height,) + tuple(self.values.shape[1:])
+        dense = jnp.zeros(out_shape, self.values.dtype)
+        import jax
+
+        return dense.at[self.rows].add(self.values)
+
+    def __repr__(self):
+        return (
+            f"SelectedRows(height={self.height}, nrows="
+            f"{None if self.rows is None else len(self.rows)})"
+        )
+
+
+class Variable:
+    def __init__(self, name: str):
+        self.name = name
+        self.value = None
+
+    def get(self):
+        return self.value
+
+    def set(self, v):
+        self.value = v
+
+    def get_tensor(self):  # reference-API compatibility
+        return self.value
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self.vars: dict = {}
+        self.kids: list = []
+
+    def var(self, name: str) -> Variable:
+        """Find-or-create in THIS scope (reference Scope::Var scope.cc:66)."""
+        v = self.find_var_local(name)
+        if v is None:
+            v = Variable(name)
+            self.vars[name] = v
+        return v
+
+    def find_var_local(self, name: str) -> Optional[Variable]:
+        return self.vars.get(name)
+
+    def find_var(self, name: str) -> Optional[Variable]:
+        """Search this scope then ancestors (reference Scope::FindVar)."""
+        s = self
+        while s is not None:
+            v = s.vars.get(name)
+            if v is not None:
+                return v
+            s = s.parent
+        return None
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self.kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self.kids = []
+
+    def erase(self, names):
+        for n in names:
+            self.vars.pop(n, None)
+
+    def local_var_names(self):
+        return list(self.vars)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def scope_guard(scope):
+    """Context manager switching the global scope (reference
+    python/paddle/fluid/executor.py scope_guard)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+
+    return guard()

@@ -1,0 +1,97 @@
+"""Device places and variable types.
+
+Reference parity:
+  - Place variant: /root/reference/paddle/fluid/platform/place.h:26-81
+    (CPUPlace / CUDAPlace / CUDAPinnedPlace).  Here a Place names a JAX
+    backend + device index; TPUPlace is the first-class citizen and
+    CUDAPlace is accepted as an alias for "the accelerator" so reference
+    user code ports cleanly.
+  - VarType enum: /root/reference/paddle/fluid/framework/framework.proto:105-165
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+
+class VarType(enum.Enum):
+    # Tensor variants (reference framework.proto VarType.Type)
+    DENSE_TENSOR = "dense_tensor"        # reference LOD_TENSOR; ragged-ness is
+                                         # carried by explicit seq_lens tensors
+    SELECTED_ROWS = "selected_rows"      # sparse rows {rows, values}
+    TENSOR_ARRAY = "tensor_array"        # list of tensors (while-loop carries)
+    READER = "reader"                    # data source endpoint
+    STEP_SCOPES = "step_scopes"          # control-flow sub-scopes
+    RAW = "raw"                          # opaque host object
+
+    # alias used in a few reference-style APIs
+    LOD_TENSOR = "dense_tensor"
+
+
+class Place:
+    """Identifies where eager (interpreter-mode) arrays should live."""
+
+    backend: str = "cpu"
+    device_id: int = 0
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            type(self) is type(other) and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    @functools.lru_cache(maxsize=None)
+    def _devices(backend):  # noqa: N805 - staticmethod-ish cache
+        import jax
+
+        try:
+            return tuple(jax.devices(backend))
+        except RuntimeError:
+            return ()
+
+    def jax_device(self):
+        """Resolve to a jax.Device, falling back to the default backend."""
+        import jax
+
+        devs = Place._devices(self.backend)
+        if not devs:
+            devs = tuple(jax.devices())
+        return devs[self.device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    backend = "cpu"
+
+
+class TPUPlace(Place):
+    backend = "tpu"
+
+    def jax_device(self):
+        import jax
+
+        for backend in ("tpu", "axon"):
+            devs = Place._devices(backend)
+            if devs:
+                return devs[self.device_id % len(devs)]
+        return jax.devices()[self.device_id % len(jax.devices())]
+
+
+class CUDAPlace(TPUPlace):
+    """Alias: reference code written against CUDAPlace runs on the TPU."""
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+def _is_accelerator_place(place) -> bool:
+    return isinstance(place, TPUPlace)

@@ -1,0 +1,27 @@
+"""DataFeeder: numpy conversion of user minibatches (reference:
+python/paddle/fluid/data_feeder.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = feed_list
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable: list of samples, each a tuple aligned with feed_list.
+        Returns {name: batched ndarray}."""
+        cols = list(zip(*iterable))
+        out = {}
+        for var, col in zip(self.feed_vars, cols):
+            arr = np.asarray(col)
+            if var.dtype is not None:
+                arr = arr.astype(var.dtype)
+            if var.shape is not None and len(var.shape) == arr.ndim + 1:
+                # samples were scalars-per-dim short; add trailing dim
+                arr = arr.reshape(arr.shape + (1,))
+            out[var.name] = arr
+        return out

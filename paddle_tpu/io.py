@@ -1,0 +1,162 @@
+"""Model save/load: checkpointing as graph execution, like the reference.
+
+Reference parity: /root/reference/python/paddle/fluid/io.py
+  save_vars/save_params/save_persistables :242,:475 (build throwaway
+  programs of save ops), load counterparts :714, save_inference_model :921,
+  load_inference_model :1109.
+"""
+
+from __future__ import annotations
+
+import os
+
+from paddle_tpu.core.program import Program
+from paddle_tpu.framework import default_main_program, program_guard
+
+
+def _save_load_program(var_names, dirname, filename, is_save):
+    prog = Program()
+    block = prog.global_block()
+    if filename:
+        path = os.path.join(dirname, filename)
+        if is_save:
+            block.append_op(type="save_combine",
+                            inputs={"X": list(var_names)}, outputs={},
+                            attrs={"file_path": path}, infer_shape=False)
+        else:
+            block.append_op(type="load_combine", inputs={},
+                            outputs={"Out": list(var_names)},
+                            attrs={"file_path": path}, infer_shape=False)
+    else:
+        for n in var_names:
+            path = os.path.join(dirname, n)
+            if is_save:
+                block.append_op(type="save", inputs={"X": [n]}, outputs={},
+                                attrs={"file_path": path},
+                                infer_shape=False)
+            else:
+                block.append_op(type="load", inputs={},
+                                outputs={"Out": [n]},
+                                attrs={"file_path": path},
+                                infer_shape=False)
+    return prog
+
+
+def _collect(program, predicate):
+    return [v.name for v in program.list_vars()
+            if v.persistable and not v.is_data and predicate(v)]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        names = _collect(program, predicate or (lambda v: True))
+    else:
+        names = [v if isinstance(v, str) else v.name for v in vars]
+    executor.run(_save_load_program(names, dirname, filename, True))
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    save_vars(executor, dirname, program,
+              vars=[v.name for v in program.all_parameters()],
+              filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program,
+              predicate=lambda v: True, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        names = _collect(program, predicate or (lambda v: True))
+    else:
+        names = [v if isinstance(v, str) else v.name for v in vars]
+    executor.run(_save_load_program(names, dirname, filename, False))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    load_vars(executor, dirname, program,
+              vars=[v.name for v in program.all_parameters()],
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: True, filename=filename)
+
+
+def _prune_for_inference(program, feed_names, fetch_names):
+    """Keep only ops needed to compute fetch vars from feeds (reference
+    framework/prune.cc:181 + Program.clone(for_test))."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if any(n in needed for n in op.output_names()):
+            keep.append(op)
+            needed.update(op.input_names())
+    block.ops = list(reversed(keep))
+    # drop vars no kept op references (e.g. learning_rate, optimizer state)
+    referenced = set(feed_names) | set(fetch_names)
+    for op in block.ops:
+        referenced.update(op.input_names())
+        referenced.update(op.output_names())
+    block.vars = {n: v for n, v in block.vars.items() if n in referenced}
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """reference io.py:921: prune to feed/fetch + serialize program, save
+    params."""
+    program = main_program or default_main_program()
+    fetch_names = [v if isinstance(v, str) else v.name
+                   for v in target_vars]
+    pruned = _prune_for_inference(program, feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    param_names = sorted({
+        v.name for v in pruned.list_vars()
+        if v.persistable and not v.is_data
+    })
+    meta = {
+        "program": pruned.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": fetch_names,
+        "param_names": param_names,
+    }
+    import json
+
+    with open(model_path, "w") as f:
+        json.dump(meta, f)
+    save_vars(executor, dirname, program, vars=param_names,
+              filename=params_filename or "__params__")
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    import json
+
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    param_names = meta.get("param_names") or sorted({
+        v.name for v in program.list_vars()
+        if v.persistable and not v.is_data
+    })
+    if param_names:
+        load_vars(executor, dirname, program, vars=param_names,
+                  filename=params_filename or "__params__")
+    fetch_vars = [program.global_block().var(n)
+                  for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars

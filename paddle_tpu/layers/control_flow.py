@@ -1,0 +1,121 @@
+"""Control-flow layers (reference: python/paddle/fluid/layers/control_flow.py
+While, Switch, lod_rank_table era constructs).
+
+TPU-first: While builds a sub-block that the compiled executor lowers to
+lax.while_loop with scope-carried state (static shapes); the interpreter
+runs it host-side.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.program import BlockRef
+from paddle_tpu.framework import default_main_program
+from paddle_tpu.layers.helper import LayerHelper
+
+__all__ = ["While", "Switch", "array_write", "array_read", "array_length"]
+
+
+class While:
+    """
+    Usage (reference semantics):
+        i = layers.fill_constant([1], 'int64', 0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            ...body...
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)   # update condition in place
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            prog = default_main_program()
+            parent_block = prog.current_block()
+            sub = prog._create_block()
+            try:
+                yield
+            finally:
+                prog._rollback()
+                parent_block.append_op(
+                    type="while",
+                    inputs={"Condition": self.cond_var},
+                    outputs={},
+                    attrs={"sub_block": BlockRef(sub.idx)},
+                    infer_shape=False,
+                )
+
+        return guard()
+
+
+class Switch:
+    """Simplified Switch (reference control_flow.py Switch): sequential
+    conditional_block cases."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._cases = []
+
+    def case(self, condition):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            prog = default_main_program()
+            parent_block = prog.current_block()
+            sub = prog._create_block()
+            try:
+                yield
+            finally:
+                prog._rollback()
+                parent_block.append_op(
+                    type="conditional_block",
+                    inputs={"Cond": condition},
+                    outputs={},
+                    attrs={"sub_block": BlockRef(sub.idx)},
+                    infer_shape=False,
+                )
+
+        return guard()
+
+    def default(self):
+        from paddle_tpu import layers
+
+        one = layers.fill_constant([1], "bool", 1.0)
+        return self.case(one)
+
+
+def array_write(x, i, array=None):
+    from paddle_tpu.core.types import VarType
+
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = helper.block.create_var(
+            name=None, type=VarType.TENSOR_ARRAY, dtype=x.dtype)
+    helper.append_op(
+        type="write_to_array", inputs={"X": x, "I": i},
+        outputs={"Out": array}, infer_shape=False)
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="read_from_array", inputs={"X": array, "I": i},
+        outputs={"Out": out}, infer_shape=False)
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="array_length", inputs={"X": array},
+                     outputs={"Out": out}, infer_shape=False)
+    return out

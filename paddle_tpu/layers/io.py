@@ -1,0 +1,25 @@
+"""Data-input layers (reference: python/paddle/fluid/layers/io.py — data
+:~60, py_reader :656)."""
+
+from __future__ import annotations
+
+from paddle_tpu.core.types import VarType
+from paddle_tpu.framework import default_main_program, default_startup_program
+
+
+def data(name, shape, dtype="float32", append_batch_size=True,
+         lod_level=0, type=VarType.DENSE_TENSOR, stop_gradient=True):
+    """Declares a feed variable.  append_batch_size=True prepends a -1 batch
+    dim (reference layers/io.py data)."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    main = default_main_program().global_block()
+    var = main.create_var(
+        name=name, shape=shape, dtype=dtype, type=type,
+        stop_gradient=stop_gradient, is_data=True)
+    # also visible in startup program so program pairs stay symmetric
+    default_startup_program().global_block().create_var(
+        name=name, shape=shape, dtype=dtype, type=type,
+        stop_gradient=True, is_data=True)
+    return var

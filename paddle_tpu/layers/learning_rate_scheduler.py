@@ -1,0 +1,146 @@
+"""LR schedulers — built as ops over a global step counter so the schedule
+runs inside the compiled program (reference:
+python/paddle/fluid/layers/learning_rate_scheduler.py)."""
+
+from __future__ import annotations
+
+import math
+
+from paddle_tpu.layers import tensor
+from paddle_tpu.layers.helper import LayerHelper
+
+__all__ = [
+    "noam_decay", "exponential_decay", "natural_exp_decay",
+    "inverse_time_decay", "polynomial_decay", "piecewise_decay",
+    "cosine_decay", "linear_lr_warmup",
+]
+
+
+def _global_step(helper):
+    from paddle_tpu import unique_name
+
+    counter = tensor.create_global_var(
+        [1], 0.0, "float32", persistable=True,
+        name=unique_name.generate("learning_rate_step"))
+    helper.block.append_op(
+        type="increment", inputs={"X": counter}, outputs={"Out": counter},
+        attrs={"step": 1.0}, op_role="lr_sched")
+    return counter
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    from paddle_tpu import layers
+
+    helper = LayerHelper("noam_decay")
+    step = _global_step(helper)
+    a = layers.pow(step, -0.5)
+    b = layers.scale(step, scale=float(warmup_steps) ** -1.5)
+    lr = layers.scale(
+        layers.elementwise_min(a, b),
+        scale=float(learning_rate) * float(d_model) ** -0.5)
+    return lr
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from paddle_tpu import layers
+
+    helper = LayerHelper("exponential_decay")
+    step = _global_step(helper)
+    div = layers.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        helper2 = LayerHelper("floor")
+        out = helper2.create_variable_for_type_inference("float32")
+        helper2.append_op(type="floor", inputs={"X": div},
+                          outputs={"Out": out})
+        div = out
+    factor = layers.elementwise_pow(
+        tensor.fill_constant([1], "float32", decay_rate), div)
+    return layers.scale(factor, scale=learning_rate)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from paddle_tpu import layers
+
+    helper = LayerHelper("natural_exp_decay")
+    step = _global_step(helper)
+    div = layers.scale(step, scale=1.0 / decay_steps)
+    ex = layers.exp(layers.scale(div, scale=-decay_rate))
+    return layers.scale(ex, scale=learning_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    from paddle_tpu import layers
+
+    helper = LayerHelper("inverse_time_decay")
+    step = _global_step(helper)
+    div = layers.scale(step, scale=decay_rate / decay_steps, bias=1.0)
+    recip = layers.elementwise_div(
+        tensor.fill_constant([1], "float32", learning_rate), div)
+    return recip
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    from paddle_tpu import layers
+
+    helper = LayerHelper("polynomial_decay")
+    step = _global_step(helper)
+    capped = layers.clip(step, 0.0, float(decay_steps))
+    frac = layers.scale(capped, scale=1.0 / decay_steps)
+    one_minus = layers.scale(frac, scale=-1.0, bias=1.0)
+    poly = layers.pow(one_minus, factor=power)
+    return layers.scale(poly, scale=learning_rate - end_learning_rate,
+                        bias=end_learning_rate)
+
+
+def piecewise_decay(boundaries, values):
+    from paddle_tpu import layers
+
+    helper = LayerHelper("piecewise_decay")
+    step = _global_step(helper)
+    lr = tensor.fill_constant([1], "float32", values[-1])
+    # nested where from the last boundary back
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        cond = layers.less_than(
+            step, tensor.fill_constant([1], "float32", float(b)))
+        lr = layers.where(cond, tensor.fill_constant([1], "float32", v),
+                          lr)
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    from paddle_tpu import layers
+
+    helper = LayerHelper("cosine_decay")
+    step = _global_step(helper)
+    epoch_f = layers.scale(step, scale=1.0 / step_each_epoch)
+    helper2 = LayerHelper("floor")
+    epoch = helper2.create_variable_for_type_inference("float32")
+    helper2.append_op(type="floor", inputs={"X": epoch_f},
+                      outputs={"Out": epoch})
+    inner = layers.scale(epoch, scale=math.pi / epochs)
+    helper3 = LayerHelper("cos")
+    cosv = helper3.create_variable_for_type_inference("float32")
+    helper3.append_op(type="cos", inputs={"X": inner},
+                      outputs={"Out": cosv})
+    return layers.scale(cosv, scale=learning_rate * 0.5,
+                        bias=learning_rate * 0.5, bias_after_scale=True)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    from paddle_tpu import layers
+
+    helper = LayerHelper("linear_lr_warmup")
+    step = _global_step(helper)
+    frac = layers.clip(
+        layers.scale(step, scale=1.0 / warmup_steps), 0.0, 1.0)
+    warm = layers.scale(frac, scale=end_lr - start_lr, bias=start_lr)
+    if not hasattr(learning_rate, "name"):
+        learning_rate = tensor.fill_constant(
+            [1], "float32", float(learning_rate))
+    done = layers.greater_than(
+        step, tensor.fill_constant([1], "float32", float(warmup_steps)))
+    return layers.where(done, learning_rate, warm)

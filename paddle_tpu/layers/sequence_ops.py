@@ -1,0 +1,81 @@
+"""Sequence layers over padded tensors + seq_len (reference:
+python/paddle/fluid/layers/nn.py sequence_* functions — see
+paddle_tpu/ops/sequence.py for the LoD->padded design note)."""
+
+from __future__ import annotations
+
+from paddle_tpu.layers.helper import LayerHelper
+
+__all__ = [
+    "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_concat", "sequence_expand", "sequence_first_step",
+    "sequence_last_step", "sequence_enumerate",
+]
+
+
+def sequence_pool(input, pool_type, seq_len=None, pad_value=0.0):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input}
+    if seq_len is not None:
+        inputs["SeqLen"] = seq_len
+    helper.append_op(
+        type="sequence_pool", inputs=inputs, outputs={"Out": out},
+        attrs={"pooltype": pool_type.upper(), "pad_value": pad_value})
+    return out
+
+
+def sequence_first_step(input, seq_len=None):
+    return sequence_pool(input, "first", seq_len)
+
+
+def sequence_last_step(input, seq_len=None):
+    return sequence_pool(input, "last", seq_len)
+
+
+def sequence_softmax(input, seq_len=None, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input}
+    if seq_len is not None:
+        inputs["SeqLen"] = seq_len
+    helper.append_op(type="sequence_softmax", inputs=inputs,
+                     outputs={"Out": out})
+    return out
+
+
+def sequence_reverse(x, seq_len=None, name=None):
+    helper = LayerHelper("sequence_reverse")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x}
+    if seq_len is not None:
+        inputs["SeqLen"] = seq_len
+    helper.append_op(type="sequence_reverse", inputs=inputs,
+                     outputs={"Y": out})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat")
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="sequence_concat", inputs={"X": input},
+                     outputs={"Out": out})
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand", inputs={"X": x, "Y": y},
+                     outputs={"Out": out},
+                     attrs={"ref_level": ref_level})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_enumerate", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"win_size": win_size, "pad_value": pad_value})
+    return out

@@ -1,0 +1,136 @@
+"""Tensor-creation layers (reference: python/paddle/fluid/layers/tensor.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.layers.helper import LayerHelper
+
+__all__ = [
+    "fill_constant", "fill_constant_batch_size_like", "assign",
+    "create_tensor", "create_global_var", "ones", "zeros", "zeros_like",
+    "sums", "range", "linspace", "argmin", "cast_tensor",
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.block.create_var(
+        name=helper.name if name else None, dtype=dtype,
+        persistable=persistable)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    from paddle_tpu.framework import default_startup_program
+
+    helper = LayerHelper("global_var", name=name)
+    var = helper.main_program.global_block().create_var(
+        name=helper.name, shape=shape, dtype=dtype,
+        persistable=persistable)
+    sb = default_startup_program().global_block()
+    sv = sb.create_var(name=helper.name, shape=shape, dtype=dtype,
+                       persistable=persistable)
+    sb.append_op(
+        type="fill_constant", outputs={"Out": sv},
+        attrs={"shape": list(shape), "dtype": dtype,
+               "value": float(value)})
+    return var
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    out = out or helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="fill_constant", outputs={"Out": out},
+        attrs={"shape": list(shape), "dtype": str(np.dtype(dtype)),
+               "value": float(value)})
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="fill_constant_batch_size_like", inputs={"Input": input},
+        outputs={"Out": out},
+        attrs={"shape": list(shape), "dtype": str(np.dtype(dtype)),
+               "value": float(value), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx})
+    out.stop_gradient = True
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, np.ndarray):
+        output = output or helper.create_variable_for_type_inference(
+            str(input.dtype))
+        helper.append_op(
+            type="assign_value", outputs={"Out": output},
+            attrs={"values": input, "dtype": str(input.dtype)})
+        return output
+    output = output or helper.create_variable_for_type_inference(
+        input.dtype)
+    helper.append_op(type="assign", inputs={"X": input},
+                     outputs={"Out": output})
+    return output
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def zeros_like(x, out=None):
+    from paddle_tpu.layers.nn import scale
+
+    return scale(x, scale=0.0)
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    out = out or helper.create_variable_for_type_inference(
+        input[0].dtype)
+    helper.append_op(type="sum", inputs={"X": input},
+                     outputs={"Out": out})
+    return out
+
+
+def range(start, end, step, dtype):
+    helper = LayerHelper("range")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="range", outputs={"Out": out},
+        attrs={"start": start, "end": end, "step": step,
+               "dtype": str(np.dtype(dtype))})
+    return out
+
+
+def linspace(start, stop, num, dtype):
+    helper = LayerHelper("linspace")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="linspace", outputs={"Out": out},
+        attrs={"start": float(start), "stop": float(stop), "num": int(num),
+               "dtype": str(np.dtype(dtype))})
+    return out
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper("arg_min")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="arg_min", inputs={"X": x},
+                     outputs={"Out": out}, attrs={"axis": axis})
+    return out
+
+
+def cast_tensor(x, dtype):
+    from paddle_tpu.layers.nn import cast
+
+    return cast(x, dtype)

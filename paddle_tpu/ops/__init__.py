@@ -1,0 +1,17 @@
+"""Operator library.
+
+Reference parity: /root/reference/paddle/fluid/operators/ (~460 op types).
+Each module registers pure-JAX compute functions with the registry
+(paddle_tpu/core/registry.py); kernels, shape inference and gradients all
+derive from the one function.
+"""
+
+from paddle_tpu.ops import basic  # noqa: F401
+from paddle_tpu.ops import nn  # noqa: F401
+from paddle_tpu.ops import optim  # noqa: F401
+from paddle_tpu.ops import metrics  # noqa: F401
+from paddle_tpu.ops import control_flow  # noqa: F401
+from paddle_tpu.ops import sequence  # noqa: F401
+from paddle_tpu.ops import collective  # noqa: F401
+from paddle_tpu.ops import io_ops  # noqa: F401
+from paddle_tpu.ops import detection  # noqa: F401

@@ -1,0 +1,131 @@
+"""Collective ops (`c_*` family).
+
+Reference parity: /root/reference/paddle/fluid/operators/collective/
+  c_allreduce_op.h (sum/max/min/prod), c_allgather_op.cc,
+  c_reducescatter_op.cc, c_broadcast_op.cc, c_comm_init_op.cc,
+  c_gen_nccl_id_op.cc; plus platform/nccl_helper.h NCCLContextMap.
+
+TPU-first difference: these lower to XLA collectives (lax.psum etc.) that
+ride the ICI mesh when the op runs inside shard_map/pjit with a bound mesh
+axis; there is no NCCL communicator bootstrap (c_comm_init / gen_nccl_id
+become no-ops — the JAX distributed runtime owns device bootstrap).  The
+`ring_id` attr maps to a mesh axis name via the parallel env
+(paddle_tpu/parallel/env.py ring registry).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+
+
+def _axis_for_ring(ring_id):
+    from paddle_tpu.parallel import env
+
+    return env.ring_axis(ring_id)
+
+
+def _in_spmd_context(axis):
+    try:
+        lax.axis_index(axis)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def _register_allreduce(name, op):
+    @register_op(name, inputs=("X",), outputs=("Out",),
+                 attrs={"ring_id": 0, "use_calc_stream": True},
+                 differentiable=False, in_place={"Out": "X"})
+    def _fn(ins, attrs, op=op):
+        axis = _axis_for_ring(attrs["ring_id"])
+        if axis is None or not _in_spmd_context(axis):
+            return {"Out": ins["X"]}  # single-participant ring
+        if op == "sum":
+            return {"Out": lax.psum(ins["X"], axis)}
+        if op == "max":
+            return {"Out": lax.pmax(ins["X"], axis)}
+        if op == "min":
+            return {"Out": lax.pmin(ins["X"], axis)}
+        if op == "prod":
+            return {"Out": jnp.exp(lax.psum(jnp.log(ins["X"]), axis))}
+    return _fn
+
+
+_register_allreduce("c_allreduce_sum", "sum")
+_register_allreduce("c_allreduce_max", "max")
+_register_allreduce("c_allreduce_min", "min")
+_register_allreduce("c_allreduce_prod", "prod")
+
+
+@register_op("c_allgather", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": 0, "nranks": 1}, differentiable=False)
+def c_allgather(ins, attrs):
+    axis = _axis_for_ring(attrs["ring_id"])
+    if axis is None or not _in_spmd_context(axis):
+        return {"Out": ins["X"]}
+    return {"Out": lax.all_gather(ins["X"], axis, tiled=True)}
+
+
+@register_op("c_reducescatter", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": 0, "nranks": 1}, differentiable=False)
+def c_reducescatter(ins, attrs):
+    axis = _axis_for_ring(attrs["ring_id"])
+    if axis is None or not _in_spmd_context(axis):
+        return {"Out": ins["X"]}
+    return {"Out": lax.psum_scatter(ins["X"], axis, tiled=True)}
+
+
+@register_op("c_broadcast", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": 0, "root": 0}, differentiable=False)
+def c_broadcast(ins, attrs):
+    axis = _axis_for_ring(attrs["ring_id"])
+    if axis is None or not _in_spmd_context(axis):
+        return {"Out": ins["X"]}
+    x = ins["X"]
+    idx = lax.axis_index(axis)
+    src = jnp.where(idx == attrs["root"], x, jnp.zeros_like(x))
+    return {"Out": lax.psum(src, axis)}
+
+
+@register_op("c_sync_calc_stream", inputs=("X",), outputs=("Out",),
+             differentiable=False)
+def c_sync_calc_stream(ins, attrs):
+    return {"Out": ins["X"]}  # XLA programs are ordered; no stream sync
+
+
+@register_op("c_sync_comm_stream", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": 0}, differentiable=False)
+def c_sync_comm_stream(ins, attrs):
+    return {"Out": ins["X"]}
+
+
+@register_op("c_comm_init", inputs=(), outputs=(),
+             attrs={"ring_id": 0, "nranks": 1, "rank": 0, "device_id": 0},
+             differentiable=False, host_only=True)
+def c_comm_init(ins, attrs):
+    return {}
+
+
+@register_op("c_gen_nccl_id", inputs=(), outputs=("Out",),
+             attrs={"rank": 0, "endpoint": "", "other_endpoints": []},
+             differentiable=False, host_only=True)
+def c_gen_nccl_id(ins, attrs):
+    return {"Out": jnp.zeros((1,), jnp.int32)}  # bootstrap handled by JAX
+
+
+@register_op("all_to_all", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": 0, "split_axis": 0, "concat_axis": 0},
+             differentiable=False)
+def all_to_all(ins, attrs):
+    axis = _axis_for_ring(attrs["ring_id"])
+    if axis is None or not _in_spmd_context(axis):
+        return {"Out": ins["X"]}
+    return {"Out": lax.all_to_all(
+        ins["X"], axis, attrs["split_axis"], attrs["concat_axis"],
+        tiled=True)}

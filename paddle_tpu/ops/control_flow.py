@@ -1,0 +1,112 @@
+"""Control-flow and feed/fetch ops.
+
+Reference parity:
+  - feed/fetch: /root/reference/paddle/fluid/operators/controlflow/feed_op.cc,
+    fetch_op.cc, framework/feed_fetch_method.cc
+  - while: operators/controlflow/while_op.cc (sub-block attr)
+  - conditional_block: operators/controlflow/conditional_block_op.cc
+  - tensor_array read/write: controlflow/tensor_array_read_write_op.cc
+  - print: operators/print_op.cc
+
+In interpreter mode while/cond run the sub-block through the executor with a
+child scope (reference semantics).  In compiled mode compiler.py lowers them
+to lax.while_loop / lax.cond with the scope-carried vars as loop state —
+XLA-friendly control flow with static shapes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.executor import register_special_op
+from paddle_tpu.core.program import BlockRef
+from paddle_tpu.core.registry import REQUIRED, register_op
+
+
+@register_special_op("feed")
+def feed_op(op, block, scope, ctx):
+    name = op.outputs["Out"][0]
+    col = op.attrs.get("col", 0)
+    key = op.inputs["X"][0] if op.inputs.get("X") else name
+    val = ctx.feed.get(key if key in ctx.feed else name)
+    if val is None:
+        raise RuntimeError(f"feed variable '{name}' was not provided")
+    scope.var(name).set(jnp.asarray(np.asarray(val)))
+
+
+@register_special_op("fetch")
+def fetch_op(op, block, scope, ctx):
+    name = op.inputs["X"][0]
+    var = scope.find_var(name)
+    if var is None:
+        raise RuntimeError(f"fetch '{name}': variable not found")
+    ctx.fetch_results[name] = var.get()
+
+
+@register_special_op("print")
+def print_op(op, block, scope, ctx):
+    name = op.inputs["In"][0]
+    var = scope.find_var(name)
+    msg = op.attrs.get("message", "")
+    print(f"{msg}{name} = {np.asarray(var.get()) if var else None}")
+    out_names = op.outputs.get("Out")
+    if out_names and var is not None:
+        scope.var(out_names[0]).set(var.get())
+
+
+@register_op("print", inputs=("In",), outputs=("Out",),
+             attrs={"message": "", "first_n": -1, "print_phase": "both"},
+             host_only=True, differentiable=False)
+def _print_compute(ins, attrs):
+    return {"Out": ins["In"]}
+
+
+@register_special_op("while")
+def while_op(op, block, scope, ctx):
+    """Runs sub-block until Condition is false (reference while_op.cc).
+    Carried vars live in the parent scope; the sub-block reads/writes them."""
+    sub_idx = op.attrs["sub_block"].idx
+    cond_name = op.inputs["Condition"][0]
+    max_iters = op.attrs.get("max_iters", 10_000_000)
+    it = 0
+    while bool(np.asarray(scope.find_var(cond_name).get())):
+        child = scope  # reference uses step scopes; flat is fine host-side
+        ctx.run_block(sub_idx, child)
+        it += 1
+        if it >= max_iters:
+            raise RuntimeError("while op exceeded max_iters")
+
+
+@register_special_op("conditional_block")
+def conditional_block(op, block, scope, ctx):
+    cond_name = op.inputs["Cond"][0]
+    if bool(np.asarray(scope.find_var(cond_name).get()).reshape(-1)[0]):
+        ctx.run_block(op.attrs["sub_block"].idx, scope)
+
+
+@register_special_op("write_to_array")
+def write_to_array(op, block, scope, ctx):
+    arr_name = op.outputs["Out"][0]
+    x = scope.find_var(op.inputs["X"][0]).get()
+    i = int(np.asarray(scope.find_var(op.inputs["I"][0]).get()))
+    var = scope.var(arr_name)
+    arr = var.get() or []
+    while len(arr) <= i:
+        arr.append(None)
+    arr[i] = x
+    var.set(arr)
+
+
+@register_special_op("read_from_array")
+def read_from_array(op, block, scope, ctx):
+    arr = scope.find_var(op.inputs["X"][0]).get()
+    i = int(np.asarray(scope.find_var(op.inputs["I"][0]).get()))
+    scope.var(op.outputs["Out"][0]).set(arr[i])
+
+
+@register_op("array_length", inputs=("X",), outputs=("Out",),
+             differentiable=False, host_only=True)
+def array_length(ins, attrs):
+    return {"Out": jnp.asarray(len(ins["X"]), jnp.int64)}

@@ -1,0 +1,144 @@
+"""Detection ops (subset; reference /root/reference/paddle/fluid/operators/
+detection/ — anchors, boxes, iou, yolo_box; NMS variants follow in the
+detection milestone)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import REQUIRED, register_op
+
+
+@register_op("iou_similarity", inputs=("X", "Y"), outputs=("Out",),
+             attrs={"box_normalized": True})
+def iou_similarity(ins, attrs):
+    """X: [N,4], Y: [M,4] (xmin,ymin,xmax,ymax) -> [N,M] IoU."""
+    x, y = ins["X"], ins["Y"]
+    off = 0.0 if attrs["box_normalized"] else 1.0
+    ax = (x[:, 2] - x[:, 0] + off) * (x[:, 3] - x[:, 1] + off)
+    ay = (y[:, 2] - y[:, 0] + off) * (y[:, 3] - y[:, 1] + off)
+    xmin = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    ymin = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    xmax = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    ymax = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    iw = jnp.maximum(xmax - xmin + off, 0.0)
+    ih = jnp.maximum(ymax - ymin + off, 0.0)
+    inter = iw * ih
+    return {"Out": inter / (ax[:, None] + ay[None, :] - inter + 1e-10)}
+
+
+@register_op("box_coder", inputs=("PriorBox", "PriorBoxVar", "TargetBox"),
+             outputs=("OutputBox",), optional=("PriorBoxVar",),
+             attrs={"code_type": "encode_center_size",
+                    "box_normalized": True, "axis": 0})
+def box_coder(ins, attrs):
+    prior = ins["PriorBox"]
+    target = ins["TargetBox"]
+    var = ins.get("PriorBoxVar")
+    off = 0.0 if attrs["box_normalized"] else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if attrs["code_type"] == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :],
+            jnp.log(tw[:, None] / pw[None, :]),
+            jnp.log(th[:, None] / ph[None, :]),
+        ], axis=-1)
+        if var is not None:
+            out = out / var[None, :, :]
+        return {"OutputBox": out}
+    # decode_center_size: target [N, M, 4]
+    t = target
+    if var is not None:
+        t = t * var[None, :, :]
+    ocx = t[..., 0] * pw[None, :] + pcx[None, :]
+    ocy = t[..., 1] * ph[None, :] + pcy[None, :]
+    ow = jnp.exp(t[..., 2]) * pw[None, :]
+    oh = jnp.exp(t[..., 3]) * ph[None, :]
+    return {"OutputBox": jnp.stack(
+        [ocx - ow / 2, ocy - oh / 2, ocx + ow / 2 - off,
+         ocy + oh / 2 - off], axis=-1)}
+
+
+@register_op("prior_box", inputs=("Input", "Image"),
+             outputs=("Boxes", "Variances"),
+             attrs={"min_sizes": REQUIRED, "max_sizes": [],
+                    "aspect_ratios": [1.0], "variances": [0.1, 0.1, 0.2,
+                                                          0.2],
+                    "flip": False, "clip": False, "step_w": 0.0,
+                    "step_h": 0.0, "offset": 0.5},
+             differentiable=False)
+def prior_box(ins, attrs):
+    feat, img = ins["Input"], ins["Image"]
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = attrs["step_w"] or iw / fw
+    step_h = attrs["step_h"] or ih / fh
+    ars = list(attrs["aspect_ratios"])
+    if attrs["flip"]:
+        ars = ars + [1.0 / a for a in attrs["aspect_ratios"] if a != 1.0]
+    sizes = []
+    for ms in attrs["min_sizes"]:
+        for ar in ars:
+            sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+    for ms, mx in zip(attrs["min_sizes"], attrs["max_sizes"] or []):
+        s = np.sqrt(ms * mx)
+        sizes.append((s, s))
+    cx = (jnp.arange(fw) + attrs["offset"]) * step_w
+    cy = (jnp.arange(fh) + attrs["offset"]) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    boxes = []
+    for bw, bh in sizes:
+        boxes.append(jnp.stack([
+            (cxg - bw / 2) / iw, (cyg - bh / 2) / ih,
+            (cxg + bw / 2) / iw, (cyg + bh / 2) / ih], axis=-1))
+    out = jnp.stack(boxes, axis=2)  # [fh, fw, nboxes, 4]
+    if attrs["clip"]:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(attrs["variances"]), out.shape)
+    return {"Boxes": out, "Variances": var}
+
+
+@register_op("yolo_box", inputs=("X", "ImgSize"),
+             outputs=("Boxes", "Scores"),
+             attrs={"anchors": REQUIRED, "class_num": REQUIRED,
+                    "conf_thresh": 0.01, "downsample_ratio": 32},
+             differentiable=False)
+def yolo_box(ins, attrs):
+    x, img_size = ins["X"], ins["ImgSize"]
+    n, c, h, w = x.shape
+    anchors = attrs["anchors"]
+    na = len(anchors) // 2
+    nc = attrs["class_num"]
+    x = x.reshape(n, na, 5 + nc, h, w)
+    grid_x = jnp.arange(w)[None, None, None, :]
+    grid_y = jnp.arange(h)[None, None, :, None]
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / h
+    aw = jnp.asarray(anchors[0::2], x.dtype)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], x.dtype)[None, :, None, None]
+    stride = attrs["downsample_ratio"]
+    bw = jnp.exp(x[:, :, 2]) * aw / (w * stride)
+    bh = jnp.exp(x[:, :, 3]) * ah / (h * stride)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    prob = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    mask = (conf >= attrs["conf_thresh"]).astype(x.dtype)
+    ih = img_size[:, 0].reshape(n, 1, 1, 1).astype(x.dtype)
+    iw_ = img_size[:, 1].reshape(n, 1, 1, 1).astype(x.dtype)
+    boxes = jnp.stack([
+        (bx - bw / 2) * iw_, (by - bh / 2) * ih,
+        (bx + bw / 2) * iw_, (by + bh / 2) * ih], axis=-1)
+    boxes = boxes * mask[..., None]
+    boxes = boxes.reshape(n, -1, 4)
+    scores = (prob * mask[:, :, None]).transpose(0, 1, 3, 4, 2)
+    return {"Boxes": boxes, "Scores": scores.reshape(n, -1, nc)}

@@ -1,0 +1,93 @@
+"""Host IO ops: save/load — checkpointing is itself graph execution, like
+the reference (SURVEY.md §5 Checkpoint/resume).
+
+Reference parity: /root/reference/paddle/fluid/operators/save_op.cc,
+load_op.cc, save_combine_op.cc, load_combine_op.cc.
+
+Format: one ``.npz``-style file per var (numpy save) or a combined archive;
+arrays round-trip exactly.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.executor import register_special_op
+from paddle_tpu.core.registry import REQUIRED, register_op
+
+
+def _ensure_dir(path):
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+
+@register_special_op("save")
+def save_op(op, block, scope, ctx):
+    name = op.inputs["X"][0]
+    path = op.attrs["file_path"]
+    _ensure_dir(path)
+    val = scope.find_var(name).get()
+    np.save(path, np.asarray(val), allow_pickle=False)
+
+
+@register_op("save", inputs=("X",), outputs=(),
+             attrs={"file_path": REQUIRED, "overwrite": True},
+             host_only=True, differentiable=False)
+def _save_compute(ins, attrs):
+    return {}
+
+
+@register_special_op("load")
+def load_op(op, block, scope, ctx):
+    name = op.outputs["Out"][0]
+    path = op.attrs["file_path"]
+    if not os.path.exists(path) and os.path.exists(path + ".npy"):
+        path = path + ".npy"
+    scope.var(name).set(jnp.asarray(np.load(path, allow_pickle=False)))
+
+
+@register_op("load", inputs=(), outputs=("Out",),
+             attrs={"file_path": REQUIRED}, host_only=True,
+             differentiable=False)
+def _load_compute(ins, attrs):
+    return {}
+
+
+@register_special_op("save_combine")
+def save_combine_op(op, block, scope, ctx):
+    names = op.inputs["X"]
+    path = op.attrs["file_path"]
+    _ensure_dir(path)
+    arrays = {n: np.asarray(scope.find_var(n).get()) for n in names}
+    np.savez(path, **arrays)
+
+
+@register_op("save_combine", inputs=("X",), outputs=(), duplicable=("X",),
+             attrs={"file_path": REQUIRED, "overwrite": True},
+             host_only=True, differentiable=False)
+def _save_combine_compute(ins, attrs):
+    return {}
+
+
+@register_special_op("load_combine")
+def load_combine_op(op, block, scope, ctx):
+    names = op.outputs["Out"]
+    path = op.attrs["file_path"]
+    if not os.path.exists(path) and os.path.exists(path + ".npz"):
+        path = path + ".npz"
+    with np.load(path, allow_pickle=False) as data:
+        for n in names:
+            scope.var(n).set(jnp.asarray(data[n]))
+
+
+@register_op("load_combine", inputs=(), outputs=("Out",),
+             duplicable=("Out",),
+             attrs={"file_path": REQUIRED}, host_only=True,
+             differentiable=False)
+def _load_combine_compute(ins, attrs):
+    return {}

@@ -1,0 +1,97 @@
+"""Metric ops (reference: /root/reference/paddle/fluid/operators/metrics/
+accuracy_op.cc, auc_op.cc, precision_recall_op.cc)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import REQUIRED, register_op
+
+
+@register_op("accuracy", inputs=("Out", "Indices", "Label"),
+             outputs=("Accuracy", "Correct", "Total"),
+             differentiable=False)
+def accuracy(ins, attrs):
+    """Indices: [N, k] top-k predictions; Label: [N, 1]."""
+    idx, label = ins["Indices"], ins["Label"]
+    lab = label.reshape(-1, 1)
+    correct = jnp.any(idx == lab, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.asarray(idx.shape[0], jnp.int64)
+    return {
+        "Accuracy": num_correct / idx.shape[0],
+        "Correct": num_correct.astype(jnp.int64),
+        "Total": total,
+    }
+
+
+@register_op("auc", inputs=("Predict", "Label", "StatPos", "StatNeg"),
+             outputs=("AUC", "StatPosOut", "StatNegOut"),
+             attrs={"num_thresholds": 4095, "curve": "ROC"},
+             differentiable=False,
+             in_place={"StatPosOut": "StatPos", "StatNegOut": "StatNeg"})
+def auc(ins, attrs):
+    """Streaming AUC via threshold buckets (reference auc_op.cc)."""
+    pred, label = ins["Predict"], ins["Label"]
+    pos_hist, neg_hist = ins["StatPos"], ins["StatNeg"]
+    n = attrs["num_thresholds"]
+    p1 = pred[:, -1] if pred.ndim == 2 else pred.reshape(-1)
+    bucket = jnp.clip((p1 * n).astype(jnp.int32), 0, n)
+    lab = label.reshape(-1).astype(jnp.bool_)
+    pos_hist = pos_hist.at[bucket].add(lab.astype(pos_hist.dtype))
+    neg_hist = neg_hist.at[bucket].add((~lab).astype(neg_hist.dtype))
+    # integrate over descending threshold
+    pos_cum = jnp.cumsum(pos_hist[::-1])
+    neg_cum = jnp.cumsum(neg_hist[::-1])
+    tot_pos = pos_cum[-1]
+    tot_neg = neg_cum[-1]
+    # trapezoid on (fpr, tpr)
+    tpr = pos_cum / jnp.maximum(tot_pos, 1)
+    fpr = neg_cum / jnp.maximum(tot_neg, 1)
+    auc_val = jnp.sum(
+        (fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0
+    ) + fpr[0] * tpr[0] / 2.0
+    return {"AUC": auc_val, "StatPosOut": pos_hist, "StatNegOut": neg_hist}
+
+
+@register_op("precision_recall",
+             inputs=("MaxProbs", "Indices", "Labels", "StatesInfo"),
+             outputs=("BatchMetrics", "AccumMetrics", "AccumStatesInfo"),
+             optional=("StatesInfo",),
+             attrs={"class_number": REQUIRED}, differentiable=False)
+def precision_recall(ins, attrs):
+    import jax
+
+    c = attrs["class_number"]
+    idx = ins["Indices"].reshape(-1).astype(jnp.int32)
+    lab = ins["Labels"].reshape(-1).astype(jnp.int32)
+    tp = jax.ops.segment_sum(
+        (idx == lab).astype(jnp.float64), lab, num_segments=c
+    )
+    pred_cnt = jax.ops.segment_sum(
+        jnp.ones_like(idx, jnp.float64), idx, num_segments=c
+    )
+    lab_cnt = jax.ops.segment_sum(
+        jnp.ones_like(lab, jnp.float64), lab, num_segments=c
+    )
+    fp = pred_cnt - tp
+    fn = lab_cnt - tp
+    states = jnp.stack([tp, fp, fn, jnp.zeros_like(tp)], axis=1)
+    if "StatesInfo" in ins:
+        states = states + ins["StatesInfo"]
+    def metrics(tp, fp, fn):
+        precision = jnp.where(tp + fp > 0, tp / (tp + fp), 0.0)
+        recall = jnp.where(tp + fn > 0, tp / (tp + fn), 0.0)
+        f1 = jnp.where(precision + recall > 0,
+                       2 * precision * recall / (precision + recall), 0.0)
+        return jnp.asarray([jnp.mean(precision), jnp.mean(recall),
+                            jnp.mean(f1),
+                            jnp.sum(tp) / jnp.maximum(
+                                jnp.sum(tp + fp), 1.0),
+                            jnp.sum(tp) / jnp.maximum(
+                                jnp.sum(tp + fn), 1.0),
+                            0.0])
+    batch = metrics(tp, fp, fn)
+    acc = metrics(states[:, 0], states[:, 1], states[:, 2])
+    return {"BatchMetrics": batch, "AccumMetrics": acc,
+            "AccumStatesInfo": states}

@@ -1,0 +1,311 @@
+"""NN ops: convolution, pooling, normalization, rnn cells.
+
+Reference parity:
+  - conv: /root/reference/paddle/fluid/operators/conv_op.cc (+cudnn variants,
+    subsumed by XLA:TPU convolution)
+  - pool: operators/pool_op.cc
+  - batch_norm: operators/batch_norm_op.cc; layer_norm: layer_norm_op.cc;
+    group_norm: group_norm_op.cc
+  - lstm/gru compute: operators/math/{lstm,gru}_compute.cc — here as fused
+    cell ops used by layers.dynamic_lstm analogs and lax.scan loops.
+
+All NCHW, matching the reference's default data_format; conv maps directly to
+lax.conv_general_dilated which XLA tiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import REQUIRED, register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+@register_op("conv2d", inputs=("Input", "Filter"), outputs=("Output",),
+             attrs={"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 1,
+                    "data_format": "NCHW", "use_cudnn": True})
+def conv2d(ins, attrs):
+    x, w = ins["Input"], ins["Filter"]
+    s, p, d = _pair(attrs["strides"]), _pair(attrs["paddings"]), _pair(
+        attrs["dilations"])
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d, dimension_numbers=dn,
+        feature_group_count=attrs["groups"],
+        preferred_element_type=None,
+    )
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d", inputs=("Input", "Filter"),
+             outputs=("Output",),
+             attrs={"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 1,
+                    "data_format": "NCHW", "use_cudnn": False})
+def depthwise_conv2d(ins, attrs):
+    x, w = ins["Input"], ins["Filter"]
+    s, p, d = _pair(attrs["strides"]), _pair(attrs["paddings"]), _pair(
+        attrs["dilations"])
+    groups = attrs["groups"] or x.shape[1]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d, dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("conv2d_transpose", inputs=("Input", "Filter"),
+             outputs=("Output",),
+             attrs={"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 1,
+                    "output_size": [], "data_format": "NCHW"})
+def conv2d_transpose(ins, attrs):
+    x, w = ins["Input"], ins["Filter"]  # w: [in, out/groups, kh, kw]
+    s, p = _pair(attrs["strides"]), _pair(attrs["paddings"])
+    d = _pair(attrs["dilations"])
+    kh = (w.shape[2] - 1) * d[0] + 1
+    kw = (w.shape[3] - 1) * d[1] + 1
+    pad = [(kh - 1 - p[0], kh - 1 - p[0]), (kw - 1 - p[1], kw - 1 - p[1])]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "IOHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad,
+        lhs_dilation=s, rhs_dilation=d, dimension_numbers=dn,
+        feature_group_count=attrs["groups"],
+    )
+    return {"Output": out}
+
+
+@register_op("pool2d", inputs=("X",), outputs=("Out",),
+             attrs={"pooling_type": "max", "ksize": REQUIRED,
+                    "global_pooling": False, "strides": [1, 1],
+                    "paddings": [0, 0], "exclusive": True,
+                    "adaptive": False, "ceil_mode": False,
+                    "data_format": "NCHW"})
+def pool2d(ins, attrs):
+    x = ins["X"]
+    if attrs["adaptive"]:
+        oh, ow = _pair(attrs["ksize"])
+        n, c, h, wd = x.shape
+        x5 = x.reshape(n, c, oh, h // oh, ow, wd // ow)
+        if attrs["pooling_type"] == "max":
+            return {"Out": jnp.max(x5, axis=(3, 5))}
+        return {"Out": jnp.mean(x5, axis=(3, 5))}
+    if attrs["global_pooling"]:
+        k = x.shape[2:4]
+        s, p = k, (0, 0)
+    else:
+        k = _pair(attrs["ksize"])
+        s = _pair(attrs["strides"])
+        p = _pair(attrs["paddings"])
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if attrs["pooling_type"] == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(x, init, lax.max, window, strides, pads)
+        return {"Out": out}
+    out = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    if attrs["exclusive"] and (p[0] or p[1]):
+        ones = jnp.ones(x.shape[2:4], x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, k, s,
+                                ((p[0], p[0]), (p[1], p[1])))
+        out = out / cnt[None, None]
+    else:
+        out = out / (k[0] * k[1])
+    return {"Out": out}
+
+
+@register_op("batch_norm",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance"),
+             attrs={"epsilon": 1e-5, "momentum": 0.9, "is_test": False,
+                    "data_layout": "NCHW", "use_global_stats": False})
+def batch_norm(ins, attrs):
+    """reference batch_norm_op.cc.  Running stats are data inputs/outputs so
+    the op stays pure; the layer wires MeanOut/VarianceOut back onto the same
+    persistable vars (in-place update, like the reference)."""
+    x = ins["X"]
+    scale, bias = ins["Scale"], ins["Bias"]
+    mean, var = ins["Mean"], ins["Variance"]
+    eps, mom = attrs["epsilon"], attrs["momentum"]
+    axes = (0, 2, 3) if (x.ndim == 4 and attrs["data_layout"] == "NCHW") \
+        else tuple(i for i in range(x.ndim) if i != x.ndim - 1) \
+        if attrs["data_layout"] == "NHWC" else (0,) + tuple(range(2, x.ndim))
+    if attrs["is_test"] or attrs["use_global_stats"]:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        mean_out = mean * mom + lax.stop_gradient(use_mean) * (1 - mom)
+        var_out = var * mom + lax.stop_gradient(use_var) * (1 - mom)
+        saved_mean = use_mean
+        saved_var = 1.0 / jnp.sqrt(use_var + eps)
+    shape = [1] * x.ndim
+    c_axis = 1 if attrs["data_layout"] == "NCHW" else x.ndim - 1
+    shape[c_axis] = x.shape[c_axis]
+    rm = use_mean.reshape(shape)
+    rv = use_var.reshape(shape)
+    y = (x - rm) * lax.rsqrt(rv + eps) * scale.reshape(shape) \
+        + bias.reshape(shape)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": saved_mean, "SavedVariance": saved_var}
+
+
+@register_op("layer_norm", inputs=("X", "Scale", "Bias"),
+             outputs=("Y", "Mean", "Variance"),
+             optional=("Scale", "Bias"),
+             attrs={"epsilon": 1e-5, "begin_norm_axis": 1})
+def layer_norm(ins, attrs):
+    x = ins["X"]
+    a = attrs["begin_norm_axis"]
+    axes = tuple(range(a, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + attrs["epsilon"])
+    norm_shape = x.shape[a:]
+    if "Scale" in ins:
+        y = y * ins["Scale"].reshape(norm_shape)
+    if "Bias" in ins:
+        y = y + ins["Bias"].reshape(norm_shape)
+    return {"Y": y, "Mean": jnp.squeeze(mean, axes),
+            "Variance": jnp.squeeze(var, axes)}
+
+
+@register_op("group_norm", inputs=("X", "Scale", "Bias"),
+             outputs=("Y", "Mean", "Variance"),
+             optional=("Scale", "Bias"),
+             attrs={"epsilon": 1e-5, "groups": REQUIRED,
+                    "data_layout": "NCHW"})
+def group_norm(ins, attrs):
+    x = ins["X"]
+    n, c = x.shape[0], x.shape[1]
+    g = attrs["groups"]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + attrs["epsilon"])).reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    if "Scale" in ins:
+        y = y * ins["Scale"].reshape(shape)
+    if "Bias" in ins:
+        y = y + ins["Bias"].reshape(shape)
+    return {"Y": y, "Mean": mean.reshape(n, g), "Variance": var.reshape(n, g)}
+
+
+@register_op("instance_norm", inputs=("X", "Scale", "Bias"),
+             outputs=("Y", "SavedMean", "SavedVariance"),
+             optional=("Scale", "Bias"),
+             attrs={"epsilon": 1e-5})
+def instance_norm(ins, attrs):
+    x = ins["X"]
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + attrs["epsilon"])
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if "Scale" in ins:
+        y = y * ins["Scale"].reshape(shape)
+    if "Bias" in ins:
+        y = y + ins["Bias"].reshape(shape)
+    return {"Y": y, "SavedMean": jnp.squeeze(mean, axes),
+            "SavedVariance": jnp.squeeze(var, axes)}
+
+
+@register_op("lrn", inputs=("X",), outputs=("Out", "MidOut"),
+             attrs={"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75})
+def lrn(ins, attrs):
+    x = ins["X"]
+    n = attrs["n"]
+    sq = jnp.square(x)
+    pad = n // 2
+    sq_p = jnp.pad(sq, ((0, 0), (pad, n - 1 - pad), (0, 0), (0, 0)))
+    acc = sum(sq_p[:, i:i + x.shape[1]] for i in range(n))
+    mid = attrs["k"] + attrs["alpha"] * acc
+    return {"Out": x / jnp.power(mid, attrs["beta"]), "MidOut": mid}
+
+
+# ---------------------------------------------------------------------------
+# fused rnn cells (reference operators/math/lstm_compute, gru_compute) —
+# single-step cells; layers build sequence loops with lax.scan around them.
+# ---------------------------------------------------------------------------
+
+@register_op("lstm_cell", inputs=("X", "HPrev", "CPrev", "W", "B"),
+             outputs=("H", "C"), optional=("B",),
+             attrs={"forget_bias": 0.0})
+def lstm_cell(ins, attrs):
+    """x:[N,D], h_prev/c_prev:[N,H], w:[D+H, 4H] (i,f,c,o), b:[4H]."""
+    x, h_prev, c_prev, w = ins["X"], ins["HPrev"], ins["CPrev"], ins["W"]
+    z = jnp.concatenate([x, h_prev], axis=-1) @ w
+    if "B" in ins:
+        z = z + ins["B"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = jax.nn.sigmoid(f + attrs["forget_bias"]) * c_prev + \
+        jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"H": h, "C": c}
+
+
+@register_op("gru_cell", inputs=("X", "HPrev", "W", "B"),
+             outputs=("H",), optional=("B",), attrs={})
+def gru_cell(ins, attrs):
+    """x:[N,D], h_prev:[N,H], w:[D+H, 3H] (r,u,c), b:[3H]."""
+    x, h_prev, w = ins["X"], ins["HPrev"], ins["W"]
+    d = x.shape[-1]
+    h_dim = h_prev.shape[-1]
+    w_ru = w[:, : 2 * h_dim]
+    w_c = w[:, 2 * h_dim:]
+    z = jnp.concatenate([x, h_prev], axis=-1) @ w_ru
+    if "B" in ins:
+        z = z + ins["B"][: 2 * h_dim]
+    r, u = jnp.split(jax.nn.sigmoid(z), 2, axis=-1)
+    c_in = jnp.concatenate([x, r * h_prev], axis=-1) @ w_c
+    if "B" in ins:
+        c_in = c_in + ins["B"][2 * h_dim:]
+    c = jnp.tanh(c_in)
+    h = u * h_prev + (1.0 - u) * c
+    return {"H": h}
+
+
+@register_op("im2sequence", inputs=("X",), outputs=("Out",),
+             attrs={"kernels": REQUIRED, "strides": [1, 1],
+                    "paddings": [0, 0, 0, 0]})
+def im2sequence(ins, attrs):
+    x = ins["X"]
+    kh, kw = attrs["kernels"]
+    sh, sw = _pair(attrs["strides"])
+    p = attrs["paddings"]
+    x = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID",
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")),
+    )
+    out = patches.reshape(n, c * kh * kw, oh * ow)
+    return {"Out": jnp.transpose(out, (0, 2, 1)).reshape(
+        n * oh * ow, c * kh * kw)}

@@ -1,0 +1,272 @@
+"""Optimizer ops — pure value-in/value-out updates; the executor writes
+ParamOut back onto the Param variable (declared via in_place), matching the
+reference's in-place optimizer kernels.
+
+Reference parity: /root/reference/paddle/fluid/operators/optimizers/
+  sgd_op.cc, momentum_op.cc (+LARS), adam_op.cc, adamax_op.cc, adagrad_op.cc,
+  adadelta_op.cc, rmsprop_op.cc, ftrl_op.cc, lamb_op.cc,
+  decayed_adagrad_op.cc, proximal_gd_op.cc.
+
+Sparse (SelectedRows) gradients are densified by the caller on TPU (dense
+segment-sum beats scatter on the MXU-adjacent memory system); a row-sliced
+sparse path exists for the PS-style embedding service.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import REQUIRED, register_op
+from paddle_tpu.core.scope import SelectedRows
+
+
+def _dense_grad(g):
+    if isinstance(g, SelectedRows):
+        return g.to_dense()
+    return g
+
+
+@register_op("sgd", inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",), differentiable=False,
+             in_place={"ParamOut": "Param"})
+def sgd(ins, attrs):
+    g = _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].astype(ins["Param"].dtype)
+    return {"ParamOut": ins["Param"] - lr * g}
+
+
+@register_op("momentum",
+             inputs=("Param", "Grad", "Velocity", "LearningRate"),
+             outputs=("ParamOut", "VelocityOut"), differentiable=False,
+             attrs={"mu": REQUIRED, "use_nesterov": False},
+             in_place={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def momentum(ins, attrs):
+    p, v = ins["Param"], ins["Velocity"]
+    g = _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].astype(p.dtype)
+    mu = attrs["mu"]
+    v_out = mu * v + g
+    if attrs["use_nesterov"]:
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("lars_momentum",
+             inputs=("Param", "Grad", "Velocity", "LearningRate"),
+             outputs=("ParamOut", "VelocityOut"), differentiable=False,
+             attrs={"mu": REQUIRED, "lars_coeff": 0.001,
+                    "lars_weight_decay": 0.0005},
+             in_place={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def lars_momentum(ins, attrs):
+    p, v = ins["Param"], ins["Velocity"]
+    g = _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].astype(p.dtype)
+    mu, coeff, wd = attrs["mu"], attrs["lars_coeff"], \
+        attrs["lars_weight_decay"]
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = lr * coeff * p_norm / (g_norm + wd * p_norm + 1e-12)
+    v_out = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": p - v_out, "VelocityOut": v_out}
+
+
+@register_op("adam",
+             inputs=("Param", "Grad", "Moment1", "Moment2", "Beta1Pow",
+                     "Beta2Pow", "LearningRate"),
+             outputs=("ParamOut", "Moment1Out", "Moment2Out",
+                      "Beta1PowOut", "Beta2PowOut"),
+             differentiable=False,
+             attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                    "lazy_mode": False},
+             in_place={"ParamOut": "Param", "Moment1Out": "Moment1",
+                       "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+                       "Beta2PowOut": "Beta2Pow"})
+def adam(ins, attrs):
+    p, m1, m2 = ins["Param"], ins["Moment1"], ins["Moment2"]
+    b1p, b2p = ins["Beta1Pow"], ins["Beta2Pow"]
+    g = _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].astype(p.dtype)
+    b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    return {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
+@register_op("adamw",
+             inputs=("Param", "Grad", "Moment1", "Moment2", "Beta1Pow",
+                     "Beta2Pow", "LearningRate"),
+             outputs=("ParamOut", "Moment1Out", "Moment2Out",
+                      "Beta1PowOut", "Beta2PowOut"),
+             differentiable=False,
+             attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                    "weight_decay": 0.01},
+             in_place={"ParamOut": "Param", "Moment1Out": "Moment1",
+                       "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+                       "Beta2PowOut": "Beta2Pow"})
+def adamw(ins, attrs):
+    p = ins["Param"]
+    lr = ins["LearningRate"].astype(p.dtype)
+    out = adam({**ins, "Param": p}, {k: attrs[k] for k in
+                                     ("beta1", "beta2", "epsilon")}
+               | {"lazy_mode": False})
+    out["ParamOut"] = out["ParamOut"] - lr * attrs["weight_decay"] * p
+    return out
+
+
+@register_op("adagrad", inputs=("Param", "Grad", "Moment", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"), differentiable=False,
+             attrs={"epsilon": 1e-6},
+             in_place={"ParamOut": "Param", "MomentOut": "Moment"})
+def adagrad(ins, attrs):
+    p, m = ins["Param"], ins["Moment"]
+    g = _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].astype(p.dtype)
+    m_out = m + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + attrs["epsilon"])
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("adadelta",
+             inputs=("Param", "Grad", "AvgSquaredGrad",
+                     "AvgSquaredUpdate"),
+             outputs=("ParamOut", "AvgSquaredGradOut",
+                      "AvgSquaredUpdateOut"),
+             differentiable=False,
+             attrs={"rho": 0.95, "epsilon": 1e-6},
+             in_place={"ParamOut": "Param",
+                       "AvgSquaredGradOut": "AvgSquaredGrad",
+                       "AvgSquaredUpdateOut": "AvgSquaredUpdate"})
+def adadelta(ins, attrs):
+    p, asg, asu = ins["Param"], ins["AvgSquaredGrad"], \
+        ins["AvgSquaredUpdate"]
+    g = _dense_grad(ins["Grad"])
+    rho, eps = attrs["rho"], attrs["epsilon"]
+    asg_out = rho * asg + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((asu + eps) / (asg_out + eps)) * g
+    asu_out = rho * asu + (1 - rho) * jnp.square(update)
+    return {"ParamOut": p + update, "AvgSquaredGradOut": asg_out,
+            "AvgSquaredUpdateOut": asu_out}
+
+
+@register_op("rmsprop",
+             inputs=("Param", "Grad", "MeanSquare", "MeanGrad", "Moment",
+                     "LearningRate"),
+             outputs=("ParamOut", "MeanSquareOut", "MeanGradOut",
+                      "MomentOut"),
+             differentiable=False,
+             attrs={"decay": 0.9, "momentum": 0.0, "epsilon": 1e-10,
+                    "centered": False},
+             in_place={"ParamOut": "Param", "MeanSquareOut": "MeanSquare",
+                       "MeanGradOut": "MeanGrad", "MomentOut": "Moment"})
+def rmsprop(ins, attrs):
+    p, ms, mg, mom = ins["Param"], ins["MeanSquare"], ins["MeanGrad"], \
+        ins["Moment"]
+    g = _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].astype(p.dtype)
+    rho, eps = attrs["decay"], attrs["epsilon"]
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    if attrs["centered"]:
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - jnp.square(mg_out) + eps
+    else:
+        mg_out = mg
+        denom = ms_out + eps
+    mom_out = attrs["momentum"] * mom + lr * g / jnp.sqrt(denom)
+    return {"ParamOut": p - mom_out, "MeanSquareOut": ms_out,
+            "MeanGradOut": mg_out, "MomentOut": mom_out}
+
+
+@register_op("adamax",
+             inputs=("Param", "Grad", "Moment", "InfNorm", "Beta1Pow",
+                     "LearningRate"),
+             outputs=("ParamOut", "MomentOut", "InfNormOut"),
+             differentiable=False,
+             attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+             in_place={"ParamOut": "Param", "MomentOut": "Moment",
+                       "InfNormOut": "InfNorm"})
+def adamax(ins, attrs):
+    p, m, inf = ins["Param"], ins["Moment"], ins["InfNorm"]
+    g = _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].astype(p.dtype)
+    b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
+    lr_t = lr / (1 - ins["Beta1Pow"])
+    return {"ParamOut": p - lr_t * m_out / inf_out, "MomentOut": m_out,
+            "InfNormOut": inf_out}
+
+
+@register_op("ftrl",
+             inputs=("Param", "Grad", "SquaredAccumulator",
+                     "LinearAccumulator", "LearningRate"),
+             outputs=("ParamOut", "SquaredAccumOut", "LinearAccumOut"),
+             differentiable=False,
+             attrs={"l1": 0.0, "l2": 0.0, "lr_power": -0.5},
+             in_place={"ParamOut": "Param",
+                       "SquaredAccumOut": "SquaredAccumulator",
+                       "LinearAccumOut": "LinearAccumulator"})
+def ftrl(ins, attrs):
+    p, sq, lin = ins["Param"], ins["SquaredAccumulator"], \
+        ins["LinearAccumulator"]
+    g = _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].astype(p.dtype)
+    l1, l2, lrp = attrs["l1"], attrs["l2"], attrs["lr_power"]
+    sq_out = sq + jnp.square(g)
+    sigma = (jnp.power(sq_out, -lrp) - jnp.power(sq, -lrp)) / lr
+    lin_out = lin + g - sigma * p
+    x = -lin_out + jnp.clip(lin_out, -l1, l1)
+    y = jnp.power(sq_out, -lrp) / lr + 2 * l2
+    return {"ParamOut": x / y, "SquaredAccumOut": sq_out,
+            "LinearAccumOut": lin_out}
+
+
+@register_op("lamb",
+             inputs=("Param", "Grad", "Moment1", "Moment2", "Beta1Pow",
+                     "Beta2Pow", "LearningRate"),
+             outputs=("ParamOut", "Moment1Out", "Moment2Out",
+                      "Beta1PowOut", "Beta2PowOut"),
+             differentiable=False,
+             attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6,
+                    "weight_decay": 0.01},
+             in_place={"ParamOut": "Param", "Moment1Out": "Moment1",
+                       "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+                       "Beta2PowOut": "Beta2Pow"})
+def lamb(ins, attrs):
+    p, m1, m2 = ins["Param"], ins["Moment1"], ins["Moment2"]
+    b1p, b2p = ins["Beta1Pow"], ins["Beta2Pow"]
+    g = _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].astype(p.dtype)
+    b1, b2, eps, wd = attrs["beta1"], attrs["beta2"], attrs["epsilon"], \
+        attrs["weight_decay"]
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+    m1_hat = m1_out / (1 - b1p)
+    m2_hat = m2_out / (1 - b2p)
+    r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where(
+        (p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0
+    )
+    return {"ParamOut": p - lr * trust * r, "Moment1Out": m1_out,
+            "Moment2Out": m2_out, "Beta1PowOut": b1p * b1,
+            "Beta2PowOut": b2p * b2}
+
+
+@register_op("decayed_adagrad",
+             inputs=("Param", "Grad", "Moment", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"), differentiable=False,
+             attrs={"decay": 0.95, "epsilon": 1e-6},
+             in_place={"ParamOut": "Param", "MomentOut": "Moment"})
+def decayed_adagrad(ins, attrs):
+    p, m = ins["Param"], ins["Moment"]
+    g = _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].astype(p.dtype)
+    m_out = attrs["decay"] * m + (1 - attrs["decay"]) * jnp.square(g)
+    return {"ParamOut": p - lr * g / (jnp.sqrt(m_out) + attrs["epsilon"]),
+            "MomentOut": m_out}

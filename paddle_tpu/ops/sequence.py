@@ -1,0 +1,161 @@
+"""Sequence ops — the reference's LoD machinery re-specified for TPU.
+
+Reference parity: /root/reference/paddle/fluid/operators/sequence_ops/
+(sequence_pool_op.cc, sequence_softmax_op.cc, sequence_expand_op.cc,
+sequence_reverse_op.cc, sequence_pad_op.cc ...) and framework/lod_tensor.h.
+
+TPU-first difference (SURVEY.md §7 "hard parts" (a)): XLA needs static
+shapes, so variable-length batches are padded [N, T, ...] tensors carried
+with an explicit SeqLen [N] int tensor — the bucketed-padding + mask design
+— instead of LoD offset vectors over a flattened [sum(T_i), ...] tensor.
+Every sequence op here takes (X, SeqLen).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import REQUIRED, register_op
+
+
+def _mask(x, seq_len):
+    """[N, T] bool validity mask broadcastable over x [N, T, ...]."""
+    t = x.shape[1]
+    m = jnp.arange(t)[None, :] < seq_len.reshape(-1, 1)
+    return m.reshape(m.shape + (1,) * (x.ndim - 2))
+
+
+@register_op("sequence_mask", inputs=("X",), outputs=("Y",),
+             attrs={"maxlen": -1, "out_dtype": "float32"},
+             differentiable=False)
+def sequence_mask(ins, attrs):
+    seq_len = ins["X"].reshape(-1)
+    maxlen = attrs["maxlen"]
+    if maxlen <= 0:
+        raise ValueError(
+            "sequence_mask on TPU needs a static maxlen attr (>0)"
+        )
+    m = jnp.arange(maxlen)[None, :] < seq_len[:, None]
+    return {"Y": m.astype(attrs["out_dtype"])}
+
+
+@register_op("sequence_pool", inputs=("X", "SeqLen"), outputs=("Out",),
+             optional=("SeqLen",),
+             attrs={"pooltype": "AVERAGE", "pad_value": 0.0})
+def sequence_pool(ins, attrs):
+    """X: [N, T, ...] padded; SeqLen: [N].  reference sequence_pool_op.cc."""
+    x = ins["X"]
+    if "SeqLen" in ins:
+        seq_len = ins["SeqLen"].reshape(-1)
+    else:
+        seq_len = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    m = _mask(x, seq_len)
+    lens = jnp.maximum(seq_len, 1).astype(x.dtype)
+    lens = lens.reshape((-1,) + (1,) * (x.ndim - 2))
+    pt = attrs["pooltype"].upper()
+    if pt == "SUM":
+        return {"Out": jnp.sum(jnp.where(m, x, 0), axis=1)}
+    if pt == "AVERAGE":
+        return {"Out": jnp.sum(jnp.where(m, x, 0), axis=1) / lens}
+    if pt == "SQRT":
+        return {"Out": jnp.sum(jnp.where(m, x, 0), axis=1)
+                / jnp.sqrt(lens)}
+    if pt == "MAX":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+        return {"Out": jnp.max(jnp.where(m, x, neg), axis=1)}
+    if pt == "LAST":
+        idx = jnp.maximum(seq_len - 1, 0)
+        return {"Out": jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        )[:, 0]}
+    if pt == "FIRST":
+        return {"Out": x[:, 0]}
+    raise ValueError(f"unknown pooltype {pt}")
+
+
+@register_op("sequence_softmax", inputs=("X", "SeqLen"), outputs=("Out",),
+             optional=("SeqLen",), attrs={})
+def sequence_softmax(ins, attrs):
+    x = ins["X"]
+    if "SeqLen" in ins:
+        m = _mask(x, ins["SeqLen"].reshape(-1))
+        x = jnp.where(m, x, jnp.asarray(-1e30, x.dtype))
+    return {"Out": jax.nn.softmax(x, axis=1)}
+
+
+@register_op("sequence_reverse", inputs=("X", "SeqLen"), outputs=("Y",),
+             optional=("SeqLen",), attrs={})
+def sequence_reverse(ins, attrs):
+    x = ins["X"]
+    if "SeqLen" not in ins:
+        return {"Y": jnp.flip(x, axis=1)}
+    seq_len = ins["SeqLen"].reshape(-1)
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    rev = seq_len[:, None] - 1 - pos
+    idx = jnp.where(pos < seq_len[:, None], rev, pos)
+    return {"Y": jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)}
+
+
+@register_op("sequence_expand", inputs=("X", "Y"), outputs=("Out",),
+             attrs={"ref_level": 0})
+def sequence_expand(ins, attrs):
+    """Broadcast per-sequence rows X [N, ...] over time: Out[n, t] = X[n].
+    Padded-form analog of reference sequence_expand_op.cc."""
+    x, y = ins["X"], ins["Y"]
+    t = y.shape[1]
+    return {"Out": jnp.broadcast_to(
+        x[:, None], (x.shape[0], t) + x.shape[1:])}
+
+
+@register_op("sequence_concat", inputs=("X",), outputs=("Out",),
+             duplicable=("X",), attrs={})
+def sequence_concat(ins, attrs):
+    return {"Out": jnp.concatenate(ins["X"], axis=1)}
+
+
+@register_op("sequence_slice", inputs=("X", "Offset", "Length"),
+             outputs=("Out",), attrs={})
+def sequence_slice(ins, attrs):
+    x, off, length = ins["X"], ins["Offset"], ins["Length"]
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    idx = jnp.minimum(off.reshape(-1, 1) + pos, t - 1)
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    valid = pos < length.reshape(-1, 1)
+    return {"Out": jnp.where(
+        valid.reshape(valid.shape + (1,) * (x.ndim - 2)), out, 0)}
+
+
+@register_op("sequence_enumerate", inputs=("X",), outputs=("Out",),
+             attrs={"win_size": REQUIRED, "pad_value": 0},
+             differentiable=False)
+def sequence_enumerate(ins, attrs):
+    x = ins["X"]  # [N, T] ids
+    w = attrs["win_size"]
+    t = x.shape[1]
+    pad = jnp.full((x.shape[0], w - 1), attrs["pad_value"], x.dtype)
+    xp = jnp.concatenate([x, pad], axis=1)
+    wins = jnp.stack([xp[:, i:i + t] for i in range(w)], axis=-1)
+    return {"Out": wins}
+
+
+@register_op("sequence_erase", inputs=("X", "SeqLen"), outputs=("Out",
+             "SeqLenOut"), optional=("SeqLen",),
+             attrs={"tokens": REQUIRED}, differentiable=False)
+def sequence_erase(ins, attrs):
+    """Mask erased tokens to pad and compact via sort (stable) — static
+    shape version of reference sequence_erase_op.cc."""
+    x = ins["X"]
+    keep = jnp.ones_like(x, jnp.bool_)
+    for tok in attrs["tokens"]:
+        keep &= x != tok
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    out = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1)
+    out = jnp.where(jnp.arange(x.shape[1])[None, :] < new_len[:, None],
+                    out, 0)
+    return {"Out": out, "SeqLenOut": new_len}

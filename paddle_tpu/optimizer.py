@@ -1,0 +1,381 @@
+"""Optimizers as IR transformations (reference:
+python/paddle/fluid/optimizer.py — Optimizer base :50, minimize :566 =
+append_backward + _create_optimization_pass :339; SGD :609 ... Lamb :2091).
+
+Each optimizer appends its update ops (op_role=optimize) referencing
+persistable accumulator vars created in both main and startup programs, so a
+checkpoint of persistables captures optimizer state — same capability as the
+reference's accumulator system.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu import unique_name
+from paddle_tpu.backward import append_backward
+from paddle_tpu.core.program import OPTIMIZE
+from paddle_tpu.framework import default_startup_program
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._accumulators: dict = {}
+        self._lr_var = None
+
+    # -- infrastructure ---------------------------------------------------------
+    def _create_lr_var(self, block):
+        if self._lr_var is not None:
+            return self._lr_var
+        if hasattr(self._learning_rate, "name"):  # scheduler-produced var
+            self._lr_var = self._learning_rate
+            return self._lr_var
+        name = unique_name.generate("learning_rate")
+        self._lr_var = block.program.global_block().create_var(
+            name=name, shape=[1], dtype="float32", persistable=True)
+        sb = default_startup_program().global_block()
+        sv = sb.create_var(name=name, shape=[1], dtype="float32",
+                           persistable=True)
+        sb.append_op(
+            type="fill_constant", outputs={"Out": sv},
+            attrs={"shape": [1], "dtype": "float32",
+                   "value": float(self._learning_rate)})
+        return self._lr_var
+
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype=None):
+        key = (name, param.name)
+        if key in self._accumulators:
+            return self._accumulators[key]
+        var_name = unique_name.generate(f"{param.name}_{name}")
+        shape = shape if shape is not None else list(param.shape)
+        dtype = dtype or param.dtype
+        block = param.block.program.global_block()
+        v = block.create_var(name=var_name, shape=shape, dtype=dtype,
+                             persistable=True, stop_gradient=True)
+        sb = default_startup_program().global_block()
+        sv = sb.create_var(name=var_name, shape=shape, dtype=dtype,
+                           persistable=True)
+        sb.append_op(
+            type="fill_constant", outputs={"Out": sv},
+            attrs={"shape": shape, "dtype": dtype,
+                   "value": float(fill_value)})
+        self._accumulators[key] = v
+        return v
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    # -- public -----------------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return append_backward(loss, parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        from paddle_tpu import clip as clip_mod
+
+        block = params_grads[0][0].block.program.global_block()
+        self._create_lr_var(block)
+        # regularization (reference regularizer.py append_regularization_ops)
+        params_grads = self._append_regularization(block, params_grads)
+        for p, g in params_grads:
+            self._append_optimize_op(block, (p, g))
+        return []
+
+    def _append_regularization(self, block, params_grads):
+        from paddle_tpu import layers
+
+        out = []
+        for p, g in params_grads:
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is None:
+                out.append((p, g))
+                continue
+            with _block_guard(block.program):
+                new_g = reg._append_regularization_op(p, g)
+            out.append((p, new_g))
+        return out
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        params_grads = self.backward(loss, startup_program,
+                                     parameter_list, no_grad_set)
+        if grad_clip is not None:
+            params_grads = grad_clip(params_grads)
+        self.apply_gradients(params_grads)
+        return [], params_grads
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _block_guard(program):
+    from paddle_tpu import framework
+
+    old = framework.switch_main_program(program)
+    try:
+        yield
+    finally:
+        framework.switch_main_program(old)
+
+
+class SGD(Optimizer):
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        block.append_op(
+            type="sgd",
+            inputs={"Param": p, "Grad": g,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p}, op_role=OPTIMIZE, infer_shape=False)
+
+
+SGDOptimizer = SGD
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        vel = self._add_accumulator("velocity", p)
+        block.append_op(
+            type="momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": vel,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "VelocityOut": vel},
+            attrs={"mu": self._momentum,
+                   "use_nesterov": self._use_nesterov},
+            op_role=OPTIMIZE, infer_shape=False)
+
+
+MomentumOptimizer = Momentum
+
+
+class LarsMomentum(Optimizer):
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        vel = self._add_accumulator("velocity", p)
+        block.append_op(
+            type="lars_momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": vel,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "VelocityOut": vel},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay},
+            op_role=OPTIMIZE, infer_shape=False)
+
+
+LarsMomentumOptimizer = LarsMomentum
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lazy_mode = lazy_mode
+
+    op_type = "adam"
+    extra_attrs = {}
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow", p, self._beta1, [1])
+        b2p = self._add_accumulator("beta2_pow", p, self._beta2, [1])
+        attrs = {"beta1": self._beta1, "beta2": self._beta2,
+                 "epsilon": self._epsilon}
+        if self.op_type == "adam":
+            attrs["lazy_mode"] = self._lazy_mode
+        attrs.update(self.extra_attrs)
+        block.append_op(
+            type=self.op_type,
+            inputs={"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                    "Beta1Pow": b1p, "Beta2Pow": b2p,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                     "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            attrs=attrs, op_role=OPTIMIZE, infer_shape=False)
+
+
+AdamOptimizer = Adam
+
+
+class AdamW(Adam):
+    op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.extra_attrs = {"weight_decay": weight_decay}
+
+
+class Lamb(Adam):
+    op_type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kwargs)
+        self.extra_attrs = {"weight_decay": lamb_weight_decay}
+
+
+LambOptimizer = Lamb
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._add_accumulator("moment", p)
+        block.append_op(
+            type="adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "MomentOut": m},
+            attrs={"epsilon": self._epsilon}, op_role=OPTIMIZE,
+            infer_shape=False)
+
+
+AdagradOptimizer = Adagrad
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        asg = self._add_accumulator("avg_squared_grad", p)
+        asu = self._add_accumulator("avg_squared_update", p)
+        block.append_op(
+            type="adadelta",
+            inputs={"Param": p, "Grad": g, "AvgSquaredGrad": asg,
+                    "AvgSquaredUpdate": asu},
+            outputs={"ParamOut": p, "AvgSquaredGradOut": asg,
+                     "AvgSquaredUpdateOut": asu},
+            attrs={"rho": self._rho, "epsilon": self._epsilon},
+            op_role=OPTIMIZE, infer_shape=False)
+
+
+AdadeltaOptimizer = Adadelta
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        ms = self._add_accumulator("mean_square", p)
+        mg = self._add_accumulator("mean_grad", p)
+        mom = self._add_accumulator("momentum", p)
+        block.append_op(
+            type="rmsprop",
+            inputs={"Param": p, "Grad": g, "MeanSquare": ms,
+                    "MeanGrad": mg, "Moment": mom,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "MeanSquareOut": ms,
+                     "MeanGradOut": mg, "MomentOut": mom},
+            attrs={"decay": self._rho, "momentum": self._momentum,
+                   "epsilon": self._epsilon,
+                   "centered": self._centered},
+            op_role=OPTIMIZE, infer_shape=False)
+
+
+RMSPropOptimizer = RMSProp
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._add_accumulator("moment", p)
+        inf = self._add_accumulator("inf_norm", p)
+        b1p = self._add_accumulator("beta1_pow", p, self._beta1, [1])
+        block.append_op(
+            type="adamax",
+            inputs={"Param": p, "Grad": g, "Moment": m, "InfNorm": inf,
+                    "Beta1Pow": b1p, "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "MomentOut": m, "InfNormOut": inf},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+            op_role=OPTIMIZE, infer_shape=False)
+        block.append_op(
+            type="scale", inputs={"X": b1p}, outputs={"Out": b1p},
+            attrs={"scale": self._beta1}, op_role=OPTIMIZE,
+            infer_shape=False)
+
+
+AdamaxOptimizer = Adamax
+
+
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        sq = self._add_accumulator("squared", p)
+        lin = self._add_accumulator("linear", p)
+        block.append_op(
+            type="ftrl",
+            inputs={"Param": p, "Grad": g, "SquaredAccumulator": sq,
+                    "LinearAccumulator": lin,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "SquaredAccumOut": sq,
+                     "LinearAccumOut": lin},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power},
+            op_role=OPTIMIZE, infer_shape=False)
+
+
+FtrlOptimizer = Ftrl
+
+
+class DecayedAdagrad(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._add_accumulator("moment", p)
+        block.append_op(
+            type="decayed_adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": p, "MomentOut": m},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+            op_role=OPTIMIZE, infer_shape=False)
+
+
+DecayedAdagradOptimizer = DecayedAdagrad

@@ -1,0 +1,17 @@
+"""Parallelism: device meshes, sharding specs, and distributed strategies.
+
+Reference parity (re-designed, not ported — SURVEY.md §2.4):
+  - ParallelExecutor multi-device DP + NCCL (framework/parallel_executor.cc)
+    -> CompiledProgram.with_data_parallel: batch-sharded pjit over a Mesh.
+  - DistributeTranspiler / fleet -> fleet facade over sharding rules.
+  - NCCLContextMap ring ids -> mesh axis names (env.ring_axis).
+"""
+
+from paddle_tpu.parallel import env
+from paddle_tpu.parallel.env import (
+    ring_axis,
+    register_ring,
+    make_mesh,
+    get_mesh,
+    set_mesh,
+)

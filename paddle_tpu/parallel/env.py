@@ -1,0 +1,58 @@
+"""Parallel environment: the device mesh and ring-id -> mesh-axis mapping.
+
+Reference parity: platform/nccl_helper.h NCCLContextMap (comm per ring_id &
+device) and collective_helper.h NCCLCommContext.  On TPU a "ring" is a mesh
+axis; collectives compile to XLA ops riding ICI (SURVEY.md §5 "Distributed
+communication backend").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+_current_mesh = None
+# ring_id -> mesh axis name; ring 0 defaults to the data axis
+_rings: dict = {}
+
+
+def make_mesh(shape=None, axis_names=None, devices=None):
+    """Build a jax.sharding.Mesh.  Default: 1-D mesh named 'dp' over all
+    devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+        axis_names = axis_names or ("dp",)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    for i, name in enumerate(mesh.axis_names):
+        _rings.setdefault(i, name)
+    return mesh
+
+
+def get_mesh():
+    return _current_mesh
+
+
+def register_ring(ring_id: int, axis_name: str):
+    _rings[ring_id] = axis_name
+
+
+def ring_axis(ring_id: int) -> Optional[str]:
+    return _rings.get(ring_id)
+
+
+def reset():
+    global _current_mesh
+    _current_mesh = None
+    _rings.clear()

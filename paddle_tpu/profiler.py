@@ -1,0 +1,100 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py:225 profiler guard;
+platform/profiler.h RecordEvent; CUPTI DeviceTracer -> here jax.profiler
+which captures XLA:TPU device traces viewable in xprof/tensorboard,
+plus a host op-span recorder with a chrome-trace exporter like
+tools/timeline.py)."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+
+_events = []
+_enabled = False
+
+
+class RecordEvent:
+    """Host event span (reference platform/profiler.h:81)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self.start = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled:
+            _events.append(
+                (self.name, self.start, time.perf_counter_ns()))
+
+
+def start_profiler(state="All"):
+    global _enabled
+    _enabled = True
+    _events.clear()
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    global _enabled
+    _enabled = False
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    if sorted_key:
+        _print_summary(sorted_key)
+
+
+def _print_summary(sorted_key="total"):
+    agg = {}
+    for name, s, e in _events:
+        tot, cnt, mx = agg.get(name, (0, 0, 0))
+        agg[name] = (tot + (e - s), cnt + 1, max(mx, e - s))
+    keyfn = {"total": lambda kv: kv[1][0],
+             "max": lambda kv: kv[1][2],
+             "calls": lambda kv: kv[1][1],
+             "ave": lambda kv: kv[1][0] / kv[1][1]}.get(
+        sorted_key, lambda kv: kv[1][0])
+    print(f"{'Event':40s} {'Calls':>8s} {'Total(ms)':>12s} "
+          f"{'Ave(ms)':>10s} {'Max(ms)':>10s}")
+    for name, (tot, cnt, mx) in sorted(agg.items(), key=keyfn,
+                                       reverse=True):
+        print(f"{name:40s} {cnt:8d} {tot / 1e6:12.3f} "
+              f"{tot / cnt / 1e6:10.3f} {mx / 1e6:10.3f}")
+
+
+def export_chrome_tracing(path):
+    """Chrome trace like the reference's tools/timeline.py."""
+    trace = {"traceEvents": [
+        {"name": name, "ph": "X", "ts": s / 1e3,
+         "dur": (e - s) / 1e3, "pid": 0, "tid": 0}
+        for name, s, e in _events
+    ]}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None):
+    """reference profiler.py:225 profiler guard."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def device_trace(logdir="/tmp/paddle_tpu_trace"):
+    """XLA/TPU device trace via jax.profiler (replaces CUPTI DeviceTracer)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def reset_profiler():
+    _events.clear()

@@ -1,0 +1,229 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py) and a
+PyReader/DataLoader analog feeding the executor.
+
+The C++ double-buffered blocking-queue feed path (reference
+operators/reader/, framework/data_feed.cc) lands with the native data
+milestone (paddle_tpu/data/); this module is the pure-python path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random as _random
+from queue import Queue
+from threading import Thread
+
+__all__ = [
+    "batch", "shuffle", "buffered", "cache", "chain", "compose", "firstn",
+    "map_readers", "xmap_readers", "PyReader", "DataLoader",
+]
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+def shuffle(reader, buf_size):
+    def shuffle_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+
+    return shuffle_reader
+
+
+def buffered(reader, size):
+    end = object()
+
+    def buffered_reader():
+        q = Queue(maxsize=size)
+
+        def worker():
+            for item in reader():
+                q.put(item)
+            q.put(end)
+
+        t = Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                return
+            yield item
+
+    return buffered_reader
+
+
+def cache(reader):
+    data = []
+    filled = [False]
+
+    def cache_reader():
+        if not filled[0]:
+            for item in reader():
+                data.append(item)
+                yield item
+            filled[0] = True
+        else:
+            yield from data
+
+    return cache_reader
+
+
+def chain(*readers):
+    def chain_reader():
+        for r in readers:
+            yield from r()
+
+    return chain_reader
+
+
+def compose(*readers, check_alignment=True):
+    def compose_reader():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+
+    return compose_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def map_readers(func, *readers):
+    def reader():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+
+    return reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Multithreaded map (reference decorator.py xmap_readers)."""
+    end = object()
+
+    def xreader():
+        in_q: Queue = Queue(buffer_size)
+        out_q: Queue = Queue(buffer_size)
+
+        def feeder():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def worker():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, x = item
+                out_q.put((i, mapper(x)))
+
+        Thread(target=feeder, daemon=True).start()
+        for _ in range(process_num):
+            Thread(target=worker, daemon=True).start()
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xreader
+
+
+class PyReader:
+    """Iterable reader bound to feed vars (reference
+    python/paddle/fluid/reader.py:46).  decorate_* then iterate yields feed
+    dicts consumable by Executor.run."""
+
+    def __init__(self, feed_list=None, capacity=64, iterable=True,
+                 return_list=False):
+        self.feed_list = feed_list or []
+        self.capacity = capacity
+        self.iterable = iterable
+        self._generator = None
+        self._batched = False
+
+    def decorate_sample_list_generator(self, generator, places=None):
+        self._generator = generator
+        self._batched = True
+
+    def decorate_batch_generator(self, generator, places=None):
+        self._generator = generator
+        self._batched = False
+
+    def __iter__(self):
+        import numpy as np
+
+        names = [v.name for v in self.feed_list]
+        if self._generator is None:
+            return iter(())
+
+        def gen():
+            for sample in self._generator():
+                if self._batched:
+                    cols = list(zip(*sample))
+                    arrays = [np.asarray(c) for c in cols]
+                else:
+                    arrays = [np.asarray(c) for c in sample]
+                yield dict(zip(names, arrays))
+
+        return gen()
+
+    # non-iterable mode parity helpers
+    def start(self):
+        self._iter = iter(self)
+
+    def reset(self):
+        self._iter = None
+
+
+class DataLoader:
+    """Modern facade (reference 1.5-era fluid.io.DataLoader precursor)."""
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, iterable=True,
+                       return_list=False):
+        return PyReader(feed_list, capacity, iterable, return_list)

@@ -1,0 +1,43 @@
+"""Weight-decay regularizers appended as grad-side ops (reference:
+python/paddle/fluid/regularizer.py:112 L2DecayRegularizer...)."""
+
+from __future__ import annotations
+
+
+class Regularizer:
+    def _append_regularization_op(self, param, grad):
+        raise NotImplementedError
+
+
+class L2Decay(Regularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def _append_regularization_op(self, param, grad):
+        from paddle_tpu import layers
+
+        decay = layers.scale(param, scale=self.coeff)
+        out = layers.elementwise_add(grad, decay)
+        for op in param.block.ops[-2:]:
+            op.op_role = "backward"
+        return out
+
+
+class L1Decay(Regularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def _append_regularization_op(self, param, grad):
+        from paddle_tpu import layers
+
+        sign = layers.elementwise_div(
+            param, layers.elementwise_add(layers.abs(param),
+                                          layers.fill_constant(
+                                              [1], param.dtype, 1e-12)))
+        decay = layers.scale(sign, scale=self.coeff)
+        out = layers.elementwise_add(grad, decay)
+        return out
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

@@ -1,0 +1,34 @@
+"""Unique name generator (reference: python/paddle/fluid/unique_name.py)."""
+
+from __future__ import annotations
+
+import contextlib
+
+_counters: dict = {}
+_prefix: list = []
+
+
+def generate(key: str) -> str:
+    full = "/".join(_prefix + [key]) if _prefix else key
+    n = _counters.get(full, 0)
+    _counters[full] = n + 1
+    return f"{full}_{n}"
+
+
+@contextlib.contextmanager
+def guard(new_prefix=None):
+    global _counters, _prefix
+    old_c, old_p = _counters, _prefix
+    _counters = {}
+    _prefix = [new_prefix] if new_prefix else []
+    try:
+        yield
+    finally:
+        _counters, _prefix = old_c, old_p
+
+
+def switch(new_counters=None):
+    global _counters
+    old = _counters
+    _counters = new_counters if new_counters is not None else {}
+    return old

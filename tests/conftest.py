@@ -1,0 +1,38 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax import so
+multi-device sharding tests run anywhere (SURVEY.md §4 implication:
+reference subprocess-cluster tests -> virtual device mesh tests)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs, scope and name counters."""
+    import paddle_tpu
+    from paddle_tpu import framework, unique_name
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.core.program import Program
+    from paddle_tpu.layers import nn as nn_layers
+
+    old_main = framework.switch_main_program(Program())
+    old_startup = framework.switch_startup_program(Program())
+    old_counters = unique_name.switch({})
+    old_scope = scope_mod._global_scope
+    scope_mod._global_scope = scope_mod.Scope()
+    nn_layers._dropout_counter_var.clear()
+    np.random.seed(0)
+    yield
+    framework.switch_main_program(old_main)
+    framework.switch_startup_program(old_startup)
+    unique_name.switch(old_counters)
+    scope_mod._global_scope = old_scope

@@ -1,0 +1,102 @@
+"""End-to-end training slice: the reference book-test pattern
+(test_fit_a_line.py / test_recognize_digits.py: train until loss drops).
+Runs the interpreter executor AND the compiled path, asserting agreement —
+the OpTest dual-run model (SURVEY.md §4.1, op_test.py:271)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+
+def _build_mlp():
+    img = layers.data("img", shape=[784], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(img, size=64, act="relu")
+    logits = layers.fc(h, size=10)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    return img, label, logits, loss
+
+
+def _synthetic_batch(bs=32, seed=0):
+    rng = np.random.RandomState(seed)
+    img = rng.rand(bs, 784).astype(np.float32)
+    # learnable mapping: label depends on pixel blocks
+    label = (img[:, :10].argmax(axis=1)).astype(np.int64).reshape(bs, 1)
+    return img, label
+
+
+def test_fit_mlp_interpreted():
+    img, label, logits, loss = _build_mlp()
+    optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for i in range(100):
+        bi, bl = _synthetic_batch(seed=i % 4)
+        (lv,) = exe.run(feed={"img": bi, "label": bl},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert losses[-1] < 1.0
+
+
+def test_compiled_matches_interpreted():
+    img, label, logits, loss = _build_mlp()
+    optimizer.SGD(learning_rate=0.1).minimize(loss)
+    main = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    bi, bl = _synthetic_batch(seed=7)
+
+    # interpreted run (seed host RNG so both startup runs draw identically)
+    np.random.seed(42)
+    exe.run(fluid.default_startup_program())
+    interp = [
+        float(exe.run(feed={"img": bi, "label": bl},
+                      fetch_list=[loss])[0])
+        for _ in range(3)
+    ]
+
+    # fresh params, compiled run
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    with scope_guard(Scope()):
+        np.random.seed(42)
+        exe.run(fluid.default_startup_program())
+        compiled = fluid.CompiledProgram(main)
+        comp = [
+            float(exe.run(compiled, feed={"img": bi, "label": bl},
+                          fetch_list=[loss])[0])
+            for _ in range(3)
+        ]
+    np.testing.assert_allclose(interp, comp, rtol=2e-4, atol=1e-5)
+
+
+def test_adam_training_compiled():
+    img, label, logits, loss = _build_mlp()
+    optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    compiled = fluid.CompiledProgram(fluid.default_main_program())
+    losses = []
+    for i in range(80):
+        bi, bl = _synthetic_batch(seed=i % 4)
+        (lv,) = exe.run(compiled, feed={"img": bi, "label": bl},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_fetch_accuracy_metric():
+    img, label, logits, loss = _build_mlp()
+    acc = layers.accuracy(layers.softmax(logits), label)
+    optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    bi, bl = _synthetic_batch()
+    for _ in range(60):
+        lv, av = exe.run(feed={"img": bi, "label": bl},
+                         fetch_list=[loss, acc])
+    assert float(av) > 0.5

@@ -1,0 +1,65 @@
+"""IR construction + serialization round-trip tests (reference test model:
+framework unit tests, e.g. framework/program_desc_test.cc)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.program import Program
+
+
+def test_build_simple_program():
+    main = fluid.default_main_program()
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.fc(x, size=1)
+    assert x.shape == (-1, 13)
+    assert y.shape == (-1, 1)
+    op_types = [op.type for op in main.global_block().ops]
+    assert "mul" in op_types and "elementwise_add" in op_types
+
+
+def test_shape_inference_propagates_batch_dim():
+    x = layers.data("x", shape=[4, 8], dtype="float32")
+    h = layers.fc(x, size=16, num_flatten_dims=1)
+    assert h.shape == (-1, 16)
+    s = layers.softmax(h)
+    assert s.shape == (-1, 16)
+
+
+def test_program_serialization_roundtrip():
+    main = fluid.default_main_program()
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.fc(x, size=7, act="relu")
+    data = main.to_bytes()
+    restored = Program.parse_from_bytes(data)
+    assert len(restored.global_block().ops) == len(
+        main.global_block().ops)
+    assert [op.type for op in restored.global_block().ops] == [
+        op.type for op in main.global_block().ops]
+    rv = restored.global_block().var(y.name)
+    assert tuple(rv.shape) == tuple(y.shape)
+    assert rv.dtype == y.dtype
+
+
+def test_clone_for_test_drops_backward_ops():
+    from paddle_tpu import optimizer
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    optimizer.SGD(0.1).minimize(loss)
+    train_types = {op.op_role for op in
+                   fluid.default_main_program().global_block().ops}
+    assert "backward" in train_types and "optimize" in train_types
+    test_types = {op.op_role for op in test_prog.global_block().ops}
+    assert test_types == {"forward"}
+
+
+def test_parameters_registered():
+    x = layers.data("x", shape=[13], dtype="float32")
+    layers.fc(x, size=3)
+    params = fluid.default_main_program().all_parameters()
+    assert len(params) == 2  # weight + bias
+    assert all(p.persistable for p in params)
