@@ -207,6 +207,10 @@ class CompiledProgram:
         self._cache = {}
         self._donate = True
         self._is_inference = False
+        # optional var-name -> PartitionSpec rule for persistable state
+        # (tensor/expert parallel param layouts; reference analog: the
+        # transpiler deciding where each param shard lives)
+        self._param_sharding_fn = None
 
     # -- parity API -------------------------------------------------------------
     def with_data_parallel(self, loss_name=None, build_strategy=None,
@@ -233,10 +237,28 @@ class CompiledProgram:
             self._data_axis = "dp"
         else:
             self._data_axis = mesh.axis_names[0]
+        self._cache.clear()
         return self
 
     def with_inference_optimize(self, config=None):
         self._is_inference = True
+        return self
+
+    def with_sharding_rules(self, fn, mesh=None):
+        """fn(var_name, shape) -> PartitionSpec or None (replicated).
+        Applies to persistable state; optimizer accumulators whose name
+        extends a param name (e.g. fc_0.w_0_velocity_0) inherit the param's
+        rule when their shape matches."""
+        from paddle_tpu.parallel import env as penv
+
+        if mesh is not None:
+            self._mesh = mesh
+            penv.set_mesh(mesh)
+        if self._mesh is not None and \
+                self._data_axis not in self._mesh.axis_names:
+            self._data_axis = self._mesh.axis_names[0]
+        self._param_sharding_fn = fn
+        self._cache.clear()  # prior jits were built with old shardings
         return self
 
     # -- execution --------------------------------------------------------------
@@ -275,7 +297,48 @@ class CompiledProgram:
                                 *([None] * (len(spec.shape) - 1))))
                 return repl
 
-            state_sh = {k: repl for k in state_names}
+            param_names = sorted(
+                (v.name for v in program.all_parameters()),
+                key=len, reverse=True)
+
+            def state_shard(name, spec):
+                if self._param_sharding_fn is None:
+                    return repl
+                ps = self._param_sharding_fn(name, tuple(spec.shape))
+                if ps is None:
+                    # optimizer accumulators inherit the param's rule when
+                    # their shape matches (longest param-name prefix wins)
+                    for pn in param_names:
+                        if name != pn and name.startswith(pn + "_"):
+                            ps = self._param_sharding_fn(
+                                pn, tuple(spec.shape))
+                            break
+                if ps is None:
+                    return repl
+                spec_axes = tuple(ps)
+                if len(spec_axes) > len(spec.shape):
+                    raise ValueError(
+                        f"sharding rule for '{name}': spec {ps} has more"
+                        f" dims than shape {tuple(spec.shape)}")
+                # refuse specs that don't divide the dims evenly
+                for dim, axes in zip(spec.shape, spec_axes):
+                    if axes is None:
+                        continue
+                    ax_list = axes if isinstance(axes, tuple) else (axes,)
+                    n = 1
+                    for a in ax_list:
+                        if a not in mesh.shape:
+                            raise ValueError(
+                                f"sharding rule for '{name}': unknown mesh"
+                                f" axis '{a}' (mesh axes:"
+                                f" {tuple(mesh.axis_names)})")
+                        n *= mesh.shape[a]
+                    if dim % n != 0:
+                        return repl
+                return NamedSharding(mesh, ps)
+
+            state_sh = {k: state_shard(k, state_specs[k])
+                        for k in state_names}
             feeds_sh = {k: feed_shard(feed_specs[k]) for k in feed_names}
             return jax.jit(
                 step,
@@ -293,6 +356,18 @@ class CompiledProgram:
         feeds = {}
         block = program.global_block()
         for name, val in feed.items():
+            if isinstance(val, jax.Array):
+                # device-resident: no host round-trip, but still coerce to
+                # the declared var dtype (matches the numpy feed path)
+                if block.has_var(name):
+                    v = block.var(name)
+                    if v.dtype is not None:
+                        target = jax.dtypes.canonicalize_dtype(
+                            np.dtype(v.dtype))
+                        if val.dtype != target:
+                            val = val.astype(target)
+                feeds[name] = val
+                continue
             arr = np.asarray(val)
             if block.has_var(name):
                 v = block.var(name)

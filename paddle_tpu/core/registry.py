@@ -208,55 +208,51 @@ def _generic_grad_def(fwd_type: str) -> OpDef:
 # arithmetic) the caller treats the failure as "shape unknown".
 # ---------------------------------------------------------------------------
 
-_DUMMY_DIMS = (1201, 1301, 1409, 1511, 1601, 1709, 1801, 1901, 2003, 2111)
-
-
 def infer_shapes(op_def: OpDef, ins_specs: dict, attrs: dict):
     """ins_specs: slot -> ShapeDtypeStruct or list thereof (shapes may have -1).
 
-    Returns {out_slot: ShapeDtypeStruct-or-list with -1 restored} or None if
-    inference failed.
+    Unknown dims (-1) all get the SAME dummy extent (so broadcasting between
+    two batch-unknown tensors works); running eval_shape twice with two
+    different dummies identifies symbolic output dims: any dim that changes
+    between the runs depends on an unknown input dim and is reported as -1.
+    Returns {out_slot: ShapeDtypeStruct-or-list} or None if inference failed.
     """
-    used = {}
-    counter = [0]
+    had_unknown = [False]
 
-    def sub(spec):
-        shape = []
-        for d in spec.shape:
-            if d is None or d < 0:
-                dummy = _DUMMY_DIMS[counter[0] % len(_DUMMY_DIMS)] + 10 * (
-                    counter[0] // len(_DUMMY_DIMS)
-                )
-                counter[0] += 1
-                used[dummy] = True
-                shape.append(dummy)
-            else:
-                shape.append(d)
-        return jax.ShapeDtypeStruct(tuple(shape), spec.dtype)
+    def sub(spec, dummy):
+        shape = tuple(
+            dummy if (d is None or d < 0) else d for d in spec.shape
+        )
+        if shape != tuple(spec.shape):
+            had_unknown[0] = True
+        return jax.ShapeDtypeStruct(shape, spec.dtype)
 
-    def sub_tree(v):
+    def sub_tree(v, dummy):
         if isinstance(v, (list, tuple)):
-            return [sub_tree(x) for x in v]
-        return sub(v)
+            return [sub_tree(x, dummy) for x in v]
+        return sub(v, dummy)
+
+    def run(dummy):
+        shaped = {k: sub_tree(v, dummy) for k, v in ins_specs.items()}
+        return jax.eval_shape(lambda i: op_def.compute(i, attrs), shaped)
 
     try:
-        shaped = {k: sub_tree(v) for k, v in ins_specs.items()}
-        out = jax.eval_shape(
-            lambda i: op_def.compute(i, attrs), shaped
-        )
+        out_a = run(960)
+        if not had_unknown[0]:
+            return out_a
+        out_b = run(1440)
     except Exception:
         return None
 
-    def unsub(spec):
-        shape = tuple(-1 if d in used else d for d in spec.shape)
-        return jax.ShapeDtypeStruct(shape, spec.dtype)
+    def merge(a, b):
+        if isinstance(a, (list, tuple)):
+            return [merge(x, y) for x, y in zip(a, b)]
+        shape = tuple(
+            da if da == db else -1 for da, db in zip(a.shape, b.shape)
+        )
+        return jax.ShapeDtypeStruct(shape, a.dtype)
 
-    def unsub_tree(v):
-        if isinstance(v, (list, tuple)):
-            return [unsub_tree(x) for x in v]
-        return unsub(v)
-
-    return {k: unsub_tree(v) for k, v in out.items()}
+    return {k: merge(out_a[k], out_b[k]) for k in out_a}
 
 
 def np_dtype(dtype) -> np.dtype:

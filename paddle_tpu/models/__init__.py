@@ -1,0 +1,14 @@
+"""Model zoo mirroring the reference workload ladder (BASELINE.md):
+MNIST MLP, ResNet-50, Transformer-base, BERT-base, DeepFM CTR.
+
+Each builder constructs the IR into the current default programs and returns
+the relevant vars; shapes/hyperparams follow the reference model configs
+(e.g. /root/reference/python/paddle/fluid/tests/unittests/dist_mnist.py,
+dist_se_resnext.py, dist_transformer.py, dist_ctr.py).
+"""
+
+from paddle_tpu.models.mlp import mnist_mlp
+from paddle_tpu.models.resnet import resnet, resnet50
+from paddle_tpu.models.transformer import transformer_encoder_model
+from paddle_tpu.models.bert import bert_model
+from paddle_tpu.models.deepfm import deepfm_model

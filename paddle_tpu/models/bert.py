@@ -1,0 +1,102 @@
+"""BERT-base pretraining model (BASELINE config 4: fused embedding +
+seq-512, masked-LM + next-sentence-prediction heads; reference analog:
+fused_embedding_seq_pool + adam_op workloads)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu import layers
+from paddle_tpu.models.transformer import (
+    _ffn,
+    _residual_norm,
+    multi_head_attention,
+)
+
+
+def bert_model(
+    vocab_size=30522, max_len=512, d_model=768, n_head=12, d_inner=3072,
+    n_layer=12, type_vocab_size=2, dropout_rate=0.1, is_test=False,
+):
+    src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
+    pos = layers.data("pos_ids", shape=[max_len, 1], dtype="int64")
+    sent = layers.data("sent_ids", shape=[max_len, 1], dtype="int64")
+    mask_pos = layers.data("mask_pos", shape=[max_len, 1], dtype="int64")
+    mask_label = layers.data("mask_label", shape=[max_len, 1],
+                             dtype="int64")
+    mask_weight = layers.data("mask_weight", shape=[max_len, 1],
+                              dtype="float32")
+    nsp_label = layers.data("nsp_label", shape=[1], dtype="int64")
+
+    emb = layers.embedding(src, size=[vocab_size, d_model])
+    pos_emb = layers.embedding(pos, size=[max_len, d_model])
+    sent_emb = layers.embedding(sent, size=[type_vocab_size, d_model])
+    x = layers.elementwise_add(
+        layers.elementwise_add(emb, pos_emb), sent_emb)
+    x = layers.layer_norm(x, begin_norm_axis=2)
+    if dropout_rate and not is_test:
+        x = layers.dropout(x, dropout_rate,
+                           dropout_implementation="upscale_in_train")
+    for _ in range(n_layer):
+        attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
+                                    is_test=is_test)
+        x = _residual_norm(x, attn, dropout_rate, is_test)
+        ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
+        x = _residual_norm(x, ffn, dropout_rate, is_test)
+
+    # masked-LM head: gather masked positions per batch row
+    mlm_h = layers.fc(x, d_model, num_flatten_dims=2, act="gelu")
+    mlm_h = layers.layer_norm(mlm_h, begin_norm_axis=2)
+    mlm_logits = layers.fc(mlm_h, vocab_size, num_flatten_dims=2,
+                           bias_attr=False)
+    # mask_pos selects positions: use one_hot matmul-free gather via
+    # take_along on time axis (gather per row)
+    mlm_sel = _gather_time(mlm_logits, mask_pos, max_len)
+    mlm_loss_tok = layers.softmax_with_cross_entropy(mlm_sel, mask_label)
+    weighted = layers.elementwise_mul(mlm_loss_tok, mask_weight)
+    mlm_loss = layers.elementwise_div(
+        layers.reduce_sum(weighted),
+        layers.elementwise_add(layers.reduce_sum(mask_weight),
+                               layers.fill_constant([], "float32", 1e-6)))
+
+    # NSP head on [CLS]
+    cls = layers.slice(x, axes=[1], starts=[0], ends=[1])
+    cls = layers.reshape(cls, [-1, d_model])
+    pooled = layers.fc(cls, d_model, act="tanh")
+    nsp_logits = layers.fc(pooled, 2)
+    nsp_loss = layers.mean(
+        layers.softmax_with_cross_entropy(nsp_logits, nsp_label))
+
+    loss = layers.elementwise_add(mlm_loss, nsp_loss)
+    return {"src_ids": src, "pos_ids": pos, "sent_ids": sent,
+            "mask_pos": mask_pos, "mask_label": mask_label,
+            "mask_weight": mask_weight, "nsp_label": nsp_label,
+            "loss": loss, "mlm_loss": mlm_loss, "nsp_loss": nsp_loss}
+
+
+def _gather_time(x, idx, t):
+    """x: [B, T, V]; idx: [B, T, 1] int64 positions -> [B, T, V] rows
+    gathered along time (static-shape take_along_axis built from one_hot
+    matmul — MXU-friendly, no dynamic gather)."""
+    sel = layers.one_hot(idx, t)            # [B, T, T]
+    return layers.matmul(sel, x)            # [B, T, V]
+
+
+def bert_inputs_synthetic(batch, max_len=512, vocab_size=30522, seed=0):
+    rng = np.random.RandomState(seed)
+    n_mask = max(1, max_len // 7)
+    mask_weight = np.zeros((batch, max_len, 1), np.float32)
+    mask_weight[:, :n_mask] = 1.0
+    return {
+        "src_ids": rng.randint(0, vocab_size,
+                               (batch, max_len, 1)).astype(np.int64),
+        "pos_ids": np.tile(np.arange(max_len)[None, :, None],
+                           (batch, 1, 1)).astype(np.int64),
+        "sent_ids": np.zeros((batch, max_len, 1), np.int64),
+        "mask_pos": rng.randint(0, max_len,
+                                (batch, max_len, 1)).astype(np.int64),
+        "mask_label": rng.randint(0, vocab_size,
+                                  (batch, max_len, 1)).astype(np.int64),
+        "mask_weight": mask_weight,
+        "nsp_label": rng.randint(0, 2, (batch, 1)).astype(np.int64),
+    }

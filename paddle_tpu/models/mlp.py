@@ -1,0 +1,18 @@
+"""MNIST MLP (reference: tests/book/test_recognize_digits.py mlp net)."""
+
+from __future__ import annotations
+
+from paddle_tpu import layers
+
+
+def mnist_mlp(hidden=(128, 64), num_classes=10, img_dim=784):
+    img = layers.data("img", shape=[img_dim], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = img
+    for width in hidden:
+        h = layers.fc(h, size=width, act="relu")
+    logits = layers.fc(h, size=num_classes)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return {"img": img, "label": label, "logits": logits, "loss": loss,
+            "acc": acc}

@@ -1,0 +1,166 @@
+"""Transformer (reference model: the fluid transformer NMT config used by
+tests/unittests/dist_transformer.py; BASELINE config 3 Transformer-base).
+
+Built entirely from IR layers (matmul/softmax/layer_norm/fc) so the program
+compiles to one XLA module; attention is batched [B, H, T, D/H] matmuls that
+XLA tiles onto the MXU.  Sharding-friendly: the fc weights carry optional
+tensor-parallel annotations set by parallel/strategies.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu import layers
+
+
+def _positional_encoding(max_len, d_model, dtype="float32"):
+    pos = np.arange(max_len)[:, None]
+    i = np.arange(d_model)[None, :]
+    angle = pos / np.power(10000.0, (2 * (i // 2)) / d_model)
+    enc = np.zeros((max_len, d_model), np.float64)
+    enc[:, 0::2] = np.sin(angle[:, 0::2])
+    enc[:, 1::2] = np.cos(angle[:, 1::2])
+    return enc.astype(dtype)
+
+
+def multi_head_attention(q_in, kv_in, d_model, n_head, dropout_rate=0.0,
+                         causal=False, is_test=False, seq_len_q=None,
+                         seq_len_kv=None, name=None):
+    """q_in: [B, Tq, D]; kv_in: [B, Tk, D]."""
+    tq = q_in.shape[1]
+    tk = kv_in.shape[1]
+    head_dim = d_model // n_head
+    q = layers.fc(q_in, d_model, num_flatten_dims=2, bias_attr=False)
+    k = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False)
+    v = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False)
+
+    def split_heads(x, t):
+        x = layers.reshape(x, [-1, t, n_head, head_dim])
+        return layers.transpose(x, [0, 2, 1, 3])  # [B, H, T, hd]
+
+    q = split_heads(q, tq)
+    k = split_heads(k, tk)
+    v = split_heads(v, tk)
+    attn = layers.matmul(q, k, transpose_y=True,
+                         alpha=float(head_dim) ** -0.5)  # [B,H,Tq,Tk]
+    if causal:
+        mask = np.triu(np.full((tq, tk), -1e9, np.float32), k=1)
+        mask_var = layers.assign(mask.reshape(1, 1, tq, tk))
+        attn = layers.elementwise_add(attn, mask_var)
+    weights = layers.softmax(attn)
+    if dropout_rate and not is_test:
+        weights = layers.dropout(weights, dropout_rate,
+                                 dropout_implementation="upscale_in_train")
+    out = layers.matmul(weights, v)  # [B,H,Tq,hd]
+    out = layers.transpose(out, [0, 2, 1, 3])
+    out = layers.reshape(out, [-1, tq, d_model])
+    return layers.fc(out, d_model, num_flatten_dims=2, bias_attr=False)
+
+
+def _ffn(x, d_model, d_inner, dropout_rate, is_test):
+    h = layers.fc(x, d_inner, num_flatten_dims=2, act="relu")
+    if dropout_rate and not is_test:
+        h = layers.dropout(h, dropout_rate,
+                           dropout_implementation="upscale_in_train")
+    return layers.fc(h, d_model, num_flatten_dims=2)
+
+
+def _residual_norm(x, sub, dropout_rate, is_test):
+    if dropout_rate and not is_test:
+        sub = layers.dropout(sub, dropout_rate,
+                             dropout_implementation="upscale_in_train")
+    return layers.layer_norm(layers.elementwise_add(x, sub),
+                             begin_norm_axis=2)
+
+
+def encoder_layer(x, d_model, n_head, d_inner, dropout_rate=0.1,
+                  is_test=False):
+    attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
+                                is_test=is_test)
+    x = _residual_norm(x, attn, dropout_rate, is_test)
+    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
+    return _residual_norm(x, ffn, dropout_rate, is_test)
+
+
+def decoder_layer(x, enc_out, d_model, n_head, d_inner, dropout_rate=0.1,
+                  is_test=False):
+    self_attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
+                                     causal=True, is_test=is_test)
+    x = _residual_norm(x, self_attn, dropout_rate, is_test)
+    cross = multi_head_attention(x, enc_out, d_model, n_head,
+                                 dropout_rate, is_test=is_test)
+    x = _residual_norm(x, cross, dropout_rate, is_test)
+    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
+    return _residual_norm(x, ffn, dropout_rate, is_test)
+
+
+def _embed(ids, vocab_size, d_model, max_len, dropout_rate, is_test,
+           scale_embedding=True):
+    emb = layers.embedding(ids, size=[vocab_size, d_model])
+    if scale_embedding:
+        emb = layers.scale(emb, scale=float(d_model) ** 0.5)
+    pe = layers.assign(
+        _positional_encoding(max_len, d_model)[None, :, :])
+    emb = layers.elementwise_add(emb, pe)
+    if dropout_rate and not is_test:
+        emb = layers.dropout(emb, dropout_rate,
+                             dropout_implementation="upscale_in_train")
+    return emb
+
+
+def transformer_encoder_model(
+    vocab_size=32000, max_len=256, d_model=512, n_head=8, d_inner=2048,
+    n_layer=6, dropout_rate=0.1, is_test=False, tie_embeddings=False,
+    label_smooth_eps=0.0,
+):
+    """Encoder-only LM-style transformer: next-token prediction over a
+    single stream (the flagship shape for bench/graft entry; the NMT
+    encoder-decoder variant is `transformer_nmt_model`)."""
+    src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
+    label = layers.data("tgt_label", shape=[max_len, 1], dtype="int64")
+    x = _embed(src, vocab_size, d_model, max_len, dropout_rate, is_test)
+    # causal self-attention stack
+    for _ in range(n_layer):
+        attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
+                                    causal=True, is_test=is_test)
+        x = _residual_norm(x, attn, dropout_rate, is_test)
+        ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
+        x = _residual_norm(x, ffn, dropout_rate, is_test)
+    logits = layers.fc(x, vocab_size, num_flatten_dims=2,
+                       bias_attr=False)
+    if label_smooth_eps:
+        one_hot = layers.one_hot(label, vocab_size)
+        smoothed = layers.label_smooth(one_hot, epsilon=label_smooth_eps)
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            logits, smoothed, soft_label=True))
+    else:
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+    return {"src_ids": src, "tgt_label": label, "logits": logits,
+            "loss": loss}
+
+
+def transformer_nmt_model(
+    src_vocab_size=32000, tgt_vocab_size=32000, max_len=256, d_model=512,
+    n_head=8, d_inner=2048, n_layer=6, dropout_rate=0.1, is_test=False,
+):
+    """Encoder-decoder NMT transformer (Transformer-base when defaults)."""
+    src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
+    tgt = layers.data("tgt_ids", shape=[max_len, 1], dtype="int64")
+    label = layers.data("tgt_label", shape=[max_len, 1], dtype="int64")
+    enc = _embed(src, src_vocab_size, d_model, max_len, dropout_rate,
+                 is_test)
+    for _ in range(n_layer):
+        enc = encoder_layer(enc, d_model, n_head, d_inner, dropout_rate,
+                            is_test)
+    dec = _embed(tgt, tgt_vocab_size, d_model, max_len, dropout_rate,
+                 is_test)
+    for _ in range(n_layer):
+        dec = decoder_layer(dec, enc, d_model, n_head, d_inner,
+                            dropout_rate, is_test)
+    logits = layers.fc(dec, tgt_vocab_size, num_flatten_dims=2,
+                       bias_attr=False)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    return {"src_ids": src, "tgt_ids": tgt, "tgt_label": label,
+            "logits": logits, "loss": loss}

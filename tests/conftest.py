@@ -4,12 +4,22 @@ reference subprocess-cluster tests -> virtual device mesh tests)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard-set: the session env may preset JAX_PLATFORMS to the real TPU
+# (e.g. 'axon'); tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = os.environ.get(
+    "PADDLE_TPU_TEST_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# the session sitecustomize (axon TPU tunnel) overrides JAX_PLATFORMS at
+# interpreter start; the config API takes precedence over both.
+jax.config.update("jax_platforms",
+                  os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu"))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
