@@ -1,0 +1,45 @@
+"""Imperative (dygraph) mode — eager execution with tape autograd.
+
+Reference parity: /root/reference/paddle/fluid/imperative/ (Tracer, VarBase,
+Engine) + /root/reference/python/paddle/fluid/dygraph/ (guard, to_variable,
+Layer, nn modules, checkpoint, DataParallel).
+"""
+
+from paddle_tpu.dygraph.base import (
+    VarBase,
+    Tracer,
+    enabled,
+    guard,
+    no_grad,
+    to_variable,
+)
+from paddle_tpu.dygraph.layers import Layer
+from paddle_tpu.dygraph import nn
+from paddle_tpu.dygraph.nn import (
+    BatchNorm,
+    Conv2D,
+    Conv2DTranspose,
+    Dropout,
+    Embedding,
+    FC,
+    GRUUnit,
+    LayerNorm,
+    Linear,
+    Pool2D,
+    PRelu,
+)
+from paddle_tpu.dygraph.checkpoint import save_dygraph, load_dygraph
+from paddle_tpu.dygraph.parallel import (
+    DataParallel,
+    Env,
+    ParallelEnv,
+    prepare_context,
+)
+
+__all__ = [
+    "VarBase", "Tracer", "enabled", "guard", "no_grad", "to_variable",
+    "Layer", "nn", "BatchNorm", "Conv2D", "Conv2DTranspose", "Dropout",
+    "Embedding", "FC", "GRUUnit", "LayerNorm", "Linear", "Pool2D", "PRelu",
+    "save_dygraph", "load_dygraph", "DataParallel", "Env", "ParallelEnv",
+    "prepare_context",
+]

@@ -1,0 +1,387 @@
+"""Imperative (dygraph) core: eager op execution + tape autograd.
+
+Reference parity:
+  - Tracer::Trace runs an op eagerly through the same kernel registry and
+    records the backward chain: /root/reference/paddle/fluid/imperative/
+    tracer.cc, tracer.h:41
+  - VarBase (eager variable with grad slot) / OpBase:
+    /root/reference/paddle/fluid/imperative/layer.h:133,334
+  - backward Engine walk: /root/reference/paddle/fluid/imperative/engine.cc
+  - python guard/to_variable: /root/reference/python/paddle/fluid/dygraph/base.py
+
+TPU-first difference: there is no separate eager kernel path — each op's
+registered JAX compute runs directly (XLA compiles per-op, cached by shape),
+and the backward walk derives each op's vjp from the same forward compute
+instead of dispatching hand-written grad kernels.  The tape stores VarBase
+references, so backward is a reverse walk with jax.vjp per record.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.core.registry import get_op_def
+
+__all__ = [
+    "guard", "enabled", "to_variable", "no_grad", "VarBase", "Tracer",
+    "grad_var_name",
+]
+
+_tracer: Optional["Tracer"] = None
+
+
+def _current_tracer() -> Optional["Tracer"]:
+    return _tracer
+
+
+def grad_var_name(name: str) -> str:
+    return name + "@GRAD"
+
+
+class VarBase:
+    """Eager variable: a jax array + grad slot (reference layer.h:133)."""
+
+    def __init__(self, value, name=None, stop_gradient=False,
+                 persistable=False):
+        import jax.numpy as jnp
+
+        from paddle_tpu import unique_name
+
+        if isinstance(value, VarBase):
+            value = value.value
+        if not hasattr(value, "dtype") or isinstance(value, np.ndarray):
+            value = jnp.asarray(np.asarray(value))
+        self.value = value
+        self.name = name or unique_name.generate("tmp_var")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.is_parameter = False
+        self.trainable = True
+        self._grad = None
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self):
+        out = VarBase(self.value, name=self.name + ".detached",
+                      stop_gradient=True)
+        return out
+
+    def astype(self, dtype):
+        return _trace_op1("cast", {"X": self},
+                          {"out_dtype": str(np.dtype(dtype))})
+
+    def set_value(self, value):
+        import jax.numpy as jnp
+
+        if isinstance(value, VarBase):
+            value = value.value
+        self.value = jnp.asarray(np.asarray(value)) \
+            if isinstance(value, np.ndarray) else value
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, retain_graph=False):
+        tracer = _current_tracer()
+        if tracer is None:
+            raise RuntimeError("VarBase.backward() outside dygraph.guard()")
+        tracer.run_backward(self, retain_graph=retain_graph)
+
+    # -- operator sugar (routes through the op registry) -------------------
+    def _binary(self, other, op_type, reverse=False):
+        if not isinstance(other, VarBase):
+            import jax.numpy as jnp
+
+            other = VarBase(jnp.asarray(other, dtype=self.value.dtype),
+                            stop_gradient=True)
+        x, y = (other, self) if reverse else (self, other)
+        return _trace_op1(op_type, {"X": x, "Y": y}, {"axis": -1})
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elementwise_div", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "elementwise_pow")
+
+    def __neg__(self):
+        return _trace_op1("scale", {"X": self}, {"scale": -1.0,
+                                                 "bias": 0.0})
+
+    def __matmul__(self, o):
+        return _trace_op1("matmul", {"X": self, "Y": o},
+                          {"transpose_X": False, "transpose_Y": False,
+                           "alpha": 1.0})
+
+    def __repr__(self):
+        return (f"VarBase(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, stop_gradient={self.stop_gradient})")
+
+    def __len__(self):
+        return int(self.value.shape[0])
+
+
+class _OpRecord:
+    """Tape entry.  Inputs are held strongly (backward needs their values);
+    outputs are held weakly so a forward-only loop (inference under guard())
+    lets dead activations collapse the chain — records whose outputs have
+    all died can never receive a cotangent and are pruned (the reference
+    gets the same effect from VarBase->OpBase ownership + Python GC)."""
+
+    __slots__ = ("op_def", "attrs", "ins", "_out_refs")
+
+    def __init__(self, op_def, attrs, ins, outs):
+        import weakref
+
+        self.op_def = op_def
+        self.attrs = attrs
+        self.ins = ins        # slot -> VarBase | [VarBase]
+        self._out_refs = {s: [weakref.ref(v) for v in vs]
+                          for s, vs in outs.items()}
+
+    def live_outs(self):
+        """slot -> [VarBase | None]."""
+        return {s: [r() for r in refs]
+                for s, refs in self._out_refs.items()}
+
+    def all_outs_dead(self):
+        return all(r() is None for refs in self._out_refs.values()
+                   for r in refs)
+
+
+def _is_diff_leaf(v: VarBase) -> bool:
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(v.value.dtype, jnp.inexact)
+
+
+def _slot_vars(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+class Tracer:
+    """Eager op runner + tape (reference imperative/tracer.h:41)."""
+
+    _PRUNE_EVERY = 256
+
+    def __init__(self):
+        self._tape: list = []
+        self._recording = True
+        self._touched_params: dict = {}   # id -> VarBase, insertion ordered
+        self._trace_count = 0
+
+    # -- forward -----------------------------------------------------------
+    def trace(self, op_type, ins, attrs=None, stop_gradient=False):
+        """Run op ``op_type`` eagerly.  ins: slot -> VarBase | [VarBase].
+        Returns slot -> VarBase | [VarBase] of freshly created outputs."""
+        op_def = get_op_def(op_type)
+        attrs = op_def.canonical_attrs(attrs or {})
+        raw_ins = {}
+        for slot, v in ins.items():
+            if v is None:
+                continue
+            if isinstance(v, (list, tuple)):
+                raw_ins[slot] = [x.value for x in v]
+            else:
+                raw_ins[slot] = v.value
+        raw_outs = op_def.compute(raw_ins, attrs) or {}
+
+        any_requires = any(
+            not v.stop_gradient and _is_diff_leaf(v)
+            for val in ins.values() if val is not None
+            for v in _slot_vars(val)
+        )
+        out_stop = (stop_gradient or not self._recording
+                    or not any_requires or not op_def.differentiable)
+        outs, out_vars = {}, {}
+        for slot, val in raw_outs.items():
+            vals = val if isinstance(val, (list, tuple)) else [val]
+            vs = [VarBase(x, stop_gradient=out_stop) for x in vals]
+            out_vars[slot] = vs
+            outs[slot] = vs if isinstance(val, (list, tuple)) else vs[0]
+
+        if self._recording and not out_stop:
+            live_ins = {s: v for s, v in ins.items() if v is not None}
+            self._tape.append(_OpRecord(op_def, attrs, live_ins, out_vars))
+            for val in live_ins.values():
+                for v in _slot_vars(val):
+                    if v.is_parameter and not v.stop_gradient:
+                        self._touched_params[id(v)] = v
+            self._trace_count += 1
+            if self._trace_count % self._PRUNE_EVERY == 0:
+                self._prune_dead()
+        return outs
+
+    def _prune_dead(self):
+        """Drop records whose outputs all died; dropping one frees its
+        strong input refs, which may kill upstream outputs — iterate to a
+        fixpoint so whole dead chains collapse in one pass."""
+        while True:
+            kept = [r for r in self._tape if not r.all_outs_dead()]
+            if len(kept) == len(self._tape):
+                return
+            self._tape = kept
+
+    def touched_parameters(self):
+        return list(self._touched_params.values())
+
+    # -- backward ----------------------------------------------------------
+    def run_backward(self, loss: VarBase, retain_graph=False):
+        import jax
+        import jax.numpy as jnp
+
+        loss._grad = jnp.ones_like(loss.value)
+        loss_id = id(loss)
+        for rec in reversed(self._tape):
+            rec_outs = rec.live_outs()
+            has_grad = any(v is not None and v._grad is not None
+                           for vs in rec_outs.values() for v in vs)
+            if not has_grad:
+                continue
+
+            # split differentiable vs. pass-through inputs, like the generic
+            # grad maker (core/registry.py _generic_grad_def)
+            diff, nondiff = {}, {}
+            for slot, val in rec.ins.items():
+                vars_ = _slot_vars(val)
+                if all(_is_diff_leaf(v) for v in vars_) and any(
+                        not v.stop_gradient for v in vars_):
+                    diff[slot] = [v.value for v in vars_] \
+                        if isinstance(val, (list, tuple)) else val.value
+                else:
+                    nondiff[slot] = [v.value for v in vars_] \
+                        if isinstance(val, (list, tuple)) else val.value
+
+            if not diff:
+                continue
+            op_def, attrs = rec.op_def, rec.attrs
+            out_slots = list(rec_outs)
+
+            def f(d):
+                outs = op_def.compute({**d, **nondiff}, attrs)
+                res = {}
+                for s in out_slots:
+                    val = outs[s]
+                    res[s] = list(val) if isinstance(val, (list, tuple)) \
+                        else [val]
+                return res
+
+            primal, vjp = jax.vjp(f, diff)
+            cts = jax.tree_util.tree_map(jnp.zeros_like, primal)
+            for slot, vs in rec_outs.items():
+                for i, v in enumerate(vs):
+                    if v is not None and v._grad is not None:
+                        cts[slot][i] = v._grad.astype(
+                            primal[slot][i].dtype)
+            (d_in,) = vjp(cts)
+            for slot, gval in d_in.items():
+                orig = rec.ins[slot]
+                if isinstance(orig, (list, tuple)):
+                    pairs = zip(orig, gval)
+                else:
+                    pairs = [(orig, gval)]
+                for v, g in pairs:
+                    if v.stop_gradient:
+                        continue
+                    v._grad = g if v._grad is None else v._grad + g
+            # free intermediate output grads (they are fully consumed);
+            # keep the loss's own grad
+            for vs in rec_outs.values():
+                for v in vs:
+                    if v is not None and not v.persistable \
+                            and not v.is_parameter and id(v) != loss_id:
+                        v._grad = None
+        if not retain_graph:
+            self._tape.clear()
+
+    @contextlib.contextmanager
+    def pause_recording(self):
+        old = self._recording
+        self._recording = False
+        try:
+            yield
+        finally:
+            self._recording = old
+
+
+def _trace_op1(op_type, ins, attrs=None):
+    """Trace an op with a single 'Out' output; create tracer on demand so
+    VarBase arithmetic also works outside guard() (stop-gradient eager)."""
+    tracer = _current_tracer() or Tracer()
+    out = tracer.trace(op_type, ins, attrs)
+    return out["Out"]
+
+
+def enabled() -> bool:
+    return framework.in_dygraph_mode()
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Enter imperative mode (reference dygraph/base.py guard)."""
+    global _tracer
+    old_tracer = _tracer
+    _tracer = Tracer()
+    with framework._dygraph_guard(True):
+        try:
+            yield
+        finally:
+            _tracer = old_tracer
+
+
+@contextlib.contextmanager
+def no_grad():
+    tracer = _current_tracer()
+    if tracer is None:
+        yield
+        return
+    with tracer.pause_recording():
+        yield
+
+
+def to_variable(value, name=None, zero_copy=None):
+    """numpy -> VarBase (reference dygraph/base.py to_variable)."""
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(value, name=name)

@@ -1,0 +1,35 @@
+"""Dygraph checkpointing: dict save/load.
+
+Reference parity: /root/reference/python/paddle/fluid/dygraph/checkpoint.py
+(save_dygraph/load_dygraph writing per-parameter files).  Here the state
+dict is a single .npz (one named array per parameter), which plays the same
+role with one host file instead of a directory of tensors.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+_SUFFIX = ".pdparams.npz"
+
+
+def save_dygraph(state_dict, model_path):
+    """state_dict: {name: ndarray-like} (Layer.state_dict() or an optimizer
+    eager-state dict)."""
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    arrays = {}
+    for k, v in state_dict.items():
+        arrays[k] = np.asarray(v.value if hasattr(v, "value") else v)
+    np.savez(model_path + _SUFFIX, **arrays)
+
+
+def load_dygraph(model_path):
+    path = model_path + _SUFFIX
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with np.load(path) as data:
+        return {k: data[k] for k in data.files}

@@ -1,0 +1,220 @@
+"""Layer: the dygraph module base class.
+
+Reference parity: /root/reference/python/paddle/fluid/dygraph/layers.py
+(Layer: create_parameter via LayerHelper, parameters(), sublayers(),
+add_parameter/add_sublayer, state_dict) and imperative parameter handling in
+layer.h.
+
+TPU-first difference: parameters are plain VarBase jax arrays initialized
+eagerly (initializers evaluated with numpy/jax RNG) — no startup program.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Tuple
+
+import numpy as np
+
+from paddle_tpu import unique_name
+from paddle_tpu.dygraph.base import VarBase
+
+__all__ = ["Layer"]
+
+
+def _eval_initializer(init, shape, dtype, is_bias):
+    """Evaluate an initializer spec eagerly (the graph path appends startup
+    ops instead; reference initializer.py)."""
+    from paddle_tpu import initializer as I
+
+    shape = tuple(int(s) for s in shape)
+    rng = np.random.RandomState(_eval_initializer._seed)
+    _eval_initializer._seed = (_eval_initializer._seed + 1) % (2 ** 31)
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.Xavier()
+    if isinstance(init, I.Constant):
+        return np.full(shape, init.value, dtype=dtype)
+    if isinstance(init, I.Uniform):
+        return rng.uniform(init.low, init.high, shape).astype(dtype)
+    if isinstance(init, I.Normal):
+        return rng.normal(init.loc, init.scale, shape).astype(dtype)
+    if isinstance(init, I.TruncatedNormal):
+        vals = rng.normal(init.loc, init.scale, shape)
+        bound = 2 * init.scale
+        bad = np.abs(vals - init.loc) > bound
+        while bad.any():
+            vals[bad] = rng.normal(init.loc, init.scale, bad.sum())
+            bad = np.abs(vals - init.loc) > bound
+        return vals.astype(dtype)
+    if isinstance(init, I.Xavier):
+        fan_in = init.fan_in or (shape[0] if shape else 1)
+        fan_out = init.fan_out or (
+            int(np.prod(shape[1:])) if len(shape) > 1 else 1)
+        if init.uniform:
+            limit = np.sqrt(6.0 / (fan_in + fan_out))
+            return rng.uniform(-limit, limit, shape).astype(dtype)
+        std = np.sqrt(2.0 / (fan_in + fan_out))
+        return rng.normal(0.0, std, shape).astype(dtype)
+    if isinstance(init, I.MSRA):
+        fan_in = init.fan_in or (shape[0] if shape else 1)
+        if init.uniform:
+            limit = np.sqrt(6.0 / fan_in)
+            return rng.uniform(-limit, limit, shape).astype(dtype)
+        return rng.normal(0.0, np.sqrt(2.0 / fan_in), shape).astype(dtype)
+    if isinstance(init, I.NumpyArrayInitializer):
+        return np.asarray(init.value, dtype=dtype).reshape(shape)
+    raise TypeError(f"unsupported initializer for dygraph: {init!r}")
+
+
+_eval_initializer._seed = 1234
+
+
+class Layer:
+    """reference dygraph/layers.py Layer."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        base = name_scope or self.__class__.__name__.lower()
+        self._full_name = unique_name.generate(base)
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- parameter/sublayer management ------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from paddle_tpu.param_attr import ParamAttr
+
+        attr = ParamAttr._to_attr(attr)
+        dtype = dtype or self._dtype
+        suffix = "b" if is_bias else "w"
+        name = attr.name or unique_name.generate(
+            f"{self._full_name}.{suffix}")
+        init = attr.initializer or default_initializer
+        value = _eval_initializer(init, shape, dtype, is_bias)
+        p = VarBase(value, name=name, persistable=True)
+        p.is_parameter = True
+        p.trainable = attr.trainable
+        p.stop_gradient = not attr.trainable
+        p.regularizer = attr.regularizer
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, value):
+        self._buffers[name] = value
+        return value
+
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if params is not None and isinstance(value, VarBase) \
+                and value.is_parameter:
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif subs is not None and isinstance(value, Layer):
+            subs[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for sub in self._sub_layers.values():
+                out.extend(sub.parameters())
+        return out
+
+    def named_parameters(self, prefix="") -> Iterator[Tuple[str, VarBase]]:
+        for name, p in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), p
+        for sname, sub in self._sub_layers.items():
+            sp = f"{prefix}.{sname}" if prefix else sname
+            yield from sub.named_parameters(sp)
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for sub in self._sub_layers.values():
+                out.extend(sub.sublayers())
+        return out
+
+    def buffers(self):
+        out = dict(self._buffers)
+        return out
+
+    def named_buffers(self, prefix=""):
+        for name, b in self._buffers.items():
+            yield (f"{prefix}.{name}" if prefix else name), b
+        for sname, sub in self._sub_layers.items():
+            sp = f"{prefix}.{sname}" if prefix else sname
+            yield from sub.named_buffers(sp)
+
+    # -- modes -------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for sub in self._sub_layers.values():
+            sub.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for sub in self._sub_layers.values():
+            sub.eval()
+        return self
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, include_sublayers=True, prefix=""):
+        out = collections.OrderedDict()
+        for name, p in self.named_parameters(prefix):
+            out[p.name] = p.numpy()
+        for key, b in self.named_buffers(prefix):
+            out[key] = np.asarray(b.value if isinstance(b, VarBase) else b)
+        return out
+
+    def set_dict(self, state_dict, include_sublayers=True):
+        """Load parameters by *name* and buffers (e.g. BatchNorm running
+        stats) by structural key (reference dygraph checkpoint load)."""
+        missing = []
+        for name, p in self.named_parameters():
+            if p.name in state_dict:
+                p.set_value(np.asarray(state_dict[p.name]))
+            else:
+                missing.append(p.name)
+        if missing:
+            raise KeyError(f"state_dict missing parameters: {missing}")
+        for key, b in self.named_buffers():
+            if key in state_dict:
+                if isinstance(b, VarBase):
+                    b.set_value(np.asarray(state_dict[key]))
+
+    load_dict = set_dict
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)

@@ -1,0 +1,303 @@
+"""Dygraph nn modules.
+
+Reference parity: /root/reference/python/paddle/fluid/dygraph/nn.py
+(Conv2D, Conv2DTranspose, Pool2D, FC, BatchNorm, Embedding, LayerNorm,
+GRUUnit, PRelu...).  Each module owns eager parameters and routes its
+forward through the shared op registry via the dygraph tracer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dygraph.base import VarBase, _current_tracer, Tracer
+from paddle_tpu.dygraph.layers import Layer
+
+__all__ = [
+    "Linear", "FC", "Conv2D", "Conv2DTranspose", "Pool2D", "BatchNorm",
+    "Embedding", "LayerNorm", "Dropout", "GRUUnit", "PRelu",
+]
+
+
+def _trace(op_type, ins, attrs=None):
+    tracer = _current_tracer() or Tracer()
+    return tracer.trace(op_type, ins, attrs)
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    return _trace(act, {"X": out})["Out"]
+
+
+def _pair(v):
+    return [v, v] if isinstance(v, int) else list(v)
+
+
+class Linear(Layer):
+    """y = xW + b (reference dygraph nn Linear / FC with 2-D input)."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._act = act
+        self.weight = self.create_parameter([input_dim, output_dim],
+                                            attr=param_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([output_dim], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        out = _trace("matmul", {"X": x, "Y": self.weight})["Out"]
+        if self.bias is not None:
+            out = _trace("elementwise_add",
+                         {"X": out, "Y": self.bias}, {"axis": -1})["Out"]
+        return _act(out, self._act)
+
+
+class FC(Layer):
+    """reference dygraph/nn.py FC: flattens input to 2-D via num_flatten_dims
+    then mul + bias + act."""
+
+    def __init__(self, name_scope=None, size=None, num_flatten_dims=1,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype=dtype)
+        assert size is not None
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self._w = None
+        self._b = None
+
+    def forward(self, x):
+        if self._w is None:
+            in_dim = int(np.prod(x.shape[self._num_flatten_dims:]))
+            # plain assignment registers the parameter via __setattr__
+            self._w = self.create_parameter([in_dim, self._size],
+                                            attr=self._param_attr)
+            if self._bias_attr is not False:
+                self._b = self.create_parameter(
+                    [self._size], attr=self._bias_attr, is_bias=True)
+        out = _trace("mul", {"X": x, "Y": self._w},
+                     {"x_num_col_dims": self._num_flatten_dims,
+                      "y_num_col_dims": 1})["Out"]
+        if self._b is not None:
+            out = _trace("elementwise_add",
+                         {"X": out, "Y": self._b}, {"axis": -1})["Out"]
+        return _act(out, self._act)
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+                       "dilations": _pair(dilation), "groups": groups}
+        self._act = act
+        fs = _pair(filter_size)
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, fs[0], fs[1]],
+            attr=param_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        out = _trace("conv2d", {"Input": x, "Filter": self.weight},
+                     self._attrs)["Output"]
+        if self.bias is not None:
+            out = _trace("elementwise_add",
+                         {"X": out, "Y": self.bias}, {"axis": 1})["Out"]
+        return _act(out, self._act)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+                       "dilations": _pair(dilation), "groups": groups}
+        self._act = act
+        fs = _pair(filter_size)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups, fs[0], fs[1]],
+            attr=param_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        out = _trace("conv2d_transpose",
+                     {"Input": x, "Filter": self.weight},
+                     self._attrs)["Output"]
+        if self.bias is not None:
+            out = _trace("elementwise_add",
+                         {"X": out, "Y": self.bias}, {"axis": 1})["Out"]
+        return _act(out, self._act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True):
+        super().__init__()
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": _pair(pool_size if pool_size != -1 else 1),
+            "global_pooling": global_pooling,
+            "strides": _pair(pool_stride),
+            "paddings": _pair(pool_padding),
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, x):
+        return _trace("pool2d", {"X": x}, self._attrs)["Out"]
+
+
+class BatchNorm(Layer):
+    """Running mean/variance live as non-trainable buffers updated in-place
+    after each training-mode forward (reference dygraph/nn.py BatchNorm)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", use_global_stats=False):
+        super().__init__(dtype=dtype)
+        from paddle_tpu.initializer import Constant
+
+        self._act = act
+        self._attrs_base = {"momentum": momentum, "epsilon": epsilon,
+                            "data_layout": data_layout,
+                            "use_global_stats": use_global_stats}
+        self.weight = self.create_parameter(
+            [num_channels], attr=param_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          is_bias=True)
+        self._mean = self.register_buffer(
+            "_mean_buf", VarBase(np.zeros(num_channels, dtype),
+                                 stop_gradient=True))
+        self._variance = self.register_buffer(
+            "_var_buf", VarBase(np.ones(num_channels, dtype),
+                                stop_gradient=True))
+
+    def forward(self, x):
+        attrs = dict(self._attrs_base)
+        attrs["is_test"] = not self.training
+        outs = _trace("batch_norm",
+                      {"X": x, "Scale": self.weight, "Bias": self.bias,
+                       "Mean": self._mean, "Variance": self._variance},
+                      attrs)
+        if self.training and not attrs["use_global_stats"]:
+            self._mean.set_value(outs["MeanOut"].value)
+            self._variance.set_value(outs["VarianceOut"].value)
+        return _act(outs["Y"], self._act)
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        self.weight = self.create_parameter(list(size), attr=param_attr)
+
+    def forward(self, ids):
+        return _trace("lookup_table", {"W": self.weight, "Ids": ids},
+                      {"padding_idx": self._padding_idx})["Out"]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        from paddle_tpu.initializer import Constant
+
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._epsilon = epsilon
+        self._act = act
+        n = int(np.prod(normalized_shape))
+        self.weight = self.create_parameter(
+            [n], attr=param_attr,
+            default_initializer=Constant(1.0)) if scale else None
+        self.bias = self.create_parameter(
+            [n], attr=bias_attr, is_bias=True) if shift else None
+
+    def forward(self, x):
+        ins = {"X": x}
+        if self.weight is not None:
+            ins["Scale"] = self.weight
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        outs = _trace("layer_norm", ins,
+                      {"epsilon": self._epsilon,
+                       "begin_norm_axis": len(x.shape) - 1})
+        return _act(outs["Y"], self._act)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, seed=0):
+        super().__init__()
+        self._p = p
+        self._seed = seed
+        self._step = 0
+
+    def forward(self, x):
+        self._step += 1
+        return _trace("dropout", {"X": x},
+                      {"dropout_prob": self._p,
+                       "is_test": not self.training,
+                       "seed": self._seed + self._step})["Out"]
+
+
+class GRUUnit(Layer):
+    """Single GRU step (reference dygraph/nn.py GRUUnit, gru_unit_op.cc).
+    size = 3 * hidden_dim."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        assert size % 3 == 0
+        d = size // 3
+        self._hidden = d
+        self.weight = self.create_parameter([2 * d, 3 * d], attr=param_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([3 * d], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x, hidden):
+        ins = {"X": x, "HPrev": hidden, "W": self.weight}
+        if self.bias is not None:
+            ins["B"] = self.bias
+        return _trace("gru_cell", ins)["H"]
+
+
+class PRelu(Layer):
+    def __init__(self, mode="all", channel=None, param_attr=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        from paddle_tpu.initializer import Constant
+
+        self._mode = mode
+        shape = [1] if mode == "all" else [channel]
+        self.weight = self.create_parameter(
+            shape, attr=param_attr, default_initializer=Constant(0.25))
+
+    def forward(self, x):
+        pos = _trace("relu", {"X": x})["Out"]
+        negx = _trace("relu", {"X": -x})["Out"]
+        # channel mode aligns the [C] weight to axis 1 (NCHW channel dim);
+        # 'all'/'element' trailing-align
+        axis = 1 if self._mode == "channel" else -1
+        neg = _trace("elementwise_mul",
+                     {"X": negx, "Y": self.weight}, {"axis": axis})["Out"]
+        return pos - neg

@@ -1,0 +1,219 @@
+"""Eager (dygraph) optimizer application.
+
+Reference parity: in dygraph mode the reference's Optimizer.minimize applies
+updates immediately to VarBase grads through the same optimizer kernels
+(python/paddle/fluid/optimizer.py dygraph branches; imperative tracer runs
+sgd/adam ops eagerly).
+
+Here each graph-mode optimizer class maps to its registered op compute; the
+op's declared ``in_place`` pairs (ParamOut->Param, Moment1Out->Moment1...)
+drive the write-back, so one generic runner serves every optimizer.
+Accumulator state lives on the optimizer instance keyed by parameter name —
+exportable via state_dict() for save_dygraph.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.registry import get_op_def
+
+# op-type specific accumulator slots: slot -> (kind, init)
+#   kind 'param' = zeros_like(param); kind 'scalar' = [1] array of init value
+_SLOT_SPECS = {
+    "sgd": {},
+    "momentum": {"Velocity": ("param", 0.0)},
+    "lars_momentum": {"Velocity": ("param", 0.0)},
+    "adam": {"Moment1": ("param", 0.0), "Moment2": ("param", 0.0),
+             "Beta1Pow": ("scalar", "_beta1"),
+             "Beta2Pow": ("scalar", "_beta2")},
+    "adagrad": {"Moment": ("param", 0.0)},
+    "adadelta": {"AvgSquaredGrad": ("param", 0.0),
+                 "AvgSquaredUpdate": ("param", 0.0)},
+    "rmsprop": {"MeanSquare": ("param", 0.0), "MeanGrad": ("param", 0.0),
+                "Moment": ("param", 0.0)},
+    "adamax": {"Moment": ("param", 0.0), "InfNorm": ("param", 0.0),
+               "Beta1Pow": ("scalar", "_beta1")},
+    "ftrl": {"SquaredAccumulator": ("param", 0.0),
+             "LinearAccumulator": ("param", 0.0)},
+    "decayed_adagrad": {"Moment": ("param", 0.0)},
+}
+_SLOT_SPECS["adamw"] = _SLOT_SPECS["adam"]
+_SLOT_SPECS["lamb"] = _SLOT_SPECS["adam"]
+
+
+def _op_type_of(opt) -> str:
+    if hasattr(opt, "op_type"):         # Adam family carries op_type
+        return opt.op_type
+    name = type(opt).__name__
+    table = {"SGD": "sgd", "Momentum": "momentum",
+             "LarsMomentum": "lars_momentum", "Adagrad": "adagrad",
+             "Adadelta": "adadelta", "RMSProp": "rmsprop",
+             "Adamax": "adamax", "Ftrl": "ftrl",
+             "DecayedAdagrad": "decayed_adagrad"}
+    for cls, op in table.items():
+        if name.startswith(cls) or name.rstrip("Optimizer") == cls:
+            return op
+    raise TypeError(f"optimizer {name} has no dygraph eager mapping")
+
+
+def _op_attrs(opt, op_type) -> dict:
+    if op_type == "sgd":
+        return {}
+    if op_type in ("momentum",):
+        return {"mu": opt._momentum, "use_nesterov": opt._use_nesterov}
+    if op_type == "lars_momentum":
+        return {"mu": opt._momentum, "lars_coeff": opt._lars_coeff,
+                "lars_weight_decay": opt._lars_weight_decay}
+    if op_type in ("adam", "adamw", "lamb"):
+        a = {"beta1": opt._beta1, "beta2": opt._beta2,
+             "epsilon": opt._epsilon}
+        a.update(getattr(opt, "extra_attrs", {}))
+        if op_type == "adam":
+            a["lazy_mode"] = getattr(opt, "_lazy_mode", False)
+        return a
+    if op_type == "adagrad":
+        return {"epsilon": opt._epsilon}
+    if op_type == "adadelta":
+        return {"rho": opt._rho, "epsilon": opt._epsilon}
+    if op_type == "rmsprop":
+        return {"decay": opt._rho, "momentum": opt._momentum,
+                "epsilon": opt._epsilon, "centered": opt._centered}
+    if op_type == "adamax":
+        return {"beta1": opt._beta1, "beta2": opt._beta2,
+                "epsilon": opt._epsilon}
+    if op_type == "ftrl":
+        return {"l1": opt._l1, "l2": opt._l2, "lr_power": opt._lr_power}
+    if op_type == "decayed_adagrad":
+        return {"decay": opt._decay, "epsilon": opt._epsilon}
+    raise TypeError(op_type)
+
+
+def _lr_value(opt):
+    import jax.numpy as jnp
+
+    lr = opt._learning_rate
+    if callable(lr) and not hasattr(lr, "dtype"):
+        lr = lr()
+    if hasattr(lr, "value"):            # VarBase from a dygraph scheduler
+        lr = lr.value
+    return jnp.asarray(np.reshape(np.asarray(lr, np.float32), (1,)))
+
+
+def _eager_clip(grad_clip, pairs):
+    """Apply a GradientClip* eagerly to [(param, grad_array)] pairs."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import clip as C
+
+    if isinstance(grad_clip, C.GradientClipByValue):
+        return [(p, jnp.clip(g, grad_clip.min, grad_clip.max))
+                for p, g in pairs]
+    if isinstance(grad_clip, C.GradientClipByNorm):
+        out = []
+        for p, g in pairs:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            out.append((p, g * jnp.minimum(
+                1.0, grad_clip.clip_norm / jnp.maximum(norm, 1e-12))))
+        return out
+    if isinstance(grad_clip, C.GradientClipByGlobalNorm):
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for _, g in pairs))
+        scale = jnp.minimum(1.0,
+                            grad_clip.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return [(p, g * scale) for p, g in pairs]
+    raise TypeError(f"unsupported grad_clip in dygraph: {grad_clip!r}")
+
+
+def eager_minimize(opt, loss, parameter_list=None, grad_clip=None):
+    """Apply one optimizer step to parameters' accumulated gradients."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.dygraph import base as dybase
+
+    if parameter_list is None:
+        tracer = dybase._current_tracer()
+        parameter_list = tracer.touched_parameters() if tracer else []
+    op_type = _op_type_of(opt)
+    op_def = get_op_def(op_type)
+    spec = _SLOT_SPECS[op_type]
+    state = getattr(opt, "_eager_state", None)
+    if state is None:
+        state = opt._eager_state = {}
+    lr = _lr_value(opt)
+    # de-dup while preserving order (a param list may alias entries)
+    seen = set()
+    unique_params = []
+    for p in parameter_list:
+        if id(p) not in seen:
+            seen.add(id(p))
+            unique_params.append(p)
+    live = []
+    for p in unique_params:
+        if p._grad is None or not getattr(p, "trainable", True):
+            continue
+        g = p._grad
+        reg = getattr(p, "regularizer", None) or opt.regularization
+        if reg is not None:
+            g = g + _eager_regularize(reg, p.value)
+        live.append((p, g))
+    if grad_clip is not None:
+        live = _eager_clip(grad_clip, live)
+    params_grads = []
+    for p, g in live:
+        pstate = state.setdefault(p.name, {})
+        ins = {"Param": p.value, "Grad": g}
+        if "LearningRate" in op_def.inputs:
+            ins["LearningRate"] = lr
+        for slot, (kind, init) in spec.items():
+            if slot not in pstate:
+                if kind == "param":
+                    pstate[slot] = jnp.zeros_like(p.value)
+                else:
+                    v = getattr(opt, init) if isinstance(init, str) else init
+                    pstate[slot] = jnp.full((1,), v, dtype=jnp.float32)
+            ins[slot] = pstate[slot]
+        outs = op_def.compute(ins, op_def.canonical_attrs(
+            _op_attrs(opt, op_type)))
+        for out_slot, in_slot in op_def.in_place.items():
+            if out_slot not in outs:
+                continue
+            if in_slot == "Param":
+                p.value = outs[out_slot]
+            else:
+                pstate[in_slot] = outs[out_slot]
+        # adamax's beta1 power is advanced by a separate scale op in graph
+        # mode (optimizer.py Adamax); mirror that here
+        if op_type == "adamax":
+            pstate["Beta1Pow"] = pstate["Beta1Pow"] * opt._beta1
+        params_grads.append((p, g))
+    return [], params_grads
+
+
+def _eager_regularize(reg, value):
+    from paddle_tpu import regularizer as R
+
+    if isinstance(reg, R.L2Decay):
+        return reg.coeff * value
+    if isinstance(reg, R.L1Decay):
+        import jax.numpy as jnp
+
+        return reg.coeff * jnp.sign(value)
+    raise TypeError(f"unsupported regularizer in dygraph: {reg!r}")
+
+
+def state_dict(opt):
+    """Flatten eager accumulator state for save_dygraph."""
+    out = {}
+    for pname, slots in getattr(opt, "_eager_state", {}).items():
+        for slot, val in slots.items():
+            out[f"{pname}::{slot}"] = np.asarray(val)
+    return out
+
+
+def set_state_dict(opt, state):
+    import jax.numpy as jnp
+
+    eager = opt._eager_state = {}
+    for key, val in state.items():
+        pname, slot = key.rsplit("::", 1)
+        eager.setdefault(pname, {})[slot] = jnp.asarray(val)

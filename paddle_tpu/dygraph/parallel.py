@@ -1,0 +1,109 @@
+"""Dygraph data parallelism over the device mesh.
+
+Reference parity: /root/reference/python/paddle/fluid/dygraph/parallel.py:84
+(DataParallel: scale_loss by 1/nranks, allreduce grads after backward) and
+imperative/nccl_context.cc (NCCL id bootstrap over TCP).
+
+TPU-first difference: there are no per-rank processes to bootstrap — eager
+JAX ops on arrays sharded over the mesh are SPMD-partitioned by XLA, which
+inserts the gradient all-reduces itself (ICI collectives).  DataParallel
+therefore (a) shards each input batch over the 'dp' mesh axis and (b) keeps
+the scale_loss/apply_collective_grads API as numerically-faithful no-ops,
+so reference training loops port unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dygraph.base import VarBase
+from paddle_tpu.dygraph.layers import Layer
+
+__all__ = ["prepare_context", "ParallelEnv", "Env", "DataParallel"]
+
+
+class ParallelEnv:
+    """reference dygraph/parallel.py Env: trainer id/num from environment.
+    Single-process SPMD means nranks = mesh size, local rank 0."""
+
+    def __init__(self):
+        from paddle_tpu.parallel import env as penv
+
+        mesh = penv.get_mesh()
+        self.nranks = int(np.prod([mesh.shape[a] for a in mesh.axis_names])
+                          ) if mesh is not None else 1
+        self.local_rank = 0
+        self.dev_id = 0
+        self.current_endpoint = ""
+        self.trainer_endpoints = []
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    """Build (or adopt) the device mesh; replaces NCCLParallelContext::Init
+    (imperative/nccl_context.cc:109)."""
+    from paddle_tpu.parallel import env as penv
+
+    if penv.get_mesh() is None:
+        penv.set_mesh(penv.make_mesh())
+    return strategy
+
+
+class DataParallel(Layer):
+    """Wraps a Layer for data-parallel eager training."""
+
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        # plain assignment registers the sublayer via __setattr__
+        self._layers = layers
+        from paddle_tpu.parallel import env as penv
+
+        self._mesh = penv.get_mesh()
+        self._axis = None
+        if self._mesh is not None:
+            self._axis = ("dp" if "dp" in self._mesh.axis_names
+                          else self._mesh.axis_names[0])
+
+    @property
+    def _nranks(self):
+        if self._mesh is None:
+            return 1
+        return self._mesh.shape[self._axis]
+
+    def shard_input(self, value):
+        """Place a host batch sharded on the batch dim over the dp axis; XLA
+        partitions every downstream eager op accordingly."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        arr = np.asarray(value)
+        if self._mesh is None or arr.ndim == 0 \
+                or arr.shape[0] % self._nranks != 0:
+            return VarBase(arr)
+        sh = NamedSharding(self._mesh,
+                           P(self._axis, *([None] * (arr.ndim - 1))))
+        return VarBase(jax.device_put(arr, sh))
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        """The reference divides by nranks because each rank reduces a SUM
+        over ranks; XLA's SPMD grads are already the global-batch gradient,
+        so the loss is returned unscaled."""
+        return loss
+
+    def apply_collective_grads(self):
+        """Gradient all-reduce is compiled into the backward by XLA SPMD;
+        nothing to do (reference: per-param ncclAllReduce here)."""
+        return
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_dict(self, *args, **kwargs):
+        return self._layers.set_dict(*args, **kwargs)
+
+    load_dict = set_dict

@@ -101,6 +101,16 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None):
+        from paddle_tpu import framework
+
+        if framework.in_dygraph_mode():
+            # eager application to VarBase grads (reference optimizer.py
+            # dygraph branches); the user calls loss.backward() first
+            from paddle_tpu.dygraph import optimizer_hook
+
+            return optimizer_hook.eager_minimize(self, loss,
+                                                 parameter_list,
+                                                 grad_clip=grad_clip)
         params_grads = self.backward(loss, startup_program,
                                      parameter_list, no_grad_set)
         if grad_clip is not None:
