@@ -45,6 +45,7 @@ from paddle_tpu import unique_name
 from paddle_tpu import parallel
 from paddle_tpu import profiler
 from paddle_tpu import dygraph
+from paddle_tpu import contrib
 from paddle_tpu.data_feeder import DataFeeder
 
 __version__ = "0.1.0"

@@ -1,0 +1,6 @@
+"""contrib — AMP, slim (quantization), and other incubating subsystems.
+
+Reference parity: /root/reference/python/paddle/fluid/contrib/
+"""
+
+from paddle_tpu.contrib import mixed_precision  # noqa: F401

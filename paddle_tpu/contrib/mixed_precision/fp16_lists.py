@@ -1,0 +1,63 @@
+"""Black/white op lists for automatic mixed precision.
+
+Reference parity:
+/root/reference/python/paddle/fluid/contrib/mixed_precision/fp16_lists.py
+(white = MXU-heavy ops cast to low precision; black = numerically sensitive
+ops kept fp32; gray follows its inputs).
+
+TPU-first difference: the low-precision dtype defaults to bfloat16 — same
+exponent range as fp32, so unlike fp16 it rarely *needs* loss scaling, but
+the scaling machinery is kept for fp16 parity and guard-rails.
+"""
+
+from __future__ import annotations
+
+import copy
+
+# MXU-bound: always worth computing in bf16
+white_list = {
+    "conv2d", "depthwise_conv2d", "conv2d_transpose", "matmul", "mul",
+}
+
+# numerically sensitive: keep fp32
+black_list = {
+    "exp", "square", "log", "mean", "sum", "cos_sim",
+    "softmax", "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "cross_entropy2",
+    "reduce_sum", "reduce_mean",
+}
+
+# dtype-agnostic: run in whatever dtype arrives
+gray_list = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "elementwise_mod", "elementwise_floordiv",
+    "batch_norm", "layer_norm", "tanh", "sigmoid", "lookup_table",
+    "relu", "relu6", "leaky_relu", "soft_relu", "top_k", "pool2d",
+    "dropout", "reshape2", "transpose2", "concat", "split", "slice",
+    "flatten2", "stack", "unstack", "expand", "scale", "cast",
+    "elementwise_op", "squeeze2", "unsqueeze2", "pad", "gather",
+}
+
+
+class AutoMixedPrecisionLists:
+    """reference fp16_lists.py AutoMixedPrecisionLists: base lists plus
+    user-supplied custom white/black adjustments."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = copy.copy(white_list)
+        self.black_list = copy.copy(black_list)
+        self.gray_list = copy.copy(gray_list)
+        if custom_white_list:
+            for op in custom_white_list:
+                self.white_list.add(op)
+                self.black_list.discard(op)
+                self.gray_list.discard(op)
+        if custom_black_list:
+            for op in custom_black_list:
+                self.black_list.add(op)
+                self.white_list.discard(op)
+                self.gray_list.discard(op)
+        overlap = self.white_list & self.black_list
+        if overlap:
+            raise ValueError(f"ops in both white and black lists: {overlap}")

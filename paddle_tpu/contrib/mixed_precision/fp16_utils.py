@@ -1,0 +1,86 @@
+"""Program rewriting for mixed precision: insert casts by op lists.
+
+Reference parity:
+/root/reference/python/paddle/fluid/contrib/mixed_precision/fp16_utils.py
+(rewrite_program: walk ops, insert cast ops on inputs per white/black
+list).  Master weights stay fp32; casts are inserted per use and XLA fuses
+them into the consuming matmul/conv (free on the MXU's bf16 multiply path).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.program import OpDesc
+
+_FLOATS = {"float32", "float64"}
+
+
+def rewrite_program(program, amp_lists, dest_dtype="bfloat16"):
+    """Rewrite the global block in place.  White-list ops get their float
+    inputs cast to ``dest_dtype``; black-list (and unknown) ops get
+    low-precision inputs cast back to fp32; gray ops follow their inputs.
+
+    A var is "eligible" if its declared dtype is float (or undeclared);
+    integer tensors (ids, indices) are never touched.  The set of vars
+    currently in low precision is tracked while walking the op list."""
+    block = program.global_block()
+
+    def eligible(name):
+        if not block.has_var(name):
+            return True
+        d = block.var(name).dtype
+        return d is None or d in _FLOATS
+
+    lowp = set()      # var names whose runtime value is dest_dtype
+    new_ops = []
+
+    def insert_cast(name, dst, cache):
+        key = (name, dst)
+        if key in cache:
+            return cache[key]
+        cast_name = f"{name}.cast_{dst}"
+        shape = block.var(name).shape if block.has_var(name) else None
+        block.create_var(name=cast_name, dtype=dst, shape=shape)
+        new_ops.append(OpDesc("cast", {"X": [name]}, {"Out": [cast_name]},
+                              {"out_dtype": dst}))
+        cache[key] = cast_name
+        return cast_name
+
+    for op in block.ops:
+        cache = {}
+        if op.type in amp_lists.white_list:
+            for slot, names in list(op.inputs.items()):
+                out = []
+                for n in names:
+                    if eligible(n) and n not in lowp:
+                        n = insert_cast(n, dest_dtype, cache)
+                        lowp.add(n)
+                    out.append(n)
+                op.inputs[slot] = out
+            out_lowp = True
+        elif op.type in amp_lists.gray_list:
+            out_lowp = any(n in lowp for ns in op.inputs.values()
+                           for n in ns)
+        else:  # black or unlisted: numerically sensitive -> fp32
+            for slot, names in list(op.inputs.items()):
+                out = []
+                for n in names:
+                    if n in lowp:
+                        n = insert_cast(n, "float32", cache)
+                    out.append(n)
+                op.inputs[slot] = out
+            out_lowp = False
+        new_ops.append(op)
+        for names in op.outputs.values():
+            for n in names:
+                if out_lowp and eligible(n):
+                    lowp.add(n)
+                else:
+                    lowp.discard(n)
+    block.ops = new_ops
+    return program
+
+
+def cast_parameters_to_fp16(program, scope=None):
+    """Not used on TPU: master weights stay fp32 and per-use casts feed the
+    MXU; kept for API parity with the reference fp16_utils."""
+    return program

@@ -1,0 +1,74 @@
+"""AMP support ops: gradient unscale/finite-check and dynamic loss scaling.
+
+Reference parity: the mixed-precision decorator's machinery at
+/root/reference/python/paddle/fluid/contrib/mixed_precision/decorator.py:27-194
+(scale loss, isfinite reduction over grads, conditional loss-scale update)
+and /root/reference/paddle/fluid/operators/isfinite_op.cc.  The reference
+composes these from isfinite/scale/cond ops in Python; here they are two
+fused ops, which XLA keeps on-device without host round-trips.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import REQUIRED, register_op
+
+
+@register_op("check_finite_and_unscale",
+             inputs=("X", "Scale"), outputs=("Out", "FoundInfinite"),
+             duplicable=("X", "Out"), differentiable=False,
+             attrs={"zero_on_inf": True})
+def check_finite_and_unscale(ins, attrs):
+    """Divide every grad by Scale; FoundInfinite = any non-finite element.
+    With zero_on_inf the unscaled grads are zeroed on overflow so the
+    optimizer step becomes a no-op for SGD-family updates — the
+    XLA-friendly analog of the reference's skip-update conditional (no
+    divergent control flow on TPU)."""
+    scale = ins["Scale"].reshape(()).astype(jnp.float32)
+    xs = ins["X"]
+    found = jnp.zeros((), bool)
+    for x in xs:
+        found = found | ~jnp.all(jnp.isfinite(x))
+    outs = []
+    for x in xs:
+        y = (x.astype(jnp.float32) / scale).astype(x.dtype)
+        if attrs["zero_on_inf"]:
+            y = jnp.where(found, jnp.zeros_like(y), y)
+        outs.append(y)
+    return {"Out": outs, "FoundInfinite": found.reshape((1,))}
+
+
+@register_op("update_loss_scaling",
+             inputs=("FoundInfinite", "PrevLossScaling", "InGoodSteps",
+                     "InBadSteps"),
+             outputs=("LossScaling", "OutGoodSteps", "OutBadSteps"),
+             differentiable=False,
+             in_place={"LossScaling": "PrevLossScaling",
+                       "OutGoodSteps": "InGoodSteps",
+                       "OutBadSteps": "InBadSteps"},
+             attrs={"incr_every_n_steps": 1000,
+                    "decr_every_n_nan_or_inf": 2,
+                    "incr_ratio": 2.0, "decr_ratio": 0.8})
+def update_loss_scaling(ins, attrs):
+    """Dynamic loss-scaling state machine (reference decorator.py
+    update_loss_scaling): grow scale after N clean steps, shrink after M
+    overflowing ones."""
+    found = ins["FoundInfinite"].reshape(()).astype(bool)
+    scale = ins["PrevLossScaling"].reshape(()).astype(jnp.float32)
+    good = ins["InGoodSteps"].reshape(()).astype(jnp.int32)
+    bad = ins["InBadSteps"].reshape(()).astype(jnp.int32)
+
+    good = jnp.where(found, 0, good + 1)
+    bad = jnp.where(found, bad + 1, 0)
+
+    grow = good >= attrs["incr_every_n_steps"]
+    shrink = bad >= attrs["decr_every_n_nan_or_inf"]
+    scale = jnp.where(grow, scale * attrs["incr_ratio"], scale)
+    scale = jnp.where(shrink,
+                      jnp.maximum(scale * attrs["decr_ratio"], 1.0), scale)
+    good = jnp.where(grow, 0, good)
+    bad = jnp.where(shrink, 0, bad)
+    return {"LossScaling": scale.reshape((1,)),
+            "OutGoodSteps": good.reshape((1,)),
+            "OutBadSteps": bad.reshape((1,))}

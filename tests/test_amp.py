@@ -1,0 +1,126 @@
+"""AMP (mixed precision) tests — reference contrib/mixed_precision tests
+(tests/test_image_classification_fp16.py pattern): rewrite correctness,
+training convergence under the decorated optimizer, dynamic loss scaling
+reaction to overflow.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.contrib import mixed_precision as amp
+
+
+def _build_regression():
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return x, y, pred, loss
+
+
+def test_rewrite_inserts_bf16_casts():
+    _, _, pred, loss = _build_regression()
+    prog = fluid.default_main_program()
+    n_ops_before = len(prog.global_block().ops)
+    amp.rewrite_program(prog, amp.AutoMixedPrecisionLists())
+    ops = prog.global_block().ops
+    cast_ops = [op for op in ops if op.type == "cast"]
+    assert len(ops) > n_ops_before
+    assert cast_ops, "no casts inserted"
+    # the mul (fc matmul) must consume bf16-cast inputs
+    mul_ops = [op for op in ops if op.type == "mul"]
+    assert mul_ops
+    for n in mul_ops[0].input_names():
+        assert n.endswith(".cast_bfloat16"), n
+    # the loss mean is black-listed: its input must be cast back to fp32
+    mean_ops = [op for op in ops if op.type in ("mean", "reduce_mean")]
+    assert mean_ops
+
+
+def test_amp_training_converges():
+    rng = np.random.RandomState(0)
+    W = rng.randn(8, 1).astype(np.float32)
+    _, _, pred, loss = _build_regression()
+    opt = amp.decorate(optimizer.SGD(0.05),
+                       init_loss_scaling=128.0,
+                       use_dynamic_loss_scaling=True)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(120):
+        bx = rng.rand(32, 8).astype(np.float32)
+        lv, = exe.run(feed={"x": bx, "y": bx @ W}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.1, losses[::20]
+    scale, = exe.run(feed={"x": bx, "y": bx @ W},
+                     fetch_list=[opt.get_loss_scaling()])
+    assert scale[0] >= 1.0
+
+
+def test_amp_compiled_path():
+    rng = np.random.RandomState(1)
+    W = rng.randn(8, 1).astype(np.float32)
+    _, _, pred, loss = _build_regression()
+    opt = amp.decorate(optimizer.SGD(0.05), init_loss_scaling=8.0)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    compiled = fluid.CompiledProgram(fluid.default_main_program()) \
+        .with_data_parallel(loss_name=loss.name)
+    losses = []
+    for _ in range(150):
+        bx = rng.rand(32, 8).astype(np.float32)
+        lv, = exe.run(compiled, feed={"x": bx, "y": bx @ W},
+                      fetch_list=[loss])
+        losses.append(float(lv))
+    # bf16 matmuls make the trajectory noisier than fp32; assert a robust
+    # downward trend (mean of last 10 well below the start)
+    assert np.mean(losses[-10:]) < losses[0] * 0.3, losses[::25]
+
+
+def test_dynamic_loss_scaling_on_overflow():
+    rng = np.random.RandomState(2)
+    _, _, pred, loss = _build_regression()
+    opt = amp.decorate(optimizer.SGD(0.1), init_loss_scaling=1024.0,
+                       decr_every_n_nan_or_inf=1, decr_ratio=0.5,
+                       use_dynamic_loss_scaling=True)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    from paddle_tpu.core.scope import global_scope
+
+    w_name = fluid.default_main_program().all_parameters()[0].name
+    w_before = np.asarray(global_scope().find_var(w_name).get()).copy()
+    # NaN input -> non-finite grads -> scale halves, update becomes no-op
+    bad = np.full((4, 8), np.nan, np.float32)
+    exe.run(feed={"x": bad, "y": np.ones((4, 1), np.float32)},
+            fetch_list=[loss])
+    scale, = exe.run(feed={"x": np.ones((4, 8), np.float32),
+                           "y": np.ones((4, 1), np.float32)},
+                     fetch_list=[opt.get_loss_scaling()])
+    assert scale[0] <= 1024.0 * 0.5 + 1e-6
+    w_after = np.asarray(global_scope().find_var(w_name).get())
+    # grads were zeroed on the overflow step; the later clean step moved
+    # the weights, so compare right after the overflow is not possible
+    # here — instead assert weights are finite (no NaN leaked in)
+    assert np.isfinite(w_after).all()
+
+
+def test_overflow_step_is_noop_on_params():
+    _, _, pred, loss = _build_regression()
+    opt = amp.decorate(optimizer.SGD(0.1), init_loss_scaling=64.0,
+                       use_dynamic_loss_scaling=False)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    from paddle_tpu.core.scope import global_scope
+
+    w_name = fluid.default_main_program().all_parameters()[0].name
+    w_before = np.asarray(global_scope().find_var(w_name).get()).copy()
+    bad = np.full((4, 8), np.inf, np.float32)
+    exe.run(feed={"x": bad, "y": np.ones((4, 1), np.float32)},
+            fetch_list=[loss])
+    w_after = np.asarray(global_scope().find_var(w_name).get())
+    np.testing.assert_allclose(w_before, w_after)
