@@ -340,9 +340,14 @@ class CompiledProgram:
             state_sh = {k: state_shard(k, state_specs[k])
                         for k in state_names}
             feeds_sh = {k: feed_shard(feed_specs[k]) for k in feed_names}
+            # pin state OUTPUT shardings to the input layout: XLA would
+            # otherwise pick its own (e.g. shard a param consumed by
+            # sharded optimizer state), and the next step's declared
+            # in_shardings would mismatch the committed arrays
             return jax.jit(
                 step,
                 in_shardings=(state_sh, feeds_sh),
+                out_shardings=(state_sh, None),
                 donate_argnums=donate,
             )
         return jax.jit(step, donate_argnums=donate)

@@ -1,0 +1,49 @@
+"""IR ops for sequence/expert parallelism.
+
+These wrap the functional kernels in paddle_tpu/parallel/ so the Program IR
+(layers -> CompiledProgram) can express ring attention, Ulysses attention
+and Switch-MoE.  The mesh is picked up from parallel.env at trace time; on
+a single device they degrade to the plain computation, so the same program
+runs anywhere (capability anchor: SURVEY.md §5 long-context/§2.4 EP).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.registry import REQUIRED, register_op
+
+
+@register_op("ring_attention", inputs=("Q", "K", "V"), outputs=("Out",),
+             attrs={"axis": "sp", "causal": False, "scale": -1.0})
+def ring_attention_op(ins, attrs):
+    from paddle_tpu.parallel.ring_attention import ring_attention
+
+    scale = None if attrs["scale"] < 0 else attrs["scale"]
+    return {"Out": ring_attention(ins["Q"], ins["K"], ins["V"],
+                                  axis=attrs["axis"],
+                                  causal=attrs["causal"], scale=scale)}
+
+
+@register_op("ulysses_attention", inputs=("Q", "K", "V"), outputs=("Out",),
+             attrs={"axis": "sp", "causal": False, "scale": -1.0})
+def ulysses_attention_op(ins, attrs):
+    from paddle_tpu.parallel.ulysses import ulysses_attention
+
+    scale = None if attrs["scale"] < 0 else attrs["scale"]
+    return {"Out": ulysses_attention(ins["Q"], ins["K"], ins["V"],
+                                     axis=attrs["axis"],
+                                     causal=attrs["causal"], scale=scale)}
+
+
+@register_op("switch_moe",
+             inputs=("X", "GateW", "W1", "B1", "W2", "B2"),
+             outputs=("Out", "AuxLoss"),
+             attrs={"axis": "ep", "capacity_factor": 1.25})
+def switch_moe_op(ins, attrs):
+    from paddle_tpu.parallel.moe import moe_ffn
+
+    out, aux = moe_ffn(ins["X"], ins["GateW"], ins["W1"], ins["B1"],
+                       ins["W2"], ins["B2"], axis=attrs["axis"],
+                       capacity_factor=attrs["capacity_factor"])
+    return {"Out": out, "AuxLoss": aux.reshape((1,))}

@@ -15,3 +15,15 @@ from paddle_tpu.parallel.env import (
     get_mesh,
     set_mesh,
 )
+from paddle_tpu.parallel.ring_attention import ring_attention
+from paddle_tpu.parallel.ulysses import ulysses_attention
+from paddle_tpu.parallel.pipeline import (
+    PipelineOptimizer,
+    pipeline_apply,
+    stack_stage_params,
+)
+from paddle_tpu.parallel.moe import moe_ffn, switch_gating
+from paddle_tpu.parallel.zero import (
+    is_optimizer_accumulator,
+    zero_sharding_rules,
+)

@@ -1,0 +1,123 @@
+"""Pipeline parallelism: GPipe-style microbatching over a mesh axis.
+
+Reference parity (SURVEY.md §2.4 "Pipeline parallelism (PP)"):
+  - PipelineTrainer + SectionWorker scope-queues between sections:
+    /root/reference/paddle/fluid/framework/trainer.h:95-120,
+    section_worker.cc:141
+  - PipelineOptimizer splitting the program into per-device sections:
+    /root/reference/python/paddle/fluid/optimizer.py:2664,2924
+
+TPU-first difference (SURVEY.md §7 hard part (c)): no host threads or scope
+queues — stages are mesh shards running the same SPMD program, microbatch
+activations hop stage->stage via lax.ppermute (collective-permute on ICI),
+and the schedule is a lax.scan over M + S - 1 ticks.  Backward through the
+scan gives the GPipe fwd-then-bwd schedule; XLA overlaps the permute with
+stage compute.  Stages must be homogeneous (same stage_fn, stacked weights)
+— the transformer-stack case the reference's SectionWorker was used for.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn, stage_params, x, num_microbatches,
+                   mesh=None, axis="pp"):
+    """Run ``x`` through S homogeneous pipeline stages.
+
+    stage_fn(params_leafwise, microbatch) -> microbatch (same shape).
+    stage_params: pytree whose leaves have leading dim S (one slice per
+    stage), sharded over ``axis``.
+    x: [B, ...] global batch; B % num_microbatches == 0.
+    Returns stage_fn composed S times over x, computed pipeline-parallel.
+    """
+    from paddle_tpu.parallel import env as penv
+
+    if mesh is None:
+        mesh = penv.get_mesh()
+    M = num_microbatches
+    if mesh is None or axis not in mesh.axis_names \
+            or mesh.shape[axis] == 1:
+        # degenerate: sequential composition
+        S = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+        out = x
+        for i in range(S):
+            p_i = jax.tree_util.tree_map(lambda a: a[i], stage_params)
+            out = stage_fn(p_i, out)
+        return out
+
+    from paddle_tpu.parallel.env import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    S = mesh.shape[axis]
+    b = x.shape[0]
+    assert b % M == 0, f"batch {b} % microbatches {M} != 0"
+    mb = b // M
+    xmb = x.reshape((M, mb) + x.shape[1:])
+
+    params_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+
+    def local(params, xs):
+        stage = lax.axis_index(axis)
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(buf, t):
+            # stage 0 injects microbatch t (clamped; ticks >= M feed
+            # garbage that never reaches the collected outputs)
+            inj = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            inp = jnp.where(stage == 0, inj, buf)
+            out = stage_fn(p_local, inp)
+            nxt = lax.ppermute(out, axis, fwd_perm)
+            return nxt, out
+
+        buf0 = jnp.zeros_like(xs[0])
+        _, outs = lax.scan(tick, buf0, jnp.arange(M + S - 1))
+        # the last stage's outputs at ticks [S-1, S-1+M) are the results;
+        # broadcast them to every shard (out_specs replicated)
+        valid = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
+        mine = jnp.where(stage == S - 1, valid,
+                         jnp.zeros_like(valid))
+        return lax.psum(mine, axis)
+
+    out = shard_map(local, mesh=mesh,
+                    in_specs=(params_spec, P()),
+                    out_specs=P(), check_rep=False)(stage_params, xmb)
+    return out.reshape((b,) + out.shape[2:])
+
+
+def stack_stage_params(per_stage_params):
+    """[pytree_stage0, pytree_stage1, ...] -> one pytree with leading stage
+    dim (what pipeline_apply consumes)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+class PipelineOptimizer:
+    """API-parity wrapper (reference optimizer.py:2664).
+
+    The reference cuts a Program into sections run by SectionWorker threads.
+    The TPU design expresses the pipeline *inside* the jitted step via
+    pipeline_apply; this wrapper carries the microbatch config and delegates
+    minimize to the inner optimizer — models built with homogeneous stages
+    (e.g. models/transformer.py blocks) route their stack through
+    pipeline_apply when a 'pp' mesh axis is active."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._num_microbatches = num_microbatches
+
+    @property
+    def num_microbatches(self):
+        return self._num_microbatches
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        grad_clip)
