@@ -46,6 +46,10 @@ from paddle_tpu import parallel
 from paddle_tpu import profiler
 from paddle_tpu import dygraph
 from paddle_tpu import contrib
+from paddle_tpu import dataset
+from paddle_tpu import datasets
+from paddle_tpu import native
+from paddle_tpu.dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from paddle_tpu.data_feeder import DataFeeder
 
 __version__ = "0.1.0"

@@ -183,5 +183,50 @@ class Executor:
             results.append(val)
         return results
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Dataset-driven training (reference executor.py:927
+        train_from_dataset -> framework/executor.cc:120 RunFromDataset).
+
+        The reference spawns a DeviceWorker thread per core, each
+        interpreting the program over its file shard (Hogwild).  Here the
+        dataset's reader threads + native parser produce batches and ONE
+        compiled program consumes them — thread-level compute parallelism
+        is replaced by XLA batch/mesh parallelism (SURVEY.md §3.4)."""
+        from paddle_tpu import framework
+
+        if dataset is None:
+            raise ValueError("dataset is required")
+        if program is None:
+            program = framework.default_main_program()
+        if scope is None:
+            scope = global_scope()
+        if thread:
+            dataset.set_thread(thread)
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [
+            (f if isinstance(f, str) else f.name) for f in fetch_list]
+        step = 0
+        for feed in dataset._iter_batches():
+            results = self.run(program, feed=feed, fetch_list=fetch_list,
+                               scope=scope)
+            step += 1
+            if debug and fetch_list and step % print_period == 0:
+                msg = ", ".join(
+                    f"{name}={np.asarray(val).ravel()[:4]}"
+                    for name, val in zip(fetch_info, results))
+                print(f"step {step}: {msg}")
+        return None
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """reference executor.py infer_from_dataset (same loop, test-mode
+        program is the caller's responsibility via Program.clone(True))."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     def close(self):
         pass

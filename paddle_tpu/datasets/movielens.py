@@ -1,0 +1,70 @@
+"""MovieLens reader creators (reference python/paddle/dataset/movielens.py).
+
+Samples: (user_id, gender_id, age_id, job_id, movie_id, category_ids,
+title_ids, score) — the recommender book-test layout.  Synthetic offline
+with a low-rank user x movie preference structure so the recommender model
+has signal to fit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_N_USER = 944
+_N_MOVIE = 1683
+_N_JOB = 21
+_N_AGE = 7
+_N_CATEGORY = 19
+_TITLE_VOCAB = 5175
+
+
+def max_user_id():
+    return _N_USER - 1
+
+
+def max_movie_id():
+    return _N_MOVIE - 1
+
+
+def max_job_id():
+    return _N_JOB - 1
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def _factors():
+    rng = np.random.RandomState(77)
+    return (rng.randn(_N_USER, 8).astype(np.float32),
+            rng.randn(_N_MOVIE, 8).astype(np.float32))
+
+
+def _reader(n, seed):
+    uf, mf = _factors()
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            u = int(rng.randint(1, _N_USER))
+            m = int(rng.randint(1, _N_MOVIE))
+            raw = float(uf[u] @ mf[m])
+            score = float(np.clip(np.round(3.0 + raw), 1, 5))
+            gender = u % 2
+            age = u % _N_AGE
+            job = u % _N_JOB
+            cats = [int(c) for c in
+                    rng.randint(0, _N_CATEGORY, rng.randint(1, 4))]
+            title = [int(t) for t in
+                     rng.randint(0, _TITLE_VOCAB, rng.randint(1, 6))]
+            yield u, gender, age, job, m, cats, title, score
+
+    return reader
+
+
+def train():
+    return _reader(4000, 0)
+
+
+def test():
+    return _reader(800, 1)

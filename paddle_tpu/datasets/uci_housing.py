@@ -1,0 +1,71 @@
+"""UCI housing reader creators (reference python/paddle/dataset/uci_housing.py).
+
+Samples: (features float32[13], price float32[1]).  Offline environment:
+synthesized from a fixed linear model + noise (fit_a_line converges on
+it); a real ``housing.data`` in the cache dir is used when present.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD",
+    "TAX", "PTRATIO", "B", "LSTAT",
+]
+
+_W = None
+
+
+def _model():
+    global _W
+    if _W is None:
+        rng = np.random.RandomState(7)
+        _W = (rng.randn(13, 1).astype(np.float32),
+              np.float32(rng.randn()))
+    return _W
+
+
+def _load_real():
+    from paddle_tpu import datasets
+
+    path = os.path.join(datasets.get_data_home(), "housing.data")
+    if not os.path.exists(path):
+        return None
+    data = np.loadtxt(path).astype(np.float32)
+    feats = data[:, :13]
+    feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-6)
+    return feats, data[:, 13:14]
+
+
+def _synthetic(n, seed):
+    w, b = _model()
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 13).astype(np.float32)
+    y = x @ w + b + 0.1 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def _reader(n, seed, lo, hi):
+    def reader():
+        real = _load_real()
+        if real is not None:
+            x, y = real
+            x, y = x[int(len(x) * lo):int(len(x) * hi)], \
+                y[int(len(y) * lo):int(len(y) * hi)]
+        else:
+            x, y = _synthetic(n, seed)
+        for xi, yi in zip(x, y):
+            yield xi, yi
+
+    return reader
+
+
+def train():
+    return _reader(2000, 0, 0.0, 0.8)
+
+
+def test():
+    return _reader(500, 1, 0.8, 1.0)

@@ -1,0 +1,166 @@
+"""Dataset API + train_from_dataset + canned datasets tests (reference
+test_dataset.py / dataset trainer path §3.4)."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+
+def _write_multislot_files(tmp_path, n_files=2, lines_per_file=64,
+                           seed=0):
+    """MultiSlot text: slot0 = 8 floats (x), slot1 = 1 float (y = x.w)."""
+    rng = np.random.RandomState(seed)
+    W = np.arange(1, 9, dtype=np.float32).reshape(8, 1) / 10
+    paths = []
+    for fi in range(n_files):
+        path = str(tmp_path / f"part-{fi}")
+        with open(path, "w") as f:
+            for _ in range(lines_per_file):
+                x = rng.rand(8).astype(np.float32)
+                y = float((x @ W)[0])
+                f.write("8 " + " ".join(f"{v:.6f}" for v in x)
+                        + f" 1 {y:.6f}\n")
+        paths.append(path)
+    return paths, W
+
+
+def test_in_memory_dataset_shuffle_and_batches(tmp_path):
+    paths, _ = _write_multislot_files(tmp_path)
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(16)
+    ds.set_filelist(paths)
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 128
+    first_before = ds._samples[0][0].copy()
+    ds.local_shuffle(seed=3)
+    batches = list(ds._iter_batches())
+    assert len(batches) == 8
+    assert batches[0]["x"].shape == (16, 8)
+    assert batches[0]["y"].shape == (16, 1)
+
+
+def test_queue_dataset_streams_all_samples(tmp_path):
+    paths, _ = _write_multislot_files(tmp_path, n_files=3,
+                                      lines_per_file=40)
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(8)
+    ds.set_thread(2)
+    ds.set_filelist(paths)
+    ds.set_use_var([x, y])
+    total = sum(b["x"].shape[0] for b in ds._iter_batches())
+    assert total == 120
+
+
+def test_train_from_dataset_converges(tmp_path):
+    paths, W = _write_multislot_files(tmp_path, n_files=2,
+                                      lines_per_file=256)
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.SGD(0.1).minimize(loss)
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(32)
+    ds.set_filelist(paths)
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    ds.local_shuffle()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for _ in range(12):      # epochs
+        exe.train_from_dataset(fluid.default_main_program(), ds,
+                               fetch_list=[loss])
+    xs = np.random.RandomState(9).rand(64, 8).astype(np.float32)
+    lv, = exe.run(feed={"x": xs, "y": xs @ W}, fetch_list=[loss])
+    assert float(lv) < 0.01, float(lv)
+
+
+def test_pipe_command_preprocessing(tmp_path):
+    """pipe_command transforms file bytes before parsing (reference
+    Dataset pipe_command)."""
+    path = str(tmp_path / "raw")
+    # raw file is CSV; sed turns it into MultiSlot "2 a b 1 c"
+    with open(path, "w") as f:
+        f.write("0.1,0.2,0.9\n0.3,0.4,0.7\n")
+    x = layers.data("x", shape=[2], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(2)
+    ds.set_filelist([path])
+    ds.set_pipe_command("sed 's/^/2 /; s/,/ /; s/,/ 1 /'")
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    batches = list(ds._iter_batches())
+    np.testing.assert_allclose(batches[0]["x"],
+                               [[0.1, 0.2], [0.3, 0.4]], rtol=1e-5)
+    np.testing.assert_allclose(batches[0]["y"], [[0.9], [0.7]],
+                               rtol=1e-5)
+
+
+def test_ragged_int_slot_padding(tmp_path):
+    path = str(tmp_path / "seq")
+    with open(path, "w") as f:
+        f.write("2 3 5 1 1.0\n4 7 8 9 2 1 0.0\n")
+    ids = layers.data("ids", shape=[-1, 1], dtype="int64")
+    lbl = layers.data("lbl", shape=[1], dtype="float32")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(2)
+    ds.set_filelist([path])
+    ds.set_use_var([ids, lbl])
+    ds.load_into_memory()
+    b = next(ds._iter_batches())
+    assert b["ids"].shape == (2, 4, 1)
+    np.testing.assert_array_equal(b["ids"][0, :, 0], [3, 5, 0, 0])
+    np.testing.assert_array_equal(b["ids"][1, :, 0], [7, 8, 9, 2])
+
+
+def test_canned_datasets_shapes():
+    from paddle_tpu import datasets
+
+    img, lbl = next(datasets.mnist.train()())
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    x, y = next(datasets.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    im, l10 = next(datasets.cifar.train10()())
+    assert im.shape == (3072,) and 0 <= l10 < 10
+    words, sent = next(datasets.imdb.train()())
+    assert isinstance(words, list) and sent in (0, 1)
+    gram = next(datasets.imikolov.train(n=5)())
+    assert len(gram) == 5
+    rec = next(datasets.movielens.train()())
+    assert len(rec) == 8 and 1.0 <= rec[-1] <= 5.0
+
+
+def test_mnist_synthetic_is_learnable():
+    """The synthetic digits must be separable — a softmax regression gets
+    well above chance in a few epochs (keeps book tests meaningful)."""
+    from paddle_tpu import datasets
+    from paddle_tpu.reader import batch
+
+    img = layers.data("img", shape=[784], dtype="float32")
+    lbl = layers.data("lbl", shape=[1], dtype="int64")
+    logits = layers.fc(img, size=10)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, lbl))
+    acc = layers.accuracy(layers.softmax(logits), lbl)
+    optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    accs = []
+    for _ in range(2):
+        for samples in batch(datasets.mnist.train(), 64)():
+            imgs = np.stack([s[0] for s in samples])
+            lbls = np.array([[s[1]] for s in samples], np.int64)
+            _, a = exe.run(feed={"img": imgs, "lbl": lbls},
+                           fetch_list=[loss, acc])
+            accs.append(float(a))
+    assert np.mean(accs[-20:]) > 0.7, np.mean(accs[-20:])
