@@ -50,6 +50,8 @@ from paddle_tpu import dataset
 from paddle_tpu import datasets
 from paddle_tpu import native
 from paddle_tpu.dataset import DatasetFactory, InMemoryDataset, QueueDataset
+from paddle_tpu import inference
+from paddle_tpu import fleet as fleet_pkg
 from paddle_tpu.data_feeder import DataFeeder
 
 __version__ = "0.1.0"

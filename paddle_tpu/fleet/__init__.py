@@ -1,0 +1,215 @@
+"""Fleet: the unified distributed-training facade.
+
+Reference parity (SURVEY.md §2.4 "Fleet API"):
+  - Fleet base + fleet.init/distributed_optimizer/minimize:
+    /root/reference/python/paddle/fluid/incubate/fleet/base/fleet_base.py:37,230
+  - collective impl: incubate/fleet/collective/__init__.py:215
+    (CollectiveOptimizer)
+  - role makers: incubate/fleet/base/role_maker.py
+
+TPU-first difference: the collective backend is the XLA SPMD mesh, not
+NCCL2 transpilation — distributed_optimizer().minimize() builds the normal
+program and fleet.main_program returns a CompiledProgram whose feeds are
+batch-sharded over every device of every host (multi-host wired by
+jax.distributed from the same PADDLE_* env contract the reference uses).
+"""
+
+from __future__ import annotations
+
+import os
+
+from paddle_tpu.fleet import role_maker as role_maker_mod
+from paddle_tpu.fleet.role_maker import (
+    PaddleCloudRoleMaker,
+    Role,
+    RoleMakerBase,
+    UserDefinedRoleMaker,
+)
+
+__all__ = ["fleet", "DistributedStrategy", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "RoleMakerBase", "Role"]
+
+
+class DistributedStrategy:
+    """reference collective DistributedStrategy knobs; the ones XLA
+    subsumes (fuse_all_reduce, hierarchical allreduce) are recorded for
+    introspection but need no action."""
+
+    def __init__(self):
+        self.mode = "collective"
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.fuse_all_reduce_ops = True
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.use_amp = False
+        self.amp_loss_scaling = 2 ** 15
+        # ZeRO-style state sharding (maps to parallel.zero rules)
+        self.zero_stage = 0
+
+
+class _Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+        self._compiled = None
+        self._origin_program = None
+        self._loss = None
+        self._is_initialized = False
+
+    # -- lifecycle (reference fleet_base.py Fleet) ------------------------
+    def init(self, role_maker=None):
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker(is_collective=True)
+        role_maker.generate_role()
+        self._role_maker = role_maker
+        self._maybe_init_distributed()
+        self._is_initialized = True
+        return self
+
+    def _maybe_init_distributed(self):
+        """Multi-host: bring up the JAX distributed runtime from the
+        PADDLE_* env contract (replaces launch.py + gen_nccl_id RPC
+        bootstrap, reference transpiler/collective.py + nccl2 mode)."""
+        import jax
+
+        n = self._role_maker.worker_num()
+        if n <= 1 or jax.process_count() > 1:
+            return
+        coordinator = os.environ.get("PADDLE_COORDINATOR_ENDPOINT")
+        if coordinator is None:
+            eps = self._role_maker.get_trainer_endpoints()
+            coordinator = eps[0] if eps else None
+        if coordinator:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=n,
+                    process_id=self._role_maker.worker_index())
+            except Exception:
+                # already initialized or single-host fallback
+                pass
+
+    # -- introspection ----------------------------------------------------
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def worker_endpoints(self):
+        return self._role_maker.get_trainer_endpoints()
+
+    @property
+    def main_program(self):
+        """The program to run: compiled data-parallel over the mesh."""
+        return self._compiled if self._compiled is not None else None
+
+    @property
+    def startup_program(self):
+        from paddle_tpu import framework
+
+        return framework.default_startup_program()
+
+    # -- no-op control plane (single-controller SPMD has no PS loop) ------
+    def init_worker(self):
+        pass
+
+    def init_server(self, model_dir=None):
+        pass
+
+    def run_server(self):
+        raise RuntimeError(
+            "collective fleet has no parameter server to run; PS-style "
+            "embedding service lives in paddle_tpu.ps")
+
+    def stop_worker(self):
+        pass
+
+    def barrier_worker(self):
+        import jax
+
+        if jax.process_count() > 1:
+            # a tiny psum across processes is the SPMD barrier
+            import jax.numpy as jnp
+
+            jax.device_get(jnp.zeros(()))
+
+    # -- optimizer --------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        return CollectiveOptimizer(self, optimizer, self._strategy)
+
+    # -- save (reference fleet_base save_* delegating to io) --------------
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from paddle_tpu import framework, io
+
+        return io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program or self._origin_program
+            or framework.default_main_program())
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from paddle_tpu import framework, io
+
+        io.save_persistables(
+            executor, dirname,
+            main_program or self._origin_program
+            or framework.default_main_program())
+
+
+class CollectiveOptimizer:
+    """reference incubate/fleet/collective/__init__.py:215."""
+
+    def __init__(self, fleet_obj, optimizer, strategy):
+        self._fleet = fleet_obj
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    def backward(self, *a, **k):
+        return self._optimizer.backward(*a, **k)
+
+    def apply_gradients(self, *a, **k):
+        return self._optimizer.apply_gradients(*a, **k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from paddle_tpu import framework
+        from paddle_tpu.core.compiler import CompiledProgram
+        from paddle_tpu.parallel import env as penv
+        from paddle_tpu.parallel.zero import zero_sharding_rules
+
+        opt = self._optimizer
+        if self._strategy.use_amp:
+            from paddle_tpu.contrib import mixed_precision as amp
+
+            opt = amp.decorate(
+                opt, init_loss_scaling=self._strategy.amp_loss_scaling)
+        ret = opt.minimize(loss, startup_program, parameter_list,
+                           no_grad_set)
+        main = framework.default_main_program()
+        self._fleet._origin_program = main
+        self._fleet._loss = loss
+        if penv.get_mesh() is None:
+            penv.set_mesh(penv.make_mesh())
+        compiled = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, mesh=penv.get_mesh())
+        if self._strategy.zero_stage:
+            compiled = compiled.with_sharding_rules(
+                zero_sharding_rules(stage=self._strategy.zero_stage))
+        self._fleet._compiled = compiled
+        return ret
+
+
+fleet = _Fleet()

@@ -1,0 +1,184 @@
+"""Inference engine: config + predictor over a frozen program.
+
+Reference parity (SURVEY.md §2.6):
+  - AnalysisConfig: /root/reference/paddle/fluid/inference/api/
+    paddle_analysis_config.h:40
+  - PaddlePredictor / CreatePaddlePredictor: inference/api/paddle_api.h:202,338
+  - analysis pipeline (ir fusion passes, memory optimize):
+    inference/analysis/analyzer.cc
+  - ZeroCopyTensor input/output handles: paddle_api.h
+
+TPU-first difference: the reference's 40+ analysis/fusion passes exist to
+hand-fuse subgraphs for cuDNN/TensorRT; here "analysis" is XLA compilation
+of the whole pruned program — one StableHLO module, fusion included.  The
+predictor owns a private Scope (isolation like the reference's
+sub-scope-per-predictor) and caches the compiled callable per input-shape
+signature.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["Config", "AnalysisConfig", "Predictor", "PaddleTensor",
+           "create_predictor", "create_paddle_predictor"]
+
+
+class Config:
+    """reference paddle_analysis_config.h (knobs that map to GPU/TRT/MKLDNN
+    are kept as recorded no-ops so reference configs port unchanged)."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self._model_dir = model_dir
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._use_feed_fetch_ops = False
+        self._memory_optim = True
+        self._glog_info = True
+
+    def set_model(self, model_dir_or_prog, params_file=None):
+        if params_file is None:
+            self._model_dir = model_dir_or_prog
+        else:
+            self._prog_file = model_dir_or_prog
+            self._params_file = params_file
+            self._model_dir = os.path.dirname(model_dir_or_prog)
+
+    def model_dir(self):
+        return self._model_dir
+
+    # -- recorded no-ops for API parity ----------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def switch_ir_optim(self, enable=True):
+        pass
+
+    def switch_use_feed_fetch_ops(self, enable=True):
+        self._use_feed_fetch_ops = enable
+
+    def enable_memory_optim(self, enable=True):
+        self._memory_optim = enable
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+
+AnalysisConfig = Config
+
+
+class PaddleTensor:
+    """Input/output handle (reference PaddleTensor + ZeroCopyTensor)."""
+
+    def __init__(self, name=None, data=None):
+        self.name = name
+        self._data = None if data is None else np.asarray(data)
+
+    # ZeroCopyTensor-style API
+    def copy_from_cpu(self, arr):
+        self._data = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return self._data
+
+    def reshape(self, shape):
+        if self._data is not None:
+            self._data = self._data.reshape(shape)
+
+    @property
+    def shape(self):
+        return None if self._data is None else list(self._data.shape)
+
+    def data(self):
+        return self._data
+
+
+class Predictor:
+    """reference analysis_predictor.cc AnalysisPredictor."""
+
+    def __init__(self, config: Config):
+        from paddle_tpu import io
+        from paddle_tpu.core.compiler import CompiledProgram
+        from paddle_tpu.core.executor import Executor
+        from paddle_tpu.core.scope import Scope, scope_guard
+        from paddle_tpu.core.types import CPUPlace
+
+        self._config = config
+        self._scope = Scope()
+        self._exe = Executor(CPUPlace())
+        model_dir = config.model_dir()
+        if model_dir is None:
+            raise ValueError("Config.set_model was not called")
+        kwargs = {}
+        if config._prog_file:
+            kwargs["model_filename"] = os.path.basename(config._prog_file)
+        if config._params_file:
+            kwargs["params_filename"] = os.path.basename(
+                config._params_file)
+        with scope_guard(self._scope):
+            self._program, self._feed_names, self._fetch_vars = \
+                io.load_inference_model(model_dir, self._exe, **kwargs)
+        self._compiled = CompiledProgram(self._program) \
+            .with_inference_optimize(config)
+        self._inputs = {n: PaddleTensor(n) for n in self._feed_names}
+
+    # -- ZeroCopy-style API ----------------------------------------------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    get_input_tensor = get_input_handle
+
+    def get_output_names(self):
+        return [v.name for v in self._fetch_vars]
+
+    def run(self, inputs=None):
+        """inputs: list of PaddleTensor/ndarray in get_input_names() order,
+        or None to use the handles filled via copy_from_cpu.  Returns list
+        of ndarrays; also retrievable via get_output_handle."""
+        feed = {}
+        if inputs is not None:
+            for name, t in zip(self._feed_names, inputs):
+                feed[name] = t.data() if isinstance(t, PaddleTensor) \
+                    else np.asarray(t)
+        else:
+            for name, t in self._inputs.items():
+                if t.data() is None:
+                    raise RuntimeError(
+                        f"input '{name}' not set; call copy_from_cpu")
+                feed[name] = t.data()
+        outs = self._exe.run(self._compiled, feed=feed,
+                             fetch_list=self._fetch_vars,
+                             scope=self._scope)
+        self._outputs = {v.name: PaddleTensor(v.name, o)
+                         for v, o in zip(self._fetch_vars, outs)}
+        return outs
+
+    # ZeroCopyRun: outputs pulled via handles after run()
+    zero_copy_run = run
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    get_output_tensor = get_output_handle
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference CreatePaddlePredictor (paddle_api.h:338)."""
+    return Predictor(config)
+
+
+create_paddle_predictor = create_predictor

@@ -1,0 +1,123 @@
+"""Fleet facade + role maker + launcher tests (reference
+test_dist_fleet_base pattern, single-host)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.fleet import (
+    DistributedStrategy,
+    PaddleCloudRoleMaker,
+    UserDefinedRoleMaker,
+    fleet,
+)
+
+
+def test_paddlecloud_role_maker_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "a:1,b:2,c:3,d:4")
+    monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "c:3")
+    rm = PaddleCloudRoleMaker()
+    rm.generate_role()
+    assert rm.worker_index() == 2
+    assert rm.worker_num() == 4
+    assert not rm.is_first_worker()
+    assert rm.get_current_endpoint() == "c:3"
+    assert rm.is_worker()
+
+
+def test_fleet_collective_training():
+    rng = np.random.RandomState(0)
+    W = rng.randn(8, 1).astype(np.float32)
+    rm = UserDefinedRoleMaker(current_id=0, worker_num=1)
+    fleet.init(rm)
+    assert fleet.is_first_worker() and fleet.worker_num() == 1
+
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    strategy = DistributedStrategy()
+    dist_opt = fleet.distributed_optimizer(optimizer.SGD(0.1), strategy)
+    dist_opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fleet.startup_program)
+    losses = []
+    for _ in range(40):
+        bx = rng.rand(32, 8).astype(np.float32)
+        lv, = exe.run(fleet.main_program,
+                      feed={"x": bx, "y": bx @ W}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+
+def test_fleet_zero_strategy():
+    from paddle_tpu.parallel import env as penv
+
+    penv.reset()
+    rng = np.random.RandomState(1)
+    W = rng.randn(8, 1).astype(np.float32)
+    fleet.init(UserDefinedRoleMaker(current_id=0, worker_num=1))
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    strategy = DistributedStrategy()
+    strategy.zero_stage = 1
+    fleet.distributed_optimizer(optimizer.Adam(0.05),
+                                strategy).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fleet.startup_program)
+    for _ in range(10):
+        bx = rng.rand(32, 8).astype(np.float32)
+        lv, = exe.run(fleet.main_program,
+                      feed={"x": bx, "y": bx @ W}, fetch_list=[loss])
+    assert np.isfinite(lv)
+    penv.reset()
+
+
+def test_fleet_save_inference_model(tmp_path):
+    rng = np.random.RandomState(2)
+    fleet.init(UserDefinedRoleMaker(current_id=0, worker_num=1))
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fleet.distributed_optimizer(optimizer.SGD(0.1)).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fleet.startup_program)
+    d = str(tmp_path / "fleet_model")
+    fleet.save_inference_model(exe, d, ["x"], [pred])
+    assert os.path.exists(os.path.join(d, "__model__"))
+
+
+_LAUNCH_CHILD = r"""
+import os, sys
+tid = os.environ["PADDLE_TRAINER_ID"]
+num = os.environ["PADDLE_TRAINERS_NUM"]
+eps = os.environ["PADDLE_TRAINER_ENDPOINTS"]
+cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
+assert eps.split(",")[int(tid)] == cur
+print(f"rank={tid}/{num} ep={cur}")
+"""
+
+
+def test_launch_spawns_ranked_processes(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text(_LAUNCH_CHILD)
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.launch",
+         "--nproc_per_node", "2", "--started_port", "6199",
+         str(script)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    assert "rank=0/2" in out.stdout
+    assert "rank=1/2" in out.stdout

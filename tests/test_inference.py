@@ -1,0 +1,74 @@
+"""Inference predictor tests (reference inference/tests/api analyzer
+pattern + tests/book train->save->load->infer round trip)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import inference, layers, optimizer
+from paddle_tpu.core.scope import Scope, scope_guard
+
+
+def _train_and_save(tmp_path, steps=80):
+    rng = np.random.RandomState(0)
+    W = rng.randn(8, 1).astype(np.float32)
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.Adam(0.02).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for _ in range(steps):
+        bx = rng.rand(32, 8).astype(np.float32)
+        exe.run(feed={"x": bx, "y": bx @ W}, fetch_list=[loss])
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    probe = rng.rand(4, 8).astype(np.float32)
+    expect, = exe.run(feed={"x": probe,
+                            "y": np.zeros((4, 1), np.float32)},
+                      fetch_list=[pred])
+    return d, probe, expect
+
+
+def test_predictor_matches_training_forward(tmp_path):
+    d, probe, expect = _train_and_save(tmp_path)
+    config = inference.Config(d)
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    out, = predictor.run([probe])
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_zero_copy_handles(tmp_path):
+    d, probe, expect = _train_and_save(tmp_path)
+    predictor = inference.create_predictor(inference.Config(d))
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(probe)
+    predictor.run()
+    out_name = predictor.get_output_names()[0]
+    out = predictor.get_output_handle(out_name).copy_to_cpu()
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_pruned_program_drops_training_ops(tmp_path):
+    d, _, _ = _train_and_save(tmp_path)
+    predictor = inference.create_predictor(inference.Config(d))
+    op_types = {op.type for op in
+                predictor._program.global_block().ops}
+    assert "adam" not in op_types
+    assert not any(t.endswith("_grad") for t in op_types), op_types
+
+
+def test_predictor_isolated_scope(tmp_path):
+    """Two predictors must not share parameter state (reference: per-
+    predictor sub-scope)."""
+    d, probe, expect = _train_and_save(tmp_path)
+    p1 = inference.create_predictor(inference.Config(d))
+    p2 = inference.create_predictor(inference.Config(d))
+    # clobber p1's params; p2 must be unaffected
+    for name, var in p1._scope.vars.items():
+        if var.get() is not None and "w" in name:
+            var.set(np.zeros_like(np.asarray(var.get())))
+    out2, = p2.run([probe])
+    np.testing.assert_allclose(out2, expect, rtol=1e-5, atol=1e-6)
