@@ -52,6 +52,9 @@ from paddle_tpu import native
 from paddle_tpu.dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from paddle_tpu import inference
 from paddle_tpu import fleet as fleet_pkg
+from paddle_tpu import flags as flags_mod
+from paddle_tpu import debugger
+from paddle_tpu.flags import get_flag, set_flags
 from paddle_tpu.data_feeder import DataFeeder
 
 __version__ = "0.1.0"

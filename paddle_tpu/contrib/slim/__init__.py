@@ -1,0 +1,16 @@
+"""slim — quantization (QAT + PTQ).
+
+Reference parity: /root/reference/python/paddle/fluid/contrib/slim/
+(quantization passes; the NAS/pruning/distillation sub-packages of the
+reference are orthogonal training recipes, not runtime components).
+"""
+
+from paddle_tpu.contrib.slim.quantization import (
+    QuantizationFreezePass,
+    QuantizationTransformPass,
+    post_training_quantize,
+    quant_aware,
+)
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "quant_aware", "post_training_quantize"]

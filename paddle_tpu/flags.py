@@ -1,0 +1,75 @@
+"""Typed flag/config system.
+
+Reference parity (SURVEY.md §5 "Config / flag system"): the reference
+scatters gflags DEFINE_* through C++ (executor.cc:40, allocator_strategy.cc,
+gpu_info.cc) re-exported to Python by whitelist (__init__.py:124
+__bootstrap__ -> core.init_gflags).  Here ONE typed registry replaces the
+three idioms; every flag reads an env override ``PADDLE_TPU_<NAME>`` at
+import, mirroring the reference's env-driven bootstrap.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_REGISTRY: dict = {}
+
+
+class _Flag:
+    __slots__ = ("name", "type", "value", "help")
+
+    def __init__(self, name, type_, default, help_):
+        self.name = name
+        self.type = type_
+        self.value = default
+        self.help = help_
+
+
+def _coerce(type_, raw: str):
+    if type_ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return type_(raw)
+
+
+def define_flag(name: str, default: Any, help_: str = ""):
+    type_ = type(default)
+    env = os.environ.get(f"PADDLE_TPU_{name.upper()}")
+    value = _coerce(type_, env) if env is not None else default
+    _REGISTRY[name] = _Flag(name, type_, value, help_)
+
+
+def get_flag(name: str):
+    return _REGISTRY[name].value
+
+
+def set_flags(flags: dict):
+    """reference fluid.set_flags analog."""
+    for name, value in flags.items():
+        f = _REGISTRY.get(name)
+        if f is None:
+            raise KeyError(f"unknown flag '{name}'")
+        if not isinstance(value, f.type):
+            value = _coerce(f.type, str(value))
+        f.value = value
+
+
+def all_flags():
+    return {name: f.value for name, f in _REGISTRY.items()}
+
+
+# ---------------------------------------------------------------------------
+# core flags (reference counterparts noted)
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "sweep op outputs for NaN/Inf after each interpreted op "
+            "(reference FLAGS_check_nan_inf, operator.cc:953)")
+define_flag("benchmark", False,
+            "block after each op to localize async failures "
+            "(reference FLAGS_benchmark, operator.cc:949)")
+define_flag("profile_ops", False,
+            "record a host span per interpreted op "
+            "(reference platform/profiler RecordEvent around op Run)")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "GC threshold placeholder (XLA owns buffers; reference "
+            "executor GC flag)")

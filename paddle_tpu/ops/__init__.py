@@ -17,3 +17,4 @@ from paddle_tpu.ops import io_ops  # noqa: F401
 from paddle_tpu.ops import detection  # noqa: F401
 from paddle_tpu.ops import amp  # noqa: F401
 from paddle_tpu.ops import parallel_ops  # noqa: F401
+from paddle_tpu.ops import quant  # noqa: F401

@@ -1,0 +1,96 @@
+"""Quantization ops: fake quantize/dequantize with straight-through grads.
+
+Reference parity:
+  - fake_quantize_abs_max / fake_quantize_moving_average_abs_max /
+    fake_channel_wise_quantize_abs_max / fake_dequantize_max_abs:
+    /root/reference/paddle/fluid/operators/fake_quantize_op.cc,
+    fake_dequantize_op.cc
+  - used by the slim QAT passes
+    (contrib/slim/quantization/quantization_pass.py).
+
+TPU-first trick: the straight-through estimator is baked into the compute
+as ``x + stop_gradient(q(x) - x)``, so the registry's generic vjp grad
+(jax.vjp over the forward) automatically yields the identity backward the
+reference implements as a separate grad kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+
+
+def _quantize(x, scale, bits):
+    """Symmetric uniform quantization to `bits` (dequantized domain)."""
+    bnd = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * bnd), -bnd, bnd)
+    return q * s / bnd
+
+
+def _ste(x, q):
+    return x + lax.stop_gradient(q - x)
+
+
+@register_op("fake_quantize_abs_max", inputs=("X",),
+             outputs=("Out", "OutScale"), attrs={"bit_length": 8})
+def fake_quantize_abs_max(ins, attrs):
+    x = ins["X"]
+    scale = jnp.max(jnp.abs(x))
+    q = _quantize(x, scale, attrs["bit_length"])
+    return {"Out": _ste(x, q), "OutScale": scale.reshape((1,))}
+
+
+@register_op("fake_channel_wise_quantize_abs_max", inputs=("X",),
+             outputs=("Out", "OutScale"),
+             attrs={"bit_length": 8, "quant_axis": 0})
+def fake_channel_wise_quantize_abs_max(ins, attrs):
+    x = ins["X"]
+    ax = attrs["quant_axis"] % x.ndim
+    red = tuple(i for i in range(x.ndim) if i != ax)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    q = _quantize(x, scale, attrs["bit_length"])
+    return {"Out": _ste(x, q), "OutScale": scale.reshape(-1)}
+
+
+@register_op("fake_quantize_moving_average_abs_max",
+             inputs=("X", "InScale", "InState", "InAccum"),
+             outputs=("Out", "OutScale", "OutState", "OutAccum"),
+             optional=("InState", "InAccum"),
+             attrs={"bit_length": 8, "moving_rate": 0.9,
+                    "is_test": False},
+             in_place={"OutScale": "InScale", "OutState": "InState",
+                       "OutAccum": "InAccum"})
+def fake_quantize_moving_average_abs_max(ins, attrs):
+    """Activation quantization with an EMA of abs-max scales (reference
+    fake_quantize_op.cc FakeQuantizeMovingAverageAbsMaxOp).  State/Accum
+    implement the bias-corrected EMA exactly like the reference."""
+    x = ins["X"]
+    in_scale = ins["InScale"].reshape(())
+    if attrs["is_test"]:
+        q = _quantize(x, in_scale, attrs["bit_length"])
+        return {"Out": _ste(x, q), "OutScale": in_scale.reshape((1,)),
+                "OutState": ins.get("InState",
+                                    jnp.ones((1,), x.dtype)),
+                "OutAccum": ins.get("InAccum",
+                                    in_scale.reshape((1,)))}
+    cur = lax.stop_gradient(jnp.max(jnp.abs(x)))
+    rate = attrs["moving_rate"]
+    state = ins.get("InState", jnp.ones((1,), x.dtype)).reshape(())
+    accum = ins.get("InAccum", in_scale.reshape((1,))).reshape(())
+    state_out = rate * state + 1.0
+    accum_out = rate * accum + cur
+    scale = accum_out / state_out
+    q = _quantize(x, scale, attrs["bit_length"])
+    return {"Out": _ste(x, q), "OutScale": scale.reshape((1,)),
+            "OutState": state_out.reshape((1,)),
+            "OutAccum": accum_out.reshape((1,))}
+
+
+@register_op("fake_dequantize_max_abs", inputs=("X", "Scale"),
+             outputs=("Out",), attrs={"max_range": 127.0})
+def fake_dequantize_max_abs(ins, attrs):
+    return {"Out": ins["X"].astype(jnp.float32)
+            * ins["Scale"].reshape(()) / attrs["max_range"]}

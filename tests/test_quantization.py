@@ -1,0 +1,113 @@
+"""Quantization tests (reference slim test_quantization_pass.py pattern)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.contrib.slim import (
+    QuantizationFreezePass,
+    QuantizationTransformPass,
+    post_training_quantize,
+)
+from paddle_tpu.core.registry import get_op_def
+
+
+def test_fake_quantize_abs_max_numeric():
+    import jax.numpy as jnp
+
+    op = get_op_def("fake_quantize_abs_max")
+    x = np.array([-1.0, -0.5, 0.0, 0.37, 1.0], np.float32)
+    outs = op.compute({"X": jnp.asarray(x)}, {"bit_length": 8})
+    scale = float(outs["OutScale"][0])
+    assert scale == 1.0
+    expect = np.round(x * 127) / 127
+    np.testing.assert_allclose(np.asarray(outs["Out"]), expect,
+                               atol=1e-6)
+
+
+def test_fake_quantize_ste_gradient():
+    import jax
+    import jax.numpy as jnp
+
+    op = get_op_def("fake_quantize_abs_max")
+
+    def f(x):
+        return jnp.sum(op.compute({"X": x}, {"bit_length": 8})["Out"])
+
+    g = jax.grad(f)(jnp.asarray([0.3, -0.7, 0.9], jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), np.ones(3), atol=1e-6)
+
+
+def _build_net():
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return x, y, pred, loss
+
+
+def test_qat_transform_inserts_fake_quant_and_trains():
+    rng = np.random.RandomState(0)
+    W = rng.randn(8, 1).astype(np.float32)
+    _, _, pred, loss = _build_net()
+    optimizer.Adam(0.02).minimize(loss)
+    prog = fluid.default_main_program()
+    QuantizationTransformPass().apply(prog)
+    qops = [op.type for op in prog.global_block().ops
+            if op.type.startswith("fake_quantize")]
+    # 2 mul ops -> 2 weight quants (abs_max) + 2 act quants (EMA)
+    assert qops.count("fake_quantize_abs_max") == 2
+    assert qops.count("fake_quantize_moving_average_abs_max") == 2
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(150):
+        bx = rng.rand(32, 8).astype(np.float32)
+        lv, = exe.run(prog, feed={"x": bx, "y": bx @ W},
+                      fetch_list=[loss])
+        losses.append(float(lv))
+    assert np.mean(losses[-10:]) < losses[0] * 0.15, losses[::30]
+
+
+def test_freeze_produces_int8_weights():
+    from paddle_tpu.core.scope import global_scope
+
+    rng = np.random.RandomState(1)
+    _, _, pred, loss = _build_net()
+    optimizer.SGD(0.05).minimize(loss)
+    prog = fluid.default_main_program()
+    QuantizationTransformPass().apply(prog)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for _ in range(20):
+        bx = rng.rand(16, 8).astype(np.float32)
+        exe.run(prog, feed={"x": bx,
+                            "y": np.sum(bx, 1, keepdims=True)},
+                fetch_list=[loss])
+    frozen = QuantizationFreezePass(global_scope()).apply(prog)
+    assert len(frozen) == 2
+    for name, (q, scale) in frozen.items():
+        assert q.dtype == np.int8
+        w = np.asarray(global_scope().find_var(name).get())
+        # stored weights are now the dequantized int8 values
+        np.testing.assert_allclose(
+            w, q.astype(np.float32) * scale / 127.0, atol=1e-6)
+
+
+def test_post_training_quantize_collects_scales():
+    from paddle_tpu.core.scope import global_scope
+
+    rng = np.random.RandomState(2)
+    _, _, pred, loss = _build_net()
+    prog = fluid.default_main_program()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    batches = [{"x": rng.rand(8, 8).astype(np.float32) * (i + 1),
+                "y": np.zeros((8, 1), np.float32)} for i in range(3)]
+    scales, weights = post_training_quantize(
+        prog, global_scope(), exe, batches, fetch_list=[loss])
+    assert scales["x"] > 0
+    assert len(weights) == 2
+    for q, s in weights.values():
+        assert q.dtype == np.int8 and s > 0
