@@ -28,7 +28,7 @@ __all__ = [
     "logical_not", "cumsum", "increment", "shape", "reduce_all",
     "reduce_any", "pow", "sqrt", "square", "abs", "exp", "log",
     "sequence_mask", "swish", "hard_sigmoid", "elu", "relu6", "softplus",
-    "softsign", "prelu", "brelu",
+    "softsign", "prelu", "brelu", "flash_attention",
 ]
 
 
@@ -906,3 +906,16 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     return _single_out("sequence_mask", x,
                        {"maxlen": maxlen or -1, "out_dtype": dtype},
                        out_dtype=dtype, out_slot="Y")
+
+
+def flash_attention(q, k, v, causal=False, scale=None, name=None):
+    """Fused blockwise attention (Pallas TPU kernel; ops/pallas_kernels.py).
+
+    q/k/v: [B, H, T, D] post-split-heads.  Replaces the reference's
+    matmul+softmax+matmul composition (nets.py scaled_dot_product_attention)
+    with a single kernel that never materializes the [Tq, Tk] score matrix.
+    """
+    return _single_out(
+        "flash_attention", q,
+        {"causal": causal, "scale": float(scale or 0.0)},
+        ins_extra={"K": k, "V": v}, in_slot="Q")

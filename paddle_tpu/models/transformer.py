@@ -26,8 +26,15 @@ def _positional_encoding(max_len, d_model, dtype="float32"):
 
 def multi_head_attention(q_in, kv_in, d_model, n_head, dropout_rate=0.0,
                          causal=False, is_test=False, seq_len_q=None,
-                         seq_len_kv=None, name=None):
-    """q_in: [B, Tq, D]; kv_in: [B, Tk, D]."""
+                         seq_len_kv=None, name=None, use_flash=True):
+    """q_in: [B, Tq, D]; kv_in: [B, Tk, D].
+
+    When attention-weight dropout is off the score+softmax+weighted-sum is
+    emitted as one fused `flash_attention` op (Pallas kernel on TPU) —
+    the [Tq, Tk] matrix never touches HBM.  With weight dropout on, the
+    unfused composition is kept so the reference's dropout-on-weights
+    semantics hold exactly.
+    """
     tq = q_in.shape[1]
     tk = kv_in.shape[1]
     head_dim = d_model // n_head
@@ -42,17 +49,26 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout_rate=0.0,
     q = split_heads(q, tq)
     k = split_heads(k, tk)
     v = split_heads(v, tk)
-    attn = layers.matmul(q, k, transpose_y=True,
-                         alpha=float(head_dim) ** -0.5)  # [B,H,Tq,Tk]
-    if causal:
-        mask = np.triu(np.full((tq, tk), -1e9, np.float32), k=1)
-        mask_var = layers.assign(mask.reshape(1, 1, tq, tk))
-        attn = layers.elementwise_add(attn, mask_var)
-    weights = layers.softmax(attn)
-    if dropout_rate and not is_test:
-        weights = layers.dropout(weights, dropout_rate,
-                                 dropout_implementation="upscale_in_train")
-    out = layers.matmul(weights, v)  # [B,H,Tq,hd]
+    weight_dropout = bool(dropout_rate) and not is_test
+    if use_flash and not weight_dropout:
+        out = layers.flash_attention(q, k, v, causal=causal)
+    else:
+        attn = layers.matmul(q, k, transpose_y=True,
+                             alpha=float(head_dim) ** -0.5)  # [B,H,Tq,Tk]
+        if causal:
+            # bottom-right aligned (query i attends keys <= i + Tk - Tq),
+            # matching the flash kernel's q_off convention
+            mask = np.triu(np.full((tq, tk), -1e9, np.float32),
+                           k=1 + tk - tq)
+            mask_var = layers.assign(mask.reshape(1, 1, tq, tk))
+            attn = layers.elementwise_add(attn, mask_var)
+        weights = layers.softmax(attn)
+        if weight_dropout:
+            weights = layers.dropout(
+                weights, dropout_rate,
+                dropout_implementation="upscale_in_train")
+        out = layers.matmul(weights, v)  # [B,H,Tq,hd]
+
     out = layers.transpose(out, [0, 2, 1, 3])
     out = layers.reshape(out, [-1, tq, d_model])
     return layers.fc(out, d_model, num_flatten_dims=2, bias_attr=False)

@@ -18,3 +18,4 @@ from paddle_tpu.ops import detection  # noqa: F401
 from paddle_tpu.ops import amp  # noqa: F401
 from paddle_tpu.ops import parallel_ops  # noqa: F401
 from paddle_tpu.ops import quant  # noqa: F401
+from paddle_tpu.ops import pallas_kernels  # noqa: F401

@@ -1,0 +1,225 @@
+"""Pallas TPU kernels: fused flash attention.
+
+Capability anchor: the reference computes attention as separate
+matmul/softmax/matmul ops that materialize the [Tq, Tk] score matrix in
+HBM (e.g. nets.py scaled_dot_product_attention,
+/root/reference/python/paddle/fluid/nets.py:503-area; transformer tests
+build it from `layers.matmul` + `layers.softmax`).  On TPU the score
+matrix is the HBM-bandwidth bottleneck, so here attention is a single
+Pallas kernel: blockwise QK^T on the MXU with online-softmax
+accumulation in VMEM scratch — the [Tq, Tk] matrix never leaves VMEM
+(FlashAttention pattern).
+
+Layout: q/k/v are [B, H, T, D] (the transformer model's post-split-heads
+layout).  Grid is (B*H, Tq/block_q, Tk/block_k) with the KV dimension
+innermost so the (acc, m, l) scratch carries across KV steps.
+
+The public `flash_attention` is differentiable via custom_vjp: forward
+runs the Pallas kernel on TPU (plain XLA path elsewhere); backward
+recomputes the scores with the reference einsum formulation and lets XLA
+fuse it (O(T^2) memory in backward only — a dedicated backward kernel is
+a later optimization).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_MIN_LANES = 128  # TPU vector lane count; m/l scratch padded to this
+
+
+# ---------------------------------------------------------------------------
+# reference (XLA) implementation — also the backward path
+# ---------------------------------------------------------------------------
+
+def _plain_attention(q, k, v, causal, scale):
+    """q/k/v: [B, H, T, D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    p = None
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        qpos = lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        kpos = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        mask = (qpos + (tk - tq) >= kpos)[None, None]
+        s = jnp.where(mask, s, _NEG_INF)
+        # fully-masked rows (tq > tk) output 0, matching the kernel
+        p = jax.nn.softmax(s, axis=-1) * mask
+    else:
+        p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)) \
+        .astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                scale, causal, block_q, block_k, kv_len, q_off):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    if causal:
+        # skip KV blocks strictly above the diagonal of this Q block
+        run = (ki * block_k) <= (q_off + qi * block_q + block_q - 1)
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                      # [bq, d]
+        k = k_ref[0]                      # [bk, d]
+        v = v_ref[0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        kpos = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = kpos < kv_len              # padded keys contribute nothing
+        if causal:
+            qpos = q_off + qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (qpos >= kpos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:, 0]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        # explicit zero for masked entries: a fully-masked row would
+        # otherwise see exp(-1e30 - (-1e30)) = 1 and accumulate garbage
+        p = jnp.where(mask, jnp.exp(s - m_next[:, None]), 0.0)
+        alpha = jnp.exp(m_prev - m_next)
+        l_next = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_next[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_next[:, None], l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows -> 0 output
+        o_ref[0, ...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def _pad_axis(x, axis, mult):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                      interpret=False):
+    """q/k/v: [B, H, T, D] -> [B, H, Tq, D]."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = min(block_q, max(tq, 8))
+    bk = min(block_k, max(tk, 8))
+    qp = _pad_axis(q.reshape(b * h, tq, d), 1, bq)
+    kp = _pad_axis(k.reshape(b * h, tk, d), 1, bk)
+    vp = _pad_axis(v.reshape(b * h, tk, d), 1, bk)
+    tq_p, tk_p = qp.shape[1], kp.shape[1]
+    grid = (b * h, tq_p // bq, tk_p // bk)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        kv_len=tk, q_off=tk - tq if causal else 0)
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _MIN_LANES), jnp.float32),
+            pltpu.VMEM((bq, _MIN_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+        **params,
+    )(qp, kp, vp)
+    return out[:, :tq, :].reshape(b, h, tq, d)
+
+
+# ---------------------------------------------------------------------------
+# public differentiable entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, impl):
+    if impl == "pallas":
+        return _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k)
+    if impl == "interpret":
+        return _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                                 interpret=True)
+    return _plain_attention(q, k, v, causal, scale)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, impl):
+    return _flash(q, k, v, causal, scale, block_q, block_k, impl), (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, impl, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: _plain_attention(a, b, c, causal, scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal=False, scale=None, block_q=512,
+                    block_k=512, impl=None):
+    """Fused attention. q/k/v: [B, H, T, D]; returns [B, H, Tq, D].
+
+    impl: None (auto: pallas on TPU, XLA elsewhere), "pallas",
+    "interpret" (pallas interpret mode, for CPU tests), or "xla".
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    return _flash(q, k, v, causal, float(scale), block_q, block_k, impl)
+
+
+# ---------------------------------------------------------------------------
+# IR op registration
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.core.registry import register_op  # noqa: E402
+
+
+@register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out",),
+             attrs={"causal": False, "scale": 0.0})
+def _flash_attention_op(ins, attrs):
+    scale = attrs.get("scale") or None
+    return {"Out": flash_attention(ins["Q"], ins["K"], ins["V"],
+                                   causal=bool(attrs.get("causal")),
+                                   scale=scale)}
