@@ -142,7 +142,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             grad_out_slots[slot + GRAD_SUFFIX] = gnames
 
         if op_def.grad_maker is not None:
-            new_ops = op_def.grad_maker(op, grad_out_slots, block, grad_map)
+            new_ops = op_def.grad_maker(op, grad_out_slots, block, grad_map,
+                                        no_grad_set)
             for nop in new_ops:
                 nop.op_role = BACKWARD
                 block.ops.append(nop)

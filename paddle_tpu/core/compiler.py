@@ -56,6 +56,12 @@ def _run_block_symbolic(program, block_idx, env):
         if op.type == "conditional_block":
             _trace_cond(program, op, env)
             continue
+        if op.type == "cond":
+            _trace_cond2(program, op, env)
+            continue
+        if op.type in ("static_rnn", "static_rnn_grad"):
+            _trace_static_rnn(program, op, env)
+            continue
         op_def = get_op_def(op.type)
         if op_def.host_only:
             continue
@@ -176,6 +182,54 @@ def _trace_cond(program, op, env):
     out = lax.cond(pred, true_fn, false_fn,
                    {k: env[k] for k in carried})
     env.update(out)
+
+
+def _trace_cond2(program, op, env):
+    """Functional two-branch cond -> lax.cond returning the branch
+    outputs directly (no pre-initialized carried vars needed)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    t_idx = op.attrs["true_block"].idx
+    f_idx = op.attrs["false_block"].idx
+    t_names = op.attrs["true_out_names"]
+    f_names = op.attrs["false_out_names"]
+
+    def branch(block_idx, names):
+        def fn(_):
+            benv = dict(env)
+            _run_block_symbolic(program, block_idx, benv)
+            return [benv[n] for n in names]
+        return fn
+
+    pred = jnp.asarray(env[op.inputs["Cond"][0]]).reshape(()).astype(bool)
+    outs = lax.cond(pred, branch(t_idx, t_names), branch(f_idx, f_names),
+                    None)
+    for name, v in zip(op.outputs.get("Out", []), outs):
+        env[name] = v
+
+
+def _trace_static_rnn(program, op, env):
+    """StaticRNN -> lax.scan: memories are the carry, step inputs the xs,
+    step outputs the stacked ys (SURVEY.md §5: dynamic RNN under XLA's
+    static shapes; reference recurrent_op.cc re-specified as scan)."""
+    from paddle_tpu.ops.control_flow import (_static_rnn_grad_apply,
+                                             _static_rnn_pure)
+
+    attrs = op.attrs
+    if op.type == "static_rnn_grad":
+        _static_rnn_grad_apply(program, op, env.__getitem__,
+                               env.__setitem__)
+        return
+    ys, final = _static_rnn_pure(
+        program, attrs,
+        [env[n] for n in op.inputs.get("StepInputs", [])],
+        [env[n] for n in op.inputs.get("InitMemories", [])],
+        [env[n] for n in op.inputs.get("OuterReads", [])])
+    for n, v in zip(op.outputs.get("StepOutputs", []), ys):
+        env[n] = v
+    for n, v in zip(op.outputs.get("FinalMemories", []), final):
+        env[n] = v
 
 
 class BuildStrategy:

@@ -9,7 +9,7 @@ from paddle_tpu.layers.helper import LayerHelper
 __all__ = [
     "fill_constant", "fill_constant_batch_size_like", "assign",
     "create_tensor", "create_global_var", "ones", "zeros", "zeros_like",
-    "sums", "range", "linspace", "argmin", "cast_tensor",
+    "sums", "range", "linspace", "argmin", "cast_tensor", "flip",
 ]
 
 
@@ -134,3 +134,12 @@ def cast_tensor(x, dtype):
     from paddle_tpu.layers.nn import cast
 
     return cast(x, dtype)
+
+
+def flip(x, axis):
+    helper = LayerHelper("flip")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="flip", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"axis": axis if isinstance(axis, (list, tuple))
+                            else [axis]})
+    return out

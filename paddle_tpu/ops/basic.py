@@ -841,3 +841,19 @@ def norm_op(ins, attrs):
         + attrs["epsilon"]
     )
     return {"Out": x / norm, "Norm": norm}
+
+
+@register_op("swapaxes", inputs=("X",), outputs=("Out",),
+             attrs={"axis1": 0, "axis2": 1})
+def swapaxes(ins, attrs):
+    """Rank-agnostic axis swap (time-major <-> batch-major flips in
+    DynamicRNN; unlike transpose2 it needs no full permutation, so it
+    works when the var's rank isn't statically recorded)."""
+    return {"Out": jnp.swapaxes(ins["X"], attrs["axis1"],
+                                attrs["axis2"])}
+
+
+@register_op("flip", inputs=("X",), outputs=("Out",),
+             attrs={"axis": [0]})
+def flip_op(ins, attrs):
+    return {"Out": jnp.flip(ins["X"], axis=tuple(attrs["axis"]))}

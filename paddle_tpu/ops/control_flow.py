@@ -110,3 +110,240 @@ def read_from_array(op, block, scope, ctx):
              differentiable=False, host_only=True)
 def array_length(ins, attrs):
     return {"Out": jnp.asarray(len(ins["X"]), jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# structural op registrations
+# ---------------------------------------------------------------------------
+# The special handlers above (and compiler.py's lowerings) own execution;
+# these registry entries exist so Block.append_op can validate attrs and so
+# program serialization round-trips.  Reference analog: while/conditional
+# ops are real registered operators (operators/controlflow/while_op.cc:58
+# REGISTER_OPERATOR) whose Run drives the executor on a sub-block.
+
+def _structural(ins, attrs):  # pragma: no cover
+    raise RuntimeError("structural op must run via executor/compiler")
+
+
+register_op("while", inputs=("Condition", "X"), outputs=("Out",),
+            attrs={"sub_block": REQUIRED, "max_iters": 10_000_000,
+                   "is_test": False},
+            duplicable=("X", "Out"), optional=("X", "Out"),
+            differentiable=False, host_only=True)(_structural)
+
+register_op("conditional_block", inputs=("Cond", "X"), outputs=("Out",),
+            attrs={"sub_block": REQUIRED, "is_scalar_condition": True},
+            duplicable=("X", "Out"), optional=("X", "Out"),
+            differentiable=False, host_only=True)(_structural)
+
+register_op("cond", inputs=("Cond",), outputs=("Out",),
+            attrs={"true_block": REQUIRED, "false_block": REQUIRED,
+                   "true_out_names": [], "false_out_names": []},
+            duplicable=("Out",), optional=("Out",),
+            differentiable=False, host_only=True)(_structural)
+
+def _static_rnn_grad_maker(op, grad_out_slots, block, grad_map,
+                           no_grad_set=frozenset()):
+    """Emit a static_rnn_grad op (BPTT).  Reference analog: the
+    RecurrentGradOp created by recurrent_op.cc's GradOpDescMaker; here
+    the backward-through-time is jax.vjp over the scan (see
+    _static_rnn_grad_impl)."""
+    from paddle_tpu.backward import (_create_grad_var, _grad_name,
+                                     _needs_grad)
+    from paddle_tpu.core.program import OpDesc
+    from paddle_tpu import unique_name
+
+    inputs = {
+        "StepInputs": list(op.inputs.get("StepInputs", [])),
+        "InitMemories": list(op.inputs.get("InitMemories", [])),
+        "OuterReads": list(op.inputs.get("OuterReads", [])),
+    }
+    inputs.update(grad_out_slots)  # StepOutputs@GRAD / FinalMemories@GRAD
+    outputs = {}
+    for slot in ("StepInputs", "InitMemories", "OuterReads"):
+        names = op.inputs.get(slot, [])
+        if not names:
+            continue
+        gnames = []
+        any_needed = False
+        for n in names:
+            if _needs_grad(block, n, no_grad_set):
+                any_needed = True
+            g = (_grad_name(n) if n not in grad_map
+                 else _grad_name(n, "@" + unique_name.generate("p")))
+            gnames.append(g)
+        if not any_needed:
+            continue
+        for n, g in zip(names, gnames):
+            _create_grad_var(block, n, g)
+            if _needs_grad(block, n, no_grad_set):
+                grad_map.setdefault(n, []).append(g)
+        outputs[slot + "@GRAD"] = gnames
+    if not outputs:
+        return []
+    return [OpDesc("static_rnn_grad", inputs, outputs, dict(op.attrs))]
+
+
+register_op("static_rnn",
+            inputs=("StepInputs", "InitMemories", "OuterReads"),
+            outputs=("StepOutputs", "FinalMemories"),
+            attrs={"sub_block": REQUIRED, "seq_len": REQUIRED,
+                   "step_input_names": [], "memory_pre_names": [],
+                   "memory_update_names": [], "step_output_names": [],
+                   "outer_read_names": []},
+            duplicable=("StepInputs", "InitMemories", "OuterReads",
+                        "StepOutputs", "FinalMemories"),
+            optional=("StepInputs", "InitMemories", "OuterReads",
+                      "StepOutputs", "FinalMemories"),
+            grad_maker=_static_rnn_grad_maker,
+            # host_only=False so append_backward reaches the grad_maker;
+            # execution is still owned by the special handler / compiler
+            # lowering (layers always append with infer_shape=False).
+            differentiable=True, host_only=False)(_structural)
+
+register_op("static_rnn_grad",
+            inputs=("StepInputs", "InitMemories", "OuterReads",
+                    "StepOutputs@GRAD", "FinalMemories@GRAD"),
+            outputs=("StepInputs@GRAD", "InitMemories@GRAD",
+                     "OuterReads@GRAD"),
+            attrs={"sub_block": REQUIRED, "seq_len": REQUIRED,
+                   "step_input_names": [], "memory_pre_names": [],
+                   "memory_update_names": [], "step_output_names": [],
+                   "outer_read_names": []},
+            duplicable=("StepInputs", "InitMemories", "OuterReads",
+                        "StepOutputs@GRAD", "FinalMemories@GRAD",
+                        "StepInputs@GRAD", "InitMemories@GRAD",
+                        "OuterReads@GRAD"),
+            optional=("StepInputs", "InitMemories", "OuterReads",
+                      "StepOutputs@GRAD", "FinalMemories@GRAD",
+                      "StepInputs@GRAD", "InitMemories@GRAD",
+                      "OuterReads@GRAD"),
+            differentiable=False, host_only=True)(_structural)
+
+register_op("write_to_array", inputs=("X", "I"), outputs=("Out",),
+            differentiable=False, host_only=True)(_structural)
+
+register_op("read_from_array", inputs=("X", "I"), outputs=("Out",),
+            differentiable=False, host_only=True)(_structural)
+
+
+@register_special_op("cond")
+def cond_op(op, block, scope, ctx):
+    """Functional two-branch cond (reference analog: the
+    conditional_block pair built by layers.cond in later fluid;
+    compiled mode lowers to lax.cond in compiler.py)."""
+    pred = bool(np.asarray(
+        scope.find_var(op.inputs["Cond"][0]).get()).reshape(-1)[0])
+    which = "true" if pred else "false"
+    ctx.run_block(op.attrs[f"{which}_block"].idx, scope)
+    src_names = op.attrs[f"{which}_out_names"]
+    for out_name, src in zip(op.outputs.get("Out", []), src_names):
+        scope.var(out_name).set(scope.find_var(src).get())
+
+
+def _static_rnn_pure(program, attrs, xs, init, reads):
+    """(xs, init, reads) -> (ys, final) as a pure lax.scan — the single
+    implementation behind the interpreter handler, the compiled lowering,
+    and BPTT (jax.vjp over this function)."""
+    from jax import lax
+
+    from paddle_tpu.core.compiler import _run_block_symbolic
+
+    def body(carry, x):
+        benv = dict(zip(attrs["outer_read_names"], reads))
+        benv.update(zip(attrs["memory_pre_names"], carry))
+        benv.update(zip(attrs["step_input_names"], x))
+        _run_block_symbolic(program, attrs["sub_block"].idx, benv)
+        return ([benv[n] for n in attrs["memory_update_names"]],
+                [benv[n] for n in attrs["step_output_names"]])
+
+    final, ys = lax.scan(body, init, xs,
+                         length=attrs["seq_len"] if not xs else None)
+    return ys, final
+
+
+def _scope_vals(scope, names):
+    return [scope.find_var(n).get() for n in names]
+
+
+@register_special_op("static_rnn")
+def static_rnn_op(op, block, scope, ctx):
+    """StaticRNN forward (reference: recurrent_op.cc per-step scopes —
+    here one lax.scan, eager in interpreter mode)."""
+    ys, final = _static_rnn_pure(
+        ctx.program, op.attrs,
+        _scope_vals(scope, op.inputs.get("StepInputs", [])),
+        _scope_vals(scope, op.inputs.get("InitMemories", [])),
+        _scope_vals(scope, op.inputs.get("OuterReads", [])))
+    for name, v in zip(op.outputs.get("StepOutputs", []), ys):
+        scope.var(name).set(v)
+    for name, v in zip(op.outputs.get("FinalMemories", []), final):
+        scope.var(name).set(v)
+
+
+def _static_rnn_grad_impl(program, attrs, xs, init, reads, g_ys, g_final):
+    import jax
+    import jax.numpy as jnp
+
+    (ys, final), vjp = jax.vjp(
+        lambda a, b, c: _static_rnn_pure(program, attrs, a, b, c),
+        xs, init, reads)
+    cot_ys = [jnp.zeros_like(y) if g is None else g.astype(y.dtype)
+              for g, y in zip(g_ys, ys)]
+    cot_final = [jnp.zeros_like(c) if g is None else g.astype(c.dtype)
+                 for g, c in zip(g_final, final)]
+    return vjp((cot_ys, cot_final))
+
+
+def _static_rnn_grad_apply(program, op, getv, setv):
+    """Shared static_rnn_grad driver for both executors; getv/setv
+    read/write values by name (scope in interpreter, env in trace)."""
+    attrs = op.attrs
+    g_ys_names = op.inputs.get("StepOutputs@GRAD", [])
+    g_fin_names = op.inputs.get("FinalMemories@GRAD", [])
+    g_ys = ([getv(n) for n in g_ys_names] if g_ys_names
+            else [None] * len(attrs["step_output_names"]))
+    g_final = ([getv(n) for n in g_fin_names] if g_fin_names
+               else [None] * len(attrs["memory_pre_names"]))
+    gxs, ginit, greads = _static_rnn_grad_impl(
+        program, attrs,
+        [getv(n) for n in op.inputs.get("StepInputs", [])],
+        [getv(n) for n in op.inputs.get("InitMemories", [])],
+        [getv(n) for n in op.inputs.get("OuterReads", [])],
+        g_ys, g_final)
+    for slot, vals in (("StepInputs@GRAD", gxs),
+                       ("InitMemories@GRAD", ginit),
+                       ("OuterReads@GRAD", greads)):
+        for name, v in zip(op.outputs.get(slot, []), vals):
+            setv(name, v)
+
+
+@register_special_op("static_rnn_grad")
+def static_rnn_grad_op(op, block, scope, ctx):
+    _static_rnn_grad_apply(
+        ctx.program, op,
+        lambda n: scope.find_var(n).get(),
+        lambda n, v: scope.var(n).set(v))
+
+
+@register_op("gather_tree", inputs=("Ids", "Parents"), outputs=("Out",),
+             differentiable=False)
+def gather_tree(ins, attrs):
+    """Beam-search finalization: walk parent pointers backwards to emit
+    full sequences (reference: beam_search_decode_op.cc walks the
+    LoD-linked per-step arrays; here it is a jittable reverse scan over
+    dense [T, B, K] tensors — TPU-friendly, no host loop)."""
+    from jax import lax
+
+    ids, parents = ins["Ids"], ins["Parents"]
+    k = ids.shape[2]
+    init = jnp.broadcast_to(jnp.arange(k, dtype=parents.dtype),
+                            ids.shape[1:])
+
+    def body(parent, xs):
+        step_ids, step_parents = xs
+        out = jnp.take_along_axis(step_ids, parent, axis=-1)
+        return jnp.take_along_axis(step_parents, parent, axis=-1), out
+
+    _, outs = lax.scan(body, init, (ids, parents), reverse=True)
+    return {"Out": outs}

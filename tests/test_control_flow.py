@@ -1,0 +1,240 @@
+"""Control-flow suite: While, cond, StaticRNN (fwd + BPTT), DynamicRNN
+masking, gather_tree, beam search.  Each construct is checked in BOTH
+executor modes (interpreted op-by-op vs whole-program XLA) — the
+reference's dual-run OpTest pattern (op_test.py:271)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, layers, optimizer
+
+
+def _both_modes(feed, fetch_list):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    interp = exe.run(framework.default_main_program(), feed=feed,
+                     fetch_list=fetch_list)
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+    comp = exe.run(compiled, feed=feed, fetch_list=fetch_list)
+    return interp, comp
+
+
+def test_while_loop_both_modes():
+    i = layers.fill_constant([1], "int64", 0)
+    n = layers.fill_constant([1], "int64", 10)
+    acc = layers.fill_constant([1], "float32", 0.0)
+    w = layers.While(layers.less_than(i, n))
+    with w.block():
+        nxt = layers.cast(i, "float32")
+        acc2 = layers.elementwise_add(acc, nxt)
+        layers.assign(acc2, output=acc)
+        layers.increment(i)
+        layers.less_than(i, n, cond=w.cond_var)
+    (r1,), (r2,) = _both_modes({}, [acc])
+    assert float(r1) == 45.0
+    assert float(r2) == 45.0
+
+
+def test_cond_both_modes():
+    x = layers.data("x", shape=[4], dtype="float32")
+    flag = layers.data("flag", shape=[], dtype="float32",
+                       append_batch_size=False)
+    pred = layers.greater_than(
+        flag, layers.fill_constant([], "float32", 0.0))
+    out = layers.cond(pred,
+                      lambda: layers.scale(x, scale=2.0),
+                      lambda: layers.scale(x, scale=-1.0))
+    xv = np.arange(8, dtype=np.float32).reshape(2, 4)
+    for fv, mult in ((np.float32(1.0), 2.0), (np.float32(-1.0), -1.0)):
+        (r1,), (r2,) = _both_modes({"x": xv, "flag": fv}, [out])
+        np.testing.assert_allclose(r1, xv * mult)
+        np.testing.assert_allclose(r2, xv * mult)
+
+
+def test_static_rnn_forward_both_modes():
+    t_len, batch, d = 5, 3, 4
+    x = layers.data("x", shape=[t_len, batch, d], dtype="float32",
+                    append_batch_size=False)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        prev = rnn.memory(shape=[batch, d], value=0.0)
+        h = layers.elementwise_add(prev, x_t)
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    out = rnn()
+    xv = np.random.RandomState(0).randn(t_len, batch, d).astype(np.float32)
+    (r1,), (r2,) = _both_modes({"x": xv}, [out])
+    ref = np.cumsum(xv, axis=0)
+    np.testing.assert_allclose(r1, ref, atol=1e-5)
+    np.testing.assert_allclose(r2, ref, atol=1e-5)
+
+
+def test_static_rnn_trains():
+    """Params used inside the RNN step get BPTT gradients and learn."""
+    t_len, batch, d, h = 6, 8, 5, 5
+    x = layers.data("x", shape=[t_len, batch, d], dtype="float32",
+                    append_batch_size=False)
+    y = layers.data("y", shape=[batch, 1], dtype="float32",
+                    append_batch_size=False)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        prev = rnn.memory(shape=[batch, h], value=0.0)
+        nxt = layers.fc(layers.concat([x_t, prev], axis=1), h, act="tanh")
+        rnn.update_memory(prev, nxt)
+        rnn.step_output(nxt)
+    final = layers.slice(rnn(), axes=[0], starts=[t_len - 1],
+                         ends=[t_len])
+    pred = layers.fc(layers.reshape(final, [batch, h]), 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.Adam(1e-2).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(t_len, batch, d).astype(np.float32)
+    yv = xv.sum(axis=(0, 2), keepdims=False)[:, None].astype(np.float32)
+    yv = yv / np.abs(yv).max()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(compiled, feed={"x": xv, "y": yv},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_dynamic_rnn_masks_past_seq_len():
+    batch, t_len, d = 2, 5, 3
+    x = layers.data("x", shape=[t_len, d], dtype="float32")
+    sl = layers.data("sl", shape=[], dtype="int64")
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        x_t = drnn.step_input(x, seq_len=sl)
+        prev = drnn.memory(shape=[batch, d], value=0.0)
+        h = layers.elementwise_add(prev, x_t)
+        h = drnn.update_memory(prev, h)
+        drnn.output(h)
+    out = drnn()
+    rng = np.random.RandomState(0)
+    xv = rng.randn(batch, t_len, d).astype(np.float32)
+    slv = np.asarray([3, 5], np.int64)
+    (r1,), (r2,) = _both_modes({"x": xv, "sl": slv}, [out])
+    for r in (r1, r2):
+        # row 0: state frozen after step 3
+        ref0 = np.cumsum(xv[0], axis=0)
+        np.testing.assert_allclose(r[0, 2], ref0[2], atol=1e-5)
+        np.testing.assert_allclose(r[0, 3], ref0[2], atol=1e-5)
+        np.testing.assert_allclose(r[0, 4], ref0[2], atol=1e-5)
+        # row 1: full length
+        np.testing.assert_allclose(r[1], np.cumsum(xv[1], axis=0),
+                                   atol=1e-5)
+
+
+def test_gather_tree_matches_numpy():
+    from paddle_tpu.core.registry import get_op_def
+
+    rng = np.random.RandomState(0)
+    t_len, b, k = 4, 2, 3
+    ids = rng.randint(0, 9, (t_len, b, k)).astype(np.int32)
+    parents = rng.randint(0, k, (t_len, b, k)).astype(np.int32)
+    out = np.asarray(get_op_def("gather_tree").compute(
+        {"Ids": jnp.asarray(ids), "Parents": jnp.asarray(parents)},
+        {})["Out"])
+    ref = np.zeros_like(ids)
+    for bi in range(b):
+        for ki in range(k):
+            parent = ki
+            for t in range(t_len - 1, -1, -1):
+                ref[t, bi, ki] = ids[t, bi, parent]
+                parent = parents[t, bi, parent]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_beam_search_finds_best_path():
+    """Deterministic position-dependent logits: beam search must return
+    the argmax sequence found by brute force."""
+    from paddle_tpu.decode import beam_search, greedy_search
+
+    rng = np.random.RandomState(3)
+    v, t_len, b, k = 6, 4, 2, 4
+    eos = 1
+    table = jnp.asarray(rng.randn(b, t_len, v).astype(np.float32) * 2)
+
+    def fn(ids, state, t):
+        # logits depend on position and (weakly) on previous token so
+        # beams diverge; state counts steps per beam
+        prev = ids[:, 0]
+        base = jnp.repeat(table[:, t, :], ids.shape[0] // b, axis=0)
+        bias = 0.3 * jnp.sin(prev[:, None].astype(jnp.float32) +
+                             jnp.arange(v)[None, :])
+        return base + bias, state
+
+    seqs, scores = jax.jit(lambda s: beam_search(
+        fn, s, b, k, v, t_len, bos_id=0, eos_id=eos))(
+            jnp.zeros((b * k, 1)))
+    # brute force over all sequences (no eos shortcut for simplicity:
+    # eos continuation forced to eos, so compare against constrained ref)
+    import itertools
+
+    def seq_score(bi, toks):
+        lp_total, prev, fin = 0.0, 0, False
+        for t, tok in enumerate(toks):
+            logits = np.asarray(table[bi, t]) + \
+                0.3 * np.sin(prev + np.arange(v))
+            lp = logits - np.log(np.exp(logits - logits.max()).sum()) - \
+                logits.max()
+            lp = np.asarray(
+                jax.nn.log_softmax(jnp.asarray(logits)))
+            if fin:
+                if tok != eos:
+                    return -np.inf
+            else:
+                lp_total += lp[tok]
+            fin = fin or tok == eos
+            prev = tok
+        return lp_total
+
+    for bi in range(b):
+        best = max(itertools.product(range(v), repeat=t_len),
+                   key=lambda s: seq_score(bi, s))
+        np.testing.assert_array_equal(np.asarray(seqs[bi, 0]),
+                                      np.asarray(best))
+        np.testing.assert_allclose(float(scores[bi, 0]),
+                                   seq_score(bi, best), rtol=1e-4)
+
+    gs, _ = greedy_search(fn, jnp.zeros((b, 1)), b, t_len, bos_id=0,
+                          eos_id=eos)
+    assert gs.shape == (b, t_len)
+
+
+def test_dynamic_gru_lstm_shapes_and_training():
+    batch, t_len, d, h = 4, 6, 3, 5
+    x = layers.data("x", shape=[t_len, d], dtype="float32")
+    sl = layers.data("sl", shape=[], dtype="int64")
+    y = layers.data("y", shape=[1], dtype="float32")
+    gru_out = layers.dynamic_gru(x, h, seq_len=sl)
+    lstm_out, _ = layers.dynamic_lstm(x, h, seq_len=sl)
+    feat = layers.concat([
+        layers.reduce_mean(gru_out, dim=1),
+        layers.reduce_mean(lstm_out, dim=1)], axis=1)
+    pred = layers.fc(feat, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.Adam(1e-2).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(batch, t_len, d).astype(np.float32)
+    slv = np.asarray([6, 4, 3, 6], np.int64)
+    yv = xv.mean(axis=(1, 2), keepdims=False)[:, None].astype(np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(compiled, feed={"x": xv, "sl": slv, "y": yv},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.6, losses[::10]
