@@ -1,0 +1,124 @@
+"""Async Communicator: background grad-send / param-recv threads for
+fully-async parameter-server training.
+
+Reference parity:
+  - C++ Communicator SendThread/RecvThread with per-var queues and
+    merge-before-send:
+    /root/reference/paddle/fluid/operators/distributed/communicator.h:160-184
+  - python wrapper: python/paddle/fluid/communicator.py
+
+The trainer pushes grads with put() (non-blocking); the send thread
+merges up to max_merge_var_num queued grads per var (mean) and ships
+their sections to the pservers; the recv thread refreshes params into
+the given scope every recv_interval.  Decouples compute from comm the
+same way the reference's fully-async mode does (staleness semantics
+included).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.rpc import global_rpc_client
+
+
+class Communicator:
+    def __init__(self, transpiler, scope, max_merge_var_num=20,
+                 send_wait_times=0.005, recv_interval=0.02):
+        """transpiler: a transpiled DistributeTranspiler (source of the
+        section plan); scope: where received params land."""
+        self._t = transpiler
+        self._scope = scope
+        self._max_merge = max_merge_var_num
+        self._send_wait = send_wait_times
+        self._recv_interval = recv_interval
+        self._queues = {g: queue.Queue()
+                        for g in (transpiler.grad_of[p]
+                                  for p in transpiler.param_plan)}
+        self._grad_to_param = {g: p
+                               for p, g in transpiler.grad_of.items()}
+        self._running = False
+        self._threads = []
+
+    # -- trainer-facing -----------------------------------------------------
+    def put(self, grad_name, value):
+        q = self._queues.get(grad_name)
+        if q is None:
+            raise KeyError(f"Communicator: unknown grad '{grad_name}'")
+        q.put(np.asarray(value))
+
+    def start(self):
+        self._running = True
+        for fn in (self._send_loop, self._recv_loop):
+            th = threading.Thread(target=fn, daemon=True)
+            th.start()
+            self._threads.append(th)
+        return self
+
+    def stop(self):
+        self._running = False
+        for th in self._threads:
+            th.join(timeout=5.0)
+        self._flush()
+
+    # -- internals ----------------------------------------------------------
+    def _drain(self, q):
+        vals = []
+        while len(vals) < self._max_merge:
+            try:
+                vals.append(q.get_nowait())
+            except queue.Empty:
+                break
+        return vals
+
+    def _send_grad(self, gname, merged):
+        client = global_rpc_client()
+        pname = self._grad_to_param[gname]
+        plan = self._t.param_plan[pname]
+        for i, sec, s, e in plan:
+            gsec = self._t._grad_section_name(pname, sec)
+            part = merged if (s == 0 and e == -1) else merged[s:e]
+            client.send_var(self._t.endpoints[i], gsec,
+                            np.ascontiguousarray(part))
+
+    def _flush(self):
+        for gname, q in self._queues.items():
+            vals = self._drain(q)
+            if vals:
+                merged = vals[0] if len(vals) == 1 else \
+                    np.mean(np.stack(vals), axis=0)
+                self._send_grad(gname, merged)
+
+    def _send_loop(self):
+        while self._running:
+            sent_any = False
+            for gname, q in self._queues.items():
+                vals = self._drain(q)
+                if not vals:
+                    continue
+                merged = vals[0] if len(vals) == 1 else \
+                    np.mean(np.stack(vals), axis=0)
+                self._send_grad(gname, merged)
+                sent_any = True
+            if not sent_any:
+                time.sleep(self._send_wait)
+
+    def _recv_loop(self):
+        client = global_rpc_client()
+        while self._running:
+            for pname, plan in self._t.param_plan.items():
+                try:
+                    parts = [client.get_var(self._t.endpoints[i], sec)
+                             for i, sec, *_ in plan]
+                except Exception:
+                    continue
+                val = parts[0] if len(parts) == 1 else \
+                    np.concatenate(parts, axis=0)
+                self._scope.var(pname).set(jnp.asarray(val))
+            time.sleep(self._recv_interval)

@@ -1,0 +1,13 @@
+"""Distributed control-plane package.
+
+Reference parity (SURVEY.md §2.4): the reference's data plane AND control
+plane both ride gRPC/BRPC (operators/distributed/).  TPU-first split: the
+data plane (gradient/param movement between accelerators) is XLA
+collectives over ICI compiled into the step function (ops/collective.py,
+parallel/); what remains host-side is the parameter-server control plane —
+variable send/recv between trainer and pserver processes, barriers,
+completion, checkpoint notify — served by the socket RPC layer here
+(rpc.py), the moral equivalent of grpc_client.h/grpc_server.h.
+"""
+
+from paddle_tpu.distributed.rpc import RPCClient, RPCServer  # noqa: F401

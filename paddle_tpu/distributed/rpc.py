@@ -1,0 +1,218 @@
+"""Socket RPC for the parameter-server control plane.
+
+Reference parity:
+  - RPCClient interface (AsyncSendVar/AsyncGetVar/barriers):
+    /root/reference/paddle/fluid/operators/distributed/rpc_client.h:33
+  - RPCServer + RequestHandler registry + barriers:
+    rpc_server.h:48, request_handler.h:148
+  - wire format VariableMessage: send_recv.proto.in:47; zero-copy serde
+    grpc/grpc_serde.cc
+
+TPU-first difference: tensors crossing this layer are host numpy arrays
+(pserver state lives on host; the trainer's device state is donated to
+XLA).  Framing is length-prefixed pickles of (msg_type, payload) — the
+protobuf/zero-copy machinery is unnecessary at control-plane rates.  The
+native C++ data path (paddle_tpu/native/) owns bulk file IO instead.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+
+_LEN = struct.Struct("!Q")
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class RPCServer:
+    """Threaded request server: one handler per message type.
+
+    handler(payload) -> reply (any picklable; None is fine).  Handlers
+    run on connection threads; use locks for shared state (the reference
+    serializes through its RequestHandler Get/Set with barriers —
+    rpc_server.h:48 registered barriers map to `barrier` here).
+    """
+
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host or "127.0.0.1", int(port)))
+        self._sock.listen(128)
+        self.endpoint = f"{host or '127.0.0.1'}:{self._sock.getsockname()[1]}"
+        self._handlers = {}
+        self._stop = threading.Event()
+        self._threads = []
+        self._barriers: dict = {}
+        self._barrier_lock = threading.Lock()
+
+    def register_handler(self, msg_type: str, fn):
+        self._handlers[msg_type] = fn
+
+    # -- barrier support (reference rpc_server.h RegisterBarrier) -----------
+    def barrier(self, name: str, count: int) -> int:
+        """Blocks the calling handler until `count` parties arrived;
+        returns the arrival index (0..count-1) so one caller can be
+        elected to do post-barrier work."""
+        with self._barrier_lock:
+            b = self._barriers.get(name)
+            if b is None or b._parties != count:
+                b = threading.Barrier(count)
+                self._barriers[name] = b
+        return b.wait()
+
+    def reset_barrier(self, name: str):
+        with self._barrier_lock:
+            self._barriers.pop(name, None)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg_type, payload = _recv_msg(conn)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                fn = self._handlers.get(msg_type)
+                if fn is None:
+                    _send_msg(conn, ("error",
+                                     f"no handler for '{msg_type}'"))
+                    continue
+                try:
+                    reply = fn(payload)
+                except Exception as e:  # surface to client
+                    _send_msg(conn, ("error", repr(e)))
+                    continue
+                _send_msg(conn, ("ok", reply))
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RPCClient:
+    """Per-endpoint persistent connections (reference grpc_client.h:176
+    channel cache); thread-safe via per-connection locks."""
+
+    _TIMEOUT = 120.0
+
+    def __init__(self):
+        self._conns: dict = {}
+        self._locks: dict = {}
+        self._global_lock = threading.Lock()
+
+    def _get_conn(self, endpoint):
+        import time
+
+        with self._global_lock:
+            if endpoint not in self._conns:
+                host, port = endpoint.rsplit(":", 1)
+                deadline = time.monotonic() + self._TIMEOUT
+                while True:
+                    # the server may not be up yet (reference
+                    # wait_server_ready polls the port the same way)
+                    try:
+                        s = socket.create_connection(
+                            (host, int(port)), timeout=self._TIMEOUT)
+                        break
+                    except (ConnectionRefusedError, OSError):
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.2)
+                s.settimeout(self._TIMEOUT)
+                self._conns[endpoint] = s
+                self._locks[endpoint] = threading.Lock()
+            return self._conns[endpoint], self._locks[endpoint]
+
+    def call(self, endpoint: str, msg_type: str, payload=None):
+        conn, lock = self._get_conn(endpoint)
+        with lock:
+            _send_msg(conn, (msg_type, payload))
+            status, reply = _recv_msg(conn)
+        if status == "error":
+            raise RuntimeError(
+                f"RPC '{msg_type}' to {endpoint} failed: {reply}")
+        return reply
+
+    # reference rpc_client.h API names
+    def send_var(self, endpoint, name, value):
+        return self.call(endpoint, "send_var", (name, value))
+
+    def get_var(self, endpoint, name):
+        return self.call(endpoint, "get_var", name)
+
+    def send_barrier(self, endpoint):
+        return self.call(endpoint, "send_barrier")
+
+    def fetch_barrier(self, endpoint):
+        return self.call(endpoint, "fetch_barrier")
+
+    def send_complete(self, endpoint):
+        return self.call(endpoint, "complete")
+
+    def close(self):
+        with self._global_lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+
+_global_client = None
+_client_lock = threading.Lock()
+
+
+def global_rpc_client() -> RPCClient:
+    global _global_client
+    with _client_lock:
+        if _global_client is None:
+            _global_client = RPCClient()
+        return _global_client

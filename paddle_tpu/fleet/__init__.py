@@ -44,6 +44,7 @@ class DistributedStrategy:
         self.recompute_checkpoints = []
         self.use_amp = False
         self.amp_loss_scaling = 2 ** 15
+        self.sync_mode = True  # PS mode: sync vs fully-async
         # ZeRO-style state sharding (maps to parallel.zero rules)
         self.zero_stage = 0
 
@@ -73,6 +74,12 @@ class _Fleet:
         bootstrap, reference transpiler/collective.py + nccl2 mode)."""
         import jax
 
+        # PS-mode processes (server role, or a PS launcher env) are not
+        # part of a JAX SPMD job — bringing one up would collide with
+        # trainer process ids / hang on the coordinator
+        if self._role_maker.is_server() or \
+                self._role_maker.get_pserver_endpoints():
+            return
         n = self._role_maker.worker_num()
         if n <= 1 or jax.process_count() > 1:
             return
@@ -118,19 +125,61 @@ class _Fleet:
     def startup_program(self):
         from paddle_tpu import framework
 
+        if getattr(self, "_ps_startup", None) is not None:
+            return self._ps_startup
         return framework.default_startup_program()
 
-    # -- no-op control plane (single-controller SPMD has no PS loop) ------
+    def server_endpoints(self):
+        return self._role_maker.get_pserver_endpoints()
+
+    # -- PS-mode control plane (collective mode: all no-ops) --------------
     def init_worker(self):
         pass
 
     def init_server(self, model_dir=None):
-        pass
+        """PS mode: run the pserver startup program (reference
+        fleet.init_server)."""
+        if getattr(self, "_transpiler", None) is None:
+            return
+        from paddle_tpu.core.executor import Executor
+        from paddle_tpu.core.types import CPUPlace
+
+        t = self._transpiler
+        ep = self._role_maker.get_current_endpoint()
+        self._ps_main = t.get_pserver_program(ep)
+        Executor(CPUPlace()).run(t.get_startup_program(ep, self._ps_main))
+        if model_dir:
+            # warm start from shards written by checkpoint_notify
+            import os
+
+            import jax.numpy as jnp
+            import numpy as np
+
+            from paddle_tpu.core.scope import global_scope
+
+            loaded = 0
+            for v in self._ps_main.global_block().vars.values():
+                path = os.path.join(
+                    model_dir, v.name.replace("/", "_") + ".npy")
+                if os.path.exists(path):
+                    global_scope().var(v.name).set(
+                        jnp.asarray(np.load(path)))
+                    loaded += 1
+            if not loaded:
+                raise FileNotFoundError(
+                    f"init_server: no shard files found in {model_dir}")
 
     def run_server(self):
-        raise RuntimeError(
-            "collective fleet has no parameter server to run; PS-style "
-            "embedding service lives in paddle_tpu.ps")
+        """PS mode: serve until every trainer completes (reference
+        fleet.run_server -> listen_and_serv loop)."""
+        if getattr(self, "_transpiler", None) is None:
+            raise RuntimeError(
+                "run_server needs a PS-mode distributed_optimizer "
+                "(strategy.mode='pserver') minimized first")
+        from paddle_tpu.core.executor import Executor
+        from paddle_tpu.core.types import CPUPlace
+
+        Executor(CPUPlace()).run(self._ps_main)
 
     def stop_worker(self):
         pass
@@ -146,7 +195,15 @@ class _Fleet:
 
     # -- optimizer --------------------------------------------------------
     def distributed_optimizer(self, optimizer, strategy=None):
+        explicit = strategy is not None
         self._strategy = strategy or DistributedStrategy()
+        if self._strategy.mode == "pserver" or (
+                not explicit
+                and self._role_maker is not None
+                and self._role_maker.get_pserver_endpoints()):
+            self._strategy.mode = "pserver"
+            return ParameterServerOptimizer(self, optimizer,
+                                            self._strategy)
         return CollectiveOptimizer(self, optimizer, self._strategy)
 
     # -- save (reference fleet_base save_* delegating to io) --------------
@@ -209,6 +266,39 @@ class CollectiveOptimizer:
             compiled = compiled.with_sharding_rules(
                 zero_sharding_rules(stage=self._strategy.zero_stage))
         self._fleet._compiled = compiled
+        return ret
+
+
+class ParameterServerOptimizer:
+    """PS-mode distributed optimizer: minimize() transpiles the program
+    with DistributeTranspiler (reference
+    incubate/fleet/parameter_server/distribute_transpiler/__init__.py
+    TranspilerOptimizer)."""
+
+    def __init__(self, fleet_obj, optimizer, strategy):
+        self._fleet = fleet_obj
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from paddle_tpu.transpiler import (DistributeTranspiler,
+                                           DistributeTranspilerConfig)
+
+        ret = self._optimizer.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+        rm = self._fleet._role_maker
+        cfg = DistributeTranspilerConfig()
+        cfg.sync_mode = self._strategy.sync_mode
+        t = DistributeTranspiler(cfg)
+        t.transpile(rm.worker_index(),
+                    pservers=",".join(rm.get_pserver_endpoints()),
+                    trainers=rm.worker_num(),
+                    sync_mode=self._strategy.sync_mode)
+        self._fleet._transpiler = t
+        if rm.is_worker():
+            self._fleet._compiled = t.get_trainer_program()
+            self._fleet._ps_startup = t.get_trainer_startup_program()
         return ret
 
 

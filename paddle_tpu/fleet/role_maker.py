@@ -52,6 +52,9 @@ class RoleMakerBase:
     def get_current_endpoint(self):
         return self._current_endpoint
 
+    def get_pserver_endpoints(self):
+        return list(getattr(self, "_server_endpoints", []))
+
 
 class PaddleCloudRoleMaker(RoleMakerBase):
     """reference role_maker.py PaddleCloudRoleMaker: env-var driven."""
@@ -73,6 +76,8 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
         self._role = Role.SERVER if training_role == "PSERVER" \
             else Role.WORKER
+        ps_eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in ps_eps.split(",") if e]
         self._generated = True
 
 

@@ -1,0 +1,234 @@
+"""Parameter-server ops: send / recv / barriers / listen_and_serv.
+
+Reference parity (SURVEY.md §2.4 DP strategy C):
+  - send/recv/send_barrier/fetch_barrier ops:
+    /root/reference/paddle/fluid/operators/distributed_ops/send_op.cc,
+    recv_op.cc, send_barrier_op.cc, fetch_barrier_op.cc
+  - listen_and_serv event loop: listen_and_serv_op.cc:109 RunSyncLoop
+    (barrier -> run optimize blocks -> barrier), :225 RunAsyncLoop
+    (grad name -> block, applied on arrival)
+  - row-sliced send: parameter_send.cc / slice_variable
+
+TPU-first: these are host control-plane ops over the socket RPC layer
+(distributed/rpc.py); the dense compute inside each optimize block still
+runs through the normal op registry (JAX on the pserver host).  Values
+crossing the wire are numpy arrays.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.executor import register_special_op
+from paddle_tpu.core.registry import REQUIRED, register_op
+from paddle_tpu.distributed.rpc import RPCServer, global_rpc_client
+
+
+def _structural(ins, attrs):  # pragma: no cover
+    raise RuntimeError("PS op must run via the executor (host op)")
+
+
+# registry entries so append_op validates attrs + programs serialize
+register_op("send", inputs=("X",), outputs=(),
+            attrs={"epmap": [], "section_names": [], "sections": []},
+            differentiable=False, host_only=True)(_structural)
+register_op("recv", inputs=(), outputs=("Out",),
+            attrs={"epmap": [], "section_names": [], "sections": []},
+            differentiable=False, host_only=True)(_structural)
+register_op("send_barrier", inputs=(), outputs=(),
+            attrs={"endpoints": []},
+            differentiable=False, host_only=True)(_structural)
+register_op("fetch_barrier", inputs=(), outputs=(),
+            attrs={"endpoints": []},
+            differentiable=False, host_only=True)(_structural)
+register_op("listen_and_serv", inputs=(), outputs=(),
+            attrs={"endpoint": REQUIRED, "Fanin": 1, "sync_mode": True,
+                   "grad_blocks": [], "lr_names": []},
+            differentiable=False, host_only=True)(_structural)
+register_op("ps_sync_init", inputs=("X",), outputs=(),
+            duplicable=("X",), optional=("X",),
+            attrs={"endpoints": [], "push_plan": [], "is_pusher": False},
+            differentiable=False, host_only=True)(_structural)
+register_op("checkpoint_notify", inputs=(), outputs=(),
+            attrs={"endpoints": [], "dirname": ""},
+            differentiable=False, host_only=True)(_structural)
+
+
+def _np(v):
+    return np.asarray(v)
+
+
+@register_special_op("send")
+def send_op(op, block, scope, ctx):
+    """Row-sliced send of a var's sections to their pservers
+    (reference parameter_send.cc)."""
+    client = global_rpc_client()
+    x = _np(scope.find_var(op.inputs["X"][0]).get())
+    for ep, name, (s, e) in zip(op.attrs["epmap"],
+                                op.attrs["section_names"],
+                                op.attrs["sections"]):
+        sec = x if s == 0 and e == -1 else x[s:e]
+        client.send_var(ep, name, np.ascontiguousarray(sec))
+
+
+@register_special_op("recv")
+def recv_op(op, block, scope, ctx):
+    client = global_rpc_client()
+    parts = []
+    for ep, name, _sec in zip(op.attrs["epmap"],
+                              op.attrs["section_names"],
+                              op.attrs["sections"]):
+        parts.append(client.get_var(ep, name))
+    val = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+    scope.var(op.outputs["Out"][0]).set(jnp.asarray(val))
+
+
+@register_special_op("send_barrier")
+def send_barrier_op(op, block, scope, ctx):
+    client = global_rpc_client()
+    for ep in op.attrs["endpoints"]:
+        client.send_barrier(ep)
+
+
+@register_special_op("fetch_barrier")
+def fetch_barrier_op(op, block, scope, ctx):
+    client = global_rpc_client()
+    for ep in op.attrs["endpoints"]:
+        client.fetch_barrier(ep)
+
+
+@register_special_op("checkpoint_notify")
+def checkpoint_notify_op(op, block, scope, ctx):
+    """Trainer asks every pserver to checkpoint its shards (reference
+    checkpoint_notify_op.cc -> pserver checkpoint block)."""
+    client = global_rpc_client()
+    for ep in op.attrs["endpoints"]:
+        client.call(ep, "checkpoint_notify", op.attrs["dirname"])
+
+
+@register_special_op("ps_sync_init")
+def ps_sync_init_op(op, block, scope, ctx):
+    """Initial-parameter sync: trainer 0 pushes its initialized param
+    sections to the pservers and signals init-done; other trainers wait
+    (gives every trainer/pserver bit-identical initial params — the
+    reference gets this by initializing on the pserver and having all
+    trainers recv before step 1)."""
+    client = global_rpc_client()
+    if op.attrs["is_pusher"]:
+        for var_name, ep, sec_name, s, e in op.attrs["push_plan"]:
+            x = _np(scope.find_var(var_name).get())
+            sec = x if s == 0 and e == -1 else x[s:e]
+            client.send_var(ep, sec_name, np.ascontiguousarray(sec))
+        for ep in op.attrs["endpoints"]:
+            client.call(ep, "init_done")
+    else:
+        for ep in op.attrs["endpoints"]:
+            client.call(ep, "init_wait")
+
+
+@register_special_op("listen_and_serv")
+def listen_and_serv_op(op, block, scope, ctx):
+    """Pserver event loop.  Blocks until every trainer sent Complete.
+
+    sync mode  (reference RunSyncLoop,  listen_and_serv_op.cc:109):
+      accumulate grads per name; on the send barrier, one handler thread
+      averages each grad's sections and runs its optimize block; the
+      fetch barrier closes the round.
+    async mode (reference RunAsyncLoop, :225): each arriving grad runs
+      its block immediately under the update lock (Hogwild-ish, the
+      Downpour staleness model).
+    """
+    attrs = op.attrs
+    fanin = int(attrs["Fanin"])
+    sync = bool(attrs["sync_mode"])
+    grad_blocks = [(g, int(b)) for g, b in attrs["grad_blocks"]]
+    grad_block_map = dict(grad_blocks)
+
+    server = RPCServer(attrs["endpoint"])
+    buffers: dict = {}
+    lock = threading.Lock()
+    stop = threading.Event()
+    init_evt = threading.Event()
+    ncomplete = [0]
+
+    def on_send_var(payload):
+        name, val = payload
+        with lock:
+            if sync and name in grad_block_map:
+                buffers.setdefault(name, []).append(val)
+            else:
+                scope.var(name).set(jnp.asarray(val))
+                if name in grad_block_map:   # async: apply on arrival
+                    ctx.run_block(grad_block_map[name], scope)
+
+    def on_send_barrier(_):
+        if not sync:
+            return
+        idx = server.barrier("send", fanin)
+        if idx == 0:
+            with lock:
+                for gname, bidx in grad_blocks:
+                    vals = buffers.pop(gname, None)
+                    if not vals:
+                        continue
+                    merged = vals[0] if len(vals) == 1 else \
+                        np.mean(np.stack(vals), axis=0)
+                    scope.var(gname).set(jnp.asarray(merged))
+                    ctx.run_block(bidx, scope)
+        server.barrier("send_done", fanin)
+
+    def on_get_var(name):
+        with lock:
+            var = scope.find_var(name)
+            if var is None or var.get() is None:
+                raise KeyError(f"pserver has no var '{name}'")
+            return _np(var.get())
+
+    def on_fetch_barrier(_):
+        if sync:
+            server.barrier("fetch", fanin)
+
+    def on_complete(_):
+        with lock:
+            ncomplete[0] += 1
+            if ncomplete[0] >= fanin:
+                stop.set()
+
+    def on_init_done(_):
+        init_evt.set()
+
+    def on_init_wait(_):
+        if not init_evt.wait(timeout=120.0):
+            raise TimeoutError(
+                "init_wait: trainer 0 never pushed initial params "
+                "(is it up? did ps_sync_init run?)")
+
+    def on_checkpoint(dirname):
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        with lock:
+            for name, var in scope.vars.items():
+                v = var.get()
+                if v is not None and hasattr(v, "dtype"):
+                    np.save(os.path.join(
+                        dirname, name.replace("/", "_") + ".npy"),
+                        _np(v))
+
+    server.register_handler("send_var", on_send_var)
+    server.register_handler("send_barrier", on_send_barrier)
+    server.register_handler("get_var", on_get_var)
+    server.register_handler("fetch_barrier", on_fetch_barrier)
+    server.register_handler("complete", on_complete)
+    server.register_handler("init_done", on_init_done)
+    server.register_handler("init_wait", on_init_wait)
+    server.register_handler("checkpoint_notify", on_checkpoint)
+    server.start()
+    try:
+        while not stop.wait(timeout=0.25):
+            pass
+    finally:
+        server.stop()

@@ -1,0 +1,9 @@
+"""Program-rewriting transpilers (reference:
+python/paddle/fluid/transpiler/)."""
+
+from paddle_tpu.transpiler.collective import (Collective,  # noqa: F401
+                                              GradAllReduce, LocalSGD)
+from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig, slice_variable)
+from paddle_tpu.transpiler.ps_dispatcher import (HashName,  # noqa: F401
+                                                 PSDispatcher, RoundRobin)

@@ -1,0 +1,333 @@
+"""DistributeTranspiler: rewrite a single-process training program into
+trainer + pserver programs (parameter-server data parallelism).
+
+Reference parity (SURVEY.md §2.4 DP strategy C):
+  - DistributeTranspiler.transpile:
+    /root/reference/python/paddle/fluid/transpiler/distribute_transpiler.py:377
+  - slice_variable (params -> blocks): :85
+  - get_trainer_program (strip optimize ops, add send/recv): :702
+  - get_pserver_program (shard vars + optimize blocks + listen_and_serv):
+    :836, grad merge :1863
+  - DistributeTranspilerConfig: :131
+
+TPU-first differences: the transport is the socket control plane
+(distributed/rpc.py) instead of gRPC; grad merge is a mean on the pserver
+host; initial-parameter consistency comes from trainer 0 pushing its
+initialized params (ps_sync_init op) instead of pserver-side init, so a
+PS run is bit-identical at step 0 to the local run it was transpiled
+from.  The trainer's forward/backward still compiles to one XLA module —
+only send/recv/barrier host ops sit outside it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.program import OPTIMIZE, OpDesc, BlockRef, Program
+from paddle_tpu.transpiler.ps_dispatcher import RoundRobin
+
+
+class DistributeTranspilerConfig:
+    """reference distribute_transpiler.py:131."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = RoundRobin
+        self.min_block_size = 1024  # min rows*cols before slicing pays off
+        self.sync_mode = True
+
+
+def slice_variable(shape, slice_count):
+    """Split dim-0 of `shape` into up to slice_count contiguous sections
+    (reference slice_variable :85, simplified to per-pserver sections).
+    Returns [(start, end), ...]."""
+    d0 = int(shape[0])
+    n = min(slice_count, d0)
+    bounds = np.linspace(0, d0, n + 1, dtype=np.int64)
+    return [(int(bounds[i]), int(bounds[i + 1])) for i in range(n)
+            if bounds[i + 1] > bounds[i]]
+
+
+class DistributeTranspiler:
+    """reference distribute_transpiler.py:183."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+
+    # ------------------------------------------------------------------ public
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=None, startup_program=None):
+        from paddle_tpu import framework
+
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self.endpoints = [e for e in pservers.split(",") if e]
+        self.sync_mode = (self.config.sync_mode if sync_mode is None
+                          else sync_mode)
+        self.origin_program = program or framework.default_main_program()
+        self.origin_startup = (startup_program or
+                               framework.default_startup_program())
+        self._build_plan()
+        self._build_trainer_program()
+        self._build_trainer_startup()
+        return self
+
+    def get_trainer_program(self, wait_port=True):
+        return self.trainer_program
+
+    def get_trainer_startup_program(self):
+        return self.trainer_startup
+
+    def get_pserver_program(self, endpoint):
+        return self._build_pserver_program(endpoint)
+
+    def get_pserver_programs(self, endpoint):
+        main = self._build_pserver_program(endpoint)
+        return main, self.get_startup_program(endpoint, main)
+
+    def get_startup_program(self, endpoint, pserver_program=None):
+        return self._build_pserver_startup(endpoint)
+
+    # ------------------------------------------------------------- planning
+    def _build_plan(self):
+        """Distribution plan: every optimized param (and its grad) maps to
+        a list of sections [(ps_index, section_name, start, end)]."""
+        gb = self.origin_program.global_block()
+        self.opt_ops = [op for op in gb.ops
+                        if op.op_role == OPTIMIZE and "Param" in op.inputs]
+        dispatcher = self.config.split_method(self.endpoints)
+        self.param_plan = {}
+        self.grad_of = {}
+        self.lr_names = sorted({
+            op.inputs["LearningRate"][0] for op in self.opt_ops
+            if op.inputs.get("LearningRate")})
+        n_ps = len(self.endpoints)
+        for op in self.opt_ops:
+            pname = op.inputs["Param"][0]
+            gname = op.inputs["Grad"][0]
+            self.grad_of[pname] = gname
+            var = gb.var(pname)
+            shape = tuple(var.shape or ())
+            numel = int(np.prod(shape)) if shape else 1
+            if (self.config.slice_var_up and n_ps > 1 and shape
+                    and shape[0] >= n_ps
+                    and numel >= self.config.min_block_size):
+                secs = slice_variable(shape, n_ps)
+            else:
+                secs = [(0, -1)]
+            if len(secs) == 1:
+                ep_i = self.endpoints.index(dispatcher.dispatch([var])[0])
+                plan = [(ep_i, f"{pname}.block0", 0, -1)]
+            else:
+                plan = [(i, f"{pname}.block{i}", s, e)
+                        for i, (s, e) in enumerate(secs)]
+            self.param_plan[pname] = plan
+
+    def _grad_section_name(self, pname, sec_name):
+        return sec_name.replace(pname, self.grad_of[pname], 1) \
+            if sec_name.startswith(pname) else sec_name + "@GRAD"
+
+    # ------------------------------------------------------- trainer program
+    def _build_trainer_program(self):
+        prog = self.origin_program.clone()
+        gb = prog.global_block()
+        gb.ops = [op for op in gb.ops
+                  if not (op.op_role == OPTIMIZE and "Param" in op.inputs)]
+        eps = self.endpoints
+        # send each grad's sections
+        for pname, plan in self.param_plan.items():
+            gname = self.grad_of[pname]
+            gb.append_op(
+                type="send", inputs={"X": gname}, outputs={},
+                attrs={
+                    "epmap": [eps[i] for i, *_ in plan],
+                    "section_names": [
+                        self._grad_section_name(pname, sec)
+                        for _, sec, *_ in plan],
+                    "sections": [[s, e] for _, _, s, e in plan],
+                }, infer_shape=False)
+        # per-step learning-rate push for scheduler-produced lr vars
+        for lr in self.lr_names:
+            if not gb.var(lr).persistable:
+                gb.append_op(
+                    type="send", inputs={"X": lr}, outputs={},
+                    attrs={"epmap": list(eps),
+                           "section_names": [lr] * len(eps),
+                           "sections": [[0, -1]] * len(eps)},
+                    infer_shape=False)
+        if self.sync_mode:
+            gb.append_op(type="send_barrier", inputs={}, outputs={},
+                         attrs={"endpoints": list(eps)},
+                         infer_shape=False)
+        # recv updated params
+        self._append_recv_ops(gb)
+        if self.sync_mode:
+            gb.append_op(type="fetch_barrier", inputs={}, outputs={},
+                         attrs={"endpoints": list(eps)},
+                         infer_shape=False)
+        self.trainer_program = prog
+
+    def _append_recv_ops(self, gb):
+        for pname, plan in self.param_plan.items():
+            gb.append_op(
+                type="recv", inputs={}, outputs={"Out": pname},
+                attrs={
+                    "epmap": [self.endpoints[i] for i, *_ in plan],
+                    "section_names": [sec for _, sec, *_ in plan],
+                    "sections": [[s, e] for _, _, s, e in plan],
+                }, infer_shape=False)
+
+    def _build_trainer_startup(self):
+        prog = self.origin_startup.clone()
+        gb = prog.global_block()
+        push_plan = []
+        for pname, plan in self.param_plan.items():
+            for i, sec, s, e in plan:
+                push_plan.append([pname, self.endpoints[i], sec, s, e])
+        gb.append_op(
+            type="ps_sync_init",
+            inputs={"X": [p for p in self.param_plan]}, outputs={},
+            attrs={"endpoints": list(self.endpoints),
+                   "push_plan": push_plan if self.trainer_id == 0 else [],
+                   "is_pusher": self.trainer_id == 0},
+            infer_shape=False)
+        # every trainer pulls the authoritative initial params
+        self._append_recv_ops(gb)
+        self.trainer_startup = prog
+
+    # ------------------------------------------------------- pserver program
+    def _sections_on(self, endpoint):
+        ep_i = self.endpoints.index(endpoint)
+        out = []
+        for pname, plan in self.param_plan.items():
+            for i, sec, s, e in plan:
+                if i == ep_i:
+                    out.append((pname, sec, s, e))
+        return out
+
+    def _sliced_shape(self, shape, s, e):
+        shape = tuple(shape or ())
+        if not shape or (s == 0 and e == -1):
+            return shape
+        return (e - s,) + shape[1:]
+
+    def _build_pserver_program(self, endpoint):
+        prog = Program()
+        gb = prog.global_block()
+        origin_gb = self.origin_program.global_block()
+        grad_blocks = []
+        for pname, sec, s, e in self._sections_on(endpoint):
+            pvar = origin_gb.var(pname)
+            shape = self._sliced_shape(pvar.shape, s, e)
+            gb.create_var(name=sec, shape=shape, dtype=pvar.dtype,
+                          persistable=True)
+            gsec = self._grad_section_name(pname, sec)
+            gb.create_var(name=gsec, shape=shape, dtype=pvar.dtype)
+            opt_op = next(op for op in self.opt_ops
+                          if op.inputs["Param"][0] == pname)
+            sub = prog._create_block()
+            self._clone_opt_op(prog, gb, sub, opt_op, pname, sec, gsec,
+                               s, e, origin_gb)
+            prog._rollback()
+            grad_blocks.append([gsec, sub.idx])
+        for lr in self.lr_names:
+            lv = origin_gb.var(lr)
+            gb.create_var(name=lr, shape=lv.shape, dtype=lv.dtype,
+                          persistable=True)
+        gb.append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint, "Fanin": self.trainers,
+                   "sync_mode": self.sync_mode,
+                   "grad_blocks": grad_blocks,
+                   "lr_names": list(self.lr_names)},
+            infer_shape=False)
+        return prog
+
+    def _clone_opt_op(self, prog, gb, sub, opt_op, pname, sec, gsec,
+                      s, e, origin_gb):
+        """Optimizer op remapped onto this param section: same-shaped
+        accumulators are sliced alongside the param, scalar accumulators
+        (beta pows) are copied per section (reference grad-merge +
+        optimizer blocks, distribute_transpiler.py:1967)."""
+        pshape = tuple(origin_gb.var(pname).shape or ())
+        name_map = {pname: sec, self.grad_of[pname]: gsec}
+        for slot, names in opt_op.inputs.items():
+            for n in names:
+                if n in name_map or n in self.lr_names:
+                    continue
+                v = origin_gb.var(n)
+                vshape = tuple(v.shape or ())
+                if vshape == pshape and vshape:
+                    new = f"{n}.block_{sec.rsplit('.', 1)[-1]}"
+                    gb.create_var(
+                        name=new,
+                        shape=self._sliced_shape(vshape, s, e),
+                        dtype=v.dtype, persistable=True)
+                else:
+                    new = f"{n}.{sec.rsplit('.', 1)[-1]}"
+                    gb.create_var(name=new, shape=vshape, dtype=v.dtype,
+                                  persistable=True)
+                name_map[n] = new
+        ins = {slot: [name_map.get(n, n) for n in names]
+               for slot, names in opt_op.inputs.items()}
+        outs = {slot: [name_map.get(n, n) for n in names]
+                for slot, names in opt_op.outputs.items()}
+        sub.ops.append(OpDesc(opt_op.type, ins, outs, dict(opt_op.attrs),
+                              OPTIMIZE))
+
+    def _build_pserver_startup(self, endpoint):
+        """Zeros for param sections (filled by the ps_sync_init push),
+        cloned fill ops (with sliced shapes) for accumulators and lr."""
+        prog = Program()
+        gb = prog.global_block()
+        origin_gb = self.origin_program.global_block()
+        origin_sb = self.origin_startup.global_block()
+        fills = {}
+        for op in origin_sb.ops:
+            if op.type == "fill_constant" and op.outputs.get("Out"):
+                fills[op.outputs["Out"][0]] = op
+        for pname, sec, s, e in self._sections_on(endpoint):
+            pvar = origin_gb.var(pname)
+            shape = self._sliced_shape(pvar.shape, s, e)
+            v = gb.create_var(name=sec, shape=shape, dtype=pvar.dtype,
+                              persistable=True)
+            gb.append_op(type="fill_constant", outputs={"Out": v},
+                         attrs={"shape": list(shape), "dtype": pvar.dtype,
+                                "value": 0.0}, infer_shape=False)
+            # accumulators for this section
+            opt_op = next(op for op in self.opt_ops
+                          if op.inputs["Param"][0] == pname)
+            pshape = tuple(pvar.shape or ())
+            for slot, names in opt_op.inputs.items():
+                for n in names:
+                    if n in (pname, self.grad_of[pname]) or \
+                            n in self.lr_names:
+                        continue
+                    ov = origin_gb.var(n)
+                    vshape = tuple(ov.shape or ())
+                    fill = fills.get(n)
+                    value = float(fill.attrs.get("value", 0.0)) \
+                        if fill is not None else 0.0
+                    if vshape == pshape and vshape:
+                        new = f"{n}.block_{sec.rsplit('.', 1)[-1]}"
+                        nshape = self._sliced_shape(vshape, s, e)
+                    else:
+                        new = f"{n}.{sec.rsplit('.', 1)[-1]}"
+                        nshape = vshape
+                    nv = gb.create_var(name=new, shape=nshape,
+                                       dtype=ov.dtype, persistable=True)
+                    gb.append_op(
+                        type="fill_constant", outputs={"Out": nv},
+                        attrs={"shape": list(nshape), "dtype": ov.dtype,
+                               "value": value}, infer_shape=False)
+        for lr in self.lr_names:
+            lv = origin_gb.var(lr)
+            fill = fills.get(lr)
+            value = float(fill.attrs.get("value", 0.0)) if fill else 0.0
+            nv = gb.create_var(name=lr, shape=lv.shape, dtype=lv.dtype,
+                               persistable=True)
+            gb.append_op(type="fill_constant", outputs={"Out": nv},
+                         attrs={"shape": list(lv.shape or [1]),
+                                "dtype": lv.dtype, "value": value},
+                         infer_shape=False)
+        return prog

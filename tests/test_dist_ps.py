@@ -1,0 +1,341 @@
+"""Parameter-server training tests: subprocess clusters on localhost
+(reference test pattern: tests/unittests/test_dist_base.py:366 —
+Popen pservers + trainers, env-injected endpoints, compare losses).
+
+The model is linear regression; sync-mode cluster must match the local
+single-process run closely (identical initial params via the
+ps_sync_init push), async mode must converge.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_RUNNER = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.transpiler import (DistributeTranspiler,
+                                       DistributeTranspilerConfig)
+
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    pserver_eps = os.environ["PADDLE_PSERVER_EPS"]
+    current_ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+    sync = os.environ.get("PADDLE_SYNC", "1") == "1"
+
+    np.random.seed(7)  # identical init on every process
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.SGD(0.05).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.min_block_size = 1      # force row-slicing even for tiny vars
+    t = DistributeTranspiler(cfg)
+    t.transpile(trainer_id, pservers=pserver_eps, trainers=trainers,
+                sync_mode=sync)
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == "PSERVER":
+        main = t.get_pserver_program(current_ep)
+        startup = t.get_startup_program(current_ep, main)
+        exe.run(startup)
+        exe.run(main)          # blocks until trainers complete
+        sys.exit(0)
+
+    exe.run(t.get_trainer_startup_program())
+    main = t.get_trainer_program()
+    rng = np.random.RandomState(100 + trainer_id)
+    W = np.arange(13, dtype=np.float32)[:, None] / 13.0
+    losses = []
+    for step in range(30):
+        bx = rng.rand(32, 13).astype(np.float32)
+        by = bx @ W
+        lv, = exe.run(main, feed={"x": bx, "y": by}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    from paddle_tpu.distributed.rpc import global_rpc_client
+    client = global_rpc_client()
+    for ep in pserver_eps.split(","):
+        client.send_complete(ep)
+    print("LOSSES " + json.dumps(losses))
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_cluster(sync=True, n_trainers=2, n_pservers=2, timeout=180):
+    eps = ",".join(f"127.0.0.1:{_free_port()}"
+                   for _ in range(n_pservers))
+    env_base = {
+        **os.environ,
+        "PADDLE_TRAINERS_NUM": str(n_trainers),
+        "PADDLE_PSERVER_EPS": eps,
+        "PADDLE_SYNC": "1" if sync else "0",
+        "JAX_PLATFORMS": "cpu",
+    }
+    procs = []
+    for ep in eps.split(","):
+        env = {**env_base, "PADDLE_TRAINING_ROLE": "PSERVER",
+               "PADDLE_CURRENT_ENDPOINT": ep}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _RUNNER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    trainers = []
+    for tid in range(n_trainers):
+        env = {**env_base, "PADDLE_TRAINING_ROLE": "TRAINER",
+               "PADDLE_TRAINER_ID": str(tid)}
+        trainers.append(subprocess.Popen(
+            [sys.executable, "-c", _RUNNER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = []
+    try:
+        for p in trainers:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, err.decode()[-3000:]
+            outs.append(out.decode())
+        for p in procs:
+            out, err = p.communicate(timeout=30)
+            assert p.returncode == 0, err.decode()[-3000:]
+    finally:
+        for p in procs + trainers:
+            if p.poll() is None:
+                p.kill()
+    losses = []
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("LOSSES ")]
+        assert line, out
+        losses.append(json.loads(line[0][len("LOSSES "):]))
+    return losses
+
+
+def _local_losses():
+    """Same model/data as trainer 0, single process."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+
+    np.random.seed(7)
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(100)
+    W = np.arange(13, dtype=np.float32)[:, None] / 13.0
+    losses = []
+    for step in range(30):
+        bx = rng.rand(32, 13).astype(np.float32)
+        by = bx @ W
+        lv, = exe.run(feed={"x": bx, "y": by}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    return losses
+
+
+def test_dist_ps_sync_matches_local():
+    """2 pservers x 2 trainers sync PS: trainer-0's step-0 loss equals
+    the local run exactly (init push), and training converges."""
+    dist = _run_cluster(sync=True)
+    local = _local_losses()
+    # step 0: identical params & identical batch => identical loss
+    np.testing.assert_allclose(dist[0][0], local[0], rtol=1e-5)
+    # both trainers converge
+    for tl in dist:
+        assert tl[-1] < tl[0] * 0.5, tl[::5]
+    # and sync PS roughly tracks local SGD (same lr; grads averaged over
+    # two trainers' batches instead of one — trajectories stay close on
+    # this convex problem)
+    assert dist[0][-1] < local[0] * 0.5
+
+
+def test_dist_ps_async_converges():
+    dist = _run_cluster(sync=False)
+    for tl in dist:
+        assert tl[-1] < tl[0] * 0.6, tl[::5]
+
+
+def test_transpiler_slices_and_plans():
+    """Unit-level: the plan row-slices large params and round-robins
+    small ones (reference slice_variable :85)."""
+    import paddle_tpu as fluid  # noqa: F401
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.transpiler import (DistributeTranspiler,
+                                       DistributeTranspilerConfig)
+
+    x = layers.data("x", shape=[16], dtype="float32")
+    pred = layers.fc(x, size=64)
+    loss = layers.mean(pred)
+    optimizer.SGD(0.1).minimize(loss)
+    cfg = DistributeTranspilerConfig()
+    cfg.min_block_size = 128     # w (16*64) slices; b (64) stays whole
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, pservers="127.0.0.1:7001,127.0.0.1:7002", trainers=2)
+    w_plan = [p for n, p in t.param_plan.items() if ".w_" in n][0]
+    b_plan = [p for n, p in t.param_plan.items() if ".b_" in n][0]
+    assert len(w_plan) == 2          # [16, 64] sliced into 2 row blocks
+    assert w_plan[0][2:] == (0, 8) and w_plan[1][2:] == (8, 16)
+    assert len(b_plan) == 1          # [64] -> whole var on one pserver
+    tp = t.get_trainer_program()
+    types = [op.type for op in tp.global_block().ops]
+    assert types.count("send") == 2
+    assert types.count("recv") == 2
+    assert "send_barrier" in types and "fetch_barrier" in types
+    assert all(op.op_role != "optimize" or "Param" not in op.inputs
+               for op in tp.global_block().ops)
+    ps = t.get_pserver_program("127.0.0.1:7001")
+    ps_types = [op.type for op in ps.global_block().ops]
+    assert ps_types[-1] == "listen_and_serv"
+
+
+def test_communicator_async_updates_params():
+    """In-process async PS: pserver runs in a thread; the Communicator's
+    send thread ships queued grads and its recv thread refreshes params
+    (reference communicator.h:160 semantics)."""
+    import threading
+    import time
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.communicator import Communicator
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.transpiler import (DistributeTranspiler,
+                                       DistributeTranspilerConfig)
+
+    ep = f"127.0.0.1:{_free_port()}"
+    np.random.seed(1)
+    x = layers.data("x", shape=[4], dtype="float32")
+    pred = layers.fc(x, size=1, bias_attr=False)
+    loss = layers.mean(pred)
+    optimizer.SGD(0.1).minimize(loss)
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, pservers=ep, trainers=1, sync_mode=False)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    ps_scope = Scope()
+    ps_main = t.get_pserver_program(ep)
+    with scope_guard(ps_scope):
+        exe.run(t.get_startup_program(ep, ps_main))
+    server_thread = threading.Thread(
+        target=lambda: exe.run(ps_main, scope=ps_scope), daemon=True)
+    server_thread.start()
+
+    trainer_scope = Scope()
+    with scope_guard(trainer_scope):
+        exe.run(t.get_trainer_startup_program(), scope=trainer_scope)
+    pname = next(iter(t.param_plan))
+    gname = t.grad_of[pname]
+    p0 = np.asarray(trainer_scope.find_var(pname).get()).copy()
+
+    comm = Communicator(t, trainer_scope).start()
+    g = np.ones_like(p0)
+    for _ in range(5):
+        comm.put(gname, g)
+    deadline = time.time() + 20
+    moved = False
+    while time.time() < deadline:
+        time.sleep(0.1)
+        cur = np.asarray(trainer_scope.find_var(pname).get())
+        if np.all(cur < p0 - 0.05):      # sgd steps with +1 grads
+            moved = True
+            break
+    comm.stop()
+    from paddle_tpu.distributed.rpc import global_rpc_client
+    global_rpc_client().send_complete(ep)
+    server_thread.join(timeout=10)
+    assert moved, (p0, cur)
+
+
+def test_fleet_ps_mode_cluster():
+    """Fleet facade drives the same PS cluster (reference
+    test_dist_fleet_base pattern)."""
+    runner = textwrap.dedent("""
+        import json, os, sys
+        import numpy as np
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import paddle_tpu as fluid
+        from paddle_tpu import layers, optimizer
+        from paddle_tpu.fleet import fleet, DistributedStrategy
+        from paddle_tpu.fleet.role_maker import PaddleCloudRoleMaker
+
+        fleet.init(PaddleCloudRoleMaker(is_collective=False))
+        np.random.seed(3)
+        x = layers.data("x", shape=[8], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        strategy = DistributedStrategy()
+        strategy.mode = "pserver"
+        opt = fleet.distributed_optimizer(optimizer.SGD(0.05), strategy)
+        opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        if fleet.is_server():
+            fleet.init_server()
+            fleet.run_server()
+            sys.exit(0)
+        exe.run(fleet.startup_program)
+        rng = np.random.RandomState(0)
+        W = np.ones((8, 1), np.float32)
+        losses = []
+        for _ in range(20):
+            bx = rng.rand(16, 8).astype(np.float32)
+            lv, = exe.run(fleet.main_program,
+                          feed={"x": bx, "y": bx @ W},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        from paddle_tpu.distributed.rpc import global_rpc_client
+        c = global_rpc_client()
+        for ep in fleet.server_endpoints():
+            c.send_complete(ep)
+        print("LOSSES " + json.dumps(losses))
+    """)
+    eps = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    env_base = {**os.environ, "PADDLE_TRAINERS_NUM": "2",
+                "PADDLE_PSERVERS_IP_PORT_LIST": eps,
+                "JAX_PLATFORMS": "cpu"}
+    procs, trainers = [], []
+    for ep in eps.split(","):
+        env = {**env_base, "TRAINING_ROLE": "PSERVER",
+               "PADDLE_CURRENT_ENDPOINT": ep}
+        procs.append(subprocess.Popen([sys.executable, "-c", runner],
+                                      env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE))
+    for tid in range(2):
+        env = {**env_base, "TRAINING_ROLE": "TRAINER",
+               "PADDLE_TRAINER_ID": str(tid)}
+        trainers.append(subprocess.Popen([sys.executable, "-c", runner],
+                                         env=env, stdout=subprocess.PIPE,
+                                         stderr=subprocess.PIPE))
+    try:
+        for p in trainers:
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, err.decode()[-3000:]
+            line = [ln for ln in out.decode().splitlines()
+                    if ln.startswith("LOSSES ")]
+            losses = json.loads(line[0][len("LOSSES "):])
+            assert losses[-1] < losses[0] * 0.7, losses[::5]
+        for p in procs:
+            p.communicate(timeout=30)
+    finally:
+        for p in procs + trainers:
+            if p.poll() is None:
+                p.kill()
