@@ -1,0 +1,218 @@
+"""Probability distributions over IR variables (reference:
+/root/reference/python/paddle/fluid/layers/distributions.py — Uniform,
+Normal, Categorical, MultivariateNormalDiag with sample / entropy /
+log_prob / kl_divergence).
+
+Sampling uses the sampled_uniform / sampled_gaussian ops whose
+SeedOffset step counter re-randomizes every executor step under jit
+(the dropout SeedOffset pattern) — the startup-program host-RNG ops
+(uniform_random / gaussian_random) would be baked in as trace-time
+constants."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from paddle_tpu.layers import tensor as tensor_layers
+from paddle_tpu.layers.helper import LayerHelper
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag"]
+
+
+def _to_var(value, ref=None):
+    """Wrap python/numpy constants as assign_value vars."""
+    if hasattr(value, "block"):
+        return value
+    arr = np.asarray(value, np.float32)
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    return tensor_layers.assign(arr)
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """reference distributions.py Uniform(low, high)."""
+
+    def __init__(self, low, high):
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        from paddle_tpu import layers
+        from paddle_tpu.layers.nn import _step_counter
+
+        helper = LayerHelper("uniform_sample")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="sampled_uniform",
+            inputs={"SeedOffset": _step_counter(helper, "sampling")},
+            outputs={"Out": out},
+            attrs={"shape": list(shape), "min": 0.0, "max": 1.0,
+                   "seed": seed}, infer_shape=False)
+        out.shape = tuple(shape)
+        span = layers.elementwise_sub(self.high, self.low)
+        return layers.elementwise_add(
+            layers.elementwise_mul(out, span), self.low)
+
+    def entropy(self):
+        from paddle_tpu import layers
+
+        return layers.log(layers.elementwise_sub(self.high, self.low))
+
+    def log_prob(self, value):
+        from paddle_tpu import layers
+
+        lb = layers.cast(layers.greater_than(value, self.low), "float32")
+        ub = layers.cast(layers.less_than(value, self.high), "float32")
+        return layers.elementwise_sub(
+            layers.log(layers.elementwise_mul(lb, ub)),
+            layers.log(layers.elementwise_sub(self.high, self.low)))
+
+
+class Normal(Distribution):
+    """reference distributions.py Normal(loc, scale)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        from paddle_tpu import layers
+        from paddle_tpu.layers.nn import _step_counter
+
+        helper = LayerHelper("normal_sample")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="sampled_gaussian",
+            inputs={"SeedOffset": _step_counter(helper, "sampling")},
+            outputs={"Out": out},
+            attrs={"shape": list(shape), "mean": 0.0, "std": 1.0,
+                   "seed": seed}, infer_shape=False)
+        out.shape = tuple(shape)
+        return layers.elementwise_add(
+            layers.elementwise_mul(out, self.scale), self.loc)
+
+    def entropy(self):
+        from paddle_tpu import layers
+
+        const = 0.5 + 0.5 * math.log(2.0 * math.pi)
+        return layers.elementwise_add(
+            layers.log(self.scale),
+            tensor_layers.assign(np.asarray([const], np.float32)))
+
+    def log_prob(self, value):
+        from paddle_tpu import layers
+
+        var = layers.elementwise_mul(self.scale, self.scale)
+        diff = layers.elementwise_sub(value, self.loc)
+        quad = layers.elementwise_div(
+            layers.elementwise_mul(diff, diff),
+            layers.scale(var, scale=2.0))
+        log_norm = layers.elementwise_add(
+            layers.log(self.scale),
+            tensor_layers.assign(
+                np.asarray([0.5 * math.log(2.0 * math.pi)], np.float32)))
+        return layers.scale(
+            layers.elementwise_add(quad, log_norm), scale=-1.0)
+
+    def kl_divergence(self, other):
+        """KL(self || other), both Normal."""
+        from paddle_tpu import layers
+
+        var_ratio = layers.elementwise_div(self.scale, other.scale)
+        var_ratio = layers.elementwise_mul(var_ratio, var_ratio)
+        diff = layers.elementwise_div(
+            layers.elementwise_sub(self.loc, other.loc), other.scale)
+        t1 = layers.elementwise_mul(diff, diff)
+        inner = layers.elementwise_sub(
+            layers.elementwise_add(var_ratio, t1),
+            tensor_layers.assign(np.asarray([1.0], np.float32)))
+        return layers.scale(
+            layers.elementwise_sub(inner, layers.log(var_ratio)),
+            scale=0.5)
+
+
+class Categorical(Distribution):
+    """reference distributions.py Categorical(logits)."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def _probs(self):
+        from paddle_tpu import layers
+
+        return layers.softmax(self.logits)
+
+    def entropy(self):
+        from paddle_tpu import layers
+
+        p = self._probs()
+        logp = layers.log_softmax(self.logits)
+        return layers.scale(
+            layers.reduce_sum(layers.elementwise_mul(p, logp), dim=-1,
+                              keep_dim=True), scale=-1.0)
+
+    def kl_divergence(self, other):
+        from paddle_tpu import layers
+
+        p = self._probs()
+        diff = layers.elementwise_sub(layers.log_softmax(self.logits),
+                                      layers.log_softmax(other.logits))
+        return layers.reduce_sum(layers.elementwise_mul(p, diff), dim=-1,
+                                 keep_dim=True)
+
+
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance multivariate normal (reference
+    distributions.py MultivariateNormalDiag; loc [..., D], scale given as
+    a diagonal matrix in the reference — here a vector of stddevs)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def entropy(self):
+        """D/2 * log(2*pi*e) + sum(log sigma_i)."""
+        from paddle_tpu import layers
+
+        d = float(self.scale.shape[-1]) if self.scale.shape else 1.0
+        const = 0.5 * d * math.log(2.0 * math.pi * math.e)
+        logdet = layers.reduce_sum(layers.log(self.scale), dim=-1,
+                                   keep_dim=True)
+        return layers.elementwise_add(
+            logdet, tensor_layers.assign(np.asarray([const], np.float32)))
+
+    def kl_divergence(self, other):
+        from paddle_tpu import layers
+
+        var_ratio = layers.elementwise_div(self.scale, other.scale)
+        var_ratio = layers.elementwise_mul(var_ratio, var_ratio)
+        diff = layers.elementwise_div(
+            layers.elementwise_sub(self.loc, other.loc), other.scale)
+        t1 = layers.elementwise_mul(diff, diff)
+        s = layers.reduce_sum(
+            layers.elementwise_sub(
+                layers.elementwise_add(var_ratio, t1),
+                layers.log(var_ratio)), dim=-1, keep_dim=True)
+        ones = tensor_layers.assign(np.asarray([1.0], np.float32))
+        dim_count = float(self.loc.shape[-1]) \
+            if self.loc.shape else 1.0
+        return layers.scale(
+            layers.elementwise_sub(
+                s, layers.scale(ones, scale=dim_count)), scale=0.5)

@@ -28,7 +28,8 @@ __all__ = [
     "logical_not", "cumsum", "increment", "shape", "reduce_all",
     "reduce_any", "pow", "sqrt", "square", "abs", "exp", "log",
     "sequence_mask", "swish", "hard_sigmoid", "elu", "relu6", "softplus",
-    "softsign", "prelu", "brelu", "flash_attention",
+    "softsign", "prelu", "brelu", "flash_attention", "linear_chain_crf",
+    "crf_decoding", "nce", "hsigmoid", "sample_logits",
 ]
 
 
@@ -282,27 +283,34 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
 _dropout_counter_var = {}
 
 
+def _step_counter(helper, prefix):
+    """Per-program persistable int64 step counter feeding SeedOffset
+    inputs, so stochastic ops re-randomize every step under jit (one
+    counter per (prefix, program))."""
+    from paddle_tpu.initializer import Constant
+    from paddle_tpu.param_attr import ParamAttr
+
+    key = (prefix, id(helper.main_program))
+    if key not in _dropout_counter_var:
+        ctr = helper.create_parameter(
+            ParamAttr(name=f"{prefix}_step_{key[1]}", trainable=False,
+                      initializer=Constant(0.0)),
+            [1], "int64")
+        ctr.stop_gradient = True
+        _dropout_counter_var[key] = ctr
+        helper.block.append_op(
+            type="increment", inputs={"X": ctr},
+            outputs={"Out": ctr}, attrs={"step": 1.0})
+    return _dropout_counter_var[key]
+
+
 def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
             dropout_implementation="downgrade_in_infer"):
     """Jit-deterministic dropout: a persistable int64 step counter feeds the
     op's SeedOffset so each executor step re-randomizes under jit."""
-    from paddle_tpu.initializer import Constant
-    from paddle_tpu.param_attr import ParamAttr
-
     helper = LayerHelper("dropout", name=name)
-    prog_id = id(helper.main_program)
     if not is_test:
-        if prog_id not in _dropout_counter_var:
-            ctr = helper.create_parameter(
-                ParamAttr(name=f"dropout_step_{prog_id}", trainable=False,
-                          initializer=Constant(0.0)),
-                [1], "int64")
-            ctr.stop_gradient = True
-            _dropout_counter_var[prog_id] = ctr
-            helper.block.append_op(
-                type="increment", inputs={"X": ctr},
-                outputs={"Out": ctr}, attrs={"step": 1.0})
-        ctr = _dropout_counter_var[prog_id]
+        ctr = _step_counter(helper, "dropout")
     out = helper.create_variable_for_type_inference(x.dtype)
     mask = helper.create_variable_for_type_inference(x.dtype, True)
     inputs = {"X": x}
@@ -919,3 +927,121 @@ def flash_attention(q, k, v, causal=False, scale=None, name=None):
         "flash_attention", q,
         {"causal": causal, "scale": float(scale or 0.0)},
         ins_extra={"K": k, "V": v}, in_slot="Q")
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None,
+                     name=None):
+    """Linear-chain CRF cost (reference layers/nn.py linear_chain_crf;
+    op: ops/loss_ops.py).  input: [B, T, D] emissions (padded), label:
+    [B, T] or [B, T, 1], length: [B].  Returns per-sequence cost [B, 1];
+    the learned 'transition' param holds [start; end; pairwise]."""
+    helper = LayerHelper("linear_chain_crf", name=name)
+    d = int(input.shape[-1])
+    transition = helper.create_parameter(param_attr, [d + 2, d],
+                                         "float32")
+    out = helper.create_variable_for_type_inference("float32")
+    inputs = {"Emission": input, "Transition": transition,
+              "Label": label}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="linear_chain_crf", inputs=inputs,
+                     outputs={"LogLikelihood": out}, infer_shape=False)
+    out.shape = (input.shape[0], 1)
+    out.transition = transition
+    return out
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None, name=None):
+    """Viterbi path (or per-position correctness when label given)."""
+    helper = LayerHelper("crf_decoding", name=name)
+    if transition is None and param_attr is not None:
+        from paddle_tpu.param_attr import ParamAttr
+
+        attr = ParamAttr._to_attr(param_attr)
+        gb = helper.main_program.global_block()
+        if attr.name and gb.has_var(attr.name):
+            transition = gb.var(attr.name)
+    if transition is None:
+        raise ValueError(
+            "crf_decoding needs the transition param: pass transition="
+            "crf_cost.transition, or param_attr=ParamAttr(name=...) "
+            "naming the shared CRF weight")
+    out = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": input, "Transition": transition}
+    if label is not None:
+        inputs["Label"] = label
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": out}, infer_shape=False)
+    out.shape = tuple(input.shape[:2])
+    return out
+
+
+def _sampling_seed_counter(helper):
+    """Shared jit-deterministic sampling counter (dropout pattern)."""
+    return _step_counter(helper, "sampling")
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, seed=0,
+        name=None):
+    """NCE loss (reference layers/nn.py nce).  Returns [B, 1] cost."""
+    helper = LayerHelper("nce", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [num_total_classes, d],
+                                "float32")
+    b = helper.create_parameter(bias_attr, [num_total_classes],
+                                "float32", is_bias=True)
+    ctr = _sampling_seed_counter(helper)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="nce",
+        inputs={"Input": input, "Label": label, "Weight": w, "Bias": b,
+                "SeedOffset": ctr},
+        outputs={"Cost": out},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples, "seed": seed},
+        infer_shape=False)
+    out.shape = (input.shape[0], 1)
+    return out
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid over a complete binary tree (reference
+    layers/nn.py hsigmoid)."""
+    helper = LayerHelper("hsigmoid", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [num_classes - 1, d],
+                                "float32")
+    b = helper.create_parameter(bias_attr, [num_classes - 1], "float32",
+                                is_bias=True)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs={"X": input, "Label": label, "W": w, "Bias": b},
+        outputs={"Out": out}, attrs={"num_classes": num_classes},
+        infer_shape=False)
+    out.shape = (input.shape[0], 1)
+    return out
+
+
+def sample_logits(logits, label, num_samples, seed=0,
+                  remove_accidental_hits=True, name=None):
+    """Sampled-softmax helper: returns (sampled_logits [B, NT+S],
+    samples [B, NT+S]); train with softmax_with_cross_entropy against
+    column-0 labels (reference layers/nn.py sample_logits + tests)."""
+    helper = LayerHelper("sample_logits", name=name)
+    ctr = _sampling_seed_counter(helper)
+    out = helper.create_variable_for_type_inference(logits.dtype)
+    samples = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(
+        type="sample_logits",
+        inputs={"Logits": logits, "Labels": label, "SeedOffset": ctr},
+        outputs={"SampledLogits": out, "Samples": samples},
+        attrs={"num_samples": num_samples, "seed": seed,
+               "remove_accidental_hits": remove_accidental_hits},
+        infer_shape=False)
+    return out, samples

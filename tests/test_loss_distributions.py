@@ -1,0 +1,201 @@
+"""CRF / NCE / hsigmoid / sample_logits ops + distributions
+(reference OpTest pattern: numpy brute-force references)."""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, layers, optimizer
+from paddle_tpu.core.registry import get_op_def
+
+
+def _crf_brute(em, trans, label, length):
+    """Brute-force logZ and gold score per sequence."""
+    start, end, w = trans[0], trans[1], trans[2:]
+    b, t, d = em.shape
+    costs = []
+    for i in range(b):
+        ln = length[i]
+        gold = start[label[i, 0]] + em[i, 0, label[i, 0]]
+        for s in range(1, ln):
+            gold += w[label[i, s - 1], label[i, s]] + em[i, s, label[i, s]]
+        gold += end[label[i, ln - 1]]
+        logz = -np.inf
+        for seq in itertools.product(range(d), repeat=ln):
+            sc = start[seq[0]] + em[i, 0, seq[0]]
+            for s in range(1, ln):
+                sc += w[seq[s - 1], seq[s]] + em[i, s, seq[s]]
+            sc += end[seq[ln - 1]]
+            logz = np.logaddexp(logz, sc)
+        costs.append(logz - gold)
+    return np.asarray(costs)
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(0)
+    b, t, d = 3, 4, 3
+    em = rng.randn(b, t, d).astype(np.float32)
+    trans = rng.randn(d + 2, d).astype(np.float32)
+    label = rng.randint(0, d, (b, t)).astype(np.int64)
+    length = np.asarray([4, 3, 2], np.int64)
+    out = get_op_def("linear_chain_crf").compute(
+        {"Emission": jnp.asarray(em), "Transition": jnp.asarray(trans),
+         "Label": jnp.asarray(label), "Length": jnp.asarray(length)},
+        {})["LogLikelihood"]
+    ref = _crf_brute(em, trans, label, length)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], ref, atol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(1)
+    b, t, d = 2, 4, 3
+    em = rng.randn(b, t, d).astype(np.float32)
+    trans = rng.randn(d + 2, d).astype(np.float32)
+    length = np.asarray([4, 3], np.int64)
+    path = np.asarray(get_op_def("crf_decoding").compute(
+        {"Emission": jnp.asarray(em), "Transition": jnp.asarray(trans),
+         "Length": jnp.asarray(length)}, {})["ViterbiPath"])
+    start, end, w = trans[0], trans[1], trans[2:]
+    for i in range(b):
+        ln = length[i]
+        best, best_seq = -np.inf, None
+        for seq in itertools.product(range(d), repeat=int(ln)):
+            sc = start[seq[0]] + em[i, 0, seq[0]]
+            for s in range(1, ln):
+                sc += w[seq[s - 1], seq[s]] + em[i, s, seq[s]]
+            sc += end[seq[ln - 1]]
+            if sc > best:
+                best, best_seq = sc, seq
+        np.testing.assert_array_equal(path[i, :ln], best_seq)
+        assert (path[i, ln:] == 0).all()
+
+
+def test_crf_trains_sequence_tagger():
+    """Tiny tagger: emissions from fc; CRF cost decreases and decoding
+    recovers the deterministic tag = token % n_tags rule."""
+    b, t, v, d, n_tags = 8, 6, 12, 16, 3
+    words = layers.data("words", shape=[t], dtype="int64")
+    target = layers.data("target", shape=[t], dtype="int64")
+    emb = layers.embedding(words, size=[v, d])
+    feat = layers.fc(emb, n_tags, num_flatten_dims=2)
+    crf_cost = layers.linear_chain_crf(feat, target)
+    loss = layers.mean(crf_cost)
+    optimizer.Adam(5e-2).minimize(loss)
+    decode = layers.crf_decoding(feat, transition=crf_cost.transition)
+
+    rng = np.random.RandomState(0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+    losses = []
+    for _ in range(60):
+        wv = rng.randint(0, v, (b, t)).astype(np.int64)
+        tv = (wv % n_tags).astype(np.int64)
+        lv, = exe.run(compiled, feed={"words": wv, "target": tv},
+                      fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.3, losses[::10]
+    wv = rng.randint(0, v, (b, t)).astype(np.int64)
+    (pv,) = exe.run(framework.default_main_program(),
+                    feed={"words": wv, "target": (wv % n_tags)},
+                    fetch_list=[decode])
+    acc = (pv == (wv % n_tags)).mean()
+    assert acc > 0.9, acc
+
+
+def test_nce_and_hsigmoid_train():
+    """Both large-vocab losses must learn the class of a linear problem
+    better than chance."""
+    b, d, c = 16, 8, 32
+    x = layers.data("x", shape=[d], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    nce_loss = layers.mean(layers.nce(x, y, num_total_classes=c,
+                                      num_neg_samples=8))
+    hs_loss = layers.mean(layers.hsigmoid(x, y, num_classes=c))
+    loss = layers.elementwise_add(nce_loss, hs_loss)
+    optimizer.Adam(5e-2).minimize(loss)
+    rng = np.random.RandomState(0)
+    W = rng.randn(d, c).astype(np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+    losses = []
+    for _ in range(80):
+        xv = rng.randn(b, d).astype(np.float32)
+        yv = np.argmax(xv @ W, -1)[:, None].astype(np.int64)
+        lv, = exe.run(compiled, feed={"x": xv, "y": yv},
+                      fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.8, losses[::16]
+
+
+def test_sample_logits_sampled_softmax():
+    """sample_logits + softmax_with_cross_entropy trains a sampled
+    softmax whose full-softmax eval accuracy beats chance."""
+    b, d, c, k = 16, 8, 64, 16
+    x = layers.data("x", shape=[d], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    logits = layers.fc(x, c, bias_attr=False)
+    sampled, _samples = layers.sample_logits(logits, y, num_samples=k)
+    zeros = layers.fill_constant_batch_size_like(
+        sampled, shape=[-1, 1], dtype="int64", value=0.0)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(sampled, zeros))
+    optimizer.Adam(5e-2).minimize(loss)
+    acc = layers.accuracy(layers.softmax(logits), y)
+    rng = np.random.RandomState(0)
+    W = rng.randn(d, c).astype(np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+    for _ in range(120):
+        xv = rng.randn(b, d).astype(np.float32)
+        yv = np.argmax(xv @ W, -1)[:, None].astype(np.int64)
+        exe.run(compiled, feed={"x": xv, "y": yv}, fetch_list=[])
+    xv = rng.randn(128, d).astype(np.float32)
+    yv = np.argmax(xv @ W, -1)[:, None].astype(np.int64)
+    (av,) = exe.run(framework.default_main_program(),
+                    feed={"x": xv, "y": yv}, fetch_list=[acc])
+    assert float(av) > 0.2, av  # chance is 1/64
+
+
+def test_distributions_numerics():
+    from paddle_tpu.layers.distributions import Categorical, Normal
+
+    n1 = Normal(0.0, 1.0)
+    n2 = Normal(1.0, 2.0)
+    ent = n1.entropy()
+    kl = n1.kl_divergence(n2)
+    logits1 = layers.assign(np.asarray([[1.0, 2.0, 3.0]], np.float32))
+    logits2 = layers.assign(np.asarray([[3.0, 1.0, 0.0]], np.float32))
+    c1, c2 = Categorical(logits1), Categorical(logits2)
+    c_ent = c1.entropy()
+    c_kl = c1.kl_divergence(c2)
+    # build sampling ops BEFORE startup runs (their step counter is a
+    # startup-initialized persistable, like any parameter)
+    s = n1.sample([4, 3])
+    u = layers.distributions.Uniform(0.0, 2.0).sample([5])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    ev, klv, cev, cklv = exe.run(
+        framework.default_main_program(), feed={},
+        fetch_list=[ent, kl, c_ent, c_kl])
+    # closed forms
+    np.testing.assert_allclose(ev, 0.5 + 0.5 * np.log(2 * np.pi),
+                               rtol=1e-5)
+    ref_kl = np.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5
+    np.testing.assert_allclose(klv, ref_kl, rtol=1e-5)
+    p = np.exp([1, 2, 3]) / np.exp([1, 2, 3]).sum()
+    np.testing.assert_allclose(cev, -(p * np.log(p)).sum(), rtol=1e-5)
+    q = np.exp([3, 1, 0]) / np.exp([3, 1, 0]).sum()
+    np.testing.assert_allclose(cklv, (p * np.log(p / q)).sum(),
+                               rtol=1e-4)
+    # sampling shape + per-step re-randomization under the compiled path
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+    sv, uv = exe.run(compiled, feed={}, fetch_list=[s, u])
+    sv2, _ = exe.run(compiled, feed={}, fetch_list=[s, u])
+    assert sv.shape == (4, 3) and uv.shape == (5,)
+    assert (uv >= 0).all() and (uv <= 2).all()
+    assert not np.allclose(sv, sv2), "samples must differ across steps"
