@@ -1,8 +1,8 @@
-"""slim — quantization (QAT + PTQ).
+"""slim — model compression: quantization (QAT + PTQ), filter pruning,
+knowledge distillation, SA-NAS.
 
 Reference parity: /root/reference/python/paddle/fluid/contrib/slim/
-(quantization passes; the NAS/pruning/distillation sub-packages of the
-reference are orthogonal training recipes, not runtime components).
+(quantization/, prune/, distillation/, nas/ sub-packages).
 """
 
 from paddle_tpu.contrib.slim.quantization import (
@@ -13,4 +13,11 @@ from paddle_tpu.contrib.slim.quantization import (
 )
 
 __all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
-           "quant_aware", "post_training_quantize"]
+           "quant_aware", "post_training_quantize", "Pruner", "flops",
+           "SAController", "distillation", "nas", "prune"]
+
+from paddle_tpu.contrib.slim import distillation  # noqa: F401
+from paddle_tpu.contrib.slim import nas  # noqa: F401
+from paddle_tpu.contrib.slim import prune  # noqa: F401
+from paddle_tpu.contrib.slim.nas import SAController  # noqa: F401
+from paddle_tpu.contrib.slim.prune import Pruner, flops  # noqa: F401

@@ -1,0 +1,94 @@
+"""Knowledge distillation (reference:
+/root/reference/python/paddle/fluid/contrib/slim/distillation/ —
+merge teacher graph into student graph, soft-label / FSP / L2 losses).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.program import OpDesc
+
+
+def merge(teacher_program, student_program, data_name_map, place=None,
+          scope=None, name_prefix="teacher_"):
+    """Clone the teacher's ops/vars into the student program under a
+    name prefix; data vars are unified per data_name_map
+    {teacher_data_name: student_data_name}.  Teacher vars are frozen
+    (stop_gradient).  Reference: slim/distillation/distiller graph
+    merge."""
+    t_block = teacher_program.global_block()
+    s_block = student_program.global_block()
+
+    def rename(n):
+        if n in data_name_map:
+            return data_name_map[n]
+        return name_prefix + n
+
+    for var in t_block.vars.values():
+        if var.name in data_name_map:
+            continue
+        new_name = rename(var.name)
+        if not s_block.has_var(new_name):
+            nv = s_block.create_var(
+                name=new_name, shape=var.shape, dtype=var.dtype,
+                persistable=var.persistable, stop_gradient=True)
+            nv.trainable = False
+    for op in t_block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        ins = {s: [rename(n) for n in ns] for s, ns in op.inputs.items()}
+        outs = {s: [rename(n) for n in ns]
+                for s, ns in op.outputs.items()}
+        s_block.ops.append(OpDesc(op.type, ins, outs, dict(op.attrs),
+                                  op.op_role))
+    # teacher params must be initialized: copy values if a scope given
+    if scope is not None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        for var in t_block.vars.values():
+            if not var.persistable or var.name in data_name_map:
+                continue
+            src = scope.find_var(var.name)
+            if src is not None and src.get() is not None:
+                scope.var(rename(var.name)).set(
+                    jnp.asarray(np.asarray(src.get())))
+
+
+def soft_label_loss(teacher_logits, student_logits,
+                    teacher_temperature=1.0, student_temperature=1.0):
+    """KL(teacher_T || student_T) soft-label loss (reference
+    slim/distillation soft_label_loss)."""
+    from paddle_tpu import layers
+
+    t = layers.softmax(layers.scale(teacher_logits,
+                                    scale=1.0 / teacher_temperature))
+    s = layers.log_softmax(layers.scale(student_logits,
+                                        scale=1.0 / student_temperature))
+    return layers.scale(
+        layers.mean(layers.reduce_sum(
+            layers.elementwise_mul(t, s), dim=-1)), scale=-1.0)
+
+
+def l2_loss(teacher_feature, student_feature):
+    from paddle_tpu import layers
+
+    return layers.mean(layers.square_error_cost(student_feature,
+                                                teacher_feature))
+
+
+def fsp_loss(teacher_a, teacher_b, student_a, student_b):
+    """Flow-of-solution-procedure loss: L2 between layer-pair Gram
+    matrices (reference slim/distillation fsp_loss)."""
+    from paddle_tpu import layers
+
+    def fsp_matrix(a, b):
+        # a: [B, Ca, H, W], b: [B, Cb, H, W] -> [B, Ca, Cb]
+        ba = layers.reshape(a, [0, int(a.shape[1]), -1])
+        bb = layers.reshape(b, [0, int(b.shape[1]), -1])
+        m = layers.matmul(ba, bb, transpose_y=True)
+        hw = float(int(a.shape[2]) * int(a.shape[3]))
+        return layers.scale(m, scale=1.0 / hw)
+
+    tm = fsp_matrix(teacher_a, teacher_b)
+    sm = fsp_matrix(student_a, student_b)
+    return layers.mean(layers.square_error_cost(sm, tm))

@@ -1,0 +1,53 @@
+"""Simulated-annealing NAS controller (reference:
+/root/reference/python/paddle/fluid/contrib/slim/nas/ — SAController
+proposing token vectors, light_nas space).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class SAController:
+    """Proposes token vectors; accept/reject by simulated annealing
+    (reference slim/nas/controller_server + sa_controller)."""
+
+    def __init__(self, range_table, reduce_rate=0.85, init_temperature=100,
+                 max_try_times=300, seed=0):
+        """range_table: per-position number of choices."""
+        self._range_table = list(range_table)
+        self._reduce_rate = reduce_rate
+        self._temperature = init_temperature
+        self._max_try_times = max_try_times
+        self._rng = np.random.RandomState(seed)
+        self._tokens = [self._rng.randint(0, r)
+                        for r in self._range_table]
+        self._reward = -np.inf
+        self.best_tokens = list(self._tokens)
+        self.best_reward = -np.inf
+        self._iter = 0
+
+    def next_tokens(self):
+        """Mutate one position of the current tokens."""
+        cand = list(self._tokens)
+        pos = self._rng.randint(0, len(cand))
+        cand[pos] = self._rng.randint(0, self._range_table[pos])
+        self._candidate = cand
+        return cand
+
+    def update(self, reward):
+        """Metropolis accept/reject of the last proposed tokens."""
+        self._iter += 1
+        accept = reward > self._reward or self._rng.rand() < math.exp(
+            min(0.0, (reward - self._reward)) / max(self._temperature,
+                                                    1e-9))
+        if accept:
+            self._tokens = self._candidate
+            self._reward = reward
+        if reward > self.best_reward:
+            self.best_reward = reward
+            self.best_tokens = list(self._candidate)
+        self._temperature *= self._reduce_rate
+        return accept

@@ -1,0 +1,94 @@
+"""Filter pruning (reference:
+/root/reference/python/paddle/fluid/contrib/slim/prune/ — Pruner,
+sensitivity analysis over conv filters ranked by L1 norm).
+
+TPU re-specification: the reference physically shrinks tensors and
+rewrites the program; under XLA static shapes we prune by MASKING —
+the lowest-L1 filters are zeroed and a mask set is returned so callers
+re-apply after each optimizer step (or fold masks at export).  FLOP
+accounting reports the would-be dense savings.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class Pruner:
+    """Rank conv/fc output filters by L1 norm, zero the lowest ratio."""
+
+    def __init__(self, criterion="l1_norm"):
+        if criterion != "l1_norm":
+            raise ValueError("only l1_norm criterion is supported")
+
+    def prune(self, program, scope, params, ratios, place=None,
+              lazy=False, only_graph=False):
+        """params: list of parameter names; ratios: per-param prune
+        fraction.  Returns {param_name: kept_mask (bool over dim 0)}.
+        Values in `scope` are masked in place."""
+        masks = {}
+        for name, ratio in zip(params, ratios):
+            var = scope.find_var(name)
+            if var is None or var.get() is None:
+                raise KeyError(f"prune: param '{name}' not in scope")
+            w = np.asarray(var.get())
+            n = w.shape[0]
+            n_prune = int(n * ratio)
+            if n_prune == 0:
+                masks[name] = np.ones(n, bool)
+                continue
+            scores = np.abs(w.reshape(n, -1)).sum(axis=1)
+            order = np.argsort(scores)
+            keep = np.ones(n, bool)
+            keep[order[:n_prune]] = False
+            masked = w * keep.reshape((n,) + (1,) * (w.ndim - 1))
+            var.set(jnp.asarray(masked))
+            masks[name] = keep
+        return masks
+
+    def apply_masks(self, scope, masks):
+        """Re-zero pruned filters (call after optimizer steps)."""
+        for name, keep in masks.items():
+            var = scope.find_var(name)
+            w = np.asarray(var.get())
+            var.set(jnp.asarray(
+                w * keep.reshape((len(keep),) + (1,) * (w.ndim - 1))))
+
+
+def sensitivity(program, scope, param_names, eval_fn,
+                pruned_ratios=(0.1, 0.3, 0.5, 0.7)):
+    """Per-param sensitivity curve (reference slim/prune/sensitive.py):
+    prune each param at each ratio, measure eval_fn() drop, restore."""
+    pruner = Pruner()
+    base = eval_fn()
+    result = {}
+    for name in param_names:
+        var = scope.find_var(name)
+        backup = var.get()
+        curves = {}
+        for r in pruned_ratios:
+            pruner.prune(program, scope, [name], [r])
+            curves[r] = base - eval_fn()
+            var.set(backup)
+        result[name] = curves
+    return result
+
+
+def flops(program):
+    """Dense-FLOP count of conv2d/mul ops in a program (reference
+    slim/analysis/flops.py)."""
+    total = 0
+    for op in program.global_block().ops:
+        if op.type == "conv2d":
+            out = program.global_block().var(op.outputs["Output"][0])
+            w = program.global_block().var(op.inputs["Filter"][0])
+            if out.shape and w.shape:
+                n, c, kh, kw = w.shape
+                total += 2 * int(np.prod(out.shape[1:])) * c * kh * kw
+        elif op.type == "mul":
+            w = program.global_block().var(op.inputs["Y"][0])
+            if w.shape:
+                total += 2 * int(np.prod(w.shape))
+    return total

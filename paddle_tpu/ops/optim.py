@@ -14,6 +14,7 @@ sparse path exists for the PS-style embedding service.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import REQUIRED, register_op
@@ -270,3 +271,91 @@ def decayed_adagrad(ins, attrs):
     m_out = attrs["decay"] * m + (1 - attrs["decay"]) * jnp.square(g)
     return {"ParamOut": p - lr * g / (jnp.sqrt(m_out) + attrs["epsilon"]),
             "MomentOut": m_out}
+
+
+@register_op("lookahead_update",
+             inputs=("Param", "Slow", "Step"),
+             outputs=("ParamOut", "SlowOut"), differentiable=False,
+             attrs={"alpha": 0.5, "k": 5},
+             in_place={"ParamOut": "Param", "SlowOut": "Slow"})
+def lookahead_update(ins, attrs):
+    """Every k steps: slow += alpha*(fast-slow); fast = slow.  The
+    k-step schedule is a where() select so it compiles into the jitted
+    step (reference incubate LookaheadOptimizer host-side variant)."""
+    p, slow = ins["Param"], ins["Slow"]
+    step = ins["Step"].reshape(()).astype(jnp.float32)
+    k = float(attrs["k"])
+    sync = jnp.mod(step, k) == 0.0
+    new_slow = slow + attrs["alpha"] * (p - slow)
+    slow_out = jnp.where(sync, new_slow, slow)
+    p_out = jnp.where(sync, new_slow, p)
+    return {"ParamOut": p_out, "SlowOut": slow_out}
+
+
+@register_op("dgc_momentum",
+             inputs=("Param", "Grad", "U", "V", "Velocity",
+                     "LearningRate", "Step"),
+             outputs=("ParamOut", "UOut", "VOut", "VelocityOut"),
+             differentiable=False, optional=("Step",),
+             attrs={"momentum": REQUIRED, "sparsity": 0.999,
+                    "rampup_begin_step": 0, "use_nesterov": False},
+             in_place={"ParamOut": "Param", "UOut": "U", "VOut": "V",
+                       "VelocityOut": "Velocity"})
+def dgc_momentum(ins, attrs):
+    """DGC (reference dgc_op.cc + DGCMomentumOptimizer): local gradient
+    accumulation u, error-feedback buffer v, top-k mask by |v|, masked
+    momentum update; dense warmup until rampup_begin_step.  The
+    'encoded' gradient stays dense (mask*value) — TPU prefers dense
+    top-k over scatter."""
+    p, g = ins["Param"], _dense_grad(ins["Grad"])
+    u, v, vel = ins["U"], ins["V"], ins["Velocity"]
+    lr = ins["LearningRate"].astype(p.dtype)
+    m = attrs["momentum"]
+    u = m * u + g                      # momentum correction
+    v = v + u
+    flat = jnp.abs(v).reshape(-1)
+    k = max(1, int(flat.shape[0] * (1.0 - attrs["sparsity"])))
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    mask = (jnp.abs(v) >= thresh).astype(p.dtype)
+    if "Step" in ins and attrs["rampup_begin_step"] > 0:
+        # dense warmup: before rampup_begin_step every component passes
+        step = ins["Step"].reshape(()).astype(jnp.float32)
+        warm = step <= float(attrs["rampup_begin_step"])
+        mask = jnp.where(warm, jnp.ones_like(mask), mask)
+    sparse_grad = v * mask
+    v = v * (1.0 - mask)               # error feedback: keep the rest
+    u = u * (1.0 - mask)
+    vel_out = m * vel + sparse_grad
+    if attrs["use_nesterov"]:
+        p_out = p - (sparse_grad + m * vel_out) * lr
+    else:
+        p_out = p - lr * vel_out
+    return {"ParamOut": p_out, "UOut": u, "VOut": v,
+            "VelocityOut": vel_out}
+
+
+@register_op("model_average_update",
+             inputs=("Params", "Sums", "Count", "Total"),
+             outputs=("SumsOut", "CountOut"),
+             duplicable=("Params", "Sums", "SumsOut"),
+             differentiable=False,
+             attrs={"average_window_rate": 0.15,
+                    "min_average_window": 100,
+                    "max_average_window": 10000},
+             in_place={"SumsOut": "Sums", "CountOut": "Count"})
+def model_average_update(ins, attrs):
+    """Bounded-window parameter-sum accumulation (reference
+    ModelAverage sum_1/2/3 rotation, optimizer.py:2244 — simplified to
+    a single sum that restarts when the window limit is hit).  The
+    effective window is max(min_w, min(max_w, rate * total_updates))."""
+    params, sums = ins["Params"], ins["Sums"]
+    count = ins["Count"].reshape(())
+    total = ins["Total"].reshape(())
+    window = jnp.clip(attrs["average_window_rate"] * total,
+                      float(attrs["min_average_window"]),
+                      float(attrs["max_average_window"]))
+    restart = count >= window
+    new_count = jnp.where(restart, 1.0, count + 1.0)
+    new_sums = [jnp.where(restart, p, s + p)
+                for p, s in zip(params, sums)]
+    return {"SumsOut": new_sums, "CountOut": new_count.reshape(1)}

@@ -389,3 +389,363 @@ class DecayedAdagrad(Optimizer):
 
 
 DecayedAdagradOptimizer = DecayedAdagrad
+
+
+# ---------------------------------------------------------------------------
+# wrapper / meta optimizers and averaging (reference optimizer.py
+# ModelAverage :2244, ExponentialMovingAverage :2434, DGCMomentum :787,
+# Lookahead / Recompute from the incubate line)
+# ---------------------------------------------------------------------------
+
+class _ParamSwapper:
+    """Shared apply()/restore() machinery: swap alternate values (shadow
+    or average) into the params for evaluation, then restore."""
+
+    def _swap_values(self):
+        raise NotImplementedError  # -> {param_name: eval_value}
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        from paddle_tpu.core.scope import global_scope
+
+        scope = global_scope()
+        if getattr(self, "_backup", None):
+            raise RuntimeError("apply() is not reentrant; restore first")
+        self._backup = {}
+        for pname, val in self._swap_values().items():
+            pvar = scope.find_var(pname)
+            self._backup[pname] = pvar.get()
+            pvar.set(val)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        from paddle_tpu.core.scope import global_scope
+
+        scope = global_scope()
+        for pname, val in getattr(self, "_backup", {}).items():
+            scope.find_var(pname).set(val)
+        self._backup = {}
+
+
+def _aux_counter(block, sb, name, value=0.0):
+    """Persistable [1] float32 counter var + startup fill."""
+    v = block.create_var(name=name, shape=(1,), dtype="float32",
+                         persistable=True, stop_gradient=True)
+    svv = sb.create_var(name=name, shape=(1,), dtype="float32",
+                        persistable=True)
+    sb.append_op(type="fill_constant", outputs={"Out": svv},
+                 attrs={"shape": [1], "dtype": "float32",
+                        "value": float(value)}, infer_shape=False)
+    return v
+
+
+class ExponentialMovingAverage(_ParamSwapper):
+    """EMA shadow of every trainable param, updated in the main program;
+    apply()/restore() swap shadows into the scope (reference
+    optimizer.py:2434).  With thres_steps given, the decay ramps as
+    min(decay, (1+step)/(10+step)) — the reference's warmup."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._thres_steps = thres_steps
+        self._name = name or unique_name.generate("ema")
+        self._shadows = {}
+
+    def _decay_var(self, block, sb):
+        """[1] var holding the effective decay for this step."""
+        if self._thres_steps is None:
+            dv = block.create_var(name=f"{self._name}.decay", shape=(1,),
+                                  dtype="float32", stop_gradient=True)
+            block.append_op(type="fill_constant", outputs={"Out": dv},
+                           attrs={"shape": [1], "dtype": "float32",
+                                  "value": self._decay},
+                           infer_shape=False)
+            return dv
+        step = _aux_counter(block, sb, f"{self._name}.step")
+        block.append_op(type="increment", inputs={"X": step},
+                        outputs={"Out": step}, attrs={"step": 1.0},
+                        op_role=OPTIMIZE, infer_shape=False)
+        num = block.create_var(name=f"{self._name}.num", shape=(1,),
+                               dtype="float32", stop_gradient=True)
+        den = block.create_var(name=f"{self._name}.den", shape=(1,),
+                               dtype="float32", stop_gradient=True)
+        ratio = block.create_var(name=f"{self._name}.ratio", shape=(1,),
+                                 dtype="float32", stop_gradient=True)
+        cap = block.create_var(name=f"{self._name}.cap", shape=(1,),
+                               dtype="float32", stop_gradient=True)
+        dv = block.create_var(name=f"{self._name}.decay", shape=(1,),
+                              dtype="float32", stop_gradient=True)
+        block.append_op(type="scale", inputs={"X": step},
+                        outputs={"Out": num},
+                        attrs={"scale": 1.0, "bias": 1.0,
+                               "bias_after_scale": True},
+                        op_role=OPTIMIZE, infer_shape=False)
+        block.append_op(type="scale", inputs={"X": step},
+                        outputs={"Out": den},
+                        attrs={"scale": 1.0, "bias": 10.0,
+                               "bias_after_scale": True},
+                        op_role=OPTIMIZE, infer_shape=False)
+        block.append_op(type="elementwise_div",
+                        inputs={"X": num, "Y": den},
+                        outputs={"Out": ratio},
+                        op_role=OPTIMIZE, infer_shape=False)
+        block.append_op(type="fill_constant", outputs={"Out": cap},
+                        attrs={"shape": [1], "dtype": "float32",
+                               "value": self._decay}, infer_shape=False)
+        block.append_op(type="elementwise_min",
+                        inputs={"X": ratio, "Y": cap},
+                        outputs={"Out": dv},
+                        op_role=OPTIMIZE, infer_shape=False)
+        return dv
+
+    def update(self):
+        from paddle_tpu import framework
+
+        prog = framework.default_main_program()
+        block = prog.global_block()
+        sb = framework.default_startup_program().global_block()
+        one = block.create_var(name=f"{self._name}.one", shape=(1,),
+                               dtype="float32", stop_gradient=True)
+        block.append_op(type="fill_constant", outputs={"Out": one},
+                        attrs={"shape": [1], "dtype": "float32",
+                               "value": 1.0}, infer_shape=False)
+        decay = self._decay_var(block, sb)
+        one_minus = block.create_var(name=f"{self._name}.om",
+                                     shape=(1,), dtype="float32",
+                                     stop_gradient=True)
+        block.append_op(type="elementwise_sub",
+                        inputs={"X": one, "Y": decay},
+                        outputs={"Out": one_minus},
+                        op_role=OPTIMIZE, infer_shape=False)
+        for p in prog.all_parameters():
+            shadow_name = f"{self._name}.{p.name}.shadow"
+            shadow = block.create_var(
+                name=shadow_name, shape=p.shape, dtype=p.dtype,
+                persistable=True, stop_gradient=True)
+            sv = sb.create_var(name=shadow_name, shape=p.shape,
+                               dtype=p.dtype, persistable=True)
+            sb.append_op(type="assign", inputs={"X": p.name},
+                         outputs={"Out": sv}, infer_shape=False)
+            scaled_s = block.create_var(
+                name=shadow_name + ".s", shape=p.shape, dtype=p.dtype)
+            scaled_p = block.create_var(
+                name=shadow_name + ".p", shape=p.shape, dtype=p.dtype)
+            block.append_op(type="elementwise_mul",
+                            inputs={"X": shadow, "Y": decay},
+                            outputs={"Out": scaled_s},
+                            op_role=OPTIMIZE, infer_shape=False)
+            block.append_op(type="elementwise_mul",
+                            inputs={"X": p, "Y": one_minus},
+                            outputs={"Out": scaled_p},
+                            op_role=OPTIMIZE, infer_shape=False)
+            block.append_op(type="elementwise_add",
+                            inputs={"X": scaled_s, "Y": scaled_p},
+                            outputs={"Out": shadow},
+                            op_role=OPTIMIZE, infer_shape=False)
+            self._shadows[p.name] = shadow
+
+    def _swap_values(self):
+        from paddle_tpu.core.scope import global_scope
+
+        scope = global_scope()
+        return {pname: scope.find_var(shadow.name).get()
+                for pname, shadow in self._shadows.items()}
+
+
+class ModelAverage(_ParamSwapper):
+    """Bounded-window running average of params (reference
+    optimizer.py:2244).  Accumulation restarts when the window exceeds
+    max(min_average_window, min(max_average_window,
+    average_window_rate * total_updates)) — bounding apply() to recent
+    history like the reference's sum_1/2/3 rotation (single-sum
+    restart instead of three-way rotation)."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=100,
+                 max_average_window=10000, name=None):
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._name = name or unique_name.generate("model_average")
+        self._sums = {}
+        self._count = None
+
+    def update(self):
+        from paddle_tpu import framework
+
+        prog = framework.default_main_program()
+        block = prog.global_block()
+        sb = framework.default_startup_program().global_block()
+        count = _aux_counter(block, sb, f"{self._name}.count")
+        total = _aux_counter(block, sb, f"{self._name}.total")
+        block.append_op(type="increment", inputs={"X": total},
+                        outputs={"Out": total}, attrs={"step": 1.0},
+                        op_role=OPTIMIZE, infer_shape=False)
+        params = [p.name for p in prog.all_parameters()]
+        sums = {}
+        for pname in params:
+            sname = f"{self._name}.{pname}.sum"
+            p = block.var(pname)
+            sums[pname] = block.create_var(
+                name=sname, shape=p.shape, dtype=p.dtype,
+                persistable=True, stop_gradient=True)
+            sv = sb.create_var(name=sname, shape=p.shape, dtype=p.dtype,
+                               persistable=True)
+            sb.append_op(type="fill_constant", outputs={"Out": sv},
+                         attrs={"shape": list(p.shape),
+                                "dtype": p.dtype, "value": 0.0},
+                         infer_shape=False)
+        block.append_op(
+            type="model_average_update",
+            inputs={"Params": params,
+                    "Sums": [sums[p].name for p in params],
+                    "Count": count, "Total": total},
+            outputs={"SumsOut": [sums[p].name for p in params],
+                     "CountOut": count},
+            attrs={"average_window_rate": self._rate,
+                   "min_average_window": self._min_w,
+                   "max_average_window": self._max_w},
+            op_role=OPTIMIZE, infer_shape=False)
+        self._sums = sums
+        self._count = count
+
+    def _swap_values(self):
+        import numpy as np
+
+        from paddle_tpu.core.scope import global_scope
+
+        scope = global_scope()
+        n = float(np.asarray(
+            scope.find_var(self._count.name).get()).reshape(-1)[0])
+        n = max(n, 1.0)
+        out = {}
+        for pname, sum_var in self._sums.items():
+            cur = scope.find_var(pname).get()
+            avg = scope.find_var(sum_var.name).get() / n
+            out[pname] = avg.astype(cur.dtype)
+        return out
+
+
+class LookaheadOptimizer:
+    """Lookahead (k slow steps, reference incubate LookaheadOptimizer):
+    every k steps slow += alpha*(fast-slow); fast = slow.  Implemented
+    with where(step%k==0) selects so the whole schedule stays inside the
+    jitted step (no host branching)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._name = name or unique_name.generate("lookahead")
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from paddle_tpu import framework
+
+        ret = self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        prog = framework.default_main_program()
+        block = prog.global_block()
+        sb = framework.default_startup_program().global_block()
+        step = _aux_counter(block, sb, f"{self._name}.step")
+        block.append_op(type="increment", inputs={"X": step},
+                        outputs={"Out": step}, attrs={"step": 1.0},
+                        op_role=OPTIMIZE, infer_shape=False)
+        for p in prog.all_parameters():
+            if p.name.startswith(self._name):
+                continue
+            slow_name = f"{self._name}.{p.name}.slow"
+            slow = block.create_var(name=slow_name, shape=p.shape,
+                                    dtype=p.dtype, persistable=True,
+                                    stop_gradient=True)
+            sv = sb.create_var(name=slow_name, shape=p.shape,
+                               dtype=p.dtype, persistable=True)
+            sb.append_op(type="assign", inputs={"X": p.name},
+                         outputs={"Out": sv}, infer_shape=False)
+            block.append_op(
+                type="lookahead_update",
+                inputs={"Param": p, "Slow": slow, "Step": step},
+                outputs={"ParamOut": p, "SlowOut": slow},
+                attrs={"alpha": self.alpha, "k": self.k},
+                op_role=OPTIMIZE, infer_shape=False)
+        return ret
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression momentum (reference optimizer.py:787 +
+    dgc_op.cc): top-k sparsify each grad with error feedback (u, v
+    accumulators) before the momentum update; dense (no compression)
+    until rampup_begin_step.  On TPU the sparsified grad stays dense
+    (mask*value) — the win the reference gets on the NCCL wire becomes
+    an XLA-collective win under DP, with identical optimizer
+    semantics."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 sparsity=0.999, use_nesterov=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._rampup_begin_step = rampup_begin_step
+        self._sparsity = sparsity
+        self._use_nesterov = use_nesterov
+        self._step_var = None
+
+    def _append_optimize_op(self, block, pg):
+        from paddle_tpu import framework
+
+        p, g = pg
+        if self._step_var is None:
+            sb = framework.default_startup_program().global_block()
+            self._step_var = _aux_counter(
+                block, sb, unique_name.generate("dgc.step"))
+            block.append_op(type="increment",
+                            inputs={"X": self._step_var},
+                            outputs={"Out": self._step_var},
+                            attrs={"step": 1.0},
+                            op_role=OPTIMIZE, infer_shape=False)
+        u = self._add_accumulator("dgc_u", p)
+        v = self._add_accumulator("dgc_v", p)
+        vel = self._add_accumulator("velocity", p)
+        block.append_op(
+            type="dgc_momentum",
+            inputs={"Param": p, "Grad": g, "U": u, "V": v,
+                    "Velocity": vel, "LearningRate": self._lr_var,
+                    "Step": self._step_var},
+            outputs={"ParamOut": p, "UOut": u, "VOut": v,
+                     "VelocityOut": vel},
+            attrs={"momentum": self._momentum,
+                   "sparsity": self._sparsity,
+                   "rampup_begin_step": self._rampup_begin_step,
+                   "use_nesterov": self._use_nesterov},
+            op_role=OPTIMIZE, infer_shape=False)
+
+
+class RecomputeOptimizer:
+    """API-parity wrapper for activation recomputation (reference
+    incubate RecomputeOptimizer).  TPU-first note: the compiled path's
+    backward ops already re-trace their forward via jax.vjp, and XLA's
+    rematerialization pass (plus jax.checkpoint inside pallas/scan
+    bodies) owns the memory/compute trade — so minimize() delegates to
+    the inner optimizer and records the checkpoint list for
+    introspection; no IR surgery is needed to get recompute semantics
+    on this backend."""
+
+    def __init__(self, optimizer):
+        self.inner_optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, *a, **k):
+        return self.inner_optimizer.backward(*a, **k)
+
+    def apply_gradients(self, *a, **k):
+        return self.inner_optimizer.apply_gradients(*a, **k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)

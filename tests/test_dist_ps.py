@@ -339,3 +339,34 @@ def test_fleet_ps_mode_cluster():
         for p in procs + trainers:
             if p.poll() is None:
                 p.kill()
+
+
+def test_grad_allreduce_transpiler_inserts_collectives():
+    """GradAllReduce (reference transpiler/collective.py:175): scales
+    the loss grad by 1/nranks and inserts c_allreduce_sum after each
+    grad's producing op."""
+    import paddle_tpu as fluid  # noqa: F401
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.core.program import BACKWARD
+    from paddle_tpu.transpiler import GradAllReduce
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    loss = layers.mean(layers.fc(x, 1))
+    optimizer.SGD(0.1).minimize(loss)
+    import paddle_tpu.framework as framework
+
+    main = framework.default_main_program()
+    startup = framework.default_startup_program()
+    GradAllReduce().transpile(startup, main, rank=0,
+                              endpoints="a:1,b:2",
+                              current_endpoint="a:1")
+    ops = main.global_block().ops
+    ar = [op for op in ops if op.type == "c_allreduce_sum"]
+    assert len(ar) == 2  # w grad + b grad
+    fills = [op for op in ops
+             if op.type == "fill_constant" and op.op_role == BACKWARD
+             and op.outputs.get("Out", [""])[0].endswith("@GRAD")]
+    assert fills and abs(fills[0].attrs["value"] - 0.5) < 1e-9
+    # allreduce sits before the optimizer consumes the grad
+    types = [op.type for op in ops]
+    assert types.index("c_allreduce_sum") < types.index("sgd")

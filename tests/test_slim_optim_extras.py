@@ -1,0 +1,203 @@
+"""Optimizer extras (EMA, ModelAverage, Lookahead, DGC) + slim
+(pruning, distillation, NAS)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, layers, optimizer
+
+
+def _linreg(lr=0.1, opt=None):
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, 1, bias_attr=False, param_attr=None)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    (opt or optimizer.SGD(lr)).minimize(loss)
+    return x, y, pred, loss
+
+
+def test_ema_tracks_manual_shadow():
+    np.random.seed(0)
+    x, y, pred, loss = _linreg(0.1)
+    ema = optimizer.ExponentialMovingAverage(0.9)
+    ema.update()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    from paddle_tpu.core.scope import global_scope
+
+    pname = framework.default_main_program().all_parameters()[0].name
+    w = np.asarray(global_scope().find_var(pname).get()).copy()
+    shadow_ref = w.copy()
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        bx = rng.rand(8, 4).astype(np.float32)
+        by = bx.sum(1, keepdims=True)
+        exe.run(feed={"x": bx, "y": by}, fetch_list=[loss])
+        w_now = np.asarray(global_scope().find_var(pname).get())
+        shadow_ref = 0.9 * shadow_ref + 0.1 * w_now
+    with ema.apply(exe):
+        w_eval = np.asarray(global_scope().find_var(pname).get())
+        np.testing.assert_allclose(w_eval, shadow_ref, rtol=1e-5)
+    w_back = np.asarray(global_scope().find_var(pname).get())
+    np.testing.assert_allclose(w_back, w_now, rtol=1e-6)
+
+
+def test_model_average_is_mean_of_trajectory():
+    np.random.seed(0)
+    x, y, pred, loss = _linreg(0.1)
+    ma = optimizer.ModelAverage()
+    ma.update()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    from paddle_tpu.core.scope import global_scope
+
+    pname = framework.default_main_program().all_parameters()[0].name
+    traj = []
+    rng = np.random.RandomState(0)
+    for _ in range(4):
+        bx = rng.rand(8, 4).astype(np.float32)
+        exe.run(feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                fetch_list=[loss])
+        traj.append(np.asarray(global_scope().find_var(pname).get()))
+    with ma.apply(exe):
+        w_eval = np.asarray(global_scope().find_var(pname).get())
+        np.testing.assert_allclose(w_eval, np.mean(traj, axis=0),
+                                   rtol=1e-5)
+
+
+def test_lookahead_syncs_every_k():
+    np.random.seed(0)
+    x, y, pred, loss = _linreg(
+        opt=optimizer.LookaheadOptimizer(optimizer.SGD(0.1), alpha=0.5,
+                                         k=3))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    from paddle_tpu.core.scope import global_scope
+
+    prog = framework.default_main_program()
+    pname = [p.name for p in prog.all_parameters()
+             if "lookahead" not in p.name][0]
+    slow_name = [v for v in prog.global_block().vars
+                 if v.endswith(f"{pname}.slow")][0]
+    rng = np.random.RandomState(0)
+    slow0 = np.asarray(global_scope().find_var(slow_name).get()).copy()
+    for i in range(1, 7):
+        bx = rng.rand(8, 4).astype(np.float32)
+        exe.run(feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                fetch_list=[loss])
+        fast = np.asarray(global_scope().find_var(pname).get())
+        slow = np.asarray(global_scope().find_var(slow_name).get())
+        if i % 3 == 0:
+            np.testing.assert_allclose(fast, slow, rtol=1e-6)
+        else:
+            assert not np.allclose(fast, slow0) or i < 3
+    # slow moved from its initial value after the first sync
+    assert not np.allclose(slow, slow0)
+
+
+def test_dgc_momentum_converges_and_error_feedback():
+    np.random.seed(0)
+    x, y, pred, loss = _linreg(
+        opt=optimizer.DGCMomentumOptimizer(0.05, momentum=0.9,
+                                           sparsity=0.5))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(60):
+        bx = rng.rand(16, 4).astype(np.float32)
+        lv, = exe.run(compiled,
+                      feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                      fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.3, losses[::10]
+
+
+def test_pruner_masks_lowest_l1_filters():
+    from paddle_tpu.contrib.slim import Pruner, flops
+    from paddle_tpu.core.scope import global_scope
+
+    np.random.seed(0)
+    img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+    conv = layers.conv2d(img, num_filters=8, filter_size=3,
+                         bias_attr=False)
+    loss = layers.mean(conv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    prog = framework.default_main_program()
+    pname = prog.all_parameters()[0].name
+    w0 = np.asarray(global_scope().find_var(pname).get()).copy()
+    scores = np.abs(w0.reshape(8, -1)).sum(1)
+    expect_pruned = set(np.argsort(scores)[:4])
+    masks = Pruner().prune(prog, global_scope(), [pname], [0.5])
+    keep = masks[pname]
+    assert set(np.where(~keep)[0]) == expect_pruned
+    w1 = np.asarray(global_scope().find_var(pname).get())
+    assert (w1[~keep] == 0).all() and (w1[keep] == w0[keep]).all()
+    # model still runs; flops accounting positive
+    out, = exe.run(prog, feed={"img": np.random.rand(
+        2, 3, 8, 8).astype(np.float32)}, fetch_list=[loss])
+    assert np.isfinite(out).all()
+    assert flops(prog) > 0
+
+
+def test_distillation_merge_and_soft_label():
+    from paddle_tpu.contrib.slim import distillation
+    from paddle_tpu.core.program import Program
+    from paddle_tpu.core.scope import global_scope
+
+    # teacher program built separately
+    teacher_prog = Program()
+    teacher_startup = Program()
+    old_main = framework.switch_main_program(teacher_prog)
+    old_startup = framework.switch_startup_program(teacher_startup)
+    np.random.seed(1)
+    tx = layers.data("x", shape=[4], dtype="float32")
+    t_logits = layers.fc(tx, 3, name="tfc")
+    framework.switch_main_program(old_main)
+    framework.switch_startup_program(old_startup)
+
+    # student
+    np.random.seed(2)
+    x = layers.data("x", shape=[4], dtype="float32")
+    s_logits = layers.fc(x, 3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    # init teacher params into scope
+    exe.run(teacher_startup)
+    distillation.merge(teacher_prog, framework.default_main_program(),
+                       {"x": "x"}, scope=global_scope())
+    t_out_name = "teacher_" + t_logits.name
+    t_var = framework.default_main_program().global_block().var(
+        t_out_name)
+    dl = distillation.soft_label_loss(t_var, s_logits,
+                                      teacher_temperature=2.0,
+                                      student_temperature=2.0)
+    optimizer.Adam(5e-2).minimize(dl)
+    exe.run(framework.default_startup_program())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(50):
+        bx = rng.rand(16, 4).astype(np.float32)
+        lv, = exe.run(feed={"x": bx}, fetch_list=[dl])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0], losses[::10]
+    # teacher unchanged by training
+    tw = [v for v in teacher_prog.global_block().vars if ".w_" in v][0]
+    assert not framework.default_main_program().global_block().var(
+        "teacher_" + tw).trainable
+
+
+def test_sa_controller_optimizes_synthetic_reward():
+    from paddle_tpu.contrib.slim import SAController
+
+    target = [3, 1, 4, 1, 5]
+    ctrl = SAController([8] * 5, seed=0)
+
+    def reward(tokens):
+        return -sum(abs(a - b) for a, b in zip(tokens, target))
+
+    for _ in range(400):
+        toks = ctrl.next_tokens()
+        ctrl.update(reward(toks))
+    assert ctrl.best_reward >= -3, (ctrl.best_tokens, ctrl.best_reward)
