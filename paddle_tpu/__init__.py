@@ -49,6 +49,10 @@ from paddle_tpu import contrib
 from paddle_tpu import dataset
 from paddle_tpu import datasets
 from paddle_tpu import native
+from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
+from paddle_tpu import transpiler
+from paddle_tpu import distributed
+from paddle_tpu import decode
 from paddle_tpu.dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from paddle_tpu import inference
 from paddle_tpu import fleet as fleet_pkg

@@ -5,8 +5,6 @@ merge teacher graph into student graph, soft-label / FSP / L2 losses).
 
 from __future__ import annotations
 
-from paddle_tpu.core.program import OpDesc
-
 
 def merge(teacher_program, student_program, data_name_map, place=None,
           scope=None, name_prefix="teacher_"):
@@ -38,8 +36,9 @@ def merge(teacher_program, student_program, data_name_map, place=None,
         ins = {s: [rename(n) for n in ns] for s, ns in op.inputs.items()}
         outs = {s: [rename(n) for n in ns]
                 for s, ns in op.outputs.items()}
-        s_block.ops.append(OpDesc(op.type, ins, outs, dict(op.attrs),
-                                  op.op_role))
+        s_block.append_op(type=op.type, inputs=ins, outputs=outs,
+                          attrs=dict(op.attrs), op_role=op.op_role,
+                          infer_shape=False)
     # teacher params must be initialized: copy values if a scope given
     if scope is not None:
         import jax.numpy as jnp

@@ -28,9 +28,16 @@ def get_data_home():
 
 from paddle_tpu.datasets import (  # noqa: E402,F401
     cifar,
+    conll05,
+    flowers,
     imdb,
     imikolov,
     mnist,
     movielens,
+    mq2007,
+    sentiment,
     uci_housing,
+    voc2012,
+    wmt14,
+    wmt16,
 )

@@ -1,0 +1,62 @@
+"""CoNLL-05 SRL reader creators (reference
+python/paddle/dataset/conll05.py).
+
+Samples: (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids,
+mark, label_ids) — the 8 input slots + label the reference's
+label_semantic_roles model feeds.  Sequences are variable-length int64
+lists.  Synthetic offline: tag = f(word, distance-to-verb) so a real
+tagger fits it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_WORD_DICT = 4000
+_VERB_DICT = 300
+_LABEL_DICT = 59   # reference label dict size (BIO over 29 roles + O)
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(_WORD_DICT)}
+    verb_dict = {f"v{i}": i for i in range(_VERB_DICT)}
+    label_dict = {f"l{i}": i for i in range(_LABEL_DICT)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Reference returns a pretrained word-embedding ndarray."""
+    rng = np.random.RandomState(7)
+    return rng.randn(_WORD_DICT, 32).astype(np.float32) * 0.1
+
+
+def _sentence(rng):
+    n = rng.randint(5, 25)
+    words = rng.randint(0, _WORD_DICT, n)
+    verb_pos = rng.randint(0, n)
+    verb = rng.randint(0, _VERB_DICT)
+    ctx = [np.roll(words, k) for k in (2, 1, 0, -1, -2)]
+    mark = (np.arange(n) == verb_pos).astype(np.int64)
+    dist = np.abs(np.arange(n) - verb_pos)
+    labels = (words + np.minimum(dist, 4)) % _LABEL_DICT
+    verb_ids = np.full(n, verb)
+    return (words, ctx[0], ctx[1], ctx[2], ctx[3], ctx[4], verb_ids,
+            mark, labels)
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            yield tuple(
+                [list(map(int, col)) for col in _sentence(rng)])
+
+    return reader
+
+
+def test(word_dict=None, verb_dict=None, label_dict=None):
+    return _reader(400, 1)
+
+
+def train(word_dict=None, verb_dict=None, label_dict=None):
+    return _reader(2000, 0)

@@ -1,0 +1,40 @@
+"""Oxford-102 flowers reader creators (reference
+python/paddle/dataset/flowers.py).
+
+Samples: (image float32[3*224*224] in [0,1], label int64 in [0,102)).
+Synthetic offline: class-template images + noise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_N_CLASSES = 102
+_IMG = 3 * 224 * 224
+
+
+def _reader(n, seed, use_xmap=True):
+    def reader():
+        rng = np.random.RandomState(seed)
+        tmpl_rng = np.random.RandomState(777)
+        # per-class low-res template upsampled (memory-friendly)
+        tmpl = tmpl_rng.rand(_N_CLASSES, 3, 8, 8).astype(np.float32)
+        for _ in range(n):
+            lbl = rng.randint(0, _N_CLASSES)
+            t = np.kron(tmpl[lbl], np.ones((28, 28), np.float32))
+            img = 0.7 * t + 0.3 * rng.rand(3, 224, 224)
+            yield img.astype(np.float32).ravel(), int(lbl)
+
+    return reader
+
+
+def train(use_xmap=True):
+    return _reader(512, 0, use_xmap)
+
+
+def test(use_xmap=True):
+    return _reader(128, 1, use_xmap)
+
+
+def valid(use_xmap=True):
+    return _reader(128, 2, use_xmap)

@@ -1,0 +1,56 @@
+"""MQ2007 learning-to-rank reader creators (reference
+python/paddle/dataset/mq2007.py — pairwise/listwise/pointwise modes).
+
+Pointwise: (feature float32[46], relevance int64 0..2)
+Pairwise:  (query-level (pos_feature, neg_feature))
+Listwise:  (label list, feature list) per query
+Synthetic offline: relevance = banded linear score of the features.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_N_FEAT = 46
+
+
+def _query(rng, w):
+    n_docs = rng.randint(5, 20)
+    feats = rng.rand(n_docs, _N_FEAT).astype(np.float32)
+    score = feats @ w
+    rel = np.digitize(score, np.quantile(score, [0.5, 0.85]))
+    return feats, rel.astype(np.int64)
+
+
+def _w():
+    return np.random.RandomState(55).rand(_N_FEAT).astype(np.float32)
+
+
+def _reader(n_queries, seed, format):
+    w = _w()
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_queries):
+            feats, rel = _query(rng, w)
+            if format == "pointwise":
+                for f, r in zip(feats, rel):
+                    yield f, int(r)
+            elif format == "pairwise":
+                pos = np.where(rel > 0)[0]
+                neg = np.where(rel == 0)[0]
+                for p in pos:
+                    for q in neg[: 3]:
+                        yield feats[p], feats[q]
+            else:  # listwise
+                yield [int(r) for r in rel], [f for f in feats]
+
+    return reader
+
+
+def train(format="pairwise"):
+    return _reader(400, 0, format)
+
+
+def test(format="pairwise"):
+    return _reader(100, 1, format)

@@ -1,0 +1,43 @@
+"""Movie-review sentiment reader creators (reference
+python/paddle/dataset/sentiment.py — NLTK movie_reviews polarity).
+
+Samples: (word_id list, label 0/1).  Synthetic offline: two word
+distributions with polarity-marker tokens so bag-of-words models
+separate the classes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_VOCAB = 5000
+_POS_MARKERS = np.arange(0, 200)
+_NEG_MARKERS = np.arange(200, 400)
+
+
+def get_word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = rng.randint(0, 2)
+            ln = rng.randint(10, 60)
+            base = rng.randint(400, _VOCAB, ln)
+            markers = (_POS_MARKERS if label else _NEG_MARKERS)
+            k = max(1, ln // 5)
+            idx = rng.choice(ln, k, replace=False)
+            base[idx] = rng.choice(markers, k)
+            yield [int(x) for x in base], int(label)
+
+    return reader
+
+
+def train():
+    return _reader(1600, 0)
+
+
+def test():
+    return _reader(400, 1)

@@ -1,0 +1,48 @@
+"""PASCAL VOC2012 segmentation reader creators (reference
+python/paddle/dataset/voc2012.py).
+
+Samples: (image float32[3, H, W], segmentation label int64[H, W]).
+Synthetic offline: blob masks with consistent color/label pairing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_N_CLASSES = 21
+_H = _W = 96
+
+
+def _sample(rng):
+    img = rng.rand(3, _H, _W).astype(np.float32) * 0.3
+    seg = np.zeros((_H, _W), np.int64)
+    for _ in range(rng.randint(1, 4)):
+        cls = rng.randint(1, _N_CLASSES)
+        cy, cx = rng.randint(0, _H), rng.randint(0, _W)
+        r = rng.randint(8, 24)
+        yy, xx = np.mgrid[0:_H, 0:_W]
+        mask = (yy - cy) ** 2 + (xx - cx) ** 2 < r * r
+        seg[mask] = cls
+        img[:, mask] += (cls / _N_CLASSES)
+    return np.clip(img, 0, 1), seg
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            yield _sample(rng)
+
+    return reader
+
+
+def train():
+    return _reader(256, 0)
+
+
+def test():
+    return _reader(64, 1)
+
+
+def val():
+    return _reader(64, 2)

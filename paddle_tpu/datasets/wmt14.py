@@ -1,0 +1,54 @@
+"""WMT14 en-fr reader creators (reference
+python/paddle/dataset/wmt14.py).
+
+Samples: (src_ids, trg_ids, trg_ids_next) int64 id lists with
+<s>=0, <e>=1, <unk>=2 (the reference's convention).  Synthetic offline:
+target = deterministic per-token mapping of source, so seq2seq models
+genuinely learn translation-like structure.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_DICT_SIZE = 30000
+
+
+def _mapping(dict_size):
+    rng = np.random.RandomState(99)
+    return rng.permutation(dict_size)
+
+
+def _reader(n, seed, dict_size):
+    table = _mapping(dict_size)
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            ln = rng.randint(4, 20)
+            src = rng.randint(3, dict_size, ln)
+            trg = table[src] % dict_size
+            trg = np.maximum(trg, 3)
+            src_ids = [int(x) for x in src]
+            trg_ids = [0] + [int(x) for x in trg]
+            trg_next = [int(x) for x in trg] + [1]
+            yield src_ids, trg_ids, trg_next
+
+    return reader
+
+
+def train(dict_size=_DICT_SIZE):
+    return _reader(4000, 0, dict_size)
+
+
+def test(dict_size=_DICT_SIZE):
+    return _reader(400, 1, dict_size)
+
+
+def get_dict(dict_size=_DICT_SIZE, reverse=False):
+    src = {f"s{i}": i for i in range(dict_size)}
+    trg = {f"t{i}": i for i in range(dict_size)}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

@@ -1,0 +1,28 @@
+"""WMT16 en-de reader creators (reference
+python/paddle/dataset/wmt16.py — BPE-ish ids, configurable dict sizes).
+
+Samples: (src_ids, trg_ids, trg_ids_next).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.datasets import wmt14
+
+
+def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return wmt14._reader(4000, 10, min(src_dict_size, trg_dict_size))
+
+
+def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return wmt14._reader(400, 11, min(src_dict_size, trg_dict_size))
+
+
+def validation(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return wmt14._reader(400, 12, min(src_dict_size, trg_dict_size))
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {f"{lang}{i}": i for i in range(dict_size)}
+    if reverse:
+        d = {v: k for k, v in d.items()}
+    return d

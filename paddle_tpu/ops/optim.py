@@ -317,6 +317,10 @@ def dgc_momentum(ins, attrs):
     k = max(1, int(flat.shape[0] * (1.0 - attrs["sparsity"])))
     thresh = jax.lax.top_k(flat, k)[0][-1]
     mask = (jnp.abs(v) >= thresh).astype(p.dtype)
+    if attrs["rampup_begin_step"] > 0 and "Step" not in ins:
+        raise ValueError(
+            "dgc_momentum: rampup_begin_step > 0 requires the Step "
+            "input (the optimizer wires it automatically)")
     if "Step" in ins and attrs["rampup_begin_step"] > 0:
         # dense warmup: before rampup_begin_step every component passes
         step = ins["Step"].reshape(()).astype(jnp.float32)

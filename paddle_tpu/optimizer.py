@@ -463,12 +463,17 @@ class ExponentialMovingAverage(_ParamSwapper):
             block.append_op(type="fill_constant", outputs={"Out": dv},
                            attrs={"shape": [1], "dtype": "float32",
                                   "value": self._decay},
-                           infer_shape=False)
+                           op_role=OPTIMIZE, infer_shape=False)
             return dv
-        step = _aux_counter(block, sb, f"{self._name}.step")
-        block.append_op(type="increment", inputs={"X": step},
-                        outputs={"Out": step}, attrs={"step": 1.0},
-                        op_role=OPTIMIZE, infer_shape=False)
+        if hasattr(self._thres_steps, "name"):
+            # reference semantics: the caller's global-step variable
+            # drives the ramp (correct across restarts/resume)
+            step = self._thres_steps
+        else:
+            step = _aux_counter(block, sb, f"{self._name}.step")
+            block.append_op(type="increment", inputs={"X": step},
+                            outputs={"Out": step}, attrs={"step": 1.0},
+                            op_role=OPTIMIZE, infer_shape=False)
         num = block.create_var(name=f"{self._name}.num", shape=(1,),
                                dtype="float32", stop_gradient=True)
         den = block.create_var(name=f"{self._name}.den", shape=(1,),
@@ -495,7 +500,8 @@ class ExponentialMovingAverage(_ParamSwapper):
                         op_role=OPTIMIZE, infer_shape=False)
         block.append_op(type="fill_constant", outputs={"Out": cap},
                         attrs={"shape": [1], "dtype": "float32",
-                               "value": self._decay}, infer_shape=False)
+                               "value": self._decay},
+                        op_role=OPTIMIZE, infer_shape=False)
         block.append_op(type="elementwise_min",
                         inputs={"X": ratio, "Y": cap},
                         outputs={"Out": dv},
@@ -512,7 +518,8 @@ class ExponentialMovingAverage(_ParamSwapper):
                                dtype="float32", stop_gradient=True)
         block.append_op(type="fill_constant", outputs={"Out": one},
                         attrs={"shape": [1], "dtype": "float32",
-                               "value": 1.0}, infer_shape=False)
+                               "value": 1.0},
+                        op_role=OPTIMIZE, infer_shape=False)
         decay = self._decay_var(block, sb)
         one_minus = block.create_var(name=f"{self._name}.om",
                                      shape=(1,), dtype="float32",

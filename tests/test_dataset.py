@@ -164,3 +164,41 @@ def test_mnist_synthetic_is_learnable():
                            fetch_list=[loss, acc])
             accs.append(float(a))
     assert np.mean(accs[-20:]) > 0.7, np.mean(accs[-20:])
+
+
+def test_new_canned_datasets_shapes():
+    """conll05 / wmt14 / wmt16 / sentiment / flowers / voc2012 / mq2007
+    reader creators yield reference-shaped samples."""
+    import numpy as np
+
+    from paddle_tpu.datasets import (conll05, flowers, mq2007, sentiment,
+                                     voc2012, wmt14, wmt16)
+
+    s = next(conll05.train()())
+    assert len(s) == 9 and len(s[0]) == len(s[8])
+    src, trg, trg_next = next(wmt14.train(dict_size=1000)())
+    assert trg[0] == 0 and trg_next[-1] == 1 and \
+        len(trg) == len(trg_next)
+    src16, t16, tn16 = next(wmt16.train(1000, 1000)())
+    assert len(t16) == len(tn16)
+    words, label = next(sentiment.train()())
+    assert label in (0, 1) and all(isinstance(w, int) for w in words)
+    img, lbl = next(flowers.train()())
+    assert img.shape == (3 * 224 * 224,) and 0 <= lbl < 102
+    im, seg = next(voc2012.train()())
+    assert im.shape[0] == 3 and seg.shape == im.shape[1:]
+    f, r = next(mq2007.train(format="pointwise")())
+    assert f.shape == (46,) and r in (0, 1, 2)
+    p, n = next(mq2007.train(format="pairwise")())
+    assert p.shape == n.shape == (46,)
+    labels, feats = next(mq2007.train(format="listwise")())
+    assert len(labels) == len(feats)
+    # rank signal is learnable: pos mean score > neg mean under true w
+    w = np.random.RandomState(55).rand(46)
+    pos_scores, neg_scores = [], []
+    for i, (p, n) in enumerate(mq2007.train(format="pairwise")()):
+        pos_scores.append(p @ w)
+        neg_scores.append(n @ w)
+        if i > 200:
+            break
+    assert np.mean(pos_scores) > np.mean(neg_scores)
