@@ -43,11 +43,19 @@ def main():
 
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(framework.default_startup_program())
-    compiled = fluid.CompiledProgram(
-        framework.default_main_program().clone(for_test=True))
+    infer_prog = framework.default_main_program().clone(for_test=True)
+    # bf16 weights+activations (the reference's headline fp16 mode,
+    # paddle/contrib/float16/float16_transpiler.py -> contrib.float16)
+    from paddle_tpu.contrib.float16 import bf16_transpile
+
+    bf16_transpile(infer_prog, scope=global_scope())
+    compiled = fluid.CompiledProgram(infer_prog)
+
+    import jax.numpy as jnp
 
     rng = np.random.RandomState(0)
-    img = jax.device_put(rng.rand(BATCH, 3, 224, 224).astype(np.float32))
+    img = jax.device_put(jnp.asarray(
+        rng.rand(BATCH, 3, 224, 224).astype(np.float32), jnp.bfloat16))
     lab = jax.device_put(np.zeros((BATCH, 1), np.int64))
     feed = {"image": img, "label": lab}
 
@@ -61,12 +69,13 @@ def main():
 
     # warm-up: compile + one synced step
     state, f = fn(state, feed)
-    float(np.asarray(f[0]).sum())
+    float(np.asarray(f[0].astype(jnp.float32)).sum())
 
     t0 = time.perf_counter()
     for _ in range(CHAIN):
         state, f = fn(state, feed)
-    float(np.asarray(f[0]).sum())  # single sync at the end of the chain
+    # single sync at the end of the chain
+    float(np.asarray(f[0].astype(jnp.float32)).sum())
     ms = (time.perf_counter() - t0) * 1e3 / CHAIN
 
     print(json.dumps({

@@ -5,3 +5,4 @@ Reference parity: /root/reference/python/paddle/fluid/contrib/
 
 from paddle_tpu.contrib import mixed_precision  # noqa: F401
 from paddle_tpu.contrib import slim  # noqa: F401
+from paddle_tpu.contrib import float16  # noqa: F401

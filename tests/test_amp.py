@@ -7,7 +7,7 @@ reaction to overflow.
 import numpy as np
 
 import paddle_tpu as fluid
-from paddle_tpu import layers, optimizer
+from paddle_tpu import framework, layers, optimizer
 from paddle_tpu.contrib import mixed_precision as amp
 
 
@@ -124,3 +124,32 @@ def test_overflow_step_is_noop_on_params():
             fetch_list=[loss])
     w_after = np.asarray(global_scope().find_var(w_name).get())
     np.testing.assert_allclose(w_before, w_after)
+
+
+def test_bf16_inference_transpiler():
+    """contrib.float16.bf16_transpile (reference
+    float16_transpiler.py): casts program + scope to bf16; outputs stay
+    close to the fp32 run."""
+    from paddle_tpu.contrib.float16 import bf16_transpile
+    from paddle_tpu.core.scope import global_scope
+
+    np.random.seed(0)
+    img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+    h = layers.conv2d(img, 8, 3, padding=1, act="relu")
+    h = layers.batch_norm(h, is_test=True)
+    logits = layers.fc(layers.flatten(h, axis=1), 5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    x = np.random.rand(4, 3, 8, 8).astype(np.float32)
+    (ref,) = exe.run(framework.default_main_program(),
+                     feed={"img": x}, fetch_list=[logits])
+    infer = framework.default_main_program().clone(for_test=True)
+    bf16_transpile(infer, scope=global_scope())
+    (out,) = exe.run(infer, feed={"img": x}, fetch_list=[logits])
+    assert out.dtype.name == "bfloat16"
+    np.testing.assert_allclose(out.astype(np.float32), ref, atol=0.1,
+                               rtol=0.05)
+    (out2,) = exe.run(fluid.CompiledProgram(infer), feed={"img": x},
+                      fetch_list=[logits])
+    np.testing.assert_allclose(out2.astype(np.float32), ref, atol=0.1,
+                               rtol=0.05)
