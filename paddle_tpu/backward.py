@@ -109,7 +109,10 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         if not has_op_def(op.type):
             continue
         op_def = get_op_def(op.type)
-        if not op_def.differentiable or op_def.host_only:
+        # host-only ops participate only when they bring their own grad
+        # maker (e.g. py_func with a backward_func)
+        if not op_def.differentiable or (
+                op_def.host_only and op_def.grad_maker is None):
             continue
         # does any output carry gradient?
         out_has_grad = {

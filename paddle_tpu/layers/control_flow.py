@@ -585,3 +585,29 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, seq_len=None,
 
 
 __all__ += ["dynamic_gru", "dynamic_lstm"]
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Host-python op (reference layers/nn.py py_func).  `out`: a var or
+    list of pre-created vars (layers.create_tensor-style) describing the
+    outputs; host-only (interpreter path).  backward_func, if given, is
+    called as backward_func(*inputs, *output_grads) and must return the
+    input gradients (reference py_func grad contract)."""
+    from paddle_tpu.ops.control_flow import register_py_func
+
+    helper = LayerHelper("py_func")
+    fid = register_py_func(func)
+    bid = register_py_func(backward_func) if backward_func else -1
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else (
+        [out] if out is not None else [])
+    helper.block.append_op(
+        type="py_func", inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs={"func_id": fid, "backward_func_id": bid},
+        infer_shape=False)
+    return out
+
+
+__all__ += ["py_func"]

@@ -347,3 +347,80 @@ def gather_tree(ins, attrs):
 
     _, outs = lax.scan(body, init, (ids, parents), reverse=True)
     return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# py_func escape hatch
+# ---------------------------------------------------------------------------
+
+_PY_FUNC_REGISTRY: list = []
+
+
+def register_py_func(fn) -> int:
+    """Returns the id used by the py_func op's func_id attr (reference
+    py_func_op.cc keeps a python-callable registry the same way)."""
+    _PY_FUNC_REGISTRY.append(fn)
+    return len(_PY_FUNC_REGISTRY) - 1
+
+
+def _py_func_grad_maker(op, grad_out_slots, block, grad_map,
+                        no_grad_set=frozenset()):
+    """When a backward_func was registered, emit a py_func grad op
+    running backward_func(*fwd_inputs, *out_grads) -> input grads
+    (reference py_func_op.cc grad maker)."""
+    if op.attrs.get("backward_func_id", -1) < 0:
+        return []
+    from paddle_tpu.backward import (_create_grad_var, _grad_name,
+                                     _needs_grad)
+    from paddle_tpu.core.program import OpDesc
+    from paddle_tpu import unique_name
+
+    fwd_in = list(op.inputs.get("X", []))
+    g_outs = grad_out_slots.get("Out@GRAD", [])
+    gnames = []
+    any_needed = False
+    for n in fwd_in:
+        if _needs_grad(block, n, no_grad_set):
+            any_needed = True
+        g = (_grad_name(n) if n not in grad_map
+             else _grad_name(n, "@" + unique_name.generate("p")))
+        gnames.append(g)
+    if not any_needed or not g_outs:
+        return []
+    for n, g in zip(fwd_in, gnames):
+        _create_grad_var(block, n, g)
+        if _needs_grad(block, n, no_grad_set):
+            grad_map.setdefault(n, []).append(g)
+    return [OpDesc("py_func", {"X": fwd_in + g_outs},
+                   {"Out": gnames},
+                   {"func_id": op.attrs["backward_func_id"],
+                    "backward_func_id": -1})]
+
+
+register_op("py_func", inputs=("X",), outputs=("Out",),
+            duplicable=("X", "Out"), optional=("X", "Out"),
+            attrs={"func_id": REQUIRED, "backward_func_id": -1},
+            grad_maker=_py_func_grad_maker,
+            differentiable=True, host_only=True)(
+    lambda ins, attrs: (_ for _ in ()).throw(
+        RuntimeError("py_func runs via the executor (host op)")))
+
+
+@register_special_op("py_func")
+def py_func_op(op, block, scope, ctx):
+    """Host-python escape hatch (reference operators/py_func_op.cc):
+    runs an arbitrary python callable over numpy inputs.  Host-only by
+    nature — the compiled executor refuses it (keep py_func out of the
+    jitted path; use it for IO/debug/metrics glue)."""
+    fn = _PY_FUNC_REGISTRY[op.attrs["func_id"]]
+    ins = [np.asarray(scope.find_var(n).get())
+           for n in op.inputs.get("X", [])]
+    outs = fn(*ins)
+    if outs is None:
+        outs = []
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    import jax.numpy as jnp
+
+    for name, val in zip(op.outputs.get("Out", []), outs):
+        scope.var(name).set(jnp.asarray(np.asarray(val)))

@@ -12,12 +12,11 @@ import jax.numpy as jnp
 from paddle_tpu.core.registry import REQUIRED, register_op
 
 
-@register_op("iou_similarity", inputs=("X", "Y"), outputs=("Out",),
-             attrs={"box_normalized": True})
-def iou_similarity(ins, attrs):
-    """X: [N,4], Y: [M,4] (xmin,ymin,xmax,ymax) -> [N,M] IoU."""
-    x, y = ins["X"], ins["Y"]
-    off = 0.0 if attrs["box_normalized"] else 1.0
+def _pairwise_iou(x, y, normalized=True):
+    """x: [N,4], y: [M,4] (xmin,ymin,xmax,ymax) -> [N,M] IoU.  With
+    normalized=False the reference adds a +1 pixel offset to widths
+    and heights (multiclass_nms_op.cc:113-146 BBoxArea/JaccardOverlap)."""
+    off = 0.0 if normalized else 1.0
     ax = (x[:, 2] - x[:, 0] + off) * (x[:, 3] - x[:, 1] + off)
     ay = (y[:, 2] - y[:, 0] + off) * (y[:, 3] - y[:, 1] + off)
     xmin = jnp.maximum(x[:, None, 0], y[None, :, 0])
@@ -27,7 +26,14 @@ def iou_similarity(ins, attrs):
     iw = jnp.maximum(xmax - xmin + off, 0.0)
     ih = jnp.maximum(ymax - ymin + off, 0.0)
     inter = iw * ih
-    return {"Out": inter / (ax[:, None] + ay[None, :] - inter + 1e-10)}
+    return inter / (ax[:, None] + ay[None, :] - inter + 1e-10)
+
+
+@register_op("iou_similarity", inputs=("X", "Y"), outputs=("Out",),
+             attrs={"box_normalized": True})
+def iou_similarity(ins, attrs):
+    return {"Out": _pairwise_iou(ins["X"], ins["Y"],
+                                 attrs["box_normalized"])}
 
 
 @register_op("box_coder", inputs=("PriorBox", "PriorBoxVar", "TargetBox"),
@@ -142,3 +148,316 @@ def yolo_box(ins, attrs):
     boxes = boxes.reshape(n, -1, 4)
     scores = (prob * mask[:, :, None]).transpose(0, 1, 3, 4, 2)
     return {"Boxes": boxes, "Scores": scores.reshape(n, -1, nc)}
+
+
+@register_op("box_clip", inputs=("Input", "ImInfo"), outputs=("Output",))
+def box_clip(ins, attrs):
+    """Clip boxes to image bounds (reference box_clip_op.cc).
+    Input: [..., 4]; ImInfo: [N, 3] (h, w, scale)."""
+    boxes = ins["Input"]
+    im = ins["ImInfo"]
+    h = (im[:, 0] / im[:, 2]) - 1.0
+    w = (im[:, 1] / im[:, 2]) - 1.0
+    shape = (-1,) + (1,) * (boxes.ndim - 2)
+    xmin = jnp.clip(boxes[..., 0], 0.0, w.reshape(shape))
+    ymin = jnp.clip(boxes[..., 1], 0.0, h.reshape(shape))
+    xmax = jnp.clip(boxes[..., 2], 0.0, w.reshape(shape))
+    ymax = jnp.clip(boxes[..., 3], 0.0, h.reshape(shape))
+    return {"Output": jnp.stack([xmin, ymin, xmax, ymax], axis=-1)}
+
+
+@register_op("sigmoid_focal_loss", inputs=("X", "Label", "FgNum"),
+             outputs=("Out",), optional=("FgNum",),
+             attrs={"gamma": 2.0, "alpha": 0.25})
+def sigmoid_focal_loss(ins, attrs):
+    """RetinaNet focal loss (reference sigmoid_focal_loss_op.cc).
+    X: [N, C] logits; Label: [N, 1] in [0, C] (0 = background)."""
+    x = ins["X"].astype(jnp.float32)
+    label = ins["Label"].reshape(-1)
+    n, c = x.shape
+    fg = ins.get("FgNum")
+    fg = jnp.maximum(fg.reshape(()).astype(jnp.float32), 1.0) \
+        if fg is not None else 1.0
+    gamma, alpha = attrs["gamma"], attrs["alpha"]
+    # one-hot with class c meaning label-1 (0 is background)
+    t = (label[:, None] == jnp.arange(1, c + 1)[None, :]).astype(
+        jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = jax.nn.softplus(-x) * t + jax.nn.softplus(x) * (1 - t)
+    pt = p * t + (1 - p) * (1 - t)
+    at = alpha * t + (1 - alpha) * (1 - t)
+    return {"Out": at * (1 - pt) ** gamma * ce / fg}
+
+
+@register_op("anchor_generator", inputs=("Input",),
+             outputs=("Anchors", "Variances"),
+             attrs={"anchor_sizes": REQUIRED, "aspect_ratios": REQUIRED,
+                    "variances": [0.1, 0.1, 0.2, 0.2],
+                    "stride": REQUIRED, "offset": 0.5})
+def anchor_generator(ins, attrs):
+    """Dense anchors over the feature map (reference
+    anchor_generator_op.cc).  Input: [N, C, H, W] ->
+    Anchors [H, W, A, 4] (xmin,ymin,xmax,ymax, image coords)."""
+    _, _, h, w = ins["Input"].shape
+    sizes = jnp.asarray(attrs["anchor_sizes"], jnp.float32)
+    ratios = jnp.asarray(attrs["aspect_ratios"], jnp.float32)
+    sw, sh = attrs["stride"]
+    off = attrs["offset"]
+    # reference anchor_generator_op.h:55,75: centers at
+    # w*stride + offset*(stride-1); extents 0.5*(anchor_dim-1) with
+    # rounded base widths/heights
+    cx = jnp.arange(w) * sw + off * (sw - 1)
+    cy = jnp.arange(h) * sh + off * (sh - 1)
+    r = jnp.sqrt(ratios)
+    area = sizes[None, :] ** 2
+    ws = jnp.round(jnp.sqrt(area / ratios[:, None])).reshape(-1)  # [A]
+    hs = jnp.round(ws.reshape(ratios.shape[0], -1)
+                   * ratios[:, None]).reshape(-1)
+    del r
+    grid_cx = jnp.broadcast_to(cx[None, :, None], (h, w, ws.shape[0]))
+    grid_cy = jnp.broadcast_to(cy[:, None, None], (h, w, ws.shape[0]))
+    anchors = jnp.stack(
+        [grid_cx - 0.5 * (ws - 1), grid_cy - 0.5 * (hs - 1),
+         grid_cx + 0.5 * (ws - 1), grid_cy + 0.5 * (hs - 1)],
+        axis=-1)
+    var = jnp.broadcast_to(
+        jnp.asarray(attrs["variances"], jnp.float32),
+        anchors.shape)
+    return {"Anchors": anchors, "Variances": var}
+
+
+@register_op("density_prior_box", inputs=("Input", "Image"),
+             outputs=("Boxes", "Variances"),
+             attrs={"densities": REQUIRED, "fixed_sizes": REQUIRED,
+                    "fixed_ratios": [1.0],
+                    "variances": [0.1, 0.1, 0.2, 0.2],
+                    "clip": False, "step_w": 0.0, "step_h": 0.0,
+                    "offset": 0.5})
+def density_prior_box(ins, attrs):
+    """Densified SSD priors (reference density_prior_box_op.cc)."""
+    _, _, h, w = ins["Input"].shape
+    _, _, img_h, img_w = ins["Image"].shape
+    step_w = attrs["step_w"] or img_w / w
+    step_h = attrs["step_h"] or img_h / h
+    off = attrs["offset"]
+    # reference density_prior_box_op.h:91-101: sub-centers spread over
+    # the STEP cell (spacing step_average/density), not over the box
+    step_average = int((step_w + step_h) * 0.5)
+    boxes_per_cell = []
+    for density, size in zip(attrs["densities"], attrs["fixed_sizes"]):
+        for ratio in attrs["fixed_ratios"]:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            shift = step_average / density
+            for di in range(density):
+                for dj in range(density):
+                    cx_off = -step_average / 2.0 + shift / 2.0 \
+                        + dj * shift
+                    cy_off = -step_average / 2.0 + shift / 2.0 \
+                        + di * shift
+                    boxes_per_cell.append((cx_off, cy_off, bw, bh))
+    cx = (jnp.arange(w) + off) * step_w
+    cy = (jnp.arange(h) + off) * step_h
+    grid_cx = jnp.broadcast_to(cx[None, :, None],
+                               (h, w, len(boxes_per_cell)))
+    grid_cy = jnp.broadcast_to(cy[:, None, None],
+                               (h, w, len(boxes_per_cell)))
+    offs = jnp.asarray(boxes_per_cell, jnp.float32)    # [K, 4]
+    bx = grid_cx + offs[None, None, :, 0]
+    by = grid_cy + offs[None, None, :, 1]
+    bw = offs[None, None, :, 2]
+    bh = offs[None, None, :, 3]
+    boxes = jnp.stack([(bx - bw / 2.0) / img_w, (by - bh / 2.0) / img_h,
+                       (bx + bw / 2.0) / img_w, (by + bh / 2.0) / img_h],
+                      axis=-1)
+    if attrs["clip"]:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(attrs["variances"], jnp.float32),
+                           boxes.shape)
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("target_assign",
+             inputs=("X", "MatchIndices", "NegIndices"),
+             outputs=("Out", "OutWeight"), optional=("NegIndices",),
+             attrs={"mismatch_value": 0})
+def target_assign(ins, attrs):
+    """Assign per-prior targets by match indices (reference
+    target_assign_op.cc).  X: [N, M, K] gt-entity features;
+    MatchIndices: [N, P] (-1 = unmatched) -> Out [N, P, K]."""
+    x, match = ins["X"], ins["MatchIndices"]
+    n, p = match.shape
+    safe = jnp.maximum(match, 0)
+    batch = jnp.arange(n)[:, None]
+    out = x[batch, safe]                              # [N, P, K]
+    matched = (match >= 0)
+    out = jnp.where(matched[..., None], out,
+                    jnp.asarray(attrs["mismatch_value"], x.dtype))
+    weight = matched.astype(jnp.float32)[..., None]
+    neg = ins.get("NegIndices")
+    if neg is not None:
+        # reference NegTargetAssignFunctor (target_assign_op.h:59-72):
+        # negatives get out=mismatch_value, weight=1
+        neg = neg.reshape(n, -1)
+        valid = neg >= 0
+        neg_safe = jnp.maximum(neg, 0)
+        is_neg = jnp.zeros((n, p), bool).at[batch, neg_safe].set(
+            valid, mode="drop")
+        out = jnp.where(is_neg[..., None],
+                        jnp.asarray(attrs["mismatch_value"], x.dtype),
+                        out)
+        weight = jnp.where(is_neg[..., None], 1.0, weight)
+    return {"Out": out, "OutWeight": weight}
+
+
+def _nms_single(boxes, scores, iou_thresh, score_thresh, keep_k,
+                normalized=True, eta=1.0):
+    """Jittable NMS for one class: returns (keep_mask, order,
+    top_scores) with a static keep_k budget.  eta < 1 shrinks the
+    threshold after each kept box (reference NMSFast adaptive
+    threshold, multiclass_nms_op.cc)."""
+    k = min(keep_k, scores.shape[0])
+    top_scores, order = jax.lax.top_k(scores, k)
+    cand = boxes[order]                               # [k, 4]
+    iou = _pairwise_iou(cand, cand, normalized)
+
+    def body(i, carry):
+        keep, thresh = carry
+        suppressed = jnp.any(
+            jnp.where(jnp.arange(k) < i, iou[i] > thresh, False) & keep)
+        keep = keep.at[i].set(~suppressed)
+        if eta < 1.0:
+            thresh = jnp.where(~suppressed & (thresh > 0.5),
+                               thresh * eta, thresh)
+        return keep, thresh
+
+    keep = jnp.ones(k, bool)
+    keep, _ = jax.lax.fori_loop(
+        1, k, body, (keep, jnp.asarray(iou_thresh, jnp.float32)))
+    keep = keep & (top_scores > score_thresh)
+    return keep, order, top_scores
+
+
+@register_op("multiclass_nms", inputs=("BBoxes", "Scores"),
+             outputs=("Out",),
+             attrs={"score_threshold": 0.01, "nms_top_k": 64,
+                    "nms_threshold": 0.3, "keep_top_k": 32,
+                    "background_label": 0, "normalized": True,
+                    "nms_eta": 1.0})
+def multiclass_nms(ins, attrs):
+    """Per-class NMS with fixed output budget (reference
+    multiclass_nms_op.cc emits a LoD tensor of variable detections; the
+    TPU re-spec emits a static [N, keep_top_k, 6] tensor
+    (class, score, x1, y1, x2, y2) padded with class=-1 rows).
+    BBoxes: [N, M, 4]; Scores: [N, C, M]."""
+    bboxes, scores = ins["BBoxes"], ins["Scores"]
+    n, c, m = scores.shape
+    keep_k = attrs["keep_top_k"]
+    nms_k = min(attrs["nms_top_k"], m)
+
+    def per_image(boxes_i, scores_i):
+        all_cls = []
+        for cls in range(c):
+            if cls == attrs["background_label"]:
+                continue
+            keep, order, top_s = _nms_single(
+                boxes_i, scores_i[cls], attrs["nms_threshold"],
+                attrs["score_threshold"], nms_k,
+                normalized=attrs["normalized"], eta=attrs["nms_eta"])
+            sel_boxes = boxes_i[order]
+            cls_col = jnp.full((order.shape[0], 1), float(cls))
+            det = jnp.concatenate(
+                [cls_col, top_s[:, None], sel_boxes], axis=1)
+            det = jnp.where(keep[:, None], det,
+                            jnp.full_like(det, -1.0))
+            all_cls.append(det)
+        dets = jnp.concatenate(all_cls, axis=0)
+        # keep_top_k overall by score (invalid rows have score -1)
+        k = min(keep_k, dets.shape[0])
+        _, idx = jax.lax.top_k(dets[:, 1], k)
+        out = dets[idx]
+        if k < keep_k:
+            out = jnp.pad(out, ((0, keep_k - k), (0, 0)),
+                          constant_values=-1.0)
+        return out
+
+    # one traced program, vmapped over the batch (the per-class python
+    # loop stays: classes need distinct score slices anyway)
+    return {"Out": jax.vmap(per_image)(bboxes, scores)}
+
+
+def _roi_sample(feat, roi, out_h, out_w, spatial_scale, align):
+    """feat: [C, H, W]; roi: [4] (x1, y1, x2, y2)."""
+    c, h, w = feat.shape
+    x1, y1, x2, y2 = [roi[i] * spatial_scale for i in range(4)]
+    if align:
+        roi_w = jnp.maximum(x2 - x1, 1.0)
+        roi_h = jnp.maximum(y2 - y1, 1.0)
+        ys = y1 + (jnp.arange(out_h) + 0.5) * roi_h / out_h - 0.5
+        xs = x1 + (jnp.arange(out_w) + 0.5) * roi_w / out_w - 0.5
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        wy = jnp.clip(ys - y0, 0.0, 1.0)
+        wx = jnp.clip(xs - x0, 0.0, 1.0)
+        f00 = feat[:, y0i][:, :, x0i]
+        f01 = feat[:, y0i][:, :, x1i]
+        f10 = feat[:, y1i][:, :, x0i]
+        f11 = feat[:, y1i][:, :, x1i]
+        top = f00 * (1 - wx)[None, None, :] + f01 * wx[None, None, :]
+        bot = f10 * (1 - wx)[None, None, :] + f11 * wx[None, None, :]
+        return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+    # roi_pool: MAX over each integer bin (reference roi_pool_op.h
+    # hstart..hend x wstart..wend), via bin-membership masks
+    x1i = jnp.round(x1).astype(jnp.int32)
+    y1i = jnp.round(y1).astype(jnp.int32)
+    x2i = jnp.round(x2).astype(jnp.int32)
+    y2i = jnp.round(y2).astype(jnp.int32)
+    roi_w = jnp.maximum(x2i - x1i + 1, 1)
+    roi_h = jnp.maximum(y2i - y1i + 1, 1)
+    bin_h = roi_h / out_h
+    bin_w = roi_w / out_w
+    rows = jnp.arange(h)
+    cols = jnp.arange(w)
+    # row r belongs to bin i iff floor((r-y1)/bin_h) == i within roi
+    def bin_mask(coords, start, extent, bins, bin_sz):
+        rel = coords[None, :] - start
+        lo = jnp.floor(jnp.arange(bins)[:, None] * bin_sz)
+        hi = jnp.ceil((jnp.arange(bins)[:, None] + 1) * bin_sz)
+        return (rel >= lo) & (rel < hi) & (rel >= 0) & (rel < extent)
+
+    row_m = bin_mask(rows, y1i, roi_h, out_h, bin_h)   # [oh, H]
+    col_m = bin_mask(cols, x1i, roi_w, out_w, bin_w)   # [ow, W]
+    mask = row_m[:, None, :, None] & col_m[None, :, None, :]
+    neg = jnp.asarray(-3.4e38, feat.dtype)
+    expanded = jnp.where(mask[None], feat[:, None, None, :, :], neg)
+    out = jnp.max(expanded, axis=(3, 4))               # [C, oh, ow]
+    return jnp.where(jnp.any(mask, axis=(2, 3))[None], out, 0.0)
+
+
+def _register_roi(name, align):
+    @register_op(name, inputs=("X", "ROIs", "RoisBatchIdx"),
+                 outputs=("Out",), optional=("RoisBatchIdx",),
+                 attrs={"pooled_height": REQUIRED,
+                        "pooled_width": REQUIRED,
+                        "spatial_scale": 1.0, "sampling_ratio": -1})
+    def _fn(ins, attrs, align=align):
+        """reference roi_align_op.cc / roi_pool_op.cc.  X: [N, C, H, W];
+        ROIs: [R, 4]; RoisBatchIdx: [R] image index per roi."""
+        x, rois = ins["X"], ins["ROIs"]
+        batch_idx = ins.get("RoisBatchIdx")
+        if batch_idx is None:
+            batch_idx = jnp.zeros(rois.shape[0], jnp.int32)
+        feats = x[batch_idx]                          # [R, C, H, W]
+        fn = lambda f, r: _roi_sample(
+            f, r, attrs["pooled_height"], attrs["pooled_width"],
+            attrs["spatial_scale"], align)
+        return {"Out": jax.vmap(fn)(feats, rois.astype(jnp.float32))}
+
+    return _fn
+
+
+_register_roi("roi_align", True)
+_register_roi("roi_pool", False)

@@ -159,3 +159,111 @@ def sequence_erase(ins, attrs):
     out = jnp.where(jnp.arange(x.shape[1])[None, :] < new_len[:, None],
                     out, 0)
     return {"Out": out, "SeqLenOut": new_len}
+
+
+@register_op("sequence_conv", inputs=("X", "Filter", "SeqLen"),
+             outputs=("Out",), optional=("SeqLen",),
+             attrs={"contextLength": REQUIRED, "contextStart": None,
+                    "contextStride": 1})
+def sequence_conv(ins, attrs):
+    """Context-window convolution over the time axis (reference
+    sequence_conv_op.cc: im2col over the sequence then GEMM).
+    X: [N, T, D]; Filter: [ctx_len * D, out_dim]."""
+    x = ins["X"]
+    w = ins["Filter"]
+    ctx_len = attrs["contextLength"]
+    start = attrs["contextStart"]
+    if start is None:
+        start = -(ctx_len // 2)
+    n, t, d = x.shape
+    cols = []
+    for k in range(ctx_len):
+        off = start + k
+        shifted = jnp.roll(x, -off, axis=1)
+        if off > 0:        # positions reading past the end -> 0
+            m = jnp.arange(t)[None, :, None] < (t - off)
+        elif off < 0:
+            m = jnp.arange(t)[None, :, None] >= (-off)
+        else:
+            m = None
+        cols.append(shifted * m if m is not None else shifted)
+    col = jnp.concatenate(cols, axis=-1)        # [N, T, ctx*D]
+    out = jnp.einsum("ntc,co->nto", col, w)
+    if "SeqLen" in ins:
+        out = out * _mask(out, ins["SeqLen"])
+    return {"Out": out}
+
+
+@register_op("sequence_expand_as", inputs=("X", "Y", "YSeqLen"),
+             outputs=("Out",), optional=("YSeqLen",), attrs={})
+def sequence_expand_as(ins, attrs):
+    """Expand each row of X along a new time axis to match Y's T
+    (reference sequence_expand_as_op.cc: per-sequence broadcast)."""
+    x, y = ins["X"], ins["Y"]
+    t = y.shape[1]
+    out = jnp.broadcast_to(x[:, None, ...], (x.shape[0], t) + x.shape[1:])
+    if "YSeqLen" in ins:
+        out = out * _mask(out, ins["YSeqLen"]).astype(out.dtype)
+    return {"Out": out}
+
+
+@register_op("sequence_pad", inputs=("X", "SeqLen", "PadValue"),
+             outputs=("Out", "Length"), optional=("PadValue",),
+             attrs={"padded_length": -1})
+def sequence_pad(ins, attrs):
+    """Re-pad a padded batch to a given length with a pad value
+    (reference sequence_pad_op.cc, LoD->padded; here padded->padded with
+    explicit value/length)."""
+    x, seq_len = ins["X"], ins["SeqLen"]
+    pad_val = ins.get("PadValue", jnp.zeros((), x.dtype))
+    target = attrs["padded_length"]
+    t = x.shape[1]
+    if target > t:
+        widths = [(0, 0), (0, target - t)] + [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, widths)
+    elif 0 < target < t:
+        x = x[:, :target]
+    m = _mask(x, seq_len)
+    out = jnp.where(m, x, jnp.asarray(pad_val, x.dtype).reshape(
+        (1,) * x.ndim))
+    return {"Out": out, "Length": seq_len}
+
+
+@register_op("sequence_unpad", inputs=("X", "Length"), outputs=("Out",))
+def sequence_unpad(ins, attrs):
+    """Zero out positions past each row's Length (reference
+    sequence_unpad_op.cc emits a LoD tensor; the padded analog keeps the
+    static shape and re-masks)."""
+    x = ins["X"]
+    return {"Out": x * _mask(x, ins["Length"]).astype(x.dtype)}
+
+
+@register_op("sequence_reshape", inputs=("X", "SeqLen"),
+             outputs=("Out", "OutSeqLen"), optional=("SeqLen",),
+             attrs={"new_dim": REQUIRED})
+def sequence_reshape(ins, attrs):
+    """Refold the time/feature axes so the feature dim becomes new_dim
+    (reference sequence_reshape_op.cc)."""
+    x = ins["X"]
+    n, t, d = x.shape
+    new_dim = attrs["new_dim"]
+    new_t = t * d // new_dim
+    out = x.reshape(n, new_t, new_dim)
+    res = {"Out": out}
+    if "SeqLen" in ins:
+        res["OutSeqLen"] = (ins["SeqLen"] * d) // new_dim
+    else:
+        res["OutSeqLen"] = jnp.full((n,), new_t, jnp.int32)
+    return res
+
+
+@register_op("sequence_scatter", inputs=("X", "Ids", "Updates"),
+             outputs=("Out",))
+def sequence_scatter(ins, attrs):
+    """Scatter per-sequence updates into X at time indices Ids
+    (reference sequence_scatter_op.cc).  X: [N, T, ...] or [N, T];
+    Ids/Updates: [N, K]."""
+    x, ids, upd = ins["X"], ins["Ids"], ins["Updates"]
+    n = x.shape[0]
+    batch_idx = jnp.arange(n)[:, None]
+    return {"Out": x.at[batch_idx, ids].add(upd.astype(x.dtype))}

@@ -1,0 +1,178 @@
+"""New detection + sequence ops + py_func (reference OpTest pattern:
+numpy brute-force references)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, layers
+from paddle_tpu.core.registry import get_op_def
+
+
+def _run(op, ins, attrs=None):
+    op_def = get_op_def(op)
+    return op_def.compute(
+        {k: jnp.asarray(v) for k, v in ins.items()},
+        op_def.canonical_attrs(attrs or {}))
+
+
+def test_sequence_conv_matches_manual():
+    rng = np.random.RandomState(0)
+    n, t, d, out_d, ctx = 2, 5, 3, 4, 3
+    x = rng.randn(n, t, d).astype(np.float32)
+    w = rng.randn(ctx * d, out_d).astype(np.float32)
+    out = np.asarray(_run("sequence_conv", {"X": x, "Filter": w},
+                          {"contextLength": ctx, "contextStart": -1,
+                           "contextStride": 1})["Out"])
+    ref = np.zeros((n, t, out_d), np.float32)
+    padded = np.pad(x, ((0, 0), (1, 1), (0, 0)))
+    for i in range(t):
+        col = padded[:, i:i + ctx].reshape(n, -1)
+        ref[:, i] = col @ w
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    sl = np.asarray([2, 4])
+    padded = _run("sequence_pad",
+                  {"X": x, "SeqLen": sl, "PadValue": np.float32(-1)},
+                  {"padded_length": 6})
+    out = np.asarray(padded["Out"])
+    assert out.shape == (2, 6, 3)
+    assert (out[0, 2:] == -1).all() and (out[1, 4:] == -1).all()
+    np.testing.assert_array_equal(out[0, :2], x[0, :2])
+    un = np.asarray(_run("sequence_unpad",
+                         {"X": out, "Length": sl}, {})["Out"])
+    assert (un[0, 2:] == 0).all()
+    np.testing.assert_array_equal(un[1, :4], x[1, :4])
+
+
+def test_sequence_reshape_and_scatter_and_expand_as():
+    x = np.arange(12, dtype=np.float32).reshape(1, 2, 6)
+    out = _run("sequence_reshape", {"X": x},
+               {"new_dim": 3})
+    assert np.asarray(out["Out"]).shape == (1, 4, 3)
+    sx = np.zeros((2, 5), np.float32)
+    ids = np.asarray([[0, 2], [1, 3]])
+    upd = np.ones((2, 2), np.float32)
+    sc = np.asarray(_run("sequence_scatter",
+                         {"X": sx, "Ids": ids, "Updates": upd},
+                         {})["Out"])
+    assert sc[0, 0] == 1 and sc[0, 2] == 1 and sc[1, 1] == 1
+    ea = np.asarray(_run("sequence_expand_as",
+                         {"X": np.asarray([[1.0], [2.0]], np.float32),
+                          "Y": np.zeros((2, 3, 1), np.float32)},
+                         {})["Out"])
+    assert ea.shape == (2, 3, 1) and (ea[1] == 2).all()
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    boxes = np.asarray([[
+        [0, 0, 10, 10], [1, 1, 11, 11],      # heavy overlap
+        [50, 50, 60, 60], [100, 100, 110, 110],
+    ]], np.float32)
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.05]     # class 1
+    out = np.asarray(_run("multiclass_nms",
+                          {"BBoxes": boxes, "Scores": scores},
+                          {"score_threshold": 0.1, "nms_top_k": 4,
+                           "nms_threshold": 0.3, "keep_top_k": 4,
+                           "background_label": 0, "normalized": True,
+                           "nms_eta": 1.0})["Out"])
+    valid = out[0][out[0, :, 0] >= 0]
+    # box 1 suppressed by box 0; box 3 under score threshold
+    assert valid.shape[0] == 2
+    np.testing.assert_allclose(sorted(valid[:, 1]), [0.7, 0.9],
+                               atol=1e-6)
+
+
+def test_roi_align_and_pool_shapes_and_values():
+    x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+    rois = np.asarray([[0, 0, 3, 3]], np.float32)
+    out = np.asarray(_run("roi_pool", {"X": x, "ROIs": rois},
+                          {"pooled_height": 2, "pooled_width": 2,
+                           "spatial_scale": 1.0})["Out"])
+    assert out.shape == (1, 2, 2, 2)
+    # max pooling over 2x2 bins of the 4x4 map
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+    al = np.asarray(_run("roi_align", {"X": x, "ROIs": rois},
+                         {"pooled_height": 2, "pooled_width": 2,
+                          "spatial_scale": 1.0})["Out"])
+    assert al.shape == (1, 2, 2, 2) and np.isfinite(al).all()
+
+
+def test_anchor_generator_and_box_clip():
+    x = np.zeros((1, 8, 2, 3), np.float32)
+    out = _run("anchor_generator", {"Input": x},
+               {"anchor_sizes": [32.0], "aspect_ratios": [1.0],
+                "stride": [16.0, 16.0], "offset": 0.5})
+    anchors = np.asarray(out["Anchors"])
+    assert anchors.shape == (2, 3, 1, 4)
+    # reference convention: center = 0.5*(stride-1) = 7.5, extents
+    # 0.5*(32-1) -> [-8, -8, 23, 23] (anchor_generator_op.h:55,75)
+    np.testing.assert_allclose(anchors[0, 0, 0], [-8, -8, 23, 23])
+    clipped = np.asarray(_run(
+        "box_clip",
+        {"Input": anchors.reshape(1, -1, 4),
+         "ImInfo": np.asarray([[20.0, 30.0, 1.0]], np.float32)},
+        {})["Output"])
+    assert clipped.min() >= 0 and clipped[..., 2].max() <= 29
+
+
+def test_sigmoid_focal_loss_reduces_easy_examples():
+    x = np.asarray([[5.0, -5.0], [0.0, 0.0]], np.float32)
+    label = np.asarray([[1], [2]], np.int64)
+    out = np.asarray(_run("sigmoid_focal_loss",
+                          {"X": x, "Label": label},
+                          {"gamma": 2.0, "alpha": 0.25})["Out"])
+    # confident-correct (x=5, label=1) must contribute far less than
+    # the uncertain example
+    assert out[0, 0] < out[1, 1]
+    assert np.isfinite(out).all()
+
+
+def test_target_assign():
+    x = np.arange(12, dtype=np.float32).reshape(1, 3, 4)
+    match = np.asarray([[1, -1, 0]])
+    out = _run("target_assign", {"X": x, "MatchIndices": match},
+               {"mismatch_value": 0})
+    o = np.asarray(out["Out"])
+    w = np.asarray(out["OutWeight"])
+    np.testing.assert_array_equal(o[0, 0], x[0, 1])
+    assert (o[0, 1] == 0).all() and w[0, 1, 0] == 0 and w[0, 0, 0] == 1
+
+
+def test_py_func_host_escape_hatch():
+    x = layers.data("x", shape=[4], dtype="float32")
+    out = layers.create_tensor("float32")
+    layers.py_func(lambda a: a * 2 + 1, x, out=out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    xv = np.arange(4, dtype=np.float32).reshape(1, 4)
+    (r,) = exe.run(framework.default_main_program(),
+                   feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(r, xv * 2 + 1)
+
+
+def test_py_func_backward_func():
+    from paddle_tpu import optimizer
+
+    x = layers.data("x", shape=[3], dtype="float32",
+                    stop_gradient=False)
+    out = layers.create_tensor("float32")
+    layers.py_func(lambda a: a * a, x, out=out,
+                   backward_func=lambda a, g: 2.0 * a * g)
+    out.shape = (-1, 3)
+    out.stop_gradient = False
+    loss = layers.mean(out)
+    from paddle_tpu.backward import append_backward
+
+    append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    xv = np.asarray([[1.0, 2.0, 3.0]], np.float32)
+    g, = exe.run(framework.default_main_program(), feed={"x": xv},
+                 fetch_list=["x@GRAD"])
+    np.testing.assert_allclose(g, 2 * xv / 3.0, rtol=1e-5)
