@@ -7,3 +7,5 @@ from paddle_tpu.layers.learning_rate_scheduler import *  # noqa: F401,F403
 from paddle_tpu.layers import sequence_ops  # noqa: F401
 from paddle_tpu.layers.sequence_ops import *  # noqa: F401,F403
 from paddle_tpu.layers import distributions  # noqa: F401
+from paddle_tpu.layers import detection  # noqa: F401
+from paddle_tpu.layers.detection import *  # noqa: F401,F403

@@ -1,0 +1,191 @@
+"""Detection layers (reference:
+/root/reference/python/paddle/fluid/layers/detection.py — prior_box,
+multi_box_head, ssd_loss, detection_output, yolo_box, roi ops...).
+
+Thin wrappers over ops/detection.py; see that module for the TPU
+re-specifications (fixed-budget NMS etc.).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.layers.helper import LayerHelper
+
+__all__ = ["prior_box", "density_prior_box", "anchor_generator",
+           "iou_similarity", "box_coder", "box_clip", "yolo_box",
+           "multiclass_nms", "roi_align", "roi_pool",
+           "sigmoid_focal_loss", "target_assign", "ssd_loss",
+           "detection_output"]
+
+
+def _op(op_type, inputs, outputs_spec, attrs):
+    helper = LayerHelper(op_type)
+    outs = {}
+    ret = []
+    for slot, dtype in outputs_spec:
+        v = helper.create_variable_for_type_inference(dtype)
+        outs[slot] = v
+        ret.append(v)
+    helper.append_op(type=op_type,
+                     inputs={k: v for k, v in inputs.items()
+                             if v is not None},
+                     outputs=outs, attrs=attrs, infer_shape=False)
+    return ret[0] if len(ret) == 1 else tuple(ret)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              name=None):
+    return _op("prior_box", {"Input": input, "Image": image},
+               [("Boxes", "float32"), ("Variances", "float32")],
+               {"min_sizes": list(min_sizes),
+                "max_sizes": list(max_sizes or []),
+                "aspect_ratios": list(aspect_ratios),
+                "variances": list(variance), "flip": flip, "clip": clip,
+                "step_w": steps[0], "step_h": steps[1],
+                "offset": offset})
+
+
+def density_prior_box(input, image, densities, fixed_sizes,
+                      fixed_ratios=(1.0,),
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, name=None):
+    return _op("density_prior_box", {"Input": input, "Image": image},
+               [("Boxes", "float32"), ("Variances", "float32")],
+               {"densities": list(densities),
+                "fixed_sizes": list(fixed_sizes),
+                "fixed_ratios": list(fixed_ratios),
+                "variances": list(variance), "clip": clip,
+                "step_w": steps[0], "step_h": steps[1],
+                "offset": offset})
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, stride,
+                     variance=(0.1, 0.1, 0.2, 0.2), offset=0.5,
+                     name=None):
+    return _op("anchor_generator", {"Input": input},
+               [("Anchors", "float32"), ("Variances", "float32")],
+               {"anchor_sizes": list(anchor_sizes),
+                "aspect_ratios": list(aspect_ratios),
+                "stride": list(stride), "variances": list(variance),
+                "offset": offset})
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    return _op("iou_similarity", {"X": x, "Y": y},
+               [("Out", "float32")], {"box_normalized": box_normalized})
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    return _op("box_coder",
+               {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+                "TargetBox": target_box},
+               [("OutputBox", "float32")],
+               {"code_type": code_type, "box_normalized": box_normalized,
+                "axis": axis})
+
+
+def box_clip(input, im_info, name=None):
+    return _op("box_clip", {"Input": input, "ImInfo": im_info},
+               [("Output", "float32")], {})
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, name=None):
+    return _op("yolo_box", {"X": x, "ImgSize": img_size},
+               [("Boxes", "float32"), ("Scores", "float32")],
+               {"anchors": list(anchors), "class_num": class_num,
+                "conf_thresh": conf_thresh,
+                "downsample_ratio": downsample_ratio})
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.01, nms_top_k=64,
+                   keep_top_k=32, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    """Static [N, keep_top_k, 6] detections padded with class=-1 rows
+    (TPU re-spec of the reference's variable-length LoD output)."""
+    return _op("multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+               [("Out", "float32")],
+               {"score_threshold": score_threshold,
+                "nms_top_k": nms_top_k, "nms_threshold": nms_threshold,
+                "keep_top_k": keep_top_k,
+                "background_label": background_label,
+                "normalized": normalized, "nms_eta": nms_eta})
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_batch_idx=None,
+              name=None):
+    return _op("roi_align",
+               {"X": input, "ROIs": rois,
+                "RoisBatchIdx": rois_batch_idx},
+               [("Out", "float32")],
+               {"pooled_height": pooled_height,
+                "pooled_width": pooled_width,
+                "spatial_scale": spatial_scale,
+                "sampling_ratio": sampling_ratio})
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_batch_idx=None, name=None):
+    return _op("roi_pool",
+               {"X": input, "ROIs": rois,
+                "RoisBatchIdx": rois_batch_idx},
+               [("Out", "float32")],
+               {"pooled_height": pooled_height,
+                "pooled_width": pooled_width,
+                "spatial_scale": spatial_scale, "sampling_ratio": -1})
+
+
+def sigmoid_focal_loss(x, label, fg_num=None, gamma=2.0, alpha=0.25,
+                       name=None):
+    return _op("sigmoid_focal_loss",
+               {"X": x, "Label": label, "FgNum": fg_num},
+               [("Out", "float32")], {"gamma": gamma, "alpha": alpha})
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    return _op("target_assign",
+               {"X": input, "MatchIndices": matched_indices,
+                "NegIndices": negative_indices},
+               [("Out", "float32"), ("OutWeight", "float32")],
+               {"mismatch_value": mismatch_value})
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0,
+             loc_loss_weight=1.0, conf_loss_weight=1.0, name=None):
+    """SSD multibox loss (reference detection.py ssd_loss; op:
+    ops/detection.py ssd_loss — argmax-IoU matching + smooth-L1 +
+    hard-negative-mined softmax CE, padded-gt TPU re-spec).
+    Returns per-image loss [N, 1]."""
+    return _op("ssd_loss",
+               {"Location": location, "Confidence": confidence,
+                "GtBox": gt_box, "GtLabel": gt_label,
+                "PriorBox": prior_box, "PriorBoxVar": prior_box_var},
+               [("Loss", "float32")],
+               {"background_label": background_label,
+                "overlap_threshold": overlap_threshold,
+                "neg_pos_ratio": neg_pos_ratio,
+                "loc_loss_weight": loc_loss_weight,
+                "conf_loss_weight": conf_loss_weight})
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3,
+                     nms_top_k=64, keep_top_k=32,
+                     score_threshold=0.01, nms_eta=1.0, name=None):
+    """Decode + NMS (reference detection.py detection_output):
+    loc [N, P, 4] offsets, scores [N, C, P], priors [P, 4]."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    return multiclass_nms(decoded, scores,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label,
+                          nms_eta=nms_eta)

@@ -461,3 +461,87 @@ def _register_roi(name, align):
 
 _register_roi("roi_align", True)
 _register_roi("roi_pool", False)
+
+
+@register_op("ssd_loss",
+             inputs=("Location", "Confidence", "GtBox", "GtLabel",
+                     "PriorBox", "PriorBoxVar"),
+             outputs=("Loss",), optional=("PriorBoxVar",),
+             attrs={"background_label": 0, "overlap_threshold": 0.5,
+                    "neg_pos_ratio": 3.0, "loc_loss_weight": 1.0,
+                    "conf_loss_weight": 1.0})
+def ssd_loss(ins, attrs):
+    """SSD multibox loss (reference detection.py ssd_loss +
+    mine_hard_examples_op.cc): argmax-IoU matching, center-size target
+    encoding, smooth-L1 localization + softmax confidence loss with
+    rank-based hard-negative mining — all static shapes.
+
+    Location [N,P,4], Confidence [N,P,C], GtBox [N,G,4] padded,
+    GtLabel [N,G] (<0 = padding), PriorBox [P,4].  Returns [N, 1]."""
+    loc = ins["Location"].astype(jnp.float32)
+    conf = ins["Confidence"].astype(jnp.float32)
+    gt_box = ins["GtBox"].astype(jnp.float32)
+    gt_label = ins["GtLabel"].reshape(gt_box.shape[0], -1)
+    prior = ins["PriorBox"].astype(jnp.float32)
+    pvar = ins.get("PriorBoxVar")
+    n, p, _ = loc.shape
+    g = gt_box.shape[1]
+    bg = attrs["background_label"]
+
+    gt_valid = gt_label >= 0                              # [N, G]
+    iou = jax.vmap(lambda b: _pairwise_iou(b, prior))(gt_box)  # [N,G,P]
+    iou = jnp.where(gt_valid[:, :, None], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)                     # [N, P]
+    best_iou = jnp.max(iou, axis=1)
+    matched = best_iou > attrs["overlap_threshold"]       # [N, P]
+
+    batch = jnp.arange(n)[:, None]
+    # bipartite step (reference bipartite_match_op.cc, run before the
+    # thresholded argmax): every valid gt claims its best prior even
+    # when that IoU is under the threshold
+    best_prior = jnp.argmax(iou, axis=2)                  # [N, G]
+    g_ids = jnp.broadcast_to(jnp.arange(g)[None, :], (n, g))
+    best_gt = best_gt.at[batch, best_prior].set(
+        jnp.where(gt_valid, g_ids, best_gt[batch, best_prior]))
+    matched = matched.at[batch, best_prior].set(
+        gt_valid | matched[batch, best_prior])
+    m_box = gt_box[batch, best_gt]                        # [N, P, 4]
+    m_label = jnp.where(matched, gt_label[batch, best_gt], bg)
+
+    # ---- localization target: center-size encoding vs priors ----------
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    gw = m_box[..., 2] - m_box[..., 0]
+    gh = m_box[..., 3] - m_box[..., 1]
+    gcx = m_box[..., 0] + gw / 2
+    gcy = m_box[..., 1] + gh / 2
+    eps = 1e-8
+    target = jnp.stack(
+        [(gcx - pcx) / (pw + eps), (gcy - pcy) / (ph + eps),
+         jnp.log(jnp.maximum(gw / (pw + eps), eps)),
+         jnp.log(jnp.maximum(gh / (ph + eps), eps))], axis=-1)
+    if pvar is not None:
+        target = target / pvar[None, :, :]
+    diff = jnp.abs(loc - target)
+    smooth_l1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+    loc_loss = jnp.sum(smooth_l1, axis=-1) * matched      # [N, P]
+
+    # ---- confidence loss + hard negative mining ------------------------
+    logp = jax.nn.log_softmax(conf, axis=-1)
+    ce = -jnp.take_along_axis(logp, m_label[..., None],
+                              axis=-1)[..., 0]            # [N, P]
+    num_pos = jnp.sum(matched, axis=1)                    # [N]
+    # rank negatives by loss; keep top neg_pos_ratio * num_pos
+    neg_score = jnp.where(matched, -jnp.inf, ce)
+    order = jnp.argsort(-neg_score, axis=1)
+    rank = jnp.argsort(order, axis=1)                     # rank of each
+    keep_neg = (~matched) & (
+        rank < (attrs["neg_pos_ratio"] * num_pos)[:, None])
+    conf_loss = jnp.sum(ce * (matched | keep_neg), axis=1)
+
+    denom = jnp.maximum(num_pos.astype(jnp.float32), 1.0)
+    total = (attrs["loc_loss_weight"] * jnp.sum(loc_loss, axis=1)
+             + attrs["conf_loss_weight"] * conf_loss) / denom
+    return {"Loss": total[:, None]}

@@ -176,3 +176,50 @@ def test_py_func_backward_func():
     g, = exe.run(framework.default_main_program(), feed={"x": xv},
                  fetch_list=["x@GRAD"])
     np.testing.assert_allclose(g, 2 * xv / 3.0, rtol=1e-5)
+
+
+def test_ssd_loss_trains_toy_detector():
+    """ssd_loss drives a toy detector toward predicting gt offsets and
+    labels (reference ssd_loss + mine_hard_examples semantics)."""
+    from paddle_tpu import optimizer
+
+    rng = np.random.RandomState(0)
+    n, p_count, c, g = 4, 16, 3, 2
+    prior = np.zeros((p_count, 4), np.float32)
+    grid = np.linspace(0.0, 0.75, 4)
+    k = 0
+    for gy in grid:
+        for gx in grid:
+            prior[k] = [gx, gy, gx + 0.25, gy + 0.25]
+            k += 1
+
+    feat = layers.data("feat", shape=[8], dtype="float32")
+    loc = layers.reshape(layers.fc(feat, p_count * 4), [-1, p_count, 4])
+    conf = layers.reshape(layers.fc(feat, p_count * c),
+                          [-1, p_count, c])
+    gt_box = layers.data("gt_box", shape=[g, 4], dtype="float32")
+    gt_label = layers.data("gt_label", shape=[g], dtype="int64")
+    prior_var = layers.assign(prior)
+    loss = layers.mean(layers.detection.ssd_loss(
+        loc, conf, gt_box, gt_label, prior_var))
+    optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+
+    def feeder():
+        fv = rng.randn(n, 8).astype(np.float32)
+        # gt boxes sit on prior cells; labels 1..c-1 (0 = background)
+        idx = rng.randint(0, p_count, (n, g))
+        gb = prior[idx] + rng.randn(n, g, 4).astype(np.float32) * 0.01
+        gl = rng.randint(1, c, (n, g)).astype(np.int64)
+        gl[:, 1] = -1           # second gt padded half the time
+        return {"feat": fv, "gt_box": gb.astype(np.float32),
+                "gt_label": gl}
+
+    losses = []
+    for _ in range(60):
+        lv, = exe.run(compiled, feed=feeder(), fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.8, losses[::10]
+    assert all(np.isfinite(losses))

@@ -72,3 +72,42 @@ def test_predictor_isolated_scope(tmp_path):
             var.set(np.zeros_like(np.asarray(var.get())))
     out2, = p2.run([probe])
     np.testing.assert_allclose(out2, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_inference_transpiler_folds_conv_bn():
+    """InferenceTranspiler (reference inference_transpiler.py:25):
+    conv+bn folded into conv weights; outputs match the unfused program."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, layers
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.transpiler import InferenceTranspiler
+
+    np.random.seed(0)
+    img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+    h = layers.conv2d(img, 8, 3, padding=1, bias_attr=False)
+    h = layers.batch_norm(h, is_test=True)
+    h2 = layers.conv2d(h, 4, 3, padding=1)          # with bias
+    h2 = layers.batch_norm(h2, is_test=True)
+    out = layers.reduce_mean(h2, dim=[2, 3])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    # make running stats non-trivial
+    for v in framework.default_main_program().global_block().vars:
+        if "batch_norm" in v and ("mean" in v or "variance" in v):
+            cur = np.asarray(global_scope().find_var(v).get())
+            global_scope().find_var(v).set(
+                __import__("jax.numpy", fromlist=["asarray"]).asarray(
+                    cur + np.random.rand(*cur.shape).astype(cur.dtype)))
+    xv = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    prog = framework.default_main_program().clone(for_test=True)
+    (ref,) = exe.run(prog, feed={"img": xv}, fetch_list=[out])
+    InferenceTranspiler().transpile(prog)
+    types = [op.type for op in prog.global_block().ops]
+    assert "batch_norm" not in types
+    (fused,) = exe.run(prog, feed={"img": xv}, fetch_list=[out])
+    np.testing.assert_allclose(fused, ref, atol=1e-4)
+    (fused2,) = exe.run(fluid.CompiledProgram(prog), feed={"img": xv},
+                        fetch_list=[out])
+    np.testing.assert_allclose(fused2, ref, atol=1e-4)
