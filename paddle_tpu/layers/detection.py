@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from paddle_tpu.layers.helper import LayerHelper
 
-__all__ = ["prior_box", "density_prior_box", "anchor_generator",
+__all__ = ["prior_box", "density_prior_box", "anchor_generator", "yolov3_loss",
            "iou_similarity", "box_coder", "box_clip", "yolo_box",
            "multiclass_nms", "roi_align", "roi_pool",
            "sigmoid_focal_loss", "target_assign", "ssd_loss",
@@ -189,3 +189,17 @@ def detection_output(loc, scores, prior_box, prior_box_var,
                           nms_threshold=nms_threshold,
                           background_label=background_label,
                           nms_eta=nms_eta)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh=0.7, downsample_ratio=32, gt_score=None,
+                use_label_smooth=True, name=None):
+    """YOLOv3 loss (reference detection.py yolov3_loss); returns [N]."""
+    return _op("yolov3_loss",
+               {"X": x, "GTBox": gt_box, "GTLabel": gt_label,
+                "GTScore": gt_score},
+               [("Loss", "float32")],
+               {"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+                "class_num": class_num, "ignore_thresh": ignore_thresh,
+                "downsample_ratio": downsample_ratio,
+                "use_label_smooth": use_label_smooth})

@@ -545,3 +545,124 @@ def ssd_loss(ins, attrs):
     total = (attrs["loc_loss_weight"] * jnp.sum(loc_loss, axis=1)
              + attrs["conf_loss_weight"] * conf_loss) / denom
     return {"Loss": total[:, None]}
+
+
+@register_op("yolov3_loss",
+             inputs=("X", "GTBox", "GTLabel", "GTScore"),
+             outputs=("Loss",), optional=("GTScore",),
+             attrs={"anchors": REQUIRED, "anchor_mask": REQUIRED,
+                    "class_num": REQUIRED, "ignore_thresh": 0.7,
+                    "downsample_ratio": 32, "use_label_smooth": True})
+def yolov3_loss(ins, attrs):
+    """YOLOv3 training loss (reference yolov3_loss_op.h): per-gt
+    best-anchor assignment, BCE on x/y/obj/class, L1 on w/h, objectness
+    ignore-mask above ignore_thresh — all static shapes (gt padded with
+    w<=0 or h<=0 rows).  Box/class losses are accumulated PER GT
+    (gathered at each gt's cell, so two gts sharing a cell both count,
+    matching the reference's per-gt loop); the reference's single
+    input_size = downsample_ratio * h normalizes both dimensions.
+
+    X: [N, A*(5+C), H, W]; GTBox: [N, B, 4] (cx, cy, w, h relative);
+    GTLabel: [N, B]; GTScore: [N, B] (mixup weights)."""
+    x = ins["X"].astype(jnp.float32)
+    gt_box = ins["GTBox"].astype(jnp.float32)
+    gt_label = ins["GTLabel"]
+    n, _, h, w = x.shape
+    nc = attrs["class_num"]
+    mask = list(attrs["anchor_mask"])
+    na = len(mask)
+    anchors = np.asarray(attrs["anchors"], np.float32).reshape(-1, 2)
+    m_anchors = jnp.asarray(anchors[mask])              # [A, 2]
+    input_size = attrs["downsample_ratio"] * h          # reference quirk
+    b = gt_box.shape[1]
+    gt_score = ins.get("GTScore")
+    if gt_score is None:
+        gt_score = jnp.ones((n, b), jnp.float32)
+    gt_score = gt_score.astype(jnp.float32)
+
+    x = x.reshape(n, na, 5 + nc, h, w)
+    px, py = x[:, :, 0], x[:, :, 1]                     # [N, A, H, W]
+    pw, ph = x[:, :, 2], x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]                                  # [N, A, C, H, W]
+
+    def bce(logit, target):
+        return jax.nn.softplus(logit) - logit * target
+
+    gt_valid = (gt_box[:, :, 2] > 0) & (gt_box[:, :, 3] > 0) & \
+        (gt_label >= 0)                                 # [N, B]
+    # best anchor per gt: wh-IoU against ALL anchors (pixel units)
+    gw = gt_box[:, :, 2] * input_size                   # [N, B]
+    gh = gt_box[:, :, 3] * input_size
+    all_anch = jnp.asarray(anchors)                     # [A_all, 2]
+    inter = jnp.minimum(gw[:, :, None], all_anch[None, None, :, 0]) * \
+        jnp.minimum(gh[:, :, None], all_anch[None, None, :, 1])
+    union = gw[:, :, None] * gh[:, :, None] + \
+        all_anch[None, None, :, 0] * all_anch[None, None, :, 1] - inter
+    best_anchor = jnp.argmax(inter / (union + 1e-10), axis=2)  # [N, B]
+    in_mask = jnp.zeros_like(best_anchor, bool)
+    local_idx = jnp.zeros_like(best_anchor)
+    for li, mi in enumerate(mask):
+        hit = best_anchor == mi
+        in_mask = in_mask | hit
+        local_idx = jnp.where(hit, li, local_idx)
+    responsible = gt_valid & in_mask                    # [N, B]
+
+    gi = jnp.clip((gt_box[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+    tx = gt_box[:, :, 0] * w - gi
+    ty = gt_box[:, :, 1] * h - gj
+    tw = jnp.log(jnp.maximum(
+        gw / jnp.maximum(m_anchors[local_idx, 0], 1e-10), 1e-10))
+    th = jnp.log(jnp.maximum(
+        gh / jnp.maximum(m_anchors[local_idx, 1], 1e-10), 1e-10))
+    box_scale = 2.0 - gt_box[:, :, 2] * gt_box[:, :, 3]
+
+    # ---- box + class losses: GATHER predictions per gt ----------------
+    batch = jnp.arange(n)[:, None].repeat(b, axis=1)    # [N, B]
+    sel = (batch, local_idx, gj, gi)
+    coord = bce(px[batch, local_idx, gj, gi], tx) + \
+        bce(py[batch, local_idx, gj, gi], ty)
+    wh = jnp.abs(pw[sel] - tw) + jnp.abs(ph[sel] - th)
+    per_gt_box = (coord + wh) * box_scale * gt_score
+    loss_box = jnp.where(responsible, per_gt_box, 0.0).sum(axis=1)
+
+    smooth = (min(1.0 / max(nc, 1), 1.0 / 40.0)
+              if attrs["use_label_smooth"] else 0.0)
+    lbl = jnp.clip(gt_label, 0, nc - 1)
+    cls_pred = jnp.moveaxis(pcls, 2, -1)[sel]           # [N, B, C]
+    one_hot = (lbl[:, :, None] ==
+               jnp.arange(nc)[None, None, :]).astype(jnp.float32)
+    cls_t = one_hot * (1.0 - smooth) + (1.0 - one_hot) * smooth
+    per_gt_cls = bce(cls_pred, cls_t).sum(axis=2) * gt_score
+    loss_cls = jnp.where(responsible, per_gt_cls, 0.0).sum(axis=1)
+
+    # ---- objectness: target 1 at gt cells (score-weighted loss), ------
+    # ignore non-gt cells whose decoded box overlaps any gt
+    has_gt = jnp.zeros((n, na, h, w), bool).at[sel].set(
+        responsible, mode="drop")
+    score_g = jnp.ones((n, na, h, w)).at[sel].set(
+        jnp.where(responsible, gt_score, 1.0), mode="drop")
+    grid_x = (jnp.arange(w)[None, None, None, :] +
+              jax.nn.sigmoid(px)) / w
+    grid_y = (jnp.arange(h)[None, None, :, None] +
+              jax.nn.sigmoid(py)) / h
+    pbw = jnp.exp(pw) * m_anchors[None, :, 0, None, None] / input_size
+    pbh = jnp.exp(ph) * m_anchors[None, :, 1, None, None] / input_size
+    pred_flat = jnp.stack([
+        grid_x - pbw / 2, grid_y - pbh / 2,
+        grid_x + pbw / 2, grid_y + pbh / 2], axis=-1).reshape(n, -1, 4)
+    gt_c = jnp.stack([
+        gt_box[:, :, 0] - gt_box[:, :, 2] / 2,
+        gt_box[:, :, 1] - gt_box[:, :, 3] / 2,
+        gt_box[:, :, 0] + gt_box[:, :, 2] / 2,
+        gt_box[:, :, 1] + gt_box[:, :, 3] / 2], axis=-1)  # [N, B, 4]
+    ious = jax.vmap(_pairwise_iou)(pred_flat, gt_c)       # [N, P, B]
+    ious = jnp.where(gt_valid[:, None, :], ious, 0.0)
+    max_iou = jnp.max(ious, axis=2).reshape(n, na, h, w)
+    ignore = (max_iou > attrs["ignore_thresh"]) & ~has_gt
+    obj_t = has_gt.astype(jnp.float32)
+    loss_obj = jnp.where(ignore, 0.0, bce(pobj, obj_t) * score_g)
+    loss_obj = loss_obj.sum(axis=(1, 2, 3))
+
+    return {"Loss": loss_box + loss_obj + loss_cls}

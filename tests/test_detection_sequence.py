@@ -223,3 +223,43 @@ def test_ssd_loss_trains_toy_detector():
         losses.append(float(lv))
     assert losses[-1] < losses[0] * 0.8, losses[::10]
     assert all(np.isfinite(losses))
+
+
+def test_yolov3_loss_trains_toy():
+    """yolov3_loss decreases when predictions move toward the gt."""
+    from paddle_tpu import optimizer
+
+    rng = np.random.RandomState(0)
+    n, gdim, nc, b = 2, 4, 3, 2
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    na = len(mask)
+    feat = layers.data("feat", shape=[8], dtype="float32")
+    x = layers.reshape(
+        layers.fc(feat, na * (5 + nc) * gdim * gdim),
+        [-1, na * (5 + nc), gdim, gdim])
+    gt_box = layers.data("gt_box", shape=[b, 4], dtype="float32")
+    gt_label = layers.data("gt_label", shape=[b], dtype="int64")
+    loss = layers.mean(layers.detection.yolov3_loss(
+        x, gt_box, gt_label, anchors, mask, nc,
+        downsample_ratio=32))
+    optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+
+    def feeder():
+        fv = rng.randn(n, 8).astype(np.float32)
+        gb = np.stack([
+            rng.uniform(0.2, 0.8, (n, b)), rng.uniform(0.2, 0.8, (n, b)),
+            rng.uniform(0.1, 0.3, (n, b)), rng.uniform(0.1, 0.3, (n, b)),
+        ], axis=-1).astype(np.float32)
+        gl = rng.randint(0, nc, (n, b)).astype(np.int64)
+        return {"feat": fv, "gt_box": gb, "gt_label": gl}
+
+    losses = []
+    for _ in range(50):
+        lv, = exe.run(compiled, feed=feeder(), fetch_list=[loss])
+        losses.append(float(lv))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.9, losses[::10]
