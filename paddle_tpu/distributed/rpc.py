@@ -10,19 +10,74 @@ Reference parity:
 
 TPU-first difference: tensors crossing this layer are host numpy arrays
 (pserver state lives on host; the trainer's device state is donated to
-XLA).  Framing is length-prefixed pickles of (msg_type, payload) — the
-protobuf/zero-copy machinery is unnecessary at control-plane rates.  The
+XLA).  Framing is length-prefixed pickles of (msg_type, payload), but
+deserialization goes through a *restricted* Unpickler that only admits
+numpy array/dtype reconstruction and plain data containers — the wire
+format is data-only, like the reference's protobuf VariableMessage
+(send_recv.proto.in:47), which cannot encode code execution.  The
 native C++ data path (paddle_tpu/native/) owns bulk file IO instead.
 """
 
 from __future__ import annotations
 
+import io
 import pickle
 import socket
 import struct
 import threading
 
 _LEN = struct.Struct("!Q")
+
+# Allow-list for the wire format: numpy reconstruction internals plus the
+# scalar types that appear inside (name, ndarray) payloads.  Anything else
+# (os.system, subprocess, functools.partial, ...) raises UnpicklingError —
+# a hostile peer gets an exception, not code execution.
+_SAFE_GLOBALS = {
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy", "float32"),
+    ("numpy", "float64"),
+    ("numpy", "float16"),
+    ("numpy", "int64"),
+    ("numpy", "int32"),
+    ("numpy", "int16"),
+    ("numpy", "int8"),
+    ("numpy", "uint8"),
+    ("numpy", "bool_"),
+    ("numpy.core.multiarray", "_frombuffer"),
+    ("numpy._core.multiarray", "_frombuffer"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("numpy.dtypes", "Float32DType"),
+    ("numpy.dtypes", "Float64DType"),
+    ("numpy.dtypes", "Int64DType"),
+    ("numpy.dtypes", "Int32DType"),
+    ("builtins", "complex"),
+    ("builtins", "bytearray"),
+    ("builtins", "frozenset"),
+    ("builtins", "set"),
+    ("builtins", "slice"),
+    ("builtins", "range"),
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Data-only unpickler: see _SAFE_GLOBALS.  Reference analog: the
+    gRPC serde can only produce tensors (grpc/grpc_serde.cc)."""
+
+    def find_class(self, module, name):
+        if (module, name) in _SAFE_GLOBALS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"wire format forbids global {module}.{name}")
+
+
+def _loads_restricted(data: bytes):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
 
 
 def _send_msg(sock, obj):
@@ -42,7 +97,7 @@ def _recv_exact(sock, n):
 
 def _recv_msg(sock):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+    return _loads_restricted(_recv_exact(sock, n))
 
 
 class RPCServer:

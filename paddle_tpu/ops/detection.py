@@ -317,6 +317,11 @@ def _nms_single(boxes, scores, iou_thresh, score_thresh, keep_k,
     threshold after each kept box (reference NMSFast adaptive
     threshold, multiclass_nms_op.cc)."""
     k = min(keep_k, scores.shape[0])
+    # reference multiclass_nms_op.cc filters by score_threshold BEFORE
+    # suppression — sub-threshold boxes must not suppress anyone.
+    # -inf sorts them last so they can only "suppress" other
+    # sub-threshold boxes, all of which are dropped by the final mask.
+    scores = jnp.where(scores > score_thresh, scores, -jnp.inf)
     top_scores, order = jax.lax.top_k(scores, k)
     cand = boxes[order]                               # [k, 4]
     iou = _pairwise_iou(cand, cand, normalized)
@@ -661,6 +666,9 @@ def yolov3_loss(ins, attrs):
     ious = jnp.where(gt_valid[:, None, :], ious, 0.0)
     max_iou = jnp.max(ious, axis=2).reshape(n, na, h, w)
     ignore = (max_iou > attrs["ignore_thresh"]) & ~has_gt
+    # reference yolov3_loss_op.h:196: positives use hard target 1 with
+    # the loss WEIGHTED by the mixup score (obj_mask_ stores the score
+    # only as that weight); negatives use target 0, weight 1.
     obj_t = has_gt.astype(jnp.float32)
     loss_obj = jnp.where(ignore, 0.0, bce(pobj, obj_t) * score_g)
     loss_obj = loss_obj.sum(axis=(1, 2, 3))

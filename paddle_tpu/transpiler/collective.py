@@ -99,19 +99,73 @@ class LocalSGD(Collective):
     """Periodic parameter averaging (reference collective.py:263): train
     locally, every k steps allreduce-average the params."""
 
+    STEP_VAR = "@LOCAL_SGD_STEP@"
+
     def __init__(self, nrings=1, k_steps=1):
         super().__init__(nrings)
         self.k_steps = k_steps
 
+    def _transpile_startup_program(self):
+        super()._transpile_startup_program()
+        gb = self.startup_program.global_block()
+        # int64: a float32 counter saturates (x+1==x) at 2^24 steps
+        gb.create_var(self.STEP_VAR, shape=(1,), dtype="int64",
+                      persistable=True)
+        gb.append_op(type="fill_constant", inputs={},
+                     outputs={"Out": self.STEP_VAR},
+                     attrs={"shape": (1,), "dtype": "int64",
+                            "value": 0},
+                     infer_shape=False)
+
     def _transpile_main_program(self):
-        gb = self.main_program.global_block()
+        """Every k steps: p <- mean_ranks(p).  The k-step schedule is a
+        where()-select inside the compiled step (same device-side idiom
+        as lookahead_update): the allreduce runs uniformly on all ranks
+        (collectives must not diverge per-rank) and the result is only
+        *applied* when step % k == 0."""
+        gb = mb = self.main_program.global_block()
         params = [v.name for v in self.main_program.all_parameters()]
         scale = 1.0 / self.nranks
+        step = self.STEP_VAR
+        mb.create_var(step, shape=(1,), dtype="int64", persistable=True)
+        gb.append_op(type="increment", inputs={"X": step},
+                     outputs={"Out": step}, attrs={"step": 1.0},
+                     op_role=OPTIMIZE, infer_shape=False)
+        sync = "@LOCAL_SGD_SYNC@"
+        mod = "@LOCAL_SGD_MOD@"
+        kvar = "@LOCAL_SGD_K@"
+        mb.create_var(sync, shape=(1,), dtype="bool")
+        mb.create_var(mod, shape=(1,), dtype="int64")
+        mb.create_var(kvar, shape=(1,), dtype="int64")
+        gb.append_op(type="fill_constant", inputs={},
+                     outputs={"Out": kvar},
+                     attrs={"shape": (1,), "dtype": "int64",
+                            "value": int(self.k_steps)},
+                     op_role=OPTIMIZE, infer_shape=False)
+        gb.append_op(type="elementwise_mod", inputs={"X": step, "Y": kvar},
+                     outputs={"Out": mod}, op_role=OPTIMIZE,
+                     infer_shape=False)
+        zvar = "@LOCAL_SGD_ZERO@"
+        mb.create_var(zvar, shape=(1,), dtype="int64")
+        gb.append_op(type="fill_constant", inputs={},
+                     outputs={"Out": zvar},
+                     attrs={"shape": (1,), "dtype": "int64",
+                            "value": 0},
+                     op_role=OPTIMIZE, infer_shape=False)
+        gb.append_op(type="equal", inputs={"X": mod, "Y": zvar},
+                     outputs={"Out": sync}, op_role=OPTIMIZE,
+                     infer_shape=False)
         for p in params:
+            avg = f"{p}@LOCAL_SGD_AVG@"
+            mb.create_var(avg, shape=mb.var(p).shape, dtype=mb.var(p).dtype)
             gb.append_op(type="c_allreduce_sum", inputs={"X": p},
-                         outputs={"Out": p},
+                         outputs={"Out": avg},
                          attrs={"ring_id": 0, "use_calc_stream": True},
                          op_role=OPTIMIZE, infer_shape=False)
-            gb.append_op(type="scale", inputs={"X": p},
-                         outputs={"Out": p}, attrs={"scale": scale},
+            gb.append_op(type="scale", inputs={"X": avg},
+                         outputs={"Out": avg}, attrs={"scale": scale},
                          op_role=OPTIMIZE, infer_shape=False)
+            gb.append_op(type="where", inputs={"Condition": sync, "X": avg,
+                                               "Y": p},
+                         outputs={"Out": p}, op_role=OPTIMIZE,
+                         infer_shape=False)

@@ -93,8 +93,12 @@ class DistributeTranspiler:
         """Distribution plan: every optimized param (and its grad) maps to
         a list of sections [(ps_index, section_name, start, end)]."""
         gb = self.origin_program.global_block()
+        # Only grad-consuming optimize ops move to pservers; Param-only
+        # optimize ops (e.g. lookahead_update, which has no Grad input)
+        # stay on the trainer — they operate on the post-recv params.
         self.opt_ops = [op for op in gb.ops
-                        if op.op_role == OPTIMIZE and "Param" in op.inputs]
+                        if op.op_role == OPTIMIZE and "Param" in op.inputs
+                        and "Grad" in op.inputs]
         dispatcher = self.config.split_method(self.endpoints)
         self.param_plan = {}
         self.grad_of = {}
@@ -131,6 +135,14 @@ class DistributeTranspiler:
     def _build_trainer_program(self):
         prog = self.origin_program.clone()
         gb = prog.global_block()
+        # Param-only optimize ops (lookahead_update etc.) stay on the
+        # trainer but must run on the POST-recv params — pull them out
+        # here and re-append after the recv/fetch_barrier below, else
+        # recv would clobber their writes every step.
+        trainer_opt_ops = [op for op in gb.ops
+                           if op.op_role == OPTIMIZE
+                           and "Param" in op.inputs
+                           and "Grad" not in op.inputs]
         gb.ops = [op for op in gb.ops
                   if not (op.op_role == OPTIMIZE and "Param" in op.inputs)]
         eps = self.endpoints
@@ -165,6 +177,7 @@ class DistributeTranspiler:
             gb.append_op(type="fetch_barrier", inputs={}, outputs={},
                          attrs={"endpoints": list(eps)},
                          infer_shape=False)
+        gb.ops.extend(trainer_opt_ops)
         self.trainer_program = prog
 
     def _append_recv_ops(self, gb):

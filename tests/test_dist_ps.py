@@ -370,3 +370,45 @@ def test_grad_allreduce_transpiler_inserts_collectives():
     # allreduce sits before the optimizer consumes the grad
     types = [op.type for op in ops]
     assert types.index("c_allreduce_sum") < types.index("sgd")
+
+
+def test_local_sgd_transpiler_k_steps_gating():
+    """LocalSGD (reference transpiler/collective.py:263): params are
+    allreduce-averaged only every k steps — the k-step schedule is a
+    where()-select on a step counter, so with nranks=1 (allreduce =
+    identity, scale = 1.0) the trajectory matches plain SGD while the
+    counter and gating machinery run inside the program."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.transpiler import LocalSGD
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    loss = layers.mean(layers.fc(x, 1))
+    optimizer.SGD(0.1).minimize(loss)
+    import paddle_tpu.framework as framework
+
+    main = framework.default_main_program()
+    startup = framework.default_startup_program()
+    LocalSGD(k_steps=3).transpile(startup, main, rank=0,
+                                  endpoints="a:1",
+                                  current_endpoint="a:1")
+    ops = main.global_block().ops
+    types = [op.type for op in ops]
+    # gating chain present, one where-select per param (w + b)
+    assert "increment" in types and "elementwise_mod" in types
+    assert types.count("where") == 2
+    assert types.count("c_allreduce_sum") == 2
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(7):
+        bx = rng.rand(8, 4).astype(np.float32)
+        lv, = exe.run(main, feed={"x": bx}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    from paddle_tpu.core.scope import global_scope
+
+    step = np.asarray(global_scope().find_var(LocalSGD.STEP_VAR).get())
+    assert step.reshape(-1)[0] == 7.0
+    assert losses[-1] < losses[0]
