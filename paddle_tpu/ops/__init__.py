@@ -21,3 +21,5 @@ from paddle_tpu.ops import quant  # noqa: F401
 from paddle_tpu.ops import pallas_kernels  # noqa: F401
 from paddle_tpu.ops import ps_ops  # noqa: F401
 from paddle_tpu.ops import loss_ops  # noqa: F401
+from paddle_tpu.ops import vision  # noqa: F401
+from paddle_tpu.ops import misc  # noqa: F401

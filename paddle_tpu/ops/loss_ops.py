@@ -260,3 +260,159 @@ def sampled_gaussian(ins, attrs):
         jnp.asarray(ins.get("SeedOffset", 0), jnp.int32).reshape(()))
     return {"Out": attrs["mean"] + attrs["std"] * jax.random.normal(
         key, tuple(attrs["shape"]), jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# loss zoo (reference operators/*_loss_op.cc family)
+# ---------------------------------------------------------------------------
+
+@register_op("hinge_loss", inputs=("Logits", "Labels"),
+             outputs=("Loss",))
+def hinge_loss(ins, attrs):
+    """hinge_loss_op.h: loss = max(0, 1 - logits*(2*label-1))."""
+    x, y = ins["Logits"], ins["Labels"]
+    return {"Loss": jnp.maximum(0.0, 1.0 - x * (2.0 * y - 1.0))}
+
+
+@register_op("rank_loss", inputs=("Label", "Left", "Right"),
+             outputs=("Out",))
+def rank_loss(ins, attrs):
+    """rank_loss_op.h: out = log(1+exp(l-r)) - label*(l-r) (RankNet)."""
+    o = ins["Left"] - ins["Right"]
+    return {"Out": jnp.logaddexp(0.0, o) - ins["Label"] * o}
+
+
+@register_op("margin_rank_loss", inputs=("X1", "X2", "Label"),
+             outputs=("Out", "Activated"),
+             attrs={"margin": 0.0})
+def margin_rank_loss(ins, attrs):
+    """margin_rank_loss_op.h: out = relu(-label*(x1-x2) + margin);
+    Activated is the >0 mask reused by the backward."""
+    d = -ins["Label"] * (ins["X1"] - ins["X2"]) + attrs["margin"]
+    out = jnp.maximum(d, 0.0)
+    return {"Out": out, "Activated": (d > 0).astype(d.dtype)}
+
+
+@register_op("kldiv_loss", inputs=("X", "Target"), outputs=("Loss",),
+             attrs={"reduction": "mean"})
+def kldiv_loss(ins, attrs):
+    """kldiv_loss_op.h: elementwise target*(log(target)-x), with
+    none/batchmean/mean/sum reductions (x is log-prob input)."""
+    x, t = ins["X"], ins["Target"]
+    ele = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-38)) - x), 0.0)
+    red = attrs["reduction"]
+    if red == "none":
+        return {"Loss": ele}
+    if red == "batchmean":
+        return {"Loss": ele.sum() / x.shape[0]}
+    if red == "sum":
+        return {"Loss": ele.sum()}
+    return {"Loss": ele.mean()}
+
+
+@register_op("smooth_l1_loss",
+             inputs=("X", "Y", "InsideWeight", "OutsideWeight"),
+             outputs=("Out", "Diff"),
+             optional=("InsideWeight", "OutsideWeight"),
+             attrs={"sigma": 1.0})
+def smooth_l1_loss(ins, attrs):
+    """smooth_l1_loss_op.h: Huber with transition at 1/sigma^2;
+    Diff caches iw*(x-y) for the backward; Out is the row-summed
+    weighted loss [N, 1]."""
+    x, y = ins["X"], ins["Y"]
+    s2 = attrs["sigma"] ** 2
+    diff = x - y
+    iw, ow = ins.get("InsideWeight"), ins.get("OutsideWeight")
+    if iw is not None:
+        diff = diff * iw
+    a = jnp.abs(diff)
+    ele = jnp.where(a < 1.0 / s2, 0.5 * diff * diff * s2, a - 0.5 / s2)
+    if ow is not None:
+        ele = ele * ow
+    out = ele.reshape(x.shape[0], -1).sum(axis=1, keepdims=True)
+    return {"Out": out, "Diff": diff}
+
+
+@register_op("bpr_loss", inputs=("X", "Label"), outputs=("Y",))
+def bpr_loss(ins, attrs):
+    """bpr_loss_op.h (Bayesian Personalized Ranking): per row i with
+    positive class y_i: mean_{j!=y} log(1+exp(x_j - x_y))."""
+    x, label = ins["X"], ins["Label"]
+    n, c = x.shape
+    pos = jnp.take_along_axis(
+        x, label.reshape(n, 1).astype(jnp.int32), axis=1)
+    ele = jnp.logaddexp(0.0, x - pos)
+    mask = jnp.arange(c)[None, :] != label.reshape(n, 1)
+    out = (ele * mask).sum(axis=1, keepdims=True) / (c - 1)
+    return {"Y": out}
+
+
+@register_op("modified_huber_loss", inputs=("X", "Y"),
+             outputs=("Out", "IntermediateVal"))
+def modified_huber_loss(ins, attrs):
+    """modified_huber_loss_op.h: z = (2y-1)*x; loss = -4z if z<-1,
+    (1-z)^2 if z<1, else 0."""
+    x, y = ins["X"], ins["Y"]
+    z = (2.0 * y - 1.0) * x
+    out = jnp.where(z < -1.0, -4.0 * z,
+                    jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return {"Out": out, "IntermediateVal": z}
+
+
+@register_op("teacher_student_sigmoid_loss", inputs=("X", "Label"),
+             outputs=("Y",),
+             attrs={"soft_max_up_bound": 15.0,
+                    "soft_max_lower_bound": -15.0})
+def teacher_student_sigmoid_loss(ins, attrs):
+    """teacher_student_sigmoid_loss_op.h: CTR distillation; label
+    encodes click z and teacher score z' as {-2, -1, [0,2)}:
+      label < -1: bce(x, 0)
+      label < 0 : bce(x, 1)
+      label < 1 : bce(x, 0) + bce(x, label)
+      else      : bce(x, 1) + bce(x, label-1)."""
+    x, lbl = ins["X"], ins["Label"]
+    bce0 = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    bce1 = bce0 - x
+
+    def soft(t):
+        return bce0 - x * t
+
+    out = jnp.where(
+        lbl < -1.0, bce0,
+        jnp.where(lbl < 0.0, bce1,
+                  jnp.where(lbl < 1.0, bce0 + soft(lbl),
+                            bce1 + soft(lbl - 1.0))))
+    return {"Y": out}
+
+
+@register_op("squared_l2_distance", inputs=("X", "Y"),
+             outputs=("Out", "sub_result"))
+def squared_l2_distance(ins, attrs):
+    """squared_l2_distance_op.h: row-wise ||x-y||^2 (Y broadcasts over
+    the batch when its first dim is 1)."""
+    x, y = ins["X"], ins["Y"]
+    sub = x - y
+    return {"Out": (sub * sub).reshape(x.shape[0], -1).sum(
+        axis=1, keepdims=True), "sub_result": sub}
+
+
+@register_op("squared_l2_norm", inputs=("X",), outputs=("Out",))
+def squared_l2_norm(ins, attrs):
+    return {"Out": jnp.sum(ins["X"] ** 2).reshape(1)}
+
+
+@register_op("l1_norm", inputs=("X",), outputs=("Out",))
+def l1_norm(ins, attrs):
+    return {"Out": jnp.sum(jnp.abs(ins["X"])).reshape(1)}
+
+
+@register_op("cos_sim", inputs=("X", "Y"),
+             outputs=("Out", "XNorm", "YNorm"))
+def cos_sim(ins, attrs):
+    """cos_sim_op.h: row-wise cosine similarity; Y may be [1, D]
+    (broadcast against every row of X)."""
+    x, y = ins["X"], ins["Y"]
+    xn = jnp.sqrt((x * x).sum(axis=1, keepdims=True))
+    yn = jnp.sqrt((y * y).sum(axis=1, keepdims=True))
+    dot = (x * y).sum(axis=1, keepdims=True)
+    return {"Out": dot / (xn * yn), "XNorm": xn, "YNorm": yn}

@@ -1,0 +1,356 @@
+"""Misc op wave: tensor aliases, CTR helpers, accumulators and the
+SelectedRows plumbing ops.
+
+Reference parity (/root/reference/paddle/fluid/operators/):
+  sign_op.cc, diag_op.cc, size_op.cc, fill_op.cc, minus_op.cc,
+  is_empty_op.cc, flatten_op.cc (flatten), reshape_op.cc (reshape),
+  squeeze_op.cc / unsqueeze_op.cc (non-2 variants), transpose_op.cc,
+  fill_zeros_like_op.cc (fill_zeros_like2), cross_entropy_op.cc
+  (cross_entropy2), multiplex_op.cc, mean_iou_op.h,
+  bilinear_tensor_product_op.h, cvm_op.h, sampling_id_op.cc,
+  uniform_random_batch_size_like_op.cc,
+  gaussian_random_batch_size_like_op.cc, average_accumulates_op.h,
+  lod_reset_op.cc, get_tensor_from_selected_rows_op.cc,
+  merge_selected_rows_op.cc.
+
+The non-"2" shape ops (flatten/reshape/squeeze/unsqueeze/transpose)
+are the legacy single-output forms; the *2 forms with XShape side
+outputs live in ops/basic.py.  Both exist in the reference registry,
+so both are registered here for program-level parity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import REQUIRED, register_op
+from paddle_tpu.core.scope import SelectedRows
+
+
+# ---------------------------------------------------------------------------
+# tiny tensor ops
+# ---------------------------------------------------------------------------
+
+@register_op("sign", inputs=("X",), outputs=("Out",))
+def sign(ins, attrs):
+    return {"Out": jnp.sign(ins["X"])}
+
+
+@register_op("diag", inputs=("Diagonal",), outputs=("Out",),
+             differentiable=False)
+def diag(ins, attrs):
+    """diag_op.cc: vector [N] -> diagonal matrix [N, N]."""
+    return {"Out": jnp.diag(ins["Diagonal"])}
+
+
+@register_op("size", inputs=("Input",), outputs=("Out",),
+             differentiable=False)
+def size(ins, attrs):
+    return {"Out": jnp.asarray(
+        int(np.prod(ins["Input"].shape) if ins["Input"].shape else 1),
+        jnp.int64).reshape(1)}
+
+
+@register_op("fill", inputs=(), outputs=("Out",), differentiable=False,
+             attrs={"value": REQUIRED, "shape": REQUIRED,
+                    "dtype": "float32", "force_cpu": False})
+def fill(ins, attrs):
+    """fill_op.cc: fill Out with the explicit per-element value list."""
+    vals = np.asarray(attrs["value"], np.dtype(attrs["dtype"]))
+    return {"Out": jnp.asarray(vals.reshape(
+        [int(s) for s in attrs["shape"]]))}
+
+
+@register_op("minus", inputs=("X", "Y"), outputs=("Out",))
+def minus(ins, attrs):
+    return {"Out": ins["X"] - ins["Y"]}
+
+
+@register_op("is_empty", inputs=("X",), outputs=("Out",),
+             differentiable=False)
+def is_empty(ins, attrs):
+    return {"Out": jnp.asarray(
+        int(np.prod(ins["X"].shape)) == 0).reshape(())}
+
+
+# legacy single-output shape ops ------------------------------------------
+
+@register_op("flatten", inputs=("X",), outputs=("Out",),
+             attrs={"axis": 1})
+def flatten(ins, attrs):
+    x = ins["X"]
+    ax = int(attrs["axis"])
+    lead = int(np.prod(x.shape[:ax])) if ax else 1
+    return {"Out": x.reshape(lead, -1)}
+
+
+@register_op("reshape", inputs=("X", "Shape"), outputs=("Out",),
+             optional=("Shape",), attrs={"shape": REQUIRED})
+def reshape(ins, attrs):
+    return {"Out": ins["X"].reshape(
+        [int(s) for s in attrs["shape"]])}
+
+
+@register_op("squeeze", inputs=("X",), outputs=("Out",),
+             attrs={"axes": []})
+def squeeze(ins, attrs):
+    x = ins["X"]
+    axes = [int(a) for a in attrs["axes"]]
+    if not axes:
+        axes = [i for i, s in enumerate(x.shape) if s == 1]
+    axes = [a for a in axes if x.shape[a] == 1]
+    return {"Out": jnp.squeeze(x, axis=tuple(axes))}
+
+
+@register_op("unsqueeze", inputs=("X",), outputs=("Out",),
+             attrs={"axes": REQUIRED})
+def unsqueeze(ins, attrs):
+    x = ins["X"]
+    for a in sorted(int(a) for a in attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x}
+
+
+@register_op("transpose", inputs=("X",), outputs=("Out",),
+             attrs={"axis": REQUIRED})
+def transpose(ins, attrs):
+    return {"Out": jnp.transpose(ins["X"],
+                                 [int(a) for a in attrs["axis"]])}
+
+
+@register_op("fill_zeros_like2", inputs=("X",), outputs=("Out",),
+             differentiable=False, attrs={"dtype": -1})
+def fill_zeros_like2(ins, attrs):
+    return {"Out": jnp.zeros_like(ins["X"])}
+
+
+@register_op("cross_entropy2", inputs=("X", "Label"),
+             outputs=("Y", "MatchX"),
+             attrs={"ignore_index": -100})
+def cross_entropy2(ins, attrs):
+    """cross_entropy_op.cc CrossEntropyOp2: hard-label CE over
+    probabilities; MatchX caches the picked probability for the
+    backward."""
+    x, label = ins["X"], ins["Label"]
+    n = x.shape[0]
+    lbl = label.reshape(n).astype(jnp.int32)
+    picked = jnp.take_along_axis(
+        x.reshape(n, -1), lbl[:, None], axis=1)
+    ignore = (lbl == attrs["ignore_index"])[:, None]
+    y = jnp.where(ignore, 0.0,
+                  -jnp.log(jnp.maximum(picked, 1e-20)))
+    return {"Y": y, "MatchX": picked}
+
+
+# ---------------------------------------------------------------------------
+# selection / metrics / CTR
+# ---------------------------------------------------------------------------
+
+@register_op("multiplex", inputs=("X", "Ids"), outputs=("Out",),
+             duplicable=("X",))
+def multiplex(ins, attrs):
+    """multiplex_op.cc: Ids [N,1] picks, per row n, row n of candidate
+    X[ids[n]]."""
+    xs = ins["X"]
+    ids = ins["Ids"].reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(xs, axis=0)          # [K, N, ...]
+    n = stacked.shape[1]
+    return {"Out": stacked[ids, jnp.arange(n)]}
+
+
+@register_op("mean_iou",
+             inputs=("Predictions", "Labels", "InWrongs", "InCorrects",
+                     "InMeanIou"),
+             outputs=("OutMeanIou", "OutWrong", "OutCorrect"),
+             duplicable=("InWrongs", "InCorrects", "InMeanIou"),
+             optional=("InWrongs", "InCorrects", "InMeanIou"),
+             differentiable=False,
+             attrs={"num_classes": REQUIRED})
+def mean_iou(ins, attrs):
+    """mean_iou_op.h: per-class correct/wrong counts; iou_c =
+    correct_c/(correct_c+wrong_c); mean over classes present."""
+    nc = int(attrs["num_classes"])
+    pred = ins["Predictions"].reshape(-1).astype(jnp.int32)
+    lbl = ins["Labels"].reshape(-1).astype(jnp.int32)
+    hit = pred == lbl
+    correct = jnp.zeros(nc, jnp.int32).at[lbl].add(
+        hit.astype(jnp.int32), mode="drop")
+    wrong = jnp.zeros(nc, jnp.int32).at[lbl].add(
+        (~hit).astype(jnp.int32), mode="drop")
+    wrong = wrong.at[pred].add((~hit).astype(jnp.int32), mode="drop")
+    for w in ins.get("InWrongs") or []:
+        wrong = wrong + w
+    for c in ins.get("InCorrects") or []:
+        correct = correct + c
+    denom = wrong + correct
+    valid = denom > 0
+    iou = jnp.where(valid, correct / jnp.maximum(denom, 1), 0.0)
+    miou = iou.sum() / jnp.maximum(valid.sum(), 1)
+    for m in ins.get("InMeanIou") or []:
+        miou = miou + m.reshape(())
+    return {"OutMeanIou": miou.reshape(1).astype(jnp.float32),
+            "OutWrong": wrong, "OutCorrect": correct}
+
+
+@register_op("bilinear_tensor_product",
+             inputs=("X", "Y", "Weight", "Bias"), outputs=("Out",),
+             optional=("Bias",))
+def bilinear_tensor_product(ins, attrs):
+    """bilinear_tensor_product_op.h: out[n,k] = x[n] @ W[k] @ y[n]."""
+    x, y, w = ins["X"], ins["Y"], ins["Weight"]
+    out = jnp.einsum("ni,kij,nj->nk", x, w, y)
+    if ins.get("Bias") is not None:
+        out = out + ins["Bias"]
+    return {"Out": out}
+
+
+@register_op("cvm", inputs=("X", "CVM"), outputs=("Y",),
+             optional=("CVM",), attrs={"use_cvm": True})
+def cvm(ins, attrs):
+    """cvm_op.h: first two features are show/click counters; use_cvm
+    log-transforms them in place, else they are dropped."""
+    x = ins["X"]
+    if attrs["use_cvm"]:
+        f0 = jnp.log(x[:, 0:1] + 1.0)
+        f1 = jnp.log(x[:, 1:2] + 1.0) - f0
+        return {"Y": jnp.concatenate([f0, f1, x[:, 2:]], axis=1)}
+    return {"Y": x[:, 2:]}
+
+
+@register_op("sampling_id", inputs=("X",), outputs=("Out",),
+             differentiable=False,
+             attrs={"min": 0.0, "max": 1.0, "seed": 0})
+def sampling_id(ins, attrs):
+    """sampling_id_op.cc: sample a column index per row of the prob
+    matrix X (categorical draw)."""
+    x = ins["X"]
+    key = jax.random.PRNGKey(attrs["seed"] or 0)
+    u = jax.random.uniform(key, (x.shape[0], 1), x.dtype,
+                           attrs["min"], attrs["max"])
+    cdf = jnp.cumsum(x, axis=1)
+    idx = jnp.sum((cdf < u).astype(jnp.int64), axis=1)
+    return {"Out": jnp.clip(idx, 0, x.shape[1] - 1)}
+
+
+@register_op("uniform_random_batch_size_like", inputs=("Input",),
+             outputs=("Out",), differentiable=False, host_only=True,
+             attrs={"shape": REQUIRED, "input_dim_idx": 0,
+                    "output_dim_idx": 0, "min": -1.0, "max": 1.0,
+                    "seed": 0, "dtype": "float32"})
+def uniform_random_batch_size_like(ins, attrs):
+    """uniform_random_batch_size_like_op.cc: host-side init (like
+    uniform_random) with the batch dim copied from Input."""
+    shape = [int(s) for s in attrs["shape"]]
+    shape[int(attrs["output_dim_idx"])] = \
+        ins["Input"].shape[int(attrs["input_dim_idx"])]
+    rng = np.random.RandomState(attrs["seed"] or None)
+    return {"Out": jnp.asarray(rng.uniform(
+        attrs["min"], attrs["max"], shape).astype(attrs["dtype"]))}
+
+
+@register_op("gaussian_random_batch_size_like", inputs=("Input",),
+             outputs=("Out",), differentiable=False, host_only=True,
+             attrs={"shape": REQUIRED, "input_dim_idx": 0,
+                    "output_dim_idx": 0, "mean": 0.0, "std": 1.0,
+                    "seed": 0, "dtype": "float32"})
+def gaussian_random_batch_size_like(ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    shape[int(attrs["output_dim_idx"])] = \
+        ins["Input"].shape[int(attrs["input_dim_idx"])]
+    rng = np.random.RandomState(attrs["seed"] or None)
+    return {"Out": jnp.asarray(
+        (rng.randn(*shape) * attrs["std"] + attrs["mean"]).astype(
+            attrs["dtype"]))}
+
+
+@register_op("average_accumulates",
+             inputs=("param", "in_sum_1", "in_sum_2", "in_sum_3",
+                     "in_num_accumulates", "in_old_num_accumulates",
+                     "in_num_updates"),
+             outputs=("out_sum_1", "out_sum_2", "out_sum_3",
+                      "out_num_accumulates", "out_old_num_accumulates",
+                      "out_num_updates"),
+             differentiable=False,
+             in_place={"out_sum_1": "in_sum_1",
+                       "out_sum_2": "in_sum_2",
+                       "out_sum_3": "in_sum_3",
+                       "out_num_accumulates": "in_num_accumulates",
+                       "out_old_num_accumulates":
+                           "in_old_num_accumulates",
+                       "out_num_updates": "in_num_updates"},
+             attrs={"average_window": 0.0,
+                    "max_average_window": REQUIRED,
+                    "min_average_window": 10000})
+def average_accumulates(ins, attrs):
+    """average_accumulates_op.h: ModelAverage accumulator rotation with
+    the 16384-step precision spill and window-restart conditions,
+    expressed as where-selects so it jits."""
+    k_max = 16384
+    p = ins["param"]
+    s1 = ins["in_sum_1"] + p
+    s2 = ins["in_sum_2"]
+    s3 = ins["in_sum_3"]
+    num_acc = ins["in_num_accumulates"].reshape(()) + 1
+    old_acc = ins["in_old_num_accumulates"].reshape(())
+    num_upd = ins["in_num_updates"].reshape(()) + 1
+    spill = (num_upd % k_max) == 0
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(
+        jnp.asarray(float(attrs["max_average_window"])),
+        num_upd.astype(jnp.float32) * attrs["average_window"])
+    restart = ((num_acc >= int(attrs["min_average_window"]))
+               & (num_acc.astype(jnp.float32) >= window))
+    s3 = jnp.where(restart, s1 + s2, s3)
+    s1 = jnp.where(restart, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(restart, jnp.zeros_like(s2), s2)
+    old_acc = jnp.where(restart, num_acc, old_acc)
+    num_acc = jnp.where(restart, jnp.zeros_like(num_acc), num_acc)
+    return {"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+            "out_num_accumulates": num_acc.reshape(
+                ins["in_num_accumulates"].shape),
+            "out_old_num_accumulates": old_acc.reshape(
+                ins["in_old_num_accumulates"].shape),
+            "out_num_updates": num_upd.reshape(
+                ins["in_num_updates"].shape)}
+
+
+@register_op("lod_reset", inputs=("X", "Y"), outputs=("Out",),
+             optional=("Y",), attrs={"target_lod": []})
+def lod_reset(ins, attrs):
+    """lod_reset_op.cc re-spec: under the padded [B,T,...]+Length
+    representation the values are unchanged — sequence re-segmentation
+    is carried by the explicit Length tensors produced by the sequence
+    layers, so this is the identity on values (parity shim)."""
+    return {"Out": ins["X"]}
+
+
+# -- SelectedRows plumbing (host/interpreter path) -------------------------
+
+@register_op("get_tensor_from_selected_rows", inputs=("X",),
+             outputs=("Out",), differentiable=False, host_only=True)
+def get_tensor_from_selected_rows(ins, attrs):
+    """get_tensor_from_selected_rows_op.cc: expose the value tensor of
+    a SelectedRows variable."""
+    x = ins["X"]
+    if isinstance(x, SelectedRows):
+        return {"Out": x.values}
+    return {"Out": x}
+
+
+@register_op("merge_selected_rows", inputs=("X",), outputs=("Out",),
+             differentiable=False, host_only=True)
+def merge_selected_rows(ins, attrs):
+    """merge_selected_rows_op.cc: sum duplicate rows so each row id
+    appears once."""
+    x = ins["X"]
+    if not isinstance(x, SelectedRows):
+        return {"Out": x}
+    rows = np.asarray(x.rows)
+    uniq, inv = np.unique(rows, return_inverse=True)
+    vals = jnp.zeros((len(uniq),) + tuple(x.values.shape[1:]),
+                     x.values.dtype).at[jnp.asarray(inv)].add(x.values)
+    return {"Out": SelectedRows(jnp.asarray(uniq), vals, x.height)}

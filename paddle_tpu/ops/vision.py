@@ -1,0 +1,681 @@
+"""Vision op family: interpolation, 3-D conv/pool, samplers, and the
+pixel-rearrangement zoo.
+
+Reference parity (all paths under /root/reference/paddle/fluid/operators/):
+  interpolate_op.cc/.h (bilinear_interp, nearest_interp — exact
+  align_corners/align_mode arithmetic from interpolate_op.h:50-135),
+  conv_op.cc (conv3d), conv_transpose_op.cc (conv3d_transpose,
+  depthwise_conv2d_transpose), pool_op.cc (pool3d),
+  pool_with_index_op.cc (max_pool2d/3d_with_index),
+  grid_sampler_op.cc/.h, affine_grid_op.cc, affine_channel_op.cc,
+  crop_op.cc, random_crop_op.cc, pad_constant_like_op.cc,
+  pixel_shuffle_op.cc, shuffle_channel_op.cc, space_to_depth_op.cc,
+  maxout_op.cc, unpool_op.cc, spp_op.cc, temporal_shift_op.cc,
+  prelu_op.cc, unfold_op.cc, conv_shift_op.cc, row_conv_op.cc,
+  fsp_op.cc, add_position_encoding_op.cc.
+
+TPU-first notes: everything is expressed as gather/reduce_window/
+conv_general_dilated so XLA can tile onto the MXU/VPU; index-typed
+outputs (argmax pools) are flat int64 indices like the reference so
+unpool can consume them.  No scalar loops; interpolation weights are
+precomputed host-side numpy constants (static shapes) baked into the
+trace, matching the reference's precomputed vy/vx tables.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import REQUIRED, register_op
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (list(v) * 3)[:3]) if len(v) < 3 \
+            else tuple(int(x) for x in v[:3])
+    return (int(v),) * 3
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1])) if len(v) >= 2 \
+            else (int(v[0]),) * 2
+    return (int(v),) * 2
+
+
+# ---------------------------------------------------------------------------
+# interpolation (interpolate_op.h)
+# ---------------------------------------------------------------------------
+
+def _interp_out_hw(in_h, in_w, attrs, ins):
+    if ins.get("OutSize") is not None:
+        # OutSize is a 2-element tensor; static shapes demand the attr
+        # path under trace — the layer front-end resolves it, keeping
+        # the op static (re-spec of the dynamic OutSize input).
+        raise ValueError(
+            "interp: dynamic OutSize tensor is not supported under XLA "
+            "static shapes; pass out_h/out_w or scale attrs instead")
+    scale = float(attrs.get("scale") or 0.0)
+    if scale > 0:
+        return int(in_h * scale), int(in_w * scale)
+    return int(attrs["out_h"]), int(attrs["out_w"])
+
+
+def _interp_ratio(in_sz, out_sz, align_corners):
+    if out_sz <= 1:
+        return 0.0
+    if align_corners:
+        return (in_sz - 1.0) / (out_sz - 1.0)
+    return float(in_sz) / out_sz
+
+
+def _bilinear_weights(in_sz, out_sz, align_corners, align_mode):
+    """Exact reference arithmetic (interpolate_op.h:70-84): returns
+    (lo_idx, hi_idx, d_lo, d_hi) numpy vectors of length out_sz."""
+    ratio = _interp_ratio(in_sz, out_sz, align_corners)
+    k = np.arange(out_sz)
+    align_flag = (align_mode == 0 and not align_corners)
+    if align_flag:
+        lo = (ratio * (k + 0.5) - 0.5).astype(np.int64)
+    else:
+        lo = (ratio * k).astype(np.int64)
+    lo = np.maximum(lo, 0)
+    hi = np.minimum(lo + 1, in_sz - 1)
+    idx_src = np.maximum(ratio * (k + 0.5) - 0.5, 0.0)
+    d_lo = (idx_src - lo) if align_flag else (ratio * k - lo)
+    d_hi = 1.0 - d_lo
+    return lo, hi, d_lo.astype(np.float32), d_hi.astype(np.float32)
+
+
+@register_op("bilinear_interp", inputs=("X", "OutSize"), outputs=("Out",),
+             optional=("OutSize",),
+             attrs={"out_h": -1, "out_w": -1, "scale": 0.0,
+                    "interp_method": "bilinear", "align_corners": True,
+                    "align_mode": 1})
+def bilinear_interp(ins, attrs):
+    x = ins["X"]
+    n, c, in_h, in_w = x.shape
+    out_h, out_w = _interp_out_hw(in_h, in_w, attrs, ins)
+    ac, am = bool(attrs["align_corners"]), int(attrs["align_mode"])
+    yn, ys, dn, ds = _bilinear_weights(in_h, out_h, ac, am)
+    xw, xe, dw, de = _bilinear_weights(in_w, out_w, ac, am)
+    rows_n = x[:, :, yn, :]                    # [N, C, OH, W]
+    rows_s = x[:, :, ys, :]
+    # interpolate along W for both row sets, then blend along H
+    def wmix(rows):
+        return (rows[:, :, :, xw] * de[None, None, None, :]
+                + rows[:, :, :, xe] * dw[None, None, None, :])
+    out = (wmix(rows_n) * ds[None, None, :, None]
+           + wmix(rows_s) * dn[None, None, :, None])
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("nearest_interp", inputs=("X", "OutSize"), outputs=("Out",),
+             optional=("OutSize",),
+             attrs={"out_h": -1, "out_w": -1, "scale": 0.0,
+                    "interp_method": "nearest", "align_corners": True,
+                    "align_mode": 1})
+def nearest_interp(ins, attrs):
+    """interpolate_op.h:29-47 NearestNeighborInterpolate."""
+    x = ins["X"]
+    n, c, in_h, in_w = x.shape
+    out_h, out_w = _interp_out_hw(in_h, in_w, attrs, ins)
+    ac = bool(attrs["align_corners"])
+    rh = _interp_ratio(in_h, out_h, ac)
+    rw = _interp_ratio(in_w, out_w, ac)
+    k = np.arange(out_h)
+    l = np.arange(out_w)
+    iy = (rh * k + 0.5).astype(np.int64) if ac else (rh * k).astype(
+        np.int64)
+    ix = (rw * l + 0.5).astype(np.int64) if ac else (rw * l).astype(
+        np.int64)
+    iy = np.clip(iy, 0, in_h - 1)
+    ix = np.clip(ix, 0, in_w - 1)
+    return {"Out": x[:, :, iy, :][:, :, :, ix]}
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv / pool family
+# ---------------------------------------------------------------------------
+
+@register_op("conv3d", inputs=("Input", "Filter"), outputs=("Output",),
+             attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "dilations": [1, 1, 1], "groups": 1,
+                    "data_format": "NCDHW", "use_cudnn": True})
+def conv3d(ins, attrs):
+    """conv_op.cc Conv3DOpMaker."""
+    x, w = ins["Input"], ins["Filter"]
+    s, p, d = (_triple(attrs["strides"]), _triple(attrs["paddings"]),
+               _triple(attrs["dilations"]))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
+        rhs_dilation=d, dimension_numbers=dn,
+        feature_group_count=attrs["groups"])
+    return {"Output": out}
+
+
+@register_op("conv3d_transpose", inputs=("Input", "Filter"),
+             outputs=("Output",),
+             attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "dilations": [1, 1, 1], "groups": 1,
+                    "output_size": [], "data_format": "NCDHW"})
+def conv3d_transpose(ins, attrs):
+    """conv_transpose_op.cc Conv3DTransposeOpMaker: fractionally-strided
+    conv via lhs_dilation (XLA's native transposed-conv form)."""
+    x, w = ins["Input"], ins["Filter"]  # w: [in, out/groups, kd, kh, kw]
+    s, p = _triple(attrs["strides"]), _triple(attrs["paddings"])
+    d = _triple(attrs["dilations"])
+    ks = [(w.shape[i + 2] - 1) * d[i] + 1 for i in range(3)]
+    pad = [(ks[i] - 1 - p[i], ks[i] - 1 - p[i]) for i in range(3)]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCDHW", "IODHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, jnp.flip(w, axis=(2, 3, 4)), window_strides=(1, 1, 1),
+        padding=pad, lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=dn, feature_group_count=attrs["groups"])
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d_transpose", inputs=("Input", "Filter"),
+             outputs=("Output",),
+             attrs={"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 1,
+                    "output_size": [], "data_format": "NCHW"})
+def depthwise_conv2d_transpose(ins, attrs):
+    x, w = ins["Input"], ins["Filter"]
+    s, p = _pair(attrs["strides"]), _pair(attrs["paddings"])
+    d = _pair(attrs["dilations"])
+    groups = attrs["groups"] or x.shape[1]
+    kh = (w.shape[2] - 1) * d[0] + 1
+    kw = (w.shape[3] - 1) * d[1] + 1
+    pad = [(kh - 1 - p[0], kh - 1 - p[0]),
+           (kw - 1 - p[1], kw - 1 - p[1])]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "IOHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, jnp.flip(w, axis=(2, 3)), window_strides=(1, 1),
+        padding=pad, lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=dn, feature_group_count=groups)
+    return {"Output": out}
+
+
+@register_op("pool3d", inputs=("X",), outputs=("Out",),
+             attrs={"pooling_type": "max", "ksize": REQUIRED,
+                    "global_pooling": False, "strides": [1, 1, 1],
+                    "paddings": [0, 0, 0], "exclusive": True,
+                    "adaptive": False, "ceil_mode": False,
+                    "data_format": "NCDHW"})
+def pool3d(ins, attrs):
+    x = ins["X"]
+    if attrs["global_pooling"]:
+        k, s, p = x.shape[2:5], x.shape[2:5], (0, 0, 0)
+    else:
+        k = _triple(attrs["ksize"])
+        s = _triple(attrs["strides"])
+        p = _triple(attrs["paddings"])
+    window = (1, 1) + tuple(k)
+    strides = (1, 1) + tuple(s)
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    if attrs["pooling_type"] == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                pads)
+        return {"Out": out}
+    out = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    if attrs["exclusive"] and any(p):
+        ones = jnp.ones(x.shape[2:5], x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, k, s,
+                                tuple((pi, pi) for pi in p))
+        out = out / cnt[None, None]
+    else:
+        out = out / float(np.prod(k))
+    return {"Out": out}
+
+
+def _max_pool_with_index(x, k, s, p, spatial_ndim):
+    """reference pool_with_index_op: returns (max, flat int64 index
+    into the flattened spatial dims of x)."""
+    spatial = x.shape[2:]
+    flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int64).reshape(
+        spatial)
+    idx = jnp.broadcast_to(flat_idx, x.shape)
+    window = (1, 1) + tuple(k)
+    strides = (1, 1) + tuple(s)
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    out, oidx = lax.reduce_window(
+        (x, idx), (jnp.asarray(-jnp.inf, x.dtype),
+                   jnp.asarray(-1, jnp.int64)),
+        sel, window, strides, pads)
+    return out, oidx
+
+
+@register_op("max_pool2d_with_index", inputs=("X",),
+             outputs=("Out", "Mask"),
+             attrs={"ksize": REQUIRED, "global_pooling": False,
+                    "strides": [1, 1], "paddings": [0, 0],
+                    "adaptive": False})
+def max_pool2d_with_index(ins, attrs):
+    x = ins["X"]
+    if attrs["global_pooling"]:
+        k, s, p = x.shape[2:4], (1, 1), (0, 0)
+    else:
+        k, s, p = (_pair(attrs["ksize"]), _pair(attrs["strides"]),
+                   _pair(attrs["paddings"]))
+    out, mask = _max_pool_with_index(x, k, s, p, 2)
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("max_pool3d_with_index", inputs=("X",),
+             outputs=("Out", "Mask"),
+             attrs={"ksize": REQUIRED, "global_pooling": False,
+                    "strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "adaptive": False})
+def max_pool3d_with_index(ins, attrs):
+    x = ins["X"]
+    if attrs["global_pooling"]:
+        k, s, p = x.shape[2:5], (1, 1, 1), (0, 0, 0)
+    else:
+        k, s, p = (_triple(attrs["ksize"]), _triple(attrs["strides"]),
+                   _triple(attrs["paddings"]))
+    out, mask = _max_pool_with_index(x, k, s, p, 3)
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("unpool", inputs=("X", "Indices"), outputs=("Out",),
+             attrs={"ksize": REQUIRED, "strides": [1, 1],
+                    "paddings": [0, 0], "unpooling_type": "max"})
+def unpool(ins, attrs):
+    """unpool_op.cc: scatter pooled values back to the argmax positions
+    recorded by max_pool2d_with_index."""
+    x, idx = ins["X"], ins["Indices"]
+    n, c, h, w = x.shape
+    k, s, p = (_pair(attrs["ksize"]), _pair(attrs["strides"]),
+               _pair(attrs["paddings"]))
+    oh = (h - 1) * s[0] - 2 * p[0] + k[0]
+    ow = (w - 1) * s[1] - 2 * p[1] + k[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].add(x.reshape(n, c, -1), mode="drop")
+    return {"Out": out.reshape(n, c, oh, ow)}
+
+
+@register_op("spp", inputs=("X",), outputs=("Out",),
+             attrs={"pyramid_height": REQUIRED, "pooling_type": "max"})
+def spp(ins, attrs):
+    """spp_op.cc spatial pyramid pooling: levels l=0..H-1 pool to
+    2^l x 2^l bins (kernel=ceil(in/bins), pad so bins*kernel >= in),
+    flattened and concatenated along channels."""
+    x = ins["X"]
+    n, c, h, w = x.shape
+    outs = []
+    for lvl in range(int(attrs["pyramid_height"])):
+        bins = 2 ** lvl
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        window, strides = (1, 1, kh, kw), (1, 1, kh, kw)
+        pads = ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                (pw, kw * bins - w - pw))
+        if attrs["pooling_type"] == "max":
+            o = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                  pads)
+        else:
+            o = lax.reduce_window(x, 0.0, lax.add, window, strides,
+                                  pads) / (kh * kw)
+        outs.append(o.reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+# ---------------------------------------------------------------------------
+# samplers / affine
+# ---------------------------------------------------------------------------
+
+@register_op("affine_grid", inputs=("Theta", "OutputShape"),
+             outputs=("Output",), optional=("OutputShape",),
+             attrs={"use_cudnn": True, "output_shape": []})
+def affine_grid(ins, attrs):
+    """affine_grid_op.cc: Theta [N,2,3] -> sampling grid [N,H,W,2] over
+    the normalized [-1,1] mesh (align_corners=True semantics)."""
+    theta = ins["Theta"]
+    shape = [int(v) for v in attrs["output_shape"]]
+    if len(shape) != 4:
+        raise ValueError("affine_grid: output_shape attr [N,C,H,W] "
+                         "required (static shapes)")
+    n, _, h, w = shape
+    ys = np.linspace(-1.0, 1.0, h, dtype=np.float32)
+    xs = np.linspace(-1.0, 1.0, w, dtype=np.float32)
+    gx, gy = np.meshgrid(xs, ys)              # [H, W]
+    base = jnp.asarray(
+        np.stack([gx, gy, np.ones_like(gx)], axis=-1))  # [H, W, 3]
+    out = jnp.einsum("hwk,njk->nhwj", base, theta)
+    return {"Output": out}
+
+
+@register_op("grid_sampler", inputs=("X", "Grid"), outputs=("Output",),
+             attrs={"use_cudnn": True})
+def grid_sampler(ins, attrs):
+    """grid_sampler_op.h: bilinear sample of X [N,C,H,W] at Grid
+    [N,H,W,2] normalized coords; x=(gx+1)*(W-1)/2 (align-corners),
+    zero padding outside."""
+    x, grid = ins["X"], ins["Grid"]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * 0.5 * (w - 1)   # [N, Hg, Wg]
+    gy = (grid[..., 1] + 1.0) * 0.5 * (h - 1)
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    dx, dy = gx - x0, gy - y0
+
+    def gather(yy, xx):
+        yi = yy.astype(jnp.int32)
+        xi = xx.astype(jnp.int32)
+        valid = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+        yc = jnp.clip(yi, 0, h - 1)
+        xc = jnp.clip(xi, 0, w - 1)
+        vals = x[jnp.arange(n)[:, None, None, None],
+                 jnp.arange(c)[None, :, None, None],
+                 yc[:, None], xc[:, None]]       # [N, C, Hg, Wg]
+        return vals * valid[:, None].astype(x.dtype)
+
+    out = (gather(y0, x0) * ((1 - dy) * (1 - dx))[:, None]
+           + gather(y0, x0 + 1) * ((1 - dy) * dx)[:, None]
+           + gather(y0 + 1, x0) * (dy * (1 - dx))[:, None]
+           + gather(y0 + 1, x0 + 1) * (dy * dx)[:, None])
+    return {"Output": out}
+
+
+@register_op("affine_channel", inputs=("X", "Scale", "Bias"),
+             outputs=("Out",),
+             attrs={"data_layout": "NCHW"})
+def affine_channel(ins, attrs):
+    x, scale, bias = ins["X"], ins["Scale"], ins["Bias"]
+    if attrs["data_layout"] == "NHWC":
+        return {"Out": x * scale + bias}
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return {"Out": x * scale.reshape(shape) + bias.reshape(shape)}
+
+
+# ---------------------------------------------------------------------------
+# crop / pad
+# ---------------------------------------------------------------------------
+
+@register_op("crop", inputs=("X", "Y", "Offsets"), outputs=("Out",),
+             optional=("Y", "Offsets"),
+             attrs={"offsets": [], "shape": []})
+def crop(ins, attrs):
+    """crop_op.cc: static offsets/shape attrs (the Offsets tensor input
+    is resolved by the layer; XLA needs static slices)."""
+    x = ins["X"]
+    shape = [int(v) for v in attrs["shape"]] or \
+        (list(ins["Y"].shape) if ins.get("Y") is not None else None)
+    if shape is None:
+        raise ValueError("crop: need shape attr or Y input")
+    offsets = [int(v) for v in (attrs["offsets"] or [0] * x.ndim)]
+    return {"Out": lax.slice(
+        x, offsets, [o + s for o, s in zip(offsets, shape)])}
+
+
+@register_op("random_crop", inputs=("X", "Seed"),
+             outputs=("Out", "SeedOut"), optional=("Seed",),
+             differentiable=False,
+             attrs={"shape": REQUIRED, "startup_seed": 0})
+def random_crop(ins, attrs):
+    """random_crop_op.cc: uniform random offsets in the trailing dims
+    matching len(shape); the evolving Seed tensor is threaded through
+    like the reference's SeedOut."""
+    x = ins["X"]
+    crop_shape = [int(v) for v in attrs["shape"]]
+    seed = ins.get("Seed")
+    if seed is None:
+        seed = jnp.asarray([attrs["startup_seed"]], jnp.int64)
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(0), seed.reshape(()).astype(jnp.uint32))
+    k = len(crop_shape)
+    lead = x.ndim - k
+    maxs = np.array([x.shape[lead + i] - crop_shape[i]
+                     for i in range(k)], np.int32)
+    offs = jax.random.randint(key, (k,), 0, jnp.asarray(maxs) + 1)
+    starts = jnp.concatenate(
+        [jnp.zeros((lead,), jnp.int32), offs.astype(jnp.int32)])
+    out = lax.dynamic_slice(x, list(starts),
+                            list(x.shape[:lead]) + crop_shape)
+    # 32-bit LCG step (minstd) — int64 literals overflow when jax
+    # runs with x64 disabled
+    new_seed = (seed * 48271 + 1) % 2147483647
+    return {"Out": out, "SeedOut": new_seed}
+
+
+@register_op("pad_constant_like", inputs=("X", "Y"), outputs=("Out",),
+             attrs={"pad_value": 0.0})
+def pad_constant_like(ins, attrs):
+    """pad_constant_like_op.cc: pad Y up to X's shape with pad_value."""
+    x, y = ins["X"], ins["Y"]
+    pads = [(0, x.shape[i] - y.shape[i]) for i in range(y.ndim)]
+    return {"Out": jnp.pad(y, pads,
+                           constant_values=attrs["pad_value"])}
+
+
+# ---------------------------------------------------------------------------
+# pixel rearrangement zoo
+# ---------------------------------------------------------------------------
+
+@register_op("pixel_shuffle", inputs=("X",), outputs=("Out",),
+             attrs={"upscale_factor": REQUIRED})
+def pixel_shuffle(ins, attrs):
+    x = ins["X"]
+    r = int(attrs["upscale_factor"])
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": out.reshape(n, c // (r * r), h * r, w * r)}
+
+
+@register_op("shuffle_channel", inputs=("X",), outputs=("Out",),
+             attrs={"group": 1})
+def shuffle_channel(ins, attrs):
+    x = ins["X"]
+    g = int(attrs["group"])
+    n, c, h, w = x.shape
+    return {"Out": x.reshape(n, g, c // g, h, w).transpose(
+        0, 2, 1, 3, 4).reshape(n, c, h, w)}
+
+
+@register_op("space_to_depth", inputs=("X",), outputs=("Out",),
+             attrs={"blocksize": REQUIRED})
+def space_to_depth(ins, attrs):
+    """space_to_depth_op.cc (blocksize b): [N,C,H,W] ->
+    [N,C*b*b,H/b,W/b]."""
+    x = ins["X"]
+    b = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // b, b, w // b, b)
+    out = out.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": out.reshape(n, c * b * b, h // b, w // b)}
+
+
+@register_op("maxout", inputs=("X",), outputs=("Out",),
+             attrs={"groups": REQUIRED})
+def maxout(ins, attrs):
+    """maxout_op.cc: out channels = C/groups; max over each group of
+    `groups` consecutive channels."""
+    x = ins["X"]
+    g = int(attrs["groups"])
+    n, c = x.shape[:2]
+    rest = x.shape[2:]
+    return {"Out": jnp.max(x.reshape((n, c // g, g) + rest), axis=2)}
+
+
+@register_op("temporal_shift", inputs=("X",), outputs=("Out",),
+             attrs={"seg_num": REQUIRED, "shift_ratio": 0.25})
+def temporal_shift(ins, attrs):
+    """temporal_shift_op.cc: within each segment of T frames, shift the
+    first C*ratio channels back one frame, the next C*ratio forward."""
+    x = ins["X"]
+    t = int(attrs["seg_num"])
+    ratio = float(attrs["shift_ratio"])
+    nt, c, h, w = x.shape
+    n = nt // t
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    v = x.reshape(n, t, c, h, w)
+    pad_past = jnp.concatenate(
+        [v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+    pad_future = jnp.concatenate(
+        [jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+    out = jnp.concatenate([pad_past, pad_future, v[:, :, c2:]], axis=2)
+    return {"Out": out.reshape(nt, c, h, w)}
+
+
+# ---------------------------------------------------------------------------
+# misc nets
+# ---------------------------------------------------------------------------
+
+@register_op("prelu", inputs=("X", "Alpha"), outputs=("Out",),
+             attrs={"mode": "all"})
+def prelu(ins, attrs):
+    """prelu_op.cc modes: all (one alpha), channel (per C), element."""
+    x, alpha = ins["X"], ins["Alpha"]
+    mode = attrs["mode"]
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        alpha = alpha.reshape((1,) + x.shape[1:])
+    else:
+        alpha = alpha.reshape(())
+    return {"Out": jnp.where(x > 0, x, alpha * x)}
+
+
+@register_op("unfold", inputs=("X",), outputs=("Y",),
+             attrs={"kernel_sizes": REQUIRED, "strides": [1, 1],
+                    "paddings": [0, 0, 0, 0], "dilations": [1, 1]})
+def unfold(ins, attrs):
+    """unfold_op.cc (im2col): [N,C,H,W] -> [N, C*kh*kw, L]."""
+    x = ins["X"]
+    kh, kw = _pair(attrs["kernel_sizes"])
+    sh, sw = _pair(attrs["strides"])
+    d = _pair(attrs["dilations"])
+    p = [int(v) for v in attrs["paddings"]]
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+    eh = (h + p[0] + p[2] - (d[0] * (kh - 1) + 1)) // sh + 1
+    ew = (w + p[1] + p[3] - (d[1] * (kw - 1) + 1)) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = lax.slice(
+                xp, (0, 0, i * d[0], j * d[1]),
+                (n, c, i * d[0] + (eh - 1) * sh + 1,
+                 j * d[1] + (ew - 1) * sw + 1),
+                (1, 1, sh, sw))
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)            # [N, C, kh*kw, eh, ew]
+    return {"Y": out.reshape(n, c * kh * kw, eh * ew)}
+
+
+@register_op("conv_shift", inputs=("X", "Y"), outputs=("Out",))
+def conv_shift(ins, attrs):
+    """conv_shift_op.cc circular convolution: X [B,M], Y [B,N] (N odd,
+    N <= M): out[i] = sum_j X[(i+j-N/2) mod M] * Y[j]."""
+    x, y = ins["X"], ins["Y"]
+    b, m = x.shape
+    nsz = y.shape[1]
+    half = nsz // 2
+    shifts = np.arange(nsz) - half
+    idx = (np.arange(m)[None, :] + shifts[:, None]) % m   # [N, M]
+    gathered = x[:, idx]                                  # [B, N, M]
+    return {"Out": jnp.einsum("bnm,bn->bm", gathered, y)}
+
+
+@register_op("row_conv", inputs=("X", "Filter"), outputs=("Out",))
+def row_conv(ins, attrs):
+    """row_conv_op.cc lookahead conv: X [N,T,D] (batched re-spec of the
+    LoD form), Filter [future_context, D]:
+    out[t] = sum_{j=0..fc-1} x[t+j] * filter[j]."""
+    x, f = ins["X"], ins["Filter"]
+    fc = f.shape[0]
+    n, t, ddim = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, fc - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for j in range(fc):
+        out = out + xp[:, j:j + t, :] * f[j][None, None, :]
+    return {"Out": out}
+
+
+@register_op("fsp", inputs=("X", "Y"), outputs=("Out",))
+def fsp(ins, attrs):
+    """fsp_op.cc (flow of solution procedure, distillation): X
+    [N,C1,H,W], Y [N,C2,H,W] -> [N,C1,C2] = x.y^T / (H*W)."""
+    x, y = ins["X"], ins["Y"]
+    h, w = x.shape[2], x.shape[3]
+    return {"Out": jnp.einsum("nahw,nbhw->nab", x, y) / (h * w)}
+
+
+@register_op("add_position_encoding", inputs=("X",), outputs=("Out",),
+             attrs={"alpha": 1.0, "beta": 1.0})
+def add_position_encoding(ins, attrs):
+    """add_position_encoding_op.cc: out = alpha*x + beta*sinusoid
+    (transformer PE over [N,T,D])."""
+    x = ins["X"]
+    n, t, dim = x.shape
+    half = dim // 2
+    pos = np.arange(t, dtype=np.float32)[:, None]
+    div = np.power(10000.0, np.arange(half, dtype=np.float32) / half)
+    pe = np.zeros((t, dim), np.float32)
+    pe[:, :half] = np.sin(pos / div)
+    pe[:, half:2 * half] = np.cos(pos / div)
+    return {"Out": attrs["alpha"] * x
+            + attrs["beta"] * jnp.asarray(pe)[None]}
+
+
+@register_op("polygon_box_transform", inputs=("Input",),
+             outputs=("Output",), differentiable=False)
+def polygon_box_transform(ins, attrs):
+    """polygon_box_transform_op.cc (EAST OCR): even channels hold x
+    offsets, odd channels y offsets; out = 4*grid_coord - in."""
+    x = ins["Input"]
+    n, c, h, w = x.shape
+    gx = np.broadcast_to(np.arange(w, dtype=np.float32), (h, w))
+    gy = np.broadcast_to(np.arange(h, dtype=np.float32)[:, None], (h, w))
+    grid = np.zeros((c, h, w), np.float32)
+    grid[0::2] = gx
+    grid[1::2] = gy
+    return {"Output": 4.0 * jnp.asarray(grid)[None] - x}
+
+
+@register_op("similarity_focus", inputs=("X",), outputs=("Out",),
+             differentiable=False,
+             attrs={"axis": REQUIRED, "indexes": REQUIRED})
+def similarity_focus(ins, attrs):
+    """similarity_focus_op.cc: for each selected index along `axis`,
+    greedily mark (row, col) argmax cells; output is a 0/1 mask
+    broadcast over channels.  Re-specified TPU-statically: the mask
+    marks, per selected slice, every cell that is the max of BOTH its
+    row and its column (the fixed point of the reference's greedy
+    selection for distinct values)."""
+    x = ins["X"]
+    axis = int(attrs["axis"])
+    indexes = [int(i) for i in attrs["indexes"]]
+    n = x.shape[0]
+    mask = jnp.zeros_like(x, dtype=x.dtype)
+    for idx in indexes:
+        sl = jnp.take(x, idx, axis=axis)      # [N, d1, d2]
+        row_max = sl == sl.max(axis=2, keepdims=True)
+        col_max = sl == sl.max(axis=1, keepdims=True)
+        m = (row_max | col_max).astype(x.dtype)  # [N, d1, d2]
+        mask = jnp.maximum(mask, jnp.expand_dims(m, axis))
+    return {"Out": mask}

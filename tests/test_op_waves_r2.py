@@ -1,0 +1,392 @@
+"""Numeric tests for the round-2 op waves (vision / loss zoo / misc),
+checked against torch (CPU) or closed-form numpy references — the
+reference's OpTest numpy-comparison pattern (op_test.py:134)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (registers ops)
+from paddle_tpu.core.registry import get_op_def
+
+jnp = pytest.importorskip("jax.numpy")
+torch = pytest.importorskip("torch")
+F = torch.nn.functional
+
+RNG = np.random.RandomState
+
+
+def run(op, ins, attrs=None):
+    d = get_op_def(op)
+    return d.compute(ins, d.canonical_attrs(attrs or {}))
+
+
+# ---------------------------------------------------------------- vision
+
+def test_bilinear_interp_vs_torch():
+    x = RNG(0).randn(2, 3, 5, 7).astype(np.float32)
+    o = run("bilinear_interp", {"X": jnp.asarray(x)},
+            {"out_h": 10, "out_w": 14, "align_corners": True})["Out"]
+    t = F.interpolate(torch.from_numpy(x), size=(10, 14),
+                      mode="bilinear", align_corners=True).numpy()
+    np.testing.assert_allclose(np.asarray(o), t, atol=1e-5)
+    o = run("bilinear_interp", {"X": jnp.asarray(x)},
+            {"out_h": 10, "out_w": 14, "align_corners": False,
+             "align_mode": 0})["Out"]
+    t = F.interpolate(torch.from_numpy(x), size=(10, 14),
+                      mode="bilinear", align_corners=False).numpy()
+    np.testing.assert_allclose(np.asarray(o), t, atol=1e-5)
+
+
+def test_nearest_interp_vs_torch():
+    x = RNG(0).randn(2, 3, 5, 7).astype(np.float32)
+    o = run("nearest_interp", {"X": jnp.asarray(x)},
+            {"out_h": 10, "out_w": 14, "align_corners": False})["Out"]
+    t = F.interpolate(torch.from_numpy(x), size=(10, 14),
+                      mode="nearest").numpy()
+    np.testing.assert_allclose(np.asarray(o), t)
+
+
+def test_conv3d_vs_torch():
+    rng = RNG(0)
+    x = rng.randn(2, 3, 5, 6, 7).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3, 3).astype(np.float32)
+    o = run("conv3d", {"Input": jnp.asarray(x), "Filter": jnp.asarray(w)},
+            {"strides": [1, 2, 1], "paddings": [1, 0, 1]})["Output"]
+    t = F.conv3d(torch.from_numpy(x), torch.from_numpy(w),
+                 stride=(1, 2, 1), padding=(1, 0, 1)).numpy()
+    np.testing.assert_allclose(np.asarray(o), t, atol=1e-4)
+
+
+def test_conv3d_transpose_vs_torch():
+    rng = RNG(0)
+    x = rng.randn(2, 4, 3, 4, 5).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3, 3).astype(np.float32)
+    o = run("conv3d_transpose",
+            {"Input": jnp.asarray(x), "Filter": jnp.asarray(w)},
+            {"strides": [2, 1, 2], "paddings": [1, 1, 0]})["Output"]
+    t = F.conv_transpose3d(torch.from_numpy(x), torch.from_numpy(w),
+                           stride=(2, 1, 2), padding=(1, 1, 0)).numpy()
+    np.testing.assert_allclose(np.asarray(o), t, atol=1e-4)
+
+
+def test_pool3d_and_maxpool_with_index_and_unpool():
+    rng = RNG(0)
+    x = rng.randn(2, 3, 6, 6, 6).astype(np.float32)
+    o = run("pool3d", {"X": jnp.asarray(x)},
+            {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+             "pooling_type": "max"})["Out"]
+    t = F.max_pool3d(torch.from_numpy(x), 2, 2).numpy()
+    np.testing.assert_allclose(np.asarray(o), t)
+
+    x2 = rng.randn(2, 3, 8, 8).astype(np.float32)
+    r = run("max_pool2d_with_index", {"X": jnp.asarray(x2)},
+            {"ksize": [2, 2], "strides": [2, 2]})
+    tv, ti = F.max_pool2d(torch.from_numpy(x2), 2, 2,
+                          return_indices=True)
+    np.testing.assert_allclose(np.asarray(r["Out"]), tv.numpy())
+    np.testing.assert_array_equal(np.asarray(r["Mask"]), ti.numpy())
+    o = run("unpool", {"X": r["Out"], "Indices": r["Mask"]},
+            {"ksize": [2, 2], "strides": [2, 2]})["Out"]
+    t = F.max_unpool2d(tv, ti, 2, 2).numpy()
+    np.testing.assert_allclose(np.asarray(o), t)
+
+
+def test_grid_sampler_affine_grid_vs_torch():
+    rng = RNG(0)
+    x = rng.randn(2, 3, 5, 6).astype(np.float32)
+    g = (rng.rand(2, 4, 4, 2).astype(np.float32) * 2 - 1)
+    o = run("grid_sampler",
+            {"X": jnp.asarray(x), "Grid": jnp.asarray(g)})["Output"]
+    t = F.grid_sample(torch.from_numpy(x), torch.from_numpy(g),
+                      mode="bilinear", padding_mode="zeros",
+                      align_corners=True).numpy()
+    np.testing.assert_allclose(np.asarray(o), t, atol=1e-5)
+
+    th = rng.randn(2, 2, 3).astype(np.float32)
+    o = run("affine_grid", {"Theta": jnp.asarray(th)},
+            {"output_shape": [2, 3, 4, 5]})["Output"]
+    t = F.affine_grid(torch.from_numpy(th), (2, 3, 4, 5),
+                      align_corners=True).numpy()
+    np.testing.assert_allclose(np.asarray(o), t, atol=1e-5)
+
+
+def test_pixel_ops():
+    rng = RNG(0)
+    x = rng.randn(2, 8, 3, 4).astype(np.float32)
+    o = run("pixel_shuffle", {"X": jnp.asarray(x)},
+            {"upscale_factor": 2})["Out"]
+    t = F.pixel_shuffle(torch.from_numpy(x), 2).numpy()
+    np.testing.assert_allclose(np.asarray(o), t)
+
+    x = rng.randn(2, 6, 3, 3).astype(np.float32)
+    o = run("maxout", {"X": jnp.asarray(x)}, {"groups": 2})["Out"]
+    np.testing.assert_allclose(np.asarray(o),
+                               x.reshape(2, 3, 2, 3, 3).max(2))
+
+    x = rng.randn(2, 4, 4, 4).astype(np.float32)
+    o = run("space_to_depth", {"X": jnp.asarray(x)},
+            {"blocksize": 2})["Out"]
+    assert o.shape == (2, 16, 2, 2)
+    # inverse consistency with pixel_shuffle's layout family
+    x = rng.randn(2, 6, 4, 4).astype(np.float32)
+    o = run("shuffle_channel", {"X": jnp.asarray(x)}, {"group": 3})["Out"]
+    ref = x.reshape(2, 3, 2, 4, 4).transpose(0, 2, 1, 3, 4).reshape(
+        2, 6, 4, 4)
+    np.testing.assert_allclose(np.asarray(o), ref)
+
+
+def test_unfold_prelu_vs_torch():
+    rng = RNG(0)
+    x = rng.randn(2, 3, 7, 8).astype(np.float32)
+    o = run("unfold", {"X": jnp.asarray(x)},
+            {"kernel_sizes": [3, 2], "strides": [2, 1],
+             "paddings": [1, 0, 1, 0], "dilations": [1, 2]})["Y"]
+    t = F.unfold(torch.from_numpy(x), (3, 2), dilation=(1, 2),
+                 padding=(1, 0), stride=(2, 1)).numpy()
+    np.testing.assert_allclose(np.asarray(o), t)
+
+    a = np.array([0.1, 0.2, 0.3], np.float32)
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    o = run("prelu", {"X": jnp.asarray(x), "Alpha": jnp.asarray(a)},
+            {"mode": "channel"})["Out"]
+    t = F.prelu(torch.from_numpy(x), torch.from_numpy(a)).numpy()
+    np.testing.assert_allclose(np.asarray(o), t)
+
+
+def test_spp_temporal_shift_row_conv_shapes():
+    rng = RNG(0)
+    o = run("spp", {"X": jnp.asarray(
+        rng.randn(2, 3, 7, 9).astype(np.float32))},
+        {"pyramid_height": 3})
+    assert o["Out"].shape == (2, 3 * (1 + 4 + 16))
+
+    x = rng.randn(8, 4, 2, 2).astype(np.float32)
+    o = run("temporal_shift", {"X": jnp.asarray(x)},
+            {"seg_num": 4})["Out"]
+    v = x.reshape(2, 4, 4, 2, 2)
+    out = np.asarray(o).reshape(2, 4, 4, 2, 2)
+    # first C/4 channels shifted backward (frame t gets t+1)
+    np.testing.assert_allclose(out[:, :-1, 0], v[:, 1:, 0])
+    np.testing.assert_allclose(out[:, -1, 0], 0.0)
+    # next C/4 shifted forward
+    np.testing.assert_allclose(out[:, 1:, 1], v[:, :-1, 1])
+    # rest unchanged
+    np.testing.assert_allclose(out[:, :, 2:], v[:, :, 2:])
+
+    x = rng.randn(2, 5, 4).astype(np.float32)
+    f = rng.randn(3, 4).astype(np.float32)
+    o = run("row_conv", {"X": jnp.asarray(x), "Filter": jnp.asarray(f)})
+    ref = np.zeros_like(x)
+    xp = np.pad(x, ((0, 0), (0, 2), (0, 0)))
+    for j in range(3):
+        ref += xp[:, j:j + 5, :] * f[j]
+    np.testing.assert_allclose(np.asarray(o["Out"]), ref, atol=1e-5)
+
+
+def test_crop_pad_constant_like():
+    rng = RNG(0)
+    x = rng.randn(4, 5, 6).astype(np.float32)
+    o = run("crop", {"X": jnp.asarray(x)},
+            {"offsets": [1, 0, 2], "shape": [2, 3, 4]})["Out"]
+    np.testing.assert_allclose(np.asarray(o), x[1:3, 0:3, 2:6])
+
+    y = rng.randn(2, 3).astype(np.float32)
+    big = np.zeros((4, 5), np.float32)
+    o = run("pad_constant_like",
+            {"X": jnp.asarray(big), "Y": jnp.asarray(y)},
+            {"pad_value": 7.0})["Out"]
+    assert o.shape == (4, 5)
+    np.testing.assert_allclose(np.asarray(o)[:2, :3], y)
+    assert float(np.asarray(o)[3, 4]) == 7.0
+
+
+# ------------------------------------------------------------- loss zoo
+
+def test_loss_zoo_closed_forms():
+    rng = RNG(0)
+    x = rng.randn(6, 1).astype(np.float32)
+    y = (rng.rand(6, 1) > 0.5).astype(np.float32)
+    o = run("hinge_loss",
+            {"Logits": jnp.asarray(x), "Labels": jnp.asarray(y)})["Loss"]
+    np.testing.assert_allclose(np.asarray(o),
+                               np.maximum(0, 1 - x * (2 * y - 1)))
+
+    l = rng.randn(5, 1).astype(np.float32)
+    r = rng.randn(5, 1).astype(np.float32)
+    lab = (rng.rand(5, 1) > 0.5).astype(np.float32)
+    o = run("rank_loss", {"Label": jnp.asarray(lab),
+                          "Left": jnp.asarray(l),
+                          "Right": jnp.asarray(r)})["Out"]
+    np.testing.assert_allclose(
+        np.asarray(o), np.log1p(np.exp(l - r)) - lab * (l - r),
+        atol=1e-6)
+
+    m = run("margin_rank_loss",
+            {"X1": jnp.asarray(l), "X2": jnp.asarray(r),
+             "Label": jnp.asarray(2 * lab - 1)},
+            {"margin": 0.1})["Out"]
+    np.testing.assert_allclose(
+        np.asarray(m),
+        np.maximum(0, -(2 * lab - 1) * (l - r) + 0.1), atol=1e-6)
+
+    xm = rng.randn(7, 1).astype(np.float32)
+    ym = (rng.rand(7, 1) > 0.5).astype(np.float32)
+    o = run("modified_huber_loss",
+            {"X": jnp.asarray(xm), "Y": jnp.asarray(ym)})["Out"]
+    z = (2 * ym - 1) * xm
+    ref = np.where(z < -1, -4 * z, np.where(z < 1, (1 - z) ** 2, 0))
+    np.testing.assert_allclose(np.asarray(o), ref, atol=1e-6)
+
+
+def test_kldiv_smooth_l1_vs_torch():
+    rng = RNG(0)
+    x = rng.randn(4, 5).astype(np.float32)
+    t = np.abs(rng.rand(4, 5)).astype(np.float32)
+    t /= t.sum()
+    o = run("kldiv_loss",
+            {"X": jnp.asarray(x), "Target": jnp.asarray(t)},
+            {"reduction": "batchmean"})["Loss"]
+    ref = F.kl_div(torch.from_numpy(x), torch.from_numpy(t),
+                   reduction="batchmean").numpy()
+    np.testing.assert_allclose(np.asarray(o), ref, atol=1e-6)
+
+    o = run("smooth_l1_loss",
+            {"X": jnp.asarray(x), "Y": jnp.asarray(t)},
+            {"sigma": 1.0})["Out"]
+    ref = F.smooth_l1_loss(torch.from_numpy(x), torch.from_numpy(t),
+                           reduction="none", beta=1.0).numpy().sum(
+        1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(o), ref, atol=1e-6)
+
+
+def test_bpr_teacher_student_cos_sim():
+    rng = RNG(0)
+    xc = rng.randn(4, 6).astype(np.float32)
+    lc = rng.randint(0, 6, (4, 1)).astype(np.int64)
+    o = run("bpr_loss", {"X": jnp.asarray(xc), "Label": jnp.asarray(lc)})
+    ref = np.zeros((4, 1), np.float32)
+    for i in range(4):
+        s = sum(np.log1p(np.exp(xc[i, j] - xc[i, lc[i, 0]]))
+                for j in range(6) if j != lc[i, 0])
+        ref[i, 0] = s / 5
+    np.testing.assert_allclose(np.asarray(o["Y"]), ref, atol=1e-5)
+
+    xs = rng.randn(5, 1).astype(np.float32)
+    b0 = np.maximum(xs, 0) + np.log1p(np.exp(-np.abs(xs)))
+    for lab_v, ref in [(-2.0, b0), (-1.0, b0 - xs),
+                       (0.7, b0 + b0 - xs * 0.7),
+                       (1.7, (b0 - xs) + (b0 - xs * 0.7))]:
+        lv = np.full((5, 1), lab_v, np.float32)
+        o = run("teacher_student_sigmoid_loss",
+                {"X": jnp.asarray(xs), "Label": jnp.asarray(lv)})["Y"]
+        np.testing.assert_allclose(np.asarray(o), ref, atol=1e-5)
+
+    xa = rng.randn(3, 4).astype(np.float32)
+    ya = rng.randn(1, 4).astype(np.float32)
+    o = run("cos_sim", {"X": jnp.asarray(xa), "Y": jnp.asarray(ya)})
+    ref = (xa * ya).sum(1, keepdims=True) / (
+        np.linalg.norm(xa, axis=1, keepdims=True)
+        * np.linalg.norm(ya, axis=1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(o["Out"]), ref, atol=1e-5)
+
+
+# ----------------------------------------------------------------- misc
+
+def test_misc_small_ops():
+    rng = RNG(0)
+    x = rng.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(run("sign", {"X": jnp.asarray(x)})["Out"]),
+        np.sign(x))
+    np.testing.assert_allclose(
+        np.asarray(run("diag", {"Diagonal": jnp.asarray(x[0])})["Out"]),
+        np.diag(x[0]))
+    assert int(run("size", {"Input": jnp.asarray(x)})["Out"][0]) == 12
+    np.testing.assert_allclose(
+        np.asarray(run("minus", {"X": jnp.asarray(x),
+                                 "Y": jnp.asarray(x * 0.5)})["Out"]),
+        x * 0.5)
+    assert bool(run("is_empty", {"X": jnp.zeros((0, 3))})["Out"])
+    o = run("fill", {}, {"value": [1.0, 2.0, 3.0, 4.0], "shape": [2, 2]})
+    np.testing.assert_allclose(np.asarray(o["Out"]),
+                               [[1, 2], [3, 4]])
+
+
+def test_multiplex_mean_iou_btp_cvm():
+    rng = RNG(0)
+    xs = [jnp.asarray(rng.randn(4, 3).astype(np.float32))
+          for _ in range(3)]
+    ids = np.array([[0], [2], [1], [0]])
+    o = run("multiplex", {"X": xs, "Ids": jnp.asarray(ids)})["Out"]
+    ref = np.stack([np.asarray(xs[ids[i, 0]])[i] for i in range(4)])
+    np.testing.assert_allclose(np.asarray(o), ref)
+
+    pred = np.array([0, 1, 2, 2])
+    lab = np.array([0, 1, 1, 2])
+    o = run("mean_iou", {"Predictions": jnp.asarray(pred),
+                         "Labels": jnp.asarray(lab)},
+            {"num_classes": 3})
+    assert abs(float(o["OutMeanIou"][0]) - 2 / 3) < 1e-6
+
+    xb = rng.randn(2, 3).astype(np.float32)
+    yb = rng.randn(2, 4).astype(np.float32)
+    w = rng.randn(5, 3, 4).astype(np.float32)
+    o = run("bilinear_tensor_product",
+            {"X": jnp.asarray(xb), "Y": jnp.asarray(yb),
+             "Weight": jnp.asarray(w)})["Out"]
+    np.testing.assert_allclose(np.asarray(o),
+                               np.einsum("ni,kij,nj->nk", xb, w, yb),
+                               atol=1e-5)
+
+    xc = np.abs(rng.randn(3, 5)).astype(np.float32)
+    o = run("cvm", {"X": jnp.asarray(xc)}, {"use_cvm": True})["Y"]
+    np.testing.assert_allclose(np.asarray(o)[:, 0], np.log(xc[:, 0] + 1),
+                               atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(o)[:, 1],
+        np.log(xc[:, 1] + 1) - np.log(xc[:, 0] + 1), atol=1e-6)
+    assert run("cvm", {"X": jnp.asarray(xc)},
+               {"use_cvm": False})["Y"].shape == (3, 3)
+
+
+def test_cross_entropy2_and_average_accumulates():
+    rng = RNG(0)
+    xp = np.abs(rng.rand(4, 5)).astype(np.float32)
+    xp /= xp.sum(1, keepdims=True)
+    lbl = rng.randint(0, 5, (4, 1)).astype(np.int64)
+    o = run("cross_entropy2",
+            {"X": jnp.asarray(xp), "Label": jnp.asarray(lbl)})
+    ref = -np.log([xp[i, lbl[i, 0]] for i in range(4)])
+    np.testing.assert_allclose(np.asarray(o["Y"]).reshape(-1), ref,
+                               atol=1e-6)
+
+    p = jnp.asarray(np.ones((2, 2), np.float32))
+    st = {"param": p,
+          "in_sum_1": jnp.zeros((2, 2)), "in_sum_2": jnp.zeros((2, 2)),
+          "in_sum_3": jnp.zeros((2, 2)),
+          "in_num_accumulates": jnp.zeros((1,), np.int32),
+          "in_old_num_accumulates": jnp.zeros((1,), np.int32),
+          "in_num_updates": jnp.zeros((1,), np.int32)}
+    o = run("average_accumulates", st,
+            {"average_window": 0.5, "max_average_window": 100,
+             "min_average_window": 2})
+    np.testing.assert_allclose(np.asarray(o["out_sum_1"]), 1.0)
+    assert int(o["out_num_updates"][0]) == 1
+
+
+def test_random_crop_and_sampling_id():
+    rng = RNG(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    o = run("random_crop", {"X": jnp.asarray(x)},
+            {"shape": [5, 5], "startup_seed": 3})
+    assert o["Out"].shape == (2, 3, 5, 5)
+    # crop content must be a contiguous window of x
+    out = np.asarray(o["Out"])
+    found = any(
+        np.allclose(out, x[:, :, i:i + 5, j:j + 5])
+        for i in range(4) for j in range(4))
+    assert found
+
+    probs = np.array([[0.0, 1.0, 0.0]] * 8, np.float32)
+    o = run("sampling_id", {"X": jnp.asarray(probs)})
+    assert (np.asarray(o["Out"]) == 1).all()
