@@ -23,3 +23,5 @@ from paddle_tpu.ops import ps_ops  # noqa: F401
 from paddle_tpu.ops import loss_ops  # noqa: F401
 from paddle_tpu.ops import vision  # noqa: F401
 from paddle_tpu.ops import misc  # noqa: F401
+from paddle_tpu.ops import rnn_ops  # noqa: F401
+from paddle_tpu.ops import fused_ops  # noqa: F401

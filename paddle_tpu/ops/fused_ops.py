@@ -1,0 +1,267 @@
+"""Fused op family (reference /root/reference/paddle/fluid/operators/fused/).
+
+On TPU these exist for *program-level parity*: XLA fuses elementwise
+chains into matmuls on its own, so each op here is simply the
+mathematical composition, registered so reference programs (and the
+inference fusion passes) can target the same op types:
+  fused_elemwise_activation_op.cc (binary/unary compounds),
+  fused_embedding_seq_pool_op.cc, fused_embedding_fc_lstm_op.cc,
+  fusion_seqconv_eltadd_relu_op.cc, fusion_seqpool_concat_op.cc,
+  fusion_repeated_fc_relu_op.cc, fusion_squared_mat_sub_op.cc,
+  fusion_transpose_flatten_concat_op.cc, conv2d_fusion_op.cc,
+  fusion_seqexpand_concat_fc_op.cc.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import REQUIRED, get_op_def, register_op
+
+_UNARY = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "identity": lambda x: x,
+}
+_BINARY = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+}
+
+
+def _functor(name, attrs):
+    if name == "scale":
+        s = float(attrs.get("scale", 1.0))
+        return lambda x: x * s
+    if name in _UNARY:
+        return _UNARY[name]
+    return None
+
+
+@register_op("fused_elemwise_activation", inputs=("X", "Y"),
+             outputs=("Out", "IntermediateOut"),
+             attrs={"functor_list": REQUIRED, "scale": 1.0, "axis": -1,
+                    "save_intermediate_out": False})
+def fused_elemwise_activation(ins, attrs):
+    """fused_elemwise_activation_op.h: functor_list of two.
+    {unary, binary} -> out = unary(binary(x, y))  (unary compound);
+    {binary, unary} -> out = binary(x, unary(y))  (binary compound)."""
+    x, y = ins["X"], ins["Y"]
+    f0, f1 = list(attrs["functor_list"])
+    if f0 in _BINARY:       # binary compound
+        inter = _functor(f1, attrs)(y)
+        out = _BINARY[f0](x, inter)
+    else:                   # unary compound
+        inter = _BINARY[f1](x, y)
+        out = _functor(f0, attrs)(inter)
+    return {"Out": out, "IntermediateOut": inter}
+
+
+@register_op("fused_embedding_seq_pool", inputs=("W", "Ids"),
+             outputs=("Out",),
+             attrs={"combiner": "sum", "is_sparse": False,
+                    "padding_idx": -1})
+def fused_embedding_seq_pool(ins, attrs):
+    """fused_embedding_seq_pool_op.cc: embedding lookup + sum pool over
+    the sequence axis; Ids padded [B, T, 1] with padding_idx rows
+    contributing zero (the LoD re-spec)."""
+    w, ids = ins["W"], ins["Ids"]
+    b = ids.shape[0]
+    flat = ids.reshape(b, -1).astype(jnp.int32)
+    emb = w[flat]                          # [B, T, D]
+    pad = int(attrs["padding_idx"])
+    if pad >= 0:
+        emb = emb * (flat != pad)[..., None].astype(emb.dtype)
+    return {"Out": emb.sum(axis=1)}
+
+
+@register_op("fused_embedding_fc_lstm",
+             inputs=("Ids", "Embeddings", "WeightH", "Bias", "H0", "C0"),
+             outputs=("Hidden", "Cell"),
+             optional=("H0", "C0"),
+             attrs={"use_peepholes": False, "is_reverse": False,
+                    "gate_activation": "sigmoid",
+                    "cell_activation": "tanh",
+                    "candidate_activation": "tanh"})
+def fused_embedding_fc_lstm(ins, attrs):
+    """fused_embedding_fc_lstm_op.cc: Embeddings is the PRE-PROJECTED
+    table (V x 4D, embedding folded into the x->gates fc), so lookup
+    directly yields gate pre-activations; then the lstm scan."""
+    ids = ins["Ids"]
+    b = ids.shape[0]
+    flat = ids.reshape(b, -1).astype(jnp.int32)
+    gates = ins["Embeddings"][flat]        # [B, T, 4D]
+    sub = {"Input": gates, "Weight": ins["WeightH"],
+           "Bias": ins["Bias"]}
+    for k in ("H0", "C0"):
+        if ins.get(k) is not None:
+            sub[k] = ins[k]
+    lstm = get_op_def("lstm")
+    return lstm.compute(sub, lstm.canonical_attrs(
+        {k: attrs[k] for k in
+         ("use_peepholes", "is_reverse", "gate_activation",
+          "cell_activation", "candidate_activation")}))
+
+
+@register_op("fusion_seqconv_eltadd_relu",
+             inputs=("X", "Filter", "Bias"), outputs=("Out",),
+             attrs={"contextLength": REQUIRED, "contextStart": 0,
+                    "contextStride": 1})
+def fusion_seqconv_eltadd_relu(ins, attrs):
+    """fusion_seqconv_eltadd_relu_op.cc: sequence_conv + bias + relu on
+    padded [B, T, D]; Filter [ctx*D, M]."""
+    x, f, bias = ins["X"], ins["Filter"], ins["Bias"]
+    b, t, d = x.shape
+    ctx = int(attrs["contextLength"])
+    start = int(attrs["contextStart"])
+    cols = []
+    for j in range(ctx):
+        off = start + j
+        if off < 0:
+            sl = jnp.pad(x[:, :max(t + off, 0)],
+                         ((0, 0), (min(-off, t), 0), (0, 0)))
+        else:
+            sl = jnp.pad(x[:, off:], ((0, 0), (0, min(off, t)), (0, 0)))
+        cols.append(sl)
+    col = jnp.concatenate(cols, axis=2)     # [B, T, ctx*D]
+    out = col @ f + bias.reshape(1, 1, -1)
+    return {"Out": jax.nn.relu(out)}
+
+
+@register_op("fusion_seqpool_concat", inputs=("X",), outputs=("Out",),
+             duplicable=("X",),
+             attrs={"pooltype": "SUM", "axis": 1})
+def fusion_seqpool_concat(ins, attrs):
+    """fusion_seqpool_concat_op.cc: pool each padded [B, T, D_i] over T
+    then concat on features."""
+    outs = []
+    for x in ins["X"]:
+        if attrs["pooltype"] == "SUM":
+            outs.append(x.sum(axis=1))
+        elif attrs["pooltype"] == "AVERAGE":
+            outs.append(x.mean(axis=1))
+        else:  # SQRT
+            outs.append(x.sum(axis=1) / np.sqrt(x.shape[1]))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("fusion_repeated_fc_relu", inputs=("X", "W", "Bias"),
+             outputs=("Out",), duplicable=("W", "Bias"))
+def fusion_repeated_fc_relu(ins, attrs):
+    """fusion_repeated_fc_relu_op.cc: x -> relu(fc) repeated."""
+    x = ins["X"]
+    for w, b in zip(ins["W"], ins["Bias"]):
+        x = jax.nn.relu(x @ w + b.reshape(1, -1))
+    return {"Out": x}
+
+
+@register_op("fusion_squared_mat_sub", inputs=("X", "Y"),
+             outputs=("SquaredX", "SquaredY", "SquaredXY", "Out"),
+             attrs={"scalar": 1.0})
+def fusion_squared_mat_sub(ins, attrs):
+    """fusion_squared_mat_sub_op.cc: scalar * ((XY)^2 - X^2 Y^2)."""
+    x, y = ins["X"], ins["Y"]
+    sx, sy = x * x, y * y
+    sxy = (x @ y) ** 2
+    return {"SquaredX": sx, "SquaredY": sy, "SquaredXY": sxy,
+            "Out": attrs["scalar"] * (sxy - sx @ sy)}
+
+
+@register_op("fusion_transpose_flatten_concat", inputs=("X",),
+             outputs=("Out",), duplicable=("X",),
+             attrs={"trans_axis": REQUIRED, "flatten_axis": REQUIRED,
+                    "concat_axis": REQUIRED})
+def fusion_transpose_flatten_concat(ins, attrs):
+    """fusion_transpose_flatten_concat_op.cc."""
+    ta = [int(a) for a in attrs["trans_axis"]]
+    fa = int(attrs["flatten_axis"])
+    outs = []
+    for x in ins["X"]:
+        x = jnp.transpose(x, ta)
+        lead = int(np.prod(x.shape[:fa])) if fa else 1
+        outs.append(x.reshape(lead, -1))
+    return {"Out": jnp.concatenate(outs, axis=int(attrs["concat_axis"]))}
+
+
+@register_op("conv2d_fusion",
+             inputs=("Input", "Filter", "Bias", "ResidualData"),
+             outputs=("Output",), optional=("Bias", "ResidualData"),
+             attrs={"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 1,
+                    "activation": "relu", "data_format": "NCHW"})
+def conv2d_fusion(ins, attrs):
+    """conv2d_fusion_op.cc: conv + bias + (residual add) + act."""
+    conv = get_op_def("conv2d")
+    out = conv.compute(
+        {"Input": ins["Input"], "Filter": ins["Filter"]},
+        conv.canonical_attrs({k: attrs[k] for k in
+                              ("strides", "paddings", "dilations",
+                               "groups", "data_format")}))["Output"]
+    if ins.get("Bias") is not None:
+        out = out + ins["Bias"].reshape(1, -1, 1, 1)
+    if ins.get("ResidualData") is not None:
+        out = out + ins["ResidualData"]
+    act = _UNARY.get(attrs["activation"], lambda x: x)
+    return {"Output": act(out)}
+
+
+@register_op("fusion_seqexpand_concat_fc",
+             inputs=("X", "FCWeight", "FCBias"), outputs=("Out",),
+             duplicable=("X",), optional=("FCBias",),
+             attrs={"fc_activation": "relu"})
+def fusion_seqexpand_concat_fc(ins, attrs):
+    """fusion_seqexpand_concat_fc_op.cc: X[0] is [B, T, D0]; the rest
+    are [B, D_i] broadcast (seq-expanded) over T; concat on features,
+    then fc + activation."""
+    xs = ins["X"]
+    base = xs[0]
+    b, t, _ = base.shape
+    feats = [base] + [
+        jnp.broadcast_to(x[:, None, :], (b, t, x.shape[-1]))
+        for x in xs[1:]]
+    cat = jnp.concatenate(feats, axis=2)
+    out = cat @ ins["FCWeight"]
+    if ins.get("FCBias") is not None:
+        out = out + ins["FCBias"].reshape(1, 1, -1)
+    return {"Out": _UNARY.get(attrs["fc_activation"],
+                              lambda x: x)(out)}
+
+
+@register_op("conv2d_inception_fusion",
+             inputs=("Input", "Filter", "Bias"), outputs=("Output",),
+             duplicable=("Filter", "Bias"),
+             attrs={"pooling_type": "max", "exclude_padding": True,
+                    "activation": "relu"})
+def conv2d_inception_fusion(ins, attrs):
+    """conv2d_inception_fusion_op.cc: 4-branch inception block —
+    1x1 conv | 1x1->3x3 | 1x1->3x3->3x3 | pool->1x1, channel concat.
+    Filter/Bias lists follow the reference's branch order."""
+    x = ins["Input"]
+    fs, bs = ins["Filter"], ins["Bias"]
+    act = _UNARY.get(attrs["activation"], lambda v: v)
+    conv = get_op_def("conv2d")
+
+    def c(inp, w, b, pad):
+        o = conv.compute(
+            {"Input": inp, "Filter": w},
+            conv.canonical_attrs({"paddings": [pad, pad]}))["Output"]
+        return act(o + b.reshape(1, -1, 1, 1))
+
+    branches = []
+    branches.append(c(x, fs[0], bs[0], 0))
+    b1 = c(x, fs[1], bs[1], 0)
+    branches.append(c(b1, fs[2], bs[2], 1))
+    b2 = c(x, fs[3], bs[3], 0)
+    b2 = c(b2, fs[4], bs[4], 1)
+    branches.append(c(b2, fs[5], bs[5], 1))
+    pooled = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, 3, 3), (1, 1, 1, 1),
+        ((0, 0), (0, 0), (1, 1), (1, 1)))
+    branches.append(c(pooled, fs[6], bs[6], 0))
+    return {"Output": jnp.concatenate(branches, axis=1)}

@@ -1,0 +1,510 @@
+"""Fused full-sequence RNN ops + CTC family.
+
+Reference parity (/root/reference/paddle/fluid/operators/):
+  gru_op.cc (gates u,r,c; h_t = (1-u)h_prev + u*c, origin_mode flips),
+  gru_unit_op.cc, lstm_op.cc (Weight={W_ch,W_ih,W_fh,W_oh}, Bias 4D or
+  7D with peepholes {b_c,b_i,b_f,b_o,W_ic,W_fc,W_oc}), lstm_unit_op.h
+  (X gate order i,f,o,g with forget_bias), lstmp_op.cc (recurrent
+  projection), cudnn_lstm_op.cc, fused/fusion_gru_op.cc,
+  fused/fusion_lstm_op.cc, warpctc_op.cc, ctc_align_op.cc,
+  edit_distance_op.cc.
+
+TPU re-specification (SURVEY.md §5 LoD note): the reference's LoD
+sequence inputs become padded [B, T, ...] plus an optional int Length
+[B]; the time recursion is one lax.scan (XLA While) so the whole layer
+stays inside the compiled program; grads come from jax.vjp through the
+scan.  cudnn_lstm's opaque packed weight is re-specified as the
+explicit concatenation [Wx | Wh | b] documented on the op.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import REQUIRED, register_op
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _length_mask(length, b, t):
+    """[B, T] float mask from Length [B] (or None -> all ones)."""
+    if length is None:
+        return None
+    steps = jnp.arange(t)[None, :]
+    return (steps < length.reshape(b, 1)).astype(jnp.float32)
+
+
+def _gru_step(g, h_prev, w, act, act_gate, origin_mode):
+    """g: [B, 3D] pre-projected (u, r, c); w: [D, 3D]."""
+    d = h_prev.shape[-1]
+    uru = g[:, :2 * d] + h_prev @ w[:, :2 * d]
+    u = act_gate(uru[:, :d])
+    r = act_gate(uru[:, d:])
+    c = act(g[:, 2 * d:] + (r * h_prev) @ w[:, 2 * d:])
+    if origin_mode:
+        return u * h_prev + (1.0 - u) * c
+    return (1.0 - u) * h_prev + u * c
+
+
+@register_op("gru", inputs=("Input", "H0", "Weight", "Bias", "Length"),
+             outputs=("Hidden",), optional=("H0", "Bias", "Length"),
+             attrs={"activation": "tanh", "gate_activation": "sigmoid",
+                    "is_reverse": False, "origin_mode": False})
+def gru(ins, attrs):
+    """gru_op.cc on padded [B, T, 3D] input (pre-projected x@Wx, gate
+    order u,r,c); Weight [D, 3D] = {W_u|W_r|W_c}."""
+    x, w = ins["Input"], ins["Weight"]
+    b, t, three_d = x.shape
+    d = three_d // 3
+    if ins.get("Bias") is not None:
+        x = x + ins["Bias"].reshape(1, 1, 3 * d)
+    h0 = ins.get("H0")
+    if h0 is None:
+        h0 = jnp.zeros((b, d), x.dtype)
+    act = _ACT[attrs["activation"]]
+    act_gate = _ACT[attrs["gate_activation"]]
+    mask = _length_mask(ins.get("Length"), b, t)
+    xs = jnp.swapaxes(x, 0, 1)              # [T, B, 3D]
+    if attrs["is_reverse"]:
+        xs = jnp.flip(xs, axis=0)
+        if mask is not None:
+            mask = jnp.flip(mask, axis=1)
+
+    def step(h, inp):
+        g, m = inp
+        h_new = _gru_step(g, h, w, act, act_gate, attrs["origin_mode"])
+        if m is not None:
+            h_new = m[:, None] * h_new + (1.0 - m[:, None]) * h
+        return h_new, h_new
+
+    msec = jnp.swapaxes(mask, 0, 1) if mask is not None else \
+        jnp.ones((t, b), jnp.float32)
+    _, hs = lax.scan(lambda h, i: step(h, (i[0], i[1])), h0, (xs, msec))
+    hs = jnp.swapaxes(hs, 0, 1)             # [B, T, D]
+    if attrs["is_reverse"]:
+        hs = jnp.flip(hs, axis=1)
+    return {"Hidden": hs}
+
+
+@register_op("gru_unit",
+             inputs=("Input", "HiddenPrev", "Weight", "Bias"),
+             outputs=("Gate", "ResetHiddenPrev", "Hidden"),
+             optional=("Bias",),
+             attrs={"activation": "tanh", "gate_activation": "sigmoid",
+                    "origin_mode": False})
+def gru_unit(ins, attrs):
+    """gru_unit_op.cc single step; outputs cache the gate values the
+    reference backward consumes."""
+    g, h_prev, w = ins["Input"], ins["HiddenPrev"], ins["Weight"]
+    d = h_prev.shape[-1]
+    if ins.get("Bias") is not None:
+        g = g + ins["Bias"].reshape(1, 3 * d)
+    act = _ACT[attrs["activation"]]
+    act_gate = _ACT[attrs["gate_activation"]]
+    uru = g[:, :2 * d] + h_prev @ w[:, :2 * d]
+    u = act_gate(uru[:, :d])
+    r = act_gate(uru[:, d:])
+    rhp = r * h_prev
+    c = act(g[:, 2 * d:] + rhp @ w[:, 2 * d:])
+    if attrs["origin_mode"]:
+        h = u * h_prev + (1.0 - u) * c
+    else:
+        h = (1.0 - u) * h_prev + u * c
+    return {"Gate": jnp.concatenate([u, r, c], axis=1),
+            "ResetHiddenPrev": rhp, "Hidden": h}
+
+
+def _lstm_scan(x, h0, c0, w, bias, use_peepholes, acts, is_reverse,
+               mask, proj_w=None, proj_act=None):
+    """Shared LSTM scan.  x: [B,T,4D] pre-projected, gate order
+    c,i,f,o (lstm_op.cc Weight={W_ch,W_ih,W_fh,W_oh}); w: [R,4D] where
+    R = D (lstm) or proj size (lstmp)."""
+    b, t, four_d = x.shape
+    d = four_d // 4
+    act_g, act_gate, act_h = acts
+    if bias is not None:
+        x = x + bias[..., :4 * d].reshape(1, 1, 4 * d)
+        peep = bias[..., 4 * d:].reshape(-1) if use_peepholes else None
+    else:
+        peep = None
+    xs = jnp.swapaxes(x, 0, 1)
+    msec = jnp.swapaxes(mask, 0, 1) if mask is not None else \
+        jnp.ones((t, b), jnp.float32)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+        msec = jnp.flip(msec, axis=0)
+
+    def step(carry, inp):
+        h, c = carry
+        g, m = inp
+        z = g + h @ w
+        zc, zi, zf, zo = (z[:, :d], z[:, d:2 * d], z[:, 2 * d:3 * d],
+                          z[:, 3 * d:])
+        if peep is not None:
+            zi = zi + peep[:d] * c
+            zf = zf + peep[d:2 * d] * c
+        i = act_gate(zi)
+        f = act_gate(zf)
+        c_new = f * c + i * act_g(zc)
+        if peep is not None:
+            zo = zo + peep[2 * d:] * c_new
+        o = act_gate(zo)
+        h_new = o * act_h(c_new)
+        if proj_w is not None:
+            h_new = h_new @ proj_w
+            if proj_act is not None:
+                h_new = proj_act(h_new)
+        mm = m[:, None]
+        h_new = mm * h_new + (1 - mm) * h
+        c_new = mm * c_new + (1 - mm) * c
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), (xs, msec))
+    hs = jnp.swapaxes(hs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        hs = jnp.flip(hs, axis=1)
+        cs = jnp.flip(cs, axis=1)
+    return hs, cs
+
+
+@register_op("lstm",
+             inputs=("Input", "H0", "C0", "Weight", "Bias", "Length"),
+             outputs=("Hidden", "Cell"),
+             optional=("H0", "C0", "Bias", "Length"),
+             attrs={"use_peepholes": True, "is_reverse": False,
+                    "gate_activation": "sigmoid",
+                    "cell_activation": "tanh",
+                    "candidate_activation": "tanh"})
+def lstm(ins, attrs):
+    x, w = ins["Input"], ins["Weight"]
+    b, t, four_d = x.shape
+    d = four_d // 4
+    h0 = ins.get("H0")
+    c0 = ins.get("C0")
+    if h0 is None:
+        h0 = jnp.zeros((b, d), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, d), x.dtype)
+    mask = _length_mask(ins.get("Length"), b, t)
+    hs, cs = _lstm_scan(
+        x, h0, c0, w, ins.get("Bias"), attrs["use_peepholes"],
+        (_ACT[attrs["candidate_activation"]],
+         _ACT[attrs["gate_activation"]],
+         _ACT[attrs["cell_activation"]]),
+        attrs["is_reverse"], mask)
+    return {"Hidden": hs, "Cell": cs}
+
+
+@register_op("lstmp",
+             inputs=("Input", "H0", "C0", "Weight", "ProjWeight",
+                     "Bias", "Length"),
+             outputs=("Projection", "Cell"),
+             optional=("H0", "C0", "Bias", "Length"),
+             attrs={"use_peepholes": True, "is_reverse": False,
+                    "gate_activation": "sigmoid",
+                    "cell_activation": "tanh",
+                    "candidate_activation": "tanh",
+                    "proj_activation": "tanh"})
+def lstmp(ins, attrs):
+    """lstmp_op.cc: LSTM with recurrent projection r_t =
+    act_proj(h_t @ ProjWeight); the projection feeds the recurrence."""
+    x, w, pw = ins["Input"], ins["Weight"], ins["ProjWeight"]
+    b, t, four_d = x.shape
+    d = four_d // 4
+    p = pw.shape[1]
+    h0 = ins.get("H0")
+    c0 = ins.get("C0")
+    if h0 is None:
+        h0 = jnp.zeros((b, p), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, d), x.dtype)
+    mask = _length_mask(ins.get("Length"), b, t)
+    hs, cs = _lstm_scan(
+        x, h0, c0, w, ins.get("Bias"), attrs["use_peepholes"],
+        (_ACT[attrs["candidate_activation"]],
+         _ACT[attrs["gate_activation"]],
+         _ACT[attrs["cell_activation"]]),
+        attrs["is_reverse"], mask, proj_w=pw,
+        proj_act=_ACT[attrs["proj_activation"]])
+    return {"Projection": hs, "Cell": cs}
+
+
+@register_op("lstm_unit", inputs=("X", "C_prev"), outputs=("C", "H"),
+             attrs={"forget_bias": 0.0})
+def lstm_unit(ins, attrs):
+    """lstm_unit_op.h: X [B, 4D] gate order i, f, o, g."""
+    x, c_prev = ins["X"], ins["C_prev"]
+    d = c_prev.shape[-1]
+    i = jax.nn.sigmoid(x[:, :d])
+    f = jax.nn.sigmoid(x[:, d:2 * d] + attrs["forget_bias"])
+    o = jax.nn.sigmoid(x[:, 2 * d:3 * d])
+    g = jnp.tanh(x[:, 3 * d:])
+    c = f * c_prev + i * g
+    return {"C": c, "H": o * jnp.tanh(c)}
+
+
+@register_op("cudnn_lstm",
+             inputs=("Input", "InitH", "InitC", "W", "Length"),
+             outputs=("Out", "last_h", "last_c"),
+             optional=("InitH", "InitC", "Length"),
+             attrs={"hidden_size": REQUIRED, "is_bidirec": False,
+                    "input_size": -1, "is_test": False, "seed": 0,
+                    "dropout_prob": 0.0})
+def cudnn_lstm(ins, attrs):
+    """cudnn_lstm_op.cc re-spec: the cudnn packed weight blob becomes
+    the explicit flat concatenation per direction of
+    [Wx (I*4D) | Wh (D*4D) | b (4D)] (gate order c,i,f,o like lstm);
+    bidirectional concatenates both directions' outputs on the feature
+    axis.  XLA compiles the scan; there is no cudnn."""
+    x = ins["Input"]                          # [B, T, I]
+    b, t, isz = x.shape
+    d = int(attrs["hidden_size"])
+    dirs = 2 if attrs["is_bidirec"] else 1
+    w = ins["W"].reshape(-1)
+    per = isz * 4 * d + d * 4 * d + 4 * d
+    outs, lhs, lcs = [], [], []
+    mask = _length_mask(ins.get("Length"), b, t)
+    for direction in range(dirs):
+        off = direction * per
+        wx = w[off:off + isz * 4 * d].reshape(isz, 4 * d)
+        wh = w[off + isz * 4 * d:
+               off + isz * 4 * d + d * 4 * d].reshape(d, 4 * d)
+        bias = w[off + per - 4 * d:off + per].reshape(1, 4 * d)
+        h0 = ins.get("InitH")
+        c0 = ins.get("InitC")
+        h0 = jnp.zeros((b, d), x.dtype) if h0 is None else \
+            h0.reshape(dirs, b, d)[direction]
+        c0 = jnp.zeros((b, d), x.dtype) if c0 is None else \
+            c0.reshape(dirs, b, d)[direction]
+        hs, cs = _lstm_scan(
+            x @ wx, h0, c0, wh, bias, False,
+            (jnp.tanh, jax.nn.sigmoid, jnp.tanh),
+            direction == 1, mask)
+        outs.append(hs)
+        lhs.append(hs[:, -1])
+        lcs.append(cs[:, -1])
+    return {"Out": jnp.concatenate(outs, axis=-1),
+            "last_h": jnp.stack(lhs, axis=0),
+            "last_c": jnp.stack(lcs, axis=0)}
+
+
+@register_op("fusion_gru",
+             inputs=("X", "H0", "WeightX", "WeightH", "Bias", "Length"),
+             outputs=("Hidden",),
+             optional=("H0", "Bias", "Length"),
+             attrs={"activation": "tanh", "gate_activation": "sigmoid",
+                    "is_reverse": False, "origin_mode": False,
+                    "use_seq": True})
+def fusion_gru(ins, attrs):
+    """fused/fusion_gru_op.cc: x-projection + gru in one op."""
+    x = ins["X"] @ ins["WeightX"]
+    sub = {"Input": x, "Weight": ins["WeightH"]}
+    for k in ("H0", "Bias", "Length"):
+        if ins.get(k) is not None:
+            sub[k] = ins[k]
+    return gru(sub, {k: attrs[k] for k in
+                     ("activation", "gate_activation", "is_reverse",
+                      "origin_mode")})
+
+
+@register_op("fusion_lstm",
+             inputs=("X", "H0", "C0", "WeightX", "WeightH", "Bias",
+                     "Length"),
+             outputs=("Hidden", "Cell"),
+             optional=("H0", "C0", "Bias", "Length"),
+             attrs={"use_peepholes": True, "is_reverse": False,
+                    "gate_activation": "sigmoid",
+                    "cell_activation": "tanh",
+                    "candidate_activation": "tanh"})
+def fusion_lstm(ins, attrs):
+    """fused/fusion_lstm_op.cc: x-projection + lstm in one op."""
+    x = ins["X"] @ ins["WeightX"]
+    sub = {"Input": x, "Weight": ins["WeightH"]}
+    for k in ("H0", "C0", "Bias", "Length"):
+        if ins.get(k) is not None:
+            sub[k] = ins[k]
+    return lstm(sub, {k: attrs[k] for k in
+                      ("use_peepholes", "is_reverse", "gate_activation",
+                       "cell_activation", "candidate_activation")})
+
+
+# ---------------------------------------------------------------------------
+# CTC family
+# ---------------------------------------------------------------------------
+
+_NEG = -1e30
+
+
+@register_op("warpctc",
+             inputs=("Logits", "Label", "LogitsLength", "LabelLength"),
+             outputs=("Loss",),
+             optional=("LogitsLength", "LabelLength"),
+             attrs={"blank": 0, "norm_by_times": False})
+def warpctc(ins, attrs):
+    """warpctc_op.cc re-spec: CTC negative log-likelihood via the
+    standard log-space forward algorithm as one lax.scan over time
+    (replaces the external warp-ctc library).  Logits [B, T, C]
+    (unnormalized), Label [B, L] padded, lengths optional."""
+    logits, label = ins["Logits"], ins["Label"]
+    b, t, c = logits.shape
+    if label.ndim > 2:
+        label = label.reshape(b, -1)
+    lmax = label.shape[1]
+    blank = int(attrs["blank"])
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    llen = ins.get("LogitsLength")
+    llen = jnp.full((b,), t, jnp.int32) if llen is None else \
+        llen.reshape(b).astype(jnp.int32)
+    tlen = ins.get("LabelLength")
+    tlen = jnp.full((b,), lmax, jnp.int32) if tlen is None else \
+        tlen.reshape(b).astype(jnp.int32)
+
+    # extended label sequence: blank l1 blank l2 ... blank  [B, S=2L+1]
+    s = 2 * lmax + 1
+    ext = jnp.full((b, s), blank, label.dtype)
+    ext = ext.at[:, 1::2].set(label)
+    ext_valid = jnp.arange(s)[None, :] < (2 * tlen + 1)[:, None]
+    # can we skip from s-2 to s (different labels, not blank)?
+    skip_ok = jnp.zeros((b, s), bool)
+    skip_ok = skip_ok.at[:, 2::2].set(False)
+    same_prev = jnp.concatenate(
+        [jnp.zeros((b, 1), bool),
+         label[:, 1:] == label[:, :-1]], axis=1)       # [B, L]
+    skip_ok = skip_ok.at[:, 3::2].set(~same_prev[:, 1:])
+    ext_lp = jnp.take_along_axis(
+        log_probs, jnp.broadcast_to(
+            ext[:, None, :], (b, t, s)).astype(jnp.int32), axis=2)
+
+    alpha0 = jnp.full((b, s), _NEG)
+    alpha0 = alpha0.at[:, 0].set(ext_lp[:, 0, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(tlen > 0, ext_lp[:, 0, 1], _NEG))
+
+    def step(alpha, inp):
+        lp_t, t_idx = inp
+        a_prev1 = jnp.concatenate(
+            [jnp.full((b, 1), _NEG), alpha[:, :-1]], axis=1)
+        a_prev2 = jnp.concatenate(
+            [jnp.full((b, 2), _NEG), alpha[:, :-2]], axis=1)
+        a_prev2 = jnp.where(skip_ok, a_prev2, _NEG)
+        stacked = jnp.stack([alpha, a_prev1, a_prev2], axis=0)
+        m = jnp.max(stacked, axis=0)
+        summed = m + jnp.log(
+            jnp.sum(jnp.exp(stacked - m[None]), axis=0))
+        new = jnp.where(ext_valid, summed + lp_t, _NEG)
+        # frozen past each sequence's logits length
+        new = jnp.where((t_idx < llen)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(
+        step, alpha0,
+        (jnp.swapaxes(ext_lp, 0, 1)[1:], jnp.arange(1, t)))
+    end1 = jnp.take_along_axis(alpha, (2 * tlen)[:, None], axis=1)
+    end2 = jnp.take_along_axis(
+        alpha, jnp.maximum(2 * tlen - 1, 0)[:, None], axis=1)
+    m = jnp.maximum(end1, end2)
+    ll = m + jnp.log(jnp.exp(end1 - m) + jnp.exp(end2 - m))
+    loss = -ll.reshape(b, 1)
+    if attrs["norm_by_times"]:
+        loss = loss / llen.reshape(b, 1).astype(loss.dtype)
+    return {"Loss": loss}
+
+
+@register_op("ctc_align", inputs=("Input", "Length"),
+             outputs=("Output", "OutLength"),
+             optional=("Length",), differentiable=False,
+             attrs={"blank": 0, "merge_repeated": True})
+def ctc_align(ins, attrs):
+    """ctc_align_op.cc re-spec: collapse repeats then strip blanks,
+    left-packed into the padded output (pad value = blank); OutLength
+    replaces the reference's LoD."""
+    x = ins["Input"]
+    if x.ndim == 1:
+        x = x[None]
+    b, t = x.shape
+    blank = int(attrs["blank"])
+    keep = x != blank
+    if attrs["merge_repeated"]:
+        prev = jnp.concatenate(
+            [jnp.full((b, 1), -1, x.dtype), x[:, :-1]], axis=1)
+        keep = keep & (x != prev)
+    length = ins.get("Length")
+    if length is not None:
+        keep = keep & (jnp.arange(t)[None, :]
+                       < length.reshape(b, 1))
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full((b, t), blank, x.dtype)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    out = out.at[rows, jnp.where(keep, pos, t)].set(
+        jnp.where(keep, x, blank), mode="drop")
+    return {"Output": out,
+            "OutLength": keep.sum(axis=1).astype(jnp.int64)}
+
+
+@register_op("edit_distance",
+             inputs=("Hyps", "Refs", "HypsLength", "RefsLength"),
+             outputs=("Out", "SequenceNum"),
+             optional=("HypsLength", "RefsLength"),
+             differentiable=False,
+             attrs={"normalized": False})
+def edit_distance(ins, attrs):
+    """edit_distance_op.h: Levenshtein distance per (hyp, ref) pair;
+    padded [B, L] + lengths re-spec of the LoD inputs.  DP over the
+    hyp axis as a scan; the inner min-prefix recurrence is a second
+    scan (wavefront form keeps everything static-shaped)."""
+    hyp, ref = ins["Hyps"], ins["Refs"]
+    if hyp.ndim > 2:
+        hyp = hyp.reshape(hyp.shape[0], -1)
+    if ref.ndim > 2:
+        ref = ref.reshape(ref.shape[0], -1)
+    b, m = hyp.shape
+    n = ref.shape[1]
+    hlen = ins.get("HypsLength")
+    hlen = jnp.full((b,), m, jnp.int32) if hlen is None else \
+        hlen.reshape(b).astype(jnp.int32)
+    rlen = ins.get("RefsLength")
+    rlen = jnp.full((b,), n, jnp.int32) if rlen is None else \
+        rlen.reshape(b).astype(jnp.int32)
+
+    def outer(row, inp):
+        """row: dp[i-1, :] of shape [B, n+1]; returns dp[i, :]."""
+        h_i, i_idx = inp
+        sub = row[:, :-1] + (ref != h_i[:, None]).astype(jnp.float32)
+        dele = row[:, 1:] + 1.0
+        base = jnp.minimum(sub, dele)          # [B, n]
+
+        def inner(left, vals):
+            v = jnp.minimum(vals, left + 1.0)
+            return v, v
+
+        first = jnp.full((b,), i_idx, jnp.float32)
+        _, cols = lax.scan(inner, first, jnp.swapaxes(base, 0, 1))
+        new = jnp.concatenate(
+            [first[:, None], jnp.swapaxes(cols, 0, 1)], axis=1)
+        # rows past the hyp length keep the previous dp row
+        new = jnp.where((i_idx <= hlen)[:, None], new, row)
+        return new, None
+
+    row0 = jnp.broadcast_to(
+        jnp.arange(n + 1, dtype=jnp.float32)[None], (b, n + 1))
+    final, _ = lax.scan(
+        outer, row0,
+        (jnp.swapaxes(hyp, 0, 1).astype(jnp.int32),
+         jnp.arange(1, m + 1, dtype=jnp.float32)))
+    dist = jnp.take_along_axis(final, rlen[:, None], axis=1)
+    dist = jnp.where((hlen == 0)[:, None], rlen[:, None].astype(
+        jnp.float32), dist)
+    if attrs["normalized"]:
+        dist = dist / jnp.maximum(rlen[:, None], 1).astype(jnp.float32)
+    return {"Out": dist,
+            "SequenceNum": jnp.asarray(b, jnp.int64).reshape(1)}

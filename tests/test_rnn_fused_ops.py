@@ -1,0 +1,277 @@
+"""Numeric tests for the fused RNN / CTC / fused-op waves, against
+torch CPU or closed-form references (op_test.py:134 pattern)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.core.registry import get_op_def
+
+jnp = pytest.importorskip("jax.numpy")
+torch = pytest.importorskip("torch")
+
+RNG = np.random.RandomState
+B, T, I, D = 3, 5, 4, 6
+
+
+def run(op, ins, attrs=None):
+    d = get_op_def(op)
+    return d.compute(ins, d.canonical_attrs(attrs or {}))
+
+
+def _sig(v):
+    return 1 / (1 + np.exp(-v))
+
+
+def test_lstm_matches_torch():
+    rng = RNG(0)
+    x = rng.randn(B, T, I).astype(np.float32)
+    wx = rng.randn(I, 4 * D).astype(np.float32) * 0.3
+    wh = rng.randn(D, 4 * D).astype(np.float32) * 0.3
+    bb = rng.randn(4 * D).astype(np.float32) * 0.1
+    o = run("lstm", {"Input": jnp.asarray(x @ wx),
+                     "Weight": jnp.asarray(wh),
+                     "Bias": jnp.asarray(bb.reshape(1, -1))},
+            {"use_peepholes": False})
+
+    def reorder(w):  # ours (c,i,f,o) -> torch (i,f,g,o)
+        c, i, f, oo = np.split(w, 4, axis=-1)
+        return np.concatenate([i, f, c, oo], axis=-1)
+
+    lstm_t = torch.nn.LSTM(I, D, batch_first=True)
+    with torch.no_grad():
+        lstm_t.weight_ih_l0.copy_(torch.from_numpy(reorder(wx).T))
+        lstm_t.weight_hh_l0.copy_(torch.from_numpy(reorder(wh).T))
+        lstm_t.bias_ih_l0.copy_(torch.from_numpy(reorder(bb[None])[0]))
+        lstm_t.bias_hh_l0.zero_()
+        t_out, _ = lstm_t(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(o["Hidden"]), t_out.numpy(),
+                               atol=1e-5)
+
+
+def _gru_manual(xg, wh3):
+    h = np.zeros((B, D), np.float32)
+    outs = []
+    for t in range(T):
+        g = xg[:, t]
+        uru = g[:, :2 * D] + h @ wh3[:, :2 * D]
+        u, r = _sig(uru[:, :D]), _sig(uru[:, D:])
+        c = np.tanh(g[:, 2 * D:] + (r * h) @ wh3[:, 2 * D:])
+        h = (1 - u) * h + u * c
+        outs.append(h.copy())
+    return np.stack(outs, 1)
+
+
+def test_gru_and_fusion_gru_match_reference_formula():
+    rng = RNG(0)
+    x = rng.randn(B, T, I).astype(np.float32)
+    wx3 = rng.randn(I, 3 * D).astype(np.float32) * 0.3
+    wh3 = rng.randn(D, 3 * D).astype(np.float32) * 0.3
+    ref = _gru_manual(x @ wx3, wh3)
+    o = run("gru", {"Input": jnp.asarray(x @ wx3),
+                    "Weight": jnp.asarray(wh3)}, {})
+    np.testing.assert_allclose(np.asarray(o["Hidden"]), ref, atol=1e-5)
+    o = run("fusion_gru", {"X": jnp.asarray(x),
+                           "WeightX": jnp.asarray(wx3),
+                           "WeightH": jnp.asarray(wh3)}, {})
+    np.testing.assert_allclose(np.asarray(o["Hidden"]), ref, atol=1e-5)
+
+
+def test_gru_unit_single_step():
+    rng = RNG(0)
+    g = rng.randn(B, 3 * D).astype(np.float32)
+    h0 = rng.randn(B, D).astype(np.float32)
+    wh3 = rng.randn(D, 3 * D).astype(np.float32) * 0.3
+    o = run("gru_unit", {"Input": jnp.asarray(g),
+                         "HiddenPrev": jnp.asarray(h0),
+                         "Weight": jnp.asarray(wh3)}, {})
+    uru = g[:, :2 * D] + h0 @ wh3[:, :2 * D]
+    u, r = _sig(uru[:, :D]), _sig(uru[:, D:])
+    c = np.tanh(g[:, 2 * D:] + (r * h0) @ wh3[:, 2 * D:])
+    np.testing.assert_allclose(np.asarray(o["Hidden"]),
+                               (1 - u) * h0 + u * c, atol=1e-5)
+
+
+def test_lstm_unit_and_cudnn_lstm():
+    rng = RNG(0)
+    xu = rng.randn(2, 4 * D).astype(np.float32)
+    cp = rng.randn(2, D).astype(np.float32)
+    o = run("lstm_unit", {"X": jnp.asarray(xu),
+                          "C_prev": jnp.asarray(cp)},
+            {"forget_bias": 1.0})
+    i = _sig(xu[:, :D])
+    f = _sig(xu[:, D:2 * D] + 1.0)
+    oo = _sig(xu[:, 2 * D:3 * D])
+    g = np.tanh(xu[:, 3 * D:])
+    c = f * cp + i * g
+    np.testing.assert_allclose(np.asarray(o["C"]), c, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o["H"]), oo * np.tanh(c),
+                               atol=1e-6)
+
+    x = rng.randn(B, T, I).astype(np.float32)
+    per = I * 4 * D + D * 4 * D + 4 * D
+    w = (rng.randn(2 * per) * 0.1).astype(np.float32)
+    o = run("cudnn_lstm", {"Input": jnp.asarray(x),
+                           "W": jnp.asarray(w)},
+            {"hidden_size": D, "is_bidirec": True})
+    assert o["Out"].shape == (B, T, 2 * D)
+    assert o["last_h"].shape == (2, B, D)
+
+
+def test_lstm_length_mask_freezes_state():
+    rng = RNG(0)
+    x = (rng.randn(2, 4, 4 * D) * 0.3).astype(np.float32)
+    wh = (rng.randn(D, 4 * D) * 0.3).astype(np.float32)
+    length = np.array([4, 2], np.int32)
+    o = run("lstm", {"Input": jnp.asarray(x), "Weight": jnp.asarray(wh),
+                     "Length": jnp.asarray(length)},
+            {"use_peepholes": False})
+    h = np.asarray(o["Hidden"])
+    # past its length, sequence 1's hidden stays frozen
+    np.testing.assert_allclose(h[1, 2], h[1, 1])
+    np.testing.assert_allclose(h[1, 3], h[1, 1])
+    assert not np.allclose(h[0, 3], h[0, 1])
+
+
+def test_warpctc_matches_torch_ctc_loss():
+    rng = RNG(0)
+    b, t, c, l = 4, 12, 6, 5
+    logits = rng.randn(b, t, c).astype(np.float32)
+    labels = rng.randint(1, c, (b, l)).astype(np.int32)
+    llen = np.array([12, 10, 8, 12], np.int32)
+    tlen = np.array([5, 3, 2, 4], np.int32)
+    o = run("warpctc", {"Logits": jnp.asarray(logits),
+                        "Label": jnp.asarray(labels),
+                        "LogitsLength": jnp.asarray(llen),
+                        "LabelLength": jnp.asarray(tlen)},
+            {"blank": 0})
+    lp = torch.log_softmax(torch.from_numpy(logits), dim=-1)
+    ref = torch.nn.functional.ctc_loss(
+        lp.transpose(0, 1), torch.from_numpy(labels.astype(np.int64)),
+        torch.from_numpy(llen.astype(np.int64)),
+        torch.from_numpy(tlen.astype(np.int64)),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(np.asarray(o["Loss"]).reshape(-1), ref,
+                               atol=1e-4)
+
+
+def test_warpctc_gradient_is_finite():
+    import jax
+
+    rng = RNG(0)
+    logits = rng.randn(2, 8, 5).astype(np.float32)
+    labels = rng.randint(1, 5, (2, 3)).astype(np.int32)
+
+    def loss_fn(lg):
+        d = get_op_def("warpctc")
+        out = d.compute({"Logits": lg, "Label": jnp.asarray(labels)},
+                        d.canonical_attrs({"blank": 0}))
+        return out["Loss"].sum()
+
+    g = jax.grad(loss_fn)(jnp.asarray(logits))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_ctc_align_and_edit_distance():
+    inp = np.array([[0, 1, 1, 0, 2, 2, 3, 0],
+                    [4, 4, 0, 0, 5, 0, 6, 6]], np.int32)
+    o = run("ctc_align", {"Input": jnp.asarray(inp)}, {"blank": 0})
+    np.testing.assert_array_equal(
+        np.asarray(o["Output"])[:, :3], [[1, 2, 3], [4, 5, 6]])
+    np.testing.assert_array_equal(np.asarray(o["OutLength"]), [3, 3])
+
+    def lev(a, b):
+        dp = np.zeros((len(a) + 1, len(b) + 1))
+        dp[:, 0] = np.arange(len(a) + 1)
+        dp[0, :] = np.arange(len(b) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                               dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return dp[len(a), len(b)]
+
+    rng = RNG(0)
+    hyp = rng.randint(0, 5, (3, 6))
+    ref = rng.randint(0, 5, (3, 7))
+    hl = np.array([6, 4, 2])
+    rl = np.array([7, 5, 3])
+    o = run("edit_distance", {"Hyps": jnp.asarray(hyp),
+                              "Refs": jnp.asarray(ref),
+                              "HypsLength": jnp.asarray(hl),
+                              "RefsLength": jnp.asarray(rl)})
+    expect = [lev(hyp[i, :hl[i]].tolist(), ref[i, :rl[i]].tolist())
+              for i in range(3)]
+    np.testing.assert_allclose(np.asarray(o["Out"]).reshape(-1), expect)
+
+
+def test_fused_ops():
+    rng = RNG(0)
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    o = run("fused_elemwise_activation",
+            {"X": jnp.asarray(x), "Y": jnp.asarray(y)},
+            {"functor_list": ["relu", "elementwise_add"]})
+    np.testing.assert_allclose(np.asarray(o["Out"]),
+                               np.maximum(x + y, 0))
+    o = run("fused_elemwise_activation",
+            {"X": jnp.asarray(x), "Y": jnp.asarray(y)},
+            {"functor_list": ["elementwise_add", "scale"], "scale": 2.0})
+    np.testing.assert_allclose(np.asarray(o["Out"]), x + 2 * y,
+                               atol=1e-6)
+
+    w = rng.randn(10, 5).astype(np.float32)
+    ids = rng.randint(0, 10, (2, 4, 1))
+    o = run("fused_embedding_seq_pool",
+            {"W": jnp.asarray(w), "Ids": jnp.asarray(ids)})
+    np.testing.assert_allclose(np.asarray(o["Out"]),
+                               w[ids.reshape(2, 4)].sum(1), atol=1e-6)
+
+    xx = rng.randn(3, 4).astype(np.float32)
+    yy = rng.randn(4, 5).astype(np.float32)
+    o = run("fusion_squared_mat_sub",
+            {"X": jnp.asarray(xx), "Y": jnp.asarray(yy)},
+            {"scalar": 0.5})
+    np.testing.assert_allclose(
+        np.asarray(o["Out"]),
+        0.5 * ((xx @ yy) ** 2 - (xx ** 2) @ (yy ** 2)), atol=1e-4)
+
+
+def test_fusion_seqconv_eltadd_relu():
+    rng = RNG(0)
+    xs3 = rng.randn(2, 5, 3).astype(np.float32)
+    filt = rng.randn(9, 4).astype(np.float32)
+    bias = rng.randn(4).astype(np.float32)
+    o = run("fusion_seqconv_eltadd_relu",
+            {"X": jnp.asarray(xs3), "Filter": jnp.asarray(filt),
+             "Bias": jnp.asarray(bias)},
+            {"contextLength": 3, "contextStart": -1})
+    col = np.zeros((2, 5, 9), np.float32)
+    for t in range(5):
+        for j in range(3):
+            src = t - 1 + j
+            if 0 <= src < 5:
+                col[:, t, j * 3:(j + 1) * 3] = xs3[:, src]
+    np.testing.assert_allclose(np.asarray(o["Out"]),
+                               np.maximum(col @ filt + bias, 0),
+                               atol=1e-5)
+
+
+def test_conv2d_fusion_and_inception():
+    rng = RNG(0)
+    xc = rng.randn(2, 3, 8, 8).astype(np.float32)
+    fc = rng.randn(4, 3, 3, 3).astype(np.float32)
+    o = run("conv2d_fusion",
+            {"Input": jnp.asarray(xc), "Filter": jnp.asarray(fc),
+             "Bias": jnp.asarray(np.ones(4, np.float32))},
+            {"paddings": [1, 1]})
+    assert o["Output"].shape == (2, 4, 8, 8)
+    assert (np.asarray(o["Output"]) >= 0).all()
+
+    shapes = [(4, 3, 1, 1), (4, 3, 1, 1), (6, 4, 3, 3), (4, 3, 1, 1),
+              (6, 4, 3, 3), (6, 6, 3, 3), (4, 3, 1, 1)]
+    fs = [jnp.asarray(rng.randn(*s).astype(np.float32) * 0.1)
+          for s in shapes]
+    bs = [jnp.asarray(np.zeros(s[0], np.float32)) for s in shapes]
+    o = run("conv2d_inception_fusion",
+            {"Input": jnp.asarray(xc), "Filter": fs, "Bias": bs})
+    assert o["Output"].shape == (2, 20, 8, 8)
