@@ -121,8 +121,19 @@ def bench_resnet50_train(batch=128, chain=30):
     from paddle_tpu.models.resnet import resnet50
 
     _fresh_programs()
+    from paddle_tpu.contrib.mixed_precision import decorate
+    from paddle_tpu.transpiler import nhwc_transpile
+
     model = resnet50(is_test=False)
-    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+    # TPU fast path: rewrite the conv stack NHWC before autodiff so the
+    # whole step (fwd+bwd) avoids MXU relayouts (see tests/test_layout.py),
+    # then AMP-rewrite to bf16 activations with fp32 master weights —
+    # the moral equivalent of the reference's float16 training story
+    # (contrib/float16/float16_benchmark.md)
+    nhwc_transpile(framework.default_main_program())
+    opt = decorate(optimizer.Momentum(learning_rate=0.1, momentum=0.9),
+                   init_loss_scaling=1.0,
+                   use_dynamic_loss_scaling=False)
     opt.minimize(model["loss"])
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(framework.default_startup_program())
@@ -210,11 +221,14 @@ def bench_resnet50_infer(batch=128, chain=100):
     from paddle_tpu.core.scope import global_scope
     from paddle_tpu.models.resnet import resnet50
 
+    from paddle_tpu.transpiler import nhwc_transpile
+
     _fresh_programs()
     model = resnet50(is_test=True)
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(framework.default_startup_program())
     infer_prog = framework.default_main_program().clone(for_test=True)
+    nhwc_transpile(infer_prog)
     bf16_transpile(infer_prog, scope=global_scope())
     compiled = fluid.CompiledProgram(infer_prog)
 

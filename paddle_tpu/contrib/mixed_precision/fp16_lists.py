@@ -34,9 +34,17 @@ gray_list = {
     "elementwise_pow", "elementwise_mod", "elementwise_floordiv",
     "batch_norm", "layer_norm", "tanh", "sigmoid", "lookup_table",
     "relu", "relu6", "leaky_relu", "soft_relu", "top_k", "pool2d",
-    "dropout", "reshape2", "transpose2", "concat", "split", "slice",
-    "flatten2", "stack", "unstack", "expand", "scale", "cast",
-    "elementwise_op", "squeeze2", "unsqueeze2", "pad", "gather",
+    "dropout", "reshape2", "transpose2", "transpose", "concat", "split",
+    "slice", "flatten2", "stack", "unstack", "expand", "scale", "cast",
+    "elementwise_op", "squeeze2", "unsqueeze2", "pad", "pad2d", "gather",
+    "swapaxes", "flip", "assign",
+}
+
+# normalization ops whose output dtype follows X (statistics stay fp32
+# inside the op compute — see ops/nn.py batch_norm/layer_norm)
+follow_x_list = {
+    "batch_norm", "sync_batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "data_norm",
 }
 
 

@@ -10,6 +10,8 @@ them into the consuming matmul/conv (free on the MXU's bf16 multiply path).
 from __future__ import annotations
 
 from paddle_tpu.core.program import OpDesc
+from paddle_tpu.contrib.mixed_precision.fp16_lists import follow_x_list \
+    as _FOLLOW_X
 
 _FLOATS = {"float32", "float64"}
 
@@ -57,9 +59,19 @@ def rewrite_program(program, amp_lists, dest_dtype="bfloat16"):
                     out.append(n)
                 op.inputs[slot] = out
             out_lowp = True
-        elif op.type in amp_lists.gray_list:
-            out_lowp = any(n in lowp for ns in op.inputs.values()
-                           for n in ns)
+        elif op.type in amp_lists.gray_list or op.type in _FOLLOW_X:
+            if op.type in _FOLLOW_X:
+                # norm ops emit Y in X's dtype (stats stay fp32 inside)
+                out_lowp = any(n in lowp for n in op.inputs.get("X", []))
+            else:
+                # conservative: jnp type promotion means the runtime
+                # result is low-precision only if EVERY float operand is;
+                # claiming lowp wrongly would make a later white-list op
+                # skip its cast and feed a matmul mixed dtypes
+                float_ins = [n for ns in op.inputs.values() for n in ns
+                             if eligible(n)]
+                out_lowp = bool(float_ins) and all(
+                    n in lowp for n in float_ins)
         else:  # black or unlisted: numerically sensitive -> fp32
             for slot, names in list(op.inputs.items()):
                 out = []
@@ -69,10 +81,15 @@ def rewrite_program(program, amp_lists, dest_dtype="bfloat16"):
                     out.append(n)
                 op.inputs[slot] = out
             out_lowp = False
+        if op.type == "cast":
+            out_lowp = str(op.attrs.get("out_dtype")) in (
+                dest_dtype, str(dest_dtype))
         new_ops.append(op)
-        for names in op.outputs.values():
+        for slot, names in op.outputs.items():
+            slot_lowp = out_lowp and (
+                op.type not in _FOLLOW_X or slot == "Y")
             for n in names:
-                if out_lowp and eligible(n):
+                if slot_lowp and eligible(n):
                     lowp.add(n)
                 else:
                     lowp.discard(n)

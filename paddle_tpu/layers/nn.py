@@ -97,9 +97,9 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, act=None, name=None,
-           use_cudnn=True):
+           use_cudnn=True, data_format="NCHW"):
     helper = LayerHelper("conv2d", name=name)
-    c_in = input.shape[1]
+    c_in = input.shape[1] if data_format == "NCHW" else input.shape[-1]
     fs = filter_size if isinstance(filter_size, (list, tuple)) else (
         filter_size, filter_size)
     w_shape = [num_filters, c_in // groups, fs[0], fs[1]]
@@ -118,7 +118,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
             else [padding, padding],
             "dilations": list(dilation)
             if isinstance(dilation, (list, tuple)) else [dilation, dilation],
-            "groups": groups,
+            "groups": groups, "data_format": data_format,
         },
     )
     if bias_attr is not False:
@@ -127,7 +127,8 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         out2 = helper.create_variable_for_type_inference(input.dtype)
         helper.append_op(
             type="elementwise_add", inputs={"X": out, "Y": b},
-            outputs={"Out": out2}, attrs={"axis": 1},
+            outputs={"Out": out2},
+            attrs={"axis": 1 if data_format == "NCHW" else -1},
         )
         out = out2
     return helper.append_activation(out, act)
@@ -135,9 +136,10 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
 def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
                      dilation=1, groups=1, param_attr=None, bias_attr=None,
-                     act=None, name=None, output_size=None):
+                     act=None, name=None, output_size=None,
+                     data_format="NCHW"):
     helper = LayerHelper("conv2d_transpose", name=name)
-    c_in = input.shape[1]
+    c_in = input.shape[1] if data_format == "NCHW" else input.shape[-1]
     fs = filter_size if isinstance(filter_size, (list, tuple)) else (
         filter_size, filter_size)
     w = helper.create_parameter(
@@ -155,6 +157,7 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
             "dilations": [dilation, dilation] if np.isscalar(dilation)
             else list(dilation),
             "groups": groups, "output_size": output_size or [],
+            "data_format": data_format,
         },
     )
     if bias_attr is not False:
@@ -163,7 +166,8 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
         out2 = helper.create_variable_for_type_inference(input.dtype)
         helper.append_op(
             type="elementwise_add", inputs={"X": out, "Y": b},
-            outputs={"Out": out2}, attrs={"axis": 1},
+            outputs={"Out": out2},
+            attrs={"axis": 1 if data_format == "NCHW" else -1},
         )
         out = out2
     return helper.append_activation(out, act)
@@ -171,7 +175,7 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, ceil_mode=False,
-           exclusive=True, adaptive=False, name=None):
+           exclusive=True, adaptive=False, name=None, data_format="NCHW"):
     attrs = {
         "pooling_type": pool_type,
         "ksize": [pool_size, pool_size] if np.isscalar(pool_size)
@@ -182,7 +186,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
         "paddings": [pool_padding, pool_padding]
         if np.isscalar(pool_padding) else list(pool_padding),
         "ceil_mode": ceil_mode, "exclusive": exclusive,
-        "adaptive": adaptive,
+        "adaptive": adaptive, "data_format": data_format,
     }
     return _single_out("pool2d", input, attrs)
 

@@ -9,8 +9,13 @@ Reference parity:
   - lstm/gru compute: operators/math/{lstm,gru}_compute.cc — here as fused
     cell ops used by layers.dynamic_lstm analogs and lax.scan loops.
 
-All NCHW, matching the reference's default data_format; conv maps directly to
-lax.conv_general_dilated which XLA tiles onto the MXU.
+Layout: ops honor the reference's ``data_format`` attr (NCHW default, like
+conv_op.cc).  On TPU, NHWC is the fast path — XLA:TPU wants channels minor so
+convs tile onto the MXU without relayouts; ``transpiler.nhwc_transpile``
+rewrites a user-built NCHW program to NHWC internally.  Filters stay OIHW in
+both layouts (user-visible param shape is layout-independent, matching the
+reference); the O(kh*kw*C^2) transpose to HWIO is folded by XLA into the
+weight's layout.
 """
 
 from __future__ import annotations
@@ -38,8 +43,9 @@ def conv2d(ins, attrs):
     x, w = ins["Input"], ins["Filter"]
     s, p, d = _pair(attrs["strides"]), _pair(attrs["paddings"]), _pair(
         attrs["dilations"])
+    fmt = attrs.get("data_format", "NCHW")
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                    ("NCHW", "OIHW", "NCHW"))
+                                    (fmt, "OIHW", fmt))
     out = lax.conv_general_dilated(
         x, w, window_strides=s,
         padding=[(p[0], p[0]), (p[1], p[1])],
@@ -59,9 +65,11 @@ def depthwise_conv2d(ins, attrs):
     x, w = ins["Input"], ins["Filter"]
     s, p, d = _pair(attrs["strides"]), _pair(attrs["paddings"]), _pair(
         attrs["dilations"])
-    groups = attrs["groups"] or x.shape[1]
+    fmt = attrs.get("data_format", "NCHW")
+    groups = attrs["groups"] or (x.shape[1] if fmt == "NCHW"
+                                 else x.shape[-1])
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                    ("NCHW", "OIHW", "NCHW"))
+                                    (fmt, "OIHW", fmt))
     out = lax.conv_general_dilated(
         x, w, window_strides=s,
         padding=[(p[0], p[0]), (p[1], p[1])],
@@ -83,8 +91,9 @@ def conv2d_transpose(ins, attrs):
     kh = (w.shape[2] - 1) * d[0] + 1
     kw = (w.shape[3] - 1) * d[1] + 1
     pad = [(kh - 1 - p[0], kh - 1 - p[0]), (kw - 1 - p[1], kw - 1 - p[1])]
+    fmt = attrs.get("data_format", "NCHW")
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                    ("NCHW", "IOHW", "NCHW"))
+                                    (fmt, "IOHW", fmt))
     out = lax.conv_general_dilated(
         x, w, window_strides=(1, 1), padding=pad,
         lhs_dilation=s, rhs_dilation=d, dimension_numbers=dn,
@@ -101,33 +110,47 @@ def conv2d_transpose(ins, attrs):
                     "data_format": "NCHW"})
 def pool2d(ins, attrs):
     x = ins["X"]
+    fmt = attrs.get("data_format", "NCHW")
+    hw = (2, 3) if fmt == "NCHW" else (1, 2)
     if attrs["adaptive"]:
         oh, ow = _pair(attrs["ksize"])
-        n, c, h, wd = x.shape
-        x5 = x.reshape(n, c, oh, h // oh, ow, wd // ow)
+        if fmt == "NCHW":
+            n, c, h, wd = x.shape
+            x6 = x.reshape(n, c, oh, h // oh, ow, wd // ow)
+            red = (3, 5)
+        else:
+            n, h, wd, c = x.shape
+            x6 = x.reshape(n, oh, h // oh, ow, wd // ow, c)
+            red = (2, 4)
         if attrs["pooling_type"] == "max":
-            return {"Out": jnp.max(x5, axis=(3, 5))}
-        return {"Out": jnp.mean(x5, axis=(3, 5))}
+            return {"Out": jnp.max(x6, axis=red)}
+        return {"Out": jnp.mean(x6, axis=red)}
     if attrs["global_pooling"]:
-        k = x.shape[2:4]
+        k = (x.shape[hw[0]], x.shape[hw[1]])
         s, p = k, (0, 0)
     else:
         k = _pair(attrs["ksize"])
         s = _pair(attrs["strides"])
         p = _pair(attrs["paddings"])
-    window = (1, 1) + k
-    strides = (1, 1) + s
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if fmt == "NCHW":
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
     if attrs["pooling_type"] == "max":
         init = -jnp.inf
         out = lax.reduce_window(x, init, lax.max, window, strides, pads)
         return {"Out": out}
     out = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
     if attrs["exclusive"] and (p[0] or p[1]):
-        ones = jnp.ones(x.shape[2:4], x.dtype)
+        ones = jnp.ones((x.shape[hw[0]], x.shape[hw[1]]), x.dtype)
         cnt = lax.reduce_window(ones, 0.0, lax.add, k, s,
                                 ((p[0], p[0]), (p[1], p[1])))
-        out = out / cnt[None, None]
+        out = out / (cnt[None, None] if fmt == "NCHW"
+                     else cnt[None, :, :, None])
     else:
         out = out / (k[0] * k[1])
     return {"Out": out}
@@ -150,14 +173,17 @@ def batch_norm(ins, attrs):
     axes = (0, 2, 3) if (x.ndim == 4 and attrs["data_layout"] == "NCHW") \
         else tuple(i for i in range(x.ndim) if i != x.ndim - 1) \
         if attrs["data_layout"] == "NHWC" else (0,) + tuple(range(2, x.ndim))
+    # statistics in fp32 (bf16 accumulation loses too much), output in
+    # x.dtype so an AMP-rewritten net stays low-precision through BN
+    xf = x.astype(mean.dtype)
     if attrs["is_test"] or attrs["use_global_stats"]:
         use_mean, use_var = mean, var
         mean_out, var_out = mean, var
         saved_mean = jnp.zeros_like(mean)
         saved_var = jnp.zeros_like(var)
     else:
-        use_mean = jnp.mean(x, axis=axes)
-        use_var = jnp.var(x, axis=axes)
+        use_mean = jnp.mean(xf, axis=axes)
+        use_var = jnp.var(xf, axis=axes)
         mean_out = mean * mom + lax.stop_gradient(use_mean) * (1 - mom)
         var_out = var * mom + lax.stop_gradient(use_var) * (1 - mom)
         saved_mean = use_mean
@@ -167,10 +193,68 @@ def batch_norm(ins, attrs):
     shape[c_axis] = x.shape[c_axis]
     rm = use_mean.reshape(shape)
     rv = use_var.reshape(shape)
-    y = (x - rm) * lax.rsqrt(rv + eps) * scale.reshape(shape) \
+    y = (xf - rm) * lax.rsqrt(rv + eps) * scale.reshape(shape) \
         + bias.reshape(shape)
-    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+    return {"Y": y.astype(x.dtype), "MeanOut": mean_out,
+            "VarianceOut": var_out,
             "SavedMean": saved_mean, "SavedVariance": saved_var}
+
+
+@register_op("batch_norm_grad",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance", "Y@GRAD",
+                     "MeanOut@GRAD", "VarianceOut@GRAD", "SavedMean@GRAD",
+                     "SavedVariance@GRAD"),
+             outputs=("X@GRAD", "Scale@GRAD", "Bias@GRAD"),
+             optional=("Bias", "Mean", "Variance", "MeanOut@GRAD",
+                       "VarianceOut@GRAD", "SavedMean@GRAD",
+                       "SavedVariance@GRAD"),
+             attrs={"epsilon": 1e-5, "momentum": 0.9, "is_test": False,
+                    "data_layout": "NCHW", "use_global_stats": False},
+             differentiable=False)
+def batch_norm_grad(ins, attrs):
+    """Hand-written BN backward (reference batch_norm_op.cc *Grad kernels):
+
+      dbias  = sum(dy)
+      dscale = sum(dy * x_hat)
+      dx     = scale*rstd * (dy - dbias/m - x_hat*dscale/m)    (train)
+      dx     = scale*rstd * dy                                 (global stats)
+
+    The auto-vjp grad would store fp32 intermediates of X's size (x_hat and
+    the f32 upcast of x); this saves only X itself — mean/var recomputation
+    CSEs with the forward pass under the compiled executor.  Statistics math
+    in fp32, dx emitted in X's dtype (AMP-friendly)."""
+    x, dy, scale = ins["X"], ins["Y@GRAD"], ins["Scale"]
+    eps = attrs["epsilon"]
+    axes = (0, 2, 3) if (x.ndim == 4 and attrs["data_layout"] == "NCHW") \
+        else tuple(i for i in range(x.ndim) if i != x.ndim - 1) \
+        if attrs["data_layout"] == "NHWC" else (0,) + tuple(range(2, x.ndim))
+    shape = [1] * x.ndim
+    c_axis = 1 if attrs["data_layout"] == "NCHW" else x.ndim - 1
+    shape[c_axis] = x.shape[c_axis]
+    f32 = scale.dtype
+    xf = x.astype(f32)
+    dyf = dy.astype(f32)
+    if attrs["is_test"] or attrs["use_global_stats"]:
+        mean, var = ins["Mean"], ins["Variance"]
+        rstd = lax.rsqrt(var + eps)
+        x_hat = (xf - mean.reshape(shape)) * rstd.reshape(shape)
+        dbias = jnp.sum(dyf, axis=axes)
+        dscale = jnp.sum(dyf * x_hat, axis=axes)
+        dx = (scale * rstd).reshape(shape) * dyf
+        return {"X@GRAD": dx.astype(x.dtype), "Scale@GRAD": dscale,
+                "Bias@GRAD": dbias}
+    m = float(np.prod([x.shape[a] for a in axes]))
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    rstd = lax.rsqrt(var + eps)
+    x_hat = (xf - mean.reshape(shape)) * rstd.reshape(shape)
+    dbias = jnp.sum(dyf, axis=axes)
+    dscale = jnp.sum(dyf * x_hat, axis=axes)
+    dx = (scale * rstd).reshape(shape) * (
+        dyf - (dbias / m).reshape(shape)
+        - x_hat * (dscale / m).reshape(shape))
+    return {"X@GRAD": dx.astype(x.dtype), "Scale@GRAD": dscale,
+            "Bias@GRAD": dbias}
 
 
 @register_op("layer_norm", inputs=("X", "Scale", "Bias"),
@@ -181,15 +265,16 @@ def layer_norm(ins, attrs):
     x = ins["X"]
     a = attrs["begin_norm_axis"]
     axes = tuple(range(a, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) * lax.rsqrt(var + attrs["epsilon"])
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + attrs["epsilon"])
     norm_shape = x.shape[a:]
     if "Scale" in ins:
         y = y * ins["Scale"].reshape(norm_shape)
     if "Bias" in ins:
         y = y + ins["Bias"].reshape(norm_shape)
-    return {"Y": y, "Mean": jnp.squeeze(mean, axes),
+    return {"Y": y.astype(x.dtype), "Mean": jnp.squeeze(mean, axes),
             "Variance": jnp.squeeze(var, axes)}
 
 
@@ -202,7 +287,8 @@ def group_norm(ins, attrs):
     x = ins["X"]
     n, c = x.shape[0], x.shape[1]
     g = attrs["groups"]
-    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    xg = x.astype(jnp.promote_types(x.dtype, jnp.float32)).reshape(
+        (n, g, c // g) + x.shape[2:])
     axes = tuple(range(2, xg.ndim))
     mean = jnp.mean(xg, axis=axes, keepdims=True)
     var = jnp.var(xg, axis=axes, keepdims=True)
@@ -212,7 +298,8 @@ def group_norm(ins, attrs):
         y = y * ins["Scale"].reshape(shape)
     if "Bias" in ins:
         y = y + ins["Bias"].reshape(shape)
-    return {"Y": y, "Mean": mean.reshape(n, g), "Variance": var.reshape(n, g)}
+    return {"Y": y.astype(x.dtype), "Mean": mean.reshape(n, g),
+            "Variance": var.reshape(n, g)}
 
 
 @register_op("instance_norm", inputs=("X", "Scale", "Bias"),
@@ -222,15 +309,16 @@ def group_norm(ins, attrs):
 def instance_norm(ins, attrs):
     x = ins["X"]
     axes = tuple(range(2, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) * lax.rsqrt(var + attrs["epsilon"])
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + attrs["epsilon"])
     shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
     if "Scale" in ins:
         y = y * ins["Scale"].reshape(shape)
     if "Bias" in ins:
         y = y + ins["Bias"].reshape(shape)
-    return {"Y": y, "SavedMean": jnp.squeeze(mean, axes),
+    return {"Y": y.astype(x.dtype), "SavedMean": jnp.squeeze(mean, axes),
             "SavedVariance": jnp.squeeze(var, axes)}
 
 

@@ -7,5 +7,7 @@ from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401
     DistributeTranspiler, DistributeTranspilerConfig, slice_variable)
 from paddle_tpu.transpiler.inference_transpiler import (  # noqa: F401
     InferenceTranspiler)
+from paddle_tpu.transpiler.layout_transpiler import (  # noqa: F401
+    nhwc_transpile)
 from paddle_tpu.transpiler.ps_dispatcher import (HashName,  # noqa: F401
                                                  PSDispatcher, RoundRobin)

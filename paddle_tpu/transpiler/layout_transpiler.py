@@ -1,0 +1,203 @@
+"""NHWC layout transpiler — the TPU fast path for conv networks.
+
+The reference keeps a ``data_format`` attr on conv/pool/norm ops
+(conv_op.cc AddAttr "data_format") and relies on cuDNN picking layouts;
+its MKLDNN build has real layout-transform IR passes
+(framework/data_layout_transform.cc, ir/mkldnn placement passes).  On
+TPU the analog is: XLA:TPU tiles convolutions onto the MXU with the
+channel dimension minor, so NCHW programs pay a relayout around every
+conv.  This pass rewrites a user-built NCHW program to run NHWC
+internally while keeping the user-facing NCHW semantics (feeds, param
+shapes, fetch shapes of non-4D tensors) unchanged:
+
+  * conv2d / depthwise_conv2d / conv2d_transpose / pool2d get
+    data_format="NHWC"; batch_norm gets data_layout="NHWC".  Filters
+    stay OIHW (param shapes are layout-independent, like the
+    reference).
+  * layout-agnostic elementwise ops (relu, dropout, residual adds,
+    channel-bias adds, ...) are carried through in NHWC.
+  * a transpose is inserted where an NCHW var first enters the NHWC
+    region (e.g. the image feed) and where an NHWC var escapes into a
+    layout-sensitive consumer (e.g. the flatten before the final fc) —
+    for a ResNet that is one 3-channel transpose in and one
+    [N,1,1,C]-sized transpose out.
+
+Run it on the forward program BEFORE append_backward/minimize: gradient
+ops are synthesized from the (now NHWC) forward computes, so the whole
+training step stays NHWC.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.program import BACKWARD, OPTIMIZE, OpDesc
+
+# ops whose compute honors a layout attr
+_CONV_LIKE = {"conv2d", "depthwise_conv2d", "conv2d_transpose", "pool2d"}
+_NORM_LIKE = {"batch_norm", "sync_batch_norm"}
+
+# unary elementwise ops that are layout-transparent: Out has X's layout
+_UNARY_FLEX = {
+    "relu", "relu6", "leaky_relu", "sigmoid", "logsigmoid", "tanh", "exp",
+    "log", "sqrt", "rsqrt", "abs", "square", "reciprocal", "softplus",
+    "softsign", "gelu", "elu", "selu", "swish", "hard_sigmoid",
+    "hard_swish", "floor", "ceil", "round", "sin", "cos", "erf",
+    "tanh_shrink", "softshrink", "hard_shrink", "thresholded_relu",
+    "scale", "cast", "dropout", "clip", "assign", "pow", "label_smooth",
+}
+
+# binary elementwise ops that are layout-transparent when both sides share
+# a layout, or when Y is a per-channel vector (axis retargeted)
+_BINARY_FLEX = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+}
+
+_NCHW_TO_NHWC = (0, 2, 3, 1)
+_NHWC_TO_NCHW = (0, 3, 1, 2)
+
+
+def _permute_shape(shape, perm):
+    if shape is None or len(shape) != 4:
+        return shape
+    return tuple(shape[i] for i in perm)
+
+
+class _Rewriter:
+    def __init__(self, block):
+        self.block = block
+        self.new_ops = []
+        self.nhwc = set()          # var names currently NHWC
+        self.to_nchw = {}          # nhwc var -> name of NCHW copy
+        self.to_nhwc = {}          # nchw var -> name of NHWC copy
+
+    def _emit_transpose(self, name, perm, suffix, cache, mark_nhwc):
+        if name in cache:
+            return cache[name]
+        src = self.block.var(name)
+        out_name = name + suffix
+        out = self.block.create_var(
+            out_name, shape=_permute_shape(src.shape, perm),
+            dtype=src.dtype)
+        out.stop_gradient = src.stop_gradient
+        self.new_ops.append(OpDesc(
+            "transpose", {"X": [name]}, {"Out": [out_name]},
+            {"axis": list(perm)}))
+        cache[name] = out_name
+        if mark_nhwc:
+            self.nhwc.add(out_name)
+        return out_name
+
+    def as_nhwc(self, name):
+        """Name of `name` in NHWC layout (transposing if needed)."""
+        if name in self.nhwc:
+            return name
+        return self._emit_transpose(name, _NCHW_TO_NHWC, "@NHWC",
+                                    self.to_nhwc, mark_nhwc=True)
+
+    def as_nchw(self, name):
+        if name not in self.nhwc:
+            return name
+        return self._emit_transpose(name, _NHWC_TO_NCHW, "@NCHW",
+                                    self.to_nchw, mark_nhwc=False)
+
+    def mark_out_nhwc(self, op, slot):
+        for n in op.outputs.get(slot, []):
+            self.nhwc.add(n)
+            v = self.block.var(n)
+            v.shape = _permute_shape(v.shape, _NCHW_TO_NHWC)
+
+    def _is_4d(self, name):
+        v = self.block.var(name)
+        return v.shape is not None and len(v.shape) == 4
+
+    def rewrite(self, op):
+        t = op.type
+        if t in _CONV_LIKE:
+            slot = "Input" if "Input" in op.inputs else "X"
+            src = op.inputs[slot][0]
+            op.inputs[slot][0] = self.as_nhwc(src)
+            op.attrs["data_format"] = "NHWC"
+            self.new_ops.append(op)
+            self.mark_out_nhwc(op, "Output" if "Output" in op.outputs
+                               else "Out")
+            return
+        if t in _NORM_LIKE:
+            src = op.inputs["X"][0]
+            if src in self.nhwc or self._is_4d(src):
+                op.inputs["X"][0] = self.as_nhwc(src)
+                op.attrs["data_layout"] = "NHWC"
+                self.new_ops.append(op)
+                self.mark_out_nhwc(op, "Y")
+                return
+            self.new_ops.append(op)
+            return
+        if t in _UNARY_FLEX:
+            src = op.inputs["X"][0]
+            if src in self.nhwc:
+                self.new_ops.append(op)
+                for n in op.output_names():
+                    if self._is_4d(n) or self.block.var(n).shape is None:
+                        self.nhwc.add(n)
+                        v = self.block.var(n)
+                        v.shape = _permute_shape(v.shape, _NCHW_TO_NHWC)
+                return
+            self.new_ops.append(op)
+            return
+        if t in _BINARY_FLEX:
+            x, y = op.inputs["X"][0], op.inputs["Y"][0]
+            x_h, y_h = x in self.nhwc, y in self.nhwc
+            xv, yv = self.block.var(x), self.block.var(y)
+            if x_h and (y_h or yv.ndim == 4):
+                op.inputs["Y"][0] = self.as_nhwc(y)
+                self.new_ops.append(op)
+                self.mark_out_nhwc(op, "Out")
+                return
+            if x_h and yv.ndim == 1 and op.attrs.get("axis", -1) == 1:
+                # per-channel bias: C is now the trailing axis
+                op.attrs["axis"] = -1
+                self.new_ops.append(op)
+                self.mark_out_nhwc(op, "Out")
+                return
+            if x_h and yv.ndim in (0, 1):
+                # scalar-ish broadcast: trailing-aligned still works only
+                # for scalars; fall back to NCHW otherwise
+                if yv.ndim == 0 or (yv.shape and yv.shape[0] == 1):
+                    self.new_ops.append(op)
+                    self.mark_out_nhwc(op, "Out")
+                    return
+            if y_h and not x_h and xv.ndim == 4:
+                op.inputs["X"][0] = self.as_nhwc(x)
+                self.new_ops.append(op)
+                self.mark_out_nhwc(op, "Out")
+                return
+            # mixed/unsupported: restore NCHW operands
+            op.inputs["X"][0] = self.as_nchw(x)
+            op.inputs["Y"][0] = self.as_nchw(y)
+            self.new_ops.append(op)
+            return
+        # layout-sensitive consumer: feed it NCHW
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [self.as_nchw(n) for n in names]
+        self.new_ops.append(op)
+
+
+def nhwc_transpile(program):
+    """Rewrite `program` (in place) so conv/pool/norm chains run NHWC.
+
+    Must be called on a forward-only program (before
+    append_backward/minimize); raises otherwise.  Returns the program.
+    """
+    for b in program.blocks:
+        for op in b.ops:
+            if op.op_role in (BACKWARD, OPTIMIZE):
+                raise ValueError(
+                    "nhwc_transpile must run before append_backward/"
+                    "minimize; found a %s op '%s'" % (op.op_role, op.type))
+    for block in program.blocks:
+        if not any(op.type in _CONV_LIKE for op in block.ops):
+            continue
+        rw = _Rewriter(block)
+        for op in block.ops:
+            rw.rewrite(op)
+        block.ops = rw.new_ops
+    return program

@@ -25,24 +25,47 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
-@pytest.fixture(autouse=True)
-def fresh_programs():
-    """Each test gets fresh default programs, scope and name counters."""
-    import paddle_tpu
+def _reset_program_state():
+    """Point the default programs/scope/name counters at fresh objects."""
     from paddle_tpu import framework, unique_name
     from paddle_tpu.core import scope as scope_mod
     from paddle_tpu.core.program import Program
     from paddle_tpu.layers import nn as nn_layers
 
-    old_main = framework.switch_main_program(Program())
-    old_startup = framework.switch_startup_program(Program())
-    old_counters = unique_name.switch({})
-    old_scope = scope_mod._global_scope
+    old = (framework.switch_main_program(Program()),
+           framework.switch_startup_program(Program()),
+           unique_name.switch({}),
+           scope_mod._global_scope)
     scope_mod._global_scope = scope_mod.Scope()
     nn_layers._dropout_counter_var.clear()
+    return old
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs, scope and name counters."""
+    from paddle_tpu import framework, unique_name
+    from paddle_tpu.core import scope as scope_mod
+
+    old_main, old_startup, old_counters, old_scope = _reset_program_state()
     np.random.seed(0)
     yield
     framework.switch_main_program(old_main)
     framework.switch_startup_program(old_startup)
     unique_name.switch(old_counters)
     scope_mod._global_scope = old_scope
+
+
+@pytest.fixture
+def fresh_programs_factory():
+    """Context-manager factory: tests comparing several independently-built
+    programs (e.g. NCHW vs NHWC builds) enter one fresh program/scope/name
+    context per build."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        _reset_program_state()
+        yield
+
+    return _ctx
