@@ -77,7 +77,14 @@ class Executor:
         if scope is None:
             scope = global_scope()
         if isinstance(program, CompiledProgram):
-            return program._run(self, feed or {}, fetch_list or [], scope,
+            feed = dict(feed or {})
+            # program-integrated py_reader: the host-only read op is
+            # skipped in the XLA trace; its outputs arrive as ordinary
+            # (already device-resident, prefetched) feeds
+            from paddle_tpu import reader as reader_mod
+
+            reader_mod.augment_feed_from_readers(program._program, feed)
+            return program._run(self, feed, fetch_list or [], scope,
                                 return_numpy)
         return self._run_interpreted(
             program, feed or {}, fetch_list or [], scope, return_numpy
@@ -94,11 +101,22 @@ class Executor:
         return self._fetch(fetch_list, scope, return_numpy)
 
     def _feed_data(self, program, feed, scope):
+        import jax
         import jax.numpy as jnp
 
         block = program.global_block()
         for name, value in feed.items():
-            if hasattr(value, "__array__") or isinstance(
+            if isinstance(value, jax.Array):
+                # device-resident (e.g. DeviceFeeder-prefetched): no host
+                # round-trip, just dtype coercion
+                if block.has_var(name):
+                    v = block.var(name)
+                    if v.dtype is not None:
+                        target = jax.dtypes.canonicalize_dtype(
+                            np.dtype(v.dtype))
+                        if value.dtype != target:
+                            value = value.astype(target)
+            elif hasattr(value, "__array__") or isinstance(
                 value, (list, tuple, int, float)
             ):
                 arr = np.asarray(value)
@@ -222,10 +240,13 @@ class Executor:
 
         The reference spawns a DeviceWorker thread per core, each
         interpreting the program over its file shard (Hogwild).  Here the
-        dataset's reader threads + native parser produce batches and ONE
-        compiled program consumes them — thread-level compute parallelism
-        is replaced by XLA batch/mesh parallelism (SURVEY.md §3.4)."""
+        dataset's reader threads + native parser produce batches, a
+        DeviceFeeder double-buffers them onto the device (reference
+        buffered_reader.cc), and ONE compiled program consumes them —
+        thread-level compute parallelism is replaced by XLA batch/mesh
+        parallelism (SURVEY.md §3.4)."""
         from paddle_tpu import framework
+        from paddle_tpu.reader import DeviceFeeder
 
         if dataset is None:
             raise ValueError("dataset is required")
@@ -239,15 +260,20 @@ class Executor:
         fetch_info = fetch_info or [
             (f if isinstance(f, str) else f.name) for f in fetch_list]
         step = 0
-        for feed in dataset._iter_batches():
-            results = self.run(program, feed=feed, fetch_list=fetch_list,
-                               scope=scope)
-            step += 1
-            if debug and fetch_list and step % print_period == 0:
-                msg = ", ".join(
-                    f"{name}={np.asarray(val).ravel()[:4]}"
-                    for name, val in zip(fetch_info, results))
-                print(f"step {step}: {msg}")
+        feeder = DeviceFeeder(dataset._iter_batches(),
+                              capacity=max(4, 2 * (thread or 1)))
+        try:
+            for feed in feeder:
+                results = self.run(program, feed=feed,
+                                   fetch_list=fetch_list, scope=scope)
+                step += 1
+                if debug and fetch_list and step % print_period == 0:
+                    msg = ", ".join(
+                        f"{name}={np.asarray(val).ravel()[:4]}"
+                        for name, val in zip(fetch_info, results))
+                    print(f"step {step}: {msg}")
+        finally:
+            feeder.stop()
         return None
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,

@@ -1,7 +1,7 @@
 from paddle_tpu.layers.helper import LayerHelper
 from paddle_tpu.layers.nn import *  # noqa: F401,F403
 from paddle_tpu.layers.tensor import *  # noqa: F401,F403
-from paddle_tpu.layers.io import data  # noqa: F401
+from paddle_tpu.layers.io import data, py_reader, read_file  # noqa: F401
 from paddle_tpu.layers.control_flow import *  # noqa: F401,F403
 from paddle_tpu.layers.learning_rate_scheduler import *  # noqa: F401,F403
 from paddle_tpu.layers import sequence_ops  # noqa: F401

@@ -3,6 +3,8 @@
 
 from __future__ import annotations
 
+import numpy as np
+
 from paddle_tpu.core.types import VarType
 from paddle_tpu.framework import default_main_program, default_startup_program
 
@@ -23,3 +25,44 @@ def data(name, shape, dtype="float32", append_batch_size=True,
         name=name, shape=shape, dtype=dtype, type=type,
         stop_gradient=True, is_data=True)
     return var
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Program-integrated async reader (reference layers/io.py:656
+    py_reader -> create_py_reader op + LoDTensorBlockingQueue).
+
+    Appends a host-only ``read`` op whose outputs are the data vars;
+    decorate a generator, ``reader.start()``, then run the program with no
+    feed — batches arrive from the background prefetcher (DeviceFeeder),
+    already device-resident on the compiled path.  A drained reader raises
+    ``fluid.core.EOFException``; ``reset()`` + ``start()`` rearm it.
+
+    Returns the PyReader; get the data vars with ``read_file(reader)``."""
+    from paddle_tpu import unique_name
+    from paddle_tpu.reader import PyReader, register_py_reader
+
+    if name is None:
+        name = unique_name.generate("py_reader")
+    main = default_main_program().global_block()
+    out_vars = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        shape = list(shape)
+        v = main.create_var(
+            name=f"{name}.out_{i}", shape=shape,
+            dtype=str(np.dtype(dtype)), stop_gradient=True, is_data=True)
+        out_vars.append(v)
+    main.append_op(
+        type="read", inputs={}, outputs={"Out": out_vars},
+        attrs={"reader_name": name}, infer_shape=False)
+    reader = PyReader(feed_list=out_vars, capacity=capacity,
+                      iterable=False, use_prefetch=use_double_buffer)
+    reader.name = name
+    reader._output_vars = out_vars
+    register_py_reader(name, reader)
+    return reader
+
+
+def read_file(reader):
+    """reference layers/io.py read_file: the data vars of a py_reader."""
+    return list(reader._output_vars)

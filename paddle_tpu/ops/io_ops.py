@@ -91,3 +91,23 @@ def load_combine_op(op, block, scope, ctx):
              differentiable=False)
 def _load_combine_compute(ins, attrs):
     return {}
+
+
+@register_special_op("read")
+def read_op(op, block, scope, ctx):
+    """Pop the next prefetched batch from the bound PyReader into the
+    output vars (reference operators/reader/read_op.cc; EOF propagates as
+    fluid.core.EOFException)."""
+    from paddle_tpu import reader as reader_mod
+
+    reader = reader_mod.get_py_reader(op.attrs["reader_name"])
+    batch = reader._next_batch()
+    for n in op.outputs["Out"]:
+        scope.var(n).set(batch[n])
+
+
+@register_op("read", inputs=(), outputs=("Out",), duplicable=("Out",),
+             attrs={"reader_name": REQUIRED}, host_only=True,
+             differentiable=False)
+def _read_compute(ins, attrs):
+    return {}

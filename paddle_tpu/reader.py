@@ -1,9 +1,15 @@
-"""Reader decorators (reference: python/paddle/reader/decorator.py) and a
-PyReader/DataLoader analog feeding the executor.
+"""Reader decorators (reference: python/paddle/reader/decorator.py), the
+PyReader program-integrated reader (reference python/paddle/fluid/
+reader.py:46 -> operators/reader/create_py_reader_op.cc +
+LoDTensorBlockingQueue), and the host->device prefetcher that replaces the
+reference's double-buffered reader (operators/reader/buffered_reader.cc).
 
-The C++ double-buffered blocking-queue feed path (reference
-operators/reader/, framework/data_feed.cc) lands with the native data
-milestone (paddle_tpu/data/); this module is the pure-python path.
+Pipeline shape on TPU: reader threads (python generator, or the native C++
+queue behind QueueDataset) produce numpy batches -> DeviceFeeder's
+transfer thread issues jax.device_put ahead of consumption (the H2D copy
+runs on its own stream) -> the train loop pops device-resident batches, so
+feed transfer overlaps the previous step's compute exactly like the
+reference's double-buffered reader overlaps cudaMemcpyAsync with kernels.
 """
 
 from __future__ import annotations
@@ -16,6 +22,7 @@ from threading import Thread
 __all__ = [
     "batch", "shuffle", "buffered", "cache", "chain", "compose", "firstn",
     "map_readers", "xmap_readers", "PyReader", "DataLoader",
+    "DeviceFeeder",
 ]
 
 
@@ -173,51 +180,222 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     return xreader
 
 
+class DeviceFeeder:
+    """Async host->device prefetcher (reference buffered_reader.cc).
+
+    Two daemon threads double-buffer the feed path:
+      * producer: drains ``batch_iter`` (python generator or the native
+        C++ BlockingQueue consumer) into a bounded host queue;
+      * transfer: pops a host batch, issues ``jax.device_put`` (async —
+        the copy engine runs while the device computes), and parks up to
+        ``device_prefetch`` device-resident batches.
+
+    Iterating yields feed dicts whose values are already on device, so
+    ``Executor.run`` skips the host round-trip entirely (compiler.py
+    feeds jax.Array values straight through)."""
+
+    _END = object()
+
+    def __init__(self, batch_iter, capacity=8, device_prefetch=2,
+                 to_device=True):
+        self._host_q: Queue = Queue(maxsize=max(2, capacity))
+        self._dev_q: Queue = Queue(maxsize=max(1, device_prefetch))
+        self._err = []
+        self._stopped = False
+        self._to_device = to_device
+
+        def producer():
+            try:
+                for item in batch_iter:
+                    if self._stopped:
+                        return
+                    self._host_q.put(item)
+            except BaseException as e:  # surfaced on the consumer side
+                self._err.append(e)
+            finally:
+                self._host_q.put(DeviceFeeder._END)
+
+        def transfer():
+            import jax
+
+            try:
+                while True:
+                    item = self._host_q.get()
+                    if item is DeviceFeeder._END or self._stopped:
+                        break
+                    if self._to_device:
+                        item = {k: jax.device_put(v)
+                                for k, v in item.items()}
+                    self._dev_q.put(item)
+            except BaseException as e:
+                self._err.append(e)
+            finally:
+                self._dev_q.put(DeviceFeeder._END)
+
+        self._threads = [Thread(target=producer, daemon=True),
+                         Thread(target=transfer, daemon=True)]
+        for t in self._threads:
+            t.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._dev_q.get()
+        if item is DeviceFeeder._END:
+            # stay drained: re-park the sentinel so another next() raises
+            # again instead of blocking on the empty queue forever
+            self._dev_q.put(DeviceFeeder._END)
+            if self._err:
+                raise self._err[0]
+            raise StopIteration
+        return item
+
+    def stop(self):
+        self._stopped = True
+        # unblock the threads if they are parked on full/empty queues
+        try:
+            while True:
+                self._host_q.get_nowait()
+        except Exception:
+            pass
+        try:
+            while True:
+                self._dev_q.get_nowait()
+        except Exception:
+            pass
+
+
 class PyReader:
-    """Iterable reader bound to feed vars (reference
-    python/paddle/fluid/reader.py:46).  decorate_* then iterate yields feed
-    dicts consumable by Executor.run."""
+    """Reader bound to feed vars (reference python/paddle/fluid/
+    reader.py:46).
+
+    Iterable mode: ``for feed in reader: exe.run(feed=feed, ...)`` — each
+    yielded dict holds device-resident arrays prefetched by DeviceFeeder.
+
+    Non-iterable (program-integrated) mode, built by ``layers.py_reader``:
+    the program carries a host-only ``read`` op; ``reader.start()`` spins
+    the prefetcher, each ``exe.run()`` (no feed) pops the next batch, and
+    exhaustion raises ``fluid.core.EOFException`` — then ``reset()`` and
+    ``start()`` again, exactly the reference loop."""
 
     def __init__(self, feed_list=None, capacity=64, iterable=True,
-                 return_list=False):
+                 return_list=False, use_prefetch=True):
         self.feed_list = feed_list or []
         self.capacity = capacity
         self.iterable = iterable
+        self.return_list = return_list
+        self._use_prefetch = use_prefetch
         self._generator = None
         self._batched = False
+        self._feeder = None
 
     def decorate_sample_list_generator(self, generator, places=None):
         self._generator = generator
         self._batched = True
 
+    # reference name for the same thing (paddle.batch-ed reader)
+    decorate_paddle_reader = decorate_sample_list_generator
+
     def decorate_batch_generator(self, generator, places=None):
         self._generator = generator
         self._batched = False
 
-    def __iter__(self):
+    def _feed_dicts(self):
         import numpy as np
 
         names = [v.name for v in self.feed_list]
+        for sample in self._generator():
+            if self._batched:
+                cols = list(zip(*sample))
+                arrays = [np.asarray(c) for c in cols]
+            else:
+                arrays = [np.asarray(c) for c in sample]
+            yield dict(zip(names, arrays))
+
+    def __iter__(self):
         if self._generator is None:
             return iter(())
+        if not self._use_prefetch:
+            return self._feed_dicts()
+        return DeviceFeeder(self._feed_dicts(), capacity=self.capacity)
 
-        def gen():
-            for sample in self._generator():
-                if self._batched:
-                    cols = list(zip(*sample))
-                    arrays = [np.asarray(c) for c in cols]
-                else:
-                    arrays = [np.asarray(c) for c in sample]
-                yield dict(zip(names, arrays))
-
-        return gen()
-
-    # non-iterable mode parity helpers
+    # -- non-iterable (program-integrated) mode -----------------------------
     def start(self):
-        self._iter = iter(self)
+        if self._generator is None:
+            raise RuntimeError("decorate a generator before start()")
+        if self._use_prefetch:
+            self._feeder = DeviceFeeder(self._feed_dicts(),
+                                        capacity=self.capacity)
+        else:  # use_double_buffer=False: no background threads
+            self._feeder = iter(self._feed_dicts())
 
     def reset(self):
-        self._iter = None
+        if isinstance(self._feeder, DeviceFeeder):
+            self._feeder.stop()
+        self._feeder = None
+
+    def _next_batch(self):
+        from paddle_tpu.core import EOFException
+
+        if self._feeder is None:
+            raise RuntimeError(
+                "py_reader not started — call reader.start() first")
+        try:
+            return next(self._feeder)
+        except StopIteration:
+            self._feeder = None
+            raise EOFException("py_reader drained") from None
+
+
+# program-integrated readers by name (reference: ReaderHolder variables in
+# the scope; here the queue lives host-side so a name registry suffices)
+_PY_READERS: dict = {}
+
+
+def register_py_reader(name, reader):
+    _PY_READERS[name] = reader
+
+
+def get_py_reader(name):
+    return _PY_READERS[name]
+
+
+def _read_ops(program):
+    """Cached list of 'read' ops in the global block (recomputed when the
+    op count changes — keeps the common no-reader hot path O(1))."""
+    block = program.global_block()
+    cached = getattr(program, "_read_ops_cache", None)
+    if cached is not None and cached[0] == len(block.ops):
+        return cached[1]
+    ops = [op for op in block.ops if op.type == "read"]
+    program._read_ops_cache = (len(block.ops), ops)
+    return ops
+
+
+def augment_feed_from_readers(program, feed):
+    """For each 'read' op whose outputs the caller did not feed, pop the
+    next prefetched batch from its reader into `feed`.  Used by the
+    compiled path, where the host-only read op is skipped in the trace and
+    its outputs arrive as ordinary (device-resident) feeds.  Raises
+    fluid.core.EOFException when a reader is drained."""
+    for op in _read_ops(program):
+        names = op.outputs.get("Out", [])
+        fed = [n for n in names if n in feed]
+        if names and len(fed) == len(names):
+            continue
+        if fed:
+            raise ValueError(
+                f"read op outputs partially fed ({fed}): feed all of "
+                f"{names} to override the reader, or none to consume a "
+                "batch")
+        reader = _PY_READERS.get(op.attrs["reader_name"])
+        if reader is None:
+            raise RuntimeError(
+                f"read op references unknown reader "
+                f"'{op.attrs['reader_name']}'")
+        feed.update(reader._next_batch())
+    return feed
 
 
 class DataLoader:
@@ -225,5 +403,6 @@ class DataLoader:
 
     @staticmethod
     def from_generator(feed_list=None, capacity=64, iterable=True,
-                       return_list=False):
-        return PyReader(feed_list, capacity, iterable, return_list)
+                       return_list=False, use_double_buffer=True):
+        return PyReader(feed_list, capacity, iterable, return_list,
+                        use_prefetch=use_double_buffer)
