@@ -1,11 +1,11 @@
 from paddle_tpu.core.types import VarType, CPUPlace, TPUPlace, CUDAPlace
-
-
-class EOFException(Exception):
-    """Raised by a drained program-integrated reader (reference:
-    fluid.core.EOFException from operators/reader/read_op.cc)."""
 from paddle_tpu.core.program import Program, Block, OpDesc, VarDesc
 from paddle_tpu.core.scope import Scope, Variable, global_scope
 from paddle_tpu.core.registry import OpDef, register_op, get_op_def, has_op_def
 from paddle_tpu.core.executor import Executor
 from paddle_tpu.core.compiler import CompiledProgram
+
+
+class EOFException(Exception):
+    """Raised by a drained program-integrated reader (reference:
+    fluid.core.EOFException from operators/reader/read_op.cc)."""

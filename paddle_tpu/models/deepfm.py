@@ -10,7 +10,11 @@ from paddle_tpu import layers
 
 def deepfm_model(num_fields=26, vocab_size=100_000, embed_dim=16,
                  dense_dim=13, hidden=(400, 400, 400), is_test=False,
-                 is_sparse=True):
+                 is_sparse=True, is_distributed=False):
+    """is_distributed=True marks the tables for pserver sharding: the
+    DistributeTranspiler replaces their lookups with prefetch RPCs and
+    their grads with sparse rows/values pushes (see
+    transpiler/distribute_transpiler.py _plan_dist_tables)."""
     sparse_ids = layers.data("sparse_ids", shape=[num_fields, 1],
                              dtype="int64")
     dense_x = layers.data("dense_x", shape=[dense_dim], dtype="float32")
@@ -18,11 +22,13 @@ def deepfm_model(num_fields=26, vocab_size=100_000, embed_dim=16,
 
     # shared embedding table; field-wise lookup [B, F, E]
     emb = layers.embedding(sparse_ids, size=[vocab_size, embed_dim],
-                           is_sparse=is_sparse)
+                           is_sparse=is_sparse,
+                           is_distributed=is_distributed)
 
     # first-order terms
     first = layers.embedding(sparse_ids, size=[vocab_size, 1],
-                             is_sparse=is_sparse)
+                             is_sparse=is_sparse,
+                             is_distributed=is_distributed)
     first_sum = layers.reduce_sum(first, dim=[1, 2], keep_dim=False)
     first_sum = layers.reshape(first_sum, [-1, 1])
 

@@ -97,12 +97,23 @@ def _load_combine_compute(ins, attrs):
 def read_op(op, block, scope, ctx):
     """Pop the next prefetched batch from the bound PyReader into the
     output vars (reference operators/reader/read_op.cc; EOF propagates as
-    fluid.core.EOFException)."""
+    fluid.core.EOFException).  Mirrors the compiled path's feed-override
+    semantics (reader.augment_feed_from_readers): a caller feeding ALL of
+    the read op's outputs overrides the reader for this run."""
     from paddle_tpu import reader as reader_mod
 
+    names = op.outputs["Out"]
+    feed = ctx.feed or {}
+    fed = [n for n in names if n in feed]
+    if names and len(fed) == len(names):
+        return  # _feed_data already set the vars
+    if fed:
+        raise ValueError(
+            f"read op outputs partially fed ({fed}): feed all of "
+            f"{names} to override the reader, or none to consume a batch")
     reader = reader_mod.get_py_reader(op.attrs["reader_name"])
     batch = reader._next_batch()
-    for n in op.outputs["Out"]:
+    for n in names:
         scope.var(n).set(batch[n])
 
 

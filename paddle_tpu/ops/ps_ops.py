@@ -47,7 +47,8 @@ register_op("fetch_barrier", inputs=(), outputs=(),
             differentiable=False, host_only=True)(_structural)
 register_op("listen_and_serv", inputs=(), outputs=(),
             attrs={"endpoint": REQUIRED, "Fanin": 1, "sync_mode": True,
-                   "grad_blocks": [], "lr_names": []},
+                   "grad_blocks": [], "lr_names": [],
+                   "sparse_grad_blocks": []},
             differentiable=False, host_only=True)(_structural)
 register_op("ps_sync_init", inputs=("X",), outputs=(),
             duplicable=("X",), optional=("X",),
@@ -56,6 +57,31 @@ register_op("ps_sync_init", inputs=("X",), outputs=(),
 register_op("checkpoint_notify", inputs=(), outputs=(),
             attrs={"endpoints": [], "dirname": ""},
             differentiable=False, host_only=True)(_structural)
+register_op("prefetch", inputs=("Ids",), outputs=("Out",),
+            attrs={"epmap": [], "table_names": [], "sections": [],
+                   "padding_idx": -1, "emb_dim": REQUIRED},
+            differentiable=False, host_only=True)(_structural)
+register_op("send_sparse_grad", inputs=("Ids", "Grad"), outputs=(),
+            attrs={"epmap": [], "section_names": [], "sections": [],
+                   "padding_idx": -1},
+            differentiable=False, host_only=True)(_structural)
+
+
+@register_op("sparse_sgd",
+             inputs=("Param", "Rows", "Grad", "LearningRate"),
+             outputs=("ParamOut",), differentiable=False,
+             in_place={"Param": "ParamOut"})
+def sparse_sgd(ins, attrs):
+    """Row-wise SGD on a sharded lookup table (reference
+    operators/optimizers/sgd_op.h SelectedRows branch: update only the
+    touched rows).  Duplicate rows accumulate via scatter-add, matching
+    the SelectedRows sum semantics."""
+    w, rows, g = ins["Param"], ins["Rows"], ins["Grad"]
+    lr = jnp.reshape(ins["LearningRate"], ())
+    if rows.shape[0] == 0:
+        return {"ParamOut": w}
+    return {"ParamOut": w.at[rows.astype(jnp.int32)].add(
+        (-lr * g).astype(w.dtype))}
 
 
 def _np(v):
@@ -99,6 +125,66 @@ def fetch_barrier_op(op, block, scope, ctx):
     client = global_rpc_client()
     for ep in op.attrs["endpoints"]:
         client.fetch_barrier(ep)
+
+
+@register_special_op("prefetch")
+def prefetch_op(op, block, scope, ctx):
+    """Distributed-lookup-table forward: split ids by table section, ask
+    each owning pserver for its rows, reassemble in id order (reference
+    operators/distributed/parameter_prefetch.cc:1 prefetch + split_ids +
+    merge_ids)."""
+    client = global_rpc_client()
+    ids = _np(scope.find_var(op.inputs["Ids"][0]).get())
+    squeeze = ids.ndim >= 2 and ids.shape[-1] == 1
+    flat = (ids[..., 0] if squeeze else ids).reshape(-1).astype(np.int64)
+    emb_dim = int(op.attrs["emb_dim"])
+    out_name = op.outputs["Out"][0]
+    dtype = np.dtype(block.var(out_name).dtype) \
+        if block.has_var(out_name) and block.var(out_name).dtype \
+        else np.dtype(np.float32)
+    out = np.zeros((flat.shape[0], emb_dim), dtype)
+    for ep, tname, (s, e) in zip(op.attrs["epmap"],
+                                 op.attrs["table_names"],
+                                 op.attrs["sections"]):
+        mask = (flat >= s) & (flat < e)
+        if not mask.any():
+            continue
+        local = flat[mask] - s
+        rows = client.call(ep, "prefetch_rows",
+                           (tname, np.ascontiguousarray(local)))
+        out[mask] = rows
+    pad = int(op.attrs["padding_idx"])
+    if pad >= 0:
+        out[flat == pad] = 0.0
+    shape = (ids.shape[:-1] if squeeze else ids.shape) + (emb_dim,)
+    scope.var(out_name).set(jnp.asarray(out.reshape(shape)))
+
+
+@register_special_op("send_sparse_grad")
+def send_sparse_grad_op(op, block, scope, ctx):
+    """Distributed-lookup-table backward: push (rows, grad-rows) of the
+    table gradient to the owning pservers (reference split_ids_op.cc +
+    the SelectedRows send path of parameter_send.cc)."""
+    client = global_rpc_client()
+    ids = _np(scope.find_var(op.inputs["Ids"][0]).get())
+    grad = _np(scope.find_var(op.inputs["Grad"][0]).get())
+    squeeze = ids.ndim >= 2 and ids.shape[-1] == 1
+    flat = (ids[..., 0] if squeeze else ids).reshape(-1).astype(np.int64)
+    g = grad.reshape(flat.shape[0], -1)
+    pad = int(op.attrs["padding_idx"])
+    if pad >= 0:
+        keep = flat != pad
+        flat, g = flat[keep], g[keep]
+    for ep, gsec, (s, e) in zip(op.attrs["epmap"],
+                                op.attrs["section_names"],
+                                op.attrs["sections"]):
+        mask = (flat >= s) & (flat < e)
+        if not mask.any():
+            continue  # sync merge divides by fanin, so skipping is safe
+        local = flat[mask] - s
+        client.call(ep, "send_sparse",
+                    (gsec, np.ascontiguousarray(local),
+                     np.ascontiguousarray(g[mask])))
 
 
 @register_special_op("checkpoint_notify")
@@ -147,13 +233,22 @@ def listen_and_serv_op(op, block, scope, ctx):
     sync = bool(attrs["sync_mode"])
     grad_blocks = [(g, int(b)) for g, b in attrs["grad_blocks"]]
     grad_block_map = dict(grad_blocks)
+    sparse_blocks = [(g, int(b))
+                     for g, b in attrs.get("sparse_grad_blocks", [])]
+    sparse_block_map = dict(sparse_blocks)
 
     server = RPCServer(attrs["endpoint"])
     buffers: dict = {}
+    sparse_buffers: dict = {}
     lock = threading.Lock()
     stop = threading.Event()
     init_evt = threading.Event()
     ncomplete = [0]
+
+    def _apply_sparse(gsec, rows, vals):
+        scope.var(gsec + ".rows").set(jnp.asarray(rows))
+        scope.var(gsec + ".values").set(jnp.asarray(vals))
+        ctx.run_block(sparse_block_map[gsec], scope)
 
     def on_send_var(payload):
         name, val = payload
@@ -179,6 +274,18 @@ def listen_and_serv_op(op, block, scope, ctx):
                         np.mean(np.stack(vals), axis=0)
                     scope.var(gname).set(jnp.asarray(merged))
                     ctx.run_block(bidx, scope)
+                for gsec, _bidx in sparse_blocks:
+                    parts = sparse_buffers.pop(gsec, None)
+                    if not parts:
+                        continue
+                    rows = np.concatenate([r for r, _ in parts])
+                    # scale by fanin to match the dense-path mean over
+                    # trainers (trainers with no ids in a section skip
+                    # the push, so len(parts) would over-scale)
+                    vals2 = np.concatenate(
+                        [v for _, v in parts]) / float(fanin)
+                    if rows.size:
+                        _apply_sparse(gsec, rows, vals2)
         server.barrier("send_done", fanin)
 
     def on_get_var(name):
@@ -187,6 +294,28 @@ def listen_and_serv_op(op, block, scope, ctx):
             if var is None or var.get() is None:
                 raise KeyError(f"pserver has no var '{name}'")
             return _np(var.get())
+
+    def on_prefetch_rows(payload):
+        """Lookup rows of a table shard (reference: the pserver-side
+        lookup block, distribute_transpiler.py:1583).  Rows are gathered
+        on-device before the host copy — never materialize the whole
+        shard per RPC."""
+        tname, rows = payload
+        with lock:
+            var = scope.find_var(tname)
+            if var is None or var.get() is None:
+                raise KeyError(f"pserver has no table shard '{tname}'")
+            picked = jnp.take(var.get(),
+                              jnp.asarray(rows.astype(np.int64)), axis=0)
+        return np.ascontiguousarray(_np(picked))
+
+    def on_send_sparse(payload):
+        gsec, rows, vals = payload
+        with lock:
+            if sync:
+                sparse_buffers.setdefault(gsec, []).append((rows, vals))
+            elif rows.size:
+                _apply_sparse(gsec, rows, vals)
 
     def on_fetch_barrier(_):
         if sync:
@@ -221,6 +350,8 @@ def listen_and_serv_op(op, block, scope, ctx):
     server.register_handler("send_var", on_send_var)
     server.register_handler("send_barrier", on_send_barrier)
     server.register_handler("get_var", on_get_var)
+    server.register_handler("prefetch_rows", on_prefetch_rows)
+    server.register_handler("send_sparse", on_send_sparse)
     server.register_handler("fetch_barrier", on_fetch_barrier)
     server.register_handler("complete", on_complete)
     server.register_handler("init_done", on_init_done)

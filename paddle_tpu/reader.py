@@ -253,17 +253,20 @@ class DeviceFeeder:
 
     def stop(self):
         self._stopped = True
-        # unblock the threads if they are parked on full/empty queues
-        try:
-            while True:
-                self._host_q.get_nowait()
-        except Exception:
-            pass
-        try:
-            while True:
-                self._dev_q.get_nowait()
-        except Exception:
-            pass
+        # unblock the threads if they are parked on full/empty queues,
+        # then re-park sentinels: the transfer thread may loop back to
+        # host_q.get() after its put unblocks, and consumers may call
+        # next() again — both must see END, not block forever
+        for q in (self._host_q, self._dev_q):
+            try:
+                while True:
+                    q.get_nowait()
+            except Exception:
+                pass
+            try:
+                q.put_nowait(DeviceFeeder._END)
+            except Exception:
+                pass
 
 
 class PyReader:

@@ -106,8 +106,11 @@ class DistributeTranspiler:
             op.inputs["LearningRate"][0] for op in self.opt_ops
             if op.inputs.get("LearningRate")})
         n_ps = len(self.endpoints)
+        self._plan_dist_tables(gb, n_ps)
         for op in self.opt_ops:
             pname = op.inputs["Param"][0]
+            if pname in self.dist_tables:
+                continue
             gname = op.inputs["Grad"][0]
             self.grad_of[pname] = gname
             var = gb.var(pname)
@@ -126,6 +129,41 @@ class DistributeTranspiler:
                 plan = [(i, f"{pname}.block{i}", s, e)
                         for i, (s, e) in enumerate(secs)]
             self.param_plan[pname] = plan
+
+    def _plan_dist_tables(self, gb, n_ps):
+        """Distributed lookup tables (reference
+        distribute_transpiler.py:1583 _replace_lookup_table_op_with_prefetch
+        + lookup-table blocks on pservers): embedding params used by
+        lookup_table ops with is_distributed=True never live on trainers —
+        they shard row-wise across ALL pservers, forward becomes a
+        prefetch RPC and backward a sparse (rows, values) push."""
+        self.dist_tables = {}
+        self.table_opt = {}
+        for op in gb.ops:
+            if op.type != "lookup_table" or \
+                    not op.attrs.get("is_distributed"):
+                continue
+            wname = op.inputs["W"][0]
+            if wname in self.dist_tables:
+                raise NotImplementedError(
+                    f"distributed table '{wname}' is consumed by more than"
+                    " one lookup_table op — not supported yet")
+            shape = tuple(gb.var(wname).shape)
+            secs = slice_variable(shape, n_ps)
+            self.dist_tables[wname] = [
+                (i % n_ps, f"{wname}.block{i}", s, e)
+                for i, (s, e) in enumerate(secs)]
+            self.grad_of[wname] = wname + "@GRAD"
+        for op in list(self.opt_ops):
+            pname = op.inputs["Param"][0]
+            if pname in self.dist_tables:
+                if op.type != "sgd":
+                    raise NotImplementedError(
+                        "distributed lookup tables require the SGD"
+                        f" optimizer (got '{op.type}'); reference parity:"
+                        " sgd/adagrad only")
+                self.table_opt[pname] = op
+                self.opt_ops.remove(op)
 
     def _grad_section_name(self, pname, sec_name):
         return sec_name.replace(pname, self.grad_of[pname], 1) \
@@ -146,6 +184,7 @@ class DistributeTranspiler:
         gb.ops = [op for op in gb.ops
                   if not (op.op_role == OPTIMIZE and "Param" in op.inputs)]
         eps = self.endpoints
+        self._rewrite_dist_lookups(gb)
         # send each grad's sections
         for pname, plan in self.param_plan.items():
             gname = self.grad_of[pname]
@@ -180,6 +219,48 @@ class DistributeTranspiler:
         gb.ops.extend(trainer_opt_ops)
         self.trainer_program = prog
 
+    def _rewrite_dist_lookups(self, gb):
+        """Swap distributed lookup_table fwd/bwd ops for prefetch /
+        send_sparse_grad host ops (reference parameter_prefetch.cc +
+        split_ids/merge_ids)."""
+        if not self.dist_tables:
+            return
+        eps = self.endpoints
+        new_ops = []
+        for op in gb.ops:
+            if op.type == "lookup_table" and \
+                    op.inputs["W"][0] in self.dist_tables:
+                wname = op.inputs["W"][0]
+                plan = self.dist_tables[wname]
+                emb_dim = int(self.origin_program.global_block()
+                              .var(wname).shape[1])
+                new_ops.append(OpDesc(
+                    "prefetch", {"Ids": list(op.inputs["Ids"])},
+                    {"Out": list(op.outputs["Out"])},
+                    {"epmap": [eps[i] for i, *_ in plan],
+                     "table_names": [sec for _, sec, *_ in plan],
+                     "sections": [[s, e] for _, _, s, e in plan],
+                     "padding_idx": int(op.attrs.get("padding_idx", -1)),
+                     "emb_dim": emb_dim}, op.op_role))
+            elif op.type == "lookup_table_grad" and \
+                    op.inputs["W"][0] in self.dist_tables:
+                wname = op.inputs["W"][0]
+                plan = self.dist_tables[wname]
+                new_ops.append(OpDesc(
+                    "send_sparse_grad",
+                    {"Ids": list(op.inputs["Ids"]),
+                     "Grad": list(op.inputs["Out@GRAD"])}, {},
+                    {"epmap": [eps[i] for i, *_ in plan],
+                     "section_names": [
+                         self._grad_section_name(wname, sec)
+                         for _, sec, *_ in plan],
+                     "sections": [[s, e] for _, _, s, e in plan],
+                     "padding_idx": int(op.attrs.get("padding_idx", -1))},
+                    op.op_role))
+            else:
+                new_ops.append(op)
+        gb.ops = new_ops
+
     def _append_recv_ops(self, gb):
         for pname, plan in self.param_plan.items():
             gb.append_op(
@@ -193,13 +274,23 @@ class DistributeTranspiler:
     def _build_trainer_startup(self):
         prog = self.origin_startup.clone()
         gb = prog.global_block()
+        if self.dist_tables and self.trainer_id != 0:
+            # only the pusher (trainer 0) needs the full table on host to
+            # seed the pserver shards; other trainers never touch it —
+            # that's the point of is_distributed for 100k+-row tables
+            gb.ops = [o for o in gb.ops
+                      if not any(n in self.dist_tables
+                                 for ns in o.outputs.values()
+                                 for n in ns)]
         push_plan = []
-        for pname, plan in self.param_plan.items():
+        for pname, plan in list(self.param_plan.items()) + \
+                list(self.dist_tables.items()):
             for i, sec, s, e in plan:
                 push_plan.append([pname, self.endpoints[i], sec, s, e])
         gb.append_op(
             type="ps_sync_init",
-            inputs={"X": [p for p in self.param_plan]}, outputs={},
+            inputs={"X": list(self.param_plan) + list(self.dist_tables)},
+            outputs={},
             attrs={"endpoints": list(self.endpoints),
                    "push_plan": push_plan if self.trainer_id == 0 else [],
                    "is_pusher": self.trainer_id == 0},
@@ -243,6 +334,29 @@ class DistributeTranspiler:
                                s, e, origin_gb)
             prog._rollback()
             grad_blocks.append([gsec, sub.idx])
+        # distributed lookup-table shards + their sparse-update blocks
+        sparse_grad_blocks = []
+        ep_i = self.endpoints.index(endpoint)
+        for wname, plan in self.dist_tables.items():
+            wvar = origin_gb.var(wname)
+            opt_op = self.table_opt[wname]
+            lr_name = opt_op.inputs["LearningRate"][0]
+            for i, sec, s, e in plan:
+                if i != ep_i:
+                    continue
+                shape = self._sliced_shape(wvar.shape, s, e)
+                gb.create_var(name=sec, shape=shape, dtype=wvar.dtype,
+                              persistable=True)
+                gsec = self._grad_section_name(wname, sec)
+                sub = prog._create_block()
+                sub.ops.append(OpDesc(
+                    "sparse_sgd",
+                    {"Param": [sec], "Rows": [gsec + ".rows"],
+                     "Grad": [gsec + ".values"],
+                     "LearningRate": [lr_name]},
+                    {"ParamOut": [sec]}, {}, OPTIMIZE))
+                prog._rollback()
+                sparse_grad_blocks.append([gsec, sub.idx])
         for lr in self.lr_names:
             lv = origin_gb.var(lr)
             gb.create_var(name=lr, shape=lv.shape, dtype=lv.dtype,
@@ -252,7 +366,8 @@ class DistributeTranspiler:
             attrs={"endpoint": endpoint, "Fanin": self.trainers,
                    "sync_mode": self.sync_mode,
                    "grad_blocks": grad_blocks,
-                   "lr_names": list(self.lr_names)},
+                   "lr_names": list(self.lr_names),
+                   "sparse_grad_blocks": sparse_grad_blocks},
             infer_shape=False)
         return prog
 
@@ -333,6 +448,19 @@ class DistributeTranspiler:
                         type="fill_constant", outputs={"Out": nv},
                         attrs={"shape": list(nshape), "dtype": ov.dtype,
                                "value": value}, infer_shape=False)
+        ep_i = self.endpoints.index(endpoint)
+        for wname, plan in self.dist_tables.items():
+            wvar = origin_gb.var(wname)
+            for i, sec, s, e in plan:
+                if i != ep_i:
+                    continue
+                shape = self._sliced_shape(wvar.shape, s, e)
+                nv = gb.create_var(name=sec, shape=shape,
+                                   dtype=wvar.dtype, persistable=True)
+                gb.append_op(
+                    type="fill_constant", outputs={"Out": nv},
+                    attrs={"shape": list(shape), "dtype": wvar.dtype,
+                           "value": 0.0}, infer_shape=False)
         for lr in self.lr_names:
             lv = origin_gb.var(lr)
             fill = fills.get(lr)
