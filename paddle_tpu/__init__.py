@@ -18,7 +18,8 @@ of the reference's hand-written InferShape/GradOpMaker/CPU/CUDA kernels.
 """
 
 from paddle_tpu.core.types import VarType, CPUPlace, TPUPlace, CUDAPlace
-from paddle_tpu.core.program import Program, Block, OpDesc, VarDesc
+from paddle_tpu.core.program import (Program, Block, OpDesc, VarDesc,
+                                     pipeline_stage)
 from paddle_tpu.core.scope import Scope, Variable, global_scope
 from paddle_tpu.core.executor import Executor
 from paddle_tpu.core.compiler import CompiledProgram

@@ -180,7 +180,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         if not grad_outputs:
             continue
         gop = OpDesc(op.type + "_grad", grad_inputs, grad_outputs,
-                     dict(op.attrs), BACKWARD)
+                     dict(op.attrs), BACKWARD,
+                     stage=op.stage)  # grad runs on its fwd op's stage
         block.ops.append(gop)
 
     # merge leaf grads (params & data) to canonical names

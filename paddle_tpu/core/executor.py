@@ -76,6 +76,22 @@ class Executor:
             program = framework.default_main_program()
         if scope is None:
             scope = global_scope()
+        popt = getattr(program, "_pipeline_opt", None)
+        if popt is not None:
+            from paddle_tpu.parallel.pipeline import PipelineRunner
+
+            runner = popt.get("_runner")
+            if runner is None:
+                runner = PipelineRunner(
+                    program, popt["sections"], popt["loss_stage"],
+                    popt["loss_name"], popt["num_microbatches"], scope)
+                popt["_runner"] = runner
+            elif runner.scope is not scope:
+                # keep the jitted per-stage functions; just re-point the
+                # scope and force a state re-pull
+                runner.scope = scope
+                runner._state = None
+            return runner.run(feed or {}, fetch_list or [], return_numpy)
         if isinstance(program, CompiledProgram):
             feed = dict(feed or {})
             # program-integrated py_reader: the host-only read op is

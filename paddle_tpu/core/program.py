@@ -35,6 +35,28 @@ LRSCHED = "lr_sched"
 LOSS = "loss"
 
 
+# active pipeline-stage annotation (reference: fluid.device_guard; ops
+# appended inside `with pipeline_stage(i):` carry stage=i for
+# PipelineOptimizer's program cut)
+_CURRENT_STAGE = [None]
+
+
+class pipeline_stage:
+    """Context manager annotating appended ops with a pipeline stage."""
+
+    def __init__(self, idx: int):
+        self.idx = int(idx)
+
+    def __enter__(self):
+        self._prev = _CURRENT_STAGE[0]
+        _CURRENT_STAGE[0] = self.idx
+        return self
+
+    def __exit__(self, *exc):
+        _CURRENT_STAGE[0] = self._prev
+        return False
+
+
 class BlockRef:
     """Attribute value referring to a sub-block (reference: AttrType BLOCK)."""
 
@@ -176,12 +198,16 @@ class OpDesc:
     """
 
     def __init__(self, type: str, inputs=None, outputs=None, attrs=None,
-                 op_role: str = FORWARD):
+                 op_role: str = FORWARD, stage=None):
         self.type = type
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
         self.op_role = op_role
+        # pipeline stage annotation (reference: the op_device attr set by
+        # device_guard that PipelineOptimizer cuts the program at).  None
+        # = unannotated; PipelineOptimizer infers by dataflow.
+        self.stage = stage
 
     def input_names(self):
         out = []
@@ -216,13 +242,16 @@ class OpDesc:
                 attrs[k] = float(v)
             else:
                 attrs[k] = v
-        return {
+        out = {
             "type": self.type,
             "inputs": self.inputs,
             "outputs": self.outputs,
             "attrs": attrs,
             "op_role": self.op_role,
         }
+        if self.stage is not None:
+            out["stage"] = self.stage
+        return out
 
     @staticmethod
     def from_dict(d):
@@ -236,7 +265,7 @@ class OpDesc:
                 attrs[k] = v
         return OpDesc(
             d["type"], d["inputs"], d["outputs"], attrs,
-            d.get("op_role", FORWARD),
+            d.get("op_role", FORWARD), d.get("stage"),
         )
 
 
@@ -316,7 +345,8 @@ class Block:
         }
         op_def = get_op_def(type)
         attrs = op_def.canonical_attrs(attrs or {})
-        op = OpDesc(type, in_names, out_names, attrs, op_role)
+        op = OpDesc(type, in_names, out_names, attrs, op_role,
+                    stage=_CURRENT_STAGE[0])
         self.ops.append(op)
         if infer_shape and not op_def.host_only:
             self._infer_shape(op, op_def)

@@ -98,15 +98,378 @@ def stack_stage_params(per_stage_params):
         lambda *leaves: jnp.stack(leaves), *per_stage_params)
 
 
-class PipelineOptimizer:
-    """API-parity wrapper (reference optimizer.py:2664).
+# ---------------------------------------------------------------------------
+# IR-level pipeline: PipelineOptimizer cuts the Program into per-stage
+# sections at `fluid.pipeline_stage(i)` annotations (reference
+# optimizer.py:2664,2924 PipelineOptimizer.minimize splitting into
+# SectionConfigs) and a runner executes them GPipe-style with one jitted
+# fwd/bwd/opt function per stage pinned to its own device — the
+# SectionWorker (section_worker.cc:141) with XLA functions instead of host
+# threads interpreting ops, and device-to-device activation hops instead
+# of scope queues.
+# ---------------------------------------------------------------------------
 
-    The reference cuts a Program into sections run by SectionWorker threads.
-    The TPU design expresses the pipeline *inside* the jitted step via
-    pipeline_apply; this wrapper carries the microbatch config and delegates
-    minimize to the inner optimizer — models built with homogeneous stages
-    (e.g. models/transformer.py blocks) route their stack through
-    pipeline_apply when a 'pp' mesh axis is active."""
+from paddle_tpu.core.program import (BACKWARD, FORWARD, LOSS, LRSCHED,
+                                     OPTIMIZE)
+
+
+class _StageSection:
+    """One pipeline section: its op lists and dataflow interfaces."""
+
+    def __init__(self, idx):
+        self.idx = idx
+        self.fwd_ops = []
+        self.bwd_ops = []
+        self.opt_ops = []
+        # interfaces (ordered name lists)
+        self.state = []        # persistables owned by this stage
+        self.feeds = []        # data vars consumed by fwd ops
+        self.fwd_in = []       # activations from earlier stages
+        self.fwd_out = []      # activations for later stages
+        self.saved = []        # fwd-env vars the bwd ops re-read
+        self.bwd_in = []       # gradients from later stages
+        self.bwd_out = []      # gradients for earlier stages
+        self.param_grads = []  # canonical grads consumed by opt ops
+
+
+def build_pipeline_plan(program, loss_name):
+    """Assign every op a stage and compute the section interfaces.
+
+    Forward ops carry explicit annotations (pipeline_stage ctx);
+    unannotated ops inherit the max stage of their input producers
+    (backward ops were pre-stamped with their forward op's stage by
+    append_backward; optimizer ops land on their grad's stage)."""
+    block = program.global_block()
+    fwd_roles = (FORWARD, LOSS)
+    loss_stage = max((op.stage or 0) for op in block.ops
+                     if op.op_role in fwd_roles)
+    producer = {}
+    for op in block.ops:
+        if op.stage is None:
+            staged = [producer[n] for n in op.input_names()
+                      if n in producer]
+            if staged:
+                op.stage = max(staged)
+            elif op.op_role == BACKWARD:
+                op.stage = loss_stage  # e.g. the loss-grad seed
+            else:
+                op.stage = 0
+        for n in op.output_names():
+            producer[n] = op.stage
+    n_stages = max(op.stage for op in block.ops) + 1
+
+    secs = [_StageSection(i) for i in range(n_stages)]
+    lr_ops = [op for op in block.ops if op.op_role == LRSCHED]
+    for op in block.ops:
+        if op.op_role in fwd_roles:
+            secs[op.stage].fwd_ops.append(op)
+        elif op.op_role == BACKWARD:
+            secs[op.stage].bwd_ops.append(op)
+        elif op.op_role == OPTIMIZE:
+            secs[op.stage].opt_ops.append(op)
+    # lr-schedule ops replicate into every stage that optimizes
+    for s in secs:
+        if s.opt_ops and lr_ops:
+            s.opt_ops = [OpDescCopy(o) for o in lr_ops] + s.opt_ops
+
+    def is_persistable(n):
+        return block.has_var(n) and block.var(n).persistable
+
+    def is_data(n):
+        return block.has_var(n) and block.var(n).is_data
+
+    # a persistable WRITTEN on one stage but read on another would
+    # silently desynchronize (each stage holds its own device copy and
+    # only the owner's is updated) — reject weight sharing across stages.
+    # Read-only persistables (constant lr) replicate safely.
+    reads, writes = {}, {}
+    lrsched_written = {n for op in lr_ops for n in op.output_names()}
+    for s in secs:
+        for op in s.fwd_ops + s.bwd_ops + s.opt_ops:
+            if op.op_role == LRSCHED:
+                continue  # replicated per stage by design, copies agree
+            for n in op.input_names():
+                if is_persistable(n):
+                    reads.setdefault(n, set()).add(s.idx)
+            for n in op.output_names():
+                if is_persistable(n):
+                    writes.setdefault(n, set()).add(s.idx)
+    for n, wstages in writes.items():
+        if n in lrsched_written:
+            continue
+        span = wstages | reads.get(n, set())
+        if len(span) > 1:
+            raise NotImplementedError(
+                f"pipeline: persistable '{n}' is written on stage(s) "
+                f"{sorted(wstages)} but used on stages {sorted(span)} — "
+                "cross-stage weight sharing is not supported; keep each "
+                "parameter inside one pipeline_stage block")
+
+    fwd_producer = {}
+    for s in secs:
+        for op in s.fwd_ops:
+            for n in op.output_names():
+                fwd_producer[n] = s.idx
+    bwd_producer = {}
+    for s in secs:
+        for op in s.bwd_ops:
+            for n in op.output_names():
+                bwd_producer[n] = s.idx
+
+    for s in secs:
+        state, feeds, fwd_in = [], [], []
+        fwd_local = set()
+        for op in s.fwd_ops + s.bwd_ops + s.opt_ops:
+            for n in op.input_names() + op.output_names():
+                if is_persistable(n) and n not in state:
+                    state.append(n)
+        for op in s.fwd_ops:
+            for n in op.input_names():
+                if is_persistable(n) or n in fwd_local:
+                    continue
+                if is_data(n) and n not in fwd_producer:
+                    if n not in feeds:
+                        feeds.append(n)
+                elif fwd_producer.get(n, s.idx) < s.idx:
+                    if n not in fwd_in:
+                        fwd_in.append(n)
+            fwd_local.update(op.output_names())
+        s.state, s.feeds, s.fwd_in = state, feeds, fwd_in
+
+    for s in secs:
+        consumed_later = set()
+        for t in secs[s.idx + 1:]:
+            for op in t.fwd_ops:
+                consumed_later.update(op.input_names())
+        s.fwd_out = [n for n in dict.fromkeys(
+            n for op in s.fwd_ops for n in op.output_names())
+            if n in consumed_later]
+        # what bwd re-reads from the fwd environment of this stage
+        bwd_reads = {n for op in s.bwd_ops for n in op.input_names()}
+        avail = set(s.fwd_in) | set(s.feeds) | {
+            n for op in s.fwd_ops for n in op.output_names()}
+        s.saved = sorted((bwd_reads & avail) -
+                         {n for n in bwd_reads if is_persistable(n)})
+        s.bwd_in = sorted(n for n in bwd_reads
+                          if bwd_producer.get(n, s.idx) > s.idx)
+        consumed_earlier = set()
+        for t in secs[:s.idx]:
+            for op in t.bwd_ops:
+                consumed_earlier.update(op.input_names())
+        s.bwd_out = [n for n in dict.fromkeys(
+            n for op in s.bwd_ops for n in op.output_names())
+            if n in consumed_earlier]
+        grad_ins = {n for op in s.opt_ops
+                    for slot, names in op.inputs.items()
+                    if slot == "Grad" for n in names}
+        s.param_grads = sorted(grad_ins)
+    return secs, loss_stage
+
+
+def OpDescCopy(op):
+    from paddle_tpu.core.program import OpDesc
+
+    return OpDesc.from_dict(op.to_dict())
+
+
+class PipelineRunner:
+    """GPipe executor over the cut sections: per-stage jitted fwd/bwd/opt
+    functions, each pinned to its own device when enough exist; gradient
+    accumulation over microbatches then one optimizer apply (reference
+    PipelineTrainer/SectionWorker semantics)."""
+
+    def __init__(self, program, sections, loss_stage, loss_name,
+                 num_microbatches, scope):
+        import types
+
+        from paddle_tpu.core.compiler import (_TraceEnv,
+                                              _run_block_symbolic)
+
+        self.program = program
+        self.sections = sections
+        self.loss_stage = loss_stage
+        self.loss_name = loss_name
+        self.M = num_microbatches
+        self.scope = scope
+        devs = jax.devices()
+        S = len(sections)
+        self.devices = [devs[i % len(devs)] for i in range(S)] \
+            if len(devs) > 1 else [None] * S
+
+        def make_fn(ops, out_names):
+            shim = types.SimpleNamespace(blocks=list(program.blocks))
+            shim.blocks[0] = types.SimpleNamespace(ops=list(ops))
+
+            def fn(env0):
+                env = _TraceEnv()
+                env.update(env0)
+                _run_block_symbolic(shim, 0, env)
+                return {n: env[n] for n in out_names if n in env}
+
+            return jax.jit(fn)
+
+        self._fwd = []
+        self._bwd = []
+        self._opt = []
+        for s in sections:
+            pers_out = [n for op in s.fwd_ops
+                        for n in op.output_names()
+                        if n in s.state]
+            fwd_outs = list(dict.fromkeys(
+                s.fwd_out + s.saved + pers_out +
+                ([loss_name] if s.idx == loss_stage else [])))
+            self._fwd.append(make_fn(s.fwd_ops, fwd_outs))
+            bwd_outs = list(dict.fromkeys(s.bwd_out + s.param_grads))
+            self._bwd.append(make_fn(s.bwd_ops, bwd_outs)
+                             if s.bwd_ops else None)
+            self._opt.append(make_fn(s.opt_ops, s.state)
+                             if s.opt_ops else None)
+        self._state = None
+
+    def _pull_state(self):
+        self._state = []
+        for s, dev in zip(self.sections, self.devices):
+            st = {}
+            for n in s.state:
+                var = self.scope.find_var(n)
+                if var is None or var.get() is None:
+                    raise RuntimeError(
+                        f"pipeline: persistable '{n}' uninitialized — run"
+                        " the startup program first")
+                v = var.get()
+                st[n] = jax.device_put(v, dev) if dev is not None else v
+            self._state.append(st)
+
+    def _push_state(self):
+        for st in self._state:
+            for n, v in st.items():
+                self.scope.var(n).set(v)
+
+    def _state_is_fresh(self):
+        """True while the scope still holds exactly the arrays we pushed;
+        an external write (reloaded checkpoint, re-run startup) breaks
+        identity and forces a re-pull."""
+        if self._state is None:
+            return False
+        for s, st in zip(self.sections, self._state):
+            for n in s.state:
+                var = self.scope.find_var(n)
+                if var is None or var.get() is not st[n]:
+                    return False
+        return True
+
+    def run(self, feed, fetch_list, return_numpy=True):
+        import numpy as np
+
+        if not self._state_is_fresh():
+            self._pull_state()
+        M = self.M
+        S = len(self.sections)
+        # split feeds into microbatches along dim 0
+        mb_feeds = [{} for _ in range(M)]
+        for name, val in feed.items():
+            arr = jnp.asarray(np.asarray(val)) \
+                if not isinstance(val, jax.Array) else val
+            if arr.shape[0] % M != 0:
+                raise ValueError(
+                    f"pipeline: batch {arr.shape[0]} not divisible by "
+                    f"num_microbatches={M} (feed '{name}')")
+            for m, part in enumerate(jnp.split(arr, M, axis=0)):
+                mb_feeds[m][name] = part
+
+        saved = [[None] * S for _ in range(M)]
+        losses = []
+        # forward sweep (python drives; jax async dispatch pipelines the
+        # per-device work like the reference's section scope-queues)
+        for m in range(M):
+            acts = {}
+            for s, sec in enumerate(self.sections):
+                dev = self.devices[s]
+                env = dict(self._state[s])
+                for n in sec.feeds:
+                    v = mb_feeds[m][n]
+                    env[n] = jax.device_put(v, dev) if dev is not None \
+                        else v
+                for n in sec.fwd_in:
+                    v = acts[n]
+                    env[n] = jax.device_put(v, dev) if dev is not None \
+                        else v
+                outs = self._fwd[s](env)
+                for n in sec.state:
+                    if n in outs:
+                        self._state[s][n] = outs[n]
+                saved[m][s] = {n: outs[n] for n in sec.saved
+                               if n in outs}
+                for n in sec.fwd_out:
+                    acts[n] = outs[n]
+                if s == self.loss_stage and self.loss_name in outs:
+                    losses.append(outs[self.loss_name])
+        # backward sweep with gradient accumulation
+        grad_acc = [dict() for _ in range(S)]
+        for m in range(M):
+            grads = {}
+            for s in range(S - 1, -1, -1):
+                sec = self.sections[s]
+                if self._bwd[s] is None:
+                    continue
+                dev = self.devices[s]
+                env = dict(self._state[s])
+                env.update(saved[m][s])
+                for n in sec.bwd_in:
+                    v = grads[n]
+                    env[n] = jax.device_put(v, dev) if dev is not None \
+                        else v
+                outs = self._bwd[s](env)
+                for n in sec.bwd_out:
+                    grads[n] = outs[n]
+                for n in sec.param_grads:
+                    if n not in outs:
+                        continue
+                    if n in grad_acc[s]:
+                        grad_acc[s][n] = grad_acc[s][n] + outs[n]
+                    else:
+                        grad_acc[s][n] = outs[n]
+        # optimizer apply (mean of microbatch grads == full-batch grad)
+        for s, sec in enumerate(self.sections):
+            if self._opt[s] is None:
+                continue
+            env = dict(self._state[s])
+            for n, g in grad_acc[s].items():
+                env[n] = g / float(M)
+            outs = self._opt[s](env)
+            for n in sec.state:
+                if n in outs:
+                    self._state[s][n] = outs[n]
+        self._push_state()
+
+        results = []
+        loss_val = None
+        if losses:
+            loss_val = sum(jnp.mean(v) for v in losses) / float(len(losses))
+        for f in fetch_list or []:
+            name = f if isinstance(f, str) else f.name
+            if name == self.loss_name and loss_val is not None:
+                val = loss_val
+            else:
+                var = self.scope.find_var(name)
+                if var is None or var.get() is None:
+                    raise RuntimeError(
+                        f"pipeline fetch '{name}': only the loss and "
+                        "persistable state are fetchable")
+                val = var.get()
+            results.append(np.asarray(val) if return_numpy else val)
+        return results
+
+
+class PipelineOptimizer:
+    """reference optimizer.py:2664 PipelineOptimizer.
+
+    minimize() runs the inner optimizer, then CUTS the program into
+    per-stage sections at `fluid.pipeline_stage(i)` annotations
+    (compile-time IR surgery, like the reference's section split at
+    :2924) and attaches the plan; Executor.run detects it and drives the
+    GPipe section runner.  Programs with no stage annotations fall back
+    to plain single-section execution."""
 
     def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
         self._optimizer = optimizer
@@ -118,6 +481,18 @@ class PipelineOptimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None):
-        return self._optimizer.minimize(loss, startup_program,
-                                        parameter_list, no_grad_set,
-                                        grad_clip)
+        result = self._optimizer.minimize(loss, startup_program,
+                                          parameter_list, no_grad_set,
+                                          grad_clip)
+        program = loss.block.program
+        annotated = any(op.stage is not None
+                        for op in program.global_block().ops)
+        if annotated:
+            sections, loss_stage = build_pipeline_plan(program, loss.name)
+            program._pipeline_opt = {
+                "sections": sections,
+                "loss_stage": loss_stage,
+                "loss_name": loss.name,
+                "num_microbatches": self._num_microbatches,
+            }
+        return result

@@ -1,0 +1,117 @@
+"""PipelineOptimizer IR surgery tests (VERDICT r2 missing #3).
+
+Reference anchors: optimizer.py:2664,2924 (PipelineOptimizer.minimize
+cuts the Program into sections), framework/section_worker.cc:141
+(per-section workers), trainer.h:95 (scope queues between sections).
+
+A layers.*-built model annotated with fluid.pipeline_stage(i) must cut
+into stage sections and train with a loss trajectory matching the same
+model run unpipelined on a single device (GPipe grad accumulation over
+microbatches == full-batch gradient for batch-linear losses)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+
+def _staged_mlp(n_stages=4, width=32, annotate=True):
+    import contextlib
+
+    x = layers.data("x", shape=[16], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = x
+    for s in range(n_stages):
+        ctx = fluid.pipeline_stage(s) if annotate \
+            else contextlib.nullcontext()
+        with ctx:
+            h = layers.fc(h, size=width, act="tanh",
+                          name=f"stage{s}_fc")
+    with (fluid.pipeline_stage(n_stages - 1) if annotate
+          else contextlib.nullcontext()):
+        pred = layers.fc(h, size=1, name="head")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    return x, y, loss
+
+
+def _batches(n, bs=32, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(16, 1).astype(np.float32) * 0.5
+    for _ in range(n):
+        bx = rng.rand(bs, 16).astype(np.float32)
+        yield bx, np.tanh(bx @ W)
+
+
+def test_pipeline_minimize_cuts_program():
+    _, _, loss = _staged_mlp()
+    from paddle_tpu.parallel import PipelineOptimizer
+
+    opt = PipelineOptimizer(optimizer.SGD(learning_rate=0.1),
+                            num_microbatches=4)
+    opt.minimize(loss)
+    popt = fluid.default_main_program()._pipeline_opt
+    assert popt is not None
+    secs = popt["sections"]
+    assert len(secs) == 4
+    # every section really has work on all three phases (except stage
+    # ordering of opt for stages without params — all have fc params here)
+    for s in secs:
+        assert s.fwd_ops, s.idx
+        assert s.bwd_ops, s.idx
+        assert s.opt_ops, s.idx
+    # activations flow stage to stage; grads flow back
+    assert secs[0].fwd_out and secs[1].fwd_in
+    assert secs[1].bwd_out and not secs[0].bwd_in == []
+    # stage params: fc weights of stage i live in section i's state
+    for i, s in enumerate(secs):
+        assert any(f"stage{i}_fc" in n for n in s.state), (i, s.state)
+
+
+@pytest.mark.parametrize("microbatches", [1, 4])
+def test_pipeline_matches_single_device(fresh_programs_factory,
+                                        microbatches):
+    """pp=4 over the virtual 8-device CPU mesh: loss trajectory equals
+    the unpipelined single-program run (GPipe exactness for batch-linear
+    losses)."""
+    from paddle_tpu.parallel import PipelineOptimizer
+
+    trajs = {}
+    for pipelined in (False, True):
+        with fresh_programs_factory():
+            np.random.seed(42)
+            _, _, loss = _staged_mlp(annotate=pipelined)
+            if pipelined:
+                opt = PipelineOptimizer(
+                    optimizer.SGD(learning_rate=0.02),
+                    num_microbatches=microbatches)
+                opt.minimize(loss)
+                assert fluid.default_main_program()._pipeline_opt
+            else:
+                optimizer.SGD(learning_rate=0.02).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            losses = []
+            for bx, by in _batches(8):
+                (lv,) = exe.run(feed={"x": bx, "y": by},
+                                fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+            trajs[pipelined] = losses
+    np.testing.assert_allclose(trajs[True], trajs[False], rtol=2e-4,
+                               atol=1e-6)
+    assert trajs[True][-1] < trajs[True][0]
+
+
+def test_pipeline_stage_annotation_on_grad_ops():
+    _, _, loss = _staged_mlp(n_stages=2)
+    from paddle_tpu.parallel import PipelineOptimizer
+
+    PipelineOptimizer(optimizer.SGD(learning_rate=0.1),
+                      num_microbatches=2).minimize(loss)
+    ops = fluid.default_main_program().global_block().ops
+    for op in ops:
+        assert op.stage is not None, op
+    # a stage-0 op's grad stays on stage 0
+    fwd = [op for op in ops if op.type == "mul" and op.stage == 0]
+    grads = [op for op in ops if op.type == "mul_grad" and op.stage == 0]
+    assert fwd and grads
