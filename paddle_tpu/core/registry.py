@@ -173,7 +173,15 @@ def _generic_grad_def(fwd_type: str) -> OpDef:
             return {s: outs[s] for s in fwd.outputs if s in outs}
 
         primal_outs, vjp = jax.vjp(f, diff)
-        cts = jax.tree_util.tree_map(jnp.zeros_like, primal_outs)
+
+        def zero_ct(x):
+            # integer/bool outputs take float0 cotangents (jax's symbolic
+            # zero type) — an int zeros_like breaks vjp tree matching
+            if jnp.issubdtype(x.dtype, jnp.inexact):
+                return jnp.zeros_like(x)
+            return np.zeros(x.shape, dtype=jax.dtypes.float0)
+
+        cts = jax.tree_util.tree_map(zero_ct, primal_outs)
         for slot in list(primal_outs):
             g = ins.get(slot + GRAD_SUFFIX)
             if g is not None:

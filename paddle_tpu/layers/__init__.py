@@ -9,3 +9,13 @@ from paddle_tpu.layers.sequence_ops import *  # noqa: F401,F403
 from paddle_tpu.layers import distributions  # noqa: F401
 from paddle_tpu.layers import detection  # noqa: F401
 from paddle_tpu.layers.detection import *  # noqa: F401,F403
+from paddle_tpu.layers.extras import (  # noqa: F401
+    conv3d, conv3d_transpose, sequence_conv, row_conv,
+    bilinear_tensor_product, gru_unit, lstm_unit, dynamic_lstmp, lstm)
+
+# auto-generated single-op layers (reference layers/ops.py idiom via
+# layer_function_generator.py:349) — fills every remaining op-without-
+# layer gap without shadowing hand-written wrappers above
+from paddle_tpu.layers import layer_function_generator as _lfg
+
+_lfg.install(globals())

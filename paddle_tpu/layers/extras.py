@@ -1,0 +1,257 @@
+"""Hand-written layer front-ends that create parameters, covering the
+round-2 op waves the registry gained without user-facing layers
+(reference surface: python/paddle/fluid/layers/nn.py — conv3d :2110-area,
+sequence_conv :1777, row_conv :5972, bilinear_tensor_product :10530,
+gru_unit :1128, lstm_unit :4780, dynamic_lstmp :561, lstm (cudnn) :980).
+
+Parameter shapes follow this repo's op compute conventions (documented on
+each op in paddle_tpu/ops/*), which re-specify the reference's LoD inputs
+as padded [N, T, D] batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.layers.helper import LayerHelper
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCDHW", use_cudnn=True):
+    """reference layers/nn.py conv3d (op conv3d_op.cc)."""
+    helper = LayerHelper("conv3d", name=name)
+    c_in = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    fs = _triple(filter_size)
+    from paddle_tpu.initializer import MSRA
+
+    w = helper.create_parameter(
+        param_attr, [num_filters, c_in // groups, fs[0], fs[1], fs[2]],
+        input.dtype, default_initializer=MSRA(uniform=True))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="conv3d", inputs={"Input": input, "Filter": w},
+        outputs={"Output": out},
+        attrs={"strides": list(_triple(stride)),
+               "paddings": list(_triple(padding)),
+               "dilations": list(_triple(dilation)), "groups": groups,
+               "data_format": data_format})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out2 = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": out, "Y": b},
+                         outputs={"Out": out2},
+                         attrs={"axis": 1 if data_format == "NCDHW"
+                                else -1})
+        out = out2
+    return helper.append_activation(out, act)
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, act=None, name=None,
+                     output_size=None):
+    """reference layers/nn.py conv3d_transpose."""
+    helper = LayerHelper("conv3d_transpose", name=name)
+    c_in = input.shape[1]
+    fs = _triple(filter_size)
+    w = helper.create_parameter(
+        param_attr, [c_in, num_filters // groups, fs[0], fs[1], fs[2]],
+        input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="conv3d_transpose", inputs={"Input": input, "Filter": w},
+        outputs={"Output": out},
+        attrs={"strides": list(_triple(stride)),
+               "paddings": list(_triple(padding)),
+               "dilations": list(_triple(dilation)), "groups": groups,
+               "output_size": output_size or []})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out2 = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": out, "Y": b},
+                         outputs={"Out": out2}, attrs={"axis": 1})
+        out = out2
+    return helper.append_activation(out, act)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, param_attr=None, bias_attr=None,
+                  act=None, name=None):
+    """reference layers/nn.py:1777 sequence_conv (op sequence_conv_op.cc);
+    input [N, T, D] padded batch, Filter [filter_size*D, num_filters]."""
+    helper = LayerHelper("sequence_conv", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [filter_size * d, num_filters],
+                                input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_conv", inputs={"X": input, "Filter": w},
+        outputs={"Out": out},
+        attrs={"contextLength": filter_size, "contextStart": None,
+               "contextStride": filter_stride})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out2 = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": out, "Y": b},
+                         outputs={"Out": out2}, attrs={"axis": -1})
+        out = out2
+    return helper.append_activation(out, act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """reference layers/nn.py:5972 row_conv (lookahead convolution);
+    Filter [future_context_size, D]."""
+    helper = LayerHelper("row_conv", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [future_context_size, d],
+                                input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="row_conv", inputs={"X": input, "Filter": w},
+                     outputs={"Out": out})
+    return helper.append_activation(out, act)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference layers/nn.py:10530; Weight [size, dx, dy]."""
+    helper = LayerHelper("bilinear_tensor_product", name=name)
+    dx, dy = int(x.shape[-1]), int(y.shape[-1])
+    w = helper.create_parameter(param_attr, [size, dx, dy], x.dtype)
+    inputs = {"X": x, "Y": y, "Weight": w}
+    if bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(
+            bias_attr, [size], x.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": out})
+    return helper.append_activation(out, act)
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False, name=None):
+    """reference layers/nn.py:1128 gru_unit: input already projected to
+    [B, 3*size]; Weight [size, 3*size].  Returns (hidden, reset_hidden,
+    gate) like the reference."""
+    helper = LayerHelper("gru_unit", name=name)
+    w = helper.create_parameter(param_attr, [size, 3 * size], input.dtype)
+    inputs = {"Input": input, "HiddenPrev": hidden, "Weight": w}
+    if bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(
+            bias_attr, [3 * size], input.dtype, is_bias=True)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    reset = helper.create_variable_for_type_inference(input.dtype)
+    out_h = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="gru_unit", inputs=inputs,
+        outputs={"Gate": gate, "ResetHiddenPrev": reset, "Hidden": out_h},
+        attrs={"activation": activation,
+               "gate_activation": gate_activation,
+               "origin_mode": origin_mode})
+    return out_h, reset, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference layers/nn.py:4780 lstm_unit: fc([x_t, h_prev]) -> 4 gates
+    -> lstm_unit op.  Returns (hidden, cell)."""
+    from paddle_tpu import layers
+
+    helper = LayerHelper("lstm_unit", name=name)
+    size = int(cell_t_prev.shape[-1])
+    concat = layers.concat([x_t, hidden_t_prev], axis=-1)
+    gates = layers.fc(concat, size=4 * size, param_attr=param_attr,
+                      bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": gates, "C_prev": cell_t_prev},
+                     outputs={"C": c, "H": h},
+                     attrs={"forget_bias": float(forget_bias)})
+    return h, c
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  seq_len=None, param_attr=None, proj_attr=None,
+                  bias_attr=None, is_reverse=False, use_peepholes=True,
+                  name=None):
+    """reference layers/nn.py:561 dynamic_lstmp: LSTM with a projection
+    layer on the hidden state.  input [B, T, D] padded; returns
+    (projection [B, T, proj_size], cell [B, T, size])."""
+    from paddle_tpu import layers
+
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    # the lstmp op consumes PRE-PROJECTED gates [B, T, 4*size] plus the
+    # recurrent Weight [proj_size, 4*size] (rnn_ops.py lstmp contract,
+    # mirroring the reference where layers feed `input` through an fc
+    # before dynamic_lstmp — layers/nn.py:561 docstring)
+    gates = layers.fc(input, size=4 * size, num_flatten_dims=2,
+                      param_attr=param_attr, bias_attr=False)
+    w = helper.create_parameter(param_attr, [proj_size, 4 * size],
+                                input.dtype)
+    wp = helper.create_parameter(proj_attr, [size, proj_size],
+                                 input.dtype)
+    inputs = {"Input": gates, "Weight": w, "ProjWeight": wp}
+    if bias_attr is not False:
+        # with peepholes the bias packs [b (4*size) | Wic Wif Wio (3*size)]
+        # like the reference lstmp_op.cc
+        bsize = 7 * size if use_peepholes else 4 * size
+        inputs["Bias"] = helper.create_parameter(
+            bias_attr, [bsize], input.dtype, is_bias=True)
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    if seq_len is not None:
+        inputs["Length"] = seq_len
+    proj = helper.create_variable_for_type_inference(input.dtype)
+    cell = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="lstmp", inputs=inputs,
+                     outputs={"Projection": proj, "Cell": cell},
+                     attrs={"is_reverse": is_reverse,
+                            "use_peepholes": use_peepholes})
+    return proj, cell
+
+
+def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
+         num_layers=1, dropout_prob=0.0, is_bidirec=False, is_test=False,
+         name=None, param_attr=None, seed=0):
+    """reference layers/nn.py:980 lstm (op cudnn_lstm): cuDNN-style fused
+    multi-layer LSTM over [B, T, D].  Returns (out, last_h, last_c)."""
+    if num_layers != 1:
+        raise NotImplementedError(
+            "lstm: the cudnn_lstm op re-spec is single-layer; stack "
+            "lstm() calls for multi-layer")
+    helper = LayerHelper("lstm", name=name)
+    d = int(input.shape[-1])
+    hidden_size = hidden_size or int(init_h.shape[-1])
+    ndir = 2 if is_bidirec else 1
+    # flat weight blob per direction: [Wx (D*4H) | Wh (H*4H) | b (4H)]
+    # (matches ops/rnn_ops.py cudnn_lstm's packed layout)
+    total = ndir * (d * 4 * hidden_size + hidden_size * 4 * hidden_size
+                    + 4 * hidden_size)
+    w = helper.create_parameter(param_attr, [total], input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    last_h = helper.create_variable_for_type_inference(input.dtype)
+    last_c = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="cudnn_lstm",
+        inputs={"Input": input, "InitH": init_h, "InitC": init_c,
+                "W": w},
+        outputs={"Out": out, "last_h": last_h, "last_c": last_c},
+        attrs={"hidden_size": hidden_size, "is_bidirec": is_bidirec,
+               "input_size": d, "is_test": is_test, "seed": seed,
+               "dropout_prob": dropout_prob})
+    return out, last_h, last_c

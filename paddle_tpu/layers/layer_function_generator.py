@@ -1,0 +1,184 @@
+"""Auto-generate layer functions from op definitions.
+
+Reference parity: python/paddle/fluid/layers/layer_function_generator.py:349
+(generate_layer_fn builds layers/ops.py's functions from OpProtos).  Here
+the OpDef registry plays the OpProto role: input slots become positional/
+keyword arguments (matched case-insensitively), remaining kwargs must be
+registered attrs, outputs get fresh vars with shapes/dtypes filled by the
+registry's eval_shape inference.
+
+Only ops whose layers need no parameter creation are generated this way;
+layers that create parameters (conv3d, dynamic_lstm, ...) are hand-written
+in their modules.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.registry import get_op_def
+from paddle_tpu.layers.helper import LayerHelper
+
+
+def generate_layer_fn(op_type, layer_name=None, return_slot=None):
+    """return_slot: name of the single output slot to return (reference
+    layers often return only the main output of a multi-output op, e.g.
+    smooth_l1 returns Out and hides Diff); None returns all outputs."""
+    od = get_op_def(op_type)
+    lname = layer_name or op_type
+    slot_by_lower = {s.lower(): s for s in od.inputs}
+
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        helper = LayerHelper(lname, name=name)
+        ins = {}
+        if len(args) > len(od.inputs):
+            raise TypeError(
+                f"{lname}() takes at most {len(od.inputs)} positional "
+                f"arguments (input slots {od.inputs}), got {len(args)}")
+        for slot, val in zip(od.inputs, args):
+            if val is not None:
+                ins[slot] = val
+        attrs = {}
+        for k, v in kwargs.items():
+            slot = slot_by_lower.get(k.lower())
+            if slot is not None:
+                if v is not None:
+                    ins[slot] = v
+            elif k in od.attrs:
+                attrs[k] = v
+            else:
+                raise TypeError(
+                    f"{lname}(): unknown argument '{k}' (inputs "
+                    f"{od.inputs}, attrs {sorted(od.attrs)})")
+        missing = [s for s in od.inputs
+                   if s not in ins and s not in od.optional]
+        if missing:
+            raise TypeError(f"{lname}(): missing inputs {missing}")
+        from paddle_tpu import unique_name
+
+        outs = {}
+        out_vars = {}
+        for oslot in od.outputs:
+            v = helper.block.create_var(
+                name=unique_name.generate(
+                    f"{helper.name}.{oslot.lower()}"),
+                shape=None, dtype=None)
+            outs[oslot] = v
+            out_vars[oslot] = v
+        helper.append_op(type=op_type, inputs=ins, outputs=outs,
+                         attrs=attrs)
+        if return_slot is not None:
+            return out_vars[return_slot]
+        vals = list(out_vars.values())
+        return vals[0] if len(vals) == 1 else tuple(vals)
+
+    fn.__name__ = lname
+    fn.__qualname__ = lname
+    fn.__doc__ = (
+        f"``{lname}`` layer wrapping op ``{op_type}`` "
+        f"(auto-generated; reference layer_function_generator.py:349).\n\n"
+        f"Inputs: {', '.join(od.inputs)}"
+        + (f" (optional: {', '.join(sorted(od.optional))})"
+           if od.optional else "")
+        + f"\nAttrs: {', '.join(sorted(od.attrs))}"
+        + f"\nOutputs: {', '.join(od.outputs)}")
+    return fn
+
+
+# layer name -> op type.  Grouped per the reference module that exposes
+# them (layers/nn.py, layers/ops.py, layers/detection.py ...).
+GENERATED_LAYERS = {
+    # activations / unary math (reference layers/ops.py auto-gen)
+    "ceil": "ceil", "floor": "floor", "round": "round", "sin": "sin",
+    "cos": "cos", "erf": "erf", "rsqrt": "rsqrt",
+    "reciprocal": "reciprocal", "logsigmoid": "logsigmoid",
+    "hard_shrink": "hard_shrink", "hard_swish": "hard_swish",
+    "softshrink": "softshrink", "selu": "selu", "stanh": "stanh",
+    "tanh_shrink": "tanh_shrink", "thresholded_relu": "thresholded_relu",
+    "sign": "sign", "isfinite": "isfinite",
+    # comparisons / logic
+    "greater_equal": "greater_equal", "less_equal": "less_equal",
+    "logical_xor": "logical_xor",
+    # loss zoo (reference layers/nn.py)
+    "bpr_loss": "bpr_loss", "hinge_loss": "hinge_loss",
+    "kldiv_loss": "kldiv_loss", "margin_rank_loss": "margin_rank_loss",
+    "rank_loss": "rank_loss",
+    "modified_huber_loss": "modified_huber_loss",
+    "teacher_student_sigmoid_loss": "teacher_student_sigmoid_loss",
+    "smooth_l1": ("smooth_l1_loss", "Out"),
+    "squared_l2_distance": "squared_l2_distance",
+    "squared_l2_norm": "squared_l2_norm", "l1_norm": "l1_norm",
+    "warpctc": "warpctc",
+    # vision (reference layers/nn.py resize_* :6700-area etc.)
+    "resize_bilinear": "bilinear_interp",
+    "resize_nearest": "nearest_interp",
+    "image_resize": "bilinear_interp",
+    "affine_channel": "affine_channel", "affine_grid": "affine_grid",
+    "grid_sampler": "grid_sampler", "pixel_shuffle": "pixel_shuffle",
+    "shuffle_channel": "shuffle_channel",
+    "space_to_depth": "space_to_depth",
+    "temporal_shift": "temporal_shift", "unfold": "unfold",
+    "maxout": "maxout", "spp": "spp", "unpool": "unpool",
+    "random_crop": "random_crop", "crop": "crop",
+    "pad_constant_like": "pad_constant_like", "pool3d": "pool3d",
+    "similarity_focus": "similarity_focus", "fsp_matrix": "fsp",
+    "polygon_box_transform": "polygon_box_transform",
+    "max_pool2d_with_index": "max_pool2d_with_index",
+    "max_pool3d_with_index": "max_pool3d_with_index",
+    # sequence (reference layers/sequence ops)
+    "sequence_erase": "sequence_erase",
+    "sequence_expand_as": "sequence_expand_as",
+    "sequence_pad": "sequence_pad", "sequence_unpad": "sequence_unpad",
+    "sequence_reshape": "sequence_reshape",
+    "sequence_scatter": "sequence_scatter",
+    "sequence_slice": "sequence_slice",
+    "im2sequence": "im2sequence", "lod_reset": "lod_reset",
+    "gather_tree": "gather_tree", "edit_distance": "edit_distance",
+    "ctc_align": "ctc_align",
+    # tensor
+    "diag": "diag", "multiplex": "multiplex",
+    "strided_slice": "strided_slice", "unstack": "unstack",
+    "reverse": "reverse", "tile": "tile",
+    "gaussian_random": "gaussian_random",
+    "uniform_random": "uniform_random",
+    "gaussian_random_batch_size_like":
+        "gaussian_random_batch_size_like",
+    "uniform_random_batch_size_like": "uniform_random_batch_size_like",
+    "argmax": "arg_max", "argmin": "arg_min",
+    # metrics
+    "auc": "auc", "mean_iou": "mean_iou",
+    # misc (reference layers/nn.py)
+    "add_position_encoding": "add_position_encoding",
+    "conv_shift": "conv_shift", "continuous_value_model": "cvm",
+    "get_tensor_from_selected_rows": "get_tensor_from_selected_rows",
+    "merge_selected_rows": "merge_selected_rows",
+    "elementwise_mod": "elementwise_mod",
+    "elementwise_floordiv": "elementwise_floordiv",
+    "sampling_id": "sampling_id",
+    # fused families (reference operators/fused/)
+    "fused_elemwise_activation": "fused_elemwise_activation",
+    "fused_embedding_seq_pool": "fused_embedding_seq_pool",
+    "fused_embedding_fc_lstm": "fused_embedding_fc_lstm",
+    "fusion_gru": "fusion_gru", "fusion_lstm": "fusion_lstm",
+    "fusion_repeated_fc_relu": "fusion_repeated_fc_relu",
+    "fusion_seqconv_eltadd_relu": "fusion_seqconv_eltadd_relu",
+    "fusion_seqexpand_concat_fc": "fusion_seqexpand_concat_fc",
+    "fusion_seqpool_concat": "fusion_seqpool_concat",
+    "fusion_squared_mat_sub": "fusion_squared_mat_sub",
+    "fusion_transpose_flatten_concat":
+        "fusion_transpose_flatten_concat",
+    "conv2d_fusion": "conv2d_fusion",
+}
+
+
+def install(namespace):
+    """Create every GENERATED_LAYERS function that the namespace does not
+    already define by hand."""
+    made = []
+    for lname, spec in GENERATED_LAYERS.items():
+        if lname in namespace:
+            continue
+        op_type, ret = spec if isinstance(spec, tuple) else (spec, None)
+        namespace[lname] = generate_layer_fn(op_type, lname,
+                                             return_slot=ret)
+        made.append(lname)
+    return made

@@ -151,8 +151,9 @@ def conv3d(ins, attrs):
     x, w = ins["Input"], ins["Filter"]
     s, p, d = (_triple(attrs["strides"]), _triple(attrs["paddings"]),
                _triple(attrs["dilations"]))
+    fmt = attrs.get("data_format", "NCDHW")
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                    ("NCDHW", "OIDHW", "NCDHW"))
+                                    (fmt, "OIDHW", fmt))
     out = lax.conv_general_dilated(
         x, w, window_strides=s,
         padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
@@ -239,26 +240,35 @@ def pool3d(ins, attrs):
 
 
 def _max_pool_with_index(x, k, s, p, spatial_ndim):
-    """reference pool_with_index_op: returns (max, flat int64 index
-    into the flattened spatial dims of x)."""
-    spatial = x.shape[2:]
-    flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int64).reshape(
-        spatial)
-    idx = jnp.broadcast_to(flat_idx, x.shape)
+    """reference pool_with_index_op: returns (max, flat int64 index into
+    the flattened spatial dims of x).  The max comes from the ordinary
+    (differentiable) reduce_window; the index from a variadic
+    reduce_window under stop_gradient — its select-pair combinator has no
+    transpose rule, so it must stay out of the autodiff graph."""
     window = (1, 1) + tuple(k)
     strides = (1, 1) + tuple(s)
     pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
 
-    def sel(a, b):
-        av, ai = a
-        bv, bi = b
-        take_b = bv > av
-        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+    def index_of_max(xs):
+        spatial = xs.shape[2:]
+        flat_idx = jnp.arange(int(np.prod(spatial)),
+                              dtype=jnp.int64).reshape(spatial)
+        idx = jnp.broadcast_to(flat_idx, xs.shape)
 
-    out, oidx = lax.reduce_window(
-        (x, idx), (jnp.asarray(-jnp.inf, x.dtype),
-                   jnp.asarray(-1, jnp.int64)),
-        sel, window, strides, pads)
+        def sel(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+        _, oidx = lax.reduce_window(
+            (xs, idx), (jnp.asarray(-jnp.inf, xs.dtype),
+                        jnp.asarray(-1, jnp.int64)),
+            sel, window, strides, pads)
+        return oidx
+
+    oidx = index_of_max(lax.stop_gradient(x))
     return out, oidx
 
 

@@ -1,0 +1,366 @@
+"""Round-3 op sweep: the round-2 op waves run through the FRAMEWORK —
+one-op programs built with append_op, executed on BOTH executors
+(interpreter vs whole-program XLA, the reference OpTest dual-run
+pattern op_test.py:271), plus finite-difference gradient checks via
+append_backward for the differentiable ones (gradient_checker.py:45).
+
+Together with tests/test_op_sweep.py this covers 120+ op types through
+the compiled path.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, layers
+from paddle_tpu.backward import append_backward
+
+RNG = np.random.RandomState
+
+
+def C(op, ins, attrs=None, grad_wrt=None, fetch=None, atol=1e-5,
+      out_slot=None):
+    """Case: op type, {slot: ndarray}, attrs; grad_wrt names a float slot
+    to finite-difference check (None = no grad check)."""
+    return dict(op=op, ins=ins, attrs=attrs or {}, grad_wrt=grad_wrt,
+                fetch=fetch, atol=atol, out_slot=out_slot)
+
+
+def _r(*shape, seed=0, scale=1.0, shift=0.0):
+    return (RNG(seed).randn(*shape) * scale + shift).astype(np.float32)
+
+
+def _u(*shape, seed=0):
+    return RNG(seed).rand(*shape).astype(np.float32)
+
+
+def _i(hi, *shape, seed=0, dtype=np.int64):
+    return RNG(seed).randint(0, hi, shape).astype(dtype)
+
+
+def _cases():
+    out = []
+    x4 = _r(2, 4, 6, 6, scale=0.5)
+    # ---- vision ----------------------------------------------------------
+    out += [
+        C("bilinear_interp", {"X": x4},
+          {"out_h": 12, "out_w": 9}, grad_wrt="X"),
+        C("nearest_interp", {"X": x4},
+          {"out_h": 12, "out_w": 12}, grad_wrt="X"),
+        C("affine_channel", {"X": x4, "Scale": _r(4, seed=1),
+                             "Bias": _r(4, seed=2)}, grad_wrt="X"),
+        C("pixel_shuffle", {"X": _r(2, 8, 4, 4)},
+          {"upscale_factor": 2}, grad_wrt="X"),
+        C("shuffle_channel", {"X": x4}, {"group": 2}, grad_wrt="X"),
+        C("space_to_depth", {"X": x4}, {"blocksize": 2}, grad_wrt="X"),
+        C("temporal_shift", {"X": _r(4, 4, 3, 3)},
+          {"seg_num": 2, "shift_ratio": 0.25}, grad_wrt="X"),
+        C("unfold", {"X": x4}, {"kernel_sizes": [3, 3]}, grad_wrt="X"),
+        C("maxout", {"X": x4}, {"groups": 2}, grad_wrt="X"),
+        C("spp", {"X": _r(2, 3, 8, 8)},
+          {"pyramid_height": 2, "pooling_type": "max"}, grad_wrt="X"),
+        C("pad_constant_like", {"X": _r(3, 5), "Y": _r(2, 4, seed=3)},
+          {"pad_value": 0.5}, grad_wrt="Y"),
+        C("pool3d", {"X": _r(2, 3, 4, 6, 6)},
+          {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+           "pooling_type": "avg"}, grad_wrt="X"),
+        C("max_pool2d_with_index", {"X": _r(2, 3, 6, 6)},
+          {"ksize": [2, 2], "strides": [2, 2]}, grad_wrt="X"),
+        C("im2sequence", {"X": _r(2, 3, 6, 6)},
+          {"kernels": [2, 2], "strides": [2, 2]}, grad_wrt="X"),
+        C("polygon_box_transform", {"Input": _r(2, 8, 4, 4)}),
+        C("similarity_focus", {"X": _u(2, 3, 4, 4)},
+          {"axis": 1, "indexes": [0]}),
+        C("fsp", {"X": _r(2, 3, 5, 5), "Y": _r(2, 4, 5, 5, seed=1)},
+          grad_wrt="X"),
+        C("grid_sampler", {"X": _r(2, 3, 5, 5),
+                           "Grid": (_u(2, 5, 5, 2, seed=2) * 2 - 1)},
+          grad_wrt="X", out_slot="Output"),
+        C("affine_grid", {"Theta": _r(2, 2, 3, scale=0.3)},
+          {"output_shape": [2, 3, 4, 4]}, grad_wrt="Theta",
+          out_slot="Output"),
+        C("conv3d", {"Input": _r(1, 2, 4, 5, 5),
+                     "Filter": _r(3, 2, 2, 2, 2, seed=4, scale=0.3)},
+          grad_wrt="Input", out_slot="Output"),
+        C("conv3d_transpose", {"Input": _r(1, 3, 3, 4, 4),
+                               "Filter": _r(3, 2, 2, 2, 2, seed=5,
+                                            scale=0.3)},
+          grad_wrt="Input", out_slot="Output"),
+        C("row_conv", {"X": _r(2, 6, 4), "Filter": _r(3, 4, seed=6)},
+          grad_wrt="X"),
+        C("conv_shift", {"X": _r(2, 8), "Y": _r(2, 3, seed=7)},
+          grad_wrt="X"),
+        C("unpool", {"X": _r(2, 2, 3, 3),
+                     "Indices": np.tile(
+                         (np.arange(9).reshape(3, 3) * 4)
+                         .astype(np.int32), (2, 2, 1, 1))},
+          {"ksize": [2, 2], "strides": [2, 2]}),
+    ]
+    # ---- loss zoo --------------------------------------------------------
+    lbl2 = _i(3, 4, 1)
+    out += [
+        C("bpr_loss", {"X": _u(4, 3) + 0.1, "Label": lbl2},
+          grad_wrt="X"),
+        C("hinge_loss", {"Logits": _r(4, 1),
+                         "Labels": _i(2, 4, 1).astype(np.float32)},
+          grad_wrt="Logits"),
+        C("kldiv_loss", {"X": np.log(_u(4, 5, seed=1) + 0.1),
+                         "Target": _u(4, 5, seed=2)},
+          {"reduction": "mean"}, grad_wrt="X"),
+        C("margin_rank_loss", {"X1": _r(4, 1), "X2": _r(4, 1, seed=1),
+                               "Label": np.sign(_r(4, 1, seed=2))},
+          {"margin": 0.1}, grad_wrt="X1"),
+        C("rank_loss", {"Label": _i(2, 4, 1).astype(np.float32),
+                        "Left": _r(4, 1), "Right": _r(4, 1, seed=1)},
+          grad_wrt="Left"),
+        C("modified_huber_loss", {"X": _r(4, 1),
+                                  "Y": _i(2, 4, 1).astype(np.float32)}),
+        C("teacher_student_sigmoid_loss",
+          {"X": _r(4, 1), "Label": _u(4, 1, seed=1)}, grad_wrt="X"),
+        C("smooth_l1_loss", {"X": _r(4, 5), "Y": _r(4, 5, seed=1)},
+          {"sigma": 1.0}, grad_wrt="X"),
+        C("squared_l2_distance", {"X": _r(4, 5),
+                                  "Y": _r(4, 5, seed=1)}, grad_wrt="X"),
+        C("squared_l2_norm", {"X": _r(4, 5)}, grad_wrt="X"),
+        C("l1_norm", {"X": _r(4, 5)}, grad_wrt="X"),
+        C("cross_entropy2", {"X": _u(4, 6) + 0.05, "Label": _i(6, 4, 1)},
+          grad_wrt="X"),
+        C("warpctc", {"Logits": _r(3, 8, 5, scale=0.5),
+                      "Label": _i(4, 3, 4, dtype=np.int32) + 1},
+          {"blank": 0}, grad_wrt="Logits", atol=1e-4,
+          out_slot="Loss"),
+        C("huber_loss", {"X": _r(4, 1), "Y": _r(4, 1, seed=1)},
+          {"delta": 1.0}, grad_wrt="X", out_slot="Out"),
+    ]
+    # ---- sequence --------------------------------------------------------
+    out += [
+        C("sequence_erase", {"X": _i(5, 2, 6)}, {"tokens": [0, 2]}),
+        C("sequence_expand_as", {"X": _r(2, 3), "Y": _r(2, 4, 3)},
+          grad_wrt="X"),
+        C("sequence_pad", {"X": _r(2, 5, 3),
+                           "SeqLen": np.array([5, 3], np.int64)},
+          {"padded_length": 6}),
+        C("sequence_unpad", {"X": _r(2, 5, 3),
+                             "Length": np.array([4, 2], np.int64)}),
+        C("sequence_reshape", {"X": _r(2, 4, 6)}, {"new_dim": 8},
+          grad_wrt="X"),
+        C("sequence_scatter", {"X": _r(2, 6),
+                               "Ids": _i(6, 2, 3),
+                               "Updates": _r(2, 3, seed=1)},
+          grad_wrt="Updates"),
+        C("sequence_slice", {"X": _r(2, 6, 3),
+                             "Offset": np.array([[1], [0]], np.int64),
+                             "Length": np.array([[3], [4]], np.int64)}),
+        C("lod_reset", {"X": _r(2, 5)}, {"target_lod": [0, 1, 2]}),
+        C("gather_tree", {"Ids": _i(9, 4, 2, 3),
+                          "Parents": _i(3, 4, 2, 3)}),
+        C("ctc_align", {"Input": _i(4, 2, 6, dtype=np.int32)},
+          {"blank": 0}, out_slot="Output"),
+        C("edit_distance", {"Hyps": _i(5, 2, 4, dtype=np.int64),
+                            "Refs": _i(5, 2, 5, dtype=np.int64)}),
+        C("sequence_conv", {"X": _r(2, 6, 4),
+                            "Filter": _r(12, 5, seed=1, scale=0.3)},
+          {"contextLength": 3}, grad_wrt="X"),
+    ]
+    # ---- rnn / fused -----------------------------------------------------
+    B, T, I, D = 2, 4, 3, 4
+    out += [
+        C("lstm", {"Input": _r(B, T, 4 * D, scale=0.4),
+                   "Weight": _r(D, 4 * D, seed=1, scale=0.3)},
+          {"use_peepholes": False}, grad_wrt="Input",
+          out_slot="Hidden"),
+        C("gru", {"Input": _r(B, T, 3 * D, scale=0.4),
+                  "Weight": _r(D, 3 * D, seed=1, scale=0.3)},
+          grad_wrt="Input", out_slot="Hidden"),
+        C("lstmp", {"Input": _r(B, T, 4 * D, scale=0.4),
+                    "Weight": _r(3, 4 * D, seed=1, scale=0.3),
+                    "ProjWeight": _r(D, 3, seed=2, scale=0.3)},
+          {"use_peepholes": False}, grad_wrt="Input",
+          out_slot="Projection"),
+        C("gru_unit", {"Input": _r(B, 3 * D, scale=0.4),
+                       "HiddenPrev": _r(B, D, seed=1),
+                       "Weight": _r(D, 3 * D, seed=2, scale=0.3)},
+          grad_wrt="Input", out_slot="Hidden"),
+        C("lstm_unit", {"X": _r(B, 4 * D, scale=0.4),
+                        "C_prev": _r(B, D, seed=1)},
+          {"forget_bias": 1.0}, grad_wrt="X", out_slot="H"),
+        C("cudnn_lstm", {"Input": _r(B, T, I, scale=0.4),
+                         "W": _r(I * 4 * D + D * 4 * D + 4 * D,
+                                 seed=1, scale=0.2)},
+          {"hidden_size": D}, grad_wrt="Input", out_slot="Out"),
+        C("fusion_gru", {"X": _r(B, T, I, scale=0.4),
+                         "WeightX": _r(I, 3 * D, seed=1, scale=0.3),
+                         "WeightH": _r(D, 3 * D, seed=2, scale=0.3)},
+          grad_wrt="X", out_slot="Hidden"),
+        C("fusion_lstm", {"X": _r(B, T, I, scale=0.4),
+                          "WeightX": _r(I, 4 * D, seed=1, scale=0.3),
+                          "WeightH": _r(D, 4 * D, seed=2, scale=0.3)},
+          {"use_peepholes": False}, grad_wrt="X", out_slot="Hidden"),
+        C("fused_elemwise_activation",
+          {"X": _r(3, 4), "Y": _r(3, 4, seed=1)},
+          {"functor_list": ["elementwise_add", "relu"]}, grad_wrt="X"),
+        C("fused_embedding_seq_pool",
+          {"W": _r(10, 4, scale=0.3), "Ids": _i(10, 2, 5, 1)},
+          {"combiner": "sum"}, grad_wrt="W"),
+        C("fusion_repeated_fc_relu",
+          {"X": _r(3, 4), "W": _r(4, 4, seed=1, scale=0.4),
+           "Bias": _r(4, seed=2, scale=0.1)}, grad_wrt="X"),
+        C("fusion_seqconv_eltadd_relu",
+          {"X": _r(2, 6, 4), "Filter": _r(12, 5, seed=1, scale=0.3),
+           "Bias": _r(5, seed=2, scale=0.1)},
+          {"contextLength": 3}, grad_wrt="X"),
+        C("fusion_squared_mat_sub",
+          {"X": _r(3, 4), "Y": _r(4, 5, seed=1)}, {"scalar": 0.5},
+          fetch=["Out"], grad_wrt="X", out_slot="Out"),
+        C("conv2d_fusion", {"Input": _r(1, 2, 5, 5),
+                            "Filter": _r(3, 2, 3, 3, seed=1,
+                                         scale=0.3)},
+          {"paddings": [1, 1], "activation": "relu"},
+          grad_wrt="Input", out_slot="Output"),
+    ]
+    # ---- misc / tensor ---------------------------------------------------
+    out += [
+        C("add_position_encoding", {"X": _r(2, 6, 4)}, grad_wrt="X"),
+        C("cvm", {"X": _r(3, 6)}, {"use_cvm": True}, out_slot="Y"),
+        C("bilinear_tensor_product",
+          {"X": _r(3, 4), "Y": _r(3, 5, seed=1),
+           "Weight": _r(2, 4, 5, seed=2, scale=0.3)}, grad_wrt="X"),
+        C("minus", {"X": _r(3, 4), "Y": _r(3, 4, seed=1)},
+          grad_wrt="X"),
+        C("multiplex", {"X": [_r(4, 3), _r(4, 3, seed=1)],
+                        "Ids": _i(2, 4, 1, dtype=np.int32)}),
+        C("diag", {"Diagonal": _r(5)}),
+        C("sign", {"X": _r(3, 4)}),
+        C("stanh", {"X": _r(3, 4)}, grad_wrt="X"),
+        C("isfinite", {"X": _r(3, 4)}),
+        C("elementwise_mod", {"X": _i(10, 3, 4) + 1,
+                              "Y": _i(5, 3, 4, seed=1) + 1}),
+        C("elementwise_floordiv", {"X": _i(10, 3, 4) + 1,
+                                   "Y": _i(5, 3, 4, seed=1) + 1}),
+        C("greater_equal", {"X": _r(3, 4), "Y": _r(3, 4, seed=1)}),
+        C("less_equal", {"X": _r(3, 4), "Y": _r(3, 4, seed=1)}),
+        C("logical_xor", {"X": _r(3, 4) > 0, "Y": _r(3, 4, seed=1) > 0}),
+        C("mean_iou", {"Predictions": _i(3, 10, dtype=np.int64),
+                       "Labels": _i(3, 10, seed=1, dtype=np.int64)},
+          {"num_classes": 3}, out_slot="OutMeanIou"),
+        C("crop", {"X": _r(3, 5)}, {"offsets": [1, 1], "shape": [2, 3]},
+          grad_wrt="X"),
+        C("random_crop", {"X": _r(2, 3, 6, 6)},
+          {"shape": [3, 4, 4], "startup_seed": 7}),
+        C("diag", {"Diagonal": _r(4, seed=9)}),
+        C("pad2d", {"X": _r(2, 3, 4, 4)},
+          {"paddings": [1, 1, 2, 0], "mode": "reflect"}, grad_wrt="X"),
+        C("label_smooth", {"X": _u(4, 5)}, {"epsilon": 0.1},
+          grad_wrt="X"),
+        C("one_hot", {"X": _i(6, 4, 1)}, {"depth": 6}),
+        C("clip_by_norm", {"X": _r(3, 4)}, {"max_norm": 1.0},
+          grad_wrt="X"),
+        C("gather", {"X": _r(6, 3), "Index": _i(6, 4, dtype=np.int64)},
+          grad_wrt="X"),
+        C("scatter", {"X": _r(6, 3),
+                      "Ids": np.array([1, 3], np.int64),
+                      "Updates": _r(2, 3, seed=1)}, grad_wrt="Updates"),
+        C("norm", {"X": _r(3, 4)}, {"axis": 1}, grad_wrt="X",
+          out_slot="Out"),
+    ]
+    return out
+
+
+_CASES = _cases()
+_IDS = [f"{i}:{c['op']}" for i, c in enumerate(_CASES)]
+
+
+def _build(case):
+    """One-op program from data vars; returns (feed, out_var, x_var)."""
+    from paddle_tpu.core.registry import get_op_def
+
+    od = get_op_def(case["op"])
+    feed, ins = {}, {}
+    for slot, arr in case["ins"].items():
+        if isinstance(arr, list):
+            vs = []
+            for j, a in enumerate(arr):
+                name = f"in_{slot}_{j}"
+                v = layers.data(name, shape=list(a.shape),
+                                dtype=str(a.dtype),
+                                append_batch_size=False,
+                                stop_gradient=False)
+                feed[name] = a
+                vs.append(v)
+            ins[slot] = vs
+        else:
+            name = f"in_{slot}"
+            v = layers.data(name, shape=list(arr.shape),
+                            dtype=str(arr.dtype),
+                            append_batch_size=False,
+                            stop_gradient=not np.issubdtype(
+                                arr.dtype, np.floating))
+            feed[name] = arr
+            ins[slot] = v
+    block = framework.default_main_program().global_block()
+    outs = {}
+    for oslot in od.outputs:
+        outs[oslot] = block.create_var(name=f"out_{oslot}", shape=None,
+                                       dtype=None)
+    block.append_op(type=case["op"], inputs=ins, outputs=outs,
+                    attrs=dict(case["attrs"]))
+    out_slot = case["out_slot"] or od.outputs[0]
+    return feed, outs[out_slot]
+
+
+@pytest.mark.parametrize("case", _CASES, ids=_IDS)
+def test_dual_executor_and_grad(case):
+    feed, out = _build(case)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (r_interp,) = exe.run(framework.default_main_program(), feed=feed,
+                          fetch_list=[out])
+    (r_comp,) = exe.run(
+        fluid.CompiledProgram(framework.default_main_program()),
+        feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(
+        np.asarray(r_interp, np.float64),
+        np.asarray(r_comp, np.float64),
+        rtol=1e-4, atol=case["atol"], err_msg=case["op"])
+
+    if case["grad_wrt"] is None:
+        return
+    # gradient: FD-check d mean(out) / d <grad_wrt> on sampled elements
+    loss = layers.mean(out)
+    append_backward(loss)
+    gname = f"in_{case['grad_wrt']}@GRAD"
+    xv = case["ins"][case["grad_wrt"]]
+    (g,) = exe.run(framework.default_main_program(), feed=feed,
+                   fetch_list=[gname])
+    g = np.asarray(g).reshape(-1)
+    eps = 1e-2
+    idx = np.linspace(0, xv.size - 1, num=min(6, xv.size),
+                      dtype=np.int64)
+    for i in idx:
+        fp = dict(feed)
+        xp = xv.copy().reshape(-1)
+        xm = xv.copy().reshape(-1)
+        xp[i] += eps
+        xm[i] -= eps
+        fp[f"in_{case['grad_wrt']}"] = xp.reshape(xv.shape)
+        (lp,) = exe.run(framework.default_main_program(), feed=fp,
+                        fetch_list=[loss])
+        fp[f"in_{case['grad_wrt']}"] = xm.reshape(xv.shape)
+        (lm,) = exe.run(framework.default_main_program(), feed=fp,
+                        fetch_list=[loss])
+        num = (float(lp) - float(lm)) / (2 * eps)
+        np.testing.assert_allclose(
+            g[i], num, rtol=5e-2, atol=5e-3,
+            err_msg=f"{case['op']} d/d{case['grad_wrt']}[{i}]")
+
+
+def test_sweep_covers_120_ops():
+    """Combined op coverage of the two sweep files >= 120 distinct ops."""
+    import re
+
+    ops = {c["op"] for c in _CASES}
+    src = open("tests/test_op_sweep.py").read()
+    ops |= set(re.findall(r'_u\("([a-z0-9_]+)"', src))
+    ops |= {"elementwise_add", "elementwise_sub", "elementwise_mul",
+            "elementwise_max", "elementwise_min"}
+    assert len(ops) >= 120, (len(ops), sorted(ops))
