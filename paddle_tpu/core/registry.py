@@ -185,6 +185,15 @@ def _generic_grad_def(fwd_type: str) -> OpDef:
         for slot in list(primal_outs):
             g = ins.get(slot + GRAD_SUFFIX)
             if g is not None:
+                p = primal_outs[slot]
+                if hasattr(g, "shape") and hasattr(p, "shape") and \
+                        g.shape != p.shape and tuple(
+                            d for d in g.shape if d != 1) == tuple(
+                            d for d in p.shape if d != 1):
+                    # squeeze-compatible mismatches only ([] vs [1],
+                    # [N,1] vs [N]) — anything else must still raise in
+                    # vjp rather than silently scramble a gradient
+                    g = jnp.reshape(g, p.shape)
                 cts[slot] = g
         (d_in,) = vjp(cts)
         return {k + GRAD_SUFFIX: v for k, v in d_in.items()}

@@ -11,7 +11,9 @@ from paddle_tpu.layers import detection  # noqa: F401
 from paddle_tpu.layers.detection import *  # noqa: F401,F403
 from paddle_tpu.layers.extras import (  # noqa: F401
     conv3d, conv3d_transpose, sequence_conv, row_conv,
-    bilinear_tensor_product, gru_unit, lstm_unit, dynamic_lstmp, lstm)
+    bilinear_tensor_product, gru_unit, lstm_unit, dynamic_lstmp, lstm,
+    sync_batch_norm, spectral_norm, data_norm, deformable_conv,
+    tree_conv, distribute_fpn_proposals)
 
 # auto-generated single-op layers (reference layers/ops.py idiom via
 # layer_function_generator.py:349) — fills every remaining op-without-

@@ -255,3 +255,192 @@ def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
                "input_size": d, "is_test": is_test, "seed": seed,
                "dropout_prob": dropout_prob})
     return out, last_h, last_c
+
+
+def sync_batch_norm(input, act=None, is_test=False, momentum=0.9,
+                    epsilon=1e-5, param_attr=None, bias_attr=None,
+                    data_layout="NCHW", name=None, sync_axis="dp"):
+    """reference layers sync_batch_norm (op sync_batch_norm_op.cu):
+    batch norm with cross-replica statistics.  Under the compiled GSPMD
+    path plain batch_norm already sees the global batch; this layer
+    matters for explicit-SPMD (shard_map) models — see ops/nn.py."""
+    from paddle_tpu.initializer import Constant
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("sync_batch_norm", name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(param_attr, [c], input.dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype,
+                                   is_bias=True)
+    mean = helper.create_parameter(
+        ParamAttr(trainable=False, initializer=Constant(0.0)), [c],
+        input.dtype)
+    var = helper.create_parameter(
+        ParamAttr(trainable=False, initializer=Constant(1.0)), [c],
+        input.dtype)
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    y = helper.create_variable_for_type_inference(input.dtype)
+    sm = helper.create_variable_for_type_inference(input.dtype, True)
+    sv = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(
+        type="sync_batch_norm",
+        inputs={"X": input, "Scale": scale, "Bias": bias, "Mean": mean,
+                "Variance": var},
+        outputs={"Y": y, "MeanOut": mean, "VarianceOut": var,
+                 "SavedMean": sm, "SavedVariance": sv},
+        attrs={"epsilon": epsilon, "momentum": momentum,
+               "is_test": is_test, "data_layout": data_layout,
+               "sync_axis": sync_axis})
+    return helper.append_activation(y, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference layers/nn.py spectral_norm (op spectral_norm_op.cc):
+    returns weight / sigma_max estimated by persistent power
+    iteration."""
+    from paddle_tpu.initializer import Normal
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("spectral_norm", name=name)
+    h = int(weight.shape[dim])
+    w = int(np.prod(weight.shape)) // h
+    u = helper.create_parameter(
+        ParamAttr(trainable=False, initializer=Normal(0.0, 1.0)), [h],
+        weight.dtype)
+    v = helper.create_parameter(
+        ParamAttr(trainable=False, initializer=Normal(0.0, 1.0)), [w],
+        weight.dtype)
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op(type="spectral_norm",
+                     inputs={"Weight": weight, "U": u, "V": v},
+                     outputs={"Out": out},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
+              name=None):
+    """reference layers/nn.py data_norm (op data_norm_op.cc): CTR
+    feature normalization by accumulated batch statistics (persistable
+    BatchSize/BatchSum/BatchSquareSum, updated by the training program
+    like BN running stats)."""
+    from paddle_tpu.initializer import Constant
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("data_norm", name=name)
+    c = int(input.shape[-1])
+    bsz = helper.create_parameter(
+        ParamAttr(trainable=False, initializer=Constant(1e4)), [c],
+        input.dtype)
+    bsum = helper.create_parameter(
+        ParamAttr(trainable=False, initializer=Constant(0.0)), [c],
+        input.dtype)
+    bsq = helper.create_parameter(
+        ParamAttr(trainable=False, initializer=Constant(1e4)), [c],
+        input.dtype)
+    for vv in (bsz, bsum, bsq):
+        vv.stop_gradient = True
+    y = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(input.dtype, True)
+    scales = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(
+        type="data_norm",
+        inputs={"X": input, "BatchSize": bsz, "BatchSum": bsum,
+                "BatchSquareSum": bsq},
+        outputs={"Y": y, "Means": means, "Scales": scales},
+        attrs={"epsilon": epsilon})
+    return helper.append_activation(y, act)
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=64,
+                    param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """reference layers/nn.py deformable_conv (deformable_conv_op.cc v2
+    when modulated, v1 otherwise)."""
+    from paddle_tpu.initializer import MSRA
+
+    helper = LayerHelper("deformable_conv", name=name)
+    c_in = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else \
+        (filter_size, filter_size)
+    w = helper.create_parameter(
+        param_attr, [num_filters, c_in // groups, fs[0], fs[1]],
+        input.dtype, default_initializer=MSRA(uniform=True))
+    ins = {"Input": input, "Offset": offset, "Filter": w}
+    if modulated and mask is not None:
+        ins["Mask"] = mask
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="deformable_conv", inputs=ins, outputs={"Output": out},
+        attrs={"strides": [stride, stride] if np.isscalar(stride)
+               else list(stride),
+               "paddings": [padding, padding] if np.isscalar(padding)
+               else list(padding),
+               "dilations": [dilation, dilation]
+               if np.isscalar(dilation) else list(dilation),
+               "groups": groups, "deformable_groups": deformable_groups,
+               "im2col_step": im2col_step})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters],
+                                    input.dtype, is_bias=True)
+        out2 = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": out, "Y": b},
+                         outputs={"Out": out2}, attrs={"axis": 1})
+        out = out2
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """reference layers/nn.py tree_conv (tree_conv_op.cc, TBCNN):
+    filter [F, 3, output_size, num_filters], output
+    [N, M, output_size, num_filters], optional bias + activation."""
+    helper = LayerHelper("tree_conv", name=name)
+    f = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(
+        param_attr, [f, 3, output_size, num_filters],
+        nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(nodes_vector.dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": nodes_vector, "EdgeSet": edge_set,
+                "Filter": w},
+        outputs={"Out": out}, attrs={"max_depth": max_depth})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters],
+                                    nodes_vector.dtype, is_bias=True)
+        out2 = helper.create_variable_for_type_inference(
+            nodes_vector.dtype)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": out, "Y": b},
+                         outputs={"Out": out2}, attrs={"axis": -1})
+        out = out2
+    return helper.append_activation(out, act)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level=2, max_level=5,
+                             refer_level=4, refer_scale=224, name=None):
+    """reference layers/detection.py distribute_fpn_proposals: routes
+    rois to pyramid levels.  Hand-written (not generated) because the
+    MultiFpnRois output is duplicable: one var per level.  Returns
+    (multi_rois list, restore_index)."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_levels = max_level - min_level + 1
+    multi = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+             for _ in range(n_levels)]
+    restore = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op(
+        type="distribute_fpn_proposals", inputs={"FpnRois": fpn_rois},
+        outputs={"MultiFpnRois": multi, "RestoreIndex": restore},
+        attrs={"min_level": min_level, "max_level": max_level,
+               "refer_level": refer_level, "refer_scale": refer_scale})
+    return multi, restore

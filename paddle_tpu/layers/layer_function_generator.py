@@ -154,6 +154,19 @@ GENERATED_LAYERS = {
     "elementwise_mod": "elementwise_mod",
     "elementwise_floordiv": "elementwise_floordiv",
     "sampling_id": "sampling_id",
+    # detection: RPN/FPN/RCNN family (reference layers/detection.py +
+    # operators/detection/)
+    "generate_proposals": "generate_proposals",
+    "rpn_target_assign": "rpn_target_assign",
+    "generate_proposal_labels": "generate_proposal_labels",
+    "generate_mask_labels": "generate_mask_labels",
+    # distribute_fpn_proposals is hand-written in layers/extras.py (its
+    # MultiFpnRois output slot is duplicable: one var per pyramid level)
+    "collect_fpn_proposals": "collect_fpn_proposals",
+    "bipartite_match": "bipartite_match",
+    "mine_hard_examples": "mine_hard_examples",
+    "detection_map": "detection_map",
+    "psroi_pool": "psroi_pool",
     # fused families (reference operators/fused/)
     "fused_elemwise_activation": "fused_elemwise_activation",
     "fused_embedding_seq_pool": "fused_embedding_seq_pool",

@@ -674,3 +674,531 @@ def yolov3_loss(ins, attrs):
     loss_obj = loss_obj.sum(axis=(1, 2, 3))
 
     return {"Loss": loss_box + loss_obj + loss_cls}
+
+
+# ---------------------------------------------------------------------------
+# RPN / FPN / RCNN family (reference operators/detection/
+# generate_proposals_op.cc, rpn_target_assign_op.cc,
+# distribute_fpn_proposals_op.cc, collect_fpn_proposals_op.cc,
+# generate_proposal_labels_op.cc, generate_mask_labels_op.cc).
+# LoD outputs are re-specified as fixed-budget padded tensors (invalid
+# rows marked with score/label -1), the same convention as
+# multiclass_nms above — XLA needs static shapes.
+# ---------------------------------------------------------------------------
+
+def _decode_center_size(anchors, deltas, variances=None):
+    """box_coder decode_center_size (reference box_coder_op.cc)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    if variances is not None:
+        deltas = deltas * variances
+    cx = deltas[:, 0] * aw + acx
+    cy = deltas[:, 1] * ah + acy
+    clip = float(np.log(1000.0 / 16.0))  # kBBoxClipDefault
+    w = jnp.exp(jnp.minimum(deltas[:, 2], clip)) * aw
+    h = jnp.exp(jnp.minimum(deltas[:, 3], clip)) * ah
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], axis=1)
+
+
+@register_op("generate_proposals",
+             inputs=("Scores", "BboxDeltas", "ImInfo", "Anchors",
+                     "Variances"),
+             outputs=("RpnRois", "RpnRoiProbs"),
+             optional=("Variances",),
+             attrs={"pre_nms_topN": 6000, "post_nms_topN": 1000,
+                    "nms_thresh": 0.5, "min_size": 0.1, "eta": 1.0},
+             differentiable=False)
+def generate_proposals(ins, attrs):
+    """generate_proposals_op.cc: decode RPN deltas onto anchors, clip to
+    the image, drop boxes smaller than min_size, take pre_nms_topN by
+    score, NMS, emit post_nms_topN (padded, prob -1 on padding).
+    Scores [N,A,H,W]; BboxDeltas [N,4A,H,W]; Anchors [H,W,A,4] (or
+    [A*H*W,4]); ImInfo [N,3] (h, w, scale)."""
+    scores, deltas, im_info = ins["Scores"], ins["BboxDeltas"], \
+        ins["ImInfo"]
+    anchors = ins["Anchors"].reshape(-1, 4)
+    variances = ins.get("Variances")
+    if variances is not None:
+        variances = variances.reshape(-1, 4)
+    n, a, h, w = scores.shape
+    k = a * h * w
+    post = int(attrs["post_nms_topN"])
+    pre = min(int(attrs["pre_nms_topN"]), k)
+
+    # [N,A,H,W] -> [N, H*W*A] matching anchors laid out [H,W,A,4]
+    sc = jnp.transpose(scores, (0, 2, 3, 1)).reshape(n, k)
+    dl = jnp.transpose(deltas.reshape(n, a, 4, h, w),
+                       (0, 3, 4, 1, 2)).reshape(n, k, 4)
+
+    def per_image(sc_i, dl_i, info_i):
+        boxes = _decode_center_size(anchors, dl_i, variances)
+        ih, iw = info_i[0], info_i[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0.0, iw - 1.0),
+            jnp.clip(boxes[:, 1], 0.0, ih - 1.0),
+            jnp.clip(boxes[:, 2], 0.0, iw - 1.0),
+            jnp.clip(boxes[:, 3], 0.0, ih - 1.0)], axis=1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        ms = attrs["min_size"] * info_i[2]
+        valid = (ws >= ms) & (hs >= ms)
+        s = jnp.where(valid, sc_i, -jnp.inf)
+        top_s, order = jax.lax.top_k(s, pre)
+        cand = boxes[order]
+        keep, korder, kscores = _nms_single(
+            cand, top_s, attrs["nms_thresh"], -jnp.inf,
+            min(pre, post if post > 0 else pre), normalized=False,
+            eta=attrs["eta"])
+        out_boxes = cand[korder]
+        out_scores = jnp.where(keep, kscores, -1.0)
+        out_boxes = jnp.where(keep[:, None], out_boxes, 0.0)
+        m = out_boxes.shape[0]
+        if m < post:
+            out_boxes = jnp.pad(out_boxes, ((0, post - m), (0, 0)))
+            out_scores = jnp.pad(out_scores, (0, post - m),
+                                 constant_values=-1.0)
+        return out_boxes[:post], out_scores[:post]
+
+    rois, probs = jax.vmap(per_image)(sc, dl, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs[..., None]}
+
+
+@register_op("rpn_target_assign",
+             inputs=("Anchor", "GtBoxes", "IsCrowd", "ImInfo"),
+             outputs=("LocationIndex", "ScoreIndex", "TargetBBox",
+                      "TargetLabel", "BBoxInsideWeight"),
+             optional=("IsCrowd", "ImInfo"),
+             attrs={"rpn_batch_size_per_im": 256,
+                    "rpn_straddle_thresh": 0.0,
+                    "rpn_fg_fraction": 0.5,
+                    "rpn_positive_overlap": 0.7,
+                    "rpn_negative_overlap": 0.3,
+                    "use_random": False},
+             differentiable=False)
+def rpn_target_assign(ins, attrs):
+    """rpn_target_assign_op.cc re-spec: per image, anchors with IoU >=
+    positive_overlap vs any gt (or argmax per gt) are positive, IoU <
+    negative_overlap negative; deterministic sampling keeps the
+    highest-IoU positives and lowest-IoU negatives up to the batch
+    budget (use_random=False path).  Anchor [A,4]; GtBoxes [N,G,4]
+    (zero rows = padding).  Index outputs are [N, budget] padded -1
+    (LoD flattening re-spec); TargetBBox are encoded regression targets
+    for the sampled positives."""
+    anchors = ins["Anchor"].reshape(-1, 4)
+    gt = ins["GtBoxes"]
+    n, g, _ = gt.shape
+    a = anchors.shape[0]
+    budget = int(attrs["rpn_batch_size_per_im"])
+    n_fg = int(budget * attrs["rpn_fg_fraction"])
+    n_bg = budget - n_fg
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+
+    def per_image(gt_i):
+        gt_valid = (gt_i[:, 2] > gt_i[:, 0]) & (gt_i[:, 3] > gt_i[:, 1])
+        iou = _pairwise_iou(anchors, gt_i, normalized=False)
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+        best_iou = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        # anchors that are the best for some gt are positive too
+        per_gt_best = jnp.max(iou, axis=0)
+        is_gt_best = jnp.any(
+            (iou >= per_gt_best[None, :] - 1e-6) & (iou > 0)
+            & gt_valid[None, :], axis=1)
+        pos = (best_iou >= attrs["rpn_positive_overlap"]) | is_gt_best
+        neg = (best_iou < attrs["rpn_negative_overlap"]) & ~pos
+        # deterministic sample: top IoU positives, lowest IoU negatives
+        pos_score = jnp.where(pos, best_iou, -jnp.inf)
+        _, pos_idx = jax.lax.top_k(pos_score, min(n_fg, a))
+        pos_ok = pos[pos_idx]
+        neg_score = jnp.where(neg, -best_iou, -jnp.inf)
+        _, neg_idx = jax.lax.top_k(neg_score, min(n_bg, a))
+        neg_ok = neg[neg_idx]
+        loc_idx = jnp.where(pos_ok, pos_idx, -1)
+        score_idx = jnp.concatenate([loc_idx,
+                                     jnp.where(neg_ok, neg_idx, -1)])
+        # regression targets for sampled positives
+        tgt = gt_i[best_gt[pos_idx]]
+        tw = tgt[:, 2] - tgt[:, 0] + 1.0
+        th = tgt[:, 3] - tgt[:, 1] + 1.0
+        tcx = tgt[:, 0] + 0.5 * tw
+        tcy = tgt[:, 1] + 0.5 * th
+        paw, pah = aw[pos_idx], ah[pos_idx]
+        dx = (tcx - acx[pos_idx]) / paw
+        dy = (tcy - acy[pos_idx]) / pah
+        dw = jnp.log(tw / paw)
+        dh = jnp.log(th / pah)
+        tbox = jnp.stack([dx, dy, dw, dh], axis=1)
+        tbox = jnp.where(pos_ok[:, None], tbox, 0.0)
+        label = jnp.concatenate([
+            jnp.where(pos_ok, 1, -1),
+            jnp.where(neg_ok, 0, -1)]).astype(jnp.int32)
+        inw = jnp.where(pos_ok[:, None], 1.0, 0.0)
+        inw = jnp.broadcast_to(inw, tbox.shape)
+        return loc_idx, score_idx, tbox, label, inw
+
+    loc, sidx, tbox, lbl, inw = jax.vmap(per_image)(gt)
+    return {"LocationIndex": loc, "ScoreIndex": sidx,
+            "TargetBBox": tbox, "TargetLabel": lbl,
+            "BBoxInsideWeight": inw}
+
+
+@register_op("distribute_fpn_proposals",
+             inputs=("FpnRois",),
+             outputs=("MultiFpnRois", "RestoreIndex"),
+             duplicable=("MultiFpnRois",),
+             attrs={"min_level": 2, "max_level": 5, "refer_level": 4,
+                    "refer_scale": 224},
+             differentiable=False)
+def distribute_fpn_proposals(ins, attrs):
+    """distribute_fpn_proposals_op.cc: route each roi to the pyramid
+    level log2(sqrt(area)/refer_scale)+refer_level.  FpnRois [R,4]
+    (padding rows have zero area and land at min_level with a dead
+    mark).  Each per-level output is [R,4] with non-member rows zeroed
+    and compacted to the front; RestoreIndex[r] gives the row's position
+    in the level-major concatenation."""
+    rois = ins["FpnRois"].reshape(-1, 4)
+    lo, hi = int(attrs["min_level"]), int(attrs["max_level"])
+    ws = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    hs = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    scale = jnp.sqrt(ws * hs)
+    lvl = jnp.floor(jnp.log2(scale / attrs["refer_scale"] + 1e-6)
+                    ) + attrs["refer_level"]
+    lvl = jnp.clip(lvl, lo, hi).astype(jnp.int32)
+    outs = []
+    r = rois.shape[0]
+    # rank of each roi within its level (stable original order)
+    level_key = lvl * r + jnp.arange(r)
+    rank_global = jnp.argsort(jnp.argsort(level_key))
+    level_start_rank = jnp.take(
+        jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                         jnp.cumsum(jnp.bincount(lvl - lo,
+                                                 length=hi - lo + 1))
+                         .astype(jnp.int32)[:-1]]), lvl - lo)
+    rank_in_level = rank_global.astype(jnp.int32) - level_start_rank
+    # RestoreIndex addresses the CONCATENATION OF THE (padded) OUTPUTS:
+    # each level block is R rows, members compacted to its front
+    restore = ((lvl - lo) * r + rank_in_level).astype(jnp.int32)
+    for level in range(lo, hi + 1):
+        member = lvl == level
+        # compact members to the front (stable)
+        key = jnp.where(member, jnp.arange(r), r + jnp.arange(r))
+        idx = jnp.argsort(key)
+        sel = rois[idx] * member[idx][:, None]
+        outs.append(sel)
+    return {"MultiFpnRois": outs, "RestoreIndex": restore[:, None]}
+
+
+@register_op("collect_fpn_proposals",
+             inputs=("MultiLevelRois", "MultiLevelScores"),
+             outputs=("FpnRois",),
+             duplicable=("MultiLevelRois", "MultiLevelScores"),
+             attrs={"post_nms_topN": 1000},
+             differentiable=False)
+def collect_fpn_proposals(ins, attrs):
+    """collect_fpn_proposals_op.cc: concat per-level rois, keep the
+    overall top post_nms_topN by score.  Rois_l [R_l,4], Scores_l
+    [R_l] (or [R_l,1]); padding has score -1."""
+    rois = jnp.concatenate([r.reshape(-1, 4)
+                            for r in ins["MultiLevelRois"]], axis=0)
+    scores = jnp.concatenate([s.reshape(-1)
+                              for s in ins["MultiLevelScores"]], axis=0)
+    k = min(int(attrs["post_nms_topN"]), scores.shape[0])
+    top_s, idx = jax.lax.top_k(scores, k)
+    out = rois[idx] * (top_s >= 0)[:, None]
+    return {"FpnRois": out}
+
+
+@register_op("generate_proposal_labels",
+             inputs=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes",
+                     "ImInfo"),
+             outputs=("Rois", "LabelsInt32", "BboxTargets",
+                      "BboxInsideWeights", "BboxOutsideWeights"),
+             optional=("IsCrowd", "ImInfo"),
+             attrs={"batch_size_per_im": 256, "fg_fraction": 0.25,
+                    "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                    "bg_thresh_lo": 0.0, "class_nums": 81,
+                    "use_random": False,
+                    "bbox_reg_weights": [0.1, 0.1, 0.2, 0.2]},
+             differentiable=False)
+def generate_proposal_labels(ins, attrs):
+    """generate_proposal_labels_op.cc re-spec: per image, match rois to
+    gt by IoU; fg rois (IoU>=fg_thresh) get the gt class and encoded
+    regression targets placed in their class' 4-column slot; bg rois
+    (bg_thresh_lo<=IoU<bg_thresh_hi) get label 0.  Deterministic
+    top-IoU sampling to batch_size_per_im (use_random=False path).
+    RpnRois [N,R,4]; GtClasses [N,G]; GtBoxes [N,G,4]."""
+    rois, gtc, gtb = ins["RpnRois"], ins["GtClasses"], ins["GtBoxes"]
+    n, r, _ = rois.shape
+    budget = min(int(attrs["batch_size_per_im"]), r)
+    n_fg = int(budget * attrs["fg_fraction"])
+    cnum = int(attrs["class_nums"])
+    wts = jnp.asarray(attrs["bbox_reg_weights"])
+
+    def per_image(rois_i, gtc_i, gtb_i):
+        gt_valid = (gtb_i[:, 2] > gtb_i[:, 0]) & \
+                   (gtb_i[:, 3] > gtb_i[:, 1])
+        iou = _pairwise_iou(rois_i, gtb_i)
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        fg = best >= attrs["fg_thresh"]
+        bg = (best < attrs["bg_thresh_hi"]) & \
+             (best >= attrs["bg_thresh_lo"]) & ~fg
+        fg_score = jnp.where(fg, best, -jnp.inf)
+        _, fg_idx = jax.lax.top_k(fg_score, min(n_fg, r))
+        fg_ok = fg[fg_idx]
+        nbg = budget - min(n_fg, r)
+        bg_score = jnp.where(bg, best, -jnp.inf)
+        _, bg_idx = jax.lax.top_k(bg_score, max(nbg, 0))
+        bg_ok = bg[bg_idx]
+        sel = jnp.concatenate([fg_idx, bg_idx])
+        ok = jnp.concatenate([fg_ok, bg_ok])
+        out_rois = rois_i[sel] * ok[:, None]
+        labels = jnp.where(
+            jnp.concatenate([fg_ok, jnp.zeros_like(bg_ok)]),
+            gtc_i[best_gt[sel]].astype(jnp.int32), 0)
+        labels = jnp.where(ok, labels, -1).astype(jnp.int32)
+        # encoded targets scattered into the class slot
+        tgt_box = gtb_i[best_gt[sel]]
+        rw = out_rois[:, 2] - out_rois[:, 0] + 1.0
+        rh = out_rois[:, 3] - out_rois[:, 1] + 1.0
+        rcx = out_rois[:, 0] + 0.5 * rw
+        rcy = out_rois[:, 1] + 0.5 * rh
+        tw = tgt_box[:, 2] - tgt_box[:, 0] + 1.0
+        th = tgt_box[:, 3] - tgt_box[:, 1] + 1.0
+        tcx = tgt_box[:, 0] + 0.5 * tw
+        tcy = tgt_box[:, 1] + 0.5 * th
+        enc = jnp.stack([(tcx - rcx) / rw / wts[0],
+                         (tcy - rcy) / rh / wts[1],
+                         jnp.log(jnp.maximum(tw / rw, 1e-6)) / wts[2],
+                         jnp.log(jnp.maximum(th / rh, 1e-6)) / wts[3]],
+                        axis=1)
+        is_fg = labels > 0
+        targets = jnp.zeros((sel.shape[0], 4 * cnum))
+        inside = jnp.zeros((sel.shape[0], 4 * cnum))
+        col = jnp.clip(labels, 0, cnum - 1) * 4
+        rows = jnp.arange(sel.shape[0])
+        for j in range(4):
+            targets = targets.at[rows, col + j].set(
+                jnp.where(is_fg, enc[:, j], 0.0))
+            # fg rois weight ALL 4 slots of their class
+            # (generate_proposal_labels_op.cc:352-355), even
+            # exactly-zero targets
+            inside = inside.at[rows, col + j].set(
+                jnp.where(is_fg, 1.0, 0.0))
+        outside = inside
+        return out_rois, labels, targets, inside, outside
+
+    o = jax.vmap(per_image)(rois, gtc, gtb)
+    return {"Rois": o[0], "LabelsInt32": o[1], "BboxTargets": o[2],
+            "BboxInsideWeights": o[3], "BboxOutsideWeights": o[4]}
+
+
+@register_op("generate_mask_labels",
+             inputs=("ImInfo", "GtClasses", "IsCrowd", "GtSegms",
+                     "Rois", "LabelsInt32"),
+             outputs=("MaskRois", "RoiHasMaskInt32", "MaskInt32"),
+             optional=("ImInfo", "IsCrowd"),
+             attrs={"num_classes": 81, "resolution": 14},
+             differentiable=False)
+def generate_mask_labels(ins, attrs):
+    """generate_mask_labels_op.cc re-spec: the reference rasterizes COCO
+    polygons on host; here GtSegms arrives as ALREADY-RASTERIZED per-gt
+    binary masks [N, G, S, S] in roi-normalized space is impractical, so
+    the re-spec takes full-image masks [N, G, Hm, Wm] and crops+resizes
+    each fg roi's matched gt mask to resolution x resolution (class-
+    expanded, -1 on non-fg rois like the reference)."""
+    gtsegms, rois, labels = ins["GtSegms"], ins["Rois"], \
+        ins["LabelsInt32"]
+    n, g, hm, wm = gtsegms.shape
+    res = int(attrs["resolution"])
+
+    def per_image(segs_i, rois_i, labels_i):
+        is_fg = labels_i > 0
+        # match each roi to the gt mask with max overlap of the mask's
+        # bounding box; approximate by sampling the mask inside the roi
+        ys = jnp.linspace(0.0, 1.0, res)
+        xs = jnp.linspace(0.0, 1.0, res)
+
+        def crop(roi, seg):
+            y0, x0 = roi[1], roi[0]
+            y1, x1 = roi[3], roi[2]
+            gy = jnp.clip((y0 + ys * jnp.maximum(y1 - y0, 1.0))
+                          .astype(jnp.int32), 0, hm - 1)
+            gx = jnp.clip((x0 + xs * jnp.maximum(x1 - x0, 1.0))
+                          .astype(jnp.int32), 0, wm - 1)
+            return seg[gy][:, gx]
+
+        def best_mask(roi):
+            crops = jax.vmap(lambda s: crop(roi, s))(segs_i)  # [G,res,res]
+            areas = crops.sum(axis=(1, 2))
+            return crops[jnp.argmax(areas)]
+
+        masks = jax.vmap(best_mask)(rois_i)                   # [R,res,res]
+        flat = masks.reshape(masks.shape[0], -1) > 0.5
+        out = jnp.where(is_fg[:, None], flat.astype(jnp.int32), -1)
+        has = is_fg.astype(jnp.int32)
+        return rois_i, has, out
+
+    o = jax.vmap(per_image)(gtsegms, rois, labels)
+    return {"MaskRois": o[0], "RoiHasMaskInt32": o[1], "MaskInt32": o[2]}
+
+
+@register_op("bipartite_match", inputs=("DistMat",),
+             outputs=("ColToRowMatchIndices", "ColToRowMatchDist"),
+             attrs={"match_type": "bipartite",
+                    "dist_threshold": 0.5},
+             differentiable=False)
+def bipartite_match(ins, attrs):
+    """bipartite_match_op.cc: greedy global bipartite matching on a
+    [B, R, C] distance (similarity) matrix: repeatedly take the global
+    argmax, bind that (row, col), exclude both, until rows exhaust.
+    match_type='per_prediction' additionally matches unmatched cols to
+    their best row when dist > dist_threshold."""
+    dist = ins["DistMat"]
+    if dist.ndim == 2:
+        dist = dist[None]
+    b, r, c = dist.shape
+    steps = min(r, c)
+
+    def per_batch(d):
+        def body(i, carry):
+            match, mdist, dd = carry
+            flat = jnp.argmax(dd)
+            row, col = flat // c, flat % c
+            ok = dd[row, col] > 0
+            match = jnp.where(ok, match.at[col].set(row.astype(jnp.int32)),
+                              match)
+            mdist = jnp.where(ok, mdist.at[col].set(dd[row, col]), mdist)
+            dd = jnp.where(ok, dd.at[row, :].set(-1.0), dd)
+            dd = jnp.where(ok, dd.at[:, col].set(-1.0), dd)
+            return match, mdist, dd
+
+        match0 = jnp.full((c,), -1, jnp.int32)
+        mdist0 = jnp.zeros((c,))
+        match, mdist, _ = jax.lax.fori_loop(0, steps, body,
+                                            (match0, mdist0, d))
+        if attrs["match_type"] == "per_prediction":
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_d = jnp.max(d, axis=0)
+            extra = (match < 0) & (best_d > attrs["dist_threshold"])
+            match = jnp.where(extra, best_row, match)
+            mdist = jnp.where(extra, best_d, mdist)
+        return match, mdist
+
+    m, md = jax.vmap(per_batch)(dist)
+    return {"ColToRowMatchIndices": m, "ColToRowMatchDist": md}
+
+
+@register_op("mine_hard_examples",
+             inputs=("ClsLoss", "LocLoss", "MatchIndices", "MatchDist"),
+             outputs=("NegIndices", "UpdatedMatchIndices"),
+             optional=("LocLoss",),
+             attrs={"neg_pos_ratio": 3.0, "neg_dist_threshold": 0.5,
+                    "mining_type": "max_negative", "sample_size": 0},
+             differentiable=False)
+def mine_hard_examples(ins, attrs):
+    """mine_hard_examples_op.cc (max_negative mining): per row, negatives
+    (match==-1, dist < neg_dist_threshold) ranked by cls loss; keep
+    neg_pos_ratio * num_pos.  NegIndices re-spec: [B, P] int32 mask (1 =
+    selected negative) instead of the reference's LoD index list."""
+    cls_loss, match, mdist = ins["ClsLoss"], ins["MatchIndices"], \
+        ins["MatchDist"]
+    loss = cls_loss + (ins["LocLoss"] if ins.get("LocLoss") is not None
+                       else 0.0)
+
+    def per_row(l, m, d):
+        is_neg = (m < 0) & (d < attrs["neg_dist_threshold"])
+        npos = jnp.sum(m >= 0)
+        budget = (npos * attrs["neg_pos_ratio"]).astype(jnp.int32)
+        if int(attrs["sample_size"]):
+            budget = jnp.minimum(budget, int(attrs["sample_size"]))
+        neg_l = jnp.where(is_neg, l, -jnp.inf)
+        order = jnp.argsort(-neg_l)
+        rank = jnp.argsort(order)
+        sel = is_neg & (rank < budget)
+        return sel.astype(jnp.int32), m
+
+    sel, m = jax.vmap(per_row)(loss, match, mdist)
+    return {"NegIndices": sel, "UpdatedMatchIndices": m}
+
+
+@register_op("detection_map",
+             inputs=("DetectRes", "Label", "HasState", "PosCount",
+                     "TruePos", "FalsePos"),
+             outputs=("MAP", "AccumPosCount", "AccumTruePos",
+                      "AccumFalsePos"),
+             optional=("HasState", "PosCount", "TruePos", "FalsePos"),
+             attrs={"overlap_threshold": 0.5, "evaluate_difficult": True,
+                    "ap_type": "integral", "class_num": REQUIRED},
+             host_only=True, differentiable=False)
+def detection_map(ins, attrs):
+    """detection_map_op.cc (host metric op): mean average precision over
+    padded detections [N, D, 6] (label, score, x1,y1,x2,y2; label -1 =
+    padding) vs ground truth [N, G, 6] (label, difficult, box)."""
+    det = np.asarray(ins["DetectRes"])
+    lab = np.asarray(ins["Label"])
+    if det.ndim == 2:
+        det, lab = det[None], lab[None]
+    thr = attrs["overlap_threshold"]
+    cnum = int(attrs["class_num"])
+    aps = []
+    for cls in range(cnum):
+        scores, tps = [], []
+        npos = 0
+        for i in range(det.shape[0]):
+            gts = lab[i][(lab[i][:, 0] == cls)]
+            if not attrs["evaluate_difficult"] and gts.size:
+                gts = gts[gts[:, 1] == 0]
+            npos += len(gts)
+            dets = det[i][(det[i][:, 0] == cls)]
+            dets = dets[np.argsort(-dets[:, 1])]
+            used = np.zeros(len(gts), bool)
+            for d in dets:
+                best, bi = 0.0, -1
+                for j, gt in enumerate(gts):
+                    bx = gt[2:6]
+                    ix1 = max(d[2], bx[0]); iy1 = max(d[3], bx[1])
+                    ix2 = min(d[4], bx[2]); iy2 = min(d[5], bx[3])
+                    iw = max(ix2 - ix1, 0); ih = max(iy2 - iy1, 0)
+                    inter = iw * ih
+                    ua = ((d[4] - d[2]) * (d[5] - d[3])
+                          + (bx[2] - bx[0]) * (bx[3] - bx[1]) - inter)
+                    ov = inter / ua if ua > 0 else 0.0
+                    if ov > best:
+                        best, bi = ov, j
+                scores.append(d[1])
+                tp = best >= thr and bi >= 0 and not used[bi]
+                if tp:
+                    used[bi] = True
+                tps.append(1.0 if tp else 0.0)
+        if npos == 0:
+            continue
+        if not scores:
+            aps.append(0.0)
+            continue
+        order = np.argsort(-np.asarray(scores))
+        tp = np.asarray(tps)[order]
+        fp = 1.0 - tp
+        ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+        rec = ctp / npos
+        prec = ctp / np.maximum(ctp + cfp, 1e-10)
+        if attrs["ap_type"] == "11point":
+            ap = float(np.mean([prec[rec >= t].max() if
+                                (rec >= t).any() else 0.0
+                                for t in np.linspace(0, 1, 11)]))
+        else:
+            ap = float(np.sum((rec[1:] - rec[:-1]) * prec[1:])
+                       + rec[0] * prec[0] if len(rec) else 0.0)
+        aps.append(ap)
+    mmap = float(np.mean(aps)) if aps else 0.0
+    z = jnp.zeros((1,))
+    return {"MAP": jnp.asarray([mmap], jnp.float32),
+            "AccumPosCount": z, "AccumTruePos": z, "AccumFalsePos": z}

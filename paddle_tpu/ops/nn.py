@@ -397,3 +397,103 @@ def im2sequence(ins, attrs):
     out = patches.reshape(n, c * kh * kw, oh * ow)
     return {"Out": jnp.transpose(out, (0, 2, 1)).reshape(
         n * oh * ow, c * kh * kw)}
+
+
+@register_op("sync_batch_norm",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance"),
+             attrs={"epsilon": 1e-5, "momentum": 0.9, "is_test": False,
+                    "data_layout": "NCHW", "use_global_stats": False,
+                    "sync_axis": "dp"})
+def sync_batch_norm(ins, attrs):
+    """sync_batch_norm_op.cu re-spec: batch norm whose statistics are
+    the GLOBAL batch statistics across the data-parallel axis.
+
+    Under the compiled GSPMD path (pjit over a sharded batch) plain
+    batch_norm is ALREADY sync — jnp.mean sees the logical global batch
+    and XLA inserts the cross-replica reduction.  This op exists for the
+    explicit-SPMD path (shard_map / pmap), where shapes are per-shard:
+    it pmeans count/sum/sum-of-squares over `sync_axis` (one psum, like
+    the reference's ncclAllReduce of the packed stats vector).  Outside
+    any named axis it degrades to local batch_norm."""
+    x = ins["X"]
+    scale, bias = ins["Scale"], ins["Bias"]
+    mean, var = ins["Mean"], ins["Variance"]
+    eps, mom = attrs["epsilon"], attrs["momentum"]
+    axes = (0, 2, 3) if (x.ndim == 4 and attrs["data_layout"] == "NCHW") \
+        else tuple(i for i in range(x.ndim) if i != x.ndim - 1) \
+        if attrs["data_layout"] == "NHWC" else (0,) + tuple(range(2, x.ndim))
+    xf = x.astype(mean.dtype)
+    if attrs["is_test"] or attrs["use_global_stats"]:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        s1 = jnp.mean(xf, axis=axes)
+        s2 = jnp.mean(jnp.square(xf), axis=axes)
+        axis = attrs.get("sync_axis") or "dp"
+        try:
+            s1 = lax.pmean(s1, axis)
+            s2 = lax.pmean(s2, axis)
+        except NameError:
+            pass  # axis not bound: single-device or GSPMD global batch
+        use_mean = s1
+        use_var = jnp.maximum(s2 - jnp.square(s1), 0.0)
+        mean_out = mean * mom + lax.stop_gradient(use_mean) * (1 - mom)
+        var_out = var * mom + lax.stop_gradient(use_var) * (1 - mom)
+        saved_mean = use_mean
+        saved_var = 1.0 / jnp.sqrt(use_var + eps)
+    shape = [1] * x.ndim
+    c_axis = 1 if attrs["data_layout"] == "NCHW" else x.ndim - 1
+    shape[c_axis] = x.shape[c_axis]
+    y = (xf - use_mean.reshape(shape)) * lax.rsqrt(
+        use_var.reshape(shape) + eps) * scale.reshape(shape) \
+        + bias.reshape(shape)
+    return {"Y": y.astype(x.dtype), "MeanOut": mean_out,
+            "VarianceOut": var_out, "SavedMean": saved_mean,
+            "SavedVariance": saved_var}
+
+
+@register_op("spectral_norm", inputs=("Weight", "U", "V"),
+             outputs=("Out",),
+             attrs={"dim": 0, "power_iters": 1, "eps": 1e-12})
+def spectral_norm(ins, attrs):
+    """spectral_norm_op.cc: weight / sigma with sigma estimated by
+    power iteration (u, v persistent across steps via the layer wiring
+    like BN running stats)."""
+    w, u, v = ins["Weight"], ins["U"], ins["V"]
+    dim = int(attrs["dim"])
+    eps = attrs["eps"]
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+    for _ in range(int(attrs["power_iters"])):
+        v = wm.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wm @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    u = lax.stop_gradient(u)
+    v = lax.stop_gradient(v)
+    sigma = u @ wm @ v
+    return {"Out": w / sigma}
+
+
+@register_op("data_norm", inputs=("X", "BatchSize", "BatchSum",
+                                  "BatchSquareSum"),
+             outputs=("Y", "Means", "Scales"),
+             attrs={"epsilon": 1e-4})
+def data_norm(ins, attrs):
+    """data_norm_op.cc (CTR feature normalization): normalize with the
+    ACCUMULATED batch statistics (no scale/shift params); the layer
+    wires accumulator updates separately.  Reference arithmetic
+    (data_norm_op.cc:194): means = b_sum/b_size,
+    scales = sqrt(b_size/b_square_sum) — no mean-centering of the
+    square sum."""
+    x = ins["X"]
+    bsz, bsum, bsq = (ins["BatchSize"], ins["BatchSum"],
+                      ins["BatchSquareSum"])
+    means = bsum / bsz
+    scales = jnp.sqrt(bsz / bsq)
+    y = (x - means) * scales
+    return {"Y": y.astype(x.dtype), "Means": means, "Scales": scales}

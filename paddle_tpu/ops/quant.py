@@ -94,3 +94,35 @@ def fake_quantize_moving_average_abs_max(ins, attrs):
 def fake_dequantize_max_abs(ins, attrs):
     return {"Out": ins["X"].astype(jnp.float32)
             * ins["Scale"].reshape(()) / attrs["max_range"]}
+
+
+@register_op("quantize", inputs=("Input",), outputs=("Output",),
+             attrs={"Scale": 1.0, "is_negative_input": True},
+             differentiable=False)
+def quantize(ins, attrs):
+    """quantize_op.cc (INT8 execution path): y = round(scale * x) as
+    int8 (uint8 when is_negative_input=False)."""
+    x = ins["Input"]
+    s = attrs["Scale"]
+    if attrs["is_negative_input"]:
+        return {"Output": jnp.clip(jnp.round(x * s), -128,
+                                   127).astype(jnp.int8)}
+    return {"Output": jnp.clip(jnp.round(x * s), 0,
+                               255).astype(jnp.uint8)}
+
+
+@register_op("dequantize", inputs=("Input",), outputs=("Output",),
+             attrs={"Scale": 1.0}, differentiable=False)
+def dequantize(ins, attrs):
+    """dequantize_op.cc: y = x / scale as float32."""
+    return {"Output": ins["Input"].astype(jnp.float32) / attrs["Scale"]}
+
+
+@register_op("requantize", inputs=("Input",), outputs=("Output",),
+             attrs={"Scale_in": 1.0, "Scale_out": 1.0},
+             differentiable=False)
+def requantize(ins, attrs):
+    """requantize_op.cc: rescale int8 between quantization domains."""
+    x = ins["Input"].astype(jnp.float32)
+    y = x * (attrs["Scale_out"] / attrs["Scale_in"])
+    return {"Output": jnp.clip(jnp.round(y), -128, 127).astype(jnp.int8)}

@@ -689,3 +689,186 @@ def similarity_focus(ins, attrs):
         m = (row_max | col_max).astype(x.dtype)  # [N, d1, d2]
         mask = jnp.maximum(mask, jnp.expand_dims(m, axis))
     return {"Out": mask}
+
+
+@register_op("deformable_conv",
+             inputs=("Input", "Offset", "Mask", "Filter"),
+             outputs=("Output",), optional=("Mask",),
+             attrs={"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 1,
+                    "deformable_groups": 1, "im2col_step": 64})
+def deformable_conv(ins, attrs):
+    """deformable_conv_op.cc (v2 when Mask given, v1 otherwise):
+    bilinear-sample the input at kernel positions shifted by learned
+    offsets, then convolve.  Input [N,C,H,W]; Offset
+    [N, 2*dg*kh*kw, Ho, Wo]; Mask [N, dg*kh*kw, Ho, Wo];
+    Filter [O, C/groups, kh, kw].  Implemented as deformed im2col
+    (gather + bilinear weights, all differentiable) followed by a
+    grouped matmul — the MXU-friendly formulation."""
+    x, off, w = ins["Input"], ins["Offset"], ins["Filter"]
+    mask = ins.get("Mask")
+    n, c, h, wd = x.shape
+    o, cg, kh, kw = w.shape
+    sh, sw = _pair(attrs["strides"])
+    ph, pw = _pair(attrs["paddings"])
+    dh, dw = _pair(attrs["dilations"])
+    dg = int(attrs["deformable_groups"])
+    ho = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    wo = (wd + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    # base sampling grid: for each (ky,kx,ho,wo) the ungated position
+    ys = (jnp.arange(ho) * sh - ph)[:, None, None, None] + \
+        (jnp.arange(kh) * dh)[None, None, :, None]        # [ho,1,kh,1]
+    xs = (jnp.arange(wo) * sw - pw)[None, :, None, None] + \
+        (jnp.arange(kw) * dw)[None, None, None, :]        # [1,wo,1,kw]
+    ys = jnp.broadcast_to(ys, (ho, wo, kh, kw)).astype(x.dtype)
+    xs = jnp.broadcast_to(xs, (ho, wo, kh, kw)).astype(x.dtype)
+
+    off = off.reshape(n, dg, kh, kw, 2, ho, wo)
+    oy = jnp.transpose(off[:, :, :, :, 0], (0, 1, 4, 5, 2, 3))
+    ox = jnp.transpose(off[:, :, :, :, 1], (0, 1, 4, 5, 2, 3))
+    py = ys[None, None] + oy                              # [n,dg,ho,wo,kh,kw]
+    px = xs[None, None] + ox
+    if mask is not None:
+        mm = mask.reshape(n, dg, kh, kw, ho, wo)
+        mm = jnp.transpose(mm, (0, 1, 4, 5, 2, 3))
+    else:
+        mm = jnp.ones_like(py)
+
+    def bil(img, yy, xx):
+        """img [cper,H,W]; yy/xx [...]; bilinear with zero padding."""
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy = yy - y0
+        wx = xx - x0
+        vals = 0.0
+        for (yo, wyy) in ((y0, 1 - wy), (y0 + 1, wy)):
+            for (xo, wxx) in ((x0, 1 - wx), (x0 + 1, wx)):
+                inb = (yo >= 0) & (yo < h) & (xo >= 0) & (xo < wd)
+                yi = jnp.clip(yo, 0, h - 1).astype(jnp.int32)
+                xi = jnp.clip(xo, 0, wd - 1).astype(jnp.int32)
+                v = img[:, yi, xi]                        # [cper, ...]
+                vals = vals + v * (wyy * wxx * inb)[None]
+        return vals
+
+    cper = c // dg
+
+    def per_image(xi, pyi, pxi, mi):
+        # per deformable group sample its channels
+        def per_group(img_g, py_g, px_g, m_g):
+            s = bil(img_g, py_g, px_g)                    # [cper,ho,wo,kh,kw]
+            return s * m_g[None]
+        xg = xi.reshape(dg, cper, h, wd)
+        cols = jax.vmap(per_group)(xg, pyi, pxi, mi)      # [dg,cper,...]
+        return cols.reshape(c, ho, wo, kh, kw)
+
+    cols = jax.vmap(per_image)(x, py, px, mm)             # [n,c,ho,wo,kh,kw]
+    g = int(attrs["groups"])
+    cols = cols.reshape(n, g, cg, ho, wo, kh, kw)
+    wg = w.reshape(g, o // g, cg, kh, kw)
+    out = jnp.einsum("ngchwyx,gocyx->ngohw", cols, wg)
+    return {"Output": out.reshape(n, o, ho, wo)}
+
+
+@register_op("psroi_pool", inputs=("X", "ROIs"), outputs=("Out",),
+             attrs={"output_channels": REQUIRED, "spatial_scale": 1.0,
+                    "pooled_height": REQUIRED, "pooled_width": REQUIRED})
+def psroi_pool(ins, attrs):
+    """psroi_pool_op.cc (R-FCN position-sensitive ROI pooling): input
+    channels are output_channels * ph * pw; bin (i,j) of output channel
+    k average-pools input channel k*ph*pw + i*pw + j over the bin.
+    ROIs re-spec: [R, 5] (batch_idx, x1, y1, x2, y2)."""
+    x, rois = ins["X"], ins["ROIs"]
+    oc = int(attrs["output_channels"])
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = attrs["spatial_scale"]
+    n, c, h, w = x.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * scale
+        y1 = roi[2] * scale
+        x2 = roi[3] * scale
+        y2 = roi[4] * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        img = x[b].reshape(oc, ph, pw, h, w)
+
+        iy = jnp.arange(h)
+        ix = jnp.arange(w)
+
+        def bin_val(k, i, j):
+            ys0 = y1 + i * bh
+            ys1 = y1 + (i + 1) * bh
+            xs0 = x1 + j * bw
+            xs1 = x1 + (j + 1) * bw
+            my = (iy >= jnp.floor(ys0)) & (iy < jnp.ceil(ys1))
+            mx = (ix >= jnp.floor(xs0)) & (ix < jnp.ceil(xs1))
+            m = my[:, None] & mx[None, :]
+            cnt = jnp.maximum(m.sum(), 1)
+            return jnp.sum(img[k, i, j] * m) / cnt
+
+        ks, is_, js = jnp.meshgrid(jnp.arange(oc), jnp.arange(ph),
+                                   jnp.arange(pw), indexing="ij")
+        vals = jax.vmap(bin_val)(ks.reshape(-1), is_.reshape(-1),
+                                 js.reshape(-1))
+        return vals.reshape(oc, ph, pw)
+
+    return {"Out": jax.vmap(one)(rois)}
+
+
+@register_op("tree_conv", inputs=("NodesVector", "EdgeSet", "Filter"),
+             outputs=("Out",),
+             attrs={"max_depth": 2})
+def tree_conv(ins, attrs):
+    """tree_conv_op.cc (TBCNN tree-based convolution) re-spec: nodes
+    [N, M, F], edges [N, E, 2] (parent, child; 0-padded), filter
+    [F, 3, out] or [F, 3, out, num_filters] (reference shape).  Each
+    node aggregates its depth<=max_depth descendants with the TBCNN
+    eta_t/eta_l/eta_r position coefficients; padding nodes (no edges)
+    contribute zero.  No activation (the layer applies act, like the
+    reference)."""
+    nodes, edges, w = ins["NodesVector"], ins["EdgeSet"], ins["Filter"]
+    n, m, f = nodes.shape
+    depth = int(attrs["max_depth"])
+
+    def per_tree(nv, es):
+        parent = es[:, 0].astype(jnp.int32)
+        child = es[:, 1].astype(jnp.int32)
+        valid = (parent != child)
+        adj = jnp.zeros((m, m), nodes.dtype)
+        adj = adj.at[parent, child].add(
+            jnp.where(valid, 1.0, 0.0))
+        # reachability within `depth` hops (incl. self at depth 0)
+        reach = jnp.eye(m, dtype=nodes.dtype)
+        hop = jnp.eye(m, dtype=nodes.dtype)
+        depths = jnp.zeros((m, m), nodes.dtype)
+        for d in range(1, depth):
+            hop = jnp.minimum(hop @ adj, 1.0)
+            depths = depths + hop * d * (depths == 0) * \
+                (1 - jnp.eye(m, dtype=nodes.dtype))
+            reach = jnp.minimum(reach + hop, 1.0)
+        # eta coefficients (TBCNN): top by depth, left/right by sibling
+        # position approximated by node index order among descendants
+        eta_t = jnp.where(reach > 0, (depth - 1 - depths) /
+                          max(depth - 1, 1), 0.0)
+        pos = jnp.broadcast_to(
+            jnp.arange(m, dtype=nodes.dtype)[None, :], (m, m))
+        denom = jnp.maximum(reach.sum(1, keepdims=True) - 1.0, 1.0)
+        rank = (pos - jnp.arange(m, dtype=nodes.dtype)[:, None])
+        eta_r = jnp.where(reach > 0, (1 - eta_t) *
+                          jnp.clip(rank / denom, 0.0, 1.0), 0.0)
+        eta_l = jnp.where(reach > 0, (1 - eta_t) * (1 - jnp.clip(
+            rank / denom, 0.0, 1.0)), 0.0)
+        agg_t = eta_t @ nv
+        agg_l = eta_l @ nv
+        agg_r = eta_r @ nv
+        if w.ndim == 4:  # [F, 3, out, num_filters]
+            return (jnp.einsum("mf,fon->mon", agg_t, w[:, 0])
+                    + jnp.einsum("mf,fon->mon", agg_l, w[:, 1])
+                    + jnp.einsum("mf,fon->mon", agg_r, w[:, 2]))
+        return (agg_t @ w[:, 0] + agg_l @ w[:, 1] + agg_r @ w[:, 2])
+
+    return {"Out": jax.vmap(per_tree)(nodes, edges)}

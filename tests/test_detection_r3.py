@@ -1,0 +1,377 @@
+"""Round-3 detection zoo + norm-op tests (VERDICT r2 missing #5/#6).
+
+Reference anchors: operators/detection/generate_proposals_op.cc,
+rpn_target_assign_op.cc, bipartite_match_op.cc, mine_hard_examples_op.cc,
+detection_map_op.cc, deformable_conv_op.cc, psroi_pool_op.cc,
+spectral_norm_op.cc, data_norm_op.cc, sync_batch_norm_op.cu,
+quantize_op.cc/dequantize_op.cc.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import get_op_def
+
+RNG = np.random.RandomState
+
+
+def run(op, ins, attrs=None):
+    od = get_op_def(op)
+    jins = {k: ([jnp.asarray(x) for x in v] if isinstance(v, list)
+                else jnp.asarray(v)) for k, v in ins.items()}
+    return od.compute(jins, od.canonical_attrs(attrs or {}))
+
+
+# ---------------------------------------------------------------------------
+# generate_proposals: hand-checkable case
+# ---------------------------------------------------------------------------
+
+def test_generate_proposals_decodes_clips_and_nms():
+    # one image, 2x2 feature map, 1 anchor per cell
+    h = w = 2
+    anchors = np.array(
+        [[[[0, 0, 15, 15]], [[16, 0, 31, 15]]],
+         [[[0, 16, 15, 31]], [[16, 16, 31, 31]]]], np.float32)  # [H,W,A,4]
+    scores = np.array([[[[0.9, 0.8], [0.2, 0.95]]]], np.float32)  # [1,1,2,2]
+    deltas = np.zeros((1, 4, 2, 2), np.float32)  # zero deltas = anchors
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    o = run("generate_proposals",
+            {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+             "Anchors": anchors},
+            {"pre_nms_topN": 4, "post_nms_topN": 4, "nms_thresh": 0.5,
+             "min_size": 1.0})
+    rois = np.asarray(o["RpnRois"])[0]
+    probs = np.asarray(o["RpnRoiProbs"])[0, :, 0]
+    # zero deltas: proposals are the anchors, ordered by score; all 4
+    # anchors are disjoint so NMS keeps all
+    assert probs.shape == (4,)
+    np.testing.assert_allclose(sorted(probs, reverse=True), probs)
+    np.testing.assert_allclose(probs, [0.95, 0.9, 0.8, 0.2], atol=1e-6)
+    # the top proposal is the highest-scoring anchor (cell (1,1) of row 0
+    # in HWA order -> anchor [16,16,31,31]... score layout [A,H,W]:
+    # score 0.95 is at (h=1,w=1) -> anchor block [16,16,31,31]
+    np.testing.assert_allclose(rois[0], [16, 16, 31, 31], atol=1e-4)
+
+
+def test_generate_proposals_min_size_filters():
+    anchors = np.array([[[[0, 0, 1, 1]], [[0, 0, 31, 31]]]],
+                       np.float32)  # [1,2,1,4]: tiny + big
+    scores = np.array([[[[0.9, 0.5]]]], np.float32).reshape(1, 1, 1, 2)
+    deltas = np.zeros((1, 4, 1, 2), np.float32)
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    o = run("generate_proposals",
+            {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+             "Anchors": anchors},
+            {"pre_nms_topN": 2, "post_nms_topN": 2, "nms_thresh": 0.5,
+             "min_size": 8.0})
+    probs = np.asarray(o["RpnRoiProbs"])[0, :, 0]
+    # the tiny anchor (score 0.9) is filtered by min_size; only the big
+    # one (0.5) survives
+    assert probs[0] == pytest.approx(0.5)
+    assert probs[1] == -1.0
+
+
+# ---------------------------------------------------------------------------
+# rpn_target_assign
+# ---------------------------------------------------------------------------
+
+def test_rpn_target_assign_labels_and_targets():
+    anchors = np.array([[0, 0, 9, 9], [20, 20, 29, 29],
+                        [100, 100, 109, 109]], np.float32)
+    gt = np.array([[[1, 1, 10, 10]]], np.float32)  # overlaps anchor 0
+    o = run("rpn_target_assign",
+            {"Anchor": anchors, "GtBoxes": gt},
+            {"rpn_batch_size_per_im": 4, "rpn_fg_fraction": 0.5,
+             "rpn_positive_overlap": 0.5, "rpn_negative_overlap": 0.1})
+    loc = np.asarray(o["LocationIndex"])[0]
+    lbl = np.asarray(o["TargetLabel"])[0]
+    tbox = np.asarray(o["TargetBBox"])[0]
+    # anchor 0 is the (only) positive
+    assert loc[0] == 0
+    assert lbl[0] == 1
+    # its regression target: gt center vs anchor center, normalized
+    # (+1 pixel width convention: anchor [0,0,9,9] -> w=10, cx=5;
+    # gt [1,1,10,10] -> w=10, cx=6)
+    aw = ah = 10.0
+    tw = th = 10.0
+    np.testing.assert_allclose(
+        tbox[0], [(6.0 - 5.0) / aw, (6.0 - 5.0) / ah,
+                  np.log(tw / aw), np.log(th / ah)], atol=1e-5)
+    # negatives get label 0, padding -1
+    assert set(lbl.tolist()) <= {1, 0, -1}
+    assert (lbl == 0).sum() >= 1
+
+
+# ---------------------------------------------------------------------------
+# fpn distribute/collect round trip
+# ---------------------------------------------------------------------------
+
+def test_fpn_distribute_collect_roundtrip():
+    rng = RNG(0)
+    sizes = np.array([20, 60, 120, 300], np.float32)
+    rois = np.stack([10 + np.zeros(4), 10 + np.zeros(4),
+                     10 + sizes, 10 + sizes], axis=1).astype(np.float32)
+    o = run("distribute_fpn_proposals", {"FpnRois": rois},
+            {"min_level": 2, "max_level": 5})
+    multi = [np.asarray(m) for m in o["MultiFpnRois"]]
+    restore = np.asarray(o["RestoreIndex"]).reshape(-1)
+    # every roi appears in exactly one level (non-zero row)
+    total = sum((m.sum(axis=1) != 0).sum() for m in multi)
+    assert total == 4
+    # RestoreIndex addresses the concatenation of the (padded) outputs:
+    # gathering with it recovers the original roi order exactly
+    level_major = np.concatenate(multi, axis=0)
+    np.testing.assert_allclose(level_major[restore], rois, atol=1e-6)
+    # collect: top-2 by score
+    scores = [np.where(m.sum(axis=1) != 0,
+                       m.sum(axis=1), -1.0).astype(np.float32)
+              for m in multi]
+    c = run("collect_fpn_proposals",
+            {"MultiLevelRois": multi, "MultiLevelScores": scores},
+            {"post_nms_topN": 2})
+    top = np.asarray(c["FpnRois"])
+    assert (top.sum(axis=1) > 0).all()
+
+
+# ---------------------------------------------------------------------------
+# generate_proposal_labels
+# ---------------------------------------------------------------------------
+
+def test_generate_proposal_labels_fg_bg():
+    rois = np.array([[[0, 0, 10, 10], [0, 0, 9, 9],
+                      [50, 50, 60, 60], [100, 100, 110, 110]]],
+                    np.float32)
+    gtb = np.array([[[0, 0, 10, 10]]], np.float32)
+    gtc = np.array([[7]], np.int64)
+    o = run("generate_proposal_labels",
+            {"RpnRois": rois, "GtClasses": gtc, "GtBoxes": gtb},
+            {"batch_size_per_im": 4, "fg_fraction": 0.5,
+             "fg_thresh": 0.5, "bg_thresh_hi": 0.1, "bg_thresh_lo": 0.0,
+             "class_nums": 10})
+    lbl = np.asarray(o["LabelsInt32"])[0]
+    tgt = np.asarray(o["BboxTargets"])[0]
+    assert (lbl == 7).sum() == 2          # both overlapping rois are fg
+    assert (lbl == 0).sum() >= 1          # far rois are bg
+    fg_row = int(np.argmax(lbl == 7))
+    # targets live in class 7's slot
+    assert np.abs(tgt[fg_row, 28:32]).sum() >= 0.0
+    assert np.abs(tgt[fg_row, :28]).sum() == 0.0
+
+
+def test_generate_mask_labels_crops_gt_mask():
+    segs = np.zeros((1, 1, 16, 16), np.float32)
+    segs[0, 0, :8, :8] = 1.0
+    rois = np.array([[[0, 0, 8, 8], [8, 8, 16, 16]]], np.float32)
+    labels = np.array([[1, -1]], np.int32)
+    o = run("generate_mask_labels",
+            {"GtSegms": segs, "Rois": rois, "LabelsInt32": labels,
+             "GtClasses": np.array([[1]], np.int64)},
+            {"num_classes": 2, "resolution": 4})
+    m = np.asarray(o["MaskInt32"])[0]
+    # fg roi [0,0,8,8] over the mask [:8,:8]: 3 of 4 sample rows/cols
+    # land inside (the roi's far edge samples pixel 8, outside) -> 9 ones
+    assert (m[0] == 1).sum() == 9
+    assert (m[1] == -1).all()             # non-fg roi is -1
+
+
+# ---------------------------------------------------------------------------
+# bipartite match / hard-example mining / mAP
+# ---------------------------------------------------------------------------
+
+def test_bipartite_match_greedy():
+    d = np.array([[[0.9, 0.1], [0.8, 0.7]]], np.float32)  # [1,R=2,C=2]
+    o = run("bipartite_match", {"DistMat": d})
+    m = np.asarray(o["ColToRowMatchIndices"])[0]
+    md = np.asarray(o["ColToRowMatchDist"])[0]
+    # global max 0.9 -> col0=row0; then col1 best remaining is row1 (0.7)
+    np.testing.assert_array_equal(m, [0, 1])
+    np.testing.assert_allclose(md, [0.9, 0.7], atol=1e-6)
+
+
+def test_mine_hard_examples_budget():
+    cls_loss = np.array([[5.0, 1.0, 4.0, 3.0, 2.0]], np.float32)
+    match = np.array([[0, -1, -1, -1, -1]], np.int32)  # 1 positive
+    dist = np.zeros((1, 5), np.float32)
+    o = run("mine_hard_examples",
+            {"ClsLoss": cls_loss, "MatchIndices": match,
+             "MatchDist": dist}, {"neg_pos_ratio": 2.0})
+    sel = np.asarray(o["NegIndices"])[0]
+    # 1 pos * ratio 2 = 2 negatives: the two highest-loss ones (idx 2, 3)
+    np.testing.assert_array_equal(sel, [0, 0, 1, 1, 0])
+
+
+def test_detection_map_perfect_is_one():
+    det = np.array([[[0, 0.9, 0, 0, 10, 10],
+                     [1, 0.8, 20, 20, 30, 30]]], np.float32)
+    lab = np.array([[[0, 0, 0, 0, 10, 10],
+                     [1, 0, 20, 20, 30, 30]]], np.float32)
+    o = run("detection_map", {"DetectRes": det, "Label": lab},
+            {"class_num": 2})
+    assert float(np.asarray(o["MAP"])[0]) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# deformable conv / psroi pool / tree conv
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = RNG(0)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = (rng.randn(3, 2, 3, 3) * 0.3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 4, 4), np.float32)
+    mask = np.ones((1, 9, 4, 4), np.float32)
+    o = run("deformable_conv",
+            {"Input": x, "Offset": off, "Mask": mask, "Filter": w})
+    ref = run("conv2d", {"Input": x, "Filter": w})["Output"]
+    np.testing.assert_allclose(np.asarray(o["Output"]),
+                               np.asarray(ref), atol=1e-4)
+
+
+def test_deformable_conv_grad_finite():
+    rng = RNG(1)
+    x = jnp.asarray(rng.randn(1, 2, 5, 5).astype(np.float32))
+    w = jnp.asarray((rng.randn(2, 2, 3, 3) * 0.3).astype(np.float32))
+    off = jnp.asarray(rng.randn(1, 18, 3, 3).astype(np.float32) * 0.5)
+    od = get_op_def("deformable_conv")
+
+    def f(xx, oo):
+        return jnp.sum(od.compute(
+            {"Input": xx, "Offset": oo, "Filter": w},
+            od.canonical_attrs({}))["Output"])
+
+    gx, go = jax.grad(f, argnums=(0, 1))(x, off)
+    assert np.isfinite(np.asarray(gx)).all()
+    assert np.isfinite(np.asarray(go)).all()
+    assert float(jnp.abs(go).sum()) > 0
+
+
+def test_psroi_pool_position_sensitive():
+    # input channel k*ph*pw + i*pw + j holds constant value i*pw+j
+    oc, ph, pw = 1, 2, 2
+    x = np.zeros((1, oc * ph * pw, 8, 8), np.float32)
+    for i in range(ph):
+        for j in range(pw):
+            x[0, i * pw + j] = i * pw + j
+    rois = np.array([[0, 0, 0, 8, 8]], np.float32)
+    o = run("psroi_pool", {"X": x, "ROIs": rois},
+            {"output_channels": oc, "pooled_height": ph,
+             "pooled_width": pw, "spatial_scale": 1.0})
+    out = np.asarray(o["Out"])[0, 0]
+    np.testing.assert_allclose(out, [[0, 1], [2, 3]], atol=1e-5)
+
+
+def test_tree_conv_runs():
+    rng = RNG(0)
+    nodes = rng.randn(2, 5, 4).astype(np.float32)
+    edges = np.array([[[0, 1], [0, 2], [1, 3], [1, 4]]] * 2, np.int64)
+    w = (rng.randn(4, 3, 6) * 0.3).astype(np.float32)
+    o = run("tree_conv", {"NodesVector": nodes, "EdgeSet": edges,
+                          "Filter": w}, {"max_depth": 2})
+    out = np.asarray(o["Out"])
+    assert out.shape == (2, 5, 6)
+    assert np.isfinite(out).all()
+
+
+# ---------------------------------------------------------------------------
+# norms
+# ---------------------------------------------------------------------------
+
+def test_sync_batch_norm_matches_global_under_shard_map():
+    """The dp-sharded sync BN must equal full-batch BN (the reference's
+    whole point: sync_batch_norm_op.cu allreduces the stats)."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import env as penv
+
+    penv.reset()
+    mesh = penv.make_mesh(shape=(8,), axis_names=("dp",),
+                          devices=jax.devices()[:8])
+    rng = RNG(0)
+    x = rng.randn(16, 4, 3, 3).astype(np.float32)
+    scale = np.ones(4, np.float32)
+    bias = np.zeros(4, np.float32)
+    mean = np.zeros(4, np.float32)
+    var = np.ones(4, np.float32)
+    od = get_op_def("sync_batch_norm")
+    attrs = od.canonical_attrs({})
+
+    def local(xs):
+        return od.compute(
+            {"X": xs, "Scale": jnp.asarray(scale),
+             "Bias": jnp.asarray(bias), "Mean": jnp.asarray(mean),
+             "Variance": jnp.asarray(var)}, attrs)["Y"]
+
+    from paddle_tpu.parallel.env import shard_map
+
+    y_sync = shard_map(local, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P("dp"))(jnp.asarray(x))
+    ref = get_op_def("batch_norm")
+    y_ref = ref.compute(
+        {"X": jnp.asarray(x), "Scale": jnp.asarray(scale),
+         "Bias": jnp.asarray(bias), "Mean": jnp.asarray(mean),
+         "Variance": jnp.asarray(var)},
+        ref.canonical_attrs({}))["Y"]
+    np.testing.assert_allclose(np.asarray(y_sync), np.asarray(y_ref),
+                               atol=1e-5)
+    # and it really differs from per-shard local BN
+    y_local = shard_map(
+        lambda xs: ref.compute(
+            {"X": xs, "Scale": jnp.asarray(scale),
+             "Bias": jnp.asarray(bias), "Mean": jnp.asarray(mean),
+             "Variance": jnp.asarray(var)},
+            ref.canonical_attrs({}))["Y"],
+        mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"))(jnp.asarray(x))
+    assert not np.allclose(np.asarray(y_local), np.asarray(y_ref),
+                           atol=1e-4)
+    penv.reset()
+
+
+def test_spectral_norm_unit_sigma():
+    rng = RNG(0)
+    w = rng.randn(6, 4).astype(np.float32) * 3.0
+    u = rng.randn(6).astype(np.float32)
+    v = rng.randn(4).astype(np.float32)
+    o = run("spectral_norm", {"Weight": w, "U": u, "V": v},
+            {"power_iters": 50})
+    wn = np.asarray(o["Out"])
+    s = np.linalg.svd(wn, compute_uv=False)
+    assert s[0] == pytest.approx(1.0, abs=1e-3)
+
+
+def test_data_norm_normalizes():
+    x = np.array([[2.0, 10.0]], np.float32)
+    bsz = np.array([4.0, 4.0], np.float32)
+    bsum = np.array([8.0, 40.0], np.float32)   # mean 2, 10
+    bsq = np.array([20.0, 404.0], np.float32)  # var 1, 1
+    o = run("data_norm", {"X": x, "BatchSize": bsz, "BatchSum": bsum,
+                          "BatchSquareSum": bsq})
+    np.testing.assert_allclose(np.asarray(o["Y"]), [[0.0, 0.0]],
+                               atol=1e-2)
+    np.testing.assert_allclose(np.asarray(o["Means"]), [2.0, 10.0],
+                               atol=1e-5)
+    # reference arithmetic: scales = sqrt(b_size / b_square_sum)
+    np.testing.assert_allclose(np.asarray(o["Scales"]),
+                               np.sqrt([4.0 / 20.0, 4.0 / 404.0]),
+                               atol=1e-5)
+    # off-mean point normalizes with those scales
+    o2 = run("data_norm", {"X": x + 1.0, "BatchSize": bsz,
+                           "BatchSum": bsum, "BatchSquareSum": bsq})
+    np.testing.assert_allclose(np.asarray(o2["Y"]),
+                               np.sqrt([[4.0 / 20.0, 4.0 / 404.0]]),
+                               atol=1e-5)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.array([[-1.0, 0.5, 0.99]], np.float32)
+    q = run("quantize", {"Input": x}, {"Scale": 127.0})["Output"]
+    assert np.asarray(q).dtype == np.int8
+    d = run("dequantize", {"Input": q}, {"Scale": 127.0})["Output"]
+    np.testing.assert_allclose(np.asarray(d), x, atol=1.0 / 127)
+    r = run("requantize", {"Input": q},
+            {"Scale_in": 127.0, "Scale_out": 63.5})["Output"]
+    np.testing.assert_allclose(np.asarray(r),
+                               np.clip(np.round(np.asarray(q) * 0.5),
+                                       -128, 127), atol=1)
