@@ -54,13 +54,24 @@ def _create_grad_var(block, fwd_name, grad_name):
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
     """Appends grad ops for every op contributing to `loss`; returns
-    [(param, grad_var)] for trainable params."""
+    [(param, grad_var)] for trainable params.
+
+    checkpoints (reference incubate RecomputeOptimizer): a list of var
+    names (or vars) bounding recompute segments.  The backward then
+    emits ONE `recompute_segment_grad` op per forward segment instead of
+    per-op grads; the segment op re-runs its forward ops from the
+    checkpoint boundary inside jax.checkpoint, so only the boundary
+    activations stay live between forward and backward."""
     block = loss.block
     program = block.program
     no_grad_set = set(no_grad_set or ())
 
     # mark boundary: ops present before backward
     fwd_ops = list(block.ops)
+    if checkpoints:
+        return _append_backward_recompute(
+            loss, fwd_ops, parameter_list, no_grad_set,
+            [c if isinstance(c, str) else c.name for c in checkpoints])
 
     # seed: d loss / d loss = 1
     loss_grad = _grad_name(loss.name)
@@ -224,3 +235,120 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
         gname = _grad_name(x.name)
         outs.append(block.vars.get(gname))
     return outs
+
+
+def _append_backward_recompute(loss, fwd_ops, parameter_list,
+                               no_grad_set, checkpoints):
+    """Segment-level backward for RecomputeOptimizer (reference incubate
+    RecomputeOptimizer clones forward ops into the backward region; here
+    each segment becomes one recompute_segment_grad op whose compute
+    replays the segment under jax.checkpoint — the optimization barrier
+    stops XLA CSE from deduplicating the replay against the forward
+    pass, which is what makes the memory saving real)."""
+    from paddle_tpu.core.program import BlockRef
+
+    block = loss.block
+    program = block.program
+    cset = set(checkpoints)
+
+    # partition forward ops into segments ending after checkpoint writes
+    # (host-only ops are skipped exactly like the compiled trace skips
+    # them — replaying one on jax tracers would crash or re-run IO)
+    segments = [[]]
+    for op in fwd_ops:
+        if not has_op_def(op.type) or get_op_def(op.type).host_only:
+            continue
+        segments[-1].append(op)
+        if any(n in cset for n in op.output_names()):
+            segments.append([])
+    segments = [s for s in segments if s]
+    for s in segments:
+        for op in s:
+            if any(isinstance(v, BlockRef) for v in op.attrs.values()):
+                raise NotImplementedError(
+                    "recompute checkpoints cannot cross control-flow "
+                    f"ops (found '{op.type}'); checkpoint outside the "
+                    "sub-block")
+
+    # seed
+    loss_grad = _grad_name(loss.name)
+    _create_grad_var(block, loss.name, loss_grad)
+    block.append_op(
+        type="fill_constant", outputs={"Out": loss_grad},
+        attrs={"shape": list(loss.shape or []), "dtype": loss.dtype,
+               "value": 1.0},
+        op_role=BACKWARD)
+    grad_map = {loss.name: loss_grad}
+
+    def needs_grad(n):
+        return _needs_grad(block, n, no_grad_set)
+
+    for si in range(len(segments) - 1, -1, -1):
+        seg = segments[si]
+        produced = {n for op in seg for n in op.output_names()}
+        seg_ins = []
+        for op in seg:
+            for n in op.input_names():
+                if n not in produced and n not in seg_ins:
+                    seg_ins.append(n)
+        # deterministic op-order iteration (a set comprehension here
+        # would permute out_names across processes via hash seeding)
+        seg_out_grads = []
+        for op in seg:
+            for n in op.output_names():
+                if n in grad_map and n not in seg_out_grads:
+                    seg_out_grads.append(n)
+        if not seg_out_grads:
+            continue
+        grad_in_names = [n for n in seg_ins if needs_grad(n)]
+        if not grad_in_names:
+            continue
+        gnames = []
+        for n in grad_in_names:
+            g = _grad_name(n, f"@SEG{si}" if n in grad_map else "")
+            _create_grad_var(block, n, g)
+            gnames.append(g)
+        op = OpDesc(
+            "recompute_segment_grad",
+            {"X": list(seg_ins),
+             "OutGrad": [grad_map[n] for n in seg_out_grads]},
+            {"XGrad": gnames},
+            {"ops": [o.to_dict() for o in seg],
+             "in_names": list(seg_ins),
+             "out_names": seg_out_grads,
+             "grad_in_names": grad_in_names},
+            BACKWARD)
+        block.ops.append(op)
+        for n, g in zip(grad_in_names, gnames):
+            if n in grad_map:
+                # accumulate with the earlier partial
+                acc = _grad_name(n, "@ACC")
+                _create_grad_var(block, n, acc)
+                block.append_op(type="sum",
+                                inputs={"X": [grad_map[n], g]},
+                                outputs={"Out": acc}, op_role=BACKWARD,
+                                infer_shape=False)
+                grad_map[n] = acc
+            else:
+                grad_map[n] = g
+
+    # canonical param grads
+    params = (
+        [block.program.global_block().var(p) if isinstance(p, str) else p
+         for p in parameter_list]
+        if parameter_list else program.all_parameters())
+    params_grads = []
+    for p in params:
+        if p.name in no_grad_set or not p.trainable:
+            continue
+        g = grad_map.get(p.name)
+        if g is None:
+            continue
+        canonical = _grad_name(p.name)
+        if g != canonical:
+            _create_grad_var(block, p.name, canonical)
+            block.append_op(type="assign", inputs={"X": g},
+                            outputs={"Out": canonical},
+                            op_role=BACKWARD, infer_shape=False)
+        params_grads.append((p, block.var(canonical)))
+    return params_grads

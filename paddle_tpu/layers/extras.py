@@ -317,7 +317,9 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     out = helper.create_variable_for_type_inference(weight.dtype)
     helper.append_op(type="spectral_norm",
                      inputs={"Weight": weight, "U": u, "V": v},
-                     outputs={"Out": out},
+                     # updated u/v wired back in place so one power
+                     # iteration per step converges over training
+                     outputs={"Out": out, "UOut": u, "VOut": v},
                      attrs={"dim": dim, "power_iters": power_iters,
                             "eps": eps})
     return out

@@ -952,17 +952,32 @@ def generate_proposal_labels(ins, attrs):
         bg = (best < attrs["bg_thresh_hi"]) & \
              (best >= attrs["bg_thresh_lo"]) & ~fg
         fg_score = jnp.where(fg, best, -jnp.inf)
-        _, fg_idx = jax.lax.top_k(fg_score, min(n_fg, r))
+        nf = min(n_fg, r)
+        _, fg_idx = jax.lax.top_k(fg_score, nf)
         fg_ok = fg[fg_idx]
-        nbg = budget - min(n_fg, r)
+        nbg = max(budget - nf, 0)
+        # bg refill (generate_proposal_labels_op.cc: background takes
+        # whatever the actual fg count leaves of batch_size_per_im):
+        # rank ALL bg candidates; unused fg slots pull extra bg rois
         bg_score = jnp.where(bg, best, -jnp.inf)
-        _, bg_idx = jax.lax.top_k(bg_score, max(nbg, 0))
-        bg_ok = bg[bg_idx]
-        sel = jnp.concatenate([fg_idx, bg_idx])
-        ok = jnp.concatenate([fg_ok, bg_ok])
+        _, bg_all = jax.lax.top_k(bg_score, min(budget, r))
+        bg_all_ok = bg[bg_all]
+        bg_idx = bg_all[:nbg]
+        bg_ok = bg_all_ok[:nbg]
+        # failed fg slot i takes the (nbg + rank)-th best bg
+        fail_rank = jnp.cumsum(~fg_ok) - 1
+        extra_pos = jnp.clip(nbg + fail_rank, 0, bg_all.shape[0] - 1)
+        extra_idx = bg_all[extra_pos]
+        extra_ok = bg_all_ok[extra_pos] & (nbg + fail_rank
+                                           < bg_all.shape[0])
+        fg_slot_idx = jnp.where(fg_ok, fg_idx, extra_idx)
+        fg_slot_ok = fg_ok | (~fg_ok & extra_ok)
+        fg_slot_is_fg = fg_ok
+        sel = jnp.concatenate([fg_slot_idx, bg_idx])
+        ok = jnp.concatenate([fg_slot_ok, bg_ok])
         out_rois = rois_i[sel] * ok[:, None]
         labels = jnp.where(
-            jnp.concatenate([fg_ok, jnp.zeros_like(bg_ok)]),
+            jnp.concatenate([fg_slot_is_fg, jnp.zeros_like(bg_ok)]),
             gtc_i[best_gt[sel]].astype(jnp.int32), 0)
         labels = jnp.where(ok, labels, -1).astype(jnp.int32)
         # encoded targets scattered into the class slot
@@ -1143,6 +1158,13 @@ def detection_map(ins, attrs):
     """detection_map_op.cc (host metric op): mean average precision over
     padded detections [N, D, 6] (label, score, x1,y1,x2,y2; label -1 =
     padding) vs ground truth [N, G, 6] (label, difficult, box)."""
+    for slot in ("HasState", "PosCount", "TruePos", "FalsePos"):
+        if ins.get(slot) is not None:
+            raise NotImplementedError(
+                "detection_map: streaming accumulation state "
+                f"('{slot}') is not supported — evaluate whole result "
+                "sets per call (the reference merges LoD score/tp "
+                "lists; feed the full detection set instead)")
     det = np.asarray(ins["DetectRes"])
     lab = np.asarray(ins["Label"])
     if det.ndim == 2:

@@ -354,3 +354,78 @@ def merge_selected_rows(ins, attrs):
     vals = jnp.zeros((len(uniq),) + tuple(x.values.shape[1:]),
                      x.values.dtype).at[jnp.asarray(inv)].add(x.values)
     return {"Out": SelectedRows(jnp.asarray(uniq), vals, x.height)}
+
+
+@register_op("recompute_segment_grad",
+             inputs=("X", "OutGrad"), outputs=("XGrad",),
+             duplicable=("X", "OutGrad", "XGrad"),
+             attrs={"ops": REQUIRED, "in_names": REQUIRED,
+                    "out_names": REQUIRED, "grad_in_names": REQUIRED},
+             differentiable=False)
+def recompute_segment_grad(ins, attrs):
+    """Backward of one recompute segment (reference incubate
+    RecomputeOptimizer; see backward.py _append_backward_recompute).
+
+    Replays the serialized forward ops from the segment's boundary
+    inputs inside jax.checkpoint and vjps the replay: residuals are the
+    BOUNDARY values only, and the checkpoint's optimization barrier
+    stops XLA from CSE-ing the replay against the forward pass — the
+    intra-segment activations are genuinely not kept live between
+    forward and backward."""
+    from paddle_tpu.core.program import OpDesc
+    from paddle_tpu.core.registry import get_op_def
+
+    ops = [OpDesc.from_dict(d) for d in attrs["ops"]]
+    in_names = list(attrs["in_names"])
+    out_names = list(attrs["out_names"])
+    grad_in = list(attrs["grad_in_names"])
+    xs = dict(zip(in_names, ins["X"]))
+    gs = dict(zip(out_names, ins["OutGrad"]))
+    diff = {k: xs[k] for k in grad_in}
+    nondiff = {k: v for k, v in xs.items() if k not in diff}
+
+    def replay(d):
+        env = dict(nondiff)
+        env.update(d)
+        for op in ops:
+            od = get_op_def(op.type)
+            op_ins = {}
+            for slot, names in op.inputs.items():
+                vals = [env.get(n) for n in names]
+                if slot in od.duplicable:
+                    op_ins[slot] = vals
+                elif vals and vals[0] is not None:
+                    op_ins[slot] = vals[0]
+            outs = od.compute(op_ins, op.attrs) or {}
+            for slot, names in op.outputs.items():
+                if slot not in outs:
+                    continue
+                vals = outs[slot]
+                if not isinstance(vals, (list, tuple)):
+                    vals = [vals]
+                for n, v in zip(names, vals):
+                    env[n] = v
+        return {n: env[n] for n in out_names}
+
+    replay = jax.checkpoint(replay)
+    primal, vjp = jax.vjp(replay, diff)
+
+    def zero_ct(x):
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            return jnp.zeros_like(x)
+        return np.zeros(x.shape, dtype=jax.dtypes.float0)
+
+    cts = {}
+    for n in out_names:
+        g = gs.get(n)
+        p = primal[n]
+        if g is None:
+            cts[n] = zero_ct(p)
+        else:
+            if g.shape != p.shape and tuple(
+                    d for d in g.shape if d != 1) == tuple(
+                    d for d in p.shape if d != 1):
+                g = jnp.reshape(g, p.shape)
+            cts[n] = g
+    (din,) = vjp(cts)
+    return {"XGrad": [din[k] for k in grad_in]}

@@ -457,12 +457,14 @@ def sync_batch_norm(ins, attrs):
 
 
 @register_op("spectral_norm", inputs=("Weight", "U", "V"),
-             outputs=("Out",),
+             outputs=("Out", "UOut", "VOut"),
              attrs={"dim": 0, "power_iters": 1, "eps": 1e-12})
 def spectral_norm(ins, attrs):
     """spectral_norm_op.cc: weight / sigma with sigma estimated by
-    power iteration (u, v persistent across steps via the layer wiring
-    like BN running stats)."""
+    power iteration.  The reference mutates U/V in place so one
+    iteration per step converges over training; here the updated
+    vectors are outputs the layer wires back onto the same persistable
+    U/V vars (the batch_norm MeanOut/VarianceOut idiom)."""
     w, u, v = ins["Weight"], ins["U"], ins["V"]
     dim = int(attrs["dim"])
     eps = attrs["eps"]
@@ -476,7 +478,7 @@ def spectral_norm(ins, attrs):
     u = lax.stop_gradient(u)
     v = lax.stop_gradient(v)
     sigma = u @ wm @ v
-    return {"Out": w / sigma}
+    return {"Out": w / sigma, "UOut": u, "VOut": v}
 
 
 @register_op("data_norm", inputs=("X", "BatchSize", "BatchSum",

@@ -730,14 +730,15 @@ class DGCMomentumOptimizer(Optimizer):
 
 
 class RecomputeOptimizer:
-    """API-parity wrapper for activation recomputation (reference
-    incubate RecomputeOptimizer).  TPU-first note: the compiled path's
-    backward ops already re-trace their forward via jax.vjp, and XLA's
-    rematerialization pass (plus jax.checkpoint inside pallas/scan
-    bodies) owns the memory/compute trade — so minimize() delegates to
-    the inner optimizer and records the checkpoint list for
-    introspection; no IR surgery is needed to get recompute semantics
-    on this backend."""
+    """Activation recomputation (reference incubate RecomputeOptimizer).
+
+    With `_set_checkpoints([...])`, backward() emits one
+    `recompute_segment_grad` op per forward segment between checkpoints
+    (backward.py _append_backward_recompute): each segment's backward
+    replays its forward ops from the checkpoint boundary inside
+    jax.checkpoint, so only the checkpointed activations stay live from
+    forward to backward — the reference's memory/compute trade, realised
+    as jax remat instead of cloned program ops."""
 
     def __init__(self, optimizer):
         self.inner_optimizer = optimizer
@@ -746,13 +747,22 @@ class RecomputeOptimizer:
     def _set_checkpoints(self, checkpoints):
         self._checkpoints = checkpoints
 
-    def backward(self, *a, **k):
-        return self.inner_optimizer.backward(*a, **k)
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from paddle_tpu.backward import append_backward
+
+        return append_backward(loss, parameter_list, no_grad_set,
+                               checkpoints=self._checkpoints)
 
     def apply_gradients(self, *a, **k):
         return self.inner_optimizer.apply_gradients(*a, **k)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        return self.inner_optimizer.minimize(
-            loss, startup_program, parameter_list, no_grad_set)
+        if not self._checkpoints:
+            return self.inner_optimizer.minimize(
+                loss, startup_program, parameter_list, no_grad_set)
+        params_grads = self.backward(loss, startup_program,
+                                     parameter_list, no_grad_set)
+        self.inner_optimizer.apply_gradients(params_grads)
+        return [], params_grads
