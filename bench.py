@@ -247,10 +247,51 @@ def bench_resnet50_infer(batch=128, chain=100):
             "batch": batch}
 
 
+def bench_resnet50_infer_int8(batch=128, chain=100):
+    """Int8-weight inference (round-2 missing #8; reference
+    inference/tests/api/int8_mkldnn_quantization.md): weights stored
+    int8 + dequantize-on-load fused by XLA."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.contrib.slim.quantization import (
+        convert_to_int8_inference, quantize_weights_abs_max)
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.models.resnet import resnet50
+    from paddle_tpu.transpiler import nhwc_transpile
+
+    _fresh_programs()
+    model = resnet50(is_test=True)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(framework.default_startup_program())
+    infer_prog = framework.default_main_program().clone(for_test=True)
+    nhwc_transpile(infer_prog)
+    qw = quantize_weights_abs_max(infer_prog, global_scope())
+    convert_to_int8_inference(infer_prog, global_scope(), qw)
+    compiled = fluid.CompiledProgram(infer_prog)
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "image": jax.device_put(jnp.asarray(
+            rng.rand(batch, 3, 224, 224).astype(np.float32))),
+        "label": jax.device_put(np.zeros((batch, 1), np.int64)),
+    }
+    fn, state = _build_compiled_fn(compiled, feed,
+                                   [model["logits"].name])
+    sec_per_step, _ = _chain_timed(fn, state, feed,
+                                   model["logits"].name, chain)
+    return {"ms_per_batch": round(sec_per_step * 1e3, 3),
+            "batch": batch,
+            "n_int8_params": len(qw)}
+
+
 def main():
     rn_train = bench_resnet50_train()
     tf_train = bench_transformer_train()
     infer = bench_resnet50_infer()
+    infer_i8 = bench_resnet50_infer_int8()
     headline = rn_train["mfu_pct"]
     print(json.dumps({
         "metric": "resnet50_bf16_train_mfu_pct_mb128",
@@ -266,6 +307,7 @@ def main():
                 "vs_v100_fp16_baseline": round(
                     BASELINE_INFER_MS / infer["ms_per_batch"], 3),
             },
+            "resnet50_infer_int8_mb128": infer_i8,
         },
     }))
 

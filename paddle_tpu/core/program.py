@@ -40,6 +40,19 @@ LOSS = "loss"
 # PipelineOptimizer's program cut)
 _CURRENT_STAGE = [None]
 
+# global IR mutation counter: bumped by every append_op / OpDesc.set_attr
+# so compiled-program fingerprints (compiler._program_fingerprint) can
+# memoize cheaply and revalidate on any structured IR edit
+_IR_MUTATION = [0]
+
+
+def ir_mutation_counter() -> int:
+    return _IR_MUTATION[0]
+
+
+def _bump_ir_mutation():
+    _IR_MUTATION[0] += 1
+
 
 class pipeline_stage:
     """Context manager annotating appended ops with a pipeline stage."""
@@ -209,6 +222,12 @@ class OpDesc:
         # = unannotated; PipelineOptimizer infers by dataflow.
         self.stage = stage
 
+    def set_attr(self, name, value):
+        """In-place attr edit visible to compiled-program caching (a raw
+        `op.attrs[k] = v` write is NOT — see _program_fingerprint)."""
+        self.attrs[name] = value
+        _bump_ir_mutation()
+
     def input_names(self):
         out = []
         for names in self.inputs.values():
@@ -348,6 +367,7 @@ class Block:
         op = OpDesc(type, in_names, out_names, attrs, op_role,
                     stage=_CURRENT_STAGE[0])
         self.ops.append(op)
+        _bump_ir_mutation()
         if infer_shape and not op_def.host_only:
             self._infer_shape(op, op_def)
         return op
@@ -383,7 +403,8 @@ class Block:
                 ins_specs[slot] = specs[0]
         if not ok:
             return
-        out = registry.infer_shapes(op_def, ins_specs, op.attrs)
+        out = registry.infer_shapes(op_def, ins_specs, op.attrs,
+                                    strict=(self.idx == 0))
         if out is None:
             return
         for slot, names in op.outputs.items():

@@ -259,16 +259,21 @@ class StaticRNN:
             raise ValueError("StaticRNN needs at least one step_input")
         outer_outs = []
         for o in self._step_outputs:
+            # unknown inner shape must stay unknown — a fabricated
+            # rank-1 (T,) shape would poison downstream inference
             ov = self._parent.create_var(
                 name=self.helper.name + "." + o.name + ".stacked",
                 dtype=o.dtype,
-                shape=(self.seq_len,) + tuple(o.shape or ()))
+                shape=((self.seq_len,) + tuple(o.shape)
+                       if o.shape is not None else None))
             outer_outs.append(ov)
         final_outs = []
         for init, pre in self._memories:
             fv = self._parent.create_var(
                 name=self.helper.name + "." + pre.name + ".final",
-                dtype=pre.dtype, shape=tuple(init.shape or ()))
+                dtype=pre.dtype,
+                shape=(tuple(init.shape)
+                       if init.shape is not None else None))
             final_outs.append(fv)
         outer_reads = self._outer_reads()
         self._parent.append_op(

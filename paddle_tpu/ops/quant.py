@@ -126,3 +126,15 @@ def requantize(ins, attrs):
     x = ins["Input"].astype(jnp.float32)
     y = x * (attrs["Scale_out"] / attrs["Scale_in"])
     return {"Output": jnp.clip(jnp.round(y), -128, 127).astype(jnp.int8)}
+
+
+@register_op("dequantize_weight", inputs=("X", "Scale"),
+             outputs=("Out",), attrs={"max_range": 127.0},
+             differentiable=False)
+def dequantize_weight(ins, attrs):
+    """Dequantize-on-load for int8-stored weights (reference
+    inference int8 path, inference/tests/api/int8_mkldnn_quantization.md):
+    w = int8 * scale / max_range.  XLA fuses this into the consuming
+    matmul/conv read, so the weight lives in HBM at 1 byte/elem."""
+    return {"Out": ins["X"].astype(jnp.float32) * ins["Scale"]
+            / attrs["max_range"]}

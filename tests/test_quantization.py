@@ -111,3 +111,47 @@ def test_post_training_quantize_collects_scales():
     assert len(weights) == 2
     for q, s in weights.values():
         assert q.dtype == np.int8 and s > 0
+
+
+def test_int8_inference_execution():
+    """Round-2 missing #8: the frozen int8 model must EXECUTE — weights
+    stored int8 in the scope, dequantize-on-load op in the program,
+    outputs within quantization error of fp32 (reference
+    inference/tests/api/int8_mkldnn_quantization.md)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.contrib.slim.quantization import (
+        convert_to_int8_inference, quantize_weights_abs_max)
+    from paddle_tpu.core.scope import global_scope
+
+    np.random.seed(0)
+    img = layers.data("img", shape=[3, 16, 16], dtype="float32")
+    x = layers.conv2d(img, 8, 3, padding=1, act="relu")
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    logits = layers.fc(x, size=10)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    infer = fluid.default_main_program().clone(for_test=True)
+    rng = np.random.RandomState(1)
+    feed = {"img": rng.rand(4, 3, 16, 16).astype(np.float32)}
+    (ref,) = exe.run(fluid.CompiledProgram(infer), feed=feed,
+                     fetch_list=[logits])
+    qw = quantize_weights_abs_max(infer, global_scope())
+    assert {"conv2d_0.w_0", "fc_0.w_0"} <= set(qw)
+    convert_to_int8_inference(infer, global_scope(), qw)
+    # int8 tensors live in the scope; fp32 copies dropped
+    q = global_scope().find_var("conv2d_0.w_0@INT8").get()
+    assert str(q.dtype) == "int8"
+    assert global_scope().find_var("conv2d_0.w_0").get() is None
+    # program carries the dequantize-on-load ops up front
+    ops = [op.type for op in infer.global_block().ops]
+    assert ops[:len(qw)] == ["dequantize_weight"] * len(qw)
+    (got,) = exe.run(fluid.CompiledProgram(infer), feed=feed,
+                     fetch_list=[logits])
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+    # interpreter agrees too
+    (got2,) = exe.run(infer, feed=feed, fetch_list=[logits])
+    np.testing.assert_allclose(got2, got, rtol=1e-5, atol=1e-6)
