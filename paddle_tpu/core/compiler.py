@@ -113,13 +113,13 @@ def _run_block_symbolic(program, block_idx, env):
         if op.type == "while":
             _trace_while(program, op, env)
             continue
-        if op.type == "conditional_block":
+        if op.type in ("conditional_block", "conditional_block_infer"):
             _trace_cond(program, op, env)
             continue
         if op.type == "cond":
             _trace_cond2(program, op, env)
             continue
-        if op.type in ("static_rnn", "static_rnn_grad"):
+        if op.type in ("static_rnn", "static_rnn_grad", "recurrent"):
             _trace_static_rnn(program, op, env)
             continue
         op_def = get_op_def(op.type)

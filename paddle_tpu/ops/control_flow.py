@@ -424,3 +424,220 @@ def py_func_op(op, block, scope, ctx):
 
     for name, val in zip(op.outputs.get("Out", []), outs):
         scope.var(name).set(jnp.asarray(np.asarray(val)))
+
+
+# ---------------------------------------------------------------------------
+# LoD-era dynamic-RNN machinery, re-specified on the padded-batch +
+# seq-len representation (SURVEY.md §5 "LoD / long-context": every
+# sequence_* capability re-specified on segments).  Reference files:
+# lod_rank_table_op.cc, reorder_lod_tensor_by_rank_op.cc,
+# shrink_rnn_memory_op.cc, rnn_memory_helper_op.cc,
+# split_lod_tensor_op.cc, merge_lod_tensor_op.cc,
+# array_to_lod_tensor_op.cc / lod_tensor_to_array_op.cc,
+# max_sequence_len_op.cc, lod_array_length_op.cc,
+# tensor_array_to_tensor_op.cc.
+# ---------------------------------------------------------------------------
+
+@register_op("lod_rank_table", inputs=("X", "SeqLen"), outputs=("Out",),
+             optional=("SeqLen",), attrs={"level": 0},
+             differentiable=False)
+def lod_rank_table(ins, attrs):
+    """Rank table: sequence indices sorted by length descending
+    (stable).  X [B, T, ...] padded; SeqLen [B] (defaults to full T).
+    Out [B, 2]: (original_index, length) rows in rank order."""
+    x = ins["X"]
+    b = x.shape[0]
+    seq = ins.get("SeqLen")
+    lens = (seq.reshape(-1).astype(jnp.int64) if seq is not None
+            else jnp.full((b,), x.shape[1], jnp.int64))
+    # composite key keeps the sort stable for equal lengths (original
+    # order preserved, like the reference rank table)
+    order = jnp.argsort(-lens * b + jnp.arange(b))
+    return {"Out": jnp.stack(
+        [order.astype(jnp.int64), lens[order]], axis=1)}
+
+
+@register_op("reorder_lod_tensor_by_rank",
+             inputs=("X", "RankTable"), outputs=("Out",),
+             differentiable=False)
+def reorder_lod_tensor_by_rank(ins, attrs):
+    return {"Out": jnp.take(ins["X"],
+                            ins["RankTable"][:, 0].astype(jnp.int32),
+                            axis=0)}
+
+
+@register_op("max_sequence_len", inputs=("RankTable",),
+             outputs=("Out",), differentiable=False)
+def max_sequence_len(ins, attrs):
+    return {"Out": jnp.max(ins["RankTable"][:, 1]).reshape(1)}
+
+
+@register_op("shrink_rnn_memory", inputs=("X", "RankTable", "I"),
+             outputs=("Out",),
+             differentiable=False)
+def shrink_rnn_memory(ins, attrs):
+    """At step I only sequences with length > I are active; the
+    reference shrinks the memory to the active prefix (rank-ordered).
+    Fixed-shape re-spec: inactive rows are zeroed instead of dropped."""
+    x, table = ins["X"], ins["RankTable"]
+    i = ins["I"].reshape(()).astype(jnp.int64)
+    active = table[:, 1] > i
+    return {"Out": jnp.where(
+        active.reshape((-1,) + (1,) * (x.ndim - 1)), x, 0.0)}
+
+
+@register_op("rnn_memory_helper", inputs=("X",), outputs=("Out",),
+             attrs={"dtype": "float32"})
+def rnn_memory_helper(ins, attrs):
+    return {"Out": ins["X"]}
+
+
+@register_op("split_lod_tensor", inputs=("X", "Mask"),
+             outputs=("OutTrue", "OutFalse"),
+             attrs={"level": 0}, differentiable=False)
+def split_lod_tensor(ins, attrs):
+    """Mask-split (reference feeds IfElse).  Fixed-shape re-spec: both
+    outputs keep X's shape with non-selected rows zeroed."""
+    x = ins["X"]
+    m = ins["Mask"].reshape((-1,) + (1,) * (x.ndim - 1)) != 0
+    return {"OutTrue": jnp.where(m, x, 0.0),
+            "OutFalse": jnp.where(m, 0.0, x)}
+
+
+@register_op("merge_lod_tensor", inputs=("X", "Mask", "InTrue",
+                                         "InFalse"),
+             outputs=("Out",), attrs={"level": 0})
+def merge_lod_tensor(ins, attrs):
+    t, f = ins["InTrue"], ins["InFalse"]
+    m = ins["Mask"].reshape((-1,) + (1,) * (t.ndim - 1)) != 0
+    return {"Out": jnp.where(m, t, f)}
+
+
+@register_op("array_to_lod_tensor", inputs=("X",), outputs=("Out",),
+             duplicable=("X",), attrs={"axis": 0})
+def array_to_lod_tensor(ins, attrs):
+    """TensorArray (list of per-step tensors) -> stacked time-major
+    tensor (the padded re-spec of the LoD concat)."""
+    return {"Out": jnp.stack(ins["X"], axis=int(attrs["axis"]))}
+
+
+@register_op("lod_tensor_to_array", inputs=("X",), outputs=("Out",),
+             duplicable=("Out",), attrs={"axis": 0})
+def lod_tensor_to_array(ins, attrs):
+    x = ins["X"]
+    ax = int(attrs["axis"])
+    n = x.shape[ax]
+    return {"Out": [jnp.take(x, i, axis=ax) for i in range(n)]}
+
+
+@register_op("tensor_array_to_tensor", inputs=("X",),
+             outputs=("Out", "OutIndex"), duplicable=("X",),
+             attrs={"axis": 0, "use_stack": False})
+def tensor_array_to_tensor(ins, attrs):
+    xs = ins["X"]
+    ax = int(attrs["axis"])
+    if attrs["use_stack"]:
+        out = jnp.stack(xs, axis=ax)
+        idx = jnp.ones((len(xs),), jnp.int32)
+    else:
+        out = jnp.concatenate(xs, axis=ax)
+        idx = jnp.asarray([x.shape[ax] for x in xs], jnp.int32)
+    return {"Out": out, "OutIndex": idx}
+
+
+@register_op("lod_array_length", inputs=("X",), outputs=("Out",),
+             duplicable=("X",), differentiable=False)
+def lod_array_length(ins, attrs):
+    return {"Out": jnp.asarray([len(ins["X"])], jnp.int64)}
+
+
+# program-compat host ops --------------------------------------------------
+# (feed/fetch registry entries; their special handlers are defined at
+# the top of this module)
+
+@register_op("feed", inputs=("X",), outputs=("Out",),
+             optional=("X",),
+             attrs={"col": 0}, differentiable=False, host_only=True)
+def _feed_structural(ins, attrs):
+    return {}
+
+
+@register_op("fetch", inputs=("X",), outputs=(),
+             attrs={"col": 0}, differentiable=False, host_only=True)
+def _fetch_structural(ins, attrs):
+    return {}
+
+
+@register_op("get_places", inputs=(), outputs=("Out",),
+             attrs={"device_count": 0, "device_type": "AUTO"},
+             differentiable=False, host_only=True)
+def _get_places_structural(ins, attrs):
+    return {}
+
+
+@register_special_op("get_places")
+def get_places_op(op, block, scope, ctx):
+    """get_places_op.cc: the device list (as a count vector; Places are
+    XLA devices here)."""
+    import jax
+
+    n = int(op.attrs["device_count"]) or len(jax.devices())
+    scope.var(op.outputs["Out"][0]).set(jnp.arange(n, dtype=jnp.int64))
+
+
+@register_op("delete_var", inputs=("X",), outputs=(),
+             duplicable=("X",), optional=("X",),
+             differentiable=False, host_only=True)
+def _delete_var_structural(ins, attrs):
+    return {}
+
+
+@register_special_op("delete_var")
+def delete_var_op(op, block, scope, ctx):
+    """delete_var_op.cc (eager GC): drop scope references; XLA owns
+    device memory so this only releases the host handle."""
+    for n in op.inputs.get("X", []):
+        var = scope.find_var(n)
+        if var is not None:
+            var.set(None)
+
+
+# reference alias registrations -------------------------------------------
+
+register_op("conditional_block_infer",
+            inputs=("Cond", "X"), outputs=("Out",),
+            attrs={"sub_block": REQUIRED, "is_scalar_condition": True},
+            duplicable=("X", "Out"), optional=("X", "Out"),
+            differentiable=False, host_only=True)(_structural)
+
+
+@register_special_op("conditional_block_infer")
+def conditional_block_infer_op(op, block, scope, ctx):
+    """conditional_block_infer_op.cc: the inference-mode alias of
+    conditional_block (no grad bookkeeping needed here — grads never
+    flow in infer programs)."""
+    from paddle_tpu.core.executor import _SPECIAL_OPS
+
+    _SPECIAL_OPS["conditional_block"](op, block, scope, ctx)
+
+
+register_op("recurrent",
+            inputs=("StepInputs", "InitMemories", "OuterReads"),
+            outputs=("StepOutputs", "FinalMemories"),
+            attrs={"sub_block": REQUIRED, "seq_len": REQUIRED,
+                   "step_input_names": [], "memory_pre_names": [],
+                   "memory_update_names": [], "step_output_names": [],
+                   "outer_read_names": []},
+            duplicable=("StepInputs", "InitMemories", "OuterReads",
+                        "StepOutputs", "FinalMemories"),
+            differentiable=False, host_only=True)(_structural)
+
+
+@register_special_op("recurrent")
+def recurrent_op(op, block, scope, ctx):
+    """recurrent_op.cc: the reference's dynamic-RNN-over-sub-block op;
+    identical semantics to our static_rnn re-spec (lax.scan lowering in
+    the compiled path)."""
+    from paddle_tpu.core.executor import _SPECIAL_OPS
+
+    _SPECIAL_OPS["static_rnn"](op, block, scope, ctx)

@@ -1224,3 +1224,169 @@ def detection_map(ins, attrs):
     z = jnp.zeros((1,))
     return {"MAP": jnp.asarray([mmap], jnp.float32),
             "AccumPosCount": z, "AccumTruePos": z, "AccumFalsePos": z}
+
+
+@register_op("box_decoder_and_assign",
+             inputs=("PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"),
+             outputs=("DecodeBox", "OutputAssignBox"),
+             optional=("PriorBoxVar",),
+             attrs={"box_clip": 4.135},
+             differentiable=False)
+def box_decoder_and_assign(ins, attrs):
+    """box_decoder_and_assign_op.cc (Cascade R-CNN): decode per-class
+    deltas [N, 4*C] onto prior boxes, then assign each box its
+    best-scoring class' decode.  BoxScore [N, C]."""
+    prior = ins["PriorBox"]
+    deltas = ins["TargetBox"]
+    score = ins["BoxScore"]
+    var = ins.get("PriorBoxVar")
+    n, c4 = deltas.shape
+    c = c4 // 4
+    # one decode implementation for the whole file: priors repeated per
+    # class, flattened through _decode_center_size
+    d = deltas.reshape(n, c, 4)
+    if var is not None:
+        d = d * (var.reshape(1, 1, 4) if var.ndim == 1
+                 else var.reshape(n, 1, 4))
+    prior_rep = jnp.repeat(prior[:, None, :], c, axis=1).reshape(-1, 4)
+    dec = _decode_center_size(prior_rep, d.reshape(-1, 4)) \
+        .reshape(n, c, 4)
+    best = jnp.argmax(score, axis=1)
+    assign = jnp.take_along_axis(
+        dec, best[:, None, None].repeat(4, axis=2), axis=1)[:, 0]
+    return {"DecodeBox": dec.reshape(n, c4),
+            "OutputAssignBox": assign}
+
+
+@register_op("retinanet_target_assign",
+             inputs=("Anchor", "GtBoxes", "GtLabels", "IsCrowd",
+                     "ImInfo"),
+             outputs=("LocationIndex", "ScoreIndex", "TargetBBox",
+                      "TargetLabel", "BBoxInsideWeight", "ForegroundNumber"),
+             optional=("IsCrowd", "ImInfo"),
+             attrs={"positive_overlap": 0.5, "negative_overlap": 0.4},
+             differentiable=False)
+def retinanet_target_assign(ins, attrs):
+    """retinanet_target_assign_op.cc: like rpn_target_assign but with
+    ALL anchors labeled (focal loss needs no sampling) and class labels
+    from the matched gt.  Fixed-shape re-spec: indices are [N, A]
+    masks/labels instead of LoD index lists."""
+    anchors = ins["Anchor"].reshape(-1, 4)
+    gtb, gtl = ins["GtBoxes"], ins["GtLabels"]
+    a = anchors.shape[0]
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+
+    def per_image(gtb_i, gtl_i, crowd_i):
+        gt_valid = (gtb_i[:, 2] > gtb_i[:, 0]) & \
+                   (gtb_i[:, 3] > gtb_i[:, 1])
+        is_crowd = crowd_i.reshape(-1) != 0
+        # crowd gts never match positives (reference excludes them)
+        matchable = gt_valid & ~is_crowd
+        iou_all = _pairwise_iou(anchors, gtb_i, normalized=False)
+        iou = jnp.where(matchable[None, :], iou_all, 0.0)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        per_gt_best = jnp.max(iou, axis=0)
+        is_gt_best = jnp.any(
+            (iou >= per_gt_best[None, :] - 1e-6) & (iou > 0)
+            & matchable[None, :], axis=1)
+        pos = (best >= attrs["positive_overlap"]) | is_gt_best
+        neg = (best < attrs["negative_overlap"]) & ~pos
+        # anchors overlapping a crowd region are IGNORED, not negative
+        crowd_iou = jnp.where((gt_valid & is_crowd)[None, :], iou_all,
+                              0.0)
+        in_crowd = jnp.max(crowd_iou, axis=1) >= \
+            attrs["positive_overlap"]
+        neg = neg & ~in_crowd
+        label = jnp.where(pos, gtl_i[best_gt].reshape(-1),
+                          jnp.where(neg, 0, -1)).astype(jnp.int32)
+        tgt = gtb_i[best_gt]
+        tw = tgt[:, 2] - tgt[:, 0] + 1.0
+        th = tgt[:, 3] - tgt[:, 1] + 1.0
+        tcx = tgt[:, 0] + 0.5 * tw
+        tcy = tgt[:, 1] + 0.5 * th
+        tbox = jnp.stack([(tcx - acx) / aw, (tcy - acy) / ah,
+                          jnp.log(tw / aw), jnp.log(th / ah)], axis=1)
+        tbox = jnp.where(pos[:, None], tbox, 0.0)
+        inw = jnp.broadcast_to(
+            jnp.where(pos[:, None], 1.0, 0.0), tbox.shape)
+        fg = jnp.sum(pos).astype(jnp.int32).reshape(1)
+        loc_idx = jnp.where(pos, jnp.arange(a), -1)
+        score_idx = jnp.where(pos | neg, jnp.arange(a), -1)
+        return loc_idx, score_idx, tbox, label, inw, fg
+
+    crowd = ins.get("IsCrowd")
+    if crowd is None:
+        crowd = jnp.zeros(gtb.shape[:2], jnp.int32)
+    o = jax.vmap(per_image)(gtb, gtl, crowd)
+    return {"LocationIndex": o[0], "ScoreIndex": o[1],
+            "TargetBBox": o[2], "TargetLabel": o[3],
+            "BBoxInsideWeight": o[4], "ForegroundNumber": o[5]}
+
+
+@register_op("retinanet_detection_output",
+             inputs=("BBoxes", "Scores", "Anchors", "ImInfo"),
+             outputs=("Out",),
+             duplicable=("BBoxes", "Scores", "Anchors"),
+             attrs={"score_threshold": 0.05, "nms_top_k": 1000,
+                    "nms_threshold": 0.3, "keep_top_k": 100,
+                    "nms_eta": 1.0},
+             differentiable=False)
+def retinanet_detection_output(ins, attrs):
+    """retinanet_detection_output_op.cc: per FPN level decode deltas on
+    anchors, take top nms_top_k by score, then class-wise NMS over the
+    union.  BBoxes_l [N, A_l, 4] deltas; Scores_l [N, A_l, C];
+    Anchors_l [A_l, 4].  Out [N, keep_top_k, 6] padded class=-1."""
+    bboxes, scores, anchors = (ins["BBoxes"], ins["Scores"],
+                               ins["Anchors"])
+    im_info = ins["ImInfo"]
+    n = bboxes[0].shape[0]
+    c = scores[0].shape[-1]
+    keep_k = int(attrs["keep_top_k"])
+
+    dec_all, sc_all = [], []
+    for dl, sc, an in zip(bboxes, scores, anchors):
+        an = an.reshape(-1, 4)
+
+        def dec_one(d_i):
+            return _decode_center_size(an, d_i)
+
+        dec_all.append(jax.vmap(dec_one)(dl))
+        sc_all.append(sc)
+    boxes = jnp.concatenate(dec_all, axis=1)               # [N, A, 4]
+    scs = jnp.concatenate(sc_all, axis=1)                  # [N, A, C]
+
+    def per_image(boxes_i, scores_i, info_i):
+        ih, iw = info_i[0], info_i[1]
+        boxes_i = jnp.stack([
+            jnp.clip(boxes_i[:, 0], 0.0, iw - 1.0),
+            jnp.clip(boxes_i[:, 1], 0.0, ih - 1.0),
+            jnp.clip(boxes_i[:, 2], 0.0, iw - 1.0),
+            jnp.clip(boxes_i[:, 3], 0.0, ih - 1.0)], axis=1)
+        all_cls = []
+        nms_k = min(int(attrs["nms_top_k"]), boxes_i.shape[0])
+        for cls in range(c):
+            keep, order, top_s = _nms_single(
+                boxes_i, scores_i[:, cls], attrs["nms_threshold"],
+                attrs["score_threshold"], nms_k, normalized=False,
+                eta=attrs["nms_eta"])
+            det = jnp.concatenate(
+                [jnp.full((order.shape[0], 1), float(cls)),
+                 top_s[:, None], boxes_i[order]], axis=1)
+            det = jnp.where(keep[:, None], det,
+                            jnp.full_like(det, -1.0))
+            all_cls.append(det)
+        dets = jnp.concatenate(all_cls, axis=0)
+        k = min(keep_k, dets.shape[0])
+        _, idx = jax.lax.top_k(dets[:, 1], k)
+        out = dets[idx]
+        if k < keep_k:
+            out = jnp.pad(out, ((0, keep_k - k), (0, 0)),
+                          constant_values=-1.0)
+        return out
+
+    return {"Out": jax.vmap(per_image)(boxes, scs, im_info)}

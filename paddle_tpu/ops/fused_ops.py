@@ -265,3 +265,110 @@ def conv2d_inception_fusion(ins, attrs):
         ((0, 0), (0, 0), (1, 1), (1, 1)))
     branches.append(c(pooled, fs[6], bs[6], 0))
     return {"Output": jnp.concatenate(branches, axis=1)}
+
+
+@register_op("fc", inputs=("Input", "W", "Bias"), outputs=("Out",),
+             optional=("Bias",),
+             attrs={"in_num_col_dims": 1, "activation_type": ""})
+def fc_fused(ins, attrs):
+    """fc_op.cc (the fused FC the fc_fuse_pass produces): flatten ->
+    matmul -> bias -> act in one op.  layers.fc builds mul+add (like
+    the reference python layer); this op is the fusion target."""
+    x, w = ins["Input"], ins["W"]
+    k = int(attrs["in_num_col_dims"])
+    lead = x.shape[:k]
+    xm = x.reshape((int(np.prod(lead)), -1))
+    out = xm @ w
+    if ins.get("Bias") is not None:
+        out = out + ins["Bias"].reshape(1, -1)
+    act = attrs["activation_type"]
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act:
+        out = getattr(jax.nn, act)(out)
+    return {"Out": out.reshape(lead + (w.shape[1],))}
+
+
+@register_op("attention_lstm",
+             inputs=("X", "C0", "H0", "AttentionWeight", "AttentionBias",
+                     "AttentionScalar", "AttentionScalarBias",
+                     "LSTMWeight", "LSTMBias"),
+             outputs=("Hidden", "Cell"),
+             optional=("H0", "AttentionBias", "AttentionScalar",
+                       "AttentionScalarBias"),
+             attrs={"gate_activation": "sigmoid",
+                    "cell_activation": "tanh",
+                    "candidate_activation": "tanh"})
+def attention_lstm(ins, attrs):
+    """attention_lstm_op.cc: at each step, attention over the input
+    sequence conditioned on the previous cell state produces the lstm
+    input.  X [B, T, M]; AttentionWeight [M+D, 1]; LSTMWeight
+    [M+D, 4D]; LSTMBias [1, 4D]; gate order c,i,f,o like the fused
+    lstm."""
+    x = ins["X"]
+    c0 = ins["C0"]
+    h0 = ins.get("H0")
+    b, t, m = x.shape
+    d = c0.shape[-1]
+    aw = ins["AttentionWeight"]
+    ab = ins.get("AttentionBias")
+    asc = ins.get("AttentionScalar")
+    asb = ins.get("AttentionScalarBias")
+    lw, lb = ins["LSTMWeight"], ins["LSTMBias"]
+    act = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+           "relu": jax.nn.relu, "identity": lambda v: v}
+    g_act = act[attrs["gate_activation"]]
+    c_act = act[attrs["cell_activation"]]
+    cand = act[attrs["candidate_activation"]]
+    if h0 is None:
+        h0 = jnp.zeros_like(c0)
+
+    def step(carry, _t):
+        h, c = carry
+        # attention: score each time step given current cell state
+        cexp = jnp.broadcast_to(c[:, None, :], (b, t, d))
+        att_in = jnp.concatenate([x, cexp], axis=-1)      # [B,T,M+D]
+        e = att_in @ aw                                    # [B,T,1]
+        if ab is not None:
+            e = e + ab.reshape(1, 1, -1)
+        if asc is not None:
+            e = e * asc.reshape(())
+        if asb is not None:
+            e = e + asb.reshape(())
+        a = jax.nn.softmax(e[..., 0], axis=-1)             # [B,T]
+        ctx_vec = jnp.einsum("bt,btm->bm", a, x)           # [B,M]
+        z = jnp.concatenate([ctx_vec, h], axis=-1) @ lw + lb.reshape(-1)
+        zc, zi, zf, zo = jnp.split(z, 4, axis=-1)
+        c_new = g_act(zi) * cand(zc) + g_act(zf) * c
+        h_new = g_act(zo) * c_act(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), jnp.arange(t))
+    return {"Hidden": jnp.transpose(hs, (1, 0, 2)),
+            "Cell": jnp.transpose(cs, (1, 0, 2))}
+
+
+@register_op("alloc_continuous_space",
+             inputs=("Input",), outputs=("Output", "FusedOutput"),
+             duplicable=("Input", "Output"),
+             attrs={"copy_data": True, "set_constant": False,
+                    "constant": 0.0},
+             differentiable=False)
+def alloc_continuous_space(ins, attrs):
+    """alloc_continuous_space_for_grad_pass / coalesce-grads buffer op:
+    flatten+concat the inputs into one fused buffer (XLA owns aliasing;
+    functionally the outputs are the inputs, the fused view is the
+    concat)."""
+    xs = ins["Input"]
+    flat = [jnp.ravel(x) for x in xs]
+    fused = jnp.concatenate(flat) if flat else jnp.zeros((0,))
+    if attrs["set_constant"]:
+        fused = jnp.full_like(fused, attrs["constant"])
+        outs = []
+        off = 0
+        for x in xs:
+            n = int(np.prod(x.shape))
+            outs.append(fused[off:off + n].reshape(x.shape))
+            off += n
+        return {"Output": outs, "FusedOutput": fused}
+    return {"Output": list(xs), "FusedOutput": fused}

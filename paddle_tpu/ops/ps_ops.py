@@ -363,3 +363,113 @@ def listen_and_serv_op(op, block, scope, ctx):
             pass
     finally:
         server.stop()
+
+
+@register_op("split_ids", inputs=("Ids",), outputs=("Out",),
+             duplicable=("Ids", "Out"),
+             attrs={"sections": []},
+             differentiable=False)
+def split_ids_op_compute(ins, attrs):
+    """split_ids_op.cc re-spec: partition a flat id vector by contiguous
+    row sections [[s,e],...] (the reference hashes by id % n_shard; our
+    tables shard by contiguous ranges like slice_variable).  Fixed-shape
+    outputs: each section output has the full length with non-members
+    masked to -1 (LoD-free re-spec; the PS prefetch handler compacts)."""
+    ids = ins["Ids"][0].reshape(-1)
+    outs = []
+    for s, e in attrs["sections"]:
+        member = (ids >= s) & (ids < e)
+        outs.append(jnp.where(member, ids, -1))
+    return {"Out": outs}
+
+
+@register_op("merge_ids", inputs=("Ids", "Rows", "X"), outputs=("Out",),
+             duplicable=("Ids", "Rows", "X"),
+             attrs={}, differentiable=False)
+def merge_ids_op_compute(ins, attrs):
+    """merge_ids_op.cc re-spec: scatter per-section embedding rows back
+    into the original id order.  Ids: original flat ids [N]; Rows: the
+    masked per-section id vectors from split_ids ([N] each, -1 = not
+    mine); X: per-section embedding results [N, D] (rows for masked-out
+    ids are ignored)."""
+    ids = ins["Ids"][0].reshape(-1)
+    out = jnp.zeros((ids.shape[0], ins["X"][0].shape[-1]),
+                    ins["X"][0].dtype)
+    for rows, x in zip(ins["Rows"], ins["X"]):
+        member = rows.reshape(-1) >= 0
+        out = jnp.where(member[:, None], x, out)
+    return {"Out": out}
+
+
+@register_op("split_byref", inputs=("X",), outputs=("Out",),
+             duplicable=("Out",),
+             attrs={"sections": []}, differentiable=False)
+def split_byref_op_compute(ins, attrs):
+    """split_byref_op.cc: split rows into contiguous sections (the
+    by-ref aliasing is an XLA buffer concern; functionally a row
+    split)."""
+    x = ins["X"]
+    outs, start = [], 0
+    for n in attrs["sections"]:
+        outs.append(x[start:start + int(n)])
+        start += int(n)
+    return {"Out": outs}
+
+
+@register_op("split_selected_rows", inputs=("X",), outputs=("Out",),
+             duplicable=("Out",),
+             attrs={"height_sections": []}, differentiable=False,
+             host_only=True)
+def _split_selected_rows_structural(ins, attrs):
+    raise RuntimeError("split_selected_rows runs via the executor")
+
+
+@register_special_op("split_selected_rows")
+def split_selected_rows_op(op, block, scope, ctx):
+    """split_selected_rows_op.cc: partition a SelectedRows by row
+    ranges."""
+    from paddle_tpu.core.scope import SelectedRows
+
+    x = scope.find_var(op.inputs["X"][0]).get()
+    secs = op.attrs["height_sections"]
+    bounds = np.cumsum([0] + [int(s) for s in secs])
+    rows = np.asarray(x.rows)
+    vals = np.asarray(x.values)
+    for i, name in enumerate(op.outputs["Out"]):
+        lo, hi = bounds[i], bounds[i + 1]
+        m = (rows >= lo) & (rows < hi)
+        scope.var(name).set(SelectedRows(
+            rows=jnp.asarray(rows[m] - lo),
+            values=jnp.asarray(vals[m]),
+            height=int(hi - lo)))
+
+
+@register_op("lookup_sparse_table", inputs=("W", "Ids"),
+             outputs=("Out",),
+             attrs={"padding_idx": -1, "auto_grown_table": True},
+             differentiable=False)
+def lookup_sparse_table(ins, attrs):
+    """lookup_sparse_table_op.cc: the pserver-side table lookup block's
+    op — rows gathered from the local shard (auto-grow is a no-op in
+    the dense-shard re-spec; unseen ids read zeros via clipping)."""
+    w, ids = ins["W"], ins["Ids"]
+    flat = ids.reshape(-1).astype(jnp.int32)
+    valid = (flat >= 0) & (flat < w.shape[0])
+    picked = jnp.take(w, jnp.clip(flat, 0, w.shape[0] - 1), axis=0)
+    return {"Out": jnp.where(valid[:, None], picked, 0.0)}
+
+
+@register_op("fake_init", inputs=(), outputs=("Out",),
+             attrs={"shape": REQUIRED, "dtype": "float32"},
+             differentiable=False, host_only=True)
+def _fake_init_structural(ins, attrs):
+    raise RuntimeError("fake_init runs via the executor")
+
+
+@register_special_op("fake_init")
+def fake_init_op(op, block, scope, ctx):
+    """fake_init_op.cc: mark a trainer-side var 'initialized' without
+    real content (its value lives on the pserver); zeros stand in."""
+    shape = [int(s) for s in op.attrs["shape"]]
+    scope.var(op.outputs["Out"][0]).set(
+        jnp.zeros(shape, np.dtype(op.attrs["dtype"])))

@@ -16,6 +16,7 @@ reference implements as a separate grad kernel.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -138,3 +139,108 @@ def dequantize_weight(ins, attrs):
     matmul/conv read, so the weight lives in HBM at 1 byte/elem."""
     return {"Out": ins["X"].astype(jnp.float32) * ins["Scale"]
             / attrs["max_range"]}
+
+
+@register_op("fake_quantize_range_abs_max",
+             inputs=("X", "InScale", "Iter"),
+             outputs=("Out", "OutScale", "OutScales"),
+             optional=("Iter",),
+             attrs={"bit_length": 8, "window_size": 10000,
+                    "is_test": False})
+def fake_quantize_range_abs_max(ins, attrs):
+    """fake_quantize_op.cc FakeQuantizeRangeAbsMax: scale = running max
+    of abs-max over a window (window bookkeeping re-specified as simple
+    running max — the training-time QAT estimator)."""
+    x = ins["X"]
+    bnd = float(2 ** (attrs["bit_length"] - 1) - 1)
+    if attrs["is_test"]:
+        scale = ins["InScale"].reshape(())
+    else:
+        scale = jnp.maximum(jnp.max(jnp.abs(x)),
+                            ins["InScale"].reshape(()))
+    scale = jnp.maximum(scale, 1e-8)  # dead activations: no 0/0 NaNs
+    q = jnp.clip(jnp.round(x / scale * bnd), -bnd, bnd) * scale / bnd
+    return {"Out": q, "OutScale": scale.reshape(1),
+            "OutScales": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             inputs=("X", "Scales"), outputs=("Out",),
+             duplicable=("Scales",),
+             attrs={"quant_bits": [8], "quant_axis": 0})
+def fake_channel_wise_dequantize_max_abs(ins, attrs):
+    """fake_dequantize_op.cc channel-wise: out = x * prod(scales)/prod(
+    ranges) along quant_axis."""
+    x = ins["X"]
+    scales = ins["Scales"]
+    bits = attrs["quant_bits"]
+    ax = attrs["quant_axis"] % x.ndim
+    shape = [1] * x.ndim
+    shape[ax] = -1
+    out = x.astype(jnp.float32)
+    for s, b in zip(scales, list(bits) + [8] * (len(scales) - len(bits))):
+        out = out * s.reshape(shape) / float(2 ** (b - 1) - 1)
+        shape = [1] * x.ndim  # subsequent scales are scalars
+    return {"Out": out}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             inputs=("X", "InScale", "InAccum", "InState"),
+             outputs=("Out", "OutScale", "OutAccum", "OutState"),
+             optional=("InAccum", "InState"),
+             attrs={"bit_length": 8, "moving_rate": 0.9,
+                    "is_test": False})
+def fake_quantize_dequantize_moving_average_abs_max(ins, attrs):
+    """fake_quantize_op.cc QuantizeDequantizeMovingAverageAbsMax (the
+    QAT activation fake-quant with straight-through estimator)."""
+    x = ins["X"]
+    bnd = float(2 ** (attrs["bit_length"] - 1) - 1)
+    rate = attrs["moving_rate"]
+    cur = jnp.max(jnp.abs(x))
+    if attrs["is_test"]:
+        # pass the moving-average state THROUGH unchanged — these
+        # outputs alias the persistent accum/state vars (the in-place
+        # wiring convention), so writing the scale here would corrupt
+        # them for a subsequent training resume
+        scale = ins["InScale"].reshape(())
+        accum = (ins["InAccum"] if ins.get("InAccum") is not None
+                 else ins["InScale"])
+        state = (ins["InState"] if ins.get("InState") is not None
+                 else jnp.ones_like(ins["InScale"]))
+    else:
+        state0 = ins.get("InState")
+        accum0 = ins.get("InAccum")
+        state = (state0.reshape(()) * rate + 1.0
+                 if state0 is not None else jnp.asarray(1.0))
+        accum = (accum0.reshape(()) * rate + cur
+                 if accum0 is not None else cur)
+        scale = accum / state
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / scale * bnd), -bnd, bnd) * scale / bnd
+    # straight-through estimator for grads
+    out = x + jax.lax.stop_gradient(q - x)
+    return {"Out": out, "OutScale": scale.reshape(1),
+            "OutAccum": jnp.reshape(accum, (1,)),
+            "OutState": jnp.reshape(state, (1,))}
+
+
+@register_op("moving_average_abs_max_scale",
+             inputs=("X", "InAccum", "InState"),
+             outputs=("OutScale", "OutAccum", "OutState"),
+             optional=("InAccum", "InState"),
+             attrs={"moving_rate": 0.9, "is_test": False},
+             differentiable=False)
+def moving_average_abs_max_scale(ins, attrs):
+    """fake_quantize_op.cc MovingAverageAbsMaxScale: scale observer
+    without quantization (output-scale collection)."""
+    x = ins["X"]
+    rate = attrs["moving_rate"]
+    cur = jnp.max(jnp.abs(x))
+    state0, accum0 = ins.get("InState"), ins.get("InAccum")
+    state = (state0.reshape(()) * rate + 1.0
+             if state0 is not None else jnp.asarray(1.0))
+    accum = (accum0.reshape(()) * rate + cur
+             if accum0 is not None else cur)
+    return {"OutScale": (accum / state).reshape(1),
+            "OutAccum": jnp.reshape(accum, (1,)),
+            "OutState": jnp.reshape(state, (1,))}

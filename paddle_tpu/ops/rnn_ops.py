@@ -508,3 +508,57 @@ def edit_distance(ins, attrs):
         dist = dist / jnp.maximum(rlen[:, None], 1).astype(jnp.float32)
     return {"Out": dist,
             "SequenceNum": jnp.asarray(b, jnp.int64).reshape(1)}
+
+
+@register_op("beam_search",
+             inputs=("pre_ids", "pre_scores", "scores"),
+             outputs=("selected_ids", "selected_scores", "parent_idx"),
+             attrs={"beam_size": REQUIRED, "end_id": 0, "level": 0},
+             differentiable=False)
+def beam_search_op(ins, attrs):
+    """beam_search_op.cc single decode step, batched re-spec:
+    pre_ids [B, K], pre_scores [B, K], scores [B, K, V] (log-probs of
+    the next token per beam).  Finished beams (pre_id == end_id)
+    propagate with unchanged score.  Outputs the top-K continuations:
+    ids [B, K], scores [B, K], parent beam indices [B, K]."""
+    pre_ids, pre_scores, scores = (ins["pre_ids"], ins["pre_scores"],
+                                   ins["scores"])
+    k = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    b, kk, v = scores.shape
+    finished = pre_ids == end_id
+    # finished beams only continue as end_id with their frozen score
+    cand = jnp.where(finished[..., None],
+                     jnp.full_like(scores, -jnp.inf), scores)
+    cand = cand.at[..., end_id].set(
+        jnp.where(finished, 0.0, cand[..., end_id]))
+    total = pre_scores[..., None] + cand                  # [B,K,V]
+    flat = total.reshape(b, kk * v)
+    top_s, top_i = jax.lax.top_k(flat, k)
+    parent = (top_i // v).astype(jnp.int64)
+    ids = (top_i % v).astype(jnp.int64)
+    return {"selected_ids": ids, "selected_scores": top_s,
+            "parent_idx": parent}
+
+
+@register_op("beam_search_decode",
+             inputs=("Ids", "Parents", "Scores"),
+             outputs=("SentenceIds", "SentenceScores"),
+             optional=("Scores",),
+             attrs={"beam_size": 0, "end_id": 0},
+             differentiable=False)
+def beam_search_decode_op(ins, attrs):
+    """beam_search_decode_op.cc re-spec: backtrack the per-step beam
+    parents into full sequences.  Ids/Parents [T, B, K] (the stacked
+    beam_search outputs); Scores [B, K] final beam scores.  Outputs
+    SentenceIds [B, K, T] and SentenceScores [B, K]."""
+    from paddle_tpu.core.registry import get_op_def
+
+    ids, parents = ins["Ids"], ins["Parents"]
+    seqs = get_op_def("gather_tree").compute(
+        {"Ids": ids, "Parents": parents}, {})["Out"]
+    out = jnp.transpose(seqs, (1, 2, 0))                  # [B,K,T]
+    scores = ins.get("Scores")
+    if scores is None:
+        scores = jnp.zeros(out.shape[:2])
+    return {"SentenceIds": out, "SentenceScores": scores}

@@ -872,3 +872,133 @@ def tree_conv(ins, attrs):
         return (agg_t @ w[:, 0] + agg_l @ w[:, 1] + agg_r @ w[:, 2])
 
     return {"Out": jax.vmap(per_tree)(nodes, edges)}
+
+
+@register_op("deformable_psroi_pooling",
+             inputs=("Input", "ROIs", "Trans"),
+             outputs=("Output", "TopCount"),
+             optional=("Trans",),
+             attrs={"output_dim": REQUIRED, "spatial_scale": 1.0,
+                    "pooled_height": REQUIRED, "pooled_width": REQUIRED,
+                    "group_size": [1, 1], "part_size": [0, 0],
+                    "sample_per_part": 4, "trans_std": 0.1,
+                    "no_trans": False})
+def deformable_psroi_pooling(ins, attrs):
+    """deformable_psroi_pooling_op.cc (Deformable R-FCN): psroi pooling
+    with learned per-bin offsets (Trans [R, 2, ph, pw] scaled by
+    trans_std), bilinear sampling inside each shifted bin."""
+    x, rois = ins["Input"], ins["ROIs"]
+    trans = ins.get("Trans")
+    oc = int(attrs["output_dim"])
+    ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])
+    scale = attrs["spatial_scale"]
+    spp = int(attrs["sample_per_part"])
+    tstd = attrs["trans_std"]
+    n, cin, h, w = x.shape
+
+    def one(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1, y1 = roi[1] * scale - 0.5, roi[2] * scale - 0.5
+        x2, y2 = roi[3] * scale + 0.5, roi[4] * scale + 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        img = x[b].reshape(oc, ph, pw, h, w)
+
+        def bin_val(k, i, j):
+            off_y = tr[0, i, j] * tstd * rh if tr is not None else 0.0
+            off_x = tr[1, i, j] * tstd * rw if tr is not None else 0.0
+            ys = y1 + i * bh + off_y + (jnp.arange(spp) + 0.5) / spp * bh
+            xs = x1 + j * bw + off_x + (jnp.arange(spp) + 0.5) / spp * bw
+            yy = jnp.clip(ys, 0, h - 1.001)
+            xx = jnp.clip(xs, 0, w - 1.001)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            fy = yy - y0
+            fx = xx - x0
+            plane = img[k, i, j]
+            vals = 0.0
+            for dy, wy in ((0, 1 - fy), (1, fy)):
+                for dx, wx in ((0, 1 - fx), (1, fx)):
+                    v = plane[jnp.clip(y0 + dy, 0, h - 1)[:, None],
+                              jnp.clip(x0 + dx, 0, w - 1)[None, :]]
+                    vals = vals + v * wy[:, None] * wx[None, :]
+            return jnp.mean(vals)
+
+        ks, is_, js = jnp.meshgrid(jnp.arange(oc), jnp.arange(ph),
+                                   jnp.arange(pw), indexing="ij")
+        vals = jax.vmap(bin_val)(ks.reshape(-1), is_.reshape(-1),
+                                 js.reshape(-1))
+        return vals.reshape(oc, ph, pw)
+
+    if trans is None:
+        out = jax.vmap(lambda r: one(r, None))(rois)
+    else:
+        out = jax.vmap(one)(rois, trans)
+    cnt = jnp.full((rois.shape[0], oc, ph, pw), float(spp * spp))
+    return {"Output": out, "TopCount": cnt}
+
+
+@register_op("roi_perspective_transform",
+             inputs=("X", "ROIs"),
+             outputs=("Out", "Mask", "TransformMatrix"),
+             attrs={"transformed_height": REQUIRED,
+                    "transformed_width": REQUIRED,
+                    "spatial_scale": 1.0},
+             differentiable=False)
+def roi_perspective_transform(ins, attrs):
+    """roi_perspective_transform_op.cc (OCR east-detection): each ROI
+    is a quadrilateral [R, 9] (batch_idx + 4 corner points); warp it to
+    a transformed_height x transformed_width rectangle via the
+    homography through the 4 point pairs, bilinear-sampled."""
+    x, rois = ins["X"], ins["ROIs"]
+    th = int(attrs["transformed_height"])
+    tw = int(attrs["transformed_width"])
+    scale = attrs["spatial_scale"]
+    n, c, h, w = x.shape
+
+    def homography(src, dst):
+        """src/dst [4,2]: solve 8x8 for the projective transform."""
+        rows = []
+        rhs = []
+        for (sx, sy), (dx, dy) in zip(src, dst):
+            rows.append([sx, sy, 1, 0, 0, 0, -dx * sx, -dx * sy])
+            rows.append([0, 0, 0, sx, sy, 1, -dy * sx, -dy * sy])
+            rhs.extend([dx, dy])
+        A = jnp.asarray(rows)
+        bv = jnp.asarray(rhs)
+        sol = jnp.linalg.solve(A, bv)
+        return jnp.concatenate([sol, jnp.ones((1,))]).reshape(3, 3)
+
+    ys, xs = jnp.meshgrid(jnp.arange(th), jnp.arange(tw), indexing="ij")
+    grid = jnp.stack([xs.reshape(-1), ys.reshape(-1),
+                      jnp.ones(th * tw)], axis=0)          # [3, th*tw]
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        quad = (roi[1:9] * scale).reshape(4, 2)
+        dst = jnp.asarray([[0.0, 0.0], [tw - 1.0, 0.0],
+                           [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+        # transform maps OUTPUT rect -> INPUT quad
+        m = homography(dst, quad)
+        p = m @ grid
+        px = p[0] / p[2]
+        py = p[1] / p[2]
+        inb = (px >= 0) & (px <= w - 1) & (py >= 0) & (py <= h - 1)
+        x0 = jnp.clip(jnp.floor(px), 0, w - 1).astype(jnp.int32)
+        y0 = jnp.clip(jnp.floor(py), 0, h - 1).astype(jnp.int32)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        fx = px - x0
+        fy = py - y0
+        img = x[b]                                         # [C,H,W]
+        v = (img[:, y0, x0] * (1 - fy) * (1 - fx)
+             + img[:, y0, x1] * (1 - fy) * fx
+             + img[:, y1, x0] * fy * (1 - fx)
+             + img[:, y1, x1] * fy * fx)                   # [C, th*tw]
+        v = jnp.where(inb[None], v, 0.0)
+        return v.reshape(c, th, tw), inb.reshape(th, tw), m.reshape(9)
+
+    outs, masks, mats = jax.vmap(one)(rois)
+    return {"Out": outs, "Mask": masks.astype(jnp.int32),
+            "TransformMatrix": mats}
