@@ -240,6 +240,14 @@ class Executor:
             if var is None:
                 raise RuntimeError(f"fetch variable '{name}' not found")
             val = var.get()
+            if val is None:
+                # e.g. deleted by a delete_var op (release_memory without
+                # the fetch target in skip_opt_set) — fail loudly instead
+                # of returning a None-valued object array
+                raise RuntimeError(
+                    f"fetch variable '{name}' has no value (was it "
+                    "garbage-collected by release_memory/delete_var? add "
+                    "it to skip_opt_set)")
             if return_numpy:
                 if isinstance(val, SelectedRows):
                     val = np.asarray(val.to_dense())
@@ -263,6 +271,7 @@ class Executor:
         parallelism (SURVEY.md §3.4)."""
         from paddle_tpu import framework
         from paddle_tpu.reader import DeviceFeeder
+        from paddle_tpu.trainer_desc import TrainerFactory
 
         if dataset is None:
             raise ValueError("dataset is required")
@@ -275,6 +284,16 @@ class Executor:
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [
             (f if isinstance(f, str) else f.name) for f in fetch_list]
+        # build the trainer descriptor from program._fleet_opt exactly like
+        # reference executor.py:927 (_prepare_trainer): it selects the
+        # trainer/device-worker pair and validates pipeline/PS programs
+        trainer = TrainerFactory()._create_trainer(
+            getattr(program, "_fleet_opt", None))
+        trainer._set_program(program)
+        trainer._set_thread(thread or dataset._thread)
+        trainer._set_debug(debug)
+        trainer._set_fetch_var_and_info(fetch_list, fetch_info, print_period)
+        trainer._gen_trainer_desc()
         step = 0
         feeder = DeviceFeeder(dataset._iter_batches(),
                               capacity=max(4, 2 * (thread or 1)))

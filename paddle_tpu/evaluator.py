@@ -1,0 +1,259 @@
+"""Program-state evaluators (reference python/paddle/fluid/evaluator.py:45
+Evaluator + ChunkEvaluator :127 / EditDistance :218 / DetectionMAP :299).
+
+Deprecated in the reference in favour of metrics.* (same warning kept here);
+each evaluator plants accumulator state vars + update ops into the main
+program, and reset()/eval() run tiny throwaway programs against the same
+scope — the pattern works unchanged on TPU because state vars are
+persistable scope entries and the update ops ride the compiled step.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from paddle_tpu import layers, unique_name
+from paddle_tpu.framework import Program, program_guard
+from paddle_tpu.initializer import Constant
+from paddle_tpu.layers.helper import LayerHelper
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+def _clone_var_(block, var):
+    """reference evaluator.py:34 — mirror a var desc into another block."""
+    assert var.name is not None
+    return block.create_var(
+        name=var.name, shape=var.shape, dtype=var.dtype,
+        persistable=var.persistable)
+
+
+class Evaluator:
+    """reference evaluator.py:45.  states: persistable accumulators reset
+    by reset(); metrics: per-minibatch metric vars."""
+
+    def __init__(self, name, **kwargs):
+        warnings.warn(
+            "The %s is deprecated, please use metrics.%s instead."
+            % (self.__class__.__name__, self.__class__.__name__), Warning)
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        """Zero all state vars (reference evaluator.py:77)."""
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(main_program=reset_program):
+            for var in self.states:
+                g_var = _clone_var_(reset_program.current_block(), var)
+                layers.fill_constant(
+                    shape=g_var.shape, value=0.0, dtype=g_var.dtype,
+                    out=g_var)
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+    def _create_state(self, suffix, dtype, shape):
+        """Persistable accumulator var, zero-initialized in the startup
+        program (reference evaluator.py:106)."""
+        block = self.helper.main_program.global_block()
+        state = block.create_var(
+            name="_".join([unique_name.generate(self.helper.name), suffix]),
+            persistable=True, dtype=dtype, shape=shape)
+        startup = self.helper.startup_program.global_block()
+        s_var = startup.create_var(
+            name=state.name, shape=shape, dtype=dtype, persistable=True)
+        startup.append_op(
+            type="fill_constant",
+            inputs={}, outputs={"Out": [s_var.name]},
+            attrs={"shape": list(shape or [1]), "dtype": dtype,
+                   "value": 0.0})
+        self.states.append(state)
+        return state
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulated chunk precision/recall/F1 (reference evaluator.py:127):
+    plants a chunk_eval op + running sums of the three chunk counters."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, seq_length=None):
+        super().__init__("chunk_eval")
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+
+        self.num_infer_chunks = self._create_state(
+            dtype="int64", shape=[1], suffix="num_infer_chunks")
+        self.num_label_chunks = self._create_state(
+            dtype="int64", shape=[1], suffix="num_label_chunks")
+        self.num_correct_chunks = self._create_state(
+            dtype="int64", shape=[1], suffix="num_correct_chunks")
+        (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+         num_correct_chunks) = layers.chunk_eval(
+            input, label, seqlength=seq_length,
+            chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types or [])
+        layers.sums(input=[self.num_infer_chunks, num_infer_chunks],
+                    out=self.num_infer_chunks)
+        layers.sums(input=[self.num_label_chunks, num_label_chunks],
+                    out=self.num_label_chunks)
+        layers.sums(input=[self.num_correct_chunks, num_correct_chunks],
+                    out=self.num_correct_chunks)
+        self.metrics.extend([precision, recall, f1_score])
+
+    def eval(self, executor, eval_program=None):
+        if eval_program is None:
+            eval_program = Program()
+        block = eval_program.current_block()
+        num_infer, num_label, num_correct = executor.run(
+            eval_program,
+            fetch_list=[_clone_var_(block, state) for state in self.states])
+        num_infer = float(np.asarray(num_infer).ravel()[0])
+        num_label = float(np.asarray(num_label).ravel()[0])
+        num_correct = float(np.asarray(num_correct).ravel()[0])
+        precision = num_correct / num_infer if num_infer else 0.0
+        recall = num_correct / num_label if num_label else 0.0
+        f1_score = (2 * precision * recall / (precision + recall)
+                    if num_correct else 0.0)
+        return (np.array([precision], dtype="float32"),
+                np.array([recall], dtype="float32"),
+                np.array([f1_score], dtype="float32"))
+
+
+class EditDistance(Evaluator):
+    """Accumulated average edit distance + instance error rate (reference
+    evaluator.py:218)."""
+
+    def __init__(self, input, label, ignored_tokens=None, **kwargs):
+        super().__init__("edit_distance", **kwargs)
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+
+        self.total_distance = self._create_state(
+            dtype="float32", shape=[1], suffix="total_distance")
+        self.seq_num = self._create_state(
+            dtype="int64", shape=[1], suffix="seq_num")
+        self.instance_error = self._create_state(
+            dtype="int64", shape=[1], suffix="instance_error")
+        if ignored_tokens:
+            input = layers.sequence_erase(input, tokens=ignored_tokens)[0]
+            label = layers.sequence_erase(label, tokens=ignored_tokens)[0]
+        distances, seq_num = layers.edit_distance(input, label)
+        zero = layers.fill_constant(shape=[1], value=0.0, dtype="float32")
+        compare_result = layers.equal(distances, zero)
+        compare_result_int = layers.cast(x=compare_result, dtype="int64")
+        seq_right_count = layers.reduce_sum(compare_result_int)
+        instance_error_count = layers.elementwise_sub(
+            x=seq_num, y=seq_right_count)
+        total_distance = layers.reduce_sum(distances)
+        layers.sums(input=[self.total_distance, total_distance],
+                    out=self.total_distance)
+        layers.sums(input=[self.seq_num, seq_num], out=self.seq_num)
+        layers.sums(input=[self.instance_error, instance_error_count],
+                    out=self.instance_error)
+        self.metrics.append(total_distance)
+        self.metrics.append(instance_error_count)
+
+    def eval(self, executor, eval_program=None):
+        if eval_program is None:
+            eval_program = Program()
+        block = eval_program.current_block()
+        with program_guard(main_program=eval_program):
+            total_distance = _clone_var_(block, self.total_distance)
+            seq_num = _clone_var_(block, self.seq_num)
+            instance_error = _clone_var_(block, self.instance_error)
+            seq_num_f = layers.cast(x=seq_num, dtype="float32")
+            instance_error_f = layers.cast(x=instance_error,
+                                           dtype="float32")
+            avg_distance = layers.elementwise_div(
+                x=total_distance, y=seq_num_f)
+            avg_instance_error = layers.elementwise_div(
+                x=instance_error_f, y=seq_num_f)
+            result = executor.run(
+                eval_program, fetch_list=[avg_distance, avg_instance_error])
+        return np.asarray(result[0]), np.asarray(result[1])
+
+
+class DetectionMAP(Evaluator):
+    """Accumulated detection mAP (reference evaluator.py:299): one
+    detection_map op for the batch mAP, a second streaming one that merges
+    into persistable row-table states (ops/detection.py detection_map)."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super().__init__("map_eval")
+
+        gt_label = layers.cast(x=gt_label, dtype=gt_box.dtype)
+        if gt_difficult is not None:
+            gt_difficult = layers.cast(x=gt_difficult, dtype=gt_box.dtype)
+            label = layers.concat([gt_label, gt_difficult, gt_box], axis=-1)
+        else:
+            label = layers.concat([gt_label, gt_box], axis=-1)
+
+        # batch mAP
+        map = layers.detection_map(
+            input, label, class_num=class_num,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_type=ap_version)
+
+        states = [
+            self._create_state(dtype="int32", shape=[class_num, 1],
+                               suffix="accum_pos_count"),
+            self._create_state(dtype="float32", shape=[0, 3],
+                               suffix="accum_true_pos"),
+            self._create_state(dtype="float32", shape=[0, 3],
+                               suffix="accum_false_pos"),
+        ]
+        self.has_state = self.helper.main_program.global_block().create_var(
+            name=unique_name.generate("map_eval_has_state"),
+            persistable=True, dtype="int32", shape=[1])
+        startup = self.helper.startup_program.global_block()
+        startup.create_var(name=self.has_state.name, shape=[1],
+                           dtype="int32", persistable=True)
+        startup.append_op(
+            type="fill_constant", inputs={},
+            outputs={"Out": [self.has_state.name]},
+            attrs={"shape": [1], "dtype": "int32", "value": 0.0})
+
+        # accumulative mAP: read + write back the same state vars
+        helper = LayerHelper("map_eval")
+        accum_map = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="detection_map",
+            inputs={"DetectRes": input, "Label": label,
+                    "HasState": self.has_state,
+                    "PosCount": states[0], "TruePos": states[1],
+                    "FalsePos": states[2]},
+            outputs={"MAP": accum_map, "AccumPosCount": states[0],
+                     "AccumTruePos": states[1],
+                     "AccumFalsePos": states[2]},
+            attrs={"overlap_threshold": overlap_threshold,
+                   "evaluate_difficult": evaluate_difficult,
+                   "ap_type": ap_version, "class_num": class_num},
+            infer_shape=False)
+        layers.fill_constant(shape=[1], value=1, dtype="int32",
+                             out=self.has_state)
+
+        self.cur_map = map
+        self.accum_map = accum_map
+
+    def get_map_var(self):
+        """(batch mAP var, accumulative mAP var) — reference :421."""
+        return self.cur_map, self.accum_map
+
+    def reset(self, executor, reset_program=None):
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(main_program=reset_program):
+            var = _clone_var_(reset_program.current_block(), self.has_state)
+            layers.fill_constant(
+                shape=var.shape, value=0, dtype=var.dtype, out=var)
+        executor.run(reset_program)

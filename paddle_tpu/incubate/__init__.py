@@ -1,0 +1,6 @@
+"""Incubating APIs (reference python/paddle/fluid/incubate/): data_generator
+plus an alias to the fleet package (which lives at paddle_tpu.fleet here).
+"""
+
+from paddle_tpu.incubate import data_generator  # noqa: F401
+from paddle_tpu import fleet  # noqa: F401  (reference: incubate.fleet)

@@ -1,0 +1,151 @@
+"""User-side data generators for the dataset pipeline (reference
+python/paddle/fluid/incubate/data_generator/__init__.py:21 DataGenerator /
+MultiSlotDataGenerator / MultiSlotStringDataGenerator).
+
+A generator subclass turns raw log lines into MultiSlot text — per slot
+"<num> <v1> ... <vnum>" — which is exactly what the native parser consumes
+(native/src/data_feed.cc pt_multislot_parse).  Typical use: as the dataset's
+`pipe_command` (`python my_generator.py < raw.log`), mirroring the
+reference's pipe_command preprocessing contract.
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    """reference data_generator/__init__.py:21."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def _set_line_limit(self, line_limit):
+        if not isinstance(line_limit, int):
+            raise ValueError("line_limit%s must be in int type" %
+                             type(line_limit))
+        if line_limit < 1:
+            raise ValueError("line_limit can not less than 1")
+        self._line_limit = line_limit
+
+    def set_batch(self, batch_size):
+        """Batch size for generate_batch grouping."""
+        self.batch_size_ = batch_size
+
+    def _flush(self, batch_samples, out):
+        batch_iter = self.generate_batch(batch_samples)
+        for sample in batch_iter():
+            out.write(self._gen_str(sample))
+
+    def _run(self, lines, out):
+        batch_samples = []
+        for line in lines:
+            line_iter = self.generate_sample(line)
+            for parsed in line_iter():
+                if parsed is None:
+                    continue
+                batch_samples.append(parsed)
+                if len(batch_samples) == self.batch_size_:
+                    self._flush(batch_samples, out)
+                    batch_samples = []
+        if batch_samples:
+            self._flush(batch_samples, out)
+
+    def run_from_memory(self, out=None):
+        """Emit samples from generate_sample(None) — debug/bench path
+        (reference :68 run_from_memory)."""
+        self._run([None], out or sys.stdout)
+
+    def run_from_stdin(self, out=None):
+        """stdin lines -> generate_sample -> MultiSlot text on stdout
+        (reference :101 run_from_stdin); this is the pipe_command mode."""
+        self._run(sys.stdin, out or sys.stdout)
+
+    def run_from_files(self, filelist, out=None):
+        """Convenience over the reference API: iterate a local filelist."""
+
+        def lines():
+            for path in filelist:
+                with open(path, "r") as f:
+                    yield from f
+
+        self._run(lines(), out or sys.stdout)
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "pls use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    def generate_sample(self, line):
+        """Override: return a no-arg iterator yielding
+        [(slot_name, [feasign, ...]), ...] per sample."""
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: "
+            "[(name, [feasign, ...]), ...] or ((name, [feasign, ...]), ...)")
+
+    def generate_batch(self, samples):
+        """Override for batch-level preprocessing (e.g. padding); default
+        passes samples through."""
+
+        def local_iter():
+            yield from samples
+
+        return local_iter
+
+
+def _check_sample(line):
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of process() must be in list or tuple type, got " +
+            str(type(line)))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots (reference :282): first float seen in a slot upgrades
+    the whole slot to float; output line is `num v1 .. vnum` per slot."""
+
+    def _gen_str(self, line):
+        _check_sample(line)
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                slot_type = "uint64"
+                for e in elements:
+                    if isinstance(e, float):
+                        slot_type = "float"
+                        break
+                self._proto_info.append((name, slot_type))
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    "the complete field set of two given line are "
+                    "inconsistent.")
+            for i, (name, elements) in enumerate(line):
+                if name != self._proto_info[i][0]:
+                    raise ValueError(
+                        "the complete field set of two given line are not "
+                        "consistent.")
+                if self._proto_info[i][1] == "uint64" and any(
+                        isinstance(e, float) for e in elements):
+                    self._proto_info[i] = (name, "float")
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Pre-stringified slots (reference :241): no type tracking, straight
+    `num s1 .. snum` concatenation."""
+
+    def _gen_str(self, line):
+        _check_sample(line)
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"

@@ -146,6 +146,7 @@ GENERATED_LAYERS = {
     "argmax": "arg_max", "argmin": "arg_min",
     # metrics
     "auc": "auc", "mean_iou": "mean_iou",
+    "chunk_eval": "chunk_eval",
     # misc (reference layers/nn.py)
     "add_position_encoding": "add_position_encoding",
     "conv_shift": "conv_shift", "continuous_value_model": "cvm",
@@ -165,7 +166,7 @@ GENERATED_LAYERS = {
     "collect_fpn_proposals": "collect_fpn_proposals",
     "bipartite_match": "bipartite_match",
     "mine_hard_examples": "mine_hard_examples",
-    "detection_map": "detection_map",
+    "detection_map": ("detection_map", "MAP"),
     "psroi_pool": "psroi_pool",
     # fused families (reference operators/fused/)
     "fused_elemwise_activation": "fused_elemwise_activation",

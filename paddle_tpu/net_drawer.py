@@ -1,0 +1,93 @@
+"""Graphviz network drawing CLI (reference python/paddle/fluid/net_drawer.py:
+parse_graph/draw_graph).  The reference walks op protos with the `graphviz`
+package; here the dot text is emitted directly (debugger.draw_program) so no
+external graphviz python binding is needed — render with `dot -Tpng`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+from paddle_tpu.debugger import _esc
+
+__all__ = ["draw_graph", "parse_graph"]
+
+logger = logging.getLogger(__name__)
+
+OP_STYLE = 'shape=box, style=filled, fillcolor=lightgray'
+VAR_STYLE = 'shape=ellipse'
+PARAM_STYLE = 'shape=ellipse, style=filled, fillcolor=lightblue'
+
+
+def parse_graph(program, lines, var_ids, params, block_idx=0):
+    """Append dot statements for one program's block-0 ops/vars (reference
+    net_drawer.py parse_graph: op boxes wired through var ellipses; params
+    highlighted)."""
+    block = program.blocks[block_idx]
+
+    def var_node(name):
+        if name not in var_ids:
+            var_ids[name] = f"var_{len(var_ids)}"
+            style = PARAM_STYLE if name in params else VAR_STYLE
+            lines.append(f'  {var_ids[name]} [label="{_esc(name)}", {style}];')
+        return var_ids[name]
+
+    base = sum(1 for l in lines if l.lstrip().startswith("op_"))
+    for i, op in enumerate(block.ops):
+        op_id = f"op_{base + i}"
+        lines.append(f'  {op_id} [label="{_esc(op.type)}", {OP_STYLE}];')
+        for names in op.inputs.values():
+            for n in names:
+                lines.append(f"  {var_node(n)} -> {op_id};")
+        for names in op.outputs.values():
+            for n in names:
+                lines.append(f"  {op_id} -> {var_node(n)};")
+
+
+def draw_graph(startup_program, main_program, path=None, **kwargs):
+    """Draw startup+main programs into one dot graph (reference
+    net_drawer.py:101 draw_graph).  kwargs: graph_attr dict (e.g. rankdir)."""
+    params = {v.name for v in main_program.global_block().vars.values()
+              if getattr(v, "trainable", False)}
+    graph_attr = kwargs.get("graph_attr") or {"rankdir": "TB"}
+    lines = ["digraph G {"]
+    for k, v in graph_attr.items():
+        lines.append(f"  {k}={v};")
+    var_ids = {}
+    parse_graph(startup_program, lines, var_ids, params)
+    parse_graph(main_program, lines, var_ids, params)
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+        logger.info("graph written to %s", path)
+    return dot
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Draw a serialized paddle_tpu Program as graphviz dot "
+        "(reference net_drawer.py __main__)")
+    parser.add_argument("--startup", help="startup program file (.json)")
+    parser.add_argument("--main", required=True,
+                        help="main program file (.json)")
+    parser.add_argument("--output", default="net.dot", help="dot output path")
+    args = parser.parse_args()
+
+    from paddle_tpu.framework import Program
+    with open(args.main) as f:
+        main_prog = Program.parse_from_string(f.read())
+    if args.startup:
+        with open(args.startup) as f:
+            startup_prog = Program.parse_from_string(f.read())
+    else:
+        startup_prog = Program()
+    draw_graph(startup_prog, main_prog, path=args.output)
+    print(json.dumps({"output": args.output}))
+
+
+if __name__ == "__main__":
+    main()

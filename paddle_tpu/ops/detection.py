@@ -1157,29 +1157,44 @@ def mine_hard_examples(ins, attrs):
 def detection_map(ins, attrs):
     """detection_map_op.cc (host metric op): mean average precision over
     padded detections [N, D, 6] (label, score, x1,y1,x2,y2; label -1 =
-    padding) vs ground truth [N, G, 6] (label, difficult, box)."""
-    for slot in ("HasState", "PosCount", "TruePos", "FalsePos"):
-        if ins.get(slot) is not None:
-            raise NotImplementedError(
-                "detection_map: streaming accumulation state "
-                f"('{slot}') is not supported — evaluate whole result "
-                "sets per call (the reference merges LoD score/tp "
-                "lists; feed the full detection set instead)")
+    padding) vs ground truth [N, G, 6] (label, difficult, box).
+
+    Streaming accumulation (the reference's PosCount/TruePos/FalsePos LoD
+    states, detection_map_op.h GetInputPos/GetOutputPos) is re-specified on
+    flat row tables — host ops run outside jit so the growing shapes are
+    fine: PosCount [C, 1] int32; TruePos/FalsePos [M, 3] float32 rows of
+    (class, score, flag).  When HasState is nonzero the batch statistics
+    are merged into the input states, and MAP is computed over the merged
+    tables (the evaluator.py DetectionMAP accumulative path)."""
     det = np.asarray(ins["DetectRes"])
     lab = np.asarray(ins["Label"])
     if det.ndim == 2:
         det, lab = det[None], lab[None]
+    if lab.shape[-1] == 5:
+        # no difficult column (reference detection_map_op.cc label width
+        # check): insert an all-easy column so rows are (label, difficult,
+        # x1, y1, x2, y2) below
+        lab = np.concatenate(
+            [lab[..., :1], np.zeros_like(lab[..., :1]), lab[..., 1:]],
+            axis=-1)
     thr = attrs["overlap_threshold"]
     cnum = int(attrs["class_num"])
-    aps = []
+
+    # ---- per-class batch statistics --------------------------------------
+    pos_count = np.zeros((cnum, 1), np.int32)
+    tp_rows, fp_rows = [], []
+    evaluate_difficult = bool(attrs["evaluate_difficult"])
     for cls in range(cnum):
-        scores, tps = [], []
-        npos = 0
         for i in range(det.shape[0]):
             gts = lab[i][(lab[i][:, 0] == cls)]
-            if not attrs["evaluate_difficult"] and gts.size:
-                gts = gts[gts[:, 1] == 0]
-            npos += len(gts)
+            # npos counts only non-difficult gts when not evaluating
+            # difficult, but matching still sees ALL gts: a detection whose
+            # best match is a difficult box is neither TP nor FP (reference
+            # detection_map_op.h CalcTrueAndFalsePositive)
+            if evaluate_difficult or not gts.size:
+                pos_count[cls, 0] += len(gts)
+            else:
+                pos_count[cls, 0] += int((gts[:, 1] == 0).sum())
             dets = det[i][(det[i][:, 0] == cls)]
             dets = dets[np.argsort(-dets[:, 1])]
             used = np.zeros(len(gts), bool)
@@ -1196,18 +1211,53 @@ def detection_map(ins, attrs):
                     ov = inter / ua if ua > 0 else 0.0
                     if ov > best:
                         best, bi = ov, j
-                scores.append(d[1])
-                tp = best >= thr and bi >= 0 and not used[bi]
-                if tp:
-                    used[bi] = True
-                tps.append(1.0 if tp else 0.0)
+                # strict > like the reference (IoU == threshold is no match)
+                if best > thr and bi >= 0:
+                    if not evaluate_difficult and gts[bi, 1] != 0:
+                        continue  # matched a difficult gt: ignore detection
+                    if not used[bi]:
+                        used[bi] = True
+                        tp_rows.append((cls, d[1], 1.0))
+                    else:
+                        fp_rows.append((cls, d[1], 1.0))
+                else:
+                    fp_rows.append((cls, d[1], 1.0))
+
+    tp_tab = np.asarray(tp_rows, np.float32).reshape(-1, 3)
+    fp_tab = np.asarray(fp_rows, np.float32).reshape(-1, 3)
+
+    # ---- merge input state (reference GetInputPos) -----------------------
+    has_state = ins.get("HasState")
+    if has_state is not None and int(np.asarray(has_state).ravel()[0]) != 0:
+        in_pos = ins.get("PosCount")
+        if in_pos is not None and np.asarray(in_pos).size:
+            pos_count += np.asarray(in_pos, np.int32).reshape(cnum, 1)
+        for slot, tab in (("TruePos", "tp"), ("FalsePos", "fp")):
+            prev = ins.get(slot)
+            if prev is None:
+                continue
+            prev = np.asarray(prev, np.float32).reshape(-1, 3)
+            if tab == "tp":
+                tp_tab = np.concatenate([prev, tp_tab], 0)
+            else:
+                fp_tab = np.concatenate([prev, fp_tab], 0)
+
+    # ---- AP over the (merged) tables -------------------------------------
+    aps = []
+    for cls in range(cnum):
+        npos = int(pos_count[cls, 0])
+        tp_s = tp_tab[tp_tab[:, 0] == cls, 1]
+        fp_s = fp_tab[fp_tab[:, 0] == cls, 1]
         if npos == 0:
             continue
-        if not scores:
-            aps.append(0.0)
+        if tp_s.size + fp_s.size == 0:
+            # class has gt but no detections at all: the reference CalcMAP
+            # skips it from the mean (no ++count), not AP=0
             continue
-        order = np.argsort(-np.asarray(scores))
-        tp = np.asarray(tps)[order]
+        scores = np.concatenate([tp_s, fp_s])
+        tp = np.concatenate([np.ones_like(tp_s), np.zeros_like(fp_s)])
+        order = np.argsort(-scores)
+        tp = tp[order]
         fp = 1.0 - tp
         ctp, cfp = np.cumsum(tp), np.cumsum(fp)
         rec = ctp / npos
@@ -1221,9 +1271,9 @@ def detection_map(ins, attrs):
                        + rec[0] * prec[0] if len(rec) else 0.0)
         aps.append(ap)
     mmap = float(np.mean(aps)) if aps else 0.0
-    z = jnp.zeros((1,))
     return {"MAP": jnp.asarray([mmap], jnp.float32),
-            "AccumPosCount": z, "AccumTruePos": z, "AccumFalsePos": z}
+            "AccumPosCount": pos_count,
+            "AccumTruePos": tp_tab, "AccumFalsePos": fp_tab}
 
 
 @register_op("box_decoder_and_assign",

@@ -95,3 +95,114 @@ def precision_recall(ins, attrs):
     acc = metrics(states[:, 0], states[:, 1], states[:, 2])
     return {"BatchMetrics": batch, "AccumMetrics": acc,
             "AccumStatesInfo": states}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval (reference operators/chunk_eval_op.h: GetSegments/ChunkBegin/
+# ChunkEnd).  Chunk decoding is data-dependent sequential control flow, so it
+# runs on host (host_only) like the reference's CPU-only kernel; padded
+# [B, T](+SeqLength) replaces the LoD input.
+# ---------------------------------------------------------------------------
+
+_CHUNK_SCHEMES = {
+    # scheme: (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_segments(seq, scheme, num_chunk_types):
+    """Decode one tag sequence into [(begin, end, type)] chunks
+    (reference chunk_eval_op.h:41 GetSegments)."""
+    num_tag, t_begin, t_inside, t_end, t_single = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types
+
+    def chunk_end(ptag, ptype, tag, typ):
+        # reference chunk_eval_op.h:83 ChunkEnd
+        if ptype == other:
+            return False
+        if typ == other or typ != ptype:
+            return True
+        return ptag in (t_end, t_single) or (
+            ptag in (t_begin, t_inside) and tag in (t_begin, t_single))
+
+    def chunk_begin(ptag, ptype, tag, typ):
+        # reference chunk_eval_op.h:96 ChunkBegin
+        if ptype == other:
+            return typ != other
+        if typ == other:
+            return False
+        if typ != ptype:
+            return True
+        if tag == t_begin or tag == t_single:
+            return True
+        if tag in (t_inside, t_end):
+            return ptag in (t_end, t_single)
+        return False
+
+    segments = []
+    tag = typ = -1
+    in_chunk = False
+    start = 0
+    for i, lab in enumerate(seq):
+        ptag, ptype = tag, typ
+        lab = int(lab)
+        tag = lab % num_tag
+        typ = lab // num_tag
+        if in_chunk and chunk_end(ptag, ptype, tag, typ):
+            segments.append((start, i - 1, ptype))
+            in_chunk = False
+        if chunk_begin(ptag, ptype, tag, typ):
+            start = i
+            in_chunk = True
+    if in_chunk:
+        segments.append((start, len(seq) - 1, typ))
+    return segments
+
+
+@register_op("chunk_eval",
+             inputs=("Inference", "Label", "SeqLength"),
+             outputs=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                      "NumLabelChunks", "NumCorrectChunks"),
+             optional=("SeqLength",),
+             attrs={"num_chunk_types": REQUIRED, "chunk_scheme": "IOB",
+                    "excluded_chunk_types": []},
+             differentiable=False, host_only=True)
+def chunk_eval(ins, attrs):
+    """Precision/recall/F1 of chunk detection over IOB/IOE/IOBES/plain
+    tagging (reference chunk_eval_op.h:109 Compute)."""
+    import numpy as np
+
+    scheme = attrs["chunk_scheme"]
+    if scheme not in _CHUNK_SCHEMES:
+        raise ValueError(f"Unknown chunk scheme {scheme!r}")
+    nct = int(attrs["num_chunk_types"])
+    excluded = set(attrs.get("excluded_chunk_types") or [])
+    inf = np.asarray(ins["Inference"]).reshape(
+        np.asarray(ins["Inference"]).shape[0], -1)
+    lab = np.asarray(ins["Label"]).reshape(inf.shape[0], -1)
+    seq_len = ins.get("SeqLength")
+    lens = (np.full((inf.shape[0],), inf.shape[1], np.int64)
+            if seq_len is None else np.asarray(seq_len).reshape(-1))
+    n_inf = n_lab = n_correct = 0
+    for b in range(inf.shape[0]):
+        L = int(lens[b])
+        inf_seg = [s for s in _chunk_segments(inf[b, :L], scheme, nct)
+                   if s[2] not in excluded]
+        lab_seg = [s for s in _chunk_segments(lab[b, :L], scheme, nct)
+                   if s[2] not in excluded]
+        n_inf += len(inf_seg)
+        n_lab += len(lab_seg)
+        n_correct += len(set(inf_seg) & set(lab_seg))
+    precision = n_correct / n_inf if n_inf else 0.0
+    recall = n_correct / n_lab if n_lab else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if n_correct else 0.0)
+    return {"Precision": np.asarray([precision], np.float32),
+            "Recall": np.asarray([recall], np.float32),
+            "F1-Score": np.asarray([f1], np.float32),
+            "NumInferChunks": np.asarray([n_inf], np.int64),
+            "NumLabelChunks": np.asarray([n_lab], np.int64),
+            "NumCorrectChunks": np.asarray([n_correct], np.int64)}

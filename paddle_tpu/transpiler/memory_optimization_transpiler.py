@@ -1,0 +1,196 @@
+"""Legacy liveness-based variable-reuse transpiler (reference
+python/paddle/fluid/transpiler/memory_optimization_transpiler.py:496
+memory_optimize / :595 release_memory over a ControlFlowGraph :60).
+
+On TPU this pass is largely subsumed: XLA's buffer assignment already
+shares/reuses device buffers inside the compiled step, and the compiler's
+donation path reuses parameter buffers across steps.  The transpiler is
+kept for reference parity and for the *interpreted* executor path, where
+renaming dead intermediates onto live ones genuinely shrinks the scope's
+working set.  Semantics match the reference:
+
+- level 0: a dead var's storage is reused only when dtype and shape match
+- level 1: dtype must match, shapes may differ (reuse when the dead var's
+  element count is >= the new var's)
+- persistables, feed/fetch vars, sub-block-referenced vars and
+  skip_opt_set names are never touched
+- release_memory inserts `delete_var` ops after each var's last use
+  instead of renaming
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["memory_optimize", "release_memory"]
+
+PRINT_LOG = False
+
+# ops owning sub-blocks: their referenced vars cross block boundaries, so
+# anything they touch is pinned (reference SUB_BLOCK_OPS)
+_SUB_BLOCK_OPS = {"while", "while_grad", "conditional_block",
+                  "conditional_block_grad", "recurrent", "recurrent_grad",
+                  "conditional_block_infer"}
+
+_PINNED_OP_TYPES = {"feed", "fetch", "read", "create_py_reader", "save",
+                    "load", "save_combine", "load_combine"}
+
+
+def _var_bytes(var):
+    if var.shape is None:
+        return None
+    shape = [d for d in var.shape if d is not None and d >= 0]
+    try:
+        return int(np.prod(shape)) if shape else 1
+    except TypeError:
+        return None
+
+
+def _block_pinned(block):
+    """Vars that must keep their identity: persistables, data vars,
+    sub-block-op operands, feed/fetch/io operands."""
+    pinned = set()
+    for var in block.vars.values():
+        if var.persistable or getattr(var, "is_data", False):
+            pinned.add(var.name)
+    for op in block.ops:
+        pin_all = op.type in _SUB_BLOCK_OPS or op.type in _PINNED_OP_TYPES \
+            or any(k == "sub_block" or k.endswith("_block")
+                   for k in op.attrs)
+        if pin_all:
+            for names in list(op.inputs.values()) + list(
+                    op.outputs.values()):
+                pinned.update(names)
+    return pinned
+
+
+def _liveness(ops):
+    """Per-op last-use index of every input var and def index of every
+    output var (single-assignment-ish scan; redefinitions extend life)."""
+    last_use = {}
+    defs = {}
+    for i, op in enumerate(ops):
+        for names in op.inputs.values():
+            for n in names:
+                last_use[n] = i
+        for names in op.outputs.values():
+            for n in names:
+                defs.setdefault(n, i)
+                # an op both reading+writing (in-place accumulators like
+                # sums) keeps the var alive through itself
+                last_use[n] = max(last_use.get(n, i), i)
+    return defs, last_use
+
+
+def _rename_in_op(op, old, new):
+    for slot, names in op.inputs.items():
+        op.inputs[slot] = [new if n == old else n for n in names]
+    for slot, names in op.outputs.items():
+        op.outputs[slot] = [new if n == old else n for n in names]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0):
+    """Reuse dead non-persistable vars' storage by renaming later vars onto
+    them (reference memory_optimization_transpiler.py:496).  Returns the
+    (mutated) program."""
+    if level not in (0, 1):
+        raise ValueError("only level 0 or 1 is supported")
+    skip = set(skip_opt_set or ())
+    for block in input_program.blocks:
+        pinned = _block_pinned(block) | skip
+        defs, last_use = _liveness(block.ops)
+        # pool of dead vars: name -> (dtype, shape, bytes)
+        pool = []
+        renamed = {}
+
+        def record(msg):
+            if print_log or PRINT_LOG:
+                print("memory_optimize:", msg)
+
+        for i, op in enumerate(block.ops):
+            # outputs defined here may steal a dead var's storage
+            for slot, names in list(op.outputs.items()):
+                for n in names:
+                    if n in pinned or n in renamed or n not in block.vars:
+                        continue
+                    if defs.get(n) != i:
+                        continue  # redefinition, not a fresh def
+                    var = block.var(n)
+                    nbytes = _var_bytes(var)
+                    if nbytes is None or var.dtype is None:
+                        continue
+                    for j, (cand, cdtype, cshape, cbytes) in \
+                            enumerate(pool):
+                        if cdtype != var.dtype:
+                            continue
+                        if level == 0 and tuple(cshape or ()) != tuple(
+                                var.shape or ()):
+                            continue
+                        if level == 1 and cbytes < nbytes:
+                            continue
+                        pool.pop(j)
+                        renamed[n] = cand
+                        # adopt the new shape on the reused var
+                        cvar = block.var(cand)
+                        cvar.shape = var.shape
+                        record(f"reuse {cand} <- {n} "
+                               f"(dtype={var.dtype}, shape={var.shape})")
+                        break
+            # apply pending renames to this op
+            for old, new in renamed.items():
+                _rename_in_op(op, old, new)
+            # vars whose last use was this op die now
+            for names in list(op.inputs.values()) + list(
+                    op.outputs.values()):
+                for n in names:
+                    orig = n
+                    if n in renamed.values():
+                        # find original name for liveness lookup
+                        cands = [o for o, w in renamed.items() if w == n]
+                        orig = cands[0] if cands else n
+                    if orig in pinned or orig not in block.vars:
+                        continue
+                    if last_use.get(orig) == i:
+                        var = block.var(orig)
+                        nbytes = _var_bytes(var)
+                        if nbytes is None or var.dtype is None:
+                            continue
+                        slotname = renamed.get(orig, orig)
+                        if any(p[0] == slotname for p in pool):
+                            continue
+                        pool.append((slotname, var.dtype, var.shape,
+                                     nbytes))
+        # drop renamed vars' descs
+        for old in renamed:
+            block.vars.pop(old, None)
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Insert delete_var ops after each non-persistable var's last use
+    (reference memory_optimization_transpiler.py:595; maps to the eager
+    deletion pass).  Returns the (mutated) program."""
+    skip = set(skip_opt_set or ())
+    for block in input_program.blocks:
+        pinned = _block_pinned(block) | skip
+        _, last_use = _liveness(block.ops)
+        # fetch targets must survive to the end
+        inserts = {}
+        for name, idx in last_use.items():
+            if name in pinned or name not in block.vars:
+                continue
+            inserts.setdefault(idx, []).append(name)
+        new_ops = []
+        for i, op in enumerate(block.ops):
+            new_ops.append(op)
+            dead = inserts.get(i)
+            if dead:
+                from paddle_tpu.core.program import OpDesc
+
+                del_op = OpDesc(type="delete_var",
+                                inputs={"X": sorted(dead)}, outputs={},
+                                attrs={})
+                new_ops.append(del_op)
+        block.ops = new_ops
+    return input_program

@@ -1,0 +1,545 @@
+"""Aux front-end modules (SURVEY.md §2.7 tail + §2.8 tooling): average,
+evaluator, trainer_desc/device_worker, data_feed_desc, data_generator,
+net_drawer, tools/timeline.py, tools/print_signatures.py.
+
+Reference models: python/paddle/fluid/average.py, evaluator.py,
+trainer_desc.py, device_worker.py, data_feed_desc.py,
+incubate/data_generator/__init__.py, net_drawer.py, tools/timeline.py,
+tools/diff_api.py.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import evaluator, layers
+from paddle_tpu.core.executor import Executor
+from paddle_tpu.core.scope import Scope, scope_guard
+from paddle_tpu.framework import Program, program_guard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- average
+
+def test_weighted_average():
+    from paddle_tpu.average import WeightedAverage
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        avg = WeightedAverage()
+    avg.add(value=2.0, weight=1)
+    avg.add(value=4.0, weight=2)
+    assert abs(avg.eval() - 10.0 / 3) < 1e-9
+    avg.reset()
+    with pytest.raises(ValueError):
+        avg.eval()
+    with pytest.raises(ValueError):
+        avg.add("nan", 1)
+
+
+# ---------------------------------------------------------------- chunk_eval
+
+def test_chunk_eval_op_iob():
+    from paddle_tpu.core.registry import get_op_def
+
+    # B-ORG=0 I-ORG=1 B-PER=2 I-PER=3 B-LOC=4 I-LOC=5 O=6
+    lab = np.array([[2, 3, 6, 6, 0, 1, 1, 1, 6, 4]])
+    inf = np.array([[2, 3, 6, 6, 0, 1, 1, 6, 6, 4]])  # ORG chunk cut short
+    out = get_op_def("chunk_eval").compute(
+        {"Inference": inf, "Label": lab},
+        {"num_chunk_types": 3, "chunk_scheme": "IOB",
+         "excluded_chunk_types": []})
+    assert int(out["NumLabelChunks"][0]) == 3
+    assert int(out["NumInferChunks"][0]) == 3
+    assert int(out["NumCorrectChunks"][0]) == 2
+    np.testing.assert_allclose(out["F1-Score"], [2 / 3], rtol=1e-6)
+
+
+def test_chunk_eval_excluded_and_seqlen():
+    from paddle_tpu.core.registry import get_op_def
+
+    lab = np.array([[2, 3, 0, 1, 6, 6]])
+    out = get_op_def("chunk_eval").compute(
+        {"Inference": lab, "Label": lab,
+         "SeqLength": np.array([4])},
+        {"num_chunk_types": 3, "chunk_scheme": "IOB",
+         "excluded_chunk_types": [1]})  # exclude PER
+    assert int(out["NumLabelChunks"][0]) == 1  # only the ORG chunk counts
+
+
+def test_chunk_eval_evaluator_accumulates():
+    prog, sprog = Program(), Program()
+    with scope_guard(Scope()):
+        with program_guard(prog, sprog):
+            inf = layers.data(name="inf", shape=[10], dtype="int64",
+                              append_batch_size=False)
+            lab = layers.data(name="lab", shape=[10], dtype="int64",
+                              append_batch_size=False)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ev = evaluator.ChunkEvaluator(
+                    inf, lab, chunk_scheme="IOB", num_chunk_types=3)
+            exe = Executor()
+            exe.run(sprog)
+            ev.reset(exe)
+            infv = np.array([[2, 3, 6, 6, 0, 1, 1, 6, 6, 4]], np.int64)
+            labv = np.array([[2, 3, 6, 6, 0, 1, 1, 1, 6, 4]], np.int64)
+            exe.run(prog, feed={"inf": infv, "lab": labv},
+                    fetch_list=ev.metrics)
+            exe.run(prog, feed={"inf": labv, "lab": labv},
+                    fetch_list=ev.metrics)
+            p, r, f = ev.eval(exe)
+            # batch1: 2/3 correct; batch2: 3/3 -> 5/6 accumulated
+            np.testing.assert_allclose(p, [5 / 6], rtol=1e-6)
+            np.testing.assert_allclose(r, [5 / 6], rtol=1e-6)
+            # reset zeroes the counters
+            ev.reset(exe)
+            p, r, f = ev.eval(exe)
+            assert p[0] == 0.0 and r[0] == 0.0
+
+
+# ---------------------------------------------------------------- evaluator
+
+def test_edit_distance_evaluator():
+    prog, sprog = Program(), Program()
+    with scope_guard(Scope()):
+        with program_guard(prog, sprog):
+            hyp = layers.data(name="hyp", shape=[2, 5], dtype="int64",
+                              append_batch_size=False)
+            ref = layers.data(name="ref", shape=[2, 5], dtype="int64",
+                              append_batch_size=False)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ev = evaluator.EditDistance(hyp, ref)
+            exe = Executor()
+            exe.run(sprog)
+            ev.reset(exe)
+            h = np.array([[1, 2, 3, 4, 5], [1, 1, 1, 1, 1]], np.int64)
+            r = np.array([[1, 2, 3, 4, 5], [2, 2, 2, 2, 2]], np.int64)
+            exe.run(prog, feed={"hyp": h, "ref": r}, fetch_list=ev.metrics)
+            avg_dist, avg_err = ev.eval(exe)
+            # distances: 0 and 5 -> avg 2.5; 1 of 2 sequences wrong
+            np.testing.assert_allclose(np.ravel(avg_dist), [2.5], rtol=1e-6)
+            np.testing.assert_allclose(np.ravel(avg_err), [0.5], rtol=1e-6)
+
+
+def test_detection_map_streaming_state_matches_joint():
+    """Two streamed batches == one combined call (reference
+    detection_map_op.h state merge semantics)."""
+    from paddle_tpu.core.registry import get_op_def
+
+    op = get_op_def("detection_map")
+    attrs = {"overlap_threshold": 0.5, "evaluate_difficult": True,
+             "ap_type": "integral", "class_num": 2}
+    # batch 1: one tp cls0, one high-score fp cls1
+    det1 = np.array([[[0, 0.9, 0, 0, 1, 1], [1, 0.95, 9, 9, 10, 10]]],
+                    np.float32)
+    lab1 = np.array([[[0, 0, 0, 0, 1, 1], [1, 0, 2, 2, 3, 3]]], np.float32)
+    # batch 2: tp cls1
+    det2 = np.array([[[1, 0.8, 2, 2, 3, 3]]], np.float32)
+    lab2 = np.array([[[1, 0, 2, 2, 3, 3]]], np.float32)
+
+    o1 = op.compute({"DetectRes": det1, "Label": lab1}, attrs)
+    o2 = op.compute(
+        {"DetectRes": det2, "Label": lab2,
+         "HasState": np.array([1], np.int32),
+         "PosCount": o1["AccumPosCount"], "TruePos": o1["AccumTruePos"],
+         "FalsePos": o1["AccumFalsePos"]}, attrs)
+
+    # joint: both images in one call (label -1 rows are padding)
+    pad = [-1, 0, 0, 0, 0, 0]
+    det_joint = np.array([[[0, 0.9, 0, 0, 1, 1], [1, 0.95, 9, 9, 10, 10]],
+                          [[1, 0.8, 2, 2, 3, 3], pad]], np.float32)
+    lab_joint = np.array([[[0, 0, 0, 0, 1, 1], [1, 0, 2, 2, 3, 3]],
+                          [[1, 0, 2, 2, 3, 3], pad]], np.float32)
+    oj = op.compute({"DetectRes": det_joint, "Label": lab_joint}, attrs)
+    np.testing.assert_allclose(np.ravel(o2["MAP"]), np.ravel(oj["MAP"]),
+                               rtol=1e-6)
+    # and streaming actually changed the answer vs batch2 alone
+    alone = op.compute({"DetectRes": det2, "Label": lab2}, attrs)
+    assert abs(float(o2["MAP"][0]) - float(alone["MAP"][0])) > 1e-3
+
+
+def test_detection_map_evaluator_reset():
+    prog, sprog = Program(), Program()
+    with scope_guard(Scope()):
+        with program_guard(prog, sprog):
+            det = layers.data(name="det", shape=[4, 6], dtype="float32",
+                              append_batch_size=False)
+            gl = layers.data(name="gl", shape=[2, 1], dtype="float32",
+                             append_batch_size=False)
+            gb = layers.data(name="gb", shape=[2, 4], dtype="float32",
+                             append_batch_size=False)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ev = evaluator.DetectionMAP(det, gl, gb, class_num=2)
+            exe = Executor()
+            exe.run(sprog)
+            ev.reset(exe)
+            cur, acc = ev.get_map_var()
+            detv = np.array([[0, 0.9, 0, 0, 1, 1], [1, 0.95, 9, 9, 10, 10],
+                             [0, 0.3, 5, 5, 6, 6], [1, 0.8, 2, 2, 3, 3]],
+                            np.float32)
+            glv = np.array([[0], [1]], np.float32)
+            gbv = np.array([[0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
+            feed = {"det": detv, "gl": glv, "gb": gbv}
+            c1, a1 = exe.run(prog, feed=feed, fetch_list=[cur, acc])
+            c2, a2 = exe.run(prog, feed=feed, fetch_list=[cur, acc])
+            # per-batch map stable; accumulative state kept flowing
+            np.testing.assert_allclose(np.ravel(c1), np.ravel(c2), rtol=1e-6)
+            ev.reset(exe)
+            c3, a3 = exe.run(prog, feed=feed, fetch_list=[cur, acc])
+            np.testing.assert_allclose(np.ravel(a3), np.ravel(a1), rtol=1e-6)
+
+
+# ------------------------------------------------- trainer/device worker
+
+def test_trainer_factory_defaults():
+    from paddle_tpu.trainer_desc import TrainerFactory
+
+    t = TrainerFactory()._create_trainer(None)
+    t._gen_trainer_desc()
+    assert t.trainer_name == "MultiTrainer"
+    assert t.device_worker_name == "HogwildWorker"
+    assert "MultiTrainer" in t._desc()
+
+
+def test_trainer_factory_from_fleet_opt():
+    from paddle_tpu.trainer_desc import TrainerFactory
+
+    prog = Program()
+    prog._fleet_opt = {"trainer": "DistMultiTrainer",
+                       "device_worker": "DownpourSGD",
+                       "sparse_tables": ["emb"], "dense_tables": ["w"]}
+    t = TrainerFactory()._create_trainer(prog._fleet_opt)
+    t._set_program(prog)
+    t._gen_trainer_desc()
+    assert t.trainer_name == "DistMultiTrainer"
+    assert t.device_worker_name == "DownpourWorker"
+    assert t.sparse_tables == ["emb"]
+
+
+def test_section_worker_requires_pipeline_opt():
+    from paddle_tpu.device_worker import DeviceWorkerFactory
+    from paddle_tpu.trainer_desc import PipelineTrainer
+
+    w = DeviceWorkerFactory()._create_device_worker("Section")
+    t = PipelineTrainer()
+    t._set_device_worker(w)
+    t._set_program(Program())  # no _pipeline_opt
+    with pytest.raises(RuntimeError):
+        t._gen_trainer_desc()
+
+
+def test_device_worker_factory_rejects_unknown():
+    from paddle_tpu.device_worker import DeviceWorkerFactory
+
+    with pytest.raises(ValueError):
+        DeviceWorkerFactory()._create_device_worker("Nope")
+
+
+# ---------------------------------------------------------- data_feed_desc
+
+_PROTO = '''name: "MultiSlotDataFeed"
+batch_size: 2
+multi_slot_desc {
+  slots {
+    name: "words"
+    type: "uint64"
+    is_dense: false
+    is_used: true
+  }
+  slots {
+    name: "label"
+    type: "uint64"
+    is_dense: false
+    is_used: false
+  }
+}
+'''
+
+
+def test_data_feed_desc_roundtrip(tmp_path):
+    from paddle_tpu.data_feed_desc import DataFeedDesc
+
+    p = tmp_path / "data.proto"
+    p.write_text(_PROTO)
+    d = DataFeedDesc(str(p))
+    assert d.batch_size() == 2
+    assert d.used_slots() == ["words"]
+    d.set_batch_size(128)
+    d.set_use_slots(["label"])
+    d.set_dense_slots(["label"])
+    assert d.batch_size() == 128
+    assert d.used_slots() == ["words", "label"]
+    # round trip through desc()
+    p2 = tmp_path / "data2.proto"
+    p2.write_text(d.desc())
+    d2 = DataFeedDesc(str(p2))
+    assert d2.batch_size() == 128
+    assert d2.used_slots() == ["words", "label"]
+
+
+# ---------------------------------------------------------- data_generator
+
+def test_multi_slot_data_generator_matches_native_parser():
+    from paddle_tpu import native
+    from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+
+    class G(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                if line is None:
+                    return
+                toks = [int(x) for x in line.split()]
+                yield [("words", toks[:-1]), ("label", [toks[-1]])]
+            return it
+
+    g = G()
+    buf = io.StringIO()
+    g._run(["1 2 3 0\n", "4 5 6 1\n"], buf)
+    text = buf.getvalue()
+    assert text == "3 1 2 3 1 0\n3 4 5 6 1 1\n"
+    # and the native MultiSlot parser accepts the emitted bytes
+    parser = native.MultiSlotParser(["int64", "int64"])
+    n, slots = parser.parse(text.encode())
+    assert n == 2
+    vals, lod = slots[0]
+    assert list(vals[lod[0]:lod[1]]) == [1, 2, 3]
+    assert list(slots[1][0]) == [0, 1]
+
+
+def test_multi_slot_data_generator_type_upgrade_and_errors():
+    from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+
+    g = MultiSlotDataGenerator()
+    g._gen_str([("a", [1, 2])])
+    g._gen_str([("a", [1.5, 2])])          # upgrades slot to float
+    assert g._proto_info[0][1] == "float"
+    with pytest.raises(ValueError):
+        g._gen_str([("b", [1])])           # name mismatch
+    with pytest.raises(ValueError):
+        g._gen_str("not-a-sample")
+
+
+# -------------------------------------------------------------- net_drawer
+
+def test_net_drawer_draw_graph():
+    from paddle_tpu.net_drawer import draw_graph
+
+    prog, sprog = Program(), Program()
+    with program_guard(prog, sprog):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=2)
+    dot = draw_graph(sprog, prog)
+    assert dot.startswith("digraph G {") and dot.endswith("}")
+    assert "matmul" in dot or "mul" in dot
+    assert "fillcolor=lightblue" in dot  # parameters highlighted
+
+
+# ------------------------------------------------------------ tools
+
+def test_timeline_merges_worker_traces(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import timeline
+    finally:
+        sys.path.pop(0)
+    t0 = {"traceEvents": [
+        {"name": "opA", "ph": "X", "ts": 0, "dur": 5, "pid": 9, "tid": 0}]}
+    t1 = {"traceEvents": [
+        {"name": "opB", "ph": "X", "ts": 2, "dur": 3, "pid": 9, "tid": 0}]}
+    p0, p1 = tmp_path / "w0.json", tmp_path / "w1.json"
+    p0.write_text(json.dumps(t0))
+    p1.write_text(json.dumps(t1))
+    merged = timeline.merge_traces(
+        timeline.parse_profile_paths(f"t0={p0},t1={p1}"))
+    evs = merged["traceEvents"]
+    names = {(e.get("pid"), e["name"]) for e in evs}
+    assert (0, "opA") in names and (1, "opB") in names
+    assert (0, "process_name") in names and (1, "process_name") in names
+
+
+def test_api_spec_gate():
+    """The committed API.spec matches the live API (reference
+    tools/diff_api.py CI gate)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "print_signatures.py"),
+         "paddle_tpu"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert out.returncode == 0, out.stderr
+    with open(os.path.join(REPO, "API.spec")) as f:
+        committed = f.read()
+    assert out.stdout == committed, (
+        "API surface changed; regenerate API.spec with "
+        "`python tools/print_signatures.py paddle_tpu > API.spec`")
+
+
+# ------------------------------------------------- executor integration
+
+def test_train_from_dataset_builds_trainer(tmp_path):
+    """train_from_dataset runs through TrainerFactory (reference
+    executor.py:927) and still trains."""
+    from paddle_tpu.dataset import DatasetFactory
+
+    data_file = tmp_path / "part-0"
+    rows = []
+    rng = np.random.RandomState(0)
+    for _ in range(8):
+        x = rng.rand(4)
+        label = float(x.sum() > 2)
+        rows.append("4 " + " ".join(f"{v:.6f}" for v in x) +
+                    f" 1 {label:.1f}")
+    data_file.write_text("\n".join(rows) + "\n")
+
+    prog, sprog = Program(), Program()
+    with scope_guard(Scope()):
+        with program_guard(prog, sprog):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            from paddle_tpu.optimizer import SGD
+            SGD(learning_rate=0.1).minimize(loss)
+            exe = Executor()
+            exe.run(sprog)
+            ds = DatasetFactory().create_dataset("QueueDataset")
+            ds.set_batch_size(4)
+            ds.set_use_var([x, y])
+            ds.set_filelist([str(data_file)])
+            exe.train_from_dataset(prog, ds, fetch_list=[loss])
+
+
+# ------------------------------------- memory_optimization_transpiler
+
+def _build_mlp_sgd():
+    from paddle_tpu import unique_name
+    from paddle_tpu.optimizer import SGD
+
+    prog, sprog = Program(), Program()
+    with program_guard(prog, sprog):
+        with unique_name.guard():
+            x = layers.data(name="x", shape=[8], dtype="float32")
+            h = layers.fc(x, size=16, act="relu")
+            h2 = layers.fc(h, size=16, act="relu")
+            y = layers.fc(h2, size=1)
+            label = layers.data(name="label", shape=[1], dtype="float32")
+            loss = layers.mean(layers.square_error_cost(y, label))
+            SGD(learning_rate=0.1).minimize(loss)
+    return prog, sprog, loss
+
+
+def test_memory_optimize_preserves_training(fresh_programs_factory):
+    """Var-reuse renaming must not change the loss trajectory (reference
+    memory_optimization_transpiler.py:496)."""
+    from paddle_tpu.transpiler import memory_optimize
+
+    feed = {"x": np.random.RandomState(1).rand(4, 8).astype(np.float32),
+            "label": np.random.RandomState(2).rand(4, 1).astype(np.float32)}
+
+    def run(transform):
+        with fresh_programs_factory():
+            np.random.seed(0)
+            with scope_guard(Scope()):
+                p, s, loss = _build_mlp_sgd()
+                nvars0 = len(p.global_block().vars)
+                if transform:
+                    transform(p, loss)
+                nvars1 = len(p.global_block().vars)
+                exe = Executor()
+                exe.run(s)
+                out = [exe.run(p, feed=feed, fetch_list=[loss.name])[0]
+                       for _ in range(4)]
+                return out, nvars0, nvars1
+
+    base, n0, _ = run(None)
+    opt, _, n1 = run(lambda p, loss: memory_optimize(
+        p, skip_opt_set={loss.name}, level=0))
+    assert n1 < n0, "memory_optimize reused nothing"
+    np.testing.assert_allclose(np.ravel(base), np.ravel(opt), rtol=1e-5)
+
+
+def test_memory_optimize_level1_and_release(fresh_programs_factory):
+    from paddle_tpu.transpiler import memory_optimize, release_memory
+
+    feed = {"x": np.random.RandomState(1).rand(4, 8).astype(np.float32),
+            "label": np.random.RandomState(2).rand(4, 1).astype(np.float32)}
+
+    def run(transform):
+        with fresh_programs_factory():
+            np.random.seed(0)
+            with scope_guard(Scope()):
+                p, s, loss = _build_mlp_sgd()
+                if transform:
+                    transform(p, loss)
+                exe = Executor()
+                exe.run(s)
+                return exe.run(p, feed=feed, fetch_list=[loss.name])[0]
+
+    base = run(None)
+    lvl1 = run(lambda p, loss: memory_optimize(
+        p, skip_opt_set={loss.name}, level=1))
+    rel = run(lambda p, loss: release_memory(p, skip_opt_set={loss.name}))
+    np.testing.assert_allclose(np.ravel(base), np.ravel(lvl1), rtol=1e-5)
+    np.testing.assert_allclose(np.ravel(base), np.ravel(rel), rtol=1e-5)
+
+
+def test_detection_map_reference_edge_semantics():
+    """Review-found deviations vs reference detection_map_op.h: a class
+    with gt but no detections is skipped from the mean (not AP=0); with
+    evaluate_difficult=False a difficult-matched detection is neither TP
+    nor FP; IoU exactly equal to the threshold is NOT a match."""
+    from paddle_tpu.core.registry import get_op_def
+
+    op = get_op_def("detection_map")
+    base = {"overlap_threshold": 0.5, "evaluate_difficult": True,
+            "ap_type": "integral", "class_num": 2}
+    det = np.array([[[0, 0.9, .1, .1, .5, .5]]], np.float32)
+    lab = np.array([[[0, 0, .1, .1, .5, .5], [1, 0, .6, .6, .9, .9]]],
+                   np.float32)
+    o = op.compute({"DetectRes": det, "Label": lab}, base)
+    np.testing.assert_allclose(np.ravel(o["MAP"]), [1.0], rtol=1e-6)
+
+    a2 = {**base, "evaluate_difficult": False, "class_num": 1}
+    det2 = np.array([[[0, 0.9, .1, .1, .5, .5]]], np.float32)
+    lab2 = np.array([[[0, 1, .1, .1, .5, .5], [0, 0, .6, .6, .9, .9]]],
+                    np.float32)
+    o2 = op.compute({"DetectRes": det2, "Label": lab2}, a2)
+    assert o2["AccumTruePos"].shape[0] == 0
+    assert o2["AccumFalsePos"].shape[0] == 0
+
+    det3 = np.array([[[0, 0.9, 0, 0, 1, 2]]], np.float32)
+    lab3 = np.array([[[0, 0, 0, 0, 1, 1]]], np.float32)  # IoU exactly 0.5
+    o3 = op.compute({"DetectRes": det3, "Label": lab3},
+                    {**base, "class_num": 1})
+    np.testing.assert_allclose(np.ravel(o3["MAP"]), [0.0], atol=1e-7)
+
+
+def test_fetch_deleted_var_raises(fresh_programs_factory):
+    """Fetching a var deleted by release_memory raises instead of silently
+    returning None (review finding on core/executor.py _fetch)."""
+    from paddle_tpu.transpiler import release_memory
+
+    with fresh_programs_factory():
+        with scope_guard(Scope()):
+            prog, sprog = Program(), Program()
+            with program_guard(prog, sprog):
+                x = layers.data(name="x", shape=[4], dtype="float32")
+                h = layers.fc(x, size=4)
+                out = layers.mean(h)
+            release_memory(prog)  # no skip set: 'out' gets deleted too
+            exe = Executor()
+            exe.run(sprog)
+            with pytest.raises(RuntimeError, match="no value"):
+                exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                        fetch_list=[out.name])

@@ -1,0 +1,37 @@
+"""Diff two API spec files and fail loudly on any change (reference
+tools/diff_api.py: the PR gate that forces API changes through review).
+
+    python tools/print_signatures.py paddle_tpu > /tmp/now.spec
+    python tools/diff_api.py API.spec /tmp/now.spec
+"""
+
+from __future__ import annotations
+
+import difflib
+import sys
+
+
+def main():
+    if len(sys.argv) != 3:
+        print("usage: diff_api.py <origin.spec> <new.spec>")
+        return 1
+    with open(sys.argv[1]) as f:
+        origin = f.read().splitlines()
+    with open(sys.argv[2]) as f:
+        new = f.read().splitlines()
+    diffs = list(difflib.unified_diff(
+        origin, new, fromfile=sys.argv[1], tofile=sys.argv[2], lineterm=""))
+    if not diffs:
+        return 0
+    print("API Difference is:")
+    for line in diffs:
+        print(line)
+    print(
+        "\nThe API change requires review — regenerate the spec with\n"
+        "  python tools/print_signatures.py paddle_tpu > API.spec\n"
+        "and include it in the change.")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Print the public API signatures of paddle_tpu, one per line, sorted —
+the API-stability gate (reference tools/print_signatures.py, consumed by
+tools/diff_api.py against paddle/fluid/API.spec).
+
+    python tools/print_signatures.py paddle_tpu > API.spec
+
+Each line: `<qualified name> (ArgSpec(args=[...], defaults=(...)), <kind>)`.
+Callables that cannot be introspected print their docstring hash instead,
+like the reference does for C-implemented functions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import inspect
+import os
+import pkgutil
+import re
+import sys
+
+# make `python tools/print_signatures.py` work from a repo checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# modules whose import has side effects we don't want in a spec run, or
+# that are internal plumbing rather than public API
+_SKIP_PREFIXES = ("paddle_tpu.native.src", "paddle_tpu.native.lib")
+
+
+def _public_modules(root_name):
+    root = importlib.import_module(root_name)
+    yield root_name, root
+    for info in pkgutil.walk_packages(root.__path__, root_name + "."):
+        if any(info.name.startswith(p) for p in _SKIP_PREFIXES):
+            continue
+        if any(part.startswith("_") for part in info.name.split(".")):
+            continue
+        try:
+            yield info.name, importlib.import_module(info.name)
+        except Exception as e:  # never let one bad module kill the gate
+            print(f"# import-failed {info.name}: {type(e).__name__}",
+                  file=sys.stderr)
+
+
+def _signature_of(obj):
+    try:
+        sig = inspect.signature(obj)
+        args = [p.name for p in sig.parameters.values()]
+        defaults = tuple(
+            re.sub(r" at 0x[0-9a-f]+", "", repr(p.default))
+            for p in sig.parameters.values()
+            if p.default is not inspect.Parameter.empty)
+        return f"ArgSpec(args={args}, defaults={defaults})"
+    except (ValueError, TypeError):
+        doc = inspect.getdoc(obj) or ""
+        return "document " + hashlib.md5(doc.encode()).hexdigest()
+
+
+def collect(root_name="paddle_tpu"):
+    lines = {}
+    for mod_name, mod in _public_modules(root_name):
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for name in names:
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            # only report objects defined under our package (skip re-exports
+            # of numpy/jax) unless the module pinned them in __all__
+            owner = getattr(obj, "__module__", "") or ""
+            if not owner.startswith(root_name) and \
+                    names is not getattr(mod, "__all__", None):
+                continue
+            qual = f"{mod_name}.{name}"
+            if inspect.isclass(obj):
+                lines[qual] = f"({_signature_of(obj.__init__)}, 'class')"
+                for mname, meth in sorted(vars(obj).items()):
+                    if mname.startswith("_") or not callable(meth):
+                        continue
+                    lines[f"{qual}.{mname}"] = \
+                        f"({_signature_of(meth)}, 'method')"
+            elif callable(obj):
+                lines[qual] = f"({_signature_of(obj)}, 'function')"
+    return lines
+
+
+def main():
+    root = sys.argv[1] if len(sys.argv) > 1 else "paddle_tpu"
+    for qual, spec in sorted(collect(root).items()):
+        print(f"{qual} {spec}")
+
+
+if __name__ == "__main__":
+    main()

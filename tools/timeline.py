@@ -1,0 +1,68 @@
+"""Merge per-worker profile dumps into one chrome trace (reference
+tools/timeline.py: _ChromeTraceFormatter + Timeline over profiler protos).
+
+The framework's profiler (paddle_tpu/profiler.py export_chrome_tracing)
+already writes chrome-trace JSON per process; distributed jobs produce one
+file per worker.  This tool re-bases each worker's events onto its own pid
+lane (with process_name metadata) and emits a single timeline, exactly the
+workflow of the reference tool:
+
+    python tools/timeline.py \
+        --profile_path trainer0=/tmp/p0.json,trainer1=/tmp/p1.json \
+        --timeline_path /tmp/timeline.json
+
+Open the result in chrome://tracing or Perfetto.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def parse_profile_paths(spec):
+    """'name1=path1,name2=path2' or a single bare path -> [(name, path)]."""
+    out = []
+    for i, part in enumerate(p for p in spec.split(",") if p):
+        if "=" in part:
+            name, path = part.split("=", 1)
+        else:
+            name, path = f"worker{i}", part
+        out.append((name, path))
+    if not out:
+        raise ValueError("empty --profile_path")
+    return out
+
+
+def merge_traces(named_paths):
+    events = []
+    for pid, (name, path) in enumerate(named_paths):
+        with open(path) as f:
+            trace = json.load(f)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": name}})
+        for ev in trace.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+    return {"traceEvents": events}
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--profile_path", type=str, required=True,
+        help="comma-separated name=chrome_trace.json pairs, one per worker")
+    parser.add_argument(
+        "--timeline_path", type=str, default="timeline.json",
+        help="merged chrome trace output")
+    args = parser.parse_args()
+    merged = merge_traces(parse_profile_paths(args.profile_path))
+    with open(args.timeline_path, "w") as f:
+        json.dump(merged, f)
+    print(f"wrote {len(merged['traceEvents'])} events to "
+          f"{args.timeline_path}")
+
+
+if __name__ == "__main__":
+    main()
