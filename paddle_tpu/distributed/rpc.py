@@ -10,78 +10,185 @@ Reference parity:
 
 TPU-first difference: tensors crossing this layer are host numpy arrays
 (pserver state lives on host; the trainer's device state is donated to
-XLA).  Framing is length-prefixed pickles of (msg_type, payload), but
-deserialization goes through a *restricted* Unpickler that only admits
-numpy array/dtype reconstruction and plain data containers — the wire
-format is data-only, like the reference's protobuf VariableMessage
-(send_recv.proto.in:47), which cannot encode code execution.  The
-native C++ data path (paddle_tpu/native/) owns bulk file IO instead.
+XLA).  Framing is length-prefixed messages in a small self-describing
+binary codec (tag + payload, ndarrays as dtype/shape/raw-bytes headers) —
+the moral equivalent of the reference's protobuf VariableMessage
+(send_recv.proto.in:47) + zero-copy serde (grpc/grpc_serde.cc): the wire
+can only describe data, never code, and is independent of numpy/pickle
+internals.  The native C++ data path (paddle_tpu/native/) owns bulk file
+IO instead.
 """
 
 from __future__ import annotations
 
-import io
-import pickle
 import socket
 import struct
 import threading
 
+import numpy as np
+
 _LEN = struct.Struct("!Q")
-
-# Allow-list for the wire format: numpy reconstruction internals plus the
-# scalar types that appear inside (name, ndarray) payloads.  Anything else
-# (os.system, subprocess, functools.partial, ...) raises UnpicklingError —
-# a hostile peer gets an exception, not code execution.
-_SAFE_GLOBALS = {
-    ("numpy.core.multiarray", "_reconstruct"),
-    ("numpy.core.multiarray", "scalar"),
-    ("numpy._core.multiarray", "_reconstruct"),
-    ("numpy._core.multiarray", "scalar"),
-    ("numpy", "ndarray"),
-    ("numpy", "dtype"),
-    ("numpy", "float32"),
-    ("numpy", "float64"),
-    ("numpy", "float16"),
-    ("numpy", "int64"),
-    ("numpy", "int32"),
-    ("numpy", "int16"),
-    ("numpy", "int8"),
-    ("numpy", "uint8"),
-    ("numpy", "bool_"),
-    ("numpy.core.multiarray", "_frombuffer"),
-    ("numpy._core.multiarray", "_frombuffer"),
-    ("numpy.core.numeric", "_frombuffer"),
-    ("numpy._core.numeric", "_frombuffer"),
-    ("numpy.dtypes", "Float32DType"),
-    ("numpy.dtypes", "Float64DType"),
-    ("numpy.dtypes", "Int64DType"),
-    ("numpy.dtypes", "Int32DType"),
-    ("builtins", "complex"),
-    ("builtins", "bytearray"),
-    ("builtins", "frozenset"),
-    ("builtins", "set"),
-    ("builtins", "slice"),
-    ("builtins", "range"),
-}
+_I64 = struct.Struct("!q")
+_F64 = struct.Struct("!d")
+_U32 = struct.Struct("!I")
 
 
-class _RestrictedUnpickler(pickle.Unpickler):
-    """Data-only unpickler: see _SAFE_GLOBALS.  Reference analog: the
-    gRPC serde can only produce tensors (grpc/grpc_serde.cc)."""
-
-    def find_class(self, module, name):
-        if (module, name) in _SAFE_GLOBALS:
-            return super().find_class(module, name)
-        raise pickle.UnpicklingError(
-            f"wire format forbids global {module}.{name}")
+class WireError(ValueError):
+    """Malformed or forbidden wire content (never code execution — the
+    codec has no notion of callables or class reconstruction)."""
 
 
-def _loads_restricted(data: bytes):
-    return _RestrictedUnpickler(io.BytesIO(data)).load()
+_MAX_DEPTH = 32
+
+
+def _enc_len_bytes(b: bytes) -> bytes:
+    try:
+        return _U32.pack(len(b)) + b
+    except struct.error:
+        raise WireError("str/bytes payload over u32 length limit") from None
+
+
+def _encode(obj, out, depth=0):
+    """Tagged binary encoding.  Supported: None, bool, int, float, str,
+    bytes, np.ndarray/np scalar, list, tuple, dict (str-ish keys ok).
+    Depth-capped like the decoder, so cyclic/over-deep payloads fail at
+    the sender with WireError, not RecursionError at the peer."""
+    if depth > _MAX_DEPTH:
+        raise WireError("nesting too deep (or cyclic payload)")
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, (np.ndarray, np.generic)):
+        # before int/float: np.float64 is a builtin-float subclass and
+        # would otherwise degrade to 'f' while float32 stays an array
+        arr = np.asarray(obj)
+        if not arr.flags.c_contiguous:
+            arr = arr.copy(order="C")  # (ascontiguousarray would 1-d-ify 0-d)
+        if arr.dtype.hasobject or arr.dtype.names is not None \
+                or arr.dtype.kind == "V":
+            raise WireError(
+                "object/structured arrays are not wire-encodable")
+        out.append(b"a" + _enc_len_bytes(arr.dtype.str.encode("ascii"))
+                   + _U32.pack(arr.ndim)
+                   + b"".join(_I64.pack(d) for d in arr.shape)
+                   + _LEN.pack(arr.nbytes))
+        out.append(arr.tobytes())
+    elif isinstance(obj, int):
+        try:
+            out.append(b"i" + _I64.pack(obj))
+        except struct.error:
+            raise WireError("int out of int64 range") from None
+    elif isinstance(obj, float):
+        out.append(b"f" + _F64.pack(obj))
+    elif isinstance(obj, str):
+        out.append(b"s" + _enc_len_bytes(obj.encode("utf-8")))
+    elif isinstance(obj, bytes):
+        out.append(b"b" + _enc_len_bytes(obj))
+    elif isinstance(obj, (list, tuple)):
+        try:
+            hdr = _U32.pack(len(obj))
+        except struct.error:
+            raise WireError("container over u32 length limit") from None
+        out.append((b"l" if isinstance(obj, list) else b"t") + hdr)
+        for item in obj:
+            _encode(item, out, depth + 1)
+    elif isinstance(obj, dict):
+        try:
+            hdr = _U32.pack(len(obj))
+        except struct.error:
+            raise WireError("container over u32 length limit") from None
+        out.append(b"d" + hdr)
+        for k, v in obj.items():
+            _encode(k, out, depth + 1)
+            _encode(v, out, depth + 1)
+    else:
+        raise WireError(
+            f"type {type(obj).__name__} is not wire-encodable")
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n):
+        if self.pos + n > len(self.data):
+            raise WireError("truncated message")
+        b = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def u32(self):
+        return _U32.unpack(self.take(4))[0]
+
+    def decode(self, depth=0):
+        if depth > _MAX_DEPTH:
+            raise WireError("nesting too deep")
+        tag = self.take(1)
+        if tag == b"N":
+            return None
+        if tag == b"T":
+            return True
+        if tag == b"F":
+            return False
+        if tag == b"i":
+            return _I64.unpack(self.take(8))[0]
+        if tag == b"f":
+            return _F64.unpack(self.take(8))[0]
+        if tag == b"s":
+            return self.take(self.u32()).decode("utf-8")
+        if tag == b"b":
+            return bytes(self.take(self.u32()))
+        if tag == b"a":
+            dtype = np.dtype(self.take(self.u32()).decode("ascii"))
+            ndim = self.u32()
+            if ndim > 32:
+                raise WireError("ndarray rank too large")
+            shape = tuple(_I64.unpack(self.take(8))[0]
+                          for _ in range(ndim))
+            nbytes = _LEN.unpack(self.take(8))[0]
+            expect = int(np.prod(shape)) * dtype.itemsize if shape else \
+                dtype.itemsize
+            if any(d < 0 for d in shape) or nbytes != expect:
+                raise WireError("ndarray header/payload mismatch")
+            return np.frombuffer(self.take(nbytes),
+                                 dtype=dtype).reshape(shape).copy()
+        if tag in (b"l", b"t"):
+            n = self.u32()
+            items = [self.decode(depth + 1) for _ in range(n)]
+            return items if tag == b"l" else tuple(items)
+        if tag == b"d":
+            n = self.u32()
+            return {self.decode(depth + 1): self.decode(depth + 1)
+                    for _ in range(n)}
+        raise WireError(f"unknown wire tag {tag!r}")
+
+
+def wire_dumps(obj) -> bytes:
+    out = []
+    _encode(obj, out)
+    return b"".join(out)
+
+
+def wire_loads(data: bytes):
+    r = _Reader(data)
+    try:
+        obj = r.decode()
+    except WireError:
+        raise
+    except (TypeError, ValueError, UnicodeDecodeError,
+            struct.error) as e:  # malformed headers -> WireError, not leaks
+        raise WireError(f"malformed wire message: {e}") from None
+    if r.pos != len(data):
+        raise WireError("trailing bytes after message")
+    return obj
 
 
 def _send_msg(sock, obj):
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    data = wire_dumps(obj)
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
@@ -97,13 +204,14 @@ def _recv_exact(sock, n):
 
 def _recv_msg(sock):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return _loads_restricted(_recv_exact(sock, n))
+    return wire_loads(_recv_exact(sock, n))
 
 
 class RPCServer:
     """Threaded request server: one handler per message type.
 
-    handler(payload) -> reply (any picklable; None is fine).  Handlers
+    handler(payload) -> reply (anything wire-encodable — scalars, str,
+    bytes, numpy arrays, lists/tuples/dicts; None is fine).  Handlers
     run on connection threads; use locks for shared state (the reference
     serializes through its RequestHandler Get/Set with barriers —
     rpc_server.h:48 registered barriers map to `barrier` here).
@@ -166,9 +274,20 @@ class RPCServer:
         try:
             while not self._stop.is_set():
                 try:
-                    msg_type, payload = _recv_msg(conn)
+                    msg = _recv_msg(conn)
                 except (ConnectionError, EOFError, OSError):
                     return
+                except WireError as e:
+                    # frame was fully consumed (length-prefixed), so the
+                    # stream is still in sync: report and keep serving
+                    _send_msg(conn, ("error", f"bad wire frame: {e}"))
+                    continue
+                if not (isinstance(msg, tuple) and len(msg) == 2
+                        and isinstance(msg[0], str)):
+                    _send_msg(conn, ("error",
+                                     "message must be (msg_type, payload)"))
+                    continue
+                msg_type, payload = msg
                 fn = self._handlers.get(msg_type)
                 if fn is None:
                     _send_msg(conn, ("error",
@@ -179,7 +298,13 @@ class RPCServer:
                 except Exception as e:  # surface to client
                     _send_msg(conn, ("error", repr(e)))
                     continue
-                _send_msg(conn, ("ok", reply))
+                try:
+                    _send_msg(conn, ("ok", reply))
+                except WireError as e:
+                    # handler returned something non-encodable: tell the
+                    # client instead of killing the connection
+                    _send_msg(conn, ("error",
+                                     f"reply not wire-encodable: {e}"))
         finally:
             conn.close()
 
