@@ -1,8 +1,30 @@
-"""contrib — AMP, slim (quantization), and other incubating subsystems.
-
-Reference parity: /root/reference/python/paddle/fluid/contrib/
+"""contrib — AMP, slim (quantization), decoders, analysis tools, and the
+other incubating subsystems (reference python/paddle/fluid/contrib/
+__init__.py re-exports the same names at package level).
 """
 
-from paddle_tpu.contrib import mixed_precision  # noqa: F401
-from paddle_tpu.contrib import slim  # noqa: F401
+from paddle_tpu.contrib import decoder  # noqa: F401
+from paddle_tpu.contrib import extend_optimizer  # noqa: F401
 from paddle_tpu.contrib import float16  # noqa: F401
+from paddle_tpu.contrib import inferencer  # noqa: F401
+from paddle_tpu.contrib import layers  # noqa: F401
+from paddle_tpu.contrib import memory_usage_calc  # noqa: F401
+from paddle_tpu.contrib import mixed_precision  # noqa: F401
+from paddle_tpu.contrib import model_stat  # noqa: F401
+from paddle_tpu.contrib import op_frequence  # noqa: F401
+from paddle_tpu.contrib import quantize  # noqa: F401
+from paddle_tpu.contrib import reader  # noqa: F401
+from paddle_tpu.contrib import slim  # noqa: F401
+from paddle_tpu.contrib import trainer  # noqa: F401
+from paddle_tpu.contrib import utils  # noqa: F401
+from paddle_tpu.contrib.extend_optimizer import (  # noqa: F401
+    extend_with_decoupled_weight_decay)
+from paddle_tpu.contrib.inferencer import Inferencer  # noqa: F401
+from paddle_tpu.contrib.memory_usage_calc import memory_usage  # noqa: F401
+from paddle_tpu.contrib.model_stat import summary  # noqa: F401
+from paddle_tpu.contrib.op_frequence import op_freq_statistic  # noqa: F401
+from paddle_tpu.contrib.quantize import QuantizeTranspiler  # noqa: F401
+from paddle_tpu.contrib.reader import (  # noqa: F401
+    ctr_reader, distributed_batch_reader)
+from paddle_tpu.contrib.trainer import (CheckpointConfig,  # noqa: F401
+                                        Trainer)

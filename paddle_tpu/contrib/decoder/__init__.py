@@ -1,0 +1,7 @@
+"""Contrib decoder package (reference
+python/paddle/fluid/contrib/decoder/__init__.py)."""
+
+from paddle_tpu.contrib.decoder import beam_search_decoder  # noqa: F401
+from paddle_tpu.contrib.decoder.beam_search_decoder import *  # noqa: F401,F403
+
+__all__ = list(beam_search_decoder.__all__)

@@ -1,0 +1,362 @@
+"""Training and beam-search decoders over a user-defined state cell
+(reference python/paddle/fluid/contrib/decoder/beam_search_decoder.py:43
+InitState, :159 StateCell, :384 TrainingDecoder, :523 BeamSearchDecoder).
+
+TPU re-specification: the reference drives the state cell through
+DynamicRNN (training) and a While loop over LoD tensor arrays (decoding).
+Here TrainingDecoder rides the framework's DynamicRNN (which lowers to one
+lax.scan), and BeamSearchDecoder statically unrolls `max_len` decode steps
+over DENSE [batch*beam] state — per step: embed prev ids, run the user's
+state updater, project to vocab, and call the dense `beam_search` op
+(ops/rnn_ops.py:513), gathering states by parent beam with gather_nd.
+The unrolled program is a single XLA computation; no host-side loop runs
+at execution time.
+"""
+
+from __future__ import annotations
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """reference beam_search_decoder.py:43."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        from paddle_tpu import layers
+
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "init_boot must be provided to infer the shape of "
+                "InitState.\n")
+        else:
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=shape or [-1],
+                dtype=dtype)
+        self._shape = shape
+        self._value = value
+        self._need_reorder = need_reorder
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """reference beam_search_decoder.py:159 — named states + inputs and a
+    user-registered updater run once per decode step."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._inputs = dict(inputs)
+        self._init_states = dict(states)
+        self._state_names = list(states)
+        self._cur_states = {k: v.value for k, v in states.items()}
+        self._out_state = out_state
+        self._state_updater = None
+        self.name = name
+
+    def state_updater(self, updater):
+        """Decorator registering the per-step updater (reference :314)."""
+        self._state_updater = updater
+
+        def _decorator(state_cell):
+            if state_cell == self:
+                raise TypeError("Updater should only accept a StateCell "
+                                "object as argument.")
+            updater(state_cell)
+
+        return _decorator
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs:
+            raise ValueError(f"Unknown input {input_name}")
+        return self._inputs[input_name]
+
+    def get_state(self, state_name):
+        if state_name not in self._cur_states:
+            raise ValueError(f"Unknown state {state_name}")
+        return self._cur_states[state_name]
+
+    def set_state(self, state_name, state_value):
+        self._cur_states[state_name] = state_value
+
+    def compute_state(self, inputs):
+        """Feed the step inputs and run the updater (reference :335)."""
+        for input_name, input_value in inputs.items():
+            if input_name not in self._inputs:
+                raise ValueError(
+                    f"Unknown input {input_name}. Please make sure "
+                    f"{input_name} in input place holder.")
+            self._inputs[input_name] = input_value
+        if self._state_updater is None:
+            raise RuntimeError("no state_updater registered")
+        self._state_updater(self)
+
+    def update_states(self):
+        """Record the new states on the enclosing decoder (reference
+        :360).  The TrainingDecoder wires this to DynamicRNN
+        update_memory; BeamSearchDecoder snapshots dense states."""
+        if getattr(self, "_update_hook", None) is not None:
+            self._update_hook()
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+    def _reset(self):
+        self._cur_states = {k: v.value
+                            for k, v in self._init_states.items()}
+
+
+class TrainingDecoder:
+    """Teacher-forced decoder over DynamicRNN (reference :384)."""
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, name=None):
+        from paddle_tpu.layers.control_flow import DynamicRNN
+
+        self._state_cell = state_cell
+        self._dynamic_rnn = DynamicRNN()
+        self._status = TrainingDecoder.BEFORE_DECODER
+        self.name = name
+        self._mems = {}
+
+    @property
+    def state_cell(self):
+        self._assert_in_decoder_block("state_cell")
+        return self._state_cell
+
+    @property
+    def dynamic_rnn(self):
+        return self._dynamic_rnn
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _block():
+            if self._status != TrainingDecoder.BEFORE_DECODER:
+                raise ValueError("decoder.block() can only be invoked once")
+            self._status = TrainingDecoder.IN_DECODER
+            sc = self._state_cell
+            with self._dynamic_rnn.block():
+                # states become rnn memories boot-strapped from InitState
+                for name in sc._state_names:
+                    mem = self._dynamic_rnn.memory(
+                        init=sc._init_states[name].value)
+                    self._mems[name] = mem
+                    sc._cur_states[name] = mem
+                sc._update_hook = self._update_states
+                yield
+            sc._update_hook = None
+            self._status = TrainingDecoder.AFTER_DECODER
+        return _block()
+
+    def _update_states(self):
+        sc = self._state_cell
+        for name, mem in self._mems.items():
+            self._dynamic_rnn.update_memory(mem, sc._cur_states[name])
+
+    def step_input(self, x):
+        self._assert_in_decoder_block("step_input")
+        return self._dynamic_rnn.step_input(x)
+
+    def static_input(self, x):
+        self._assert_in_decoder_block("static_input")
+        # dense re-spec: static inputs need no LoD re-rank; pass through
+        return x
+
+    def output(self, *outputs):
+        self._assert_in_decoder_block("output")
+        self._dynamic_rnn.output(*outputs)
+
+    def __call__(self, *args, **kwargs):
+        if self._status != TrainingDecoder.AFTER_DECODER:
+            raise ValueError(
+                "Output of training decoder can only be visited outside "
+                "the block.")
+        return self._dynamic_rnn(*args, **kwargs)
+
+    def _assert_in_decoder_block(self, method):
+        if self._status != TrainingDecoder.IN_DECODER:
+            raise ValueError(
+                f"{method} should be invoked inside block of "
+                "TrainingDecoder object.")
+
+
+class BeamSearchDecoder:
+    """Beam-search decode driven by the same state cell (reference :523).
+
+    Dense re-spec: init_ids [B, 1] int64 and init_scores [B, 1] float32
+    (one live beam per batch element to start); states are kept flat
+    [B*beam, D].  decode() unrolls max_len steps; __call__() returns
+    (translation_ids [B, beam, T], translation_scores [B, beam])."""
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim, input_var_dict=None, topk_size=50,
+                 sparse_emb=True, max_len=100, beam_size=1, end_id=1,
+                 name=None):
+        self._state_cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = target_dict_dim
+        self._word_dim = word_dim
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = topk_size
+        self._sparse_emb = sparse_emb
+        self._max_len = int(max_len)
+        self._beam_size = int(beam_size)
+        self._end_id = int(end_id)
+        self.name = name
+        self._decoded = None
+
+    def decode(self):
+        """Build the unrolled decode program (reference :653).
+
+        Parameter sharing across the unrolled steps: each step is built
+        under an identical unique_name counter snapshot, so every step
+        regenerates the SAME parameter names (embedding table, score fc,
+        and whatever the user's state updater creates) — one shared set
+        of weights, exactly like ops re-executing inside the reference's
+        While block.  Cross-step values (selected ids/parents, states)
+        are snapshotted into fresh outer-named vars with assign so the
+        collected outputs stay distinct."""
+        from paddle_tpu import layers, unique_name
+        from paddle_tpu.layers.helper import LayerHelper
+
+        sc = self._state_cell
+        sc._reset()
+        K = self._beam_size
+        # expand the single live beam to K beams: ids/scores [B, K]
+        prev_ids = layers.expand(
+            layers.reshape(self._init_ids, shape=[-1, 1]),
+            expand_times=[1, K])
+        # only beam 0 is live initially; others at -inf so the first
+        # beam_search step selects from beam 0's continuations
+        neg = layers.fill_constant_batch_size_like(
+            input=self._init_scores, shape=[-1, K], value=-1e9,
+            dtype="float32")
+        first = layers.reshape(self._init_scores, shape=[-1, 1])
+        prev_scores = layers.concat(
+            [first, layers.slice(neg, axes=[1], starts=[1], ends=[K])],
+            axis=1)
+        # states: expand [B, D] -> [B*K, D]
+        for name in sc._state_names:
+            st = sc.get_state(name)
+            st = layers.expand(layers.unsqueeze(st, axes=[1]),
+                               expand_times=[1, K, 1])
+            sc.set_state(name, layers.reshape(
+                st, shape=[-1, int(st.shape[-1])]))
+
+        step_ids, step_parents = [], []
+        for _ in range(self._max_len):
+            # every step rebuilds under a fresh 'bsd_step' name guard, so
+            # all steps generate IDENTICAL (prefixed) names: parameters
+            # are shared across the unroll, and the prefix keeps step
+            # names from colliding with outer vars
+            step_guard = unique_name.guard("bsd_step")
+            step_guard.__enter__()
+            ids_flat = layers.reshape(prev_ids, shape=[-1, 1])
+            emb = layers.embedding(
+                ids_flat, size=[self._target_dict_dim, self._word_dim],
+                dtype="float32", is_sparse=self._sparse_emb,
+                param_attr=None)
+            feed = {}
+            for input_name in sc._inputs:
+                feed[input_name] = self._input_var_dict.get(
+                    input_name, emb)
+            sc.compute_state(inputs=feed)
+            cur = sc.out_state()
+            scores = layers.fc(cur, size=self._target_dict_dim,
+                               act="softmax")
+            log_probs = layers.log(scores)
+            probs_bkv = layers.reshape(
+                log_probs, shape=[-1, K, self._target_dict_dim])
+            helper = LayerHelper("beam_search_step")
+            sel_ids = helper.create_variable_for_type_inference("int64")
+            sel_scores = helper.create_variable_for_type_inference(
+                "float32")
+            parent_idx = helper.create_variable_for_type_inference(
+                "int64")
+            helper.append_op(
+                type="beam_search",
+                inputs={"pre_ids": prev_ids, "pre_scores": prev_scores,
+                        "scores": probs_bkv},
+                outputs={"selected_ids": sel_ids,
+                         "selected_scores": sel_scores,
+                         "parent_idx": parent_idx},
+                attrs={"beam_size": K, "end_id": self._end_id,
+                       "level": 0})
+            # gather states by parent beam: [B, K, D] indexed at parent
+            gathered = {}
+            for name in sc._state_names:
+                st = sc.get_state(name)
+                d = int(st.shape[-1])
+                st_bkd = layers.reshape(st, shape=[-1, K, d])
+                picked = _gather_by_parent(st_bkd, parent_idx)
+                gathered[name] = layers.reshape(picked, shape=[-1, d])
+            # back to outer names: snapshot everything that crosses steps
+            step_guard.__exit__(None, None, None)
+            for name, val in gathered.items():
+                sc.set_state(name, layers.assign(val))
+            sel_ids = layers.assign(sel_ids)
+            sel_scores = layers.assign(sel_scores)
+            parent_idx = layers.assign(parent_idx)
+            step_ids.append(sel_ids)
+            step_parents.append(parent_idx)
+            prev_ids, prev_scores = sel_ids, sel_scores
+
+        ids_tbk = layers.stack(step_ids, axis=0)        # [T, B, K]
+        parents_tbk = layers.stack(step_parents, axis=0)
+        helper = LayerHelper("beam_search_decode")
+        sent_ids = helper.create_variable_for_type_inference("int64")
+        sent_scores = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="beam_search_decode",
+            inputs={"Ids": ids_tbk, "Parents": parents_tbk,
+                    "Scores": prev_scores},
+            outputs={"SentenceIds": sent_ids,
+                     "SentenceScores": sent_scores},
+            attrs={"beam_size": K, "end_id": self._end_id})
+        self._decoded = (sent_ids, sent_scores)
+
+    def early_stop(self):
+        """No-op in the dense re-spec: finished beams freeze inside the
+        beam_search op (the reference short-circuits its While loop)."""
+
+    def __call__(self):
+        if self._decoded is None:
+            raise ValueError("decode() must be called before the decoder")
+        return self._decoded
+
+
+def _gather_by_parent(st_bkd, parent_idx):
+    """new_state[b, k] = st_bkd[b, parent_idx[b, k]] via gather_nd."""
+    from paddle_tpu import layers
+
+    b_idx = layers.expand(
+        layers.unsqueeze(_batch_range_like(parent_idx), axes=[1]),
+        expand_times=[1, int(parent_idx.shape[1])])
+    idx = layers.stack([b_idx, parent_idx], axis=-1)   # [B, K, 2]
+    return layers.gather_nd(st_bkd, idx)
+
+
+def _batch_range_like(x):
+    """[B] int64 0..B-1 with the batch size of x (dense helper)."""
+    from paddle_tpu import layers
+
+    ones = layers.fill_constant_batch_size_like(
+        input=x, shape=[-1], value=1, dtype="int64")
+    csum = layers.cumsum(ones, axis=0)
+    return layers.elementwise_sub(
+        csum, layers.fill_constant(shape=[1], dtype="int64", value=1))

@@ -1,0 +1,88 @@
+"""Decoupled weight decay (AdamW-style) optimizer extension (reference
+python/paddle/fluid/contrib/extend_optimizer/
+extend_optimizer_with_weight_decay.py:20,102).
+
+new_param = optimized_param - old_param * coeff, applied as program ops so
+it rides the same compiled step as the base optimizer (arXiv 1711.05101).
+"""
+
+from __future__ import annotations
+
+__all__ = ["DecoupledWeightDecay", "extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay:
+    """Mixin over an Optimizer subclass (reference :20).  The decay uses
+    the PRE-update parameter value, captured before apply_gradients."""
+
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None, **kwargs):
+        from paddle_tpu.core.program import VarDesc
+
+        if not isinstance(coeff, float) and not isinstance(coeff, VarDesc):
+            raise TypeError("coeff should be float or Variable.")
+        self._params_name = set()
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._coeff = coeff
+        super().__init__(**kwargs)
+
+    def _scale_parameters(self, params_and_grads):
+        """Snapshot param*coeff before the optimizer update (reference
+        :30 _scale_parameters)."""
+        from paddle_tpu import layers
+
+        if isinstance(self._coeff, float) and self._coeff == 0.0:
+            return []
+        scaled_params = []
+        for param, grad in params_and_grads:
+            if grad is None:
+                continue
+            if self._apply_decay_param_fun is not None \
+                    and not self._apply_decay_param_fun(param.name):
+                continue
+            assert param.name not in self._params_name
+            scaled = layers.scale(param, scale=self._coeff) \
+                if isinstance(self._coeff, float) else \
+                layers.elementwise_mul(param, self._coeff)
+            scaled_params.append((param, grad, scaled))
+            self._params_name.add(param.name)
+        return scaled_params
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from paddle_tpu import layers
+
+        params_grads = self.backward(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        # capture pre-update scaled params BEFORE the optimizer writes
+        scaled_params = self._scale_parameters(params_grads)
+        optimize_ops = self.apply_gradients(params_grads)
+        # then subtract the decay term from the updated params
+        for param, grad, scaled in scaled_params:
+            updated = layers.elementwise_sub(x=param, y=scaled)
+            layers.assign(updated, output=param)
+        return optimize_ops, params_grads
+
+    def __str__(self):
+        return " ".join(["Weight Decay, params:",
+                         ",".join(sorted(self._params_name))])
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Class decorator: AdamW = extend_with_decoupled_weight_decay(Adam);
+    AdamW(learning_rate=..., weight_decay=0.01) (reference :102)."""
+    from paddle_tpu.optimizer import Optimizer
+
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, Optimizer)):
+        raise TypeError(
+            "The input(base_optimizer) should be a derived class of "
+            "Optimizer.")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay, apply_decay_param_fun=None,
+                     **kwargs):
+            super().__init__(weight_decay, apply_decay_param_fun, **kwargs)
+
+    return OptimizerWithDecoupledWeightDecay

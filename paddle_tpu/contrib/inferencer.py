@@ -1,0 +1,62 @@
+"""Inferencer high-level API (reference
+python/paddle/fluid/contrib/inferencer.py:31): rebuild the inference net
+from a function, load params saved by Trainer.save_params / io.save_params,
+and run feeds through it.  `parallel=True` compiles the program through
+CompiledProgram (whole-net XLA jit) instead of the interpreted path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer:
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        from paddle_tpu import framework, io, unique_name
+        from paddle_tpu.core.executor import Executor
+        from paddle_tpu.core.scope import Scope
+
+        self.param_path = param_path
+        self.scope = Scope()
+        self.parallel = parallel
+        self.place = place
+
+        self.inference_program = framework.Program()
+        startup = framework.Program()
+        with framework.program_guard(self.inference_program, startup):
+            with unique_name.guard():
+                self.predict_var = infer_func()
+
+        with self._prog_and_scope_guard():
+            io.load_params(Executor(self.place), param_path,
+                           main_program=self.inference_program)
+
+        self.exe = Executor(self.place)
+        self.inference_program = self.inference_program.clone(for_test=True)
+        if parallel:
+            from paddle_tpu.core.compiler import CompiledProgram
+
+            self._run_program = CompiledProgram(self.inference_program)
+        else:
+            self._run_program = self.inference_program
+
+    def infer(self, inputs, return_numpy=True):
+        """inputs: {feed_name: ndarray} -> [predict] (reference :80)."""
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        with self._prog_and_scope_guard():
+            return self.exe.run(self._run_program, feed=inputs,
+                                fetch_list=[self.predict_var.name],
+                                return_numpy=return_numpy)
+
+    @contextlib.contextmanager
+    def _prog_and_scope_guard(self):
+        from paddle_tpu import framework
+        from paddle_tpu.core.scope import scope_guard
+
+        with framework.program_guard(main_program=self.inference_program):
+            with scope_guard(self.scope):
+                yield

@@ -1,0 +1,10 @@
+"""Contrib layers (reference python/paddle/fluid/contrib/layers/):
+fused_elemwise_activation + the basic multi-layer/bidirectional RNNs.
+"""
+
+from paddle_tpu.contrib.layers import nn  # noqa: F401
+from paddle_tpu.contrib.layers.nn import *  # noqa: F401,F403
+from paddle_tpu.contrib.layers import rnn_impl  # noqa: F401
+from paddle_tpu.contrib.layers.rnn_impl import *  # noqa: F401,F403
+
+__all__ = list(nn.__all__) + list(rnn_impl.__all__)

@@ -1,0 +1,37 @@
+"""Contrib nn layers (reference python/paddle/fluid/contrib/layers/nn.py:29
+fused_elemwise_activation).  The op itself lives in ops/fused_ops.py; the
+main layers namespace already generates the layer function — re-exported
+here so `fluid.contrib.layers.fused_elemwise_activation` resolves like the
+reference path.
+"""
+
+from __future__ import annotations
+
+__all__ = ["fused_elemwise_activation"]
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """out = Unary(Binary(x, y)) or Binary(x, Unary(y)) (reference
+    contrib/layers/nn.py:29).  functor_list e.g.
+    ['elementwise_add', 'relu'] or ['relu', 'elementwise_add']."""
+    from paddle_tpu.layers.helper import LayerHelper
+
+    if isinstance(functor_list, str):
+        functor_list = functor_list.split(",")
+    if not isinstance(functor_list, list) or len(functor_list) != 2:
+        raise ValueError(
+            "functor_list should be a list of str, and the length should "
+            "be 2.")
+    helper = LayerHelper("fused_elemwise_activation")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    intermediate_out = helper.create_variable_for_type_inference(
+        dtype=x.dtype)
+    helper.append_op(
+        type="fused_elemwise_activation",
+        inputs={"X": x, "Y": y},
+        outputs={"Out": out, "IntermediateOut": intermediate_out},
+        attrs={"axis": axis, "scale": scale,
+               "save_intermediate_out": save_intermediate_out,
+               "functor_list": functor_list})
+    return out

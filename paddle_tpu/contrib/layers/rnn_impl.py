@@ -1,0 +1,252 @@
+"""Multi-layer / bidirectional GRU and LSTM built from basic units
+(reference python/paddle/fluid/contrib/layers/rnn_impl.py:22 BasicGRUUnit,
+:139 basic_gru, :353 basic_lstm, :622 BasicLSTMUnit).
+
+TPU re-specification: the reference unrolls BasicGRUUnit/BasicLSTMUnit
+per time step inside a StaticRNN (host-built unrolled program).  Here each
+(layer, direction) becomes ONE fusion_gru / fusion_lstm op — a single
+lax.scan (XLA While) with the x-projection fused in — so a 4-layer bidir
+GRU is 8 scan ops instead of thousands of unrolled ops, and the math
+(gate order u,r,c; h = u*h_prev + (1-u)*c, i.e. origin_mode) matches the
+reference unit equations.
+"""
+
+from __future__ import annotations
+
+__all__ = ["BasicGRUUnit", "basic_gru", "BasicLSTMUnit", "basic_lstm"]
+
+
+def _unit_params(helper, name, input_size, hidden_size, gates, dtype,
+                 param_attr, bias_attr):
+    """(WeightX [in, gates*D], WeightH [D, gates*D], Bias [gates*D])."""
+    wx = helper.create_parameter(
+        attr=param_attr, shape=[input_size, gates * hidden_size],
+        dtype=dtype)
+    wh = helper.create_parameter(
+        attr=param_attr, shape=[hidden_size, gates * hidden_size],
+        dtype=dtype)
+    b = helper.create_parameter(
+        attr=bias_attr, shape=[gates * hidden_size], dtype=dtype,
+        is_bias=True)
+    return wx, wh, b
+
+
+class _BasicUnit:
+    """Single-step cell exposing the reference Layer-ish call API."""
+
+    GATES = None
+    OP = None
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        self._name = name_scope
+        self._hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_activation = gate_activation or "sigmoid"
+        self._activation = activation or "tanh"
+        self._forget_bias = forget_bias
+        self._dtype = dtype
+        self._built = False
+
+    def _build_once(self, input_size):
+        from paddle_tpu.layers.helper import LayerHelper
+
+        helper = LayerHelper(self._name)
+        self._helper = helper
+        self.wx, self.wh, self.b = _unit_params(
+            helper, self._name, input_size, self._hidden_size,
+            self.GATES, self._dtype, self._param_attr, self._bias_attr)
+        self._built = True
+
+
+class BasicGRUUnit(_BasicUnit):
+    """reference rnn_impl.py:22 — one GRU step:
+    u = sigmoid(x Wu + h Wuh + bu); r = sigmoid(...);
+    c = tanh(x Wc + (r*h) Wch + bc); h = u*h_prev + (1-u)*c."""
+
+    GATES = 3
+
+    def __call__(self, input, pre_hidden):
+        from paddle_tpu.layers.helper import LayerHelper
+
+        if not self._built:
+            self._build_once(int(input.shape[-1]))
+        helper = LayerHelper(self._name + "_step")
+        # pre-project x once, then one gru_unit op
+        from paddle_tpu import layers
+
+        g = layers.elementwise_add(
+            layers.matmul(input, self.wx), self.b)
+        gate = helper.create_variable_for_type_inference(self._dtype)
+        rhp = helper.create_variable_for_type_inference(self._dtype)
+        hidden = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            type="gru_unit",
+            inputs={"Input": g, "HiddenPrev": pre_hidden,
+                    "Weight": self.wh},
+            outputs={"Gate": gate, "ResetHiddenPrev": rhp,
+                     "Hidden": hidden},
+            attrs={"activation": self._activation,
+                   "gate_activation": self._gate_activation,
+                   "origin_mode": True})
+        return hidden
+
+
+class BasicLSTMUnit(_BasicUnit):
+    """reference rnn_impl.py:622 — one LSTM step with forget_bias."""
+
+    GATES = 4
+
+    def __call__(self, input, pre_hidden, pre_cell):
+        from paddle_tpu import layers
+        from paddle_tpu.layers.helper import LayerHelper
+
+        if not self._built:
+            self._build_once(int(input.shape[-1]))
+        helper = LayerHelper(self._name + "_step")
+        # pre-project x and h; lstm_unit consumes the summed gate input
+        # (lstm_unit_op.cc contract: X [B, 4D], C_prev [B, D])
+        g = layers.elementwise_add(
+            layers.elementwise_add(layers.matmul(input, self.wx),
+                                   layers.matmul(pre_hidden, self.wh)),
+            self.b)
+        cell = helper.create_variable_for_type_inference(self._dtype)
+        hidden = helper.create_variable_for_type_inference(self._dtype)
+        helper.append_op(
+            type="lstm_unit",
+            inputs={"X": g, "C_prev": pre_cell},
+            outputs={"C": cell, "H": hidden},
+            attrs={"forget_bias": float(self._forget_bias)})
+        return hidden, cell
+
+
+def _run_fused_rnn(op_type, x, hidden_size, num_layers, sequence_length,
+                   dropout_prob, bidirectional, batch_first, param_attr,
+                   bias_attr, gate_activation, activation, dtype, name,
+                   init_hidden=None, init_cell=None, forget_bias=1.0):
+    from paddle_tpu import layers
+    from paddle_tpu.layers.helper import LayerHelper
+
+    gates = 3 if op_type == "fusion_gru" else 4
+    if not batch_first:
+        x = layers.transpose(x, [1, 0, 2])  # -> [B, T, D]
+    dirs = 2 if bidirectional else 1
+    last_hiddens, last_cells = [], []
+    inp = x
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            lname = f"{name}_l{layer}" + ("_rev" if d else "")
+            helper = LayerHelper(lname)
+            input_size = int(inp.shape[-1])
+            wx, wh, b = _unit_params(helper, lname, input_size,
+                                     hidden_size, gates, dtype,
+                                     param_attr, bias_attr)
+            bias_in = b
+            if op_type == "fusion_lstm" and forget_bias:
+                # fold the reference BasicLSTMUnit forget_bias into the
+                # f-gate quarter of the bias — gate order is c,i,f,o
+                # (ops/rnn_ops.py _lstm_scan), so the third quarter:
+                # f = sigmoid(pre + b_f + forget_bias)
+                fb = layers.concat([
+                    layers.fill_constant([2 * hidden_size], "float32",
+                                         0.0),
+                    layers.fill_constant([hidden_size], "float32",
+                                         float(forget_bias)),
+                    layers.fill_constant([hidden_size], "float32", 0.0)],
+                    axis=0)
+                bias_in = layers.elementwise_add(b, fb)
+            ins = {"X": inp, "WeightX": wx, "WeightH": wh,
+                   "Bias": bias_in}
+            if sequence_length is not None:
+                ins["Length"] = sequence_length
+            idx = layer * dirs + d
+            if init_hidden is not None:
+                ins["H0"] = layers.slice(
+                    init_hidden, axes=[0], starts=[idx], ends=[idx + 1])
+                ins["H0"] = layers.squeeze(ins["H0"], axes=[0])
+            attrs = {"is_reverse": bool(d),
+                     "gate_activation": gate_activation or "sigmoid"}
+            outs_map = {}
+            hidden = helper.create_variable_for_type_inference(dtype)
+            outs_map["Hidden"] = hidden
+            if op_type == "fusion_gru":
+                attrs["activation"] = activation or "tanh"
+                attrs["origin_mode"] = True  # reference unit equations
+            else:
+                if init_cell is not None:
+                    ins["C0"] = layers.squeeze(layers.slice(
+                        init_cell, axes=[0], starts=[idx],
+                        ends=[idx + 1]), axes=[0])
+                attrs["use_peepholes"] = False
+                attrs["cell_activation"] = activation or "tanh"
+                attrs["candidate_activation"] = activation or "tanh"
+                cell = helper.create_variable_for_type_inference(dtype)
+                outs_map["Cell"] = cell
+            helper.append_op(type=op_type, inputs=ins, outputs=outs_map,
+                             attrs=attrs)
+            outs.append(hidden)
+            # last step state.  The ops flip the reverse-direction output
+            # back to original time order, so the reverse pass's final
+            # (whole-sequence) state sits at time index 0 — for any
+            # sequence_length, since reverse padding is consumed first.
+            def _final_state(seq_out):
+                if d:  # reverse direction
+                    return layers.slice(seq_out, axes=[1], starts=[0],
+                                        ends=[1])
+                if sequence_length is not None:
+                    return layers.sequence_pool(
+                        seq_out, pool_type="last",
+                        seq_len=sequence_length)
+                return layers.slice(seq_out, axes=[1],
+                                    starts=[int(x.shape[1]) - 1],
+                                    ends=[int(x.shape[1])])
+
+            last_hiddens.append(_final_state(hidden))
+            if op_type == "fusion_lstm":
+                last_cells.append(_final_state(cell))
+        inp = outs[0] if dirs == 1 else layers.concat(outs, axis=-1)
+        if dropout_prob and layer < num_layers - 1:
+            inp = layers.dropout(inp, dropout_prob=dropout_prob)
+    rnn_out = inp
+    if not batch_first:
+        rnn_out = layers.transpose(rnn_out, [1, 0, 2])
+    last_hidden = layers.concat(
+        [layers.reshape(h, shape=[1, -1, hidden_size])
+         for h in last_hiddens], axis=0)
+    if op_type == "fusion_gru":
+        return rnn_out, last_hidden
+    last_cell = layers.concat(
+        [layers.reshape(c, shape=[1, -1, hidden_size])
+         for c in last_cells], axis=0)
+    return rnn_out, last_hidden, last_cell
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """reference rnn_impl.py:139 — returns (rnn_out, last_hidden)."""
+    return _run_fused_rnn(
+        "fusion_gru", input, hidden_size, num_layers, sequence_length,
+        dropout_prob, bidirectional, batch_first, param_attr, bias_attr,
+        gate_activation, activation, dtype, name,
+        init_hidden=init_hidden)
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0,
+               bidirectional=False, batch_first=True, param_attr=None,
+               bias_attr=None, gate_activation=None, activation=None,
+               forget_bias=1.0, dtype="float32", name="basic_lstm"):
+    """reference rnn_impl.py:353 — returns (rnn_out, last_hidden,
+    last_cell)."""
+    return _run_fused_rnn(
+        "fusion_lstm", input, hidden_size, num_layers, sequence_length,
+        dropout_prob, bidirectional, batch_first, param_attr, bias_attr,
+        gate_activation, activation, dtype, name,
+        init_hidden=init_hidden, init_cell=init_cell,
+        forget_bias=forget_bias)

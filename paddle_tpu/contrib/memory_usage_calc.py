@@ -1,0 +1,78 @@
+"""Estimate a program's variable memory footprint (reference
+python/paddle/fluid/contrib/memory_usage_calc.py:46 memory_usage).
+
+Sums every output var's bytes over block 0 with the batch dim substituted;
+the 5%-10% slack band matches the reference.  On TPU the real number is
+XLA's buffer assignment (peak HBM), so this is a pre-compile estimate the
+way the reference's is a pre-run estimate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["memory_usage"]
+
+DEBUG = False
+
+_DTYPE_TO_SIZE = {
+    "float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+    "int16": 2, "int32": 4, "int64": 8, "int8": 1, "uint8": 1, "bool": 1,
+}
+
+
+def memory_usage(program, batch_size):
+    """Returns (min_total, max_total, unit_str) like the reference."""
+    from paddle_tpu.framework import Program
+
+    if not isinstance(program, Program):
+        raise TypeError(
+            "Calculating Memory Usage requires Program as its Parameter."
+            "But you passed in %s" % (type(program)))
+    if batch_size <= 0:
+        raise ValueError("The batch size need to be positive.")
+
+    total_memory = 0.0
+    seen = set()
+    block = program.global_block()
+    for op in block.ops:
+        for names in op.outputs.values():
+            for var_name in names:
+                if var_name in seen or not block.has_var(var_name):
+                    continue
+                seen.add(var_name)
+                var = block.var(var_name)
+                if var.shape is None or var.dtype is None:
+                    continue
+                data_count = 1
+                neg_dim_count = 0
+                for x in var.shape:
+                    if x is None:
+                        continue
+                    if x < 0:
+                        if neg_dim_count >= 1:
+                            raise ValueError(
+                                "Var %s has more than one negative dim."
+                                % var_name)
+                        neg_dim_count += 1
+                        data_count *= batch_size * (-x)
+                    else:
+                        data_count *= x
+                size = _DTYPE_TO_SIZE.get(str(np.dtype(var.dtype))
+                                          if var.dtype != "bfloat16"
+                                          else "bfloat16", 4)
+                var_memory = data_count * size
+                if DEBUG:
+                    print("%s memory usage: %d" % (var_name, var_memory))
+                total_memory += var_memory
+    if DEBUG:
+        print("total memory usage: %.2f" % total_memory)
+
+    unit_str = "B"
+    if total_memory > 1024:
+        total_memory /= 1024
+        unit_str = "KB"
+        if total_memory > 1024:
+            total_memory /= 1024
+            unit_str = "MB"
+    return total_memory * 1.05, total_memory * 1.1, unit_str

@@ -1,0 +1,137 @@
+"""Model PARAMs/FLOPs summary (reference
+python/paddle/fluid/contrib/model_stat.py:40 summary + :69 _summary_model):
+walks the program's conv/pool/mul/activation/batch_norm ops and prints a
+per-layer table with totals.  Shapes follow the op descs, so it works on
+both NCHW programs and nhwc_transpile'd ones (layout detected per conv).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+__all__ = ["summary"]
+
+
+def summary(main_prog):
+    """Print (and return) the per-op PARAMs/FLOPs table."""
+    collected_ops_list = []
+    for one_b in main_prog.blocks:
+        for one_op in one_b.ops:
+            spf_res = _summary_model(one_b, one_op)
+            if spf_res is None:
+                continue
+            op_info = OrderedDict()
+            op_info["type"] = one_op.type
+            op_info["input_shape"] = tuple(spf_res[0][1:])
+            op_info["out_shape"] = tuple(spf_res[1][1:])
+            op_info["PARAMs"] = spf_res[2]
+            op_info["FLOPs"] = spf_res[3]
+            collected_ops_list.append(op_info)
+    table, total = _format_summary(collected_ops_list)
+    _print_summary(table, total)
+    return collected_ops_list
+
+
+def _shape(block, name):
+    return tuple(block.var(name).shape or ())
+
+
+def _in(op, slot):
+    names = op.inputs.get(slot) or []
+    return names[0] if names else None
+
+
+def _out(op, slot):
+    names = op.outputs.get(slot) or []
+    return names[0] if names else None
+
+
+def _summary_model(block, one_op):
+    """(in_shape, out_shape, params, flops) per op, or None if the op type
+    is not counted (reference _summary_model:69)."""
+    t = one_op.type
+    if t in ("conv2d", "depthwise_conv2d"):
+        k = _shape(block, _in(one_op, "Filter"))
+        in_shape = _shape(block, _in(one_op, "Input"))
+        out_shape = _shape(block, _out(one_op, "Output"))
+        c_out, c_in, k_h, k_w = k
+        nhwc = one_op.attrs.get("data_format") == "NHWC"
+        if nhwc:
+            h_out, w_out = out_shape[1], out_shape[2]
+        else:
+            h_out, w_out = out_shape[2], out_shape[3]
+        groups = one_op.attrs.get("groups", 1) or 1
+        kernel_ops = k_h * k_w * (c_in / groups)
+        bias_ops = 0 if not one_op.inputs.get("Bias") else 1
+        params = c_out * (kernel_ops + bias_ops)
+        flops = 2 * h_out * w_out * c_out * (kernel_ops + bias_ops)
+    elif t == "pool2d":
+        in_shape = _shape(block, _in(one_op, "X"))
+        out_shape = _shape(block, _out(one_op, "Out"))
+        if one_op.attrs.get("data_format") == "NHWC":
+            h_out, w_out, c_out = out_shape[1], out_shape[2], out_shape[3]
+        else:
+            c_out, h_out, w_out = out_shape[1], out_shape[2], out_shape[3]
+        k_size = one_op.attrs.get("ksize", [1, 1])
+        params = 0
+        flops = h_out * w_out * c_out * (k_size[0] * k_size[1])
+    elif t in ("mul", "matmul"):
+        yname = _in(one_op, "Y")
+        k = _shape(block, yname)
+        in_shape = _shape(block, _in(one_op, "X"))
+        out_shape = _shape(block, _out(one_op, "Out"))
+        if len(k) != 2:
+            return None
+        k_in, k_out = k
+        params = k_in * k_out + 1  # bias lands in the following add
+        flops = k_in * k_out
+    elif t in ("sigmoid", "tanh", "relu", "leaky_relu", "prelu"):
+        in_shape = _shape(block, _in(one_op, "X"))
+        out_shape = _shape(block, _out(one_op, "Out"))
+        params = 1 if t == "prelu" else 0
+        flops = 1
+        for d in in_shape:
+            flops *= abs(d) if d else 1
+    elif t == "batch_norm":
+        in_shape = _shape(block, _in(one_op, "X"))
+        out_shape = _shape(block, _out(one_op, "Y"))
+        if one_op.attrs.get("data_layout") == "NHWC" or \
+                one_op.attrs.get("data_format") == "NHWC":
+            c_in = in_shape[-1]
+            h_out, w_out = in_shape[1], in_shape[2]
+        else:
+            c_in = in_shape[1]
+            h_out = in_shape[2] if len(in_shape) > 2 else 1
+            w_out = in_shape[3] if len(in_shape) > 3 else 1
+        params = c_in * 2
+        flops = h_out * w_out * c_in * 2
+    else:
+        return None
+    return in_shape, out_shape, params, flops
+
+
+def _format_summary(collected_ops_list):
+    """reference _format_summary:143 — column table + totals."""
+    summary_table = []
+    total = {"params": 0, "flops": 0}
+    for op in collected_ops_list:
+        summary_table.append(
+            (op["type"], str(op["input_shape"]), str(op["out_shape"]),
+             int(op["PARAMs"]), int(op["FLOPs"])))
+        total["params"] += int(op["PARAMs"])
+        total["flops"] += int(op["FLOPs"])
+    return summary_table, total
+
+
+def _print_summary(summary_table, total):
+    """reference _print_summary:179."""
+    print("-" * 76)
+    print(f"{'TYPE':<20}{'INPUT':<18}{'OUTPUT':<18}"
+          f"{'PARAMs':>10}{'FLOPs':>10}")
+    print("-" * 76)
+    for row in summary_table:
+        print(f"{row[0]:<20}{row[1]:<18}{row[2]:<18}"
+              f"{row[3]:>10}{row[4]:>10}")
+    print("-" * 76)
+    print(f"Total PARAMs: {total['params']} ({total['params']/1e6:.4f}M)")
+    print(f"Total FLOPs:  {total['flops']} ({total['flops']/1e9:.2f}G)")

@@ -1,0 +1,54 @@
+"""Op frequency statistics over a Program (reference
+python/paddle/fluid/contrib/op_frequence.py:23 op_freq_statistic):
+single-op counts plus adjacent producer->consumer pair counts — the quick
+way to see which fusion patterns (XLA or pallas) would pay off.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_2_op_freq): lists of (key, count) sorted
+    by count descending; pair keys are 'producer->consumer'."""
+    from paddle_tpu.framework import Program
+
+    if not isinstance(program, Program):
+        raise TypeError("The input type should be Program."
+                        "But you passed in %s" % (type(program)))
+
+    block = program.global_block()
+    parameters = {v.name for v in block.vars.values()
+                  if getattr(v, "trainable", False)}
+
+    uni_op_freq = OrderedDict()
+    for op in block.ops:
+        produces_non_param = any(
+            n not in parameters
+            for names in op.outputs.values() for n in names)
+        if produces_non_param:
+            uni_op_freq[op.type] = uni_op_freq.get(op.type, 0) + 1
+
+    # producer of each var (last writer wins, like the reference's
+    # var_gen_op[-1])
+    adj_2_op_freq = OrderedDict()
+    var_gen_op = {}
+    for op in block.ops:
+        for names in op.inputs.values():
+            for var_name in names:
+                if var_name in parameters:
+                    continue
+                gen = var_gen_op.get(var_name)
+                if gen:
+                    key = gen[-1] + "->" + op.type
+                    adj_2_op_freq[key] = adj_2_op_freq.get(key, 0) + 1
+        for names in op.outputs.values():
+            for var_name in names:
+                var_gen_op.setdefault(var_name, []).append(op.type)
+
+    uni = sorted(uni_op_freq.items(), key=lambda kv: kv[1], reverse=True)
+    adj = sorted(adj_2_op_freq.items(), key=lambda kv: kv[1], reverse=True)
+    return uni, adj

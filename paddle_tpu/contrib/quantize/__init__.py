@@ -1,0 +1,85 @@
+"""Quantization transpiler API (reference
+python/paddle/fluid/contrib/quantize/quantize_transpiler.py:81
+QuantizeTranspiler: training_transpile / freeze_program / convert_to_int8),
+fronting the slim passes (contrib/slim/quantization.py) so users of the
+reference's contrib.quantize entry point find the same surface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["QuantizeTranspiler"]
+
+
+class QuantizeTranspiler:
+    """reference quantize_transpiler.py:81."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9):
+        quant_types = ("abs_max", "range_abs_max",
+                       "moving_average_abs_max")
+        if activation_quantize_type not in quant_types:
+            raise ValueError(
+                "Unknown activation_quantize_type: %s"
+                % activation_quantize_type)
+        if weight_quantize_type != "abs_max":
+            raise ValueError(
+                "Only abs_max weight quantization is supported, got %s"
+                % weight_quantize_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.window_size = window_size
+        self.moving_rate = moving_rate
+        self._transform = None
+
+    def training_transpile(self, program=None, startup_program=None,
+                           scope=None):
+        """Insert fake quant/dequant ops for QAT (reference :147)."""
+        from paddle_tpu import framework
+        from paddle_tpu.contrib.slim.quantization import \
+            QuantizationTransformPass
+
+        program = program or framework.default_main_program()
+        startup_program = startup_program or \
+            framework.default_startup_program()
+        self._transform = QuantizationTransformPass(
+            scope, self.weight_bits, self.activation_bits,
+            self.activation_quantize_type,
+            startup_program=startup_program)
+        return self._transform.apply(program)
+
+    def freeze_program(self, program, place=None, fuse_bn=False,
+                       scope=None):
+        """Freeze QAT scales into the program for inference
+        (reference :224); fuse_bn folds conv+bn first like the
+        InferenceTranspiler."""
+        from paddle_tpu.contrib.slim.quantization import \
+            QuantizationFreezePass
+        from paddle_tpu.core.scope import global_scope
+
+        scope = scope or global_scope()
+        if fuse_bn:
+            from paddle_tpu.transpiler import InferenceTranspiler
+
+            InferenceTranspiler().transpile(program, place, scope=scope)
+        return QuantizationFreezePass(
+            scope, self.weight_bits).apply(program)
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """Store weights as int8 in the scope and rewrite the program to
+        dequantize-on-entry (reference :354; executes int8 via
+        contrib/slim convert_to_int8_inference)."""
+        from paddle_tpu.contrib.slim.quantization import (
+            convert_to_int8_inference, quantize_weights_abs_max)
+        from paddle_tpu.core.scope import global_scope
+
+        scope = scope or global_scope()
+        quant_weights = quantize_weights_abs_max(
+            program, scope, weight_bits=self.weight_bits)
+        return convert_to_int8_inference(program, scope, quant_weights,
+                                         weight_bits=self.weight_bits)

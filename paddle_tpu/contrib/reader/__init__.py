@@ -1,0 +1,164 @@
+"""Contrib readers (reference python/paddle/fluid/contrib/reader/):
+distributed_batch_reader (multi-process sharding decorator) and ctr_reader
+(threaded csv/svm file reader feeding a PyReader-style queue; the
+reference backs it with the C++ ctr_reader operator, here the native
+blocking queue + reader threads play that role).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import threading
+
+import numpy as np
+
+__all__ = ["distributed_batch_reader", "ctr_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Shard a batch reader across PADDLE_TRAINERS_NUM processes by
+    round-robin batch ownership (reference distributed_reader.py:21)."""
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    trainer_id = int(os.getenv("PADDLE_TRAINER_ID", 0))
+    assert trainer_id < trainers_num
+
+    def decorate_for_multi_process():
+        for batch_id, data in enumerate(batch_reader()):
+            if trainers_num > 1:
+                if batch_id % trainers_num == trainer_id:
+                    yield data
+            else:
+                yield data
+
+    return decorate_for_multi_process
+
+
+def _parse_csv(line, dense_slot_index, sparse_slot_index):
+    """csv: comma-separated; dense slots are floats, sparse slots are
+    space-separated id lists (reference ctr_reader csv format)."""
+    cols = line.rstrip("\n").split(",")
+    sample = []
+    for i, col in enumerate(cols):
+        if i in dense_slot_index:
+            sample.append(np.asarray([float(col)], np.float32))
+        elif i in sparse_slot_index:
+            ids = [int(t) for t in col.split()] or [0]
+            sample.append(np.asarray(ids, np.int64))
+    return sample
+
+
+def _parse_svm(line, *_):
+    """svm: `label idx:val idx:val ...` — label + sparse feature ids
+    (reference ctr_reader svm format)."""
+    parts = line.rstrip("\n").split()
+    label = np.asarray([float(parts[0])], np.float32)
+    ids = [int(p.split(":")[0]) for p in parts[1:]] or [0]
+    return [np.asarray(ids, np.int64), label]
+
+
+def ctr_reader(feed_dict, file_type, file_format, dense_slot_index,
+               sparse_slot_index, capacity, thread_num, batch_size,
+               file_list, slots=None, name=None):
+    """Threaded CTR file reader (reference ctr_reader.py:53): `thread_num`
+    reader threads parse gzip/plain csv/svm files into a bounded queue;
+    the returned object yields {var_name: batch} dicts like the PyReader
+    iterable mode.
+
+    Returns an iterable with .start()/.reset() like the reference reader
+    variable contract.
+    """
+    if file_type not in ("gzip", "plain"):
+        raise ValueError("file_type must be gzip or plain")
+    if file_format not in ("csv", "svm"):
+        raise ValueError("file_format must be csv or svm")
+    parse = _parse_csv if file_format == "csv" else _parse_svm
+    import queue as _pyqueue
+
+    _EOF = object()
+
+    class _CtrReader:
+        def __init__(self):
+            self._queue = None
+            self._threads = []
+            self._files = list(file_list)
+            self._stop = threading.Event()
+
+        def start(self):
+            self._stop.clear()
+            self._queue = _pyqueue.Queue(maxsize=capacity)
+            shards = [self._files[i::thread_num]
+                      for i in range(thread_num)]
+            self._threads = [
+                threading.Thread(target=self._read_shard, args=(sh,),
+                                 daemon=True) for sh in shards]
+            for t in self._threads:
+                t.start()
+            threading.Thread(target=self._close_when_done,
+                             daemon=True).start()
+
+        def _read_shard(self, files):
+            pending = []
+            for path in files:
+                opener = gzip.open if file_type == "gzip" else open
+                with opener(path, "rt") as f:
+                    for line in f:
+                        if self._stop.is_set():
+                            return
+                        pending.append(parse(line, dense_slot_index,
+                                             sparse_slot_index))
+                        if len(pending) == batch_size:
+                            self._push(pending)
+                            pending = []
+            if pending:
+                self._push(pending)
+
+        def _push(self, samples):
+            feed = {}
+            for si, var in enumerate(feed_dict):
+                vals = [s[si] for s in samples]
+                maxlen = max(len(v) for v in vals)
+                if maxlen == min(len(v) for v in vals):
+                    arr = np.stack(vals)
+                else:  # ragged sparse ids: zero-pad (segment re-spec)
+                    arr = np.zeros((len(vals), maxlen), vals[0].dtype)
+                    for i, v in enumerate(vals):
+                        arr[i, :len(v)] = v
+                feed[var.name] = arr
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(feed, timeout=0.1)
+                    return
+                except _pyqueue.Full:
+                    continue
+
+        def _close_when_done(self):
+            for t in self._threads:
+                t.join()
+            self._queue.put(_EOF)
+
+        def reset(self):
+            self._stop.set()
+            if self._queue is not None:
+                # drain so blocked producers can exit
+                try:
+                    while True:
+                        self._queue.get_nowait()
+                except _pyqueue.Empty:
+                    pass
+            for t in self._threads:
+                t.join(timeout=5)
+            self._threads = []
+            self._queue = None
+
+        def __iter__(self):
+            if self._queue is None:
+                self.start()
+            while True:
+                item = self._queue.get()
+                if item is _EOF:
+                    self._queue = None
+                    return
+                yield item
+
+    return _CtrReader()

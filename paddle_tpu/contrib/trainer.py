@@ -1,0 +1,347 @@
+"""Event-driven high-level Trainer / checkpoint config (reference
+python/paddle/fluid/contrib/trainer.py:169 Trainer, :40-100 events,
+:100 CheckpointConfig) and its companion Inferencer lives in
+contrib/inferencer.py.
+
+The reference drives Executor or ParallelExecutor per device; here the
+parallel path is the CompiledProgram data-parallel step (XLA shards the
+batch over the mesh).  PS-mode env-var bootstrapping uses the same
+PADDLE_TRAINING_ROLE/PSERVER env contract via DistributeTranspiler.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "CheckpointConfig", "Trainer",
+           "build_feed_var_list"]
+
+
+class BeginEpochEvent:
+    """reference trainer.py:40."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    """reference trainer.py:52."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    """reference trainer.py:64; set fetch_metrics False to skip fetches
+    for speed."""
+
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    """reference trainer.py:83."""
+
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """reference trainer.py:100 — periodic persistable snapshots with
+    epoch/step resume bookkeeping."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            os.getcwd(), "checkpoints")
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+        self.pserver_id = None
+        self.lookup_table_name = None
+
+
+def build_feed_var_list(program, feed_order):
+    """reference trainer.py:630 — resolve feed var descs from a name list
+    or {name: position} dict."""
+    from paddle_tpu.framework import Program
+
+    if not isinstance(program, Program):
+        raise TypeError("The 'program' should be an object of Program")
+    if feed_order is None:
+        raise ValueError("feed_order=None requires explicit feed names "
+                         "in this implementation — pass a list or dict")
+    if isinstance(feed_order, list):
+        return [program.global_block().var(name) for name in feed_order]
+    if not isinstance(feed_order, dict):
+        raise TypeError("The 'feed_order' should be either None, list or "
+                        "dict.")
+    if sorted(feed_order.values()) != list(range(len(feed_order))):
+        raise ValueError("The values of 'feed_order' should be a "
+                         "permutation of [0, len(feed_order))")
+    return [program.global_block().var(name) for name, _ in
+            sorted(feed_order.items(), key=lambda item: item[1])]
+
+
+class Trainer:
+    """reference trainer.py:169.
+
+    train_func() -> loss var (or [loss, metrics...]); optimizer_func() ->
+    Optimizer.  Events fire around every epoch/step; `parallel=True` runs
+    the step through CompiledProgram.with_data_parallel (XLA mesh DP).
+    """
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        from paddle_tpu import framework, io, unique_name
+        from paddle_tpu.core.executor import Executor
+        from paddle_tpu.core.scope import Scope
+        from paddle_tpu.optimizer import Optimizer
+
+        self.__stop = False
+        self.parallel = parallel
+        self.trainer_id = 0
+        self.checkpoint_cfg = checkpoint_config
+        if self.checkpoint_cfg is not None:
+            assert isinstance(self.checkpoint_cfg, CheckpointConfig)
+            serial = _get_latest_checkpoint_serial(
+                self.checkpoint_cfg.checkpoint_dir)
+            self.checkpoint_cfg.load_serial = serial if serial >= 0 else None
+
+        self.scope = Scope()
+        self.place = place
+        self.startup_program = framework.Program()
+        self.train_program = framework.Program()
+
+        with framework.program_guard(self.train_program,
+                                     self.startup_program):
+            with unique_name.guard():
+                outs = train_func()
+                self.train_func_outputs = outs if isinstance(outs, list) \
+                    else [outs]
+                self.test_program = self.train_program.clone(for_test=True)
+                loss = self.train_func_outputs[0]
+                optimizer = optimizer_func()
+                if not isinstance(optimizer, Optimizer):
+                    raise TypeError(
+                        "The optimizer should be an instance of Optimizer")
+                optimize_ops, params_grads = optimizer.minimize(loss)
+
+        self._dist_transpile_if_necessary(optimize_ops, params_grads)
+
+        with self._prog_and_scope_guard():
+            exe = Executor(self.place)
+            exe.run(self.startup_program)
+            if self.checkpoint_cfg and \
+                    self.checkpoint_cfg.load_serial is not None:
+                self._load_checkpoint(exe)
+            if param_path and os.path.isdir(param_path):
+                io.load_persistables(exe, dirname=param_path,
+                                     main_program=self.train_program)
+        self._compiled = None
+
+    # -- distributed bootstrap (reference :324) ---------------------------
+    def _dist_transpile_if_necessary(self, optimize_ops, params_grads):
+        if "PADDLE_TRAINING_ROLE" not in os.environ:
+            return
+        from paddle_tpu.transpiler import DistributeTranspiler
+
+        port = os.getenv("PADDLE_PSERVER_PORT", "6174")
+        pserver_ips = os.getenv("PADDLE_PSERVER_IPS", "")
+        eplist = [f"{ip}:{port}" for ip in pserver_ips.split(",") if ip]
+        pserver_endpoints = ",".join(eplist)
+        trainers = int(os.getenv("PADDLE_TRAINERS", "1"))
+        current_endpoint = os.getenv("PADDLE_CURRENT_IP", "") + ":" + port
+        self.trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        training_role = os.getenv("PADDLE_TRAINING_ROLE")
+        with self._prog_and_scope_guard():
+            t = DistributeTranspiler()
+            t.transpile(self.trainer_id, program=self.train_program,
+                        pservers=pserver_endpoints, trainers=trainers)
+            if training_role == "PSERVER":
+                self.train_program = t.get_pserver_program(current_endpoint)
+                self.startup_program = t.get_startup_program(
+                    current_endpoint, self.train_program)
+            elif training_role == "TRAINER":
+                self.train_program = t.get_trainer_program()
+            else:
+                raise ValueError(
+                    "TRAINING_ROLE environment variable must be either "
+                    "TRAINER or PSERVER")
+
+    def stop(self):
+        self.__stop = True
+
+    # -- train/test (reference :379,:407) ---------------------------------
+    def train(self, num_epochs, event_handler, reader=None,
+              feed_order=None):
+        from paddle_tpu.core.executor import Executor
+
+        if os.getenv("PADDLE_TRAINING_ROLE", "") == "PSERVER":
+            with self._prog_and_scope_guard():
+                exe = Executor(self.place)
+                exe.run(self.train_program)
+                return
+        self._train_by_executor(num_epochs, event_handler, reader,
+                                feed_order)
+
+    def test(self, reader, feed_order):
+        from paddle_tpu.core.executor import Executor
+        from paddle_tpu.core.scope import scope_guard
+        from paddle_tpu.data_feeder import DataFeeder
+
+        with scope_guard(self.scope):
+            feed_vars = build_feed_var_list(self.test_program, feed_order)
+            feeder = DataFeeder(feed_list=feed_vars, place=self.place)
+            exe = Executor(self.place)
+            import numpy as np
+
+            fetch = [v.name for v in self.train_func_outputs]
+            accumulated = [0.0] * len(fetch)
+            count = 0
+            for data in reader():
+                outs = exe.run(program=self.test_program,
+                               feed=feeder.feed(data), fetch_list=fetch)
+                accumulated = [a + float(np.ravel(o)[0])
+                               for a, o in zip(accumulated, outs)]
+                count += 1
+            return [a / max(count, 1) for a in accumulated]
+
+    def save_params(self, param_path):
+        from paddle_tpu import io
+        from paddle_tpu.core.executor import Executor
+
+        with self._prog_and_scope_guard():
+            io.save_persistables(Executor(self.place), dirname=param_path,
+                                 main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        from paddle_tpu import io
+        from paddle_tpu.core.executor import Executor
+
+        with self._prog_and_scope_guard():
+            targets = [self.train_func_outputs[i]
+                       for i in target_var_indexes]
+            io.save_inference_model(param_path, feeded_var_names, targets,
+                                    Executor(self.place),
+                                    main_program=self.test_program)
+
+    # -- internals --------------------------------------------------------
+    def _prog_and_scope_guard(self):
+        import contextlib
+
+        from paddle_tpu import framework
+        from paddle_tpu.core.scope import scope_guard
+
+        @contextlib.contextmanager
+        def guard():
+            with framework.program_guard(self.train_program,
+                                         self.startup_program):
+                with scope_guard(self.scope):
+                    yield
+
+        return guard()
+
+    def _step_program(self):
+        if not self.parallel:
+            return self.train_program
+        if self._compiled is None:
+            from paddle_tpu.core.compiler import CompiledProgram
+
+            self._compiled = CompiledProgram(
+                self.train_program).with_data_parallel(
+                    loss_name=self.train_func_outputs[0].name)
+        return self._compiled
+
+    def _train_by_executor(self, num_epochs, event_handler, reader,
+                           feed_order):
+        from paddle_tpu.core.executor import Executor
+        from paddle_tpu.data_feeder import DataFeeder
+
+        with self._prog_and_scope_guard():
+            feed_vars = build_feed_var_list(self.train_program, feed_order)
+            feeder = DataFeeder(feed_list=feed_vars, place=self.place)
+            exe = Executor(self.place)
+            cfg = self.checkpoint_cfg
+            start_epoch = cfg.epoch_id if cfg and cfg.load_serial is not \
+                None else 0
+            for epoch_id in range(start_epoch, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stop:
+                        return
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    fetch = [v.name for v in self.train_func_outputs] \
+                        if begin.fetch_metrics else []
+                    metrics = exe.run(self._step_program(),
+                                      feed=feeder.feed(data),
+                                      fetch_list=fetch)
+                    if cfg and step_id % cfg.step_interval == 0 and \
+                            epoch_id % cfg.epoch_interval == 0:
+                        self._save_checkpoint(exe, epoch_id, step_id)
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                event_handler(EndEpochEvent(epoch_id))
+
+    # -- checkpoints (reference trainer.py:655+ private checkpoint API) ---
+    def _ckpt_dir(self, serial):
+        return os.path.join(self.checkpoint_cfg.checkpoint_dir,
+                            str(serial))
+
+    def _save_checkpoint(self, exe, epoch_id, step_id):
+        from paddle_tpu import io
+
+        cfg = self.checkpoint_cfg
+        serial = (cfg.load_serial or 0) + 1
+        d = self._ckpt_dir(serial)
+        os.makedirs(d, exist_ok=True)
+        io.save_persistables(exe, dirname=d,
+                             main_program=self.train_program)
+        with open(os.path.join(d, "_SUCCESS"), "w") as f:
+            f.write(f"{epoch_id} {step_id}")
+        cfg.load_serial = serial
+        cfg.epoch_id, cfg.step_id = epoch_id, step_id
+        # retention: keep the newest max_num_checkpoints
+        serials = sorted(
+            (int(s) for s in os.listdir(cfg.checkpoint_dir)
+             if s.isdigit()), reverse=True)
+        for old in serials[cfg.max_num_checkpoints:]:
+            import shutil
+
+            shutil.rmtree(self._ckpt_dir(old), ignore_errors=True)
+
+    def _load_checkpoint(self, exe):
+        from paddle_tpu import io
+
+        cfg = self.checkpoint_cfg
+        d = self._ckpt_dir(cfg.load_serial)
+        io.load_persistables(exe, dirname=d,
+                             main_program=self.train_program)
+        marker = os.path.join(d, "_SUCCESS")
+        if os.path.exists(marker):
+            with open(marker) as f:
+                parts = f.read().split()
+            if len(parts) == 2:
+                cfg.epoch_id, cfg.step_id = int(parts[0]), int(parts[1])
+
+
+def _get_latest_checkpoint_serial(checkpoint_dir):
+    """Largest serial subdir containing a _SUCCESS marker, else -1
+    (reference trainer.py _get_latest_checkpoint_serial)."""
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return -1
+    best = -1
+    for name in os.listdir(checkpoint_dir):
+        if name.isdigit() and os.path.exists(
+                os.path.join(checkpoint_dir, name, "_SUCCESS")):
+            best = max(best, int(name))
+    return best

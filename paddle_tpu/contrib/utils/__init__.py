@@ -1,0 +1,12 @@
+"""Contrib utils (reference python/paddle/fluid/contrib/utils/):
+HDFSClient shell wrapper + multi_download/multi_upload, and the
+distributed-lookup-table persistence helpers.
+"""
+
+from paddle_tpu.contrib.utils.hdfs_utils import (HDFSClient,  # noqa: F401
+                                                 getfilelist,
+                                                 multi_download,
+                                                 multi_upload)
+from paddle_tpu.contrib.utils.lookup_table_utils import (  # noqa: F401
+    convert_dist_to_sparse_program, load_persistables_for_increment,
+    load_persistables_for_inference)

@@ -1,0 +1,126 @@
+"""Distributed-lookup-table persistence helpers (reference
+python/paddle/fluid/contrib/utils/lookup_table_utils.py:84
+convert_dist_to_sparse_program, :135 load_persistables_for_increment,
+:259 load_persistables_for_inference).
+
+A trainer program produced by DistributeTranspiler with a distributed
+table replaces lookup_table ops with `prefetch` RPC ops (transpiler
+_rewrite_dist_lookups); these helpers turn that program back into a
+locally-runnable one (prefetch -> lookup_sparse_table over a local table
+var) and load pserver-saved shards into it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+__all__ = ["convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+_logger = logging.getLogger(__name__)
+
+
+def _dist_table_info(program):
+    """(table_name, emb_dim, prefetch op list) from the trainer program's
+    prefetch ops; the transpiler stores the table name on the program."""
+    table = getattr(program, "_distributed_lookup_table", None)
+    prefetch_ops = [op for op in program.global_block().ops
+                    if op.type == "prefetch"]
+    if table is None and prefetch_ops:
+        # fall back: derive from the first prefetch's table sections
+        names = prefetch_ops[0].attrs.get("table_names") or []
+        if names:
+            table = names[0].rsplit(".block", 1)[0] \
+                if ".block" in names[0] else names[0]
+    return table, prefetch_ops
+
+
+def convert_dist_to_sparse_program(program):
+    """Replace prefetch RPC ops with local lookup_sparse_table ops over a
+    persistable table var (reference :84).  Mutates and returns the
+    program; returns None if there is no distributed table, like the
+    reference's warning path."""
+    from paddle_tpu.core.program import OpDesc
+
+    table, prefetch_ops = _dist_table_info(program)
+    if not prefetch_ops or table is None:
+        _logger.warning(
+            "There are no distributed lookup tables need to be converted")
+        return None
+    block = program.global_block()
+    emb_dim = int(prefetch_ops[0].attrs["emb_dim"])
+    height = max(int(sec[1]) for op in prefetch_ops
+                 for sec in op.attrs["sections"])
+    if not block.has_var(table):
+        block.create_var(name=table, shape=[height, emb_dim],
+                         dtype="float32", persistable=True)
+    new_ops = []
+    for op in block.ops:
+        if op.type == "prefetch":
+            new_ops.append(OpDesc(
+                "lookup_sparse_table",
+                {"W": [table], "Ids": list(op.inputs["Ids"])},
+                {"Out": list(op.outputs["Out"])},
+                {"padding_idx": int(op.attrs.get("padding_idx", -1)),
+                 "auto_grown_table": False}, op.op_role))
+        elif op.type == "send_sparse_grad":
+            continue  # local program trains densely or not at all
+        else:
+            new_ops.append(op)
+    block.ops = new_ops
+    return program
+
+
+def _load_table_shards(dirname, table):
+    """Concatenate pserver-saved table shard files `<table>.block<i>` (or
+    the whole table file) back into one [height, dim] array."""
+    for whole in (os.path.join(dirname, table),
+                  os.path.join(dirname, table + ".npy")):
+        if os.path.exists(whole):
+            return np.load(whole, allow_pickle=False)
+    shards = sorted(
+        (f for f in os.listdir(dirname)
+         if f.startswith(table + ".block")),
+        key=lambda f: int(f.rsplit("block", 1)[1].removesuffix(".npy")))
+    if not shards:
+        raise FileNotFoundError(
+            f"no saved table '{table}' (or shards) under {dirname}")
+    return np.concatenate(
+        [np.load(os.path.join(dirname, f), allow_pickle=False)
+         for f in shards], axis=0)
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var_name=None,
+                                    lookup_table_var_path=None):
+    """Load a PS checkpoint for continued training (reference :135):
+    ordinary persistables through io.load_persistables, the table from its
+    shard files into the scope."""
+    from paddle_tpu import io
+    from paddle_tpu.core.scope import global_scope
+
+    table, _ = _dist_table_info(program)
+    table = lookup_table_var_name or table
+    io.load_persistables(executor, dirname, main_program=program)
+    if table:
+        src = lookup_table_var_path or dirname
+        arr = _load_table_shards(os.path.dirname(src)
+                                 if os.path.isfile(src) else src,
+                                 os.path.basename(src)
+                                 if os.path.isfile(src) else table)
+        global_scope().var(table).set(arr)
+    return program
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    """Load params + table for a converted inference program
+    (reference :259).  Convert first with
+    convert_dist_to_sparse_program."""
+    return load_persistables_for_increment(
+        dirname, executor, program,
+        lookup_table_var_name=lookup_table_var_name)

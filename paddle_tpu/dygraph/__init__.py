@@ -17,16 +17,25 @@ from paddle_tpu.dygraph.layers import Layer
 from paddle_tpu.dygraph import nn
 from paddle_tpu.dygraph.nn import (
     BatchNorm,
+    BilinearTensorProduct,
     Conv2D,
     Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
     Dropout,
     Embedding,
     FC,
+    GroupNorm,
     GRUUnit,
     LayerNorm,
     Linear,
+    NCE,
     Pool2D,
     PRelu,
+    RowConv,
+    SequenceConv,
+    SpectralNorm,
+    TreeConv,
 )
 from paddle_tpu.dygraph.checkpoint import save_dygraph, load_dygraph
 from paddle_tpu.dygraph.parallel import (
@@ -38,8 +47,10 @@ from paddle_tpu.dygraph.parallel import (
 
 __all__ = [
     "VarBase", "Tracer", "enabled", "guard", "no_grad", "to_variable",
-    "Layer", "nn", "BatchNorm", "Conv2D", "Conv2DTranspose", "Dropout",
-    "Embedding", "FC", "GRUUnit", "LayerNorm", "Linear", "Pool2D", "PRelu",
-    "save_dygraph", "load_dygraph", "DataParallel", "Env", "ParallelEnv",
-    "prepare_context",
+    "Layer", "nn", "BatchNorm", "BilinearTensorProduct", "Conv2D",
+    "Conv2DTranspose", "Conv3D", "Conv3DTranspose", "Dropout",
+    "Embedding", "FC", "GroupNorm", "GRUUnit", "LayerNorm", "Linear",
+    "NCE", "Pool2D", "PRelu", "RowConv", "SequenceConv", "SpectralNorm",
+    "TreeConv", "save_dygraph", "load_dygraph", "DataParallel", "Env",
+    "ParallelEnv", "prepare_context",
 ]

@@ -14,8 +14,10 @@ from paddle_tpu.dygraph.base import VarBase, _current_tracer, Tracer
 from paddle_tpu.dygraph.layers import Layer
 
 __all__ = [
-    "Linear", "FC", "Conv2D", "Conv2DTranspose", "Pool2D", "BatchNorm",
-    "Embedding", "LayerNorm", "Dropout", "GRUUnit", "PRelu",
+    "Linear", "FC", "Conv2D", "Conv2DTranspose", "Conv3D",
+    "Conv3DTranspose", "Pool2D", "BatchNorm", "Embedding", "LayerNorm",
+    "Dropout", "GRUUnit", "PRelu", "NCE", "BilinearTensorProduct",
+    "SequenceConv", "RowConv", "GroupNorm", "SpectralNorm", "TreeConv",
 ]
 
 
@@ -301,3 +303,254 @@ class PRelu(Layer):
         neg = _trace("elementwise_mul",
                      {"X": negx, "Y": self.weight}, {"axis": axis})["Out"]
         return pos - neg
+
+
+class Conv3D(Layer):
+    """reference dygraph/nn.py:257 Conv3D (NCDHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"strides": _triple(stride),
+                       "paddings": _triple(padding),
+                       "dilations": _triple(dilation), "groups": groups}
+        self._act = act
+        fs = _triple(filter_size)
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, fs[0], fs[1], fs[2]],
+            attr=param_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        out = _trace("conv3d", {"Input": x, "Filter": self.weight},
+                     self._attrs)["Output"]
+        if self.bias is not None:
+            out = _trace("elementwise_add",
+                         {"X": out, "Y": self.bias}, {"axis": 1})["Out"]
+        return _act(out, self._act)
+
+
+class Conv3DTranspose(Layer):
+    """reference dygraph/nn.py:454 Conv3DTranspose."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"strides": _triple(stride),
+                       "paddings": _triple(padding),
+                       "dilations": _triple(dilation), "groups": groups}
+        self._act = act
+        fs = _triple(filter_size)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups, fs[0], fs[1], fs[2]],
+            attr=param_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        out = _trace("conv3d_transpose",
+                     {"Input": x, "Filter": self.weight},
+                     self._attrs)["Output"]
+        if self.bias is not None:
+            out = _trace("elementwise_add",
+                         {"X": out, "Y": self.bias}, {"axis": 1})["Out"]
+        return _act(out, self._act)
+
+
+class NCE(Layer):
+    """reference dygraph/nn.py:1569 NCE: noise-contrastive estimation
+    loss head over [Input, Label]."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 seed=0, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._sample_weight = sample_weight
+        self._attrs = {"num_total_classes": int(num_total_classes),
+                       "num_neg_samples": int(num_neg_samples),
+                       "seed": int(seed)}
+        self.weight = self.create_parameter(
+            [num_total_classes, dim], attr=param_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_total_classes], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, sample_weight=None):
+        ins = {"Input": input, "Label": label, "Weight": self.weight}
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        sw = sample_weight if sample_weight is not None else \
+            self._sample_weight
+        if sw is not None:
+            ins["SampleWeight"] = sw
+        return _trace("nce", ins, self._attrs)["Cost"]
+
+
+class BilinearTensorProduct(Layer):
+    """reference dygraph/nn.py:1870: out_k = x W_k y^T + b."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim,
+                 param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=param_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([1, output_dim],
+                                              attr=bias_attr, is_bias=True)
+
+    def forward(self, x, y):
+        ins = {"X": x, "Y": y, "Weight": self.weight}
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        return _act(_trace("bilinear_tensor_product", ins)["Out"],
+                    self._act)
+
+
+class SequenceConv(Layer):
+    """reference dygraph/nn.py:2187 SequenceConv over padded [B, T, D]
+    (+ optional seq_len at call time)."""
+
+    def __init__(self, input_dim, num_filters, filter_size=3,
+                 filter_stride=1, padding=None, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"contextLength": int(filter_size),
+                       "contextStart": -((int(filter_size) - 1) // 2),
+                       "contextStride": int(filter_stride)}
+        self._act = act
+        self.weight = self.create_parameter(
+            [filter_size * input_dim, num_filters], attr=param_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_filters],
+                                              attr=bias_attr, is_bias=True)
+
+    def forward(self, x, seq_len=None):
+        ins = {"X": x, "Filter": self.weight}
+        if seq_len is not None:
+            ins["SeqLen"] = seq_len
+        out = _trace("sequence_conv", ins, self._attrs)["Out"]
+        if self.bias is not None:
+            out = _trace("elementwise_add",
+                         {"X": out, "Y": self.bias}, {"axis": -1})["Out"]
+        return _act(out, self._act)
+
+
+class RowConv(Layer):
+    """reference dygraph/nn.py:2258 RowConv (lookahead conv)."""
+
+    def __init__(self, input_dim, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            [future_context_size + 1, input_dim], attr=param_attr)
+
+    def forward(self, x):
+        return _act(_trace("row_conv",
+                           {"X": x, "Filter": self.weight})["Out"],
+                    self._act)
+
+
+class GroupNorm(Layer):
+    """reference dygraph/nn.py:2334 GroupNorm (NCHW)."""
+
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"groups": int(groups), "epsilon": float(epsilon)}
+        self._act = act
+        self.weight = None
+        self.bias = None
+        if param_attr is not False:
+            from paddle_tpu.initializer import Constant
+
+            self.weight = self.create_parameter(
+                [channels], attr=param_attr,
+                default_initializer=Constant(1.0))
+        if bias_attr is not False:
+            self.bias = self.create_parameter([channels], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        ins = {"X": x}
+        if self.weight is not None:
+            ins["Scale"] = self.weight
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        return _act(_trace("group_norm", ins, self._attrs)["Y"],
+                    self._act)
+
+
+class SpectralNorm(Layer):
+    """reference dygraph/nn.py:2433 SpectralNorm: weight / sigma_max via
+    persistent power-iteration vectors U, V."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"dim": int(dim), "power_iters": int(power_iters),
+                       "eps": float(eps)}
+        import numpy as _np
+
+        h = int(weight_shape[dim])
+        w = int(_np.prod(weight_shape)) // h
+        from paddle_tpu.initializer import Normal
+
+        self.u = self.create_parameter([h], attr=None,
+                                       default_initializer=Normal(0., 1.))
+        self.v = self.create_parameter([w], attr=None,
+                                       default_initializer=Normal(0., 1.))
+        self.u.stop_gradient = True
+        self.v.stop_gradient = True
+
+    def forward(self, weight):
+        outs = _trace("spectral_norm",
+                      {"Weight": weight, "U": self.u, "V": self.v},
+                      self._attrs)
+        # persist the power-iteration state like BatchNorm's running stats
+        self.u.set_value(outs["UOut"].value)
+        self.v.set_value(outs["VOut"].value)
+        return outs["Out"]
+
+
+class TreeConv(Layer):
+    """reference dygraph/nn.py:2533 TreeConv (tree-based convolution on
+    [NodesVector, EdgeSet])."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"max_depth": int(max_depth)}
+        self._act = act
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters], attr=param_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_filters], attr=bias_attr, is_bias=True)
+
+    def forward(self, nodes_vector, edge_set):
+        out = _trace("tree_conv",
+                     {"NodesVector": nodes_vector, "EdgeSet": edge_set,
+                      "Filter": self.weight}, self._attrs)["Out"]
+        if self.bias is not None:
+            out = _trace("elementwise_add",
+                         {"X": out, "Y": self.bias}, {"axis": -1})["Out"]
+        return _act(out, self._act)
+
+
+def _triple(v):
+    return [v, v, v] if isinstance(v, int) else list(v)

@@ -1000,10 +1000,13 @@ def nce(input, label, num_total_classes, sample_weight=None,
                                 "float32", is_bias=True)
     ctr = _sampling_seed_counter(helper)
     out = helper.create_variable_for_type_inference("float32")
+    ins = {"Input": input, "Label": label, "Weight": w, "Bias": b,
+           "SeedOffset": ctr}
+    if sample_weight is not None:
+        ins["SampleWeight"] = sample_weight
     helper.append_op(
         type="nce",
-        inputs={"Input": input, "Label": label, "Weight": w, "Bias": b,
-                "SeedOffset": ctr},
+        inputs=ins,
         outputs={"Cost": out},
         attrs={"num_total_classes": num_total_classes,
                "num_neg_samples": num_neg_samples, "seed": seed},

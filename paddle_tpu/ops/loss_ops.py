@@ -140,8 +140,10 @@ def _sample_ids(seed, offset, k, num_classes):
 
 
 @register_op("nce",
-             inputs=("Input", "Label", "Weight", "Bias", "SeedOffset"),
-             outputs=("Cost",), optional=("Bias", "SeedOffset"),
+             inputs=("Input", "Label", "Weight", "Bias", "SampleWeight",
+                     "SeedOffset"),
+             outputs=("Cost",),
+             optional=("Bias", "SampleWeight", "SeedOffset"),
              attrs={"num_total_classes": REQUIRED, "num_neg_samples": 10,
                     "seed": 0})
 def nce(ins, attrs):
@@ -171,7 +173,12 @@ def nce(ins, attrs):
     corr = math.log(k * q)
     pos = jax.nn.softplus(-(s_true - corr)).sum(axis=1)
     neg = jax.nn.softplus(s_neg - corr).sum(axis=1)
-    return {"Cost": (pos + neg)[:, None]}
+    cost = pos + neg
+    sw = ins.get("SampleWeight")
+    if sw is not None:
+        # reference nce_op.h: per-example weight scales its loss
+        cost = cost * sw.reshape(-1).astype(cost.dtype)
+    return {"Cost": cost[:, None]}
 
 
 @register_op("hierarchical_sigmoid",

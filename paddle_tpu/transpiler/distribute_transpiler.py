@@ -217,6 +217,11 @@ class DistributeTranspiler:
                          attrs={"endpoints": list(eps)},
                          infer_shape=False)
         gb.ops.extend(trainer_opt_ops)
+        if self.dist_tables:
+            # contrib.utils.lookup_table_utils reads this to convert the
+            # prefetch program back to a local sparse-table one (reference
+            # program._distributed_lookup_table)
+            prog._distributed_lookup_table = next(iter(self.dist_tables))
         self.trainer_program = prog
 
     def _rewrite_dist_lookups(self, gb):
