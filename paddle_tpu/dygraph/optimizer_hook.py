@@ -101,11 +101,17 @@ def _lr_value(opt):
 
 
 def _eager_clip(grad_clip, pairs):
-    """Apply a GradientClip* eagerly to [(param, grad_array)] pairs."""
+    """Apply a GradientClip* (or dygraph GradClip*) eagerly to
+    [(param, grad_array)] pairs."""
     import jax.numpy as jnp
 
     from paddle_tpu import clip as C
+    from paddle_tpu import dygraph_grad_clip as DGC
 
+    if isinstance(grad_clip, DGC.GradClipBase):
+        # dygraph_grad_clip classes are already eager callables over
+        # (param, grad) pairs (reference dygraph_grad_clip.py)
+        return grad_clip(pairs)
     if isinstance(grad_clip, C.GradientClipByValue):
         return [(p, jnp.clip(g, grad_clip.min, grad_clip.max))
                 for p, g in pairs]

@@ -1,0 +1,116 @@
+"""Eager gradient clipping for dygraph mode (reference
+python/paddle/fluid/dygraph_grad_clip.py:34 GradClipBase, :46
+GradClipByValue, :120 GradClipByNorm, :191 GradClipByGlobalNorm).
+
+Each clip is a callable over [(param, grad VarBase)] applied between
+loss.backward() and optimizer.minimize(..., grad_clip=clip) — the grads
+are device arrays, so the clip math runs as plain jnp ops (no program
+surgery, matching the reference's eager layers calls).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["GradClipBase", "GradClipByValue", "GradClipByNorm",
+           "GradClipByGlobalNorm"]
+
+
+def _grad_array(g):
+    return g.value if hasattr(g, "value") else g
+
+
+def _rewrap(g, new_value):
+    if hasattr(g, "value"):
+        from paddle_tpu.dygraph.base import VarBase
+
+        return VarBase(new_value, stop_gradient=True)
+    return new_value
+
+
+class GradClipBase:
+    def __str__(self):
+        raise NotImplementedError()
+
+    def _clip(self, para_and_grad):
+        raise NotImplementedError()
+
+    def __call__(self, para_and_grad):
+        return self._clip(para_and_grad)
+
+
+class GradClipByValue(GradClipBase):
+    """Clip every grad element into [min_value, max_value] (reference
+    :46; max_value=None mirrors min into +/-|min|)."""
+
+    def __init__(self, min_value, max_value=None):
+        if max_value is None:
+            max_value = abs(min_value)
+            min_value = -max_value
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def __str__(self):
+        return "ClipByValue, min = %f, max = %f" % (self.min_value,
+                                                    self.max_value)
+
+    def _clip(self, para_and_grad):
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            new_g = jnp.clip(_grad_array(g), self.min_value,
+                             self.max_value)
+            out.append((p, _rewrap(g, new_g)))
+        return out
+
+
+class GradClipByNorm(GradClipBase):
+    """Per-tensor L2-norm clipping (reference :120)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __str__(self):
+        return "ClipByNorm, clip_norm=%f" % self.clip_norm
+
+    def _clip(self, para_and_grad):
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            arr = _grad_array(g)
+            norm = jnp.sqrt(jnp.sum(jnp.square(arr)))
+            scale = jnp.minimum(
+                1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+            out.append((p, _rewrap(g, arr * scale)))
+        return out
+
+
+class GradClipByGlobalNorm(GradClipBase):
+    """Joint global-L2-norm clipping over all grads (reference :191)."""
+
+    def __init__(self, max_global_norm):
+        self.max_global_norm = float(max_global_norm)
+
+    def __str__(self):
+        return "ClipByGlobalNorm, max_global_norm=%f" % (
+            self.max_global_norm)
+
+    def _clip(self, para_and_grad):
+        sq = [jnp.sum(jnp.square(_grad_array(g)))
+              for _, g in para_and_grad if g is not None]
+        if not sq:
+            return list(para_and_grad)
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.max_global_norm / jnp.maximum(
+            global_norm, self.max_global_norm)
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, _rewrap(g, _grad_array(g) * scale)))
+        return out

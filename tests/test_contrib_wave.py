@@ -618,3 +618,108 @@ def test_nce_sample_weight_scales_cost():
         weighted = np.asarray(nce(a, lab, sample_weight=sw).value)
         np.testing.assert_allclose(weighted.ravel(),
                                    base.ravel() * [2.0, 0.5], rtol=1e-5)
+
+
+# --------------------------------- old distributed/ + dygraph grad clip
+
+def test_dygraph_grad_clip_classes():
+    from paddle_tpu.dygraph_grad_clip import (GradClipByGlobalNorm,
+                                              GradClipByNorm,
+                                              GradClipByValue)
+
+    g1 = np.array([3.0, -4.0], np.float32)   # norm 5
+    g2 = np.array([6.0, 8.0], np.float32)    # norm 10
+    pairs = [("p1", g1), ("p2", g2), ("p3", None)]
+
+    out = GradClipByValue(-1.0, 1.0)(pairs)
+    np.testing.assert_allclose(out[0][1], [1.0, -1.0])
+    assert out[2][1] is None
+
+    out = GradClipByNorm(2.5)(pairs)
+    np.testing.assert_allclose(np.linalg.norm(out[0][1]), 2.5, rtol=1e-5)
+    np.testing.assert_allclose(np.linalg.norm(out[1][1]), 2.5, rtol=1e-5)
+
+    out = GradClipByGlobalNorm(5.0)(pairs)
+    gn = np.sqrt(np.linalg.norm(out[0][1]) ** 2 +
+                 np.linalg.norm(out[1][1]) ** 2)
+    np.testing.assert_allclose(gn, 5.0, rtol=1e-5)
+    # relative magnitudes preserved
+    np.testing.assert_allclose(out[1][1] / out[0][1][0] * 3.0,
+                               g2 / g1[0] * 3.0, rtol=1e-5)
+
+
+def test_dygraph_minimize_accepts_gradclip():
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.dygraph import guard, to_variable
+    from paddle_tpu.dygraph_grad_clip import GradClipByGlobalNorm
+    from paddle_tpu.optimizer import SGD
+
+    with guard():
+        from paddle_tpu.dygraph.base import _current_tracer
+        fc = dg.Linear(4, 2)
+        x = to_variable(np.ones((3, 4), np.float32))
+        loss = _current_tracer().trace(
+            "reduce_mean", {"X": fc(x)}, {"reduce_all": True})["Out"]
+        loss.backward()
+        SGD(learning_rate=0.1).minimize(
+            loss, grad_clip=GradClipByGlobalNorm(0.1))
+
+
+def test_downpour_sgd_publishes_fleet_opt():
+    from paddle_tpu.distributed.downpour import DownpourSGD
+
+    with scope_guard(Scope()):
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                ids = layers.data(name="ids", shape=[1], dtype="int64")
+                emb = layers.embedding(ids, size=[100, 8],
+                                       is_distributed=True)
+                dense = layers.fc(emb, size=4)
+                loss = layers.mean(dense)
+                opt_info, skipped = DownpourSGD(
+                    learning_rate=0.1).minimize([loss])
+        assert prog._fleet_opt is opt_info
+        assert opt_info["sparse_tables"] and "lookup_table" in skipped
+        assert any("fc" in n for n in opt_info["dense_tables"])
+        with pytest.raises(ValueError):
+            DownpourSGD().minimize(loss)  # must be a list
+
+
+def test_paddle_ps_instance_roles():
+    from paddle_tpu.distributed.ps_instance import PaddlePSInstance
+
+    # interleaved mode over 2 nodes x 2 procs: ranks 0,2 servers; 1,3 workers
+    roles = [PaddlePSInstance(1, 2, nodes=2, rankid=r) for r in range(4)]
+    assert [i.is_server() for i in roles] == [True, False, True, False]
+    assert [i.is_worker() for i in roles] == [False, True, False, True]
+    assert roles[1].is_first_worker()
+    assert roles[3].get_worker_index() == 1
+    assert roles[0].get_worker_num() == 2
+    # block mode: first block workers, then servers
+    blk = [PaddlePSInstance(0, 2, nodes=2, rankid=r) for r in range(4)]
+    assert [i.is_worker() for i in blk] == [True, True, False, False]
+    assert [i.is_server() for i in blk] == [False, False, True, True]
+    blk[0].barrier_all()  # no endpoint: no-op, must not raise
+
+
+def test_paddle_ps_instance_indices_consistent():
+    """Review regressions: block-mode indices follow the block layout;
+    interleaved indices are unique for proc_per_node > 2."""
+    from paddle_tpu.distributed.ps_instance import PaddlePSInstance
+
+    blk = [PaddlePSInstance(0, 2, nodes=2, rankid=r) for r in range(4)]
+    # workers ranks 0,1 -> indices 0,1; servers ranks 2,3 -> indices 0,1
+    assert [i.get_worker_index() for i in blk[:2]] == [0, 1]
+    assert [i.get_server_index() for i in blk[2:]] == [0, 1]
+    assert blk[0].is_first_worker()
+
+    inter = [PaddlePSInstance(1, 4, nodes=2, rankid=r) for r in range(8)]
+    workers = [i for i in inter if i.is_worker()]
+    servers = [i for i in inter if i.is_server()]
+    assert sorted(i.get_worker_index() for i in workers) == [0, 1, 2, 3]
+    assert sorted(i.get_server_index() for i in servers) == [0, 1, 2, 3]
+    assert sum(i.is_first_worker() for i in inter) == 1
+
+    with pytest.raises(ValueError):
+        PaddlePSInstance(1, 3)
