@@ -14,7 +14,7 @@ __all__ = ["prior_box", "density_prior_box", "anchor_generator", "yolov3_loss",
            "iou_similarity", "box_coder", "box_clip", "yolo_box",
            "multiclass_nms", "roi_align", "roi_pool",
            "sigmoid_focal_loss", "target_assign", "ssd_loss",
-           "detection_output"]
+           "detection_output", "multi_box_head"]
 
 
 def _op(op_type, inputs, outputs_spec, attrs):
@@ -203,3 +203,93 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                 "class_num": class_num, "ignore_thresh": ignore_thresh,
                 "downsample_ratio": downsample_ratio,
                 "use_label_smooth": use_label_smooth})
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD prior boxes + loc/conf conv heads over a feature pyramid
+    (reference layers/detection.py:1737 multi_box_head).
+
+    Returns (mbox_locs [N, num_priors, 4], mbox_confs
+    [N, num_priors, num_classes], boxes [num_priors, 4],
+    variances [num_priors, 4])."""
+    import math
+
+    from paddle_tpu.layers import nn as _nn
+
+    if min_max_aspect_ratios_order:
+        raise NotImplementedError(
+            "min_max_aspect_ratios_order=True is not supported: "
+            "ops/detection.py prior_box emits all aspect-ratio boxes "
+            "first, then the min-max pairs (the False ordering)")
+    num_layer = len(inputs)
+    if num_layer <= 2:
+        assert min_sizes is not None and max_sizes is not None
+        assert len(min_sizes) == num_layer and \
+            len(max_sizes) == num_layer
+    elif min_sizes is None and max_sizes is None:
+        min_sizes, max_sizes = [], []
+        step = int(math.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+    if steps:
+        step_w = steps
+        step_h = steps
+
+    mbox_locs, mbox_confs, box_results, var_results = [], [], [], []
+    for i, inp in enumerate(inputs):
+        min_size = min_sizes[i]
+        max_size = max_sizes[i]
+        if not isinstance(min_size, (list, tuple)):
+            min_size = [min_size]
+        if not isinstance(max_size, (list, tuple)):
+            max_size = [max_size]
+        aspect_ratio = aspect_ratios[i] if aspect_ratios else []
+        if not isinstance(aspect_ratio, (list, tuple)):
+            aspect_ratio = [aspect_ratio]
+        # ratio-1 box always included (reference prior_box expands
+        # aspect ratios with 1.0); the op takes the explicit list
+        full_ars = [1.0] + [a for a in aspect_ratio if a != 1.0]
+        step = [step_w[i] if step_w else 0.0,
+                step_h[i] if step_h else 0.0]
+        box, var = prior_box(inp, image, min_size, max_size, full_ars,
+                             variance, flip, clip, step, offset)
+        box_results.append(_nn.reshape(box, shape=[-1, 4]))
+        var_results.append(_nn.reshape(var, shape=[-1, 4]))
+        # priors per location, matching ops/detection.py prior_box:
+        # (ars + flips) * len(min) + len(min..max pairs)
+        n_ars = len(full_ars) + (len([a for a in full_ars if a != 1.0])
+                                 if flip else 0)
+        num_boxes = n_ars * len(min_size) + min(len(min_size),
+                                                len(max_size))
+
+        mbox_loc = _nn.conv2d(inp, num_filters=num_boxes * 4,
+                              filter_size=kernel_size, padding=pad,
+                              stride=stride)
+        mbox_loc = _nn.transpose(mbox_loc, perm=[0, 2, 3, 1])
+        mbox_locs.append(_nn.reshape(mbox_loc, shape=[0, -1, 4]))
+
+        conf = _nn.conv2d(inp, num_filters=num_boxes * num_classes,
+                          filter_size=kernel_size, padding=pad,
+                          stride=stride)
+        conf = _nn.transpose(conf, perm=[0, 2, 3, 1])
+        mbox_confs.append(_nn.reshape(conf, shape=[0, -1, num_classes]))
+
+    if num_layer == 1:
+        box, var = box_results[0], var_results[0]
+        locs, confs = mbox_locs[0], mbox_confs[0]
+    else:
+        box = _nn.concat(box_results, axis=0)
+        var = _nn.concat(var_results, axis=0)
+        locs = _nn.concat(mbox_locs, axis=1)
+        confs = _nn.concat(mbox_confs, axis=1)
+    box.stop_gradient = True
+    var.stop_gradient = True
+    return locs, confs, box, var

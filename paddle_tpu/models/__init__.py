@@ -1,5 +1,7 @@
 """Model zoo mirroring the reference workload ladder (BASELINE.md):
-MNIST MLP, ResNet-50, Transformer-base, BERT-base, DeepFM CTR.
+MNIST MLP, ResNet-50, Transformer-base, BERT-base, DeepFM CTR, plus the
+detection family (MobileNet-SSD, YOLOv3) exercising the detection zoo
+through the IR.
 
 Each builder constructs the IR into the current default programs and returns
 the relevant vars; shapes/hyperparams follow the reference model configs
@@ -12,3 +14,5 @@ from paddle_tpu.models.resnet import resnet, resnet50
 from paddle_tpu.models.transformer import transformer_encoder_model
 from paddle_tpu.models.bert import bert_model
 from paddle_tpu.models.deepfm import deepfm_model
+from paddle_tpu.models.ssd import ssd_mobilenet
+from paddle_tpu.models.yolov3 import yolov3

@@ -1,0 +1,102 @@
+"""MobileNet-flavoured SSD detector (reference model family:
+PaddlePaddle models/PaddleCV ssd/mobilenet_ssd.py built on
+fluid layers multi_box_head :1737 + ssd_loss + detection_output —
+the SSD paper's architecture over depthwise-separable conv blocks).
+
+Exercises the detection zoo end to end THROUGH the IR: conv/depthwise
+conv/bn backbone, multi_box_head prior+head conv pyramid, ssd_loss for
+training and detection_output (box_coder + multiclass_nms) for
+inference — all compiled as one XLA program.
+
+`ssd_mobilenet(...)` is scale-parameterized so tests run a tiny config
+(image 64, scale 0.25) while the full 300x300 model is the default.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import layers
+
+
+def _conv_bn(x, num_filters, filter_size, stride, padding, num_groups=1,
+             act="relu", is_test=False):
+    conv = layers.conv2d(
+        input=x, num_filters=num_filters, filter_size=filter_size,
+        stride=stride, padding=padding, groups=num_groups,
+        bias_attr=False)
+    return layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def _depthwise_separable(x, num_filters1, num_filters2, num_groups,
+                         stride, scale, is_test=False):
+    """MobileNet block: depthwise 3x3 + pointwise 1x1."""
+    dw = _conv_bn(x, int(num_filters1 * scale), 3, stride, 1,
+                  num_groups=int(num_groups * scale), is_test=is_test)
+    return _conv_bn(dw, int(num_filters2 * scale), 1, 1, 0,
+                    is_test=is_test)
+
+
+def _extra_block(x, num_filters1, num_filters2, scale, is_test=False):
+    """SSD extra feature block: 1x1 squeeze + 3x3 stride-2."""
+    p = _conv_bn(x, int(num_filters1 * scale), 1, 1, 0, is_test=is_test)
+    return _conv_bn(p, int(num_filters2 * scale), 3, 2, 1,
+                    is_test=is_test)
+
+
+def ssd_mobilenet(num_classes=21, img_shape=(3, 300, 300), scale=1.0,
+                  max_gt=50, is_test=False):
+    """Build the SSD program pieces.
+
+    Returns dict with image/gt inputs, per-image train `loss`, and the
+    inference `nmsed_out` [N, keep_top_k, 6] detections."""
+    c, h, w = img_shape
+    image = layers.data(name="image", shape=[c, h, w], dtype="float32")
+
+    # MobileNet backbone (conv1 + 13 depthwise blocks)
+    tmp = _conv_bn(image, int(32 * scale), 3, 2, 1, is_test=is_test)
+    tmp = _depthwise_separable(tmp, 32, 64, 32, 1, scale, is_test)
+    tmp = _depthwise_separable(tmp, 64, 128, 64, 2, scale, is_test)
+    tmp = _depthwise_separable(tmp, 128, 128, 128, 1, scale, is_test)
+    tmp = _depthwise_separable(tmp, 128, 256, 128, 2, scale, is_test)
+    tmp = _depthwise_separable(tmp, 256, 256, 256, 1, scale, is_test)
+    tmp = _depthwise_separable(tmp, 256, 512, 256, 2, scale, is_test)
+    for _ in range(5):
+        tmp = _depthwise_separable(tmp, 512, 512, 512, 1, scale, is_test)
+    module11 = tmp                                   # stride 16 map
+    tmp = _depthwise_separable(tmp, 512, 1024, 512, 2, scale, is_test)
+    module13 = _depthwise_separable(tmp, 1024, 1024, 1024, 1, scale,
+                                    is_test)         # stride 32 map
+    module14 = _extra_block(module13, 256, 512, scale, is_test)
+    module15 = _extra_block(module14, 128, 256, scale, is_test)
+    module16 = _extra_block(module15, 128, 256, scale, is_test)
+    module17 = _extra_block(module16, 64, 128, scale, is_test)
+
+    feats = [module11, module13, module14, module15, module16, module17]
+    mbox_locs, mbox_confs, box, box_var = layers.multi_box_head(
+        inputs=feats, image=image, num_classes=num_classes,
+        base_size=h,
+        min_ratio=20, max_ratio=90,
+        aspect_ratios=[[2.0], [2.0, 3.0], [2.0, 3.0], [2.0, 3.0],
+                       [2.0, 3.0], [2.0, 3.0]],
+        offset=0.5, flip=True)
+
+    out = {"image": image, "locs": mbox_locs, "confs": mbox_confs,
+           "box": box, "box_var": box_var, "feats": feats}
+
+    if is_test:
+        # detection_output wants scores [N, C, P]
+        scores = layers.transpose(
+            layers.softmax(mbox_confs), perm=[0, 2, 1])
+        out["nmsed_out"] = layers.detection_output(
+            mbox_locs, scores, box, box_var,
+            nms_threshold=0.45, background_label=0)
+    else:
+        gt_box = layers.data(name="gt_box", shape=[max_gt, 4],
+                             dtype="float32")
+        gt_label = layers.data(name="gt_label", shape=[max_gt, 1],
+                               dtype="int64")
+        per_image = layers.ssd_loss(mbox_locs, mbox_confs, gt_box,
+                                    gt_label, box, box_var)
+        out["gt_box"] = gt_box
+        out["gt_label"] = gt_label
+        out["loss"] = layers.mean(per_image)
+    return out

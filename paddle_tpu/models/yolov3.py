@@ -1,0 +1,135 @@
+"""YOLOv3 detector (reference model family: PaddleCV yolov3 on fluid —
+DarkNet-53 backbone + 3-scale detection heads, trained with the
+yolov3_loss op (operators/detection/yolov3_loss_op.cc) and decoded with
+yolo_box + multiclass_nms).
+
+Scale-parameterized DarkNet: `depths` picks the residual-stage depths so
+tests can run a tiny (1,1,1,1,1) variant; default (1,2,8,8,4) is
+DarkNet-53.  The whole net is static-shape NCHW conv+bn — one XLA
+program for the fwd+bwd step.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import layers
+
+_ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
+            59, 119, 116, 90, 156, 198, 373, 326]
+_ANCHOR_MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]
+
+
+def _conv_bn(x, ch_out, filter_size, stride, padding, act="leaky_relu",
+             is_test=False):
+    conv = layers.conv2d(x, num_filters=ch_out, filter_size=filter_size,
+                         stride=stride, padding=padding, bias_attr=False)
+    return layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def _downsample(x, ch_out, is_test=False):
+    return _conv_bn(x, ch_out, 3, 2, 1, is_test=is_test)
+
+
+def _basic_block(x, ch_out, is_test=False):
+    c1 = _conv_bn(x, ch_out, 1, 1, 0, is_test=is_test)
+    c2 = _conv_bn(c1, ch_out * 2, 3, 1, 1, is_test=is_test)
+    return layers.elementwise_add(x, c2)
+
+
+def _stage(x, ch_out, count, is_test=False):
+    for _ in range(count):
+        x = _basic_block(x, ch_out, is_test=is_test)
+    return x
+
+
+def _darknet(image, depths, is_test=False):
+    """Returns the three pyramid features (stride 8, 16, 32)."""
+    x = _conv_bn(image, 32, 3, 1, 1, is_test=is_test)
+    x = _downsample(x, 64, is_test)
+    x = _stage(x, 32, depths[0], is_test)
+    x = _downsample(x, 128, is_test)
+    x = _stage(x, 64, depths[1], is_test)
+    x = _downsample(x, 256, is_test)
+    c3 = _stage(x, 128, depths[2], is_test)      # stride 8
+    x = _downsample(c3, 512, is_test)
+    c4 = _stage(x, 256, depths[3], is_test)      # stride 16
+    x = _downsample(c4, 1024, is_test)
+    c5 = _stage(x, 512, depths[4], is_test)      # stride 32
+    return c3, c4, c5
+
+
+def _yolo_detection_block(x, ch_out, is_test=False):
+    for _ in range(2):
+        x = _conv_bn(x, ch_out, 1, 1, 0, is_test=is_test)
+        x = _conv_bn(x, ch_out * 2, 3, 1, 1, is_test=is_test)
+    route = _conv_bn(x, ch_out, 1, 1, 0, is_test=is_test)
+    tip = _conv_bn(route, ch_out * 2, 3, 1, 1, is_test=is_test)
+    return route, tip
+
+
+def yolov3(num_classes=80, img_size=416, depths=(1, 2, 8, 8, 4),
+           max_gt=50, is_test=False):
+    """Build the YOLOv3 program pieces.
+
+    Train: `loss` (sum of the three scale losses).  Test: `boxes`
+    [N, P, 4] + `scores` [N, C, P] + `nmsed_out` [N, keep_top_k, 6]."""
+    image = layers.data(name="image",
+                        shape=[3, img_size, img_size], dtype="float32")
+    c3, c4, c5 = _darknet(image, depths, is_test=is_test)
+
+    outputs = []
+    route = None
+    blocks = [c5, c4, c3]
+    for i, block in enumerate(blocks):
+        if i > 0:
+            # lateral conv widths 256, 128 (reference PaddleCV yolov3:
+            # the route conv of pyramid level i-1)
+            route = _conv_bn(route, 256 // (2 ** (i - 1)), 1, 1, 0,
+                             is_test=is_test)
+            route = layers.resize_nearest(route, scale=2.0)
+            block = layers.concat([route, block], axis=1)
+        route, tip = _yolo_detection_block(block, 512 // (2 ** i),
+                                           is_test=is_test)
+        n_anchors = len(_ANCHOR_MASKS[i])
+        head = layers.conv2d(
+            tip, num_filters=n_anchors * (5 + num_classes),
+            filter_size=1, stride=1, padding=0)
+        outputs.append(head)
+
+    out = {"image": image, "heads": outputs}
+    if is_test:
+        img_size_var = layers.data(name="img_shape", shape=[2],
+                                   dtype="int32")
+        all_boxes, all_scores = [], []
+        for i, head in enumerate(outputs):
+            anchors = [a for idx in _ANCHOR_MASKS[i]
+                       for a in _ANCHORS[2 * idx:2 * idx + 2]]
+            boxes, scores = layers.yolo_box(
+                head, img_size_var, anchors=anchors,
+                class_num=num_classes, conf_thresh=0.005,
+                downsample_ratio=32 // (2 ** i))
+            all_boxes.append(boxes)
+            all_scores.append(layers.transpose(scores, perm=[0, 2, 1]))
+        boxes = layers.concat(all_boxes, axis=1)
+        scores = layers.concat(all_scores, axis=2)
+        out["boxes"] = boxes
+        out["scores"] = scores
+        out["img_shape"] = img_size_var
+        out["nmsed_out"] = layers.multiclass_nms(
+            boxes, scores, score_threshold=0.01, nms_threshold=0.45,
+            background_label=-1)
+    else:
+        gt_box = layers.data(name="gt_box", shape=[max_gt, 4],
+                             dtype="float32")
+        gt_label = layers.data(name="gt_label", shape=[max_gt],
+                               dtype="int64")
+        losses = []
+        for i, head in enumerate(outputs):
+            per_image = layers.yolov3_loss(
+                head, gt_box, gt_label, anchors=_ANCHORS,
+                anchor_mask=_ANCHOR_MASKS[i], class_num=num_classes,
+                ignore_thresh=0.7, downsample_ratio=32 // (2 ** i))
+            losses.append(layers.mean(per_image))
+        out["gt_box"] = gt_box
+        out["gt_label"] = gt_label
+        out["loss"] = layers.sums(losses)
+    return out

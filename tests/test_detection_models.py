@@ -1,0 +1,129 @@
+"""Detection model family end-to-end through the IR (reference model zoo:
+PaddleCV mobilenet_ssd / yolov3 on fluid; layers multi_box_head
+detection.py:1737, ssd_loss, yolov3_loss_op.cc, yolo_box + NMS)."""
+
+import numpy as np
+
+from paddle_tpu import layers, unique_name
+from paddle_tpu.core.executor import Executor
+from paddle_tpu.core.scope import Scope, scope_guard
+from paddle_tpu.framework import Program, program_guard
+from paddle_tpu.models.ssd import ssd_mobilenet
+from paddle_tpu.models.yolov3 import yolov3
+from paddle_tpu.optimizer import SGD
+
+
+def _feed_dets(batch=2):
+    rng = np.random.RandomState(0)
+    return {"image": rng.rand(batch, 3, 64, 64).astype(np.float32)}
+
+
+def test_ssd_training_decreases_loss():
+    with scope_guard(Scope()):
+        np.random.seed(0)
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                model = ssd_mobilenet(num_classes=4, img_shape=(3, 64, 64),
+                                      scale=0.25, max_gt=5)
+                SGD(learning_rate=0.01).minimize(model["loss"])
+        exe = Executor()
+        exe.run(sprog)
+        feed = dict(_feed_dets())
+        feed["gt_box"] = np.tile(
+            np.array([[0.1, 0.1, 0.5, 0.5]], np.float32), (2, 5, 1))
+        feed["gt_label"] = np.ones((2, 5, 1), np.int64)
+        losses = []
+        for _ in range(8):
+            lv, = exe.run(prog, feed=feed, fetch_list=[model["loss"]])
+            losses.append(float(np.ravel(lv)[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+
+def test_ssd_inference_emits_padded_detections():
+    with scope_guard(Scope()):
+        np.random.seed(0)
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                model = ssd_mobilenet(num_classes=4, img_shape=(3, 64, 64),
+                                      scale=0.25, is_test=True)
+        exe = Executor()
+        exe.run(sprog)
+        out, = exe.run(prog, feed=_feed_dets(),
+                       fetch_list=[model["nmsed_out"]])
+        assert out.shape == (2, 32, 6)
+        # padded rows carry class -1; real rows have class in [0, 4)
+        cls = out[..., 0]
+        assert ((cls == -1) | ((cls >= 0) & (cls < 4))).all()
+
+
+def test_multi_box_head_prior_count_matches_runtime():
+    """The analytic per-location prior count must equal the prior_box
+    op's actual box count (keeps head conv widths consistent)."""
+    with scope_guard(Scope()):
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                image = layers.data(name="image", shape=[3, 32, 32],
+                                    dtype="float32")
+                feat = layers.conv2d(image, num_filters=8, filter_size=3,
+                                     padding=1, stride=4)
+                locs, confs, box, var = layers.multi_box_head(
+                    inputs=[feat], image=image, base_size=32,
+                    num_classes=3, aspect_ratios=[[2.0]],
+                    min_sizes=[4.0], max_sizes=[8.0], flip=True)
+        exe = Executor()
+        exe.run(sprog)
+        l, c, b = exe.run(
+            prog, feed={"image": np.zeros((1, 3, 32, 32), np.float32)},
+            fetch_list=[locs, confs, box])
+        # total priors consistent across head outputs and prior boxes
+        assert l.shape[1] == c.shape[1] == b.shape[0]
+        assert l.shape[2] == 4 and c.shape[2] == 3
+
+
+def test_yolov3_training_decreases_loss():
+    with scope_guard(Scope()):
+        np.random.seed(0)
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                model = yolov3(num_classes=4, img_size=64,
+                               depths=(1, 1, 1, 1, 1), max_gt=3)
+                SGD(learning_rate=0.0005).minimize(model["loss"])
+        exe = Executor()
+        exe.run(sprog)
+        feed = dict(_feed_dets())
+        feed["gt_box"] = np.tile(
+            np.array([[0.3, 0.3, 0.2, 0.2]], np.float32), (2, 3, 1))
+        feed["gt_label"] = np.ones((2, 3), np.int64)
+        losses = []
+        for _ in range(6):
+            lv, = exe.run(prog, feed=feed, fetch_list=[model["loss"]])
+            losses.append(float(np.ravel(lv)[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.8
+
+
+def test_yolov3_inference_boxes_and_nms():
+    with scope_guard(Scope()):
+        np.random.seed(0)
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                model = yolov3(num_classes=4, img_size=64,
+                               depths=(1, 1, 1, 1, 1), is_test=True)
+        exe = Executor()
+        exe.run(sprog)
+        feed = dict(_feed_dets())
+        feed["img_shape"] = np.array([[64, 64], [64, 64]], np.int32)
+        nms, boxes, scores = exe.run(
+            prog, feed=feed,
+            fetch_list=[model["nmsed_out"], model["boxes"],
+                        model["scores"]])
+        # 3 scales over a 64px image: 2x2 + 4x4 + 8x8 locations x 3 anchors
+        assert boxes.shape == (2, 252, 4)
+        assert scores.shape == (2, 4, 252)
+        assert nms.shape == (2, 32, 6)
