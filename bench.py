@@ -32,6 +32,7 @@ import time
 import numpy as np
 
 BASELINE_INFER_MS = 64.52  # V100 fp16 mb=128, float16_benchmark.md:42-44
+BASELINE_VGG16_MB64_MS = 60.23  # V100 fp16 mb=64, float16_benchmark.md:23-25
 MFU_TARGET = 0.50          # BASELINE.md north star
 
 # bf16 peak FLOP/s per chip by device kind (public spec sheets)
@@ -247,6 +248,40 @@ def bench_resnet50_infer(batch=128, chain=100):
             "batch": batch}
 
 
+def bench_vgg16_infer(batch=64, chain=60):
+    """The reference's HEADLINE fp16 benchmark network
+    (float16_benchmark.md:23-25: VGG16 ImageNet fp16 mb=1 3.32 ms,
+    mb=64 60.23 ms on V100) — bf16 on TPU via the same transpiles."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.contrib.float16 import bf16_transpile
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.models.vgg import vgg16
+    from paddle_tpu.transpiler import nhwc_transpile
+
+    _fresh_programs()
+    model = vgg16(is_test=True)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(framework.default_startup_program())
+    infer_prog = framework.default_main_program().clone(for_test=True)
+    nhwc_transpile(infer_prog)
+    bf16_transpile(infer_prog, scope=global_scope())
+    compiled = fluid.CompiledProgram(infer_prog)
+
+    rng = np.random.RandomState(0)
+    feed = {"image": jax.device_put(jnp.asarray(
+        rng.rand(batch, 3, 224, 224).astype(np.float32), jnp.bfloat16))}
+    fn, state = _build_compiled_fn(compiled, feed,
+                                   [model["logits"].name])
+    sec_per_step, _ = _chain_timed(fn, state, feed,
+                                   model["logits"].name, chain)
+    return {"ms_per_batch": round(sec_per_step * 1e3, 3),
+            "batch": batch}
+
+
 def bench_resnet50_infer_int8(batch=128, chain=100):
     """Int8-weight inference (round-2 missing #8; reference
     inference/tests/api/int8_mkldnn_quantization.md): weights stored
@@ -328,6 +363,7 @@ def main():
     tf_train = bench_transformer_train()
     infer = bench_resnet50_infer()
     infer_i8 = bench_resnet50_infer_int8()
+    vgg_infer = bench_vgg16_infer()
     headline = rn_train["mfu_pct"]
     print(json.dumps({
         "metric": "resnet50_bf16_train_mfu_pct_mb128",
@@ -345,6 +381,12 @@ def main():
                     BASELINE_INFER_MS / infer["ms_per_batch"], 3),
             },
             "resnet50_infer_int8_mb128": infer_i8,
+            "vgg16_infer_bf16_mb64": {
+                **vgg_infer,
+                "vs_v100_fp16_baseline": round(
+                    BASELINE_VGG16_MB64_MS / vgg_infer["ms_per_batch"],
+                    3),
+            },
         },
     }))
 

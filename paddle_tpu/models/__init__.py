@@ -16,3 +16,4 @@ from paddle_tpu.models.bert import bert_model
 from paddle_tpu.models.deepfm import deepfm_model
 from paddle_tpu.models.ssd import ssd_mobilenet
 from paddle_tpu.models.yolov3 import yolov3
+from paddle_tpu.models.vgg import vgg, vgg16

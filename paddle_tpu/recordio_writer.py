@@ -1,0 +1,105 @@
+"""Convert python readers into recordio files (reference
+python/paddle/fluid/recordio_writer.py:26 create_recordio_writer /
+:34 convert_reader_to_recordio_file / :91 convert_reader_to_recordio_files,
+over paddle/fluid/recordio/{writer,chunk}.h).
+
+Records are written through the native chunked writer
+(native/src/recordio.cc); each record is one batch's feed dict serialized
+with the data-only RPC wire codec (distributed/rpc.py wire_dumps) —
+tensors as dtype/shape/raw-bytes, no pickle.  `read_recordio_file` is the
+matching reader the reference keeps in the recordio reader op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["create_recordio_writer", "convert_reader_to_recordio_file",
+           "convert_reader_to_recordio_files", "read_recordio_file"]
+
+
+@contextlib.contextmanager
+def create_recordio_writer(filename, compressor=None,
+                           max_num_records=1000):
+    """Context manager over the native RecordIOWriter (reference :26).
+
+    compressor and max_num_records are accepted for reference-signature
+    parity but are no-ops: the native writer streams uncompressed host
+    bytes with its own fixed chunking (native/src/recordio.cc)."""
+    from paddle_tpu import native
+
+    writer = native.RecordIOWriter(filename)
+    try:
+        yield writer
+    finally:
+        writer.close()
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder,
+                                    compressor=None, max_num_records=1000,
+                                    feed_order=None):
+    """Write every batch of reader_creator() as one record; returns the
+    record count (reference :34)."""
+    from paddle_tpu.distributed.rpc import wire_dumps
+
+    if feed_order is None:
+        feed_order = [v.name for v in feeder.feed_vars]
+    counter = 0
+    with create_recordio_writer(filename, compressor,
+                                max_num_records) as writer:
+        for batch in reader_creator():
+            res = feeder.feed(batch)
+            record = {name: res[name] for name in feed_order}
+            writer.write(wire_dumps(record))
+            counter += 1
+    return counter
+
+
+def convert_reader_to_recordio_files(filename, batch_per_file,
+                                     reader_creator, feeder,
+                                     compressor=None,
+                                     max_num_records=1000,
+                                     feed_order=None):
+    """Shard the reader across many .recordio files of batch_per_file
+    records each (reference :91).  Returns the total record count."""
+    from paddle_tpu import native
+    from paddle_tpu.distributed.rpc import wire_dumps
+
+    f_name, f_ext = os.path.splitext(filename)
+    assert f_ext == ".recordio"
+    if feed_order is None:
+        feed_order = [v.name for v in feeder.feed_vars]
+    counter = 0
+    writer = None
+    f_idx = 0
+    try:
+        for idx, batch in enumerate(reader_creator()):
+            if idx % batch_per_file == 0:
+                if writer is not None:
+                    writer.close()
+                writer = native.RecordIOWriter(
+                    f"{f_name}-{f_idx:05d}{f_ext}")
+                f_idx += 1
+            res = feeder.feed(batch)
+            writer.write(wire_dumps(
+                {name: res[name] for name in feed_order}))
+            counter += 1
+    finally:
+        if writer is not None:
+            writer.close()
+    return counter
+
+
+def read_recordio_file(filename):
+    """Yield the {name: ndarray} feed dicts back out of a recordio file
+    (the reader half: reference operators/reader recordio reader op)."""
+    from paddle_tpu import native
+    from paddle_tpu.distributed.rpc import wire_loads
+
+    scanner = native.RecordIOScanner(filename)
+    try:
+        for rec in scanner:
+            yield wire_loads(rec)
+    finally:
+        scanner.close()

@@ -79,3 +79,32 @@ def test_mlp_model_builder():
                     lambda i: {"img": img, "label": lab}, steps=20,
                     lr=1e-2)
     assert losses[-1] < losses[0] * 0.7
+
+
+def test_vgg16_builds_and_trains_small():
+    """VGG (float16_benchmark.md headline net) builds + one train step
+    decreases loss at CIFAR scale."""
+    import numpy as np
+
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.executor import Executor
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.models.vgg import vgg
+    from paddle_tpu.optimizer import SGD
+
+    with scope_guard(Scope()):
+        np.random.seed(0)
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                m = vgg(11, class_dim=10, img_shape=(3, 32, 32))
+                SGD(learning_rate=0.01).minimize(m["loss"])
+        exe = Executor()
+        exe.run(sprog)
+        feed = {"image": np.random.rand(4, 3, 32, 32).astype(np.float32),
+                "label": np.random.randint(0, 10, (4, 1)).astype(np.int64)}
+        losses = [float(np.ravel(exe.run(prog, feed=feed,
+                                         fetch_list=[m["loss"]])[0])[0])
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]

@@ -113,3 +113,45 @@ def test_multislot_parse_large_batch():
 def test_shell_reader():
     r = native.ShellReader("printf 'a\\nb\\nc\\n'")
     assert r.read_all() == b"a\nb\nc\n"
+
+
+def test_recordio_writer_reader_roundtrip(tmp_path):
+    """reference recordio_writer.py:34 convert_reader_to_recordio_file(s)
+    + the reader half, over the native chunked writer."""
+    import numpy as np
+
+    from paddle_tpu import layers
+    from paddle_tpu.data_feeder import DataFeeder
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.recordio_writer import (
+        convert_reader_to_recordio_file, convert_reader_to_recordio_files,
+        read_recordio_file)
+
+    prog, sprog = Program(), Program()
+    with program_guard(prog, sprog):
+        img = layers.data(name="img", shape=[4], dtype="float32")
+        lab = layers.data(name="label", shape=[1], dtype="int64")
+    feeder = DataFeeder(feed_list=[img, lab])
+    rng = np.random.RandomState(0)
+    batches = [[(rng.rand(4).astype(np.float32), np.array([i]))
+                for _ in range(3)] for i in range(5)]
+
+    fn = str(tmp_path / "data.recordio")
+    n = convert_reader_to_recordio_file(fn, lambda: iter(batches), feeder)
+    assert n == 5
+    back = list(read_recordio_file(fn))
+    assert len(back) == 5
+    assert back[0]["img"].shape == (3, 4)
+    assert back[0]["img"].dtype == np.float32
+    np.testing.assert_array_equal(back[2]["label"].ravel(), [2, 2, 2])
+
+    n2 = convert_reader_to_recordio_files(
+        str(tmp_path / "multi.recordio"), 2, lambda: iter(batches), feeder)
+    import os
+
+    files = sorted(f for f in os.listdir(tmp_path)
+                   if f.startswith("multi"))
+    assert len(files) == 3  # 2+2+1
+    total = sum(len(list(read_recordio_file(str(tmp_path / f))))
+                for f in files)
+    assert total == n2 == 5
