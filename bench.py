@@ -210,42 +210,52 @@ def bench_transformer_train(batch=32, seq=512, chain=30):
     }
 
 
-def bench_resnet50_infer(batch=128, chain=100):
-    """Round-1 anchor: bf16 inference vs the reference's V100 fp16
-    headline (float16_benchmark.md:42-44)."""
-    import jax
-    import jax.numpy as jnp
-
+def _bench_infer(model_builder, feed_builder, fetch_key, chain):
+    """Shared bf16-inference bench: build through the IR, clone for test,
+    NHWC + bf16 transpile, compile, chain-timed run."""
     import paddle_tpu as fluid
     from paddle_tpu import framework
     from paddle_tpu.contrib.float16 import bf16_transpile
     from paddle_tpu.core.scope import global_scope
-    from paddle_tpu.models.resnet import resnet50
-
     from paddle_tpu.transpiler import nhwc_transpile
 
     _fresh_programs()
-    model = resnet50(is_test=True)
+    model = model_builder()
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(framework.default_startup_program())
     infer_prog = framework.default_main_program().clone(for_test=True)
     nhwc_transpile(infer_prog)
     bf16_transpile(infer_prog, scope=global_scope())
     compiled = fluid.CompiledProgram(infer_prog)
+    feed = feed_builder()
+    fn, state = _build_compiled_fn(compiled, feed,
+                                   [model[fetch_key].name])
+    sec_per_step, _ = _chain_timed(fn, state, feed,
+                                   model[fetch_key].name, chain)
+    return sec_per_step
+
+
+def bench_resnet50_infer(batch=128, chain=100):
+    """Round-1 anchor: bf16 inference vs the reference's V100 fp16
+    headline (float16_benchmark.md:42-44)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.resnet import resnet50
 
     rng = np.random.RandomState(0)
-    feed = {
-        "image": jax.device_put(jnp.asarray(
-            rng.rand(batch, 3, 224, 224).astype(np.float32),
-            jnp.bfloat16)),
-        "label": jax.device_put(np.zeros((batch, 1), np.int64)),
-    }
-    fn, state = _build_compiled_fn(compiled, feed,
-                                   [model["logits"].name])
-    sec_per_step, _ = _chain_timed(fn, state, feed,
-                                   model["logits"].name, chain)
-    return {"ms_per_batch": round(sec_per_step * 1e3, 3),
-            "batch": batch}
+
+    def feed():
+        return {
+            "image": jax.device_put(jnp.asarray(
+                rng.rand(batch, 3, 224, 224).astype(np.float32),
+                jnp.bfloat16)),
+            "label": jax.device_put(np.zeros((batch, 1), np.int64)),
+        }
+
+    sec = _bench_infer(lambda: resnet50(is_test=True), feed, "logits",
+                       chain)
+    return {"ms_per_batch": round(sec * 1e3, 3), "batch": batch}
 
 
 def bench_vgg16_infer(batch=64, chain=60):
@@ -255,31 +265,18 @@ def bench_vgg16_infer(batch=64, chain=60):
     import jax
     import jax.numpy as jnp
 
-    import paddle_tpu as fluid
-    from paddle_tpu import framework
-    from paddle_tpu.contrib.float16 import bf16_transpile
-    from paddle_tpu.core.scope import global_scope
     from paddle_tpu.models.vgg import vgg16
-    from paddle_tpu.transpiler import nhwc_transpile
-
-    _fresh_programs()
-    model = vgg16(is_test=True)
-    exe = fluid.Executor(fluid.TPUPlace())
-    exe.run(framework.default_startup_program())
-    infer_prog = framework.default_main_program().clone(for_test=True)
-    nhwc_transpile(infer_prog)
-    bf16_transpile(infer_prog, scope=global_scope())
-    compiled = fluid.CompiledProgram(infer_prog)
 
     rng = np.random.RandomState(0)
-    feed = {"image": jax.device_put(jnp.asarray(
-        rng.rand(batch, 3, 224, 224).astype(np.float32), jnp.bfloat16))}
-    fn, state = _build_compiled_fn(compiled, feed,
-                                   [model["logits"].name])
-    sec_per_step, _ = _chain_timed(fn, state, feed,
-                                   model["logits"].name, chain)
-    return {"ms_per_batch": round(sec_per_step * 1e3, 3),
-            "batch": batch}
+
+    def feed():
+        return {"image": jax.device_put(jnp.asarray(
+            rng.rand(batch, 3, 224, 224).astype(np.float32),
+            jnp.bfloat16))}
+
+    sec = _bench_infer(lambda: vgg16(is_test=True), feed, "logits",
+                       chain)
+    return {"ms_per_batch": round(sec * 1e3, 3), "batch": batch}
 
 
 def bench_resnet50_infer_int8(batch=128, chain=100):

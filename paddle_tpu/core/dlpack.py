@@ -1,0 +1,52 @@
+"""DLPack interop (reference framework/dlpack_tensor.cc: Tensor <->
+DLPack for zero-copy exchange with other frameworks).
+
+On TPU the device buffers are jax Arrays, which speak the DLPack protocol
+natively; these helpers expose the exchange at the framework level —
+scope variables / fetched tensors out, any DLPack-capable producer
+(torch, numpy, cupy, another jax) in.
+"""
+
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(value):
+    """value: a scope var name (looked up in the global scope), a scope
+    Variable, a jax Array, or a numpy array -> a DLPack-protocol object
+    (implements __dlpack__/__dlpack_device__).
+
+    Modern consumers (torch.utils.dlpack.from_dlpack, np.from_dlpack,
+    jnp.from_dlpack) take the protocol object directly — the capsule
+    handshake happens inside the consumer, so the exchange stays
+    single-use-safe without handing out a raw capsule."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.scope import Variable, global_scope
+
+    if isinstance(value, str):
+        var = global_scope().find_var(value)
+        if var is None or var.get() is None:
+            raise KeyError(f"no tensor named '{value}' in the scope")
+        value = var.get()
+    elif isinstance(value, Variable):
+        value = value.get()
+    return jnp.asarray(value)
+
+
+def from_dlpack(tensor):
+    """Any object with __dlpack__/__dlpack_device__ (torch tensor, numpy
+    array, to_dlpack output, ...) -> jax Array.
+
+    Store it into a program scope with scope.var(name).set(...).
+    Raw PyCapsules are not accepted (jax >= 0.9 consumes the protocol,
+    not bare capsules) — pass the producing tensor itself."""
+    import jax.numpy as jnp
+
+    if not hasattr(tensor, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack needs an object implementing the DLPack "
+            "protocol (__dlpack__/__dlpack_device__); raw capsules are "
+            "not supported — pass the producing tensor instead")
+    return jnp.from_dlpack(tensor)

@@ -63,31 +63,26 @@ def convert_reader_to_recordio_files(filename, batch_per_file,
                                      feed_order=None):
     """Shard the reader across many .recordio files of batch_per_file
     records each (reference :91).  Returns the total record count."""
-    from paddle_tpu import native
-    from paddle_tpu.distributed.rpc import wire_dumps
-
     f_name, f_ext = os.path.splitext(filename)
     assert f_ext == ".recordio"
     if feed_order is None:
         feed_order = [v.name for v in feeder.feed_vars]
     counter = 0
-    writer = None
+    shard = []
     f_idx = 0
-    try:
-        for idx, batch in enumerate(reader_creator()):
-            if idx % batch_per_file == 0:
-                if writer is not None:
-                    writer.close()
-                writer = native.RecordIOWriter(
-                    f"{f_name}-{f_idx:05d}{f_ext}")
-                f_idx += 1
-            res = feeder.feed(batch)
-            writer.write(wire_dumps(
-                {name: res[name] for name in feed_order}))
-            counter += 1
-    finally:
-        if writer is not None:
-            writer.close()
+
+    def flush(batches, idx):
+        return convert_reader_to_recordio_file(
+            f"{f_name}-{idx:05d}{f_ext}", lambda: iter(batches), feeder,
+            compressor, max_num_records, feed_order)
+
+    for batch in reader_creator():
+        shard.append(batch)
+        if len(shard) == batch_per_file:
+            counter += flush(shard, f_idx)
+            shard, f_idx = [], f_idx + 1
+    if shard:
+        counter += flush(shard, f_idx)
     return counter
 
 
