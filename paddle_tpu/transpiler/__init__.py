@@ -6,7 +6,7 @@ from paddle_tpu.transpiler.collective import (Collective,  # noqa: F401
 from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401
     DistributeTranspiler, DistributeTranspilerConfig, slice_variable)
 from paddle_tpu.transpiler.inference_transpiler import (  # noqa: F401
-    InferenceTranspiler)
+    FuseElewiseAddActTranspiler, FuseFCTranspiler, InferenceTranspiler)
 from paddle_tpu.transpiler.layout_transpiler import (  # noqa: F401
     nhwc_transpile)
 from paddle_tpu.transpiler.memory_optimization_transpiler import (  # noqa: F401

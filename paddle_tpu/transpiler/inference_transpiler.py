@@ -19,6 +19,32 @@ import numpy as np
 import jax.numpy as jnp
 
 
+def _producer(block, name, before_idx):
+    """(index, op) producing `name` before before_idx, else (-1, None)."""
+    for j in range(before_idx - 1, -1, -1):
+        op = block.ops[j]
+        if any(name in names for names in op.outputs.values()):
+            return j, op
+    return -1, None
+
+
+def _consumers(block, name):
+    count = 0
+    for op in block.ops:
+        for names in op.inputs.values():
+            count += names.count(name)
+    return count
+
+
+def _first_consumer(block, name, after_idx):
+    """(index, op) of the first op reading `name` after after_idx."""
+    for j in range(after_idx + 1, len(block.ops)):
+        op = block.ops[j]
+        if any(name in names for names in op.inputs.values()):
+            return j, op
+    return -1, None
+
+
 class InferenceTranspiler:
     """reference inference_transpiler.py:25."""
 
@@ -131,3 +157,143 @@ class InferenceTranspiler:
                          {"X": [x_name], "Y": [b_name]},
                          {"Out": [y_name]}, {"axis": 1})
             block.ops.insert(idx + 1, add)
+
+
+class FuseFCTranspiler:
+    """mul + elementwise_add -> fc fusion at the IR level (reference
+    framework/ir/fc_fuse_pass.cc, here as a Python transpiler like the
+    conv-bn one).  Also fuses a following activation into the fc op's
+    activation_type when it is the only consumer.
+
+    Guards (the fc op assumes a 2-D W and a trailing column bias):
+    mul must have y_num_col_dims == 1 and a rank-2 persistable W; the
+    add must be a trailing-axis bias (axis -1 or 1) whose 1-D length
+    equals W's output width."""
+
+    _ACTS = ("relu", "tanh", "sigmoid")
+
+    def transpile(self, program):
+        block = program.global_block()
+        changed = True
+        while changed:
+            changed = self._fuse_one(block)
+        return program
+
+    def _fuse_one(self, block):
+        for i, op in enumerate(block.ops):
+            if op.type != "mul":
+                continue
+            if op.attrs.get("y_num_col_dims", 1) != 1:
+                continue
+            try:
+                w_var = block.var(op.inputs["Y"][0])
+            except KeyError:
+                continue
+            if w_var.shape is None or len(w_var.shape) != 2:
+                continue
+            out = op.outputs["Out"][0]
+            if _consumers(block, out) != 1:
+                continue
+            j, add_op = _first_consumer(block, out, i)
+            if add_op is None or add_op.type != "elementwise_add" or \
+                    add_op.inputs["X"][0] != out:
+                continue
+            if add_op.attrs.get("axis", -1) not in (-1, 1):
+                continue  # only a trailing column bias maps onto fc
+            bias = add_op.inputs["Y"][0]
+            try:
+                bias_var = block.var(bias)
+            except KeyError:
+                continue
+            if not bias_var.persistable or bias_var.shape is None or \
+                    len(bias_var.shape) != 1 or \
+                    int(bias_var.shape[0]) != int(w_var.shape[1]):
+                continue
+            add_out = add_op.outputs["Out"][0]
+            # optional trailing activation
+            act_type = ""
+            act_op = None
+            _, cand = _first_consumer(block, add_out, j)
+            if cand is not None and cand.type in self._ACTS and \
+                    _consumers(block, add_out) == 1:
+                act_op = cand
+                act_type = cand.type
+            final_out = act_op.outputs["Out"][0] if act_op else add_out
+            from paddle_tpu.core.program import OpDesc
+
+            fc = OpDesc(
+                "fc",
+                {"Input": list(op.inputs["X"]),
+                 "W": list(op.inputs["Y"]), "Bias": [bias]},
+                {"Out": [final_out]},
+                {"in_num_col_dims": op.attrs.get("x_num_col_dims", 1),
+                 "activation_type": act_type}, op.op_role)
+            block.ops[i] = fc
+            block.ops.remove(add_op)
+            if act_op is not None:
+                block.ops.remove(act_op)
+            return True
+        return False
+
+
+class FuseElewiseAddActTranspiler:
+    """elementwise_add + activation -> fused_elemwise_activation
+    (reference framework/ir/fuse_elewise_add_act_pass.cc).
+
+    Guards: only attr-free activations (relu/tanh/sigmoid — the fused
+    op cannot carry a scale op's scale/bias), and only trailing
+    (numpy-style) broadcasts — the fused op's compute ignores the axis
+    attr, so mid-axis bias adds (e.g. NCHW channel bias with axis=1)
+    are left alone."""
+
+    _ACTS = ("relu", "tanh", "sigmoid")
+
+    def transpile(self, program):
+        block = program.global_block()
+        changed = True
+        while changed:
+            changed = self._fuse_one(block)
+        return program
+
+    def _trailing_broadcast(self, block, add_op):
+        try:
+            x_var = block.var(add_op.inputs["X"][0])
+            y_var = block.var(add_op.inputs["Y"][0])
+        except KeyError:
+            return False
+        if x_var.shape is None or y_var.shape is None:
+            return False
+        xr, yr = len(x_var.shape), len(y_var.shape)
+        axis = add_op.attrs.get("axis", -1)
+        return xr == yr or axis in (-1, xr - yr)
+
+    def _fuse_one(self, block):
+        for i, op in enumerate(block.ops):
+            if op.type != "elementwise_add":
+                continue
+            if not self._trailing_broadcast(block, op):
+                continue
+            out = op.outputs["Out"][0]
+            if _consumers(block, out) != 1:
+                continue
+            _, act_op = _first_consumer(block, out, i)
+            if act_op is None or act_op.type not in self._ACTS:
+                continue
+            from paddle_tpu import unique_name
+            from paddle_tpu.core.program import OpDesc
+
+            inter = block.create_var(
+                name=unique_name.generate("fuse_add_act.inter"),
+                shape=None, dtype=None)
+            fused = OpDesc(
+                "fused_elemwise_activation",
+                {"X": list(op.inputs["X"]), "Y": list(op.inputs["Y"])},
+                {"Out": list(act_op.outputs["Out"]),
+                 "IntermediateOut": [inter.name]},
+                {"functor_list": [act_op.type, "elementwise_add"],
+                 "axis": op.attrs.get("axis", -1), "scale": 1.0,
+                 "save_intermediate_out": False}, op.op_role)
+            block.ops[i] = fused
+            block.ops.remove(act_op)
+            return True
+        return False

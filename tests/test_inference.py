@@ -111,3 +111,115 @@ def test_inference_transpiler_folds_conv_bn():
     (fused2,) = exe.run(fluid.CompiledProgram(prog), feed={"img": xv},
                         fetch_list=[out])
     np.testing.assert_allclose(fused2, ref, atol=1e-4)
+
+
+def test_fuse_fc_and_add_act_transpilers():
+    """IR-level fc_fuse_pass.cc + fuse_elewise_add_act_pass.cc
+    re-specifications: op count shrinks, numerics unchanged."""
+    import numpy as np
+
+    from paddle_tpu import layers, unique_name
+    from paddle_tpu.core.executor import Executor
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.transpiler import (FuseElewiseAddActTranspiler,
+                                       FuseFCTranspiler)
+
+    with scope_guard(Scope()):
+        np.random.seed(0)
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                x = layers.data(name="x", shape=[8], dtype="float32")
+                h = layers.fc(x, size=16, act="relu")
+                y = layers.fc(h, size=4)
+                z = layers.relu(layers.elementwise_add(
+                    y, layers.fc(x, size=4)))
+        exe = Executor()
+        exe.run(sprog)
+        feed = {"x": np.random.rand(3, 8).astype(np.float32)}
+        base, = exe.run(prog, feed=feed, fetch_list=[z])
+        n0 = len(prog.global_block().ops)
+        FuseFCTranspiler().transpile(prog)
+        FuseElewiseAddActTranspiler().transpile(prog)
+        types = [op.type for op in prog.global_block().ops]
+        assert len(types) < n0
+        assert types.count("fc") == 3          # all three mul+add fused
+        assert "fused_elemwise_activation" in types
+        assert "mul" not in types and "elementwise_add" not in types
+        fused, = exe.run(prog, feed=feed, fetch_list=[z])
+        np.testing.assert_allclose(base, fused, rtol=1e-5)
+
+
+def test_fuse_fc_skips_non_bias_adds():
+    """A residual add (non-persistable Y) must NOT become an fc bias."""
+    import numpy as np
+
+    from paddle_tpu import layers, unique_name
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.transpiler import FuseFCTranspiler
+
+    with scope_guard(Scope()):
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                x = layers.data(name="x", shape=[8], dtype="float32")
+                a = layers.fc(x, size=8, bias_attr=False)
+                b = layers.fc(x, size=8, bias_attr=False)
+                layers.elementwise_add(a, b)   # residual, not a bias
+        FuseFCTranspiler().transpile(prog)
+        types = [op.type for op in prog.global_block().ops]
+        assert "elementwise_add" in types      # untouched
+
+
+def test_fusion_passes_guard_unsupported_patterns():
+    """Review regressions: channel-bias adds (axis=1 mid-broadcast),
+    scale activations, and non-2D/mismatched-bias muls stay unfused."""
+    import numpy as np
+
+    from paddle_tpu import layers, unique_name
+    from paddle_tpu.core.executor import Executor
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.transpiler import (FuseElewiseAddActTranspiler,
+                                       FuseFCTranspiler)
+
+    with scope_guard(Scope()):
+        np.random.seed(0)
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                img = layers.data(name="img", shape=[4, 6, 5],
+                                  dtype="float32")
+                conv = layers.conv2d(img, num_filters=3, filter_size=1)
+                # channel bias with axis=1: mid-axis broadcast, C != W
+                from paddle_tpu.layers.helper import LayerHelper
+                bias = LayerHelper("chan").create_parameter(
+                    None, [3], "float32", is_bias=True)
+                biased = layers.elementwise_add(conv, bias, axis=1)
+                layers.relu(biased)
+                # scale activation after a fusable add
+                a = layers.data(name="a", shape=[7], dtype="float32")
+                b = layers.data(name="b", shape=[7], dtype="float32")
+                layers.scale(layers.elementwise_add(a, b), scale=2.0)
+        exe = Executor()
+        exe.run(sprog)
+        feed = {"img": np.random.rand(2, 4, 6, 5).astype(np.float32),
+                "a": np.random.rand(2, 7).astype(np.float32),
+                "b": np.random.rand(2, 7).astype(np.float32)}
+        fetches = [op.outputs["Out"][0]
+                   for op in prog.global_block().ops
+                   if op.type in ("relu", "scale")]
+        base = exe.run(prog, feed=feed, fetch_list=fetches)
+        FuseElewiseAddActTranspiler().transpile(prog)
+        FuseFCTranspiler().transpile(prog)
+        types = [op.type for op in prog.global_block().ops]
+        # both patterns must survive untouched (conv2d's own bias add
+        # is the third)
+        assert types.count("elementwise_add") == 3
+        assert "relu" in types and "scale" in types
+        after = exe.run(prog, feed=feed, fetch_list=fetches)
+        for x, y in zip(base, after):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-6)
