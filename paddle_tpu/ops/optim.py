@@ -314,7 +314,9 @@ def dgc_momentum(ins, attrs):
     u = m * u + g                      # momentum correction
     v = v + u
     flat = jnp.abs(v).reshape(-1)
-    k = max(1, int(flat.shape[0] * (1.0 - attrs["sparsity"])))
+    from paddle_tpu.parallel.dgc import dgc_top_k_count
+
+    k = dgc_top_k_count(flat.shape[0], attrs["sparsity"])
     thresh = jax.lax.top_k(flat, k)[0][-1]
     mask = (jnp.abs(v) >= thresh).astype(p.dtype)
     if attrs["rampup_begin_step"] > 0 and "Step" not in ins:

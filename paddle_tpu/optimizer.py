@@ -685,10 +685,14 @@ class DGCMomentumOptimizer(Optimizer):
     """Deep Gradient Compression momentum (reference optimizer.py:787 +
     dgc_op.cc): top-k sparsify each grad with error feedback (u, v
     accumulators) before the momentum update; dense (no compression)
-    until rampup_begin_step.  On TPU the sparsified grad stays dense
-    (mask*value) — the win the reference gets on the NCCL wire becomes
-    an XLA-collective win under DP, with identical optimizer
-    semantics."""
+    until rampup_begin_step.
+
+    In this program-level optimizer the sparsified grad stays dense
+    (mask*value) — correct semantics on any executor.  The actual
+    sparse WIRE exchange (2k values+indices per worker over the mesh,
+    reference sparse_all_reduce_op_handle.cc RunImplEncoded) is
+    parallel/dgc.py dgc_allreduce, a shard_map collective for the DP
+    training loop."""
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,
                  sparsity=0.999, use_nesterov=False, **kwargs):

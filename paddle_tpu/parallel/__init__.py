@@ -22,6 +22,8 @@ from paddle_tpu.parallel.pipeline import (
     pipeline_apply,
     stack_stage_params,
 )
+from paddle_tpu.parallel.dgc import (dgc_allreduce, dgc_compress_ratio,
+                                     dgc_top_k_count)
 from paddle_tpu.parallel.moe import moe_ffn, switch_gating
 from paddle_tpu.parallel.zero import (
     is_optimizer_accumulator,

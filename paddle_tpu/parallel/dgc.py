@@ -1,0 +1,70 @@
+"""Deep Gradient Compression as a sparse-wire mesh collective (reference
+details/sparse_all_reduce_op_handle.cc:43 RunImplEncoded + dgc_op.cc +
+optimizer.py:787 DGCMomentumOptimizer; paper arXiv 1712.01887).
+
+The reference encodes each worker's top-k gradient entries and
+ncclAllGather's the encoded buffers; here the same exchange is a
+shard_map-level function: per-worker momentum-corrected error feedback,
+top-k selection, then `lax.all_gather` of exactly (k values + k indices)
+per worker — 2k elements on the ICI wire instead of the full dense
+gradient — scattered back into a dense sum on every worker.  Static k
+keeps every shape compile-time fixed (the XLA requirement the
+reference's variable-length encode path doesn't have).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["dgc_allreduce", "dgc_compress_ratio", "dgc_top_k_count"]
+
+
+def dgc_top_k_count(numel, sparsity):
+    """Elements kept per worker — the ONE k formula shared with the
+    dgc_momentum kernel (ops/optim.py), truncating like the reference."""
+    return max(1, int(numel * (1.0 - sparsity)))
+
+
+def dgc_compress_ratio(numel, sparsity):
+    """Wire elements per worker (2k) / dense numel."""
+    return (2 * dgc_top_k_count(numel, sparsity)) / numel
+
+
+def dgc_allreduce(grad, u, v, *, sparsity=0.999, momentum=0.9,
+                  axis="dp"):
+    """One DGC gradient exchange step.  Call INSIDE shard_map/pjit with
+    `axis` bound to the data-parallel mesh axis.
+
+    grad: this worker's local gradient (any shape).
+    u, v: error-feedback accumulators, same shape as grad (persistent
+        across steps; initialize to zeros).
+    Returns (avg_grad, u_new, v_new): the mean of all workers' top-k
+    sparsified gradients (dense, grad's shape) and the updated
+    accumulators holding the unsent residual.
+
+    Semantics follow dgc_op.cc: u = m*u + g (momentum correction),
+    v = v + u, send top-k of |v|, clear the sent entries from u and v.
+    """
+    shape = grad.shape
+    k = dgc_top_k_count(grad.size, sparsity)
+
+    u_flat = (momentum * u + grad).reshape(-1)
+    v_flat = v.reshape(-1) + u_flat
+
+    _, top_idx = lax.top_k(jnp.abs(v_flat), k)
+    sel_vals = jnp.take(v_flat, top_idx)
+
+    # the sparse wire: 2k elements per worker ride the ICI
+    all_vals = lax.all_gather(sel_vals, axis)        # [W, k]
+    all_idx = lax.all_gather(top_idx, axis)          # [W, k]
+    nranks = all_vals.shape[0]
+    dense_sum = jnp.zeros_like(v_flat).at[
+        all_idx.reshape(-1)].add(all_vals.reshape(-1))
+    avg = (dense_sum / nranks).reshape(shape)
+
+    # error feedback: sent entries leave the accumulators
+    sent = jnp.zeros_like(v_flat, dtype=bool).at[top_idx].set(True)
+    u_new = jnp.where(sent, 0.0, u_flat).reshape(shape)
+    v_new = jnp.where(sent, 0.0, v_flat).reshape(shape)
+    return avg, u_new, v_new
