@@ -55,7 +55,9 @@ class DatasetBase:
 
     def set_pipe_command(self, pipe_command):
         """Each file is piped through this shell command before parsing
-        (reference Dataset pipe_command preprocessing)."""
+        (reference Dataset pipe_command preprocessing).  Not applicable
+        to .recordio files (binary records): mixing the two raises at
+        read time."""
         self._pipe_command = pipe_command
 
     def set_use_var(self, var_list):
@@ -74,8 +76,36 @@ class DatasetBase:
         with open(path, "rb") as f:
             return f.read()
 
+    def _read_file_tagged(self, path):
+        """b"T" + file bytes without an extra full-size copy (readinto a
+        pre-tagged buffer) for the plain-file path."""
+        if self._pipe_command:
+            return b"T" + self._read_file(path)
+        import os as _os
+
+        size = _os.path.getsize(path)
+        buf = bytearray(1 + size)
+        buf[0] = ord("T")
+        with open(path, "rb") as f:
+            f.readinto(memoryview(buf)[1:])
+        return bytes(buf)
+
     def _parse_file(self, path):
-        """-> list of per-sample tuples of np arrays (one per slot)."""
+        """-> list of per-sample tuples of np arrays (one per slot).
+
+        .recordio files (recordio_writer.py convert_reader_to_recordio_*)
+        hold wire-codec batch dicts; anything else is MultiSlot text."""
+        if path.endswith(".recordio"):
+            if self._pipe_command:
+                raise ValueError(
+                    "pipe_command cannot be applied to binary .recordio "
+                    "files (set_pipe_command is for text inputs)")
+            samples = []
+            from paddle_tpu.recordio_writer import read_recordio_file
+
+            for rec in read_recordio_file(path):
+                samples.extend(self._record_to_samples(rec))
+            return samples
         n, slots = self._parser.parse(self._read_file(path))
         samples = []
         for i in range(n):
@@ -84,6 +114,12 @@ class DatasetBase:
                 sample.append(vals[lod[i]:lod[i + 1]])
             samples.append(tuple(sample))
         return samples
+
+    def _record_to_samples(self, rec):
+        """One recordio batch dict -> per-sample tuples in use_var order."""
+        cols = [np.asarray(rec[v.name]) for v in self._use_vars]
+        batch = cols[0].shape[0]
+        return [tuple(c[i] for c in cols) for i in range(batch)]
 
     def _batch_to_feed(self, batch):
         """batch: list of sample tuples -> {var_name: ndarray} with
@@ -127,10 +163,26 @@ class QueueDataset(DatasetBase):
         files = list(self._filelist)
 
         def reader(paths):
-            for p in paths:
-                data = self._read_file(p)
-                if not q.push(data):
-                    return
+            try:
+                for p in paths:
+                    if p.endswith(".recordio"):
+                        if self._pipe_command:
+                            raise ValueError(
+                                "pipe_command cannot be applied to "
+                                "binary .recordio files")
+                        # records are already wire-encoded batch dicts
+                        scanner = native.RecordIOScanner(p)
+                        try:
+                            for rec in scanner:
+                                if not q.push(b"R" + rec):
+                                    return
+                        finally:
+                            scanner.close()
+                        continue
+                    if not q.push(self._read_file_tagged(p)):
+                        return
+            except Exception as e:  # surface to the consumer, not silence
+                q.push(b"E" + repr(e).encode("utf-8", "replace"))
 
         threads = []
         for t in range(self._thread):
@@ -147,15 +199,26 @@ class QueueDataset(DatasetBase):
 
         threading.Thread(target=closer, daemon=True).start()
 
+        from paddle_tpu.distributed.rpc import wire_loads
+
         pending = []
         while True:
             data = q.pop()
             if data is None:
                 break
-            n, slots = self._parser.parse(data)
-            for i in range(n):
-                pending.append(tuple(
-                    vals[lod[i]:lod[i + 1]] for vals, lod in slots))
+            if data[:1] == b"E":
+                raise RuntimeError(
+                    "dataset reader thread failed: "
+                    + data[1:].decode("utf-8", "replace"))
+            if data[:1] == b"R":
+                new_samples = self._record_to_samples(wire_loads(data[1:]))
+            else:
+                n, slots = self._parser.parse(data[1:])
+                new_samples = [
+                    tuple(vals[lod[i]:lod[i + 1]] for vals, lod in slots)
+                    for i in range(n)]
+            for sample in new_samples:
+                pending.append(sample)
                 if len(pending) == self._batch_size:
                     yield self._batch_to_feed(pending)
                     pending = []
