@@ -202,6 +202,15 @@ class QueueDataset(DatasetBase):
         from paddle_tpu.distributed.rpc import wire_loads
 
         pending = []
+        try:
+            yield from self._consume(q, wire_loads)
+        finally:
+            # unblock any reader still in q.push (error paths / early
+            # generator abandonment): push returns False once closed
+            q.close()
+
+    def _consume(self, q, wire_loads):
+        pending = []
         while True:
             data = q.pop()
             if data is None:

@@ -53,7 +53,10 @@ def _register_allreduce(name, op):
         if op == "min":
             return {"Out": lax.pmin(ins["X"], axis)}
         if op == "prod":
-            return {"Out": jnp.exp(lax.psum(jnp.log(ins["X"]), axis))}
+            # exact for negatives/zeros (log-psum NaNs on them):
+            # one all_gather then a local product
+            return {"Out": jnp.prod(lax.all_gather(ins["X"], axis),
+                                    axis=0)}
     return _fn
 
 
@@ -129,3 +132,40 @@ def all_to_all(ins, attrs):
     return {"Out": lax.all_to_all(
         ins["X"], axis, attrs["split_axis"], attrs["concat_axis"],
         tiled=True)}
+
+
+@register_op("allreduce", inputs=("X",), outputs=("Out",),
+             attrs={"reduce_type": 0, "sync_mode": False},
+             differentiable=False, in_place={"Out": "X"})
+def allreduce(ins, attrs):
+    """distributed_ops/allreduce_op.cc (the legacy in-program collective;
+    reduce_type 0..3 = sum/max/min/prod like RedType).  Rides the ring-0
+    mesh axis; identity outside an SPMD context."""
+    axis = _axis_for_ring(0)
+    x = ins["X"]
+    if axis is None or not _in_spmd_context(axis):
+        return {"Out": x}
+    rt = int(attrs["reduce_type"])
+    if rt == 0:
+        return {"Out": lax.psum(x, axis)}
+    if rt == 1:
+        return {"Out": lax.pmax(x, axis)}
+    if rt == 2:
+        return {"Out": lax.pmin(x, axis)}
+    if rt == 3:
+        return {"Out": jnp.prod(lax.all_gather(x, axis), axis=0)}
+    raise ValueError(f"unknown reduce_type {rt}")
+
+
+@register_op("broadcast", inputs=("X",), outputs=("Out",),
+             attrs={"root": 0, "sync_mode": False},
+             differentiable=False, in_place={"Out": "X"})
+def broadcast_op(ins, attrs):
+    """distributed_ops/broadcast_op.cc: every participant takes rank
+    `root`'s value.  all_gather + slice keeps it one XLA collective."""
+    axis = _axis_for_ring(0)
+    x = ins["X"]
+    if axis is None or not _in_spmd_context(axis):
+        return {"Out": x}
+    gathered = lax.all_gather(x, axis)
+    return {"Out": gathered[int(attrs["root"])]}

@@ -206,3 +206,68 @@ def chunk_eval(ins, attrs):
             "NumInferChunks": np.asarray([n_inf], np.int64),
             "NumLabelChunks": np.asarray([n_lab], np.int64),
             "NumCorrectChunks": np.asarray([n_correct], np.int64)}
+
+
+@register_op("positive_negative_pair",
+             inputs=("Score", "Label", "QueryID",
+                     "AccumulatePositivePair", "AccumulateNegativePair",
+                     "AccumulateNeutralPair", "Weight"),
+             outputs=("PositivePair", "NegativePair", "NeutralPair"),
+             optional=("AccumulatePositivePair",
+                       "AccumulateNegativePair",
+                       "AccumulateNeutralPair", "Weight"),
+             attrs={"column": -1},
+             differentiable=False, host_only=True)
+def positive_negative_pair(ins, attrs):
+    """positive_negative_pair_op.h: per-query ranking pair counts —
+    for every doc pair with different labels, score order agreeing with
+    label order counts positive, disagreeing negative, ties neutral;
+    pair weight = mean of the two doc weights.  Host metric op (hash-map
+    grouping) like the reference's CPU-only kernel."""
+    import numpy as np
+
+    score = np.asarray(ins["Score"])
+    col = int(attrs.get("column", -1))
+    if score.ndim > 1:
+        width = score.shape[1]
+        if col < 0:
+            col += width
+        score = score[:, col]
+    score = score.reshape(-1)
+    label = np.asarray(ins["Label"]).reshape(-1)
+    query = np.asarray(ins["QueryID"]).reshape(-1)
+    weight = ins.get("Weight")
+    weight = (np.ones_like(score) if weight is None
+              else np.asarray(weight).reshape(-1))
+    pos = neg = neu = 0.0
+    acc = ins.get("AccumulatePositivePair")
+    if acc is not None:
+        pos = float(np.asarray(acc).ravel()[0])
+        neg = float(np.asarray(
+            ins["AccumulateNegativePair"]).ravel()[0])
+        neu = float(np.asarray(
+            ins["AccumulateNeutralPair"]).ravel()[0])
+    by_query = {}
+    for i in range(score.shape[0]):
+        by_query.setdefault(int(query[i]), []).append(
+            (float(score[i]), float(label[i]), float(weight[i])))
+    for docs in by_query.values():
+        for a in range(len(docs)):
+            for b in range(a + 1, len(docs)):
+                s1, l1, w1 = docs[a]
+                s2, l2, w2 = docs[b]
+                if l1 == l2:
+                    continue
+                w = 0.5 * (w1 + w2)
+                # reference parity (positive_negative_pair_op.h:94-99):
+                # a tie adds to neutral AND falls through the ternary
+                # into negative — deliberately no elif here
+                if s1 == s2:
+                    neu += w
+                if (s1 - s2) * (l1 - l2) > 0.0:
+                    pos += w
+                else:
+                    neg += w
+    return {"PositivePair": np.asarray([pos], np.float32),
+            "NegativePair": np.asarray([neg], np.float32),
+            "NeutralPair": np.asarray([neu], np.float32)}

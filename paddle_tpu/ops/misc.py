@@ -429,3 +429,74 @@ def recompute_segment_grad(ins, attrs):
             cts[n] = g
     (din,) = vjp(cts)
     return {"XGrad": [din[k] for k in grad_in]}
+
+
+@register_op("fill_any_like", inputs=("X",), outputs=("Out",),
+             attrs={"value": 0.0, "dtype": -1}, differentiable=False)
+def fill_any_like(ins, attrs):
+    """fill_any_like_op.cc: constant tensor with X's shape (dtype -1
+    keeps X's dtype, like the reference's VarType -1 sentinel)."""
+    x = ins["X"]
+    dt = attrs.get("dtype", -1)
+    if dt in (-1, None):
+        dtype = x.dtype
+    else:
+        try:
+            dtype = np.dtype(dt)
+        except TypeError:
+            raise ValueError(
+                f"fill_any_like: unsupported dtype attr {dt!r} (use a "
+                "numpy dtype name or -1 to keep X's dtype)") from None
+    return {"Out": jnp.full(x.shape, attrs["value"], dtype)}
+
+
+def _splitmix64(v):
+    """Deterministic 64-bit mix (the role XXH64 plays in hash_op.h:40 —
+    bucketing, not cryptography)."""
+    v = (v + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    v = ((v ^ (v >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) \
+        & np.uint64(0xFFFFFFFFFFFFFFFF)
+    v = ((v ^ (v >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) \
+        & np.uint64(0xFFFFFFFFFFFFFFFF)
+    return v ^ (v >> np.uint64(31))
+
+
+@register_op("hash", inputs=("X",), outputs=("Out",),
+             attrs={"num_hash": 1, "mod_by": 100000},
+             differentiable=False, host_only=True)
+def hash_op(ins, attrs):
+    """hash_op.cc: each row's ids hash to num_hash buckets in
+    [0, mod_by); output [..., num_hash, 1] like HashOutputSize.
+    XXH64(seed=ihash) becomes a splitmix64 over (row-digest, seed) —
+    same contract (deterministic, seed-separated buckets)."""
+    x = np.asarray(ins["X"]).astype(np.int64)
+    rows = x.reshape(-1, x.shape[-1]).astype(np.uint64)
+    num_hash = int(attrs["num_hash"])
+    mod_by = np.uint64(int(attrs["mod_by"]))
+    with np.errstate(over="ignore"):
+        digest = np.zeros(rows.shape[0], np.uint64)
+        for col in range(rows.shape[1]):
+            digest = _splitmix64(digest ^ _splitmix64(rows[:, col]))
+        out = np.empty((rows.shape[0], num_hash, 1), np.int64)
+        for ihash in range(num_hash):
+            out[:, ihash, 0] = (_splitmix64(digest ^ np.uint64(ihash))
+                                % mod_by).astype(np.int64)
+    return {"Out": out.reshape(x.shape[:-1] + (num_hash, 1))}
+
+
+@register_op("unique", inputs=("X",), outputs=("Out", "Index"),
+             attrs={"dtype": "int32"}, differentiable=False,
+             host_only=True)
+def unique_op(ins, attrs):
+    """unique_op.cc: 1-D unique values in first-occurrence order + the
+    index of each input element in Out.  Variable-length output keeps
+    this a host op like the reference's CPU-only kernel."""
+    x = np.asarray(ins["X"]).reshape(-1)
+    _, first_idx, inverse = np.unique(x, return_index=True,
+                                      return_inverse=True)
+    order = np.argsort(first_idx)            # first-occurrence order
+    out = x[np.sort(first_idx)]
+    remap = np.empty_like(order)
+    remap[order] = np.arange(len(order))
+    index = remap[inverse].astype(np.dtype(attrs["dtype"]))
+    return {"Out": out, "Index": index}

@@ -365,3 +365,117 @@ def model_average_update(ins, attrs):
     new_sums = [jnp.where(restart, p, s + p)
                 for p, s in zip(params, sums)]
     return {"SumsOut": new_sums, "CountOut": new_count.reshape(1)}
+
+
+@register_op("proximal_gd",
+             inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",), differentiable=False,
+             attrs={"l1": 0.0, "l2": 0.0},
+             in_place={"ParamOut": "Param"})
+def proximal_gd(ins, attrs):
+    """optimizers/proximal_gd_op.h: prox_param = p - lr*g, then the
+    l1 soft-threshold / l2 shrink proximal step."""
+    p, g = ins["Param"], _dense_grad(ins["Grad"])
+    lr = ins["LearningRate"].reshape(()).astype(p.dtype)
+    l1 = jnp.asarray(attrs["l1"], p.dtype)
+    l2 = jnp.asarray(attrs["l2"], p.dtype)
+    prox = p - lr * g
+    if attrs["l1"] > 0:
+        out = (jnp.sign(prox)
+               * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+               / (1.0 + lr * l2))
+    else:
+        out = prox / (1.0 + lr * l2)
+    return {"ParamOut": out}
+
+
+@register_op("proximal_adagrad",
+             inputs=("Param", "Moment", "Grad", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"), differentiable=False,
+             attrs={"l1": 0.0, "l2": 0.0},
+             in_place={"ParamOut": "Param", "MomentOut": "Moment"})
+def proximal_adagrad(ins, attrs):
+    """optimizers/proximal_adagrad_op.h: adagrad accumulator + the same
+    proximal step with per-element lr/sqrt(m)."""
+    p, g = ins["Param"], _dense_grad(ins["Grad"])
+    m = ins["Moment"]
+    lr = ins["LearningRate"].reshape(()).astype(p.dtype)
+    l1 = jnp.asarray(attrs["l1"], p.dtype)
+    l2 = jnp.asarray(attrs["l2"], p.dtype)
+    m_out = m + g * g
+    prox = p - lr * g / jnp.sqrt(m_out)
+    if attrs["l1"] > 0:
+        out = (jnp.sign(prox)
+               * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+               / (1.0 + lr * l2))
+    else:
+        out = prox / (1.0 + lr * l2)
+    return {"ParamOut": out, "MomentOut": m_out}
+
+
+def _dgc_rampup_sparsity(step, sparsity_steps, rampup_begin, rampup_step):
+    """Sparsity warmup schedule (reference dgc_op.cc: the sparsity attr
+    is a per-phase vector swept over rampup_step steps)."""
+    phases = len(sparsity_steps)
+    frac = jnp.clip((step - rampup_begin) / max(rampup_step, 1.0),
+                    0.0, 1.0)
+    idx = jnp.minimum((frac * phases).astype(jnp.int32), phases - 1)
+    return jnp.asarray(sparsity_steps)[idx]
+
+
+@register_op("dgc",
+             inputs=("U", "V", "Grad", "current_step"),
+             outputs=("U_out", "V_out", "EncodeGrad", "Grad_out", "k"),
+             differentiable=False,
+             attrs={"m": 0.9, "use_nesterov": False,
+                    "sparsity": [0.999], "rampup_begin_step": 0.0,
+                    "rampup_step": 1.0},
+             in_place={"U_out": "U", "V_out": "V"})
+def dgc(ins, attrs):
+    """dgc_op.cc: the standalone sparsify stage (momentum correction +
+    error feedback + top-k).  EncodeGrad is the dense masked gradient —
+    the actual sparse wire exchange is parallel/dgc.py dgc_allreduce."""
+    g = _dense_grad(ins["Grad"])
+    u, v = ins["U"], ins["V"]
+    step = ins["current_step"].reshape(()).astype(jnp.float32)
+    m = attrs["m"]
+    u = m * u + g
+    v = v + u
+    sparsity = _dgc_rampup_sparsity(
+        step, [float(s) for s in attrs["sparsity"]],
+        float(attrs["rampup_begin_step"]), float(attrs["rampup_step"]))
+    n = v.size
+    # the scheduled sparsity is a traced value, so k is dynamic: take
+    # the threshold at the k-th largest |v| via a full descending sort
+    # + dynamic_slice (static shapes throughout, jittable)
+    flat = jnp.abs(v).reshape(-1)
+    sorted_desc = jnp.sort(flat)[::-1]
+    k_sched = jnp.clip(
+        (n * (1.0 - sparsity)).astype(jnp.int32), 1, n)
+    kth = jax.lax.dynamic_index_in_dim(sorted_desc, k_sched - 1,
+                                       keepdims=False)
+    warm = step < float(attrs["rampup_begin_step"])
+    mask = jnp.where(warm, jnp.ones_like(v, dtype=bool),
+                     jnp.abs(v) >= kth)
+    encode = jnp.where(mask, v, 0.0)
+    u_out = jnp.where(mask, 0.0, u)
+    v_out = jnp.where(mask, 0.0, v)
+    return {"U_out": u_out, "V_out": v_out, "EncodeGrad": encode,
+            "Grad_out": encode,
+            "k": k_sched.astype(jnp.float32).reshape(1)}
+
+
+@register_op("dgc_clip_by_norm",
+             inputs=("X", "current_step"), outputs=("Out",),
+             differentiable=False,
+             attrs={"max_norm": REQUIRED, "rampup_begin_step": 0.0})
+def dgc_clip_by_norm(ins, attrs):
+    """dgc_clip_by_norm_op.cc: clip_by_norm that only engages after
+    rampup_begin_step (identity during dense warmup)."""
+    x = ins["X"]
+    step = ins["current_step"].reshape(()).astype(jnp.float32)
+    norm = jnp.sqrt(jnp.sum(x * x))
+    max_norm = jnp.asarray(attrs["max_norm"], x.dtype)
+    clipped = x * jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return {"Out": jnp.where(step < float(attrs["rampup_begin_step"]),
+                             x, clipped)}

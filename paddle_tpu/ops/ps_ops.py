@@ -473,3 +473,19 @@ def fake_init_op(op, block, scope, ctx):
     shape = [int(s) for s in op.attrs["shape"]]
     scope.var(op.outputs["Out"][0]).set(
         jnp.zeros(shape, np.dtype(op.attrs["dtype"])))
+
+
+@register_op("ref_by_trainer_id", inputs=("X", "TrainerId"),
+             outputs=("Out",), duplicable=("X",),
+             differentiable=False)
+def ref_by_trainer_id(ins, attrs):
+    """distributed_ops/ref_by_trainer_id_op.cc: Out = X[trainer_id] —
+    pserver DC-ASGD blocks pick their per-trainer state this way.
+    Static-rank select via lax.switch keeps it jittable."""
+    xs = ins["X"]
+    tid = ins["TrainerId"]
+    idx = jnp.clip(jnp.asarray(tid).reshape(()).astype(jnp.int32), 0,
+                   len(xs) - 1)
+    from jax import lax as _lax
+
+    return {"Out": _lax.switch(idx, [lambda x=x: x for x in xs])}

@@ -1,0 +1,199 @@
+"""Final op-gap wave: the last 11 reference REGISTER_OPERATOR names
+(allreduce, broadcast, dgc, dgc_clip_by_norm, fill_any_like, hash,
+positive_negative_pair, proximal_adagrad, proximal_gd, ref_by_trainer_id,
+unique) + the tools/op_coverage.py audit gate."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from paddle_tpu.core.registry import get_op_def
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_op_coverage_audit_passes():
+    """The runnable inventory audit reports zero genuinely-missing ops."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "op_coverage.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "genuinely missing          : 0" in out.stdout
+
+
+def test_fill_any_like():
+    o = get_op_def("fill_any_like").compute(
+        {"X": np.zeros((2, 3), np.float32)}, {"value": 7.0, "dtype": -1})
+    np.testing.assert_array_equal(o["Out"], np.full((2, 3), 7.0))
+    assert o["Out"].dtype == np.float32
+
+
+def test_hash_deterministic_buckets():
+    x = np.array([[1], [2], [1]], np.int64)
+    o = get_op_def("hash").compute({"X": x},
+                                   {"num_hash": 3, "mod_by": 1000})
+    out = np.asarray(o["Out"])
+    assert out.shape == (3, 3, 1)
+    np.testing.assert_array_equal(out[0], out[2])  # same ids, same buckets
+    assert not np.array_equal(out[0], out[1])
+    assert out.min() >= 0 and out.max() < 1000
+    # seeds separate the num_hash buckets
+    assert len({int(v) for v in out[0].ravel()}) > 1
+
+
+def test_unique_first_occurrence_order():
+    o = get_op_def("unique").compute({"X": np.array([2, 3, 3, 1, 5, 3])},
+                                     {"dtype": "int32"})
+    np.testing.assert_array_equal(o["Out"], [2, 3, 1, 5])
+    np.testing.assert_array_equal(o["Index"], [0, 1, 1, 2, 3, 1])
+    assert o["Index"].dtype == np.int32
+
+
+def test_proximal_gd_and_adagrad():
+    p = np.array([1.0, -2.0, 0.01], np.float32)
+    g = np.array([0.1, 0.1, 0.1], np.float32)
+    lr = np.array([0.5], np.float32)
+    o = get_op_def("proximal_gd").compute(
+        {"Param": p, "Grad": g, "LearningRate": lr},
+        {"l1": 0.1, "l2": 0.1})
+    prox = p - 0.5 * g
+    exp = np.sign(prox) * np.maximum(np.abs(prox) - 0.05, 0) / 1.05
+    np.testing.assert_allclose(o["ParamOut"], exp, rtol=1e-5)
+
+    m = np.full(3, 0.5, np.float32)
+    o = get_op_def("proximal_adagrad").compute(
+        {"Param": p, "Moment": m, "Grad": g, "LearningRate": lr},
+        {"l1": 0.0, "l2": 0.2})
+    m_out = m + g * g
+    exp = (p - 0.5 * g / np.sqrt(m_out)) / (1 + 0.5 * 0.2)
+    np.testing.assert_allclose(o["MomentOut"], m_out, rtol=1e-6)
+    np.testing.assert_allclose(o["ParamOut"], exp, rtol=1e-5)
+
+
+def test_dgc_op_sparsify_and_warmup():
+    u = np.zeros(4, np.float32)
+    v = np.zeros(4, np.float32)
+    g = np.array([1, 2, 3, 4], np.float32)
+    attrs = {"m": 0.9, "use_nesterov": False, "sparsity": [0.75],
+             "rampup_begin_step": 5.0, "rampup_step": 1.0}
+    # warmup: everything passes dense
+    o = get_op_def("dgc").compute(
+        {"U": u, "V": v, "Grad": g, "current_step": np.array([2.0])},
+        attrs)
+    np.testing.assert_allclose(o["EncodeGrad"], g, rtol=1e-6)
+    # past rampup: top-1 of |v| only, error feedback keeps the rest
+    o = get_op_def("dgc").compute(
+        {"U": u, "V": v, "Grad": g, "current_step": np.array([9.0])},
+        attrs)
+    np.testing.assert_allclose(o["EncodeGrad"], [0, 0, 0, 4], rtol=1e-6)
+    assert float(np.asarray(o["k"])[0]) == 1.0
+    np.testing.assert_allclose(o["V_out"], [1, 2, 3, 0], rtol=1e-6)
+
+
+def test_dgc_clip_by_norm_rampup_gate():
+    x = np.array([3.0, 4.0], np.float32)
+    attrs = {"max_norm": 1.0, "rampup_begin_step": 5.0}
+    o = get_op_def("dgc_clip_by_norm").compute(
+        {"X": x, "current_step": np.array([0.0])}, attrs)
+    np.testing.assert_allclose(o["Out"], x)       # warmup: identity
+    o = get_op_def("dgc_clip_by_norm").compute(
+        {"X": x, "current_step": np.array([9.0])}, attrs)
+    np.testing.assert_allclose(np.linalg.norm(o["Out"]), 1.0, rtol=1e-5)
+
+
+def test_positive_negative_pair():
+    o = get_op_def("positive_negative_pair").compute(
+        {"Score": np.array([[0.9], [0.5], [0.3], [0.3]], np.float32),
+         "Label": np.array([2., 1., 1., 0.], np.float32),
+         "QueryID": np.array([1, 1, 2, 2])},
+        {"column": -1})
+    # q1: order agrees -> pos; q2: tie -> neutral AND negative (reference
+    # counts a tie in both buckets, positive_negative_pair_op.h:94-99)
+    assert float(o["PositivePair"][0]) == 1.0
+    assert float(o["NegativePair"][0]) == 1.0
+    assert float(o["NeutralPair"][0]) == 1.0
+    # accumulation inputs carry forward
+    o2 = get_op_def("positive_negative_pair").compute(
+        {"Score": np.array([[0.9], [0.5]], np.float32),
+         "Label": np.array([2., 1.], np.float32),
+         "QueryID": np.array([1, 1]),
+         "AccumulatePositivePair": o["PositivePair"],
+         "AccumulateNegativePair": o["NegativePair"],
+         "AccumulateNeutralPair": o["NeutralPair"]},
+        {"column": -1})
+    assert float(o2["PositivePair"][0]) == 2.0
+
+
+def test_ref_by_trainer_id():
+    o = get_op_def("ref_by_trainer_id").compute(
+        {"X": [np.ones(3), np.full(3, 2.0), np.full(3, 3.0)],
+         "TrainerId": np.array([2])}, {})
+    np.testing.assert_array_equal(np.asarray(o["Out"]), [3, 3, 3])
+
+
+def test_allreduce_broadcast_solo_and_mesh():
+    # solo: identity (single-participant ring)
+    o = get_op_def("allreduce").compute(
+        {"X": np.ones(3, np.float32)}, {"reduce_type": 0,
+                                        "sync_mode": False})
+    np.testing.assert_array_equal(np.asarray(o["Out"]), np.ones(3))
+    # mesh: real psum / root-select over 8 virtual devices
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import env as penv
+    from paddle_tpu.parallel.env import shard_map
+
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    penv.register_ring(0, "dp")
+    try:
+        def red(x):
+            return get_op_def("allreduce").compute(
+                {"X": x[0]}, {"reduce_type": 0, "sync_mode": False}
+            )["Out"][None]
+
+        vals = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = shard_map(red, mesh=mesh, in_specs=(P("dp"),),
+                        out_specs=P("dp"))(vals)
+        np.testing.assert_allclose(np.asarray(out).ravel(),
+                                   np.full(8, vals.sum()), rtol=1e-6)
+
+        def bc(x):
+            return get_op_def("broadcast").compute(
+                {"X": x[0]}, {"root": 3, "sync_mode": False}
+            )["Out"][None]
+
+        out = shard_map(bc, mesh=mesh, in_specs=(P("dp"),),
+                        out_specs=P("dp"))(vals)
+        np.testing.assert_allclose(np.asarray(out).ravel(),
+                                   np.full(8, 3.0), rtol=1e-6)
+    finally:
+        penv.reset()
+
+
+def test_dgc_rampup_schedule_phases():
+    """Review regression: the sparsity VECTOR actually ramps — early
+    post-warmup steps keep more entries than the final phase."""
+    u = np.zeros(100, np.float32)
+    v = np.zeros(100, np.float32)
+    g = np.arange(1, 101, dtype=np.float32)
+    attrs = {"m": 0.0, "use_nesterov": False,
+             "sparsity": [0.5, 0.75, 0.9], "rampup_begin_step": 0.0,
+             "rampup_step": 30.0}
+    def nnz(step):
+        o = get_op_def("dgc").compute(
+            {"U": u, "V": v, "Grad": g,
+             "current_step": np.array([float(step)])}, attrs)
+        return int((np.asarray(o["EncodeGrad"]) != 0).sum()), \
+            float(np.asarray(o["k"])[0])
+    n0, k0 = nnz(1)     # phase 0: sparsity 0.5 -> ~50 kept
+    n1, k1 = nnz(15)    # phase 1: sparsity 0.75 -> ~25 kept
+    n2, k2 = nnz(29)    # phase 2: sparsity 0.9 -> ~10 kept
+    assert n0 == 50 and n1 == 25 and n2 == 10
+    assert (k0, k1, k2) == (50.0, 25.0, 10.0)

@@ -1,0 +1,93 @@
+"""Op-inventory audit: reference REGISTER_OPERATOR names vs this repo's
+registry (SURVEY.md §2.3's enumeration method, runnable by anyone).
+
+    python tools/op_coverage.py [--reference /root/reference] [--missing]
+
+Counts forward op types registered in the reference C++ sources, maps
+each to the registry, and classifies the rest as by-design-absent
+(XLA/runtime-subsumed engines and bootstrap ops) or genuinely missing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Reference op types with no TPU-native counterpart BY DESIGN, with the
+# subsuming mechanism.
+BY_DESIGN_ABSENT = {
+    "anakin_engine": "external inference engine (XLA is the engine)",
+    "tensorrt_engine": "external inference engine (XLA is the engine)",
+    "ngraph_engine": "external compiler bridge (XLA is the compiler)",
+    "nccl_init": "NCCL bootstrap (JAX distributed runtime owns devices)",
+    "ncclInit": "NCCL bootstrap (JAX distributed runtime owns devices)",
+    "ncclAllReduce": "legacy NCCL op (lax.psum over the mesh)",
+    "ncclBcast": "legacy NCCL op (XLA collective)",
+    "ncclReduce": "legacy NCCL op (XLA collective)",
+    "c_gen_nccl_id": "NCCL id exchange (no NCCL communicator exists)",
+    "gen_nccl_id": "NCCL id exchange (no NCCL communicator exists)",
+    "create_custom_reader": "reader graph op (PyReader/DataLoader path)",
+    "cross_entropy_grad2": "grad-only registration (grads are synthesized)",
+}
+
+_REG = re.compile(r"REGISTER_OPERATOR\(\s*\n?\s*([A-Za-z0-9_]+)")
+_REG2 = re.compile(r"REGISTER_OP_WITHOUT_GRADIENT\(\s*\n?\s*([A-Za-z0-9_]+)")
+
+
+def reference_ops(root):
+    names = set()
+    for dirpath, _, files in os.walk(os.path.join(root, "paddle")):
+        for f in files:
+            if not f.endswith((".cc", ".cu", ".h")):
+                continue
+            if "test" in f:  # gtest-registered dummy ops aren't capabilities
+                continue
+            try:
+                text = open(os.path.join(dirpath, f), errors="ignore").read()
+            except OSError:
+                continue
+            for m in _REG.finditer(text):
+                names.add(m.group(1))
+            for m in _REG2.finditer(text):
+                names.add(m.group(1))
+    # grad registrations aren't separate capabilities (vjp-synthesized);
+    # op_name/op_type are the REGISTER_OPERATOR macro's formal parameters
+    # (op_registry.h:197, reader_op_registry.h:92), not ops
+    return {n for n in names if not n.endswith("_grad")
+            and not n.endswith("_grad2")
+            and n not in ("op_name", "op_type")}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference", default="/root/reference")
+    ap.add_argument("--missing", action="store_true",
+                    help="list genuinely missing op names")
+    args = ap.parse_args()
+
+    from paddle_tpu.core.registry import _REGISTRY, has_op_def
+
+    ref = reference_ops(args.reference)
+    covered = {n for n in ref if has_op_def(n)}
+    absent_by_design = {n for n in ref - covered if n in BY_DESIGN_ABSENT}
+    missing = sorted(ref - covered - absent_by_design)
+
+    print(f"reference forward op types : {len(ref)}")
+    print(f"covered by the registry    : {len(covered)}")
+    print(f"by-design absent           : {len(absent_by_design)}")
+    print(f"genuinely missing          : {len(missing)}")
+    print(f"registry total (incl. TPU-first extras): {len(_REGISTRY)}")
+    if args.missing or missing:
+        for n in missing:
+            print(f"  MISSING {n}")
+    for n in sorted(absent_by_design):
+        print(f"  by-design: {n} — {BY_DESIGN_ABSENT[n]}")
+    return 0 if not missing else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
