@@ -1,0 +1,59 @@
+"""Find tests that started but never finished in a pytest log (reference
+tools/check_ctest_hung.py, adapted from ctest logs to `pytest -v` /
+`pytest -rA` output).
+
+    python -m pytest tests/ -v | tee run.log   # (even if it hung/was killed)
+    python tools/check_test_hung.py run.log
+
+Prints the set of test ids with no recorded outcome — the hang suspects.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+_STARTED = re.compile(r"^(tests/[\w/]+\.py::[\w\[\]\-\.]+)")
+_OUTCOME = re.compile(
+    r"(PASSED|FAILED|ERROR|SKIPPED|XFAIL|XPASS)\s+"
+    r"(tests/[\w/]+\.py::[\w\[\]\-\.]+)")
+_INLINE = re.compile(
+    r"^(tests/[\w/]+\.py::[\w\[\]\-\.]+)\s+"
+    r"(PASSED|FAILED|ERROR|SKIPPED|XFAIL|XPASS)")
+
+
+def scan(lines):
+    started, finished = set(), set()
+    for line in lines:
+        line = line.rstrip("\r\n")
+        m = _INLINE.match(line)
+        if m:
+            started.add(m.group(1))
+            finished.add(m.group(1))
+            continue
+        m = _STARTED.match(line)
+        if m:
+            started.add(m.group(1))
+        m = _OUTCOME.search(line)
+        if m:
+            finished.add(m.group(2))
+    return started - finished
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 0
+    with open(sys.argv[1], errors="replace") as f:
+        hung = scan(f)
+    if hung:
+        print("Hung (started, no outcome):")
+        for t in sorted(hung):
+            print(" ", t)
+        return 1
+    print("No hung tests found.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
