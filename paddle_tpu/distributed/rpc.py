@@ -352,9 +352,23 @@ class RPCClient:
 
     def call(self, endpoint: str, msg_type: str, payload=None):
         conn, lock = self._get_conn(endpoint)
-        with lock:
-            _send_msg(conn, (msg_type, payload))
-            status, reply = _recv_msg(conn)
+        try:
+            with lock:
+                _send_msg(conn, (msg_type, payload))
+                status, reply = _recv_msg(conn)
+        except (ConnectionError, OSError):
+            # evict the dead cached socket so the next call reconnects
+            # (e.g. a pserver restart in the elastic path)
+            with self._global_lock:
+                cached = self._conns.get(endpoint)
+                if cached is conn:
+                    try:
+                        cached.close()
+                    except OSError:
+                        pass
+                    del self._conns[endpoint]
+                    del self._locks[endpoint]
+            raise
         if status == "error":
             raise RuntimeError(
                 f"RPC '{msg_type}' to {endpoint} failed: {reply}")
@@ -396,3 +410,100 @@ def global_rpc_client() -> RPCClient:
         if _global_client is None:
             _global_client = RPCClient()
         return _global_client
+
+
+class HeartbeatMonitor:
+    """Liveness tracking over the RPC control plane (the failure-detection
+    half the reference keeps minimal — retries + complete-notify; this
+    adds the elastic-training primitive: per-peer heartbeats with a
+    deadline, reference analog: fleet elastic heartbeat loops).
+
+    Server side: monitor = HeartbeatMonitor(timeout); server.register_handler
+    ("heartbeat", monitor.beat).  Client side:
+    HeartbeatSender(None, endpoint, peer_id).start() spawns a daemon
+    thread beating every interval seconds.
+    """
+
+    def __init__(self, timeout=10.0):
+        self.timeout = float(timeout)
+        self._last_seen: dict = {}
+        self._lock = threading.Lock()
+
+    def beat(self, peer_id):
+        import time
+
+        with self._lock:
+            self._last_seen[str(peer_id)] = time.monotonic()
+        return len(self._last_seen)
+
+    def peers(self):
+        with self._lock:
+            return sorted(self._last_seen)
+
+    def live_peers(self):
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            return sorted(p for p, t in self._last_seen.items()
+                          if now - t <= self.timeout)
+
+    def dead_peers(self):
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            return sorted(p for p, t in self._last_seen.items()
+                          if now - t > self.timeout)
+
+    def forget(self, peer_id):
+        with self._lock:
+            self._last_seen.pop(str(peer_id), None)
+
+
+class HeartbeatSender:
+    """Daemon thread beating a server's 'heartbeat' handler (client half
+    of HeartbeatMonitor).
+
+    client=None (recommended) uses a DEDICATED short-timeout RPCClient so
+    a stuck beat can never hold a shared client's connection locks and
+    stall foreground RPCs."""
+
+    def __init__(self, client, endpoint, peer_id, interval=1.0):
+        if client is None:
+            client = RPCClient()
+            client._TIMEOUT = max(2.0, 2 * float(interval))
+            self._owns_client = True
+        else:
+            self._owns_client = False
+        self._client = client
+        self._endpoint = endpoint
+        self._peer_id = str(peer_id)
+        self._interval = float(interval)
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self  # idempotent
+        self._stop.clear()  # restartable after stop()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self._client.call(self._endpoint, "heartbeat",
+                                      self._peer_id)
+                except Exception:
+                    pass  # server down: the monitor times us out anyway
+                self._stop.wait(self._interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self._interval + 1.0)
+        if self._owns_client:
+            self._client.close()

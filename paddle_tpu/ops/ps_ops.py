@@ -48,7 +48,8 @@ register_op("fetch_barrier", inputs=(), outputs=(),
 register_op("listen_and_serv", inputs=(), outputs=(),
             attrs={"endpoint": REQUIRED, "Fanin": 1, "sync_mode": True,
                    "grad_blocks": [], "lr_names": [],
-                   "sparse_grad_blocks": []},
+                   "sparse_grad_blocks": [],
+                   "heartbeat_timeout": 10.0},
             differentiable=False, host_only=True)(_structural)
 register_op("ps_sync_init", inputs=("X",), outputs=(),
             duplicable=("X",), optional=("X",),
@@ -347,6 +348,17 @@ def listen_and_serv_op(op, block, scope, ctx):
                         dirname, name.replace("/", "_") + ".npy"),
                         _np(v))
 
+    # elastic liveness (beyond the reference's retry+complete minimum):
+    # trainers may heartbeat; anyone can query live/dead trainer sets
+    from paddle_tpu.distributed.rpc import HeartbeatMonitor
+
+    hb_monitor = HeartbeatMonitor(
+        timeout=float(attrs.get("heartbeat_timeout", 10.0)))
+    server.register_handler("heartbeat", hb_monitor.beat)
+    server.register_handler("live_trainers",
+                            lambda _: hb_monitor.live_peers())
+    server.register_handler("dead_trainers",
+                            lambda _: hb_monitor.dead_peers())
     server.register_handler("send_var", on_send_var)
     server.register_handler("send_barrier", on_send_barrier)
     server.register_handler("get_var", on_get_var)

@@ -165,3 +165,87 @@ def test_server_survives_bad_frames_and_bad_replies():
         s.close()
     finally:
         server.stop()
+
+
+def test_heartbeat_monitor_liveness():
+    """Elastic liveness primitive (beyond the reference's retry +
+    complete-notify failure handling): peers beat, the monitor times
+    out the silent ones."""
+    import time
+
+    from paddle_tpu.distributed.rpc import (HeartbeatMonitor,
+                                            HeartbeatSender)
+
+    server = RPCServer("127.0.0.1:0").start()
+    mon = HeartbeatMonitor(timeout=0.8)
+    server.register_handler("heartbeat", mon.beat)
+    try:
+        client = RPCClient()
+        hb1 = HeartbeatSender(client, server.endpoint, "trainer0",
+                              interval=0.2).start()
+        hb2 = HeartbeatSender(client, server.endpoint, "trainer1",
+                              interval=0.2).start()
+        time.sleep(0.6)
+        assert mon.live_peers() == ["trainer0", "trainer1"]
+        assert mon.dead_peers() == []
+        hb1.stop()
+        time.sleep(1.4)
+        assert mon.dead_peers() == ["trainer0"]
+        assert mon.live_peers() == ["trainer1"]
+        mon.forget("trainer0")
+        assert mon.peers() == ["trainer1"]
+        hb2.stop()
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_heartbeat_survives_server_restart():
+    """Review regression: the dead cached socket is evicted on failure,
+    so heartbeats (and any RPC) recover when the server comes back on
+    the same port; HeartbeatSender is restartable after stop()."""
+    import time
+
+    from paddle_tpu.distributed.rpc import (HeartbeatMonitor,
+                                            HeartbeatSender)
+
+    server = RPCServer("127.0.0.1:0").start()
+    host, port = server.endpoint.rsplit(":", 1)
+    mon = HeartbeatMonitor(timeout=1.0)
+    server.register_handler("heartbeat", mon.beat)
+    hb = HeartbeatSender(None, server.endpoint, "t0", interval=0.2)
+    hb.start()
+    hb.start()  # idempotent
+    try:
+        time.sleep(0.5)
+        assert mon.live_peers() == ["t0"]
+        server.stop()
+        time.sleep(0.5)
+        server2 = RPCServer(f"127.0.0.1:{port}").start()
+        mon2 = HeartbeatMonitor(timeout=1.0)
+        server2.register_handler("heartbeat", mon2.beat)
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline and \
+                    mon2.live_peers() != ["t0"]:
+                time.sleep(0.2)
+            assert mon2.live_peers() == ["t0"]
+        finally:
+            server2.stop()
+    finally:
+        hb.stop()
+    # restart after stop() beats again
+    server3 = RPCServer("127.0.0.1:0").start()
+    mon3 = HeartbeatMonitor(timeout=1.0)
+    server3.register_handler("heartbeat", mon3.beat)
+    try:
+        hb.stop()
+        hb3 = HeartbeatSender(None, server3.endpoint, "x", interval=0.2)
+        hb3.start()
+        hb3.stop()
+        hb3.start()
+        time.sleep(0.5)
+        assert mon3.live_peers() == ["x"]
+        hb3.stop()
+    finally:
+        server3.stop()
