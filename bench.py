@@ -210,6 +210,80 @@ def bench_transformer_train(batch=32, seq=512, chain=30):
     }
 
 
+def bench_bert_train(batch=8, seq=512, chain=20):
+    """BASELINE workload 4: BERT-base pretraining seq-512 (MLM+NSP)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, optimizer
+    from paddle_tpu.models.bert import bert_inputs_synthetic, bert_model
+
+    _fresh_programs()
+    d_model, n_layer, d_inner, vocab = 768, 12, 3072, 30522
+    model = bert_model(vocab_size=vocab, max_len=seq, d_model=d_model,
+                       n_head=12, d_inner=d_inner, n_layer=n_layer,
+                       dropout_rate=0.0)
+    optimizer.Adam(learning_rate=1e-4).minimize(model["loss"])
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+
+    feed = {k: jax.device_put(jnp.asarray(v))
+            for k, v in bert_inputs_synthetic(batch, seq, vocab).items()}
+    fn, state = _build_compiled_fn(compiled, feed, [model["loss"].name])
+    sec_per_step, _ = _chain_timed(fn, state, feed, model["loss"].name,
+                                   chain)
+    toks_per_sec = batch * seq / sec_per_step
+    # embeddings + per-layer attn/FFN + the untied MLM decoder
+    # projection (d_model*vocab) — same accounting as the transformer
+    # bench so the two MFU numbers are comparable
+    n_params = (vocab * d_model + seq * d_model + 2 * d_model
+                + n_layer * (4 * d_model * d_model
+                             + 2 * d_model * d_inner)
+                + d_model * vocab)
+    peak, kind = _chip_peak_flops()
+    fpt = _transformer_train_flops_per_token(n_params, d_model, n_layer,
+                                             seq)
+    mfu = fpt * toks_per_sec / peak
+    return {"tokens_per_sec": round(toks_per_sec, 1),
+            "step_ms": round(sec_per_step * 1e3, 3),
+            "mfu_pct": round(100 * mfu, 2),
+            "batch": batch, "seq": seq, "device": kind}
+
+
+def bench_deepfm_train(batch=2048, chain=30):
+    """BASELINE workload 5: DeepFM CTR (sparse lookup + dense DNN)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, optimizer
+    from paddle_tpu.models.deepfm import deepfm_model
+
+    _fresh_programs()
+    model = deepfm_model(is_sparse=False)  # dense lookups jit whole-graph
+    optimizer.Adam(learning_rate=1e-3).minimize(model["loss"])
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "sparse_ids": jax.device_put(jnp.asarray(
+            rng.randint(0, 100_000, (batch, 26, 1)).astype(np.int64))),
+        "dense_x": jax.device_put(jnp.asarray(
+            rng.rand(batch, 13).astype(np.float32))),
+        "label": jax.device_put(jnp.asarray(
+            rng.randint(0, 2, (batch, 1)).astype(np.int64))),
+    }
+    fn, state = _build_compiled_fn(compiled, feed, [model["loss"].name])
+    sec_per_step, _ = _chain_timed(fn, state, feed, model["loss"].name,
+                                   chain)
+    return {"examples_per_sec": round(batch / sec_per_step, 1),
+            "step_ms": round(sec_per_step * 1e3, 3), "batch": batch}
+
+
 def _bench_infer(model_builder, feed_builder, fetch_key, chain):
     """Shared bf16-inference bench: build through the IR, clone for test,
     NHWC + bf16 transpile, compile, chain-timed run."""
@@ -358,6 +432,8 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     rn_train = bench_resnet50_train()
     tf_train = bench_transformer_train()
+    bert_train = bench_bert_train()
+    dfm_train = bench_deepfm_train()
     infer = bench_resnet50_infer()
     infer_i8 = bench_resnet50_infer_int8()
     vgg_infer = bench_vgg16_infer()
@@ -372,6 +448,8 @@ def main():
         "extras": {
             "resnet50_train": rn_train,
             "transformer_base_train": tf_train,
+            "bert_base_train_seq512": bert_train,
+            "deepfm_ctr_train": dfm_train,
             "resnet50_infer_bf16_mb128": {
                 **infer,
                 "vs_v100_fp16_baseline": round(

@@ -398,6 +398,7 @@ class RPCClient:
                 except OSError:
                     pass
             self._conns.clear()
+            self._locks.clear()
 
 
 _global_client = None
@@ -485,7 +486,12 @@ class HeartbeatSender:
 
     def start(self):
         if self._thread is not None and self._thread.is_alive():
-            return self  # idempotent
+            if not self._stop.is_set():
+                return self  # genuinely running: idempotent
+            # a previous stop() timed out mid-beat: wait the old loop
+            # out before spawning, or heartbeats would silently never
+            # resume once it exits
+            self._thread.join()
         self._stop.clear()  # restartable after stop()
 
         def loop():

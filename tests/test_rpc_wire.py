@@ -185,13 +185,19 @@ def test_heartbeat_monitor_liveness():
                               interval=0.2).start()
         hb2 = HeartbeatSender(client, server.endpoint, "trainer1",
                               interval=0.2).start()
-        time.sleep(0.6)
-        assert mon.live_peers() == ["trainer0", "trainer1"]
+
+        def until(cond, deadline=8.0):
+            end = time.time() + deadline
+            while time.time() < end and not cond():
+                time.sleep(0.1)
+            return cond()
+
+        assert until(lambda: mon.live_peers() ==
+                     ["trainer0", "trainer1"])
         assert mon.dead_peers() == []
         hb1.stop()
-        time.sleep(1.4)
-        assert mon.dead_peers() == ["trainer0"]
-        assert mon.live_peers() == ["trainer1"]
+        assert until(lambda: mon.dead_peers() == ["trainer0"])
+        assert until(lambda: "trainer1" in mon.live_peers())
         mon.forget("trainer0")
         assert mon.peers() == ["trainer1"]
         hb2.stop()
