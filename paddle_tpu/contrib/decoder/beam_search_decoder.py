@@ -227,10 +227,13 @@ class BeamSearchDecoder:
         under an identical unique_name counter snapshot, so every step
         regenerates the SAME parameter names (embedding table, score fc,
         and whatever the user's state updater creates) — one shared set
-        of weights, exactly like ops re-executing inside the reference's
-        While block.  Cross-step values (selected ids/parents, states)
-        are snapshotted into fresh outer-named vars with assign so the
-        collected outputs stay distinct."""
+        of weights, like ops re-executing inside the reference's While
+        block — and those names are the updater's NATURAL names (no
+        decoder prefix), so params line up with a training program built
+        in the same order, the fluid load-by-name idiom the reference
+        decode test relies on.  Cross-step plumbing (state snapshots,
+        selected ids/parents, the final backtrack) is built under a
+        'bsd/' name prefix so it can never collide with step names."""
         from paddle_tpu import layers, unique_name
         from paddle_tpu.layers.helper import LayerHelper
 
@@ -259,13 +262,15 @@ class BeamSearchDecoder:
                 st, shape=[-1, int(st.shape[-1])]))
 
         step_ids, step_parents = [], []
+        # every step rebuilds from this exact counter state, so all
+        # steps regenerate identical, NATURAL names (params shared
+        # across the unroll AND matchable against a training program);
+        # cross-step plumbing accumulates in outer_counters under the
+        # 'bsd/' prefix, disjoint from the repeating step names
+        entry_counters = dict(unique_name._counters)
+        outer_counters = dict(entry_counters)
         for _ in range(self._max_len):
-            # every step rebuilds under a fresh 'bsd_step' name guard, so
-            # all steps generate IDENTICAL (prefixed) names: parameters
-            # are shared across the unroll, and the prefix keeps step
-            # names from colliding with outer vars
-            step_guard = unique_name.guard("bsd_step")
-            step_guard.__enter__()
+            unique_name.switch(dict(entry_counters))
             ids_flat = layers.reshape(prev_ids, shape=[-1, 1])
             emb = layers.embedding(
                 ids_flat, size=[self._target_dict_dim, self._word_dim],
@@ -305,19 +310,28 @@ class BeamSearchDecoder:
                 st_bkd = layers.reshape(st, shape=[-1, K, d])
                 picked = _gather_by_parent(st_bkd, parent_idx)
                 gathered[name] = layers.reshape(picked, shape=[-1, d])
-            # back to outer names: snapshot everything that crosses steps
-            step_guard.__exit__(None, None, None)
-            for name, val in gathered.items():
-                sc.set_state(name, layers.assign(val))
-            sel_ids = layers.assign(sel_ids)
-            sel_scores = layers.assign(sel_scores)
-            parent_idx = layers.assign(parent_idx)
+            # cross-step snapshots: outer_counters persists across the
+            # loop so each step's 'bsd/assign_*' names stay distinct
+            unique_name.switch(outer_counters)
+            unique_name._prefix.append("bsd")
+            try:
+                for name, val in gathered.items():
+                    sc.set_state(name, layers.assign(val))
+                sel_ids = layers.assign(sel_ids)
+                sel_scores = layers.assign(sel_scores)
+                parent_idx = layers.assign(parent_idx)
+            finally:
+                unique_name._prefix.pop()
             step_ids.append(sel_ids)
             step_parents.append(parent_idx)
             prev_ids, prev_scores = sel_ids, sel_scores
 
-        ids_tbk = layers.stack(step_ids, axis=0)        # [T, B, K]
-        parents_tbk = layers.stack(step_parents, axis=0)
+        unique_name._prefix.append("bsd")
+        try:
+            ids_tbk = layers.stack(step_ids, axis=0)    # [T, B, K]
+            parents_tbk = layers.stack(step_parents, axis=0)
+        finally:
+            unique_name._prefix.pop()
         helper = LayerHelper("beam_search_decode")
         sent_ids = helper.create_variable_for_type_inference("int64")
         sent_scores = helper.create_variable_for_type_inference("float32")

@@ -723,3 +723,115 @@ def test_paddle_ps_instance_indices_consistent():
 
     with pytest.raises(ValueError):
         PaddlePSInstance(1, 3)
+
+
+def test_beam_search_decoder_shares_trained_weights_by_name():
+    """The fluid idiom the reference decode test relies on (reference
+    tests/test_beam_search_decoder.py): train with TrainingDecoder,
+    build the decode program in the SAME scope with matching creation
+    order, and BeamSearchDecoder's steps run on the TRAINED weights
+    (natural param names, no decoder prefix)."""
+    from paddle_tpu.contrib.decoder import BeamSearchDecoder, TrainingDecoder
+    from paddle_tpu.optimizer import Adam
+
+    V, D, B, T = 6, 8, 4, 3
+    TARGET = 3
+
+    def build_cell(ctx):
+        from paddle_tpu.contrib.decoder import InitState, StateCell
+
+        h = InitState(init=ctx)
+        sc = StateCell(inputs={"x": None}, states={"h": h},
+                       out_state="h")
+
+        @sc.state_updater
+        def up(cell):
+            cell.set_state("h", layers.fc(
+                layers.concat([cell.get_state("h"),
+                               cell.get_input("x")], axis=-1),
+                size=D, act="tanh"))
+
+        return sc
+
+    with scope_guard(Scope()):
+        np.random.seed(0)
+        # ---- training program: teacher-forced, label = TARGET always
+        train_prog, sprog = Program(), Program()
+        with program_guard(train_prog, sprog):
+            with unique_name.guard():
+                ctx = layers.data(name="ctx", shape=[B, D],
+                                  dtype="float32",
+                                  append_batch_size=False)
+                trg_ids = layers.data(name="trg_ids", shape=[B, T, 1],
+                                      dtype="int64",
+                                      append_batch_size=False)
+                # embedding FIRST: same creation order as decode()
+                emb = layers.embedding(
+                    layers.reshape(trg_ids, shape=[-1, 1]),
+                    size=[V, D], dtype="float32")
+                emb = layers.reshape(emb, shape=[B, T, D])
+                sc = build_cell(ctx)
+                decoder = TrainingDecoder(sc)
+                with decoder.block():
+                    word = decoder.step_input(emb)
+                    decoder.state_cell.compute_state(inputs={"x": word})
+                    score = layers.fc(decoder.state_cell.get_state("h"),
+                                      size=V, act="softmax")
+                    decoder.state_cell.update_states()
+                    decoder.output(score)
+                out = decoder()
+                label = layers.data(name="label", shape=[B, T, 1],
+                                    dtype="int64",
+                                    append_batch_size=False)
+                loss = layers.mean(layers.cross_entropy(
+                    layers.reshape(out, shape=[-1, V]),
+                    layers.reshape(label, shape=[-1, 1])))
+                Adam(learning_rate=0.1).minimize(loss)
+        exe = Executor()
+        exe.run(sprog)
+        rng = np.random.RandomState(0)
+        feed = {"ctx": rng.rand(B, D).astype(np.float32),
+                "trg_ids": rng.randint(0, V, (B, T, 1)).astype(np.int64),
+                "label": np.full((B, T, 1), TARGET, np.int64)}
+        for _ in range(40):
+            lv, = exe.run(train_prog, feed=feed, fetch_list=[loss])
+        assert float(np.ravel(lv)[0]) < 0.1  # learned "always TARGET"
+
+        # ---- decode program in the SAME scope, matching build order
+        infer_prog, isprog = Program(), Program()
+        with program_guard(infer_prog, isprog):
+            with unique_name.guard():
+                ctx2 = layers.data(name="ctx", shape=[B, D],
+                                   dtype="float32",
+                                   append_batch_size=False)
+                ii = layers.data(name="init_ids", shape=[B, 1],
+                                 dtype="int64", append_batch_size=False)
+                isc = layers.data(name="init_scores", shape=[B, 1],
+                                  dtype="float32",
+                                  append_batch_size=False)
+                sc2 = build_cell(ctx2)
+                dec = BeamSearchDecoder(
+                    state_cell=sc2, init_ids=ii, init_scores=isc,
+                    target_dict_dim=V, word_dim=D, topk_size=V,
+                    max_len=T, beam_size=2, end_id=V - 1)
+                dec.decode()
+                tid, tsc = dec()
+        # params must be the TRAINED ones: names match, so skip the
+        # decode startup (isprog) entirely — scope already has them
+        train_params = {v.name for v in
+                        train_prog.global_block().vars.values()
+                        if getattr(v, "trainable", False)}
+        dec_params = {v.name for v in
+                      infer_prog.global_block().vars.values()
+                      if getattr(v, "trainable", False)}
+        assert dec_params <= train_params, (
+            dec_params - train_params)
+        ids, _ = exe.run(infer_prog,
+                         feed={"ctx": feed["ctx"],
+                               "init_ids": np.zeros((B, 1), np.int64),
+                               "init_scores": np.zeros((B, 1),
+                                                       np.float32)},
+                         fetch_list=[tid, tsc])
+        # the trained model emits TARGET at (nearly) every step
+        frac = float((np.asarray(ids)[:, 0] == TARGET).mean())
+        assert frac > 0.9, (frac, ids)
