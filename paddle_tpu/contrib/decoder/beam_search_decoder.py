@@ -235,6 +235,7 @@ class BeamSearchDecoder:
         selected ids/parents, the final backtrack) is built under a
         'bsd/' name prefix so it can never collide with step names."""
         from paddle_tpu import layers, unique_name
+        from paddle_tpu.framework import name_scope
         from paddle_tpu.layers.helper import LayerHelper
 
         sc = self._state_cell
@@ -269,6 +270,7 @@ class BeamSearchDecoder:
         # 'bsd/' prefix, disjoint from the repeating step names
         entry_counters = dict(unique_name._counters)
         outer_counters = dict(entry_counters)
+        step_end_counters = {}
         for _ in range(self._max_len):
             unique_name.switch(dict(entry_counters))
             ids_flat = layers.reshape(prev_ids, shape=[-1, 1])
@@ -310,28 +312,30 @@ class BeamSearchDecoder:
                 st_bkd = layers.reshape(st, shape=[-1, K, d])
                 picked = _gather_by_parent(st_bkd, parent_idx)
                 gathered[name] = layers.reshape(picked, shape=[-1, d])
+            step_end_counters = dict(unique_name._counters)
             # cross-step snapshots: outer_counters persists across the
             # loop so each step's 'bsd/assign_*' names stay distinct
             unique_name.switch(outer_counters)
-            unique_name._prefix.append("bsd")
-            try:
+            with name_scope("bsd"):
                 for name, val in gathered.items():
                     sc.set_state(name, layers.assign(val))
                 sel_ids = layers.assign(sel_ids)
                 sel_scores = layers.assign(sel_scores)
                 parent_idx = layers.assign(parent_idx)
-            finally:
-                unique_name._prefix.pop()
             step_ids.append(sel_ids)
             step_parents.append(parent_idx)
             prev_ids, prev_scores = sel_ids, sel_scores
 
-        unique_name._prefix.append("bsd")
-        try:
+        # post-loop: advance past one full step's names so anything the
+        # CALLER builds after decode() cannot collide with (or silently
+        # share) the step-internal layers — outer_counters only knows
+        # the entry snapshot + 'bsd/' names
+        for key, count in step_end_counters.items():
+            if outer_counters.get(key, 0) < count:
+                outer_counters[key] = count
+        with name_scope("bsd"):
             ids_tbk = layers.stack(step_ids, axis=0)    # [T, B, K]
             parents_tbk = layers.stack(step_parents, axis=0)
-        finally:
-            unique_name._prefix.pop()
         helper = LayerHelper("beam_search_decode")
         sent_ids = helper.create_variable_for_type_inference("int64")
         sent_scores = helper.create_variable_for_type_inference("float32")

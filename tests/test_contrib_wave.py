@@ -835,3 +835,39 @@ def test_beam_search_decoder_shares_trained_weights_by_name():
         # the trained model emits TARGET at (nearly) every step
         frac = float((np.asarray(ids)[:, 0] == TARGET).mean())
         assert frac > 0.9, (frac, ids)
+
+
+def test_beam_search_decoder_post_decode_layers_do_not_collide():
+    """Review regression: layers built AFTER decode() in the same
+    program get fresh names — no silent sharing/corruption of the
+    decoder's step-internal params."""
+    from paddle_tpu.contrib.decoder import BeamSearchDecoder
+
+    with scope_guard(Scope()):
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                ctx = layers.data(name="ctx", shape=[2, 4],
+                                  dtype="float32",
+                                  append_batch_size=False)
+                ii = layers.data(name="ii", shape=[2, 1], dtype="int64",
+                                 append_batch_size=False)
+                isc = layers.data(name="isc", shape=[2, 1],
+                                  dtype="float32",
+                                  append_batch_size=False)
+                sc = _build_state_cell(ctx)
+                dec = BeamSearchDecoder(
+                    state_cell=sc, init_ids=ii, init_scores=isc,
+                    target_dict_dim=11, word_dim=4, topk_size=11,
+                    max_len=4, beam_size=2, end_id=1)
+                dec.decode()
+                params_before = {
+                    v.name for v in prog.global_block().vars.values()
+                    if getattr(v, "trainable", False)}
+                post = layers.fc(ctx, size=4)  # was the crash repro
+                params_after = {
+                    v.name for v in prog.global_block().vars.values()
+                    if getattr(v, "trainable", False)}
+        new_params = params_after - params_before
+        assert new_params and all(
+            p not in params_before for p in new_params)
