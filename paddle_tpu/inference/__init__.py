@@ -30,6 +30,8 @@ class Config:
     """reference paddle_analysis_config.h (knobs that map to GPU/TRT/MKLDNN
     are kept as recorded no-ops so reference configs port unchanged)."""
 
+    _ir_optim = True
+
     def __init__(self, model_dir=None, prog_file=None, params_file=None):
         self._model_dir = model_dir
         self._prog_file = prog_file
@@ -63,7 +65,11 @@ class Config:
         pass
 
     def switch_ir_optim(self, enable=True):
-        pass
+        """Toggle the analysis pass pipeline (reference
+        analysis_config.cc SwitchIrOptim -> ir_pass_manager.cc): conv-bn
+        fold + fc fuse + elewise-add-act fuse on load.  On by default
+        like the reference."""
+        self._ir_optim = bool(enable)
 
     def switch_use_feed_fetch_ops(self, enable=True):
         self._use_feed_fetch_ops = enable
@@ -129,6 +135,18 @@ class Predictor:
         with scope_guard(self._scope):
             self._program, self._feed_names, self._fetch_vars = \
                 io.load_inference_model(model_dir, self._exe, **kwargs)
+            if getattr(config, "_ir_optim", True):
+                # the analysis pass pipeline (reference analyzer.cc ->
+                # ir_pass_manager.cc): weight-folding + op fusions at
+                # the IR level; XLA does the rest at compile time
+                from paddle_tpu.transpiler import (
+                    FuseElewiseAddActTranspiler, FuseFCTranspiler,
+                    InferenceTranspiler)
+
+                InferenceTranspiler().transpile(self._program,
+                                                scope=self._scope)
+                FuseFCTranspiler().transpile(self._program)
+                FuseElewiseAddActTranspiler().transpile(self._program)
         self._compiled = CompiledProgram(self._program) \
             .with_inference_optimize(config)
         self._inputs = {n: PaddleTensor(n) for n in self._feed_names}

@@ -223,3 +223,56 @@ def test_fusion_passes_guard_unsupported_patterns():
         for x, y in zip(base, after):
             np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                        rtol=1e-6)
+
+
+def test_predictor_analysis_pass_pipeline(tmp_path):
+    """Predictor applies the analysis pass pipeline on load (reference
+    analysis_predictor.cc -> ir_pass_manager.cc): conv-bn fold + fc
+    fuse + add-act fuse, numerics unchanged; switch_ir_optim(False)
+    keeps the raw graph (reference SwitchIrOptim)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, unique_name
+    from paddle_tpu.core.executor import Executor
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.inference import Config, Predictor
+
+    with scope_guard(Scope()):
+        np.random.seed(0)
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                x = layers.data(name="x", shape=[3, 8, 8],
+                                dtype="float32")
+                c = layers.conv2d(x, num_filters=4, filter_size=3,
+                                  padding=1, bias_attr=False)
+                b = layers.batch_norm(c, is_test=True)
+                h = layers.fc(b, size=10, act="relu")
+                pred = layers.fc(h, size=3)
+        exe = Executor()
+        exe.run(sprog)
+        feed = {"x": np.random.rand(2, 3, 8, 8).astype(np.float32)}
+        base, = exe.run(prog, feed=feed, fetch_list=[pred])
+        d = str(tmp_path / "model")
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=prog)
+
+    p = Predictor(Config(d))
+    types = [op.type for op in p._program.global_block().ops]
+    assert "batch_norm" not in types        # folded into conv
+    assert "mul" not in types               # fc-fused
+    assert types.count("fc") == 2
+    inp = p.get_input_handle("x")
+    inp.copy_from_cpu(feed["x"])
+    p.run()
+    out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(np.asarray(out), base, rtol=2e-4,
+                               atol=1e-5)
+
+    cfg2 = Config(d)
+    cfg2.switch_ir_optim(False)
+    p2 = Predictor(cfg2)
+    types2 = [op.type for op in p2._program.global_block().ops]
+    assert "batch_norm" in types2 and "mul" in types2
