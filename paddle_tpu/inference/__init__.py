@@ -30,9 +30,8 @@ class Config:
     """reference paddle_analysis_config.h (knobs that map to GPU/TRT/MKLDNN
     are kept as recorded no-ops so reference configs port unchanged)."""
 
-    _ir_optim = True
-
     def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self._ir_optim = True
         self._model_dir = model_dir
         self._prog_file = prog_file
         self._params_file = params_file
@@ -135,7 +134,7 @@ class Predictor:
         with scope_guard(self._scope):
             self._program, self._feed_names, self._fetch_vars = \
                 io.load_inference_model(model_dir, self._exe, **kwargs)
-            if getattr(config, "_ir_optim", True):
+            if config._ir_optim:
                 # the analysis pass pipeline (reference analyzer.cc ->
                 # ir_pass_manager.cc): weight-folding + op fusions at
                 # the IR level; XLA does the rest at compile time
@@ -143,10 +142,16 @@ class Predictor:
                     FuseElewiseAddActTranspiler, FuseFCTranspiler,
                     InferenceTranspiler)
 
-                InferenceTranspiler().transpile(self._program,
-                                                scope=self._scope)
-                FuseFCTranspiler().transpile(self._program)
-                FuseElewiseAddActTranspiler().transpile(self._program)
+                protected = set(self._feed_names) | {
+                    f if isinstance(f, str) else f.name
+                    for f in self._fetch_vars}
+                InferenceTranspiler().transpile(
+                    self._program, scope=self._scope,
+                    protected=protected)
+                FuseFCTranspiler().transpile(self._program,
+                                             protected=protected)
+                FuseElewiseAddActTranspiler().transpile(
+                    self._program, protected=protected)
         self._compiled = CompiledProgram(self._program) \
             .with_inference_optimize(config)
         self._inputs = {n: PaddleTensor(n) for n in self._feed_names}

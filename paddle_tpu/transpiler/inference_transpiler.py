@@ -48,12 +48,16 @@ def _first_consumer(block, name, after_idx):
 class InferenceTranspiler:
     """reference inference_transpiler.py:25."""
 
-    def transpile(self, program, place=None, scope=None):
+    def transpile(self, program, place=None, scope=None,
+                  protected=None):
         """Fold conv2d (+ optional elementwise_add bias) -> batch_norm
-        chains.  Mutates `program` and the scope's weight values."""
+        chains.  Mutates `program` and the scope's weight values.
+        Vars named in `protected` (e.g. the model's fetch targets) are
+        never erased by a fold."""
         from paddle_tpu.core.scope import global_scope
 
         scope = scope or global_scope()
+        self._protected = frozenset(protected or ())
         block = program.global_block()
         changed = True
         while changed:
@@ -109,8 +113,10 @@ class InferenceTranspiler:
                 conv = prev
             else:
                 continue
-            # the bn input must feed ONLY this bn
-            if self._consumers(block, x_name) != 1:
+            # the bn input must feed ONLY this bn, and must not be a
+            # protected (fetch-target) var — the fold erases it
+            if self._consumers(block, x_name) != 1 or \
+                    x_name in getattr(self, "_protected", frozenset()):
                 continue
             y_name = op.outputs["Y"][0]
             self._fold(block, scope, conv, bias_op, op, x_name, y_name)
@@ -172,7 +178,8 @@ class FuseFCTranspiler:
 
     _ACTS = ("relu", "tanh", "sigmoid")
 
-    def transpile(self, program):
+    def transpile(self, program, protected=None):
+        self._protected = frozenset(protected or ())
         block = program.global_block()
         changed = True
         while changed:
@@ -180,6 +187,7 @@ class FuseFCTranspiler:
         return program
 
     def _fuse_one(self, block):
+        protected = getattr(self, "_protected", frozenset())
         for i, op in enumerate(block.ops):
             if op.type != "mul":
                 continue
@@ -192,8 +200,8 @@ class FuseFCTranspiler:
             if w_var.shape is None or len(w_var.shape) != 2:
                 continue
             out = op.outputs["Out"][0]
-            if _consumers(block, out) != 1:
-                continue
+            if _consumers(block, out) != 1 or out in protected:
+                continue  # fusing erases the mul output
             j, add_op = _first_consumer(block, out, i)
             if add_op is None or add_op.type != "elementwise_add" or \
                     add_op.inputs["X"][0] != out:
@@ -210,12 +218,14 @@ class FuseFCTranspiler:
                     int(bias_var.shape[0]) != int(w_var.shape[1]):
                 continue
             add_out = add_op.outputs["Out"][0]
-            # optional trailing activation
+            # optional trailing activation (not if add_out is a fetch
+            # target — folding the act would erase it)
             act_type = ""
             act_op = None
             _, cand = _first_consumer(block, add_out, j)
             if cand is not None and cand.type in self._ACTS and \
-                    _consumers(block, add_out) == 1:
+                    _consumers(block, add_out) == 1 and \
+                    add_out not in protected:
                 act_op = cand
                 act_type = cand.type
             final_out = act_op.outputs["Out"][0] if act_op else add_out
@@ -248,7 +258,8 @@ class FuseElewiseAddActTranspiler:
 
     _ACTS = ("relu", "tanh", "sigmoid")
 
-    def transpile(self, program):
+    def transpile(self, program, protected=None):
+        self._protected = frozenset(protected or ())
         block = program.global_block()
         changed = True
         while changed:
@@ -274,8 +285,9 @@ class FuseElewiseAddActTranspiler:
             if not self._trailing_broadcast(block, op):
                 continue
             out = op.outputs["Out"][0]
-            if _consumers(block, out) != 1:
-                continue
+            if _consumers(block, out) != 1 or \
+                    out in getattr(self, "_protected", frozenset()):
+                continue  # fusing erases the add output
             _, act_op = _first_consumer(block, out, i)
             if act_op is None or act_op.type not in self._ACTS:
                 continue

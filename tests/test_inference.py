@@ -276,3 +276,42 @@ def test_predictor_analysis_pass_pipeline(tmp_path):
     p2 = Predictor(cfg2)
     types2 = [op.type for op in p2._program.global_block().ops]
     assert "batch_norm" in types2 and "mul" in types2
+
+
+def test_predictor_fusion_preserves_intermediate_fetch_targets(tmp_path):
+    """Review regression: a fetch target that is an INTERMEDIATE (e.g.
+    pre-activation) must survive the analysis passes."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, unique_name
+    from paddle_tpu.core.executor import Executor
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.inference import Config, Predictor
+
+    with scope_guard(Scope()):
+        np.random.seed(0)
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                x = layers.data(name="x", shape=[4], dtype="float32")
+                pre = layers.fc(x, size=3)        # mul+add chain
+                act = layers.relu(pre)
+        exe = Executor()
+        exe.run(sprog)
+        feed = {"x": np.random.rand(2, 4).astype(np.float32)}
+        base_pre, base_act = exe.run(prog, feed=feed,
+                                     fetch_list=[pre, act])
+        d = str(tmp_path / "m")
+        fluid.io.save_inference_model(d, ["x"], [pre, act], exe,
+                                      main_program=prog)
+    p = Predictor(Config(d))
+    inp = p.get_input_handle("x")
+    inp.copy_from_cpu(feed["x"])
+    p.run()
+    outs = {n: p.get_output_handle(n).copy_to_cpu()
+            for n in p.get_output_names()}
+    got = sorted(np.asarray(v).sum() for v in outs.values())
+    want = sorted([base_pre.sum(), base_act.sum()])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
