@@ -25,3 +25,48 @@ class DataFeeder:
                 arr = arr.reshape(arr.shape + (1,))
             out[var.name] = arr
         return out
+
+    def feed_parallel(self, iterable, num_places=None):
+        """Multiple per-device mini-batches -> ONE feed dict with the
+        batches concatenated along axis 0 (reference data_feeder.py:292
+        feed_parallel).  The compiled data-parallel program shards the
+        leading axis back over the mesh, so concat-then-shard reproduces
+        the reference's per-device placement."""
+        batches = [self.feed(batch) for batch in iterable]
+        if num_places is not None and len(batches) != num_places:
+            raise ValueError(
+                f"feed_parallel got {len(batches)} mini-batches for "
+                f"{num_places} places")
+        if not batches:
+            raise ValueError("feed_parallel needs at least one batch")
+        out = {}
+        for var in self.feed_vars:
+            out[var.name] = np.concatenate(
+                [b[var.name] for b in batches], axis=0)
+        return out
+
+    def decorate_reader(self, reader, multi_devices=False,
+                        num_places=None, drop_last=True):
+        """Wrap a sample-batch reader into a feed-dict reader (reference
+        data_feeder.py:368).  With multi_devices=True, groups num_places
+        consecutive batches per step via feed_parallel."""
+        import jax
+
+        def single():
+            for batch in reader():
+                yield self.feed(batch)
+
+        def multi():
+            n = num_places or len(jax.devices())
+            group = []
+            for batch in reader():
+                group.append(batch)
+                if len(group) == n:
+                    yield self.feed_parallel(group, n)
+                    group = []
+            if group and not drop_last:
+                yield self.feed_parallel(group)
+            elif group and drop_last:
+                return
+
+        return multi if multi_devices else single

@@ -543,3 +543,37 @@ def test_fetch_deleted_var_raises(fresh_programs_factory):
             with pytest.raises(RuntimeError, match="no value"):
                 exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
                         fetch_list=[out.name])
+
+
+def test_data_feeder_parallel_and_decorate():
+    """reference data_feeder.py:292 feed_parallel / :368 decorate_reader:
+    per-device batches concatenate on axis 0 (the compiled DP program
+    shards them back over the mesh)."""
+    from paddle_tpu.data_feeder import DataFeeder
+
+    prog, sprog = Program(), Program()
+    with program_guard(prog, sprog):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        yv = layers.data(name="y", shape=[1], dtype="float32")
+    feeder = DataFeeder([x, yv])
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(9):
+            yield [(rng.rand(4).astype(np.float32),
+                    rng.rand(1).astype(np.float32)) for _ in range(2)]
+
+    fp = feeder.feed_parallel(
+        [[(np.ones(4, np.float32), np.zeros(1, np.float32))] * 2] * 4, 4)
+    assert fp["x"].shape == (8, 4)
+    with pytest.raises(ValueError):
+        feeder.feed_parallel([[(np.ones(4, np.float32),
+                                np.zeros(1, np.float32))]], 4)
+
+    multi = feeder.decorate_reader(reader, multi_devices=True,
+                                   num_places=4)
+    feeds = list(multi())
+    assert len(feeds) == 2               # 9 batches -> 2 full groups
+    assert feeds[0]["x"].shape == (8, 4)
+    single = feeder.decorate_reader(reader)
+    assert next(single())["x"].shape == (2, 4)
