@@ -65,8 +65,12 @@ class DataFeeder:
                     yield self.feed_parallel(group, n)
                     group = []
             if group and not drop_last:
-                yield self.feed_parallel(group)
-            elif group and drop_last:
-                return
+                # a partial group cannot shard evenly over the mesh —
+                # fail HERE instead of deep inside the compiled run
+                # (the reference's decorate_reader raises the same way)
+                raise ValueError(
+                    f"decorate_reader: {len(group)} leftover "
+                    f"mini-batches do not fill {n} devices; use "
+                    "drop_last=True or pad the reader")
 
         return multi if multi_devices else single

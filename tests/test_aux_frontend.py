@@ -575,5 +575,11 @@ def test_data_feeder_parallel_and_decorate():
     feeds = list(multi())
     assert len(feeds) == 2               # 9 batches -> 2 full groups
     assert feeds[0]["x"].shape == (8, 4)
+    # drop_last=False with a partial group raises at the reader, not
+    # deep inside the compiled run (review regression)
+    lax_reader = feeder.decorate_reader(reader, multi_devices=True,
+                                        num_places=4, drop_last=False)
+    with pytest.raises(ValueError, match="leftover"):
+        list(lax_reader())
     single = feeder.decorate_reader(reader)
     assert next(single())["x"].shape == (2, 4)
