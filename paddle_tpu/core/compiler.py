@@ -38,7 +38,46 @@ _SKIP_IN_TRACE = {"feed", "fetch", "print", "save", "load", "save_combine",
 
 
 class _TraceEnv(dict):
-    pass
+    """name -> traced array, plus poisoned names that raise a clear
+    error when anything reads them (host-only op outputs that cannot
+    join the XLA program)."""
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self._poisoned = {}
+
+    def poison(self, name, message):
+        self._poisoned[name] = message
+
+    def __setitem__(self, name, value):
+        # a later legitimate write (IR freely reuses names) un-poisons
+        self._poisoned.pop(name, None)
+        super().__setitem__(name, value)
+
+    def update(self, *a, **k):
+        for d in a:
+            for name in d:
+                self._poisoned.pop(name, None)
+        for name in k:
+            self._poisoned.pop(name, None)
+        super().update(*a, **k)
+
+    def poisoned(self, name):
+        return self._poisoned.get(name)
+
+    def __getitem__(self, name):
+        if name in self._poisoned:
+            raise RuntimeError(
+                f"compile: '{name}' is unavailable — "
+                + self._poisoned[name])
+        return super().__getitem__(name)
+
+    def get(self, name, default=None):
+        if name in self._poisoned:
+            raise RuntimeError(
+                f"compile: '{name}' is unavailable — "
+                + self._poisoned[name])
+        return super().get(name, default)
 
 
 def _program_fingerprint(program):
@@ -124,6 +163,7 @@ def _run_block_symbolic(program, block_idx, env):
             continue
         op_def = get_op_def(op.type)
         if op_def.host_only:
+            _trace_host_op(program, block_idx, op, op_def, env)
             continue
         ins = {}
         ok = True
@@ -158,6 +198,167 @@ def _run_block_symbolic(program, block_idx, env):
                 vals = [vals]
             for n, v in zip(names, vals):
                 env[n] = v
+
+
+_HOST_SKIP_SILENT = {
+    # side-effect / bootstrap ops with no data outputs the graph could
+    # consume (or whose outputs arrive via state/feeds instead).
+    # NOTE: feed/fetch/print/save/load-style ops never reach this set —
+    # _SKIP_IN_TRACE short-circuits them first.
+    "checkpoint_notify", "delete_var", "send", "recv", "send_barrier",
+    "fetch_barrier", "listen_and_serv", "create_py_reader", "read",
+    "py_reader", "fake_init", "ps_sync_init", "get_places",
+}
+
+
+def _lookup_var(program, block_idx, name):
+    """Var desc by name, walking the block parent chain."""
+    bidx = block_idx
+    while bidx >= 0:
+        block = program.blocks[bidx]
+        if name in block.vars:
+            return block.vars[name]
+        bidx = block.parent_idx
+    return None
+
+
+def _poison_or_raise(env, name, message):
+    poison = getattr(env, "poison", None)
+    if poison is not None:
+        poison(name, message)
+    else:
+        # sub-block envs are plain dicts: no lazy poisoning possible,
+        # fail here with the clear message instead of an AttributeError
+        raise RuntimeError(f"compile: '{name}' is unavailable — "
+                           + message)
+
+
+def _trace_host_op(program, block_idx, op, op_def, env):
+    """Host-only op inside the compiled trace.
+
+    TPU-native path: when every output var has a fully-known static
+    shape+dtype, the op runs as a jax.pure_callback — the host compute
+    becomes a node of the XLA program (the reference's C++ host kernels
+    run inline in its executor the same way).  Otherwise the op's
+    outputs are poisoned so any later consumer (or fetch) produces a
+    clear error instead of a silent skip / bare KeyError."""
+    import jax
+    import numpy as _np
+
+    from paddle_tpu.core.executor import _SPECIAL_OPS
+
+    out_slots = [(slot, i, n) for slot, names in op.outputs.items()
+                 for i, n in enumerate(names)]
+    # ops with an executor special handler (py_func, tensor arrays, ...)
+    # have computes that refuse to run standalone: never callback them
+    executor_only = op.type in _SPECIAL_OPS
+
+    specs = []
+    static = bool(out_slots) and not executor_only
+    if static:
+        for _, _, n in out_slots:
+            var = _lookup_var(program, block_idx, n)
+            shape = getattr(var, "shape", None) if var is not None \
+                else None
+            dtype = getattr(var, "dtype", None) if var is not None \
+                else None
+            if shape is None or dtype is None or any(
+                    d is None or int(d) < 0 for d in shape):
+                static = False
+                break
+            specs.append(jax.ShapeDtypeStruct(
+                tuple(int(d) for d in shape),
+                jax.dtypes.canonicalize_dtype(_np.dtype(dtype))))
+
+    poisoned_fn = getattr(env, "poisoned", lambda _n: None)
+    ins = {}
+    complete = True
+    poisoned_input = None
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if poisoned_fn(n):
+                poisoned_input = n
+                vals.append(None)
+            else:
+                vals.append(dict.get(env, n))
+        if slot in op_def.duplicable:
+            if any(v is None for v in vals):
+                if slot in op_def.optional:
+                    continue
+                complete = False
+            else:
+                ins[slot] = vals
+        else:
+            v = vals[0] if vals else None
+            if v is None:
+                if slot in op_def.optional or not names:
+                    continue
+                complete = False
+            else:
+                ins[slot] = v
+
+    if static and complete and poisoned_input is None:
+        attrs = dict(op.attrs)
+        in_keys = sorted(ins)
+        dup = {k: len(ins[k]) for k in in_keys
+               if k in op_def.duplicable}
+
+        def host_call(*arrays):
+            it = iter(arrays)
+            rebuilt = {}
+            for k in in_keys:
+                if k in dup:
+                    rebuilt[k] = [next(it) for _ in range(dup[k])]
+                else:
+                    rebuilt[k] = next(it)
+            outs = op_def.compute(rebuilt, attrs) or {}
+            flat = []
+            for (slot, i, _n), spec in zip(out_slots, specs):
+                if slot not in outs:
+                    raise RuntimeError(
+                        f"host op '{op.type}' did not produce declared "
+                        f"output slot '{slot}' inside pure_callback")
+                v = outs[slot]
+                if isinstance(v, (list, tuple)):
+                    v = v[i]
+                flat.append(_np.asarray(v).astype(spec.dtype))
+            return tuple(flat)
+
+        flat_in = []
+        for k in in_keys:
+            if k in dup:
+                flat_in.extend(ins[k])
+            else:
+                flat_in.append(ins[k])
+        results = jax.pure_callback(host_call, tuple(specs), *flat_in)
+        for (slot, i, n), val in zip(out_slots, results):
+            env[n] = val
+        return
+
+    if op.type in _HOST_SKIP_SILENT:
+        return
+    if executor_only:
+        reason = ("it only runs through the interpreted executor's "
+                  "special handler")
+    elif poisoned_input is not None:
+        reason = (f"its input '{poisoned_input}' is itself an "
+                  "unavailable host-only product")
+    elif not static:
+        reason = "outputs have dynamic/unknown shapes"
+    else:
+        reason = "some inputs are missing in the trace"
+    for _, _, n in out_slots:
+        if n in env:
+            # value already supplied via state/feeds (e.g. a load op
+            # re-producing a persistable): keep it usable
+            continue
+        _poison_or_raise(
+            env, n,
+            f"op '{op.type}' is host-only and cannot join the "
+            f"compiled XLA program ({reason}); run this program "
+            "through the interpreted executor, or give its outputs "
+            "static shapes to lower it via pure_callback")
 
 
 def _block_io_vars(program, block_idx):

@@ -63,3 +63,161 @@ def test_parameters_registered():
     params = fluid.default_main_program().all_parameters()
     assert len(params) == 2  # weight + bias
     assert all(p.persistable for p in params)
+
+
+class TestHostOpsInCompiledPrograms:
+    """Host-only ops inside CompiledProgram (reference: the C++ executor
+    runs host kernels inline; here static-shaped host ops lower to
+    jax.pure_callback nodes of the XLA program, and dynamic ones fail
+    with a clear message instead of a silent skip)."""
+
+    def _build_hash_prog(self):
+        import paddle_tpu as fluid
+        from paddle_tpu import layers, unique_name
+        from paddle_tpu.framework import Program, program_guard
+
+        prog, sprog = Program(), Program()
+        with program_guard(prog, sprog):
+            with unique_name.guard():
+                ids = layers.data(name="ids", shape=[2, 1],
+                                  dtype="int64",
+                                  append_batch_size=False)
+                gb = prog.global_block()
+                hashed = gb.create_var(name="hashed", shape=[2, 2, 1],
+                                       dtype="int64")
+                gb.append_op(type="hash", inputs={"X": [ids.name]},
+                             outputs={"Out": [hashed.name]},
+                             attrs={"num_hash": 2, "mod_by": 97},
+                             infer_shape=False)
+                dense = layers.cast(hashed, dtype="float32")
+                out = layers.reduce_sum(dense)
+        return prog, sprog, out
+
+    def test_static_host_op_lowers_to_pure_callback(self):
+        import numpy as np
+
+        import paddle_tpu as fluid
+        from paddle_tpu.core.scope import Scope, scope_guard
+
+        with scope_guard(Scope()):
+            prog, sprog, out = self._build_hash_prog()
+            exe = fluid.Executor()
+            exe.run(sprog)
+            feed = {"ids": np.array([[3], [5]], np.int64)}
+            compiled_hash, compiled_sum = exe.run(
+                fluid.CompiledProgram(prog), feed=feed,
+                fetch_list=["hashed", out])
+            interp_hash, = exe.run(prog, feed=feed,
+                                   fetch_list=["hashed"])
+            np.testing.assert_array_equal(np.asarray(compiled_hash),
+                                          np.asarray(interp_hash))
+            assert float(np.ravel(compiled_sum)[0]) == float(
+                np.asarray(interp_hash).sum())
+
+    def test_dynamic_host_op_raises_clear_error(self):
+        import numpy as np
+        import pytest
+
+        import paddle_tpu as fluid
+        from paddle_tpu import layers, unique_name
+        from paddle_tpu.core.scope import Scope, scope_guard
+        from paddle_tpu.framework import Program, program_guard
+
+        with scope_guard(Scope()):
+            prog, sprog = Program(), Program()
+            with program_guard(prog, sprog):
+                with unique_name.guard():
+                    x = layers.data(name="x", shape=[6], dtype="int64",
+                                    append_batch_size=False)
+                    gb = prog.global_block()
+                    uq = gb.create_var(name="uq", shape=None,
+                                       dtype="int64")
+                    ix = gb.create_var(name="ix", shape=None,
+                                       dtype="int32")
+                    gb.append_op(type="unique",
+                                 inputs={"X": [x.name]},
+                                 outputs={"Out": [uq.name],
+                                          "Index": [ix.name]},
+                                 attrs={"dtype": "int32"},
+                                 infer_shape=False)
+            exe = fluid.Executor()
+            exe.run(sprog)
+            with pytest.raises(RuntimeError, match="host-only"):
+                exe.run(fluid.CompiledProgram(prog),
+                        feed={"x": np.arange(6)}, fetch_list=["uq"])
+
+    def test_poison_cleared_by_later_write(self):
+        """A later legitimate write to a poisoned name un-poisons it."""
+        import numpy as np
+
+        import paddle_tpu as fluid
+        from paddle_tpu import layers, unique_name
+        from paddle_tpu.core.scope import Scope, scope_guard
+        from paddle_tpu.framework import Program, program_guard
+
+        with scope_guard(Scope()):
+            prog, sprog = Program(), Program()
+            with program_guard(prog, sprog):
+                with unique_name.guard():
+                    x = layers.data(name="x", shape=[6], dtype="int64",
+                                    append_batch_size=False)
+                    gb = prog.global_block()
+                    uq = gb.create_var(name="uq", shape=None,
+                                       dtype="int64")
+                    ix = gb.create_var(name="ix", shape=None,
+                                       dtype="int32")
+                    gb.append_op(type="unique",
+                                 inputs={"X": [x.name]},
+                                 outputs={"Out": [uq.name],
+                                          "Index": [ix.name]},
+                                 attrs={"dtype": "int32"},
+                                 infer_shape=False)
+                    # reuse the name 'uq' with a real device op
+                    gb.append_op(type="cast",
+                                 inputs={"X": [x.name]},
+                                 outputs={"Out": [uq.name]},
+                                 attrs={"out_dtype": "int64"},
+                                 infer_shape=False)
+            exe = fluid.Executor()
+            exe.run(sprog)
+            out, = exe.run(fluid.CompiledProgram(prog),
+                           feed={"x": np.arange(6)}, fetch_list=["uq"])
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.arange(6))
+
+    def test_executor_only_host_op_not_callbacked(self):
+        """Ops with executor special handlers (py_func et al) are never
+        lowered to pure_callback even with static shapes — clear error
+        instead of an opaque XLA failure."""
+        import numpy as np
+        import pytest
+
+        import paddle_tpu as fluid
+        from paddle_tpu import layers, unique_name
+        from paddle_tpu.core.scope import Scope, scope_guard
+        from paddle_tpu.framework import Program, program_guard
+        from paddle_tpu.ops.control_flow import register_py_func
+
+        fid = register_py_func(lambda a: a * 2)
+        with scope_guard(Scope()):
+            prog, sprog = Program(), Program()
+            with program_guard(prog, sprog):
+                with unique_name.guard():
+                    x = layers.data(name="x", shape=[2, 3],
+                                    dtype="float32",
+                                    append_batch_size=False)
+                    gb = prog.global_block()
+                    y = gb.create_var(name="y", shape=[2, 3],
+                                      dtype="float32")
+                    gb.append_op(type="py_func",
+                                 inputs={"X": [x.name]},
+                                 outputs={"Out": [y.name]},
+                                 attrs={"func_id": fid,
+                                        "backward_func_id": -1},
+                                 infer_shape=False)
+            exe = fluid.Executor()
+            exe.run(sprog)
+            with pytest.raises(RuntimeError, match="interpreted"):
+                exe.run(fluid.CompiledProgram(prog),
+                        feed={"x": np.ones((2, 3), np.float32)},
+                        fetch_list=["y"])
