@@ -421,41 +421,49 @@ def _probe_device(timeout_s=180):
 
 def main():
     platform = _probe_device()
-    if platform is None:
+    degraded = platform is None or platform == "cpu"
+    if degraded:
         import sys
 
-        print("WARNING: device probe timed out (TPU tunnel wedged?) — "
-              "benching on the CPU backend with TINY shapes so the run "
+        print("WARNING: no accelerator (probe timed out or CPU-only "
+              "backend) — benching on CPU with TINY shapes so the run "
               "finishes; numbers are NOT representative of TPU "
               "performance", file=sys.stderr)
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
-        # full-size models at full chains would take hours on CPU —
-        # shrink to keep the driver's bench run bounded (~minutes)
-        rn_train = bench_resnet50_train(batch=8, chain=2)
-        tf_train = bench_transformer_train(batch=2, seq=128, chain=2)
-        bert_train = bench_bert_train(batch=1, seq=128, chain=1)
-        dfm_train = bench_deepfm_train(batch=256, chain=3)
-        infer = bench_resnet50_infer(batch=8, chain=3)
-        infer_i8 = bench_resnet50_infer_int8(batch=8, chain=3)
-        vgg_infer = bench_vgg16_infer(batch=4, chain=2)
-    else:
-        rn_train = bench_resnet50_train()
-        tf_train = bench_transformer_train()
-        bert_train = bench_bert_train()
-        dfm_train = bench_deepfm_train()
-        infer = bench_resnet50_infer()
-        infer_i8 = bench_resnet50_infer_int8()
-        vgg_infer = bench_vgg16_infer()
+        if platform is None:
+            jax.config.update("jax_platforms", "cpu")
+    # full-size models at full chains would take hours on CPU — shrink
+    # every bench to keep the run bounded (~2 min total, measured)
+    tiny = {
+        "rn_train": dict(batch=8, chain=2),
+        "tf_train": dict(batch=2, seq=128, chain=2),
+        "bert_train": dict(batch=1, seq=128, chain=1),
+        "dfm_train": dict(batch=256, chain=3),
+        "infer": dict(batch=8, chain=3),
+        "infer_i8": dict(batch=8, chain=3),
+        "vgg_infer": dict(batch=4, chain=2),
+    } if degraded else {}
+    rn_train = bench_resnet50_train(**tiny.get("rn_train", {}))
+    tf_train = bench_transformer_train(**tiny.get("tf_train", {}))
+    bert_train = bench_bert_train(**tiny.get("bert_train", {}))
+    dfm_train = bench_deepfm_train(**tiny.get("dfm_train", {}))
+    infer = bench_resnet50_infer(**tiny.get("infer", {}))
+    infer_i8 = bench_resnet50_infer_int8(**tiny.get("infer_i8", {}))
+    vgg_infer = bench_vgg16_infer(**tiny.get("vgg_infer", {}))
     headline = rn_train["mfu_pct"]
+    # vs-V100 ratios are only honest at the baseline's batch sizes on a
+    # real chip; degraded runs report None there
+    unit = "% of chip peak (bf16)"
+    if degraded:
+        unit += " [DEGRADED: tiny-shape CPU run]"
     print(json.dumps({
         "metric": "resnet50_bf16_train_mfu_pct_mb128",
         "value": headline,
-        "unit": "% of chip peak (bf16)",
+        "unit": unit,
         # >=1.0 means the 50%-MFU north star is met
         "vs_baseline": round(headline / (100 * MFU_TARGET), 4),
-        "degraded_to_cpu": platform is None,
+        "degraded_to_cpu": degraded,
         "extras": {
             "resnet50_train": rn_train,
             "transformer_base_train": tf_train,
@@ -463,13 +471,13 @@ def main():
             "deepfm_ctr_train": dfm_train,
             "resnet50_infer_bf16_mb128": {
                 **infer,
-                "vs_v100_fp16_baseline": round(
+                "vs_v100_fp16_baseline": None if degraded else round(
                     BASELINE_INFER_MS / infer["ms_per_batch"], 3),
             },
             "resnet50_infer_int8_mb128": infer_i8,
             "vgg16_infer_bf16_mb64": {
                 **vgg_infer,
-                "vs_v100_fp16_baseline": round(
+                "vs_v100_fp16_baseline": None if degraded else round(
                     BASELINE_VGG16_MB64_MS / vgg_infer["ms_per_batch"],
                     3),
             },
