@@ -1,0 +1,28 @@
+#!/bin/bash
+# Full validation matrix (the reference's paddle_build.sh ctest+py_test
+# role).  Runs everywhere: tests force a virtual 8-device CPU mesh.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== 1/5 test suite (virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== 2/5 op inventory audit vs reference REGISTER_OPERATOR =="
+JAX_PLATFORMS=cpu python tools/op_coverage.py
+
+echo "== 3/5 API stability gate =="
+JAX_PLATFORMS=cpu python tools/print_signatures.py paddle_tpu > /tmp/_api_now.spec
+python tools/diff_api.py API.spec /tmp/_api_now.spec
+
+echo "== 4/5 multichip dry-run (8 virtual devices) =="
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+PADDLE_TPU_TEST_PLATFORM=cpu python -c "
+import os; os.environ['JAX_PLATFORMS']='cpu'
+import jax; jax.config.update('jax_platforms','cpu')
+import __graft_entry__ as ge; ge.dryrun_multichip(8)
+print('dryrun_multichip(8) OK')"
+
+echo "== 5/5 benchmark (real chip if attached; tiny CPU run otherwise) =="
+python bench.py
+
+echo "ALL CHECKS PASSED"
