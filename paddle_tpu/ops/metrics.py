@@ -3,6 +3,7 @@ accuracy_op.cc, auc_op.cc, precision_recall_op.cc)."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import REQUIRED, register_op
@@ -17,10 +18,11 @@ def accuracy(ins, attrs):
     lab = label.reshape(-1, 1)
     correct = jnp.any(idx == lab, axis=1)
     num_correct = jnp.sum(correct.astype(jnp.float32))
-    total = jnp.asarray(idx.shape[0], jnp.int64)
+    int_t = jax.dtypes.canonicalize_dtype(jnp.int64)
+    total = jnp.asarray(idx.shape[0], int_t)
     return {
         "Accuracy": num_correct / idx.shape[0],
-        "Correct": num_correct.astype(jnp.int64),
+        "Correct": num_correct.astype(int_t),
         "Total": total,
     }
 

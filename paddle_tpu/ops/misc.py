@@ -51,7 +51,7 @@ def diag(ins, attrs):
 def size(ins, attrs):
     return {"Out": jnp.asarray(
         int(np.prod(ins["Input"].shape) if ins["Input"].shape else 1),
-        jnp.int64).reshape(1)}
+        jax.dtypes.canonicalize_dtype(jnp.int64)).reshape(1)}
 
 
 @register_op("fill", inputs=(), outputs=("Out",), differentiable=False,

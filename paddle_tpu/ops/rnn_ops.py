@@ -507,7 +507,8 @@ def edit_distance(ins, attrs):
     if attrs["normalized"]:
         dist = dist / jnp.maximum(rlen[:, None], 1).astype(jnp.float32)
     return {"Out": dist,
-            "SequenceNum": jnp.asarray(b, jnp.int64).reshape(1)}
+            "SequenceNum": jnp.asarray(
+                b, jax.dtypes.canonicalize_dtype(jnp.int64)).reshape(1)}
 
 
 @register_op("beam_search",
