@@ -393,14 +393,13 @@ def bench_resnet50_infer_int8(batch=128, chain=100):
             "n_int8_params": len(qw)}
 
 
-def _probe_device(timeout_s=180):
+def _probe_device_once(timeout_s=180):
     """Run one tiny computation in a SUBPROCESS with a hard timeout.
 
     The axon TPU tunnel blocks forever on a wedged claim
     (axon/register ifrt claim_timeout_s=-1), which would hang the whole
-    bench run.  If the probe can't finish, fall back to the CPU backend
-    so the driver still gets a JSON line — clearly marked, with
-    vs_baseline honestly computed against the same targets."""
+    bench run.  Probing in a child process keeps the parent able to
+    fall back to the CPU backend if the claim never resolves."""
     import subprocess
     import sys
 
@@ -413,14 +412,52 @@ def _probe_device(timeout_s=180):
                              capture_output=True, text=True,
                              timeout=timeout_s)
         if out.returncode == 0:
-            return out.stdout.strip() or "ok"
+            return out.stdout.strip() or "ok", "ok"
+        return None, "exit=%d %s" % (out.returncode,
+                                     (out.stderr or "")[-200:].strip())
     except subprocess.TimeoutExpired:
-        pass
-    return None
+        return None, "timeout>%ds" % timeout_s
+
+
+def _probe_device(budget_s=900):
+    """Retry the probe with backoff for up to ~15 min before degrading.
+
+    A wedged tunnel sometimes recovers within minutes; a degraded CPU
+    run throws away the whole round's hardware evidence, so patience is
+    cheap by comparison.  Returns (platform_or_None, probe_history) —
+    history is embedded in the bench JSON so a degraded run is
+    diagnosable after the fact."""
+    history = []
+    deadline = time.time() + budget_s
+    timeout_s, backoff = 60, 30
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.time()
+        platform, detail = _probe_device_once(timeout_s=timeout_s)
+        history.append({"attempt": attempt,
+                        "t_offset_s": round(t0 - deadline + budget_s, 1),
+                        "took_s": round(time.time() - t0, 1),
+                        "result": platform or "fail",
+                        "detail": detail})
+        if platform is not None and platform != "cpu":
+            return platform, history
+        if platform == "cpu":
+            # backend itself is CPU-only (no tunnel configured): no
+            # amount of retrying will produce a TPU — bail out now
+            return platform, history
+        timeout_s = min(180, timeout_s * 2)
+        backoff = min(240, backoff * 2)
+        if time.time() + backoff + timeout_s > deadline:
+            return None, history
+        time.sleep(backoff)
 
 
 def main():
-    platform = _probe_device()
+    import os
+
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", "900"))
+    platform, probe_history = _probe_device(budget_s=budget)
     degraded = platform is None or platform == "cpu"
     if degraded:
         import sys
@@ -457,25 +494,46 @@ def main():
     unit = "% of chip peak (bf16)"
     if degraded:
         unit += " [DEGRADED: tiny-shape CPU run]"
+
+    def key(base, **shape):
+        # Degraded runs shrink the workload; the metric key must say so
+        # (a dashboard diffing rounds by key must never compare a
+        # seq-128 run against a seq-512 one under the same name).  The
+        # full-size shape baked into the base name is stripped first so
+        # the degraded key states exactly one shape.
+        if not degraded:
+            return base
+        import re
+
+        base = re.sub(r"_(?:mb|seq)\d+", "", base)
+        tag = "_".join("%s%s" % (k, v) for k, v in shape.items())
+        return "%s_DEGRADED_%s" % (base, tag) if tag else \
+            "%s_DEGRADED" % base
+
     print(json.dumps({
-        "metric": "resnet50_bf16_train_mfu_pct_mb128",
+        "metric": key("resnet50_bf16_train_mfu_pct_mb128",
+                      mb=rn_train["batch"]),
         "value": headline,
         "unit": unit,
         # >=1.0 means the 50%-MFU north star is met
         "vs_baseline": round(headline / (100 * MFU_TARGET), 4),
         "degraded_to_cpu": degraded,
+        "probe_history": probe_history,
         "extras": {
-            "resnet50_train": rn_train,
-            "transformer_base_train": tf_train,
-            "bert_base_train_seq512": bert_train,
-            "deepfm_ctr_train": dfm_train,
-            "resnet50_infer_bf16_mb128": {
+            key("resnet50_train", mb=rn_train["batch"]): rn_train,
+            key("transformer_base_train", mb=tf_train["batch"],
+                seq=tf_train["seq"]): tf_train,
+            key("bert_base_train_seq512", mb=bert_train["batch"],
+                seq=bert_train["seq"]): bert_train,
+            key("deepfm_ctr_train", mb=dfm_train["batch"]): dfm_train,
+            key("resnet50_infer_bf16_mb128", mb=infer["batch"]): {
                 **infer,
                 "vs_v100_fp16_baseline": None if degraded else round(
                     BASELINE_INFER_MS / infer["ms_per_batch"], 3),
             },
-            "resnet50_infer_int8_mb128": infer_i8,
-            "vgg16_infer_bf16_mb64": {
+            key("resnet50_infer_int8_mb128",
+                mb=infer_i8["batch"]): infer_i8,
+            key("vgg16_infer_bf16_mb64", mb=vgg_infer["batch"]): {
                 **vgg_infer,
                 "vs_v100_fp16_baseline": None if degraded else round(
                     BASELINE_VGG16_MB64_MS / vgg_infer["ms_per_batch"],

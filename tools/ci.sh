@@ -23,6 +23,8 @@ import __graft_entry__ as ge; ge.dryrun_multichip(8)
 print('dryrun_multichip(8) OK')"
 
 echo "== 5/5 benchmark (real chip if attached; tiny CPU run otherwise) =="
-python bench.py
+# CI keeps the TPU probe short; the 15-min retry budget is for real
+# bench rounds (driver invocation), not the validation matrix.
+BENCH_PROBE_BUDGET_S="${BENCH_PROBE_BUDGET_S:-120}" python bench.py
 
 echo "ALL CHECKS PASSED"
