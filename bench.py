@@ -428,15 +428,20 @@ def _probe_device(budget_s=900):
     history is embedded in the bench JSON so a degraded run is
     diagnosable after the fact."""
     history = []
-    deadline = time.time() + budget_s
+    start = time.time()
+    deadline = start + budget_s
     timeout_s, backoff = 60, 30
     attempt = 0
     while True:
+        remaining = deadline - time.time()
+        if remaining <= 5:
+            return None, history
         attempt += 1
         t0 = time.time()
-        platform, detail = _probe_device_once(timeout_s=timeout_s)
+        platform, detail = _probe_device_once(
+            timeout_s=max(5, min(timeout_s, remaining)))
         history.append({"attempt": attempt,
-                        "t_offset_s": round(t0 - deadline + budget_s, 1),
+                        "t_offset_s": round(t0 - start, 1),
                         "took_s": round(time.time() - t0, 1),
                         "result": platform or "fail",
                         "detail": detail})
@@ -448,9 +453,10 @@ def _probe_device(budget_s=900):
             return platform, history
         timeout_s = min(180, timeout_s * 2)
         backoff = min(240, backoff * 2)
-        if time.time() + backoff + timeout_s > deadline:
+        remaining = deadline - time.time()
+        if remaining <= 10:
             return None, history
-        time.sleep(backoff)
+        time.sleep(min(backoff, remaining - 5))
 
 
 def main():

@@ -94,8 +94,13 @@ def ctr_reader(feed_dict, file_type, file_format, dense_slot_index,
                                  daemon=True) for sh in shards]
             for t in self._threads:
                 t.start()
-            threading.Thread(target=self._close_when_done,
-                             daemon=True).start()
+            # the closer captures this generation's queue + producer
+            # list so a concurrent reset() (which nulls self._queue)
+            # can't crash it or let it poison a later generation's queue
+            self._closer = threading.Thread(
+                target=self._close_when_done,
+                args=(self._queue, list(self._threads)), daemon=True)
+            self._closer.start()
 
         def _read_shard(self, files):
             pending = []
@@ -132,10 +137,13 @@ def ctr_reader(feed_dict, file_type, file_format, dense_slot_index,
                 except _pyqueue.Full:
                     continue
 
-        def _close_when_done(self):
-            for t in self._threads:
+        def _close_when_done(self, q, producers):
+            for t in producers:
                 t.join()
-            self._queue.put(_EOF)
+            # unconditional: a consumer blocked in q.get() must always
+            # be woken, even when reset() raced us (q is this
+            # generation's queue, so a late EOF can't poison the next)
+            q.put(_EOF)
 
         def reset(self):
             self._stop.set()
@@ -148,6 +156,9 @@ def ctr_reader(feed_dict, file_type, file_format, dense_slot_index,
                     pass
             for t in self._threads:
                 t.join(timeout=5)
+            closer = getattr(self, "_closer", None)
+            if closer is not None:
+                closer.join(timeout=5)
             self._threads = []
             self._queue = None
 

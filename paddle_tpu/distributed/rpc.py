@@ -68,9 +68,10 @@ def _encode(obj, out, depth=0):
         if not arr.flags.c_contiguous:
             arr = arr.copy(order="C")  # (ascontiguousarray would 1-d-ify 0-d)
         if arr.dtype.hasobject or arr.dtype.names is not None \
-                or arr.dtype.kind == "V":
+                or arr.dtype.kind not in "biufcSU":
             raise WireError(
-                "object/structured arrays are not wire-encodable")
+                f"arrays of dtype kind {arr.dtype.kind!r} are not "
+                "wire-encodable (plain numeric/bool/bytes/str only)")
         out.append(b"a" + _enc_len_bytes(arr.dtype.str.encode("ascii"))
                    + _U32.pack(arr.ndim)
                    + b"".join(_I64.pack(d) for d in arr.shape)
@@ -143,7 +144,17 @@ class _Reader:
         if tag == b"b":
             return bytes(self.take(self.u32()))
         if tag == b"a":
-            dtype = np.dtype(self.take(self.u32()).decode("ascii"))
+            try:
+                dtype = np.dtype(self.take(self.u32()).decode("ascii"))
+            except TypeError as e:
+                raise WireError(f"bad ndarray dtype: {e}") from None
+            # decode must be the exact inverse of encode: reject dtype
+            # kinds the encoder refuses (object/structured/void, and
+            # anything outside plain numeric/bool/bytes/str kinds)
+            if dtype.hasobject or dtype.names is not None or \
+                    dtype.kind not in "biufcSU":
+                raise WireError(
+                    f"dtype kind {dtype.kind!r} is not wire-decodable")
             ndim = self.u32()
             if ndim > 32:
                 raise WireError("ndarray rank too large")

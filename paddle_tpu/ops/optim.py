@@ -413,14 +413,15 @@ def proximal_adagrad(ins, attrs):
     return {"ParamOut": out, "MomentOut": m_out}
 
 
-def _dgc_rampup_sparsity(step, sparsity_steps, rampup_begin, rampup_step):
-    """Sparsity warmup schedule (reference dgc_op.cc: the sparsity attr
-    is a per-phase vector swept over rampup_step steps)."""
+def _dgc_rampup_sparsity(step, sparsity_steps, rampup_step):
+    """Sparsity warmup schedule, matching dgc_op.h get_period_sparcity:
+    idx = int(cur_step * len(sparsity) / rampup_steps) over the ABSOLUTE
+    step count, pinned to 0.999 once idx runs past the vector end."""
     phases = len(sparsity_steps)
-    frac = jnp.clip((step - rampup_begin) / max(rampup_step, 1.0),
-                    0.0, 1.0)
-    idx = jnp.minimum((frac * phases).astype(jnp.int32), phases - 1)
-    return jnp.asarray(sparsity_steps)[idx]
+    idx = (step * phases / max(rampup_step, 1.0)).astype(jnp.int32)
+    in_vec = jnp.asarray(sparsity_steps)[
+        jnp.clip(idx, 0, phases - 1)]
+    return jnp.where(idx >= phases, 0.999, in_vec)
 
 
 @register_op("dgc",
@@ -439,11 +440,18 @@ def dgc(ins, attrs):
     u, v = ins["U"], ins["V"]
     step = ins["current_step"].reshape(()).astype(jnp.float32)
     m = attrs["m"]
-    u = m * u + g
-    v = v + u
+    if attrs["use_nesterov"]:
+        # dgc_op.h:89-97: u = m*(u+g); v = u + v + g (v_out aliases v,
+        # so both adds read the freshly written u)
+        u = m * (u + g)
+        v = u + v + g
+    else:
+        # dgc_op.h:99-104: u = m*u + g; v = u + v
+        u = m * u + g
+        v = v + u
     sparsity = _dgc_rampup_sparsity(
         step, [float(s) for s in attrs["sparsity"]],
-        float(attrs["rampup_begin_step"]), float(attrs["rampup_step"]))
+        float(attrs["rampup_step"]))
     n = v.size
     # the scheduled sparsity is a traced value, so k is dynamic: take
     # the threshold at the k-th largest |v| via a full descending sort

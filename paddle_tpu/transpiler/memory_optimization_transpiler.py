@@ -103,6 +103,11 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False,
         # pool of dead vars: name -> (dtype, shape, bytes)
         pool = []
         renamed = {}
+        # pooled name -> the original var currently living in it; kept
+        # as a dict (updated on each steal) because renamed can map two
+        # different originals onto the same pooled name over time and a
+        # reverse scan would pick an arbitrary one
+        alias_of = {}
 
         def record(msg):
             if print_log or PRINT_LOG:
@@ -131,6 +136,7 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False,
                             continue
                         pool.pop(j)
                         renamed[n] = cand
+                        alias_of[cand] = n
                         # adopt the new shape on the reused var
                         cvar = block.var(cand)
                         cvar.shape = var.shape
@@ -144,11 +150,9 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False,
             for names in list(op.inputs.values()) + list(
                     op.outputs.values()):
                 for n in names:
-                    orig = n
-                    if n in renamed.values():
-                        # find original name for liveness lookup
-                        cands = [o for o, w in renamed.items() if w == n]
-                        orig = cands[0] if cands else n
+                    # liveness was computed on original names: map a
+                    # pooled name back to its CURRENT live tenant
+                    orig = alias_of.get(n, n)
                     if orig in pinned or orig not in block.vars:
                         continue
                     if last_use.get(orig) == i:

@@ -114,6 +114,58 @@ def test_dgc_momentum_converges_and_error_feedback():
     assert losses[-1] < losses[0] * 0.3, losses[::10]
 
 
+def test_dgc_op_momentum_correction_formulas():
+    """dgc op vs the reference formulas (dgc_op.h:89-104): plain
+    u=m*u+g, v=v+u; Nesterov u=m*(u+g), v=u+v+g."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def
+
+    d = get_op_def("dgc")
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(32).astype(np.float32)
+    v0 = rng.randn(32).astype(np.float32)
+    g = rng.randn(32).astype(np.float32)
+    m = 0.9
+    for nesterov in (False, True):
+        out = d.compute(
+            {"U": jnp.asarray(u0), "V": jnp.asarray(v0),
+             "Grad": jnp.asarray(g),
+             "current_step": jnp.asarray([0.0])},
+            d.canonical_attrs({"m": m, "use_nesterov": nesterov,
+                               "sparsity": [0.5],
+                               "rampup_begin_step": 0.0,
+                               "rampup_step": 100.0}))
+        if nesterov:
+            u_ref = m * (u0 + g)
+            v_ref = u_ref + v0 + g
+        else:
+            u_ref = m * u0 + g
+            v_ref = v0 + u_ref
+        # reconstruct the pre-mask u/v: masked entries were zeroed and
+        # moved to EncodeGrad (error feedback)
+        enc = np.asarray(out["EncodeGrad"])
+        u_full = np.asarray(out["U_out"]) + np.where(enc != 0, u_ref, 0)
+        v_full = np.asarray(out["V_out"]) + enc
+        np.testing.assert_allclose(u_full, u_ref, rtol=1e-5)
+        np.testing.assert_allclose(v_full, v_ref, rtol=1e-5)
+        # sparsity 0.5 keeps the top half of |v|
+        assert (enc != 0).sum() == 16
+
+
+def test_dgc_rampup_schedule_matches_reference():
+    """get_period_sparcity (dgc_op.h:24): idx indexes by ABSOLUTE step
+    over rampup_steps, and pins to 0.999 past the vector end."""
+    from paddle_tpu.ops.optim import _dgc_rampup_sparsity
+
+    sched = [0.75, 0.9375, 0.984375]
+    for step, want in [(0, 0.75), (33, 0.75), (34, 0.9375),
+                       (67, 0.984375), (100, 0.999), (1000, 0.999)]:
+        got = float(_dgc_rampup_sparsity(
+            np.float32(step), sched, 100.0))
+        assert got == np.float32(want), (step, got, want)
+
+
 def test_pruner_masks_lowest_l1_filters():
     from paddle_tpu.contrib.slim import Pruner, flops
     from paddle_tpu.core.scope import global_scope
