@@ -770,3 +770,148 @@ class RecomputeOptimizer:
                                      parameter_list, no_grad_set)
         self.inner_optimizer.apply_gradients(params_grads)
         return [], params_grads
+
+
+class GradientMergeOptimizer:
+    """k-microstep gradient accumulation as an IR transform (reference
+    multi_batch_merge_pass.cc:1 — the batch-merge pass repeats
+    forward/backward k times per device and merges the grads before one
+    update; the reference-era API name is the pass, the semantics are
+    'effective batch = k x microbatch').
+
+    Here the k microbatches arrive as k successive executor steps: every
+    step adds each grad into a persistable ``<param>@GradientMerge``
+    buffer, and on each k-th step a ``conditional_block`` (lax.cond in
+    the compiled path) runs the inner optimizer's real update ops on the
+    (optionally averaged) accumulated grad and zeroes the buffers.
+    Off-boundary steps touch no parameter or optimizer state, so the
+    trajectory is loss-equivalent to training on the concatenated big
+    batch (tests/test_gradient_merge.py).  On TPU this is the standard
+    lever when HBM caps the per-step batch; it composes with
+    RecomputeOptimizer (pass it as the inner optimizer) and with data
+    parallelism (per-replica grads are allreduced each microstep before
+    accumulation, which is equivalent to allreducing the merged sum).
+    """
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.inner_optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from paddle_tpu.core.program import BlockRef
+
+        # unwrap pass-through wrappers (e.g. Recompute) to the base
+        # Optimizer that owns lr/accumulators/update ops; backward()
+        # above still goes through the wrapper (remat-aware)
+        inner = self.inner_optimizer
+        while not hasattr(inner, "_append_optimize_op") and \
+                hasattr(inner, "inner_optimizer"):
+            inner = inner.inner_optimizer
+        if self.k_steps == 1:
+            return self.inner_optimizer.minimize(
+                loss, startup_program, parameter_list, no_grad_set)
+        params_grads = self.backward(loss, startup_program,
+                                     parameter_list, no_grad_set)
+        prog = loss.block.program
+        block = prog.global_block()
+        sb = default_startup_program().global_block()
+
+        # int64 counter: a float32 counter saturates at 2^24 microsteps
+        # and would freeze the step%k gate for the rest of training
+        step_name = unique_name.generate("gradient_merge.step")
+        step = block.create_var(name=step_name, shape=(1,),
+                                dtype="int64", persistable=True,
+                                stop_gradient=True)
+        sv = sb.create_var(name=step_name, shape=(1,), dtype="int64",
+                           persistable=True)
+        sb.append_op(type="fill_constant", outputs={"Out": sv},
+                     attrs={"shape": [1], "dtype": "int64",
+                            "value": 0.0}, infer_shape=False)
+        block.append_op(type="increment", inputs={"X": step},
+                        outputs={"Out": step}, attrs={"step": 1.0},
+                        op_role=OPTIMIZE, infer_shape=False)
+
+        # per-param persistable accumulators, zero-initialised
+        accums = []
+        for p, g in params_grads:
+            acc_name = unique_name.generate(p.name + "@GradientMerge")
+            acc = block.create_var(name=acc_name, shape=list(p.shape),
+                                   dtype=p.dtype, persistable=True,
+                                   stop_gradient=True)
+            sv = sb.create_var(name=acc_name, shape=list(p.shape),
+                               dtype=p.dtype, persistable=True)
+            sb.append_op(type="fill_constant", outputs={"Out": sv},
+                         attrs={"shape": list(p.shape), "dtype": p.dtype,
+                                "value": 0.0}, infer_shape=False)
+            block.append_op(type="elementwise_add",
+                            inputs={"X": acc, "Y": g},
+                            outputs={"Out": acc}, op_role=OPTIMIZE,
+                            infer_shape=False)
+            accums.append((p, acc))
+
+        # gate: step % k == 0
+        def _tmp(name, dtype="float32", shape=(1,)):
+            return block.create_var(
+                name=unique_name.generate(name), shape=list(shape),
+                dtype=dtype, stop_gradient=True)
+
+        kconst = _tmp("gradient_merge.k", dtype="int64")
+        block.append_op(type="fill_constant", outputs={"Out": kconst},
+                        attrs={"shape": [1], "dtype": "int64",
+                               "value": float(self.k_steps)},
+                        op_role=OPTIMIZE, infer_shape=False)
+        rem = _tmp("gradient_merge.rem", dtype="int64")
+        block.append_op(type="elementwise_mod",
+                        inputs={"X": step, "Y": kconst},
+                        outputs={"Out": rem}, op_role=OPTIMIZE,
+                        infer_shape=False)
+        zero = _tmp("gradient_merge.zero", dtype="int64")
+        block.append_op(type="fill_constant", outputs={"Out": zero},
+                        attrs={"shape": [1], "dtype": "int64",
+                               "value": 0.0},
+                        op_role=OPTIMIZE, infer_shape=False)
+        cond = _tmp("gradient_merge.cond", dtype="bool")
+        block.append_op(type="equal", inputs={"X": rem, "Y": zero},
+                        outputs={"Out": cond}, op_role=OPTIMIZE,
+                        infer_shape=False)
+
+        # the real update, gated on the k-th step
+        inner._create_lr_var(block)
+        sub = prog._create_block()
+        try:
+            for p, acc in accums:
+                if self.avg:
+                    gvar = sub.create_var(
+                        name=unique_name.generate(
+                            p.name + "@GradientMerge.avg"),
+                        shape=list(p.shape), dtype=p.dtype,
+                        stop_gradient=True)
+                    sub.append_op(type="scale", inputs={"X": acc},
+                                  outputs={"Out": gvar},
+                                  attrs={"scale": 1.0 / self.k_steps},
+                                  op_role=OPTIMIZE, infer_shape=False)
+                else:
+                    gvar = acc
+                with _block_guard(prog):
+                    pg = inner._append_regularization(block, [(p, gvar)])
+                inner._append_optimize_op(sub, pg[0])
+            for _, acc in accums:
+                sub.append_op(type="fill_zeros_like",
+                              inputs={"X": acc}, outputs={"Out": acc},
+                              op_role=OPTIMIZE, infer_shape=False)
+        finally:
+            prog._rollback()
+        block.append_op(type="conditional_block",
+                        inputs={"Cond": cond}, outputs={},
+                        attrs={"sub_block": BlockRef(sub.idx)},
+                        op_role=OPTIMIZE, infer_shape=False)
+        return [], params_grads

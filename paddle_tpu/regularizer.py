@@ -18,7 +18,10 @@ class L2Decay(Regularizer):
 
         decay = layers.scale(param, scale=self.coeff)
         out = layers.elementwise_add(grad, decay)
-        for op in param.block.ops[-2:]:
+        # tag the ops where they actually landed — the current block,
+        # which is a conditional sub-block under GradientMergeOptimizer,
+        # not necessarily param.block
+        for op in out.block.ops[-2:]:
             op.op_role = "backward"
         return out
 

@@ -1,0 +1,185 @@
+"""GradientMergeOptimizer: k-microstep accumulation must be
+loss-equivalent to the big concatenated batch (reference
+multi_batch_merge_pass.cc:1 — grad accumulation as a graph transform).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, layers, optimizer
+
+
+def _build(opt_factory, seed=7):
+    np.random.seed(seed)
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, 1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt_factory().minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    pname = framework.default_main_program().all_parameters()[0].name
+    return exe, loss, pname
+
+
+def _param(pname):
+    from paddle_tpu.core.scope import global_scope
+
+    return np.asarray(global_scope().find_var(pname).get()).copy()
+
+
+def _data(n_updates, k, micro):
+    rng = np.random.RandomState(3)
+    big = [rng.rand(k * micro, 4).astype(np.float32)
+           for _ in range(n_updates)]
+    return big
+
+
+def test_gradient_merge_matches_big_batch_sgd(fresh_programs_factory):
+    k, micro, n_up = 4, 8, 3
+    bigs = _data(n_up, k, micro)
+
+    with fresh_programs_factory():
+        exe, loss, pname = _build(lambda: optimizer.SGD(0.1))
+        for bx in bigs:
+            exe.run(feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                    fetch_list=[loss])
+        w_big = _param(pname)
+
+    with fresh_programs_factory():
+        exe, loss, pname = _build(lambda: optimizer.GradientMergeOptimizer(
+            optimizer.SGD(0.1), k_steps=k, avg=True))
+        for bx in bigs:
+            for j in range(k):  # k microbatches = one big batch
+                mb = bx[j * micro:(j + 1) * micro]
+                exe.run(feed={"x": mb, "y": mb.sum(1, keepdims=True)},
+                        fetch_list=[loss])
+        w_merge = _param(pname)
+
+    # mean-loss grads: mean of k equal-size microbatch grads == big grad
+    np.testing.assert_allclose(w_merge, w_big, rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_merge_matches_big_batch_adam_compiled(
+        fresh_programs_factory):
+    """Stateful inner optimizer (Adam moments + beta powers) through the
+    COMPILED path: off-boundary steps must leave every state var
+    untouched, so the trajectory equals big-batch Adam."""
+    k, micro, n_up = 2, 8, 3
+    bigs = _data(n_up, k, micro)
+
+    with fresh_programs_factory():
+        exe, loss, pname = _build(lambda: optimizer.Adam(0.01))
+        compiled = fluid.CompiledProgram(framework.default_main_program())
+        for bx in bigs:
+            exe.run(compiled,
+                    feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                    fetch_list=[loss])
+        w_big = _param(pname)
+
+    with fresh_programs_factory():
+        exe, loss, pname = _build(lambda: optimizer.GradientMergeOptimizer(
+            optimizer.Adam(0.01), k_steps=k, avg=True))
+        compiled = fluid.CompiledProgram(framework.default_main_program())
+        for bx in bigs:
+            for j in range(k):
+                mb = bx[j * micro:(j + 1) * micro]
+                exe.run(compiled,
+                        feed={"x": mb, "y": mb.sum(1, keepdims=True)},
+                        fetch_list=[loss])
+        w_merge = _param(pname)
+
+    np.testing.assert_allclose(w_merge, w_big, rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_merge_no_update_between_boundaries():
+    opt = optimizer.GradientMergeOptimizer(optimizer.SGD(0.5), k_steps=3)
+    np.random.seed(0)
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, 1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    pname = framework.default_main_program().all_parameters()[0].name
+    w0 = _param(pname)
+    rng = np.random.RandomState(0)
+    for i in range(1, 7):
+        bx = rng.rand(8, 4).astype(np.float32)
+        exe.run(feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                fetch_list=[loss])
+        w = _param(pname)
+        if i % 3 == 0:
+            assert not np.allclose(w, w0), f"no update at boundary {i}"
+            w0 = w
+        else:
+            np.testing.assert_array_equal(w, w0)
+
+
+def test_gradient_merge_with_l2decay_keeps_gate_roles(
+        fresh_programs_factory):
+    """Regression: L2Decay tags its two reg ops 'backward' in the block
+    they landed in (the conditional sub-block), NOT the tail of the main
+    block — otherwise role-based passes would reorder the gate ops."""
+    from paddle_tpu import regularizer
+
+    k, micro = 2, 8
+    bigs = _data(2, k, micro)
+
+    with fresh_programs_factory():
+        exe, loss, pname = _build(lambda: optimizer.SGD(
+            0.1, regularization=regularizer.L2Decay(0.01)))
+        for bx in bigs:
+            exe.run(feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                    fetch_list=[loss])
+        w_big = _param(pname)
+
+    with fresh_programs_factory():
+        exe, loss, pname = _build(lambda: optimizer.GradientMergeOptimizer(
+            optimizer.SGD(0.1, regularization=regularizer.L2Decay(0.01)),
+            k_steps=k, avg=True))
+        main = framework.default_main_program()
+        # every main-block op after backward must still be role optimize
+        gate_ops = [op for op in main.global_block().ops
+                    if op.type in ("equal", "elementwise_mod",
+                                   "conditional_block")]
+        assert gate_ops and all(op.op_role == "optimize"
+                                for op in gate_ops), \
+            [(o.type, o.op_role) for o in gate_ops]
+        for bx in bigs:
+            for j in range(k):
+                mb = bx[j * micro:(j + 1) * micro]
+                exe.run(feed={"x": mb, "y": mb.sum(1, keepdims=True)},
+                        fetch_list=[loss])
+        w_merge = _param(pname)
+
+    np.testing.assert_allclose(w_merge, w_big, rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_merge_composes_with_recompute(fresh_programs_factory):
+    """GradientMerge(Recompute(SGD)) still matches big-batch SGD."""
+    k, micro = 2, 8
+    bigs = _data(2, k, micro)
+
+    with fresh_programs_factory():
+        exe, loss, pname = _build(lambda: optimizer.SGD(0.1))
+        for bx in bigs:
+            exe.run(feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                    fetch_list=[loss])
+        w_big = _param(pname)
+
+    with fresh_programs_factory():
+        def factory():
+            inner = optimizer.RecomputeOptimizer(optimizer.SGD(0.1))
+            return optimizer.GradientMergeOptimizer(inner, k_steps=k)
+
+        exe, loss, pname = _build(factory)
+        for bx in bigs:
+            for j in range(k):
+                mb = bx[j * micro:(j + 1) * micro]
+                exe.run(feed={"x": mb, "y": mb.sum(1, keepdims=True)},
+                        fetch_list=[loss])
+        w_merge = _param(pname)
+
+    np.testing.assert_allclose(w_merge, w_big, rtol=1e-5, atol=1e-6)
