@@ -354,16 +354,17 @@ def bench_vgg16_infer(batch=64, chain=60):
 
 
 def bench_resnet50_infer_int8(batch=128, chain=100):
-    """Int8-weight inference (round-2 missing #8; reference
-    inference/tests/api/int8_mkldnn_quantization.md): weights stored
-    int8 + dequantize-on-load fused by XLA."""
+    """True-int8 inference (round-3 verdict do-this #3; reference
+    inference/tests/api/int8_mkldnn_quantization.md): every conv/mul
+    executes on int8 operands with int32 accumulation
+    (convert_to_int8_execution), not dequantize-then-bf16."""
     import jax
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
     from paddle_tpu import framework
     from paddle_tpu.contrib.slim.quantization import (
-        convert_to_int8_inference, quantize_weights_abs_max)
+        convert_to_int8_execution, quantize_weights_abs_max)
     from paddle_tpu.core.scope import global_scope
     from paddle_tpu.models.resnet import resnet50
     from paddle_tpu.transpiler import nhwc_transpile
@@ -375,7 +376,7 @@ def bench_resnet50_infer_int8(batch=128, chain=100):
     infer_prog = framework.default_main_program().clone(for_test=True)
     nhwc_transpile(infer_prog)
     qw = quantize_weights_abs_max(infer_prog, global_scope())
-    convert_to_int8_inference(infer_prog, global_scope(), qw)
+    convert_to_int8_execution(infer_prog, global_scope(), qw)
     compiled = fluid.CompiledProgram(infer_prog)
 
     rng = np.random.RandomState(0)

@@ -8,12 +8,16 @@ Reference parity: /root/reference/python/paddle/fluid/contrib/slim/
 from paddle_tpu.contrib.slim.quantization import (
     QuantizationFreezePass,
     QuantizationTransformPass,
+    convert_to_int8_execution,
+    convert_to_int8_inference,
     post_training_quantize,
     quant_aware,
 )
 
 __all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
-           "quant_aware", "post_training_quantize", "Pruner", "flops",
+           "quant_aware", "post_training_quantize",
+           "convert_to_int8_execution", "convert_to_int8_inference",
+           "Pruner", "flops",
            "SAController", "distillation", "nas", "prune"]
 
 from paddle_tpu.contrib.slim import distillation  # noqa: F401

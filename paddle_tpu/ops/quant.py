@@ -141,6 +141,97 @@ def dequantize_weight(ins, attrs):
             / attrs["max_range"]}
 
 
+@register_op("conv2d_int8", inputs=("Input", "Filter", "FilterScale"),
+             outputs=("Output",),
+             attrs={"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 1,
+                    "data_format": "NCHW", "max_range": 127.0},
+             differentiable=False)
+def conv2d_int8(ins, attrs):
+    """True-int8 convolution (reference int8 execution path,
+    inference/tests/api/int8_mkldnn_quantization.md — there via mkldnn
+    u8s8 kernels; here the MXU): dynamically quantize the activation
+    per-tensor to int8, convolve int8 x int8 with int32 accumulation
+    (lax.conv_general_dilated preferred_element_type=int32), then apply
+    the combined activation x per-out-channel filter scale.  Unlike
+    dequantize_weight (which saves bytes but computes in fp32/bf16),
+    the MACs themselves run on 1-byte operands."""
+    from paddle_tpu.ops.nn import _pair
+
+    x, q, ws = ins["Input"], ins["Filter"], ins["FilterScale"]
+    bnd = attrs["max_range"]
+    sx = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    x8 = jnp.clip(jnp.round(x / sx * bnd), -bnd, bnd).astype(jnp.int8)
+    s, p, d = (_pair(attrs["strides"]), _pair(attrs["paddings"]),
+               _pair(attrs["dilations"]))
+    fmt = attrs.get("data_format", "NCHW")
+    dn = lax.conv_dimension_numbers(x.shape, q.shape,
+                                    (fmt, "OIHW", fmt))
+    y32 = lax.conv_general_dilated(
+        x8, q, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d, dimension_numbers=dn,
+        feature_group_count=attrs["groups"],
+        preferred_element_type=jnp.int32)
+    oscale = ws.reshape(-1)  # per-out-channel (O,1,1,1) -> (O,)
+    sc = (oscale.reshape(1, -1, 1, 1) if fmt == "NCHW"
+          else oscale.reshape(1, 1, 1, -1))
+    y = y32.astype(jnp.float32) * (sx / (bnd * bnd)) * sc
+    return {"Output": y}
+
+
+@register_op("mul_int8", inputs=("X", "Y", "Scale"), outputs=("Out",),
+             attrs={"x_num_col_dims": 1, "y_num_col_dims": 1,
+                    "max_range": 127.0},
+             differentiable=False)
+def mul_int8(ins, attrs):
+    """True-int8 mul: int8 x int8 matmul with int32 accumulation.
+
+    Weight scale conventions (w ~= q * scale / max_range), decided by
+    the scale's SHAPE so a square weight (K == N) stays unambiguous:
+      - 2-D (K,1): per-input-row — folded into the activation BEFORE
+        quantization so it factors out of the sum
+      - 2-D (1,N): per-output-column — applied after the matmul
+      - size 1: per-tensor
+      - 1-D length-K/N falls back to the size heuristic (row wins on a
+        square weight; pass a 2-D scale to disambiguate)
+    """
+    import numpy as np
+
+    x, q, ws = ins["X"], ins["Y"], ins["Scale"]
+    bnd = attrs["max_range"]
+    xnc, ync = attrs["x_num_col_dims"], attrs["y_num_col_dims"]
+    x2 = x.reshape((int(np.prod(x.shape[:xnc])), -1))
+    q2 = q.reshape((int(np.prod(q.shape[:ync])), -1))
+    k, n = q2.shape
+    ws = jnp.asarray(ws)
+    if ws.size == 1:
+        per_row = per_col = False
+    elif ws.ndim >= 2 and np.prod(ws.shape[1:]) == 1:  # (K,1,...)
+        per_row, per_col = True, False
+    elif ws.ndim >= 2 and ws.shape[0] == 1:            # (1,N)
+        per_row, per_col = False, True
+    else:  # 1-D: size heuristic, row convention wins when square
+        per_row = ws.size == k
+        per_col = not per_row and ws.size == n
+    ws2 = ws.reshape(-1)
+    post = None
+    if per_row:             # fold into activation
+        x2 = x2 * (ws2 / bnd).reshape(1, k)
+    elif per_col:           # apply after
+        post = (ws2 / bnd).reshape(1, n)
+    else:                   # per-tensor
+        post = ws2.reshape(()) / bnd
+    sx = jnp.maximum(jnp.max(jnp.abs(x2)), 1e-8)
+    x8 = jnp.clip(jnp.round(x2 / sx * bnd), -bnd, bnd).astype(jnp.int8)
+    y32 = lax.dot_general(x8, q2, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    y = y32.astype(jnp.float32) * (sx / bnd)
+    if post is not None:
+        y = y * post
+    return {"Out": y.reshape(x.shape[:xnc] + q.shape[ync:])}
+
+
 @register_op("fake_quantize_range_abs_max",
              inputs=("X", "InScale", "Iter"),
              outputs=("Out", "OutScale", "OutScales"),

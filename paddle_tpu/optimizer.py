@@ -825,16 +825,26 @@ class GradientMergeOptimizer:
         block = prog.global_block()
         sb = default_startup_program().global_block()
 
-        # int64 counter: a float32 counter saturates at 2^24 microsteps
-        # and would freeze the step%k gate for the rest of training
+        if hasattr(inner._learning_rate, "name"):
+            import warnings
+
+            warnings.warn(
+                "GradientMergeOptimizer with an lr-scheduler variable: "
+                "the schedule's step advances every MICROstep (k x "
+                "faster than a big-batch run); rescale the schedule's "
+                "boundaries by k_steps to keep trajectories comparable",
+                stacklevel=2)
+        # integer counter: a float32 counter saturates at 2^24
+        # microsteps and would freeze the step%k gate for the rest of
+        # training (int32 range is ample)
         step_name = unique_name.generate("gradient_merge.step")
         step = block.create_var(name=step_name, shape=(1,),
-                                dtype="int64", persistable=True,
+                                dtype="int32", persistable=True,
                                 stop_gradient=True)
-        sv = sb.create_var(name=step_name, shape=(1,), dtype="int64",
+        sv = sb.create_var(name=step_name, shape=(1,), dtype="int32",
                            persistable=True)
         sb.append_op(type="fill_constant", outputs={"Out": sv},
-                     attrs={"shape": [1], "dtype": "int64",
+                     attrs={"shape": [1], "dtype": "int32",
                             "value": 0.0}, infer_shape=False)
         block.append_op(type="increment", inputs={"X": step},
                         outputs={"Out": step}, attrs={"step": 1.0},
@@ -864,19 +874,19 @@ class GradientMergeOptimizer:
                 name=unique_name.generate(name), shape=list(shape),
                 dtype=dtype, stop_gradient=True)
 
-        kconst = _tmp("gradient_merge.k", dtype="int64")
+        kconst = _tmp("gradient_merge.k", dtype="int32")
         block.append_op(type="fill_constant", outputs={"Out": kconst},
-                        attrs={"shape": [1], "dtype": "int64",
+                        attrs={"shape": [1], "dtype": "int32",
                                "value": float(self.k_steps)},
                         op_role=OPTIMIZE, infer_shape=False)
-        rem = _tmp("gradient_merge.rem", dtype="int64")
+        rem = _tmp("gradient_merge.rem", dtype="int32")
         block.append_op(type="elementwise_mod",
                         inputs={"X": step, "Y": kconst},
                         outputs={"Out": rem}, op_role=OPTIMIZE,
                         infer_shape=False)
-        zero = _tmp("gradient_merge.zero", dtype="int64")
+        zero = _tmp("gradient_merge.zero", dtype="int32")
         block.append_op(type="fill_constant", outputs={"Out": zero},
-                        attrs={"shape": [1], "dtype": "int64",
+                        attrs={"shape": [1], "dtype": "int32",
                                "value": 0.0},
                         op_role=OPTIMIZE, infer_shape=False)
         cond = _tmp("gradient_merge.cond", dtype="bool")
