@@ -118,6 +118,12 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         block.append_op(type="sum", inputs={"X": parts},
                         outputs={"Out": out}, op_role=BACKWARD,
                         infer_shape=False)
+        # the merged grad is itself this var's error grad: clip it too
+        # (reference error_clip_callback fires on the sum op as well),
+        # otherwise a fan-out var's bound degrades to N_consumers * max
+        ec = getattr(block.vars.get(var_name), "error_clip", None)
+        if ec is not None:
+            ec._append_clip_op(block, out)
         grad_map[var_name] = [out]
         return out
 
@@ -161,11 +167,19 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             grad_out_slots[slot + GRAD_SUFFIX] = gnames
 
         if op_def.grad_maker is not None:
+            pre_len = {n: len(v) for n, v in grad_map.items()}
             new_ops = op_def.grad_maker(op, grad_out_slots, block, grad_map,
                                         no_grad_set)
             for nop in new_ops:
                 nop.op_role = BACKWARD
                 block.ops.append(nop)
+            # error clip applies to maker-produced grads too (the
+            # maker appends partials to grad_map; clip the new ones)
+            for n in {m for names in op.inputs.values() for m in names}:
+                ec = getattr(block.vars.get(n), "error_clip", None)
+                if ec is not None and _needs_grad(block, n, no_grad_set):
+                    for g in grad_map.get(n, [])[pre_len.get(n, 0):]:
+                        ec._append_clip_op(block, g)
             continue
 
         grad_inputs = dict(grad_out_slots)
@@ -173,25 +187,25 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             grad_inputs[slot] = list(names)
         grad_outputs = {}
         for slot, names in op.inputs.items():
+            if not any(_needs_grad(block, n, no_grad_set)
+                       for n in names):
+                continue
             gnames = []
-            slot_any = False
             for n in names:
-                if _needs_grad(block, n, no_grad_set):
-                    slot_any = True
-                if n in grad_map or not _needs_grad(block, n, no_grad_set):
+                # grad_map is consulted (and updated) per occurrence:
+                # a var repeated WITHIN one duplicable slot (e.g.
+                # concat([x, x])) must get a distinct partial per
+                # occurrence or the cotangents overwrite each other
+                if n in grad_map or not _needs_grad(block, n,
+                                                    no_grad_set):
                     g = _grad_name(
                         n, "@" + unique_name.generate("p"))
                 else:
                     g = _grad_name(n)
-                gnames.append(g)
-            if not slot_any:
-                continue
-            for n, g in zip(names, gnames):
+                _create_grad_var(block, n, g)
                 if _needs_grad(block, n, no_grad_set):
-                    _create_grad_var(block, n, g)
                     grad_map.setdefault(n, []).append(g)
-                else:
-                    _create_grad_var(block, n, g)
+                gnames.append(g)
             grad_outputs[slot + GRAD_SUFFIX] = gnames
         if not grad_outputs:
             continue
@@ -199,6 +213,18 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                      dict(op.attrs), BACKWARD,
                      stage=op.stage)  # grad runs on its fwd op's stage
         block.ops.append(gop)
+        # error clip (reference clip.py error_clip_callback): a forward
+        # var carrying _set_error_clip gets its freshly produced grad
+        # clipped in place, before any earlier op consumes it
+        for slot, names in op.inputs.items():
+            gnames = grad_outputs.get(slot + GRAD_SUFFIX)
+            if not gnames:
+                continue
+            for n, g in zip(names, gnames):
+                fwd = block.vars.get(n)
+                ec = getattr(fwd, "error_clip", None)
+                if ec is not None and _needs_grad(block, n, no_grad_set):
+                    ec._append_clip_op(block, g)
 
     # merge leaf grads (params & data) to canonical names
     params = (
@@ -208,20 +234,33 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         else program.all_parameters()
     )
     params_grads = []
-    for p in params:
-        if p.name in no_grad_set or not p.trainable:
-            continue
-        g = merged_grad(p.name)
+
+    def canonicalize(name):
+        g = merged_grad(name)
         if g is None:
-            continue
-        if g != _grad_name(p.name):
-            canonical = _grad_name(p.name)
-            _create_grad_var(block, p.name, canonical)
+            return None
+        if g != _grad_name(name):
+            canonical = _grad_name(name)
+            _create_grad_var(block, name, canonical)
             block.append_op(type="assign", inputs={"X": g},
                             outputs={"Out": canonical},
                             op_role=BACKWARD, infer_shape=False)
             g = canonical
-        params_grads.append((p, block.var(g)))
+        return g
+
+    for p in params:
+        if p.name in no_grad_set or not p.trainable:
+            continue
+        g = canonicalize(p.name)
+        if g is not None:
+            params_grads.append((p, block.var(g)))
+    # feed/data leaves have no producing op, so nothing downstream ever
+    # calls merged_grad on them — merge here or gradients() would hand
+    # back a single partial for a multiply-consumed input
+    for name, v in list(block.vars.items()):
+        if getattr(v, "is_data", False) and name in grad_map \
+                and name not in no_grad_set:
+            canonicalize(name)
     return params_grads
 
 
@@ -255,6 +294,17 @@ def _append_backward_recompute(loss, fwd_ops, parameter_list,
     block = loss.block
     program = block.program
     cset = set(checkpoints)
+
+    clipped = [n for n, v in block.vars.items()
+               if getattr(v, "error_clip", None) is not None]
+    if clipped:
+        import warnings
+
+        warnings.warn(
+            "error_clip on %s is IGNORED under recompute: segment "
+            "grads are computed inside jax.checkpoint replays, so "
+            "per-var error clipping has no insertion point" % clipped,
+            stacklevel=3)
 
     # partition forward ops into segments ending after checkpoint writes
     # (host-only ops are skipped exactly like the compiled trace skips

@@ -61,4 +61,33 @@ def set_gradient_clip(clip, param_list=None, program=None):
         p.gradient_clip = clip
 
 
-ErrorClipByValue = GradientClipByValue
+class BaseErrorClipAttr:
+    """Attaches to a *variable* (var._set_error_clip(...)): clips the
+    var's upstream error gradient the moment append_backward produces
+    it, so every op earlier in the backward walk sees the clipped
+    error (reference clip.py:33 BaseErrorClipAttr + the
+    error_clip_callback run after each appended grad op)."""
+
+    def _append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    """Reference clip.py:42: in-place clip of the attached variable's
+    gradient to [min, max] during append_backward — different
+    attachment semantics from GradientClipByValue, which rewrites the
+    final (param, grad) list just before the optimizer."""
+
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.min = -max if min is None else float(min)
+        self.max = max
+
+    def __str__(self):
+        return "ByValue, min=%f, max=%f" % (self.min, self.max)
+
+    def _append_clip_op(self, block, grad_name):
+        block.append_op(type="clip", inputs={"X": grad_name},
+                        outputs={"Out": grad_name},
+                        attrs={"min": self.min, "max": self.max},
+                        op_role="backward", infer_shape=False)

@@ -230,32 +230,44 @@ def convert_to_int8_inference(program, scope, quant_weights,
     dequantize_weight op reading the int8 tensor + scale — the stored
     model/live state holds 1-byte weights; XLA fuses the dequant into
     the consumer."""
-    import jax.numpy as jnp
-
     block = program.global_block()
     bnd = float(2 ** (weight_bits - 1) - 1)
     dequant_ops = []
     for name, (q, scale) in quant_weights.items():
         if name not in block.vars:
             continue
-        v = block.vars[name]
-        qname, sname = name + "@INT8", name + "@SCALE"
-        block.create_var(name=qname, shape=q.shape, dtype="int8",
-                         persistable=True)
-        block.create_var(name=sname, shape=np.shape(scale),
-                         dtype="float32", persistable=True)
-        v.persistable = False  # recomputed (fused) from int8 each run
+        qname, sname = _store_int8_weight(block, scope, name, q, scale)
         dequant_ops.append(OpDesc(
             "dequantize_weight", {"X": [qname], "Scale": [sname]},
             {"Out": [name]}, {"max_range": bnd}))
-        scope.var(qname).set(jnp.asarray(q))
-        scope.var(sname).set(jnp.asarray(
-            np.asarray(scale, np.float32)))
-        svar = scope.find_var(name)
-        if svar is not None:
-            svar.set(None)  # drop the fp32 copy
     block.ops = dequant_ops + block.ops
     return program
+
+
+def _store_int8_weight(block, scope, name, q, scale):
+    """Materialize <name>@INT8 + <name>@SCALE persistables in block and
+    scope, flip the fp32 var non-persistable and drop its value (it is
+    recomputed — fused — from int8 each run).  Shared by the
+    dequantize-on-load and true-int8-execution converters so the naming
+    and fp32-drop behavior can't diverge."""
+    import jax.numpy as jnp
+
+    qname, sname = name + "@INT8", name + "@SCALE"
+    if qname in block.vars:
+        return qname, sname
+    block.create_var(name=qname, shape=q.shape, dtype="int8",
+                     persistable=True)
+    block.create_var(name=sname, shape=np.shape(scale),
+                     dtype="float32", persistable=True)
+    scope.var(qname).set(jnp.asarray(q))
+    scope.var(sname).set(jnp.asarray(np.asarray(scale, np.float32)))
+    v = block.vars.get(name)
+    if v is not None:
+        v.persistable = False
+    svar = scope.find_var(name)
+    if svar is not None:
+        svar.set(None)  # drop the fp32 copy
+    return qname, sname
 
 
 _INT8_EXEC_WSLOT = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
@@ -275,46 +287,31 @@ def convert_to_int8_execution(program, scope, quant_weights,
     the activation is dynamically quantized per-tensor inside the op.
     Quantized weights consumed by unsupported ops fall back to the
     dequantize-on-load path."""
-    import jax.numpy as jnp
-
     block = program.global_block()
     bnd = float(2 ** (weight_bits - 1) - 1)
 
     # a weight is only safe to strip when EVERY consumer converts to an
     # int8 op; otherwise the original fp32 name must keep existing, so
-    # the weight falls through to the dequantize-on-load path instead
+    # the weight falls through to the dequantize-on-load path instead.
+    # Consumers are collected across ALL blocks (a while/cond sub-block
+    # reading the weight blocks conversion), but only global-block ops
+    # are rewritten.
     convertible = set()
     blocked = set()
-    for op in block.ops:
-        wslot = _INT8_EXEC_WSLOT.get(op.type)
-        consumed = {n for names in op.inputs.values() for n in names}
-        conv_w = set()
-        if wslot and not (op.type == "depthwise_conv2d"
-                          and not op.attrs.get("groups")):
-            conv_w = set(op.inputs.get(wslot, [])) & set(quant_weights)
-            convertible |= conv_w
-        blocked |= (consumed & set(quant_weights)) - conv_w
+    for blk in program.blocks:
+        for op in blk.ops:
+            wslot = _INT8_EXEC_WSLOT.get(op.type)
+            consumed = {n for names in op.inputs.values()
+                        for n in names}
+            conv_w = set()
+            if blk is block and wslot and not (
+                    op.type == "depthwise_conv2d"
+                    and not op.attrs.get("groups")):
+                conv_w = (set(op.inputs.get(wslot, []))
+                          & set(quant_weights))
+                convertible |= conv_w
+            blocked |= (consumed & set(quant_weights)) - conv_w
     convertible -= blocked
-    made = set()
-
-    def _materialize(name, q, scale):
-        qname, sname = name + "@INT8", name + "@SCALE"
-        if name in made:
-            return qname, sname
-        made.add(name)
-        block.create_var(name=qname, shape=q.shape, dtype="int8",
-                         persistable=True)
-        block.create_var(name=sname, shape=np.shape(scale),
-                         dtype="float32", persistable=True)
-        scope.var(qname).set(jnp.asarray(q))
-        scope.var(sname).set(jnp.asarray(np.asarray(scale, np.float32)))
-        v = block.vars.get(name)
-        if v is not None:
-            v.persistable = False
-        svar = scope.find_var(name)
-        if svar is not None:
-            svar.set(None)  # drop the fp32 copy
-        return qname, sname
 
     converted = set()
     new_ops = []
@@ -324,7 +321,8 @@ def convert_to_int8_execution(program, scope, quant_weights,
         wname = wnames[0] if wnames else None
         if wname in convertible:
             q, scale = quant_weights[wname]
-            qname, sname = _materialize(wname, q, scale)
+            qname, sname = _store_int8_weight(block, scope, wname, q,
+                                              scale)
             converted.add(wname)
             if op.type == "mul":
                 new_ops.append(OpDesc(

@@ -112,6 +112,18 @@ class VarDesc:
         self.is_data = is_data
         # optional sharding annotation: PartitionSpec-like tuple of axis names
         self.sharding = None
+        # error-clip attr: clips this var's upstream error gradient the
+        # moment append_backward produces it (reference clip.py:42)
+        self.error_clip = None
+
+    def _set_error_clip(self, clip):
+        """Reference framework.py Variable._set_error_clip."""
+        from paddle_tpu.clip import BaseErrorClipAttr
+
+        if not isinstance(clip, BaseErrorClipAttr):
+            raise TypeError(
+                "error_clip must be an instance of BaseErrorClipAttr")
+        self.error_clip = clip
 
     # -- convenience used by layers ------------------------------------------------
     @property
@@ -491,6 +503,10 @@ class Program:
             nb = Block(p, b.idx, b.parent_idx)
             for v in b.vars.values():
                 nv = VarDesc.from_dict(nb, v.to_dict())
+                # python-side attrs that don't serialize: carried across
+                # clone so a pre-transpile clone keeps its semantics
+                nv.error_clip = v.error_clip
+                nv.sharding = v.sharding
                 nb.vars[v.name] = nv
             for op in b.ops:
                 if for_test and op.op_role in (BACKWARD, OPTIMIZE):

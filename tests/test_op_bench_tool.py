@@ -1,0 +1,51 @@
+"""tools/op_bench.py — per-op micro-bench (reference
+operators/benchmark/op_tester.cc): spec parsing, timing run, and the
+baseline regression gate."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_op_api():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import op_bench
+
+    ms = op_bench.bench_op(
+        "scale", {"X": ("float32", (64, 64))}, {"scale": 2.0},
+        repeat=3, warmup=1)
+    assert ms > 0
+
+
+def test_cli_single_op_and_gate(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "op_bench.py"),
+         "--cpu", "--op", "mul",
+         "--input", "X=float32:32,64", "--input", "Y=float32:64,16",
+         "--repeat", "3"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["op"] == "mul" and row["ms"] > 0
+
+    # regression gate trips on an absurdly fast fake baseline
+    spec = [{"op": "mul",
+             "inputs": {"X": {"dtype": "float32", "shape": [32, 64]},
+                        "Y": {"dtype": "float32", "shape": [64, 16]}},
+             "repeat": 3}]
+    suite = tmp_path / "suite.json"
+    suite.write_text(json.dumps(spec))
+    base = [{"op": "mul", "ms": 1e-9, "device": row["device"]}]
+    basef = tmp_path / "base.json"
+    basef.write_text(json.dumps(base))
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "op_bench.py"),
+         "--cpu", "--suite", str(suite), "--baseline", str(basef),
+         "--tolerance", "2.0"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 1
+    assert "REGRESSIONS" in out.stderr
