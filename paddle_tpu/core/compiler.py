@@ -582,7 +582,8 @@ class CompiledProgram:
         return [v.name for v in self._program.persistables()
                 if not v.is_data]
 
-    def _build_fn(self, feed_names, feed_specs, fetch_names, state_specs):
+    def _build_fn(self, feed_names, feed_specs, fetch_names, state_specs,
+                  feed_shardings=None):
         import jax
 
         program = self._program
@@ -654,7 +655,13 @@ class CompiledProgram:
 
             state_sh = {k: state_shard(k, state_specs[k])
                         for k in state_names}
-            feeds_sh = {k: feed_shard(feed_specs[k]) for k in feed_names}
+            # multi-process: the committed arrays' ACTUAL shardings are
+            # authoritative (one policy, decided in _globalize); the
+            # shape-derived feed_shard is the single-process path
+            feeds_sh = (dict(feed_shardings)
+                        if feed_shardings is not None
+                        else {k: feed_shard(feed_specs[k])
+                              for k in feed_names})
             # pin state OUTPUT shardings to the input layout: XLA would
             # otherwise pick its own (e.g. shard a param consumed by
             # sharded optimizer state), and the next step's declared
@@ -666,6 +673,58 @@ class CompiledProgram:
                 donate_argnums=donate,
             )
         return jax.jit(step, donate_argnums=donate)
+
+    def _globalize(self, feeds, state):
+        """Multi-process path (reference: multi-trainer NCCL2 mode):
+        each process holds its LOCAL shard of every feed; assemble
+        global jax Arrays over the multi-host mesh via
+        make_array_from_process_local_data.  State is process-local
+        full copies (identical across processes — same startup seed),
+        committed as replicated global arrays."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._param_sharding_fn is not None:
+            raise NotImplementedError(
+                "multi-process training with per-param sharding rules "
+                "is not wired yet; use replicated state (dp)")
+        mesh = self._mesh
+        pcount = jax.process_count()
+        repl = NamedSharding(mesh, P())
+        dpn = mesh.shape[self._data_axis]
+        out_feeds = {}
+        for k, v in feeds.items():
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                out_feeds[k] = v  # caller-supplied global array
+                continue
+            arr = np.asarray(v)
+            if arr.ndim >= 1 and dpn % pcount == 0 and \
+                    (arr.shape[0] * pcount) % dpn == 0:
+                sh = NamedSharding(mesh, P(
+                    self._data_axis, *([None] * (arr.ndim - 1))))
+            elif arr.ndim == 0 or arr.shape[0] <= 1:
+                sh = repl  # scalars / broadcast rows: true replicas
+            else:
+                # an uneven local batch CANNOT be committed as
+                # 'replicated' — each process holds different rows and
+                # XLA would silently treat them as equal (no gradient
+                # reduction, divergent replicas)
+                raise ValueError(
+                    f"multi-process feed '{k}': local shape "
+                    f"{arr.shape} x {pcount} processes does not "
+                    f"divide the '{self._data_axis}' axis ({dpn}); "
+                    "feed an evenly divisible per-process shard, or "
+                    "pass a pre-built global jax.Array")
+            out_feeds[k] = jax.make_array_from_process_local_data(
+                sh, arr)
+        out_state = {}
+        for k, v in state.items():
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                out_state[k] = v
+                continue
+            out_state[k] = jax.make_array_from_process_local_data(
+                repl, np.asarray(v))
+        return out_feeds, out_state
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
         import jax
@@ -693,7 +752,8 @@ class CompiledProgram:
                 v = block.var(name)
                 if v.dtype is not None and arr.dtype != np.dtype(v.dtype):
                     arr = arr.astype(v.dtype)
-            feeds[name] = jnp.asarray(arr)
+            feeds[name] = arr if self._mesh is not None and \
+                jax.process_count() > 1 else jnp.asarray(arr)
         fetch_names = [f if isinstance(f, str) else f.name
                        for f in fetch_list]
         # persistable state from scope
@@ -705,9 +765,17 @@ class CompiledProgram:
                     f"CompiledProgram: persistable '{n}' is uninitialized —"
                     " run the startup program first")
             state[n] = var.get()
+        multiproc = self._mesh is not None and jax.process_count() > 1
+        feed_shardings = None
+        if multiproc:
+            feeds, state = self._globalize(feeds, state)
+            feed_shardings = {k: v.sharding for k, v in feeds.items()}
         key = (
             tuple(sorted((k, v.shape, str(v.dtype))
                          for k, v in feeds.items())),
+            tuple(sorted((k, str(s.spec))
+                         for k, s in feed_shardings.items()))
+            if feed_shardings else None,
             tuple(fetch_names),
             _program_fingerprint(program),
             _mesh_fingerprint(self._mesh),
@@ -719,11 +787,24 @@ class CompiledProgram:
             state_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                           for k, v in state.items()}
             fn = self._build_fn(list(feeds), feed_specs, fetch_names,
-                                state_specs)
+                                state_specs,
+                                feed_shardings=feed_shardings)
             self._cache[key] = fn
         new_state, fetches = fn(state, feeds)
         for k, v in new_state.items():
             scope.var(k).set(v)
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            out = []
+            for v in fetches:
+                if isinstance(v, jax.Array) and \
+                        not v.is_fully_addressable and \
+                        not v.is_fully_replicated:
+                    # sharded output spanning other processes: gather
+                    # the global value (reference: fetch implies a
+                    # device->host gather in multi-trainer mode)
+                    from jax.experimental import multihost_utils
+
+                    v = multihost_utils.process_allgather(v, tiled=True)
+                out.append(np.asarray(v))
+            return out
         return list(fetches)

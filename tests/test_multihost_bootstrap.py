@@ -99,3 +99,143 @@ def test_two_process_jax_distributed_bootstrap():
     for r in results:
         if "psum" in r:
             assert r["psum"] == 2 * 1.0 + 2 * 2.0, results
+
+
+_TRAIN_RUNNER = textwrap.dedent("""
+    import json, os
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from paddle_tpu.fleet import fleet
+    from paddle_tpu.fleet.role_maker import PaddleCloudRoleMaker
+
+    fleet.init(PaddleCloudRoleMaker())
+    rank = jax.process_index()
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, layers, optimizer
+
+    np.random.seed(7)                    # identical params everywhere
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, 1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(
+        framework.default_main_program()).with_data_parallel(
+        loss_name=loss.name)             # mesh over all 8 GLOBAL devices
+
+    rng = np.random.RandomState(42)      # same batch stream everywhere
+    losses = []
+    for _ in range(5):
+        bx = rng.rand(16, 4).astype(np.float32)
+        by = bx.sum(1, keepdims=True)
+        lo = rank * 8, (rank + 1) * 8    # my process-local shard
+        lv, pv = exe.run(compiled,
+                         feed={"x": bx[lo[0]:lo[1]],
+                               "y": by[lo[0]:lo[1]]},
+                         fetch_list=[loss, pred])
+        losses.append(float(np.asarray(lv)))
+    # sharded fetch gathers the GLOBAL prediction on every process
+    assert pv.shape == (16, 1), pv.shape
+    # uneven local shards must raise, not silently diverge
+    try:
+        exe.run(compiled, feed={"x": bx[:5], "y": by[:5]},
+                fetch_list=[loss])
+        uneven = "no-error"
+    except ValueError as e:
+        uneven = "raised" if "divide" in str(e) else str(e)[:80]
+    print("RESULT " + json.dumps({"rank": rank, "losses": losses,
+                                  "uneven": uneven}))
+""")
+
+
+def test_two_process_dp_training_matches_single_process():
+    """VERDICT r3 do-this #4 (reference test_dist_base.py:366
+    check_with_place): the SAME dp CompiledProgram step run as 2
+    processes x 4 virtual devices must produce the same loss
+    trajectory as one process with 8 devices."""
+    # ---- single-process reference: this test process has the 8-dev
+    # virtual mesh from conftest; run the identical model on the full
+    # batch in a subprocess for clean program/scope state
+    single = textwrap.dedent("""
+        import json
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import paddle_tpu as fluid
+        from paddle_tpu import framework, layers, optimizer
+
+        np.random.seed(7)
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, 1, bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(framework.default_startup_program())
+        compiled = fluid.CompiledProgram(
+            framework.default_main_program()).with_data_parallel(
+            loss_name=loss.name)
+        rng = np.random.RandomState(42)
+        losses = []
+        for _ in range(5):
+            bx = rng.rand(16, 4).astype(np.float32)
+            lv, = exe.run(compiled,
+                          feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+        print("RESULT " + json.dumps({"losses": losses}))
+    """)
+    env1 = {**os.environ, "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    out = subprocess.run([sys.executable, "-c", single], env=env1,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-3000:]
+    line = [ln for ln in out.stdout.splitlines()
+            if ln.startswith("RESULT ")]
+    ref_losses = json.loads(line[0][len("RESULT "):])["losses"]
+
+    # ---- 2-process cluster, 4 virtual devices each
+    eps = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    procs = []
+    for rank in range(2):
+        env = {
+            **os.environ,
+            "PADDLE_TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+            "PADDLE_CURRENT_ENDPOINT": eps[rank],
+            "PADDLE_COORDINATOR_ENDPOINT": eps[0],
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _TRAIN_RUNNER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    results = []
+    try:
+        for p in procs:
+            out_b, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()[-3000:]
+            line = [ln for ln in out_b.decode().splitlines()
+                    if ln.startswith("RESULT ")]
+            assert line, out_b.decode()[-2000:]
+            results.append(json.loads(line[0][len("RESULT "):]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert {r["rank"] for r in results} == {0, 1}
+    assert all(r["uneven"] == "raised" for r in results), results
+    # both ranks observe the same (global, replicated) loss, and it
+    # matches the single-process 8-device trajectory step for step
+    np.testing.assert_allclose(results[0]["losses"],
+                               results[1]["losses"], rtol=1e-5)
+    np.testing.assert_allclose(results[0]["losses"], ref_losses,
+                               rtol=1e-4, atol=1e-6)
+    # it actually trained
+    assert results[0]["losses"][-1] < results[0]["losses"][0]
