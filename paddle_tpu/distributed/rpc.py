@@ -239,6 +239,7 @@ class RPCServer:
         self._stop = threading.Event()
         self._threads = []
         self._barriers: dict = {}
+        self._dyn_barriers: dict = {}
         self._barrier_lock = threading.Lock()
 
     def register_handler(self, msg_type: str, fn):
@@ -259,6 +260,37 @@ class RPCServer:
     def reset_barrier(self, name: str):
         with self._barrier_lock:
             self._barriers.pop(name, None)
+            self._dyn_barriers.pop(name, None)
+
+    def barrier_dynamic(self, name: str, count_fn, poll=0.25) -> int:
+        """Like barrier(), but the required party count is re-evaluated
+        every `poll` seconds — the survivor-continue primitive: when a
+        trainer dies mid-step, count_fn (e.g. fanin - dead_trainers)
+        drops and the remaining waiters release instead of deadlocking
+        (reference rpc_server.h:48 barriers are fixed-count; the
+        reference cluster simply hangs on a dead trainer)."""
+        with self._barrier_lock:
+            b = self._dyn_barriers.get(name)
+            if b is None:
+                b = self._dyn_barriers[name] = {
+                    "cond": threading.Condition(),
+                    "arrived": 0, "gen": 0}
+        c = b["cond"]
+        with c:
+            gen = b["gen"]
+            idx = b["arrived"]
+            b["arrived"] += 1
+            c.notify_all()
+            while b["gen"] == gen and \
+                    b["arrived"] < max(1, int(count_fn())):
+                c.wait(poll)
+            if b["gen"] == gen:
+                # first waiter to observe completion advances the
+                # generation and releases everyone else
+                b["gen"] += 1
+                b["arrived"] = 0
+                c.notify_all()
+            return idx
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -398,8 +430,13 @@ class RPCClient:
     def fetch_barrier(self, endpoint):
         return self.call(endpoint, "fetch_barrier")
 
-    def send_complete(self, endpoint):
-        return self.call(endpoint, "complete")
+    def send_complete(self, endpoint, peer_id=None):
+        """Notify trainer completion (reference Executor::Close
+        SendComplete).  peer_id lets the pserver retire this trainer
+        from its liveness accounting instead of later declaring the
+        (now silent) trainer dead."""
+        stop_shared_heartbeats(endpoint=endpoint)
+        return self.call(endpoint, "complete", peer_id)
 
     def close(self):
         with self._global_lock:
@@ -524,3 +561,34 @@ class HeartbeatSender:
             self._thread.join(timeout=2 * self._interval + 1.0)
         if self._owns_client:
             self._client.close()
+
+
+# -- shared sender registry (one daemon per (endpoint, peer_id)) ----------
+_shared_senders: dict = {}
+_shared_senders_lock = threading.Lock()
+
+
+def start_shared_heartbeat(endpoint, peer_id, interval=1.0):
+    """Idempotent process-wide HeartbeatSender registry (used by the
+    trainer program's heartbeat_start op): one daemon per (endpoint,
+    peer_id), stoppable via stop_shared_heartbeats so completed jobs
+    don't leak threads that retry dead endpoints forever."""
+    key = (endpoint, str(peer_id))
+    with _shared_senders_lock:
+        s = _shared_senders.get(key)
+        if s is None:
+            s = _shared_senders[key] = HeartbeatSender(
+                None, endpoint, peer_id, interval=interval)
+        s.start()
+        return s
+
+
+def stop_shared_heartbeats(endpoint=None):
+    """Stop (and drop) shared senders — all, or those beating one
+    endpoint.  Called automatically by RPCClient.send_complete."""
+    with _shared_senders_lock:
+        keys = [k for k in _shared_senders
+                if endpoint is None or k[0] == endpoint]
+        senders = [_shared_senders.pop(k) for k in keys]
+    for s in senders:
+        s.stop()

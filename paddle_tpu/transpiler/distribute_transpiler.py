@@ -35,6 +35,11 @@ class DistributeTranspilerConfig:
         self.split_method = RoundRobin
         self.min_block_size = 1024  # min rows*cols before slicing pays off
         self.sync_mode = True
+        # liveness: trainers heartbeat the pservers; a trainer silent
+        # for this long is declared dead and sync barriers re-count so
+        # the survivors continue (see listen_and_serv effective_fanin)
+        self.heartbeat_timeout = 10.0
+        self.heartbeat_interval = 1.0
 
 
 def slice_variable(shape, slice_count):
@@ -185,6 +190,13 @@ class DistributeTranspiler:
                   if not (op.op_role == OPTIMIZE and "Param" in op.inputs)]
         eps = self.endpoints
         self._rewrite_dist_lookups(gb)
+        # liveness: announce this trainer to every pserver's heartbeat
+        # monitor (idempotent daemon; first exe.run starts it)
+        gb.ops.insert(0, OpDesc(
+            "heartbeat_start", {}, {},
+            {"endpoints": list(eps),
+             "peer_id": f"trainer{self.trainer_id}",
+             "interval": float(self.config.heartbeat_interval)}))
         # send each grad's sections
         for pname, plan in self.param_plan.items():
             gname = self.grad_of[pname]
@@ -372,7 +384,9 @@ class DistributeTranspiler:
                    "sync_mode": self.sync_mode,
                    "grad_blocks": grad_blocks,
                    "lr_names": list(self.lr_names),
-                   "sparse_grad_blocks": sparse_grad_blocks},
+                   "sparse_grad_blocks": sparse_grad_blocks,
+                   "heartbeat_timeout":
+                       float(self.config.heartbeat_timeout)},
             infer_shape=False)
         return prog
 
