@@ -294,6 +294,51 @@ class Executor:
         trainer._set_debug(debug)
         trainer._set_fetch_var_and_info(fetch_list, fetch_info, print_period)
         trainer._gen_trainer_desc()
+        # Downpour: the async PS worker loop owns pull/compute/push
+        # (reference DownpourWorker::TrainFiles, downpour_worker.cc:369)
+        opt_info = getattr(program, "_fleet_opt", None) or {}
+        runner = opt_info.get("downpour_runner")
+        if runner is None and \
+                opt_info.get("device_worker") == "DownpourSGD":
+            t = opt_info.get("transpiler")
+            if t is None:
+                # fall back to the fleet role contract (reference: the
+                # pslib fleet init is what wires DownpourWorker to its
+                # parameter servers)
+                from paddle_tpu.fleet import fleet
+                from paddle_tpu.transpiler import (
+                    DistributeTranspiler, DistributeTranspilerConfig)
+
+                rm = getattr(fleet, "_role_maker", None)
+                eps = ",".join(rm.get_pserver_endpoints()) if rm else ""
+                if not eps:
+                    raise RuntimeError(
+                        "DownpourSGD device worker needs parameter "
+                        "servers: fleet.init(role_maker) with pserver "
+                        "endpoints, or put a configured "
+                        "DistributeTranspiler in "
+                        "program._fleet_opt['transpiler'] (async "
+                        "mode), or a ready DownpourRunner in "
+                        "['downpour_runner']")
+                cfg = DistributeTranspilerConfig()
+                cfg.sync_mode = False
+                t = DistributeTranspiler(cfg)
+                t.transpile(rm.worker_index(), program=program,
+                            pservers=eps, trainers=rm.worker_num(),
+                            sync_mode=False)
+                opt_info["transpiler"] = t
+            from paddle_tpu.distributed.downpour_worker import (
+                DownpourRunner)
+
+            runner = DownpourRunner(
+                t, program=program, scope=scope, executor=self,
+                push_window=int(opt_info.get("push_window", 4)),
+                pull_dense_every=int(
+                    opt_info.get("pull_dense_every", 1)))
+            opt_info["downpour_runner"] = runner
+        if runner is not None:
+            runner.train_from_dataset(dataset, fetch_list)
+            return None
         step = 0
         feeder = DeviceFeeder(dataset._iter_batches(),
                               capacity=max(4, 2 * (thread or 1)))

@@ -1,0 +1,228 @@
+"""Real Downpour async worker loop (reference
+framework/downpour_worker.cc:369 DownpourWorker::TrainFiles;
+framework/fleet/fleet_wrapper.h:55 PullSparseVarsSync, :62
+PushSparseVarsWithLabelAsync, :95 PullDenseVarsAsync; plus
+framework/pull_dense_worker.cc's periodic dense refresh).
+
+Per batch the worker
+
+  1. PULLS the batch's sparse rows from the PS table shards into the
+     local table (reference PullSparseVarsSync + FillSparseValue),
+  2. runs forward/backward locally — optimizer ops are NOT run, the
+     parameter server owns every update,
+  3. PUSHES sparse and dense gradients asynchronously with a bounded
+     in-flight window (the staleness knob the reference expresses as
+     push_{sparse,dense}_wait_times), and
+  4. refreshes dense params from the PS every `pull_dense_every`
+     batches (PullDenseWorker semantics: params are at most that many
+     steps stale).
+
+The PS side is the ordinary async-mode listen_and_serv program built by
+DistributeTranspiler (grads applied on arrival, sparse blocks per table
+section) — the runner just drives it with Downpour's timing instead of
+the inline send/recv ops of the transpiled trainer program.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+__all__ = ["DownpourRunner"]
+
+
+class DownpourRunner:
+    def __init__(self, transpiler, program=None, scope=None,
+                 executor=None, push_window=4, pull_dense_every=1):
+        from paddle_tpu.core.program import OPTIMIZE
+        from paddle_tpu.core.scope import global_scope
+        from paddle_tpu.distributed.rpc import RPCClient
+
+        t = transpiler
+        if not t.endpoints:
+            raise ValueError("transpiler has no pserver endpoints")
+        self.t = t
+        self.eps = list(t.endpoints)
+        self.scope = scope if scope is not None else global_scope()
+        if executor is None:
+            import paddle_tpu as fluid
+
+            executor = fluid.Executor(fluid.CPUPlace())
+        self.exe = executor
+        prog = program if program is not None else t.origin_program
+        # local worker program: fwd + bwd only (the PS runs optimizers)
+        self.worker_prog = prog.clone()
+        gb = self.worker_prog.global_block()
+        gb.ops = [op for op in gb.ops if op.op_role != OPTIMIZE]
+        # sparse tables -> the id slots their lookups consume
+        self.table_ids: dict = {}
+        for op in gb.ops:
+            if op.type == "lookup_table" and \
+                    op.inputs["W"][0] in t.dist_tables:
+                self.table_ids.setdefault(
+                    op.inputs["W"][0], []).extend(op.inputs["Ids"])
+        # persistent local fill buffer per table (reference
+        # FillSparseValue target): dist tables never initialize on
+        # non-zero trainers, and only the pulled rows are ever read, so
+        # zeros are the right start.  Pulls scatter into THIS buffer —
+        # no O(vocab x dim) copy per batch.
+        self._table_buf: dict = {}
+        for wname in self.table_ids:
+            var = self.scope.find_var(wname)
+            if var is not None and var.get() is not None:
+                buf = np.array(var.get(), copy=True)
+            else:
+                v = self.worker_prog.global_block().var(wname)
+                buf = np.zeros(tuple(v.shape),
+                               np.dtype(v.dtype or "float32"))
+            self._table_buf[wname] = buf
+            self.scope.var(wname).set(buf)
+        self.push_window = int(push_window)
+        self.pull_dense_every = max(1, int(pull_dense_every))
+        self._pool = ThreadPoolExecutor(max_workers=2)
+        self._pending: deque = deque()
+        self._batch = 0
+        self._lock = threading.Lock()
+        # dedicated clients: pushes must never block pulls on a
+        # connection lock (reference: separate push status queues)
+        self._pull_client = RPCClient()
+        self._push_client = RPCClient()
+        # liveness: announce this worker so pserver barriers/completions
+        # account for it (see listen_and_serv effective_fanin); the
+        # beat interval pairs with the transpiler's heartbeat_timeout
+        from paddle_tpu.distributed.rpc import start_shared_heartbeat
+
+        interval = float(getattr(t.config, "heartbeat_interval", 1.0))
+        for ep in self.eps:
+            start_shared_heartbeat(ep, f"trainer{t.trainer_id}",
+                                   interval=interval)
+
+    # ----------------------------------------------------------- dense
+    def pull_dense(self):
+        """Refresh every dense param from its PS shards (reference
+        PullDenseVarsAsync / pull_dense_worker.cc)."""
+        import jax.numpy as jnp
+
+        for pname, plan in self.t.param_plan.items():
+            parts = [self._pull_client.get_var(
+                self.eps[ep_i], sec) for ep_i, sec, _s, _e in plan]
+            val = parts[0] if len(parts) == 1 else np.concatenate(
+                parts, axis=0)
+            self.scope.var(pname).set(jnp.asarray(val))
+
+    def _push_dense(self):
+        """Async dense-grad push (reference PushDenseVarsAsync)."""
+        for pname, plan in self.t.param_plan.items():
+            gname = self.t.grad_of.get(pname)
+            if gname is None:
+                continue
+            gvar = self.scope.find_var(gname)
+            if gvar is None or gvar.get() is None:
+                continue
+            g = np.asarray(gvar.get())
+            for ep_i, sec, s, e in plan:
+                gsec = self.t._grad_section_name(pname, sec)
+                part = g if (s == 0 and e == -1) else g[s:e]
+                self._submit(lambda ep=self.eps[ep_i], n=gsec,
+                             v=np.ascontiguousarray(part):
+                             self._push_client.send_var(ep, n, v))
+
+    # ---------------------------------------------------------- sparse
+    def _pull_sparse(self, feed):
+        """Pull the batch's rows into the persistent local buffer
+        (reference PullSparseVarsSync + FillSparseValue)."""
+        for wname, slots in self.table_ids.items():
+            chunks = [np.asarray(feed[s]).ravel() for s in slots
+                      if s in feed]
+            if not chunks:
+                continue
+            ids = np.unique(np.concatenate(chunks).astype(np.int64))
+            buf = self._table_buf[wname]
+            n_rows = buf.shape[0]
+            for ep_i, sec, s, e in self.t.dist_tables[wname]:
+                hi = n_rows if e == -1 else e
+                sel = ids[(ids >= s) & (ids < hi)]
+                if sel.size == 0:
+                    continue
+                rows = self._pull_client.call(
+                    self.eps[ep_i], "prefetch_rows",
+                    (sec, (sel - s).astype(np.int64)))
+                buf[sel] = rows
+            self.scope.var(wname).set(buf)
+
+    def _push_sparse(self, feed):
+        """Async sparse-grad push (reference
+        PushSparseVarsWithLabelAsync, minus the pslib click/CVM
+        columns which belong to the closed table format)."""
+        for wname in self.table_ids:
+            gvar = self.scope.find_var(wname + "@GRAD")
+            if gvar is None or gvar.get() is None:
+                continue
+            g = gvar.get()
+            if hasattr(g, "rows"):          # SelectedRows
+                rows = np.asarray(g.rows).astype(np.int64)
+                vals = np.asarray(g.values)
+            else:                            # dense grad: batch rows
+                chunks = [np.asarray(feed[s]).ravel()
+                          for s in self.table_ids[wname] if s in feed]
+                rows = np.unique(
+                    np.concatenate(chunks).astype(np.int64))
+                vals = np.asarray(g)[rows]
+            n_rows = int(self.scope.find_var(wname).get().shape[0])
+            for ep_i, sec, s, e in self.t.dist_tables[wname]:
+                hi = n_rows if e == -1 else e
+                m = (rows >= s) & (rows < hi)
+                if not m.any():
+                    continue
+                gsec = self.t._grad_section_name(wname, sec)
+                self._submit(lambda ep=self.eps[ep_i], n=gsec,
+                             r=np.ascontiguousarray(rows[m] - s),
+                             v=np.ascontiguousarray(vals[m]):
+                             self._push_client.call(
+                                 ep, "send_sparse", (n, r, v)))
+
+    # ------------------------------------------------------- lifecycle
+    def _submit(self, fn):
+        """Bounded-staleness async push: at most push_window in-flight
+        (reference push_*_wait_times; a full window waits the oldest)."""
+        with self._lock:
+            while len(self._pending) >= self.push_window:
+                self._pending.popleft().result()
+            self._pending.append(self._pool.submit(fn))
+
+    def drain(self):
+        with self._lock:
+            while self._pending:
+                self._pending.popleft().result()
+
+    def run_step(self, feed, fetch_list=()):
+        """One Downpour batch: pull -> compute -> async push."""
+        if self._batch % self.pull_dense_every == 0:
+            self.drain()      # pushed grads land before the re-pull
+            self.pull_dense()
+        self._pull_sparse(feed)
+        res = self.exe.run(self.worker_prog, feed=feed,
+                           fetch_list=list(fetch_list),
+                           scope=self.scope)
+        self._push_sparse(feed)
+        self._push_dense()
+        self._batch += 1
+        return res
+
+    def train_from_dataset(self, dataset, fetch_list=()):
+        """Dataset-driven Downpour loop (reference TrainFiles: while
+        device_reader->Next())."""
+        results = []
+        for feed in dataset._iter_batches():
+            results.append(self.run_step(feed, fetch_list))
+        self.drain()
+        return results
+
+    def finish(self):
+        self.drain()
+        self._pool.shutdown(wait=True)
+        self._pull_client.close()
+        self._push_client.close()

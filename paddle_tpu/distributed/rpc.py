@@ -238,7 +238,6 @@ class RPCServer:
         self._handlers = {}
         self._stop = threading.Event()
         self._threads = []
-        self._barriers: dict = {}
         self._dyn_barriers: dict = {}
         self._barrier_lock = threading.Lock()
 
@@ -249,17 +248,12 @@ class RPCServer:
     def barrier(self, name: str, count: int) -> int:
         """Blocks the calling handler until `count` parties arrived;
         returns the arrival index (0..count-1) so one caller can be
-        elected to do post-barrier work."""
-        with self._barrier_lock:
-            b = self._barriers.get(name)
-            if b is None or b._parties != count:
-                b = threading.Barrier(count)
-                self._barriers[name] = b
-        return b.wait()
+        elected to do post-barrier work.  Fixed-count convenience over
+        barrier_dynamic (one implementation, one release semantics)."""
+        return self.barrier_dynamic(name, lambda: count)
 
     def reset_barrier(self, name: str):
         with self._barrier_lock:
-            self._barriers.pop(name, None)
             self._dyn_barriers.pop(name, None)
 
     def barrier_dynamic(self, name: str, count_fn, poll=0.25) -> int:
@@ -424,18 +418,18 @@ class RPCClient:
     def get_var(self, endpoint, name):
         return self.call(endpoint, "get_var", name)
 
-    def send_barrier(self, endpoint):
-        return self.call(endpoint, "send_barrier")
+    def send_barrier(self, endpoint, peer_id=None):
+        return self.call(endpoint, "send_barrier", peer_id)
 
-    def fetch_barrier(self, endpoint):
-        return self.call(endpoint, "fetch_barrier")
+    def fetch_barrier(self, endpoint, peer_id=None):
+        return self.call(endpoint, "fetch_barrier", peer_id)
 
     def send_complete(self, endpoint, peer_id=None):
         """Notify trainer completion (reference Executor::Close
         SendComplete).  peer_id lets the pserver retire this trainer
         from its liveness accounting instead of later declaring the
         (now silent) trainer dead."""
-        stop_shared_heartbeats(endpoint=endpoint)
+        stop_shared_heartbeats(endpoint=endpoint, peer_id=peer_id)
         return self.call(endpoint, "complete", peer_id)
 
     def close(self):
@@ -583,12 +577,16 @@ def start_shared_heartbeat(endpoint, peer_id, interval=1.0):
         return s
 
 
-def stop_shared_heartbeats(endpoint=None):
-    """Stop (and drop) shared senders — all, or those beating one
-    endpoint.  Called automatically by RPCClient.send_complete."""
+def stop_shared_heartbeats(endpoint=None, peer_id=None):
+    """Stop (and drop) shared senders — all, one endpoint's, or one
+    (endpoint, peer) pair's.  Called automatically by
+    RPCClient.send_complete with the completing peer only, so other
+    peers hosted in the same process keep beating."""
+    peer_id = None if peer_id is None else str(peer_id)
     with _shared_senders_lock:
         keys = [k for k in _shared_senders
-                if endpoint is None or k[0] == endpoint]
+                if (endpoint is None or k[0] == endpoint)
+                and (peer_id is None or k[1] == peer_id)]
         senders = [_shared_senders.pop(k) for k in keys]
     for s in senders:
         s.stop()

@@ -40,10 +40,10 @@ register_op("recv", inputs=(), outputs=("Out",),
             attrs={"epmap": [], "section_names": [], "sections": []},
             differentiable=False, host_only=True)(_structural)
 register_op("send_barrier", inputs=(), outputs=(),
-            attrs={"endpoints": []},
+            attrs={"endpoints": [], "peer_id": ""},
             differentiable=False, host_only=True)(_structural)
 register_op("fetch_barrier", inputs=(), outputs=(),
-            attrs={"endpoints": []},
+            attrs={"endpoints": [], "peer_id": ""},
             differentiable=False, host_only=True)(_structural)
 register_op("listen_and_serv", inputs=(), outputs=(),
             attrs={"endpoint": REQUIRED, "Fanin": 1, "sync_mode": True,
@@ -138,15 +138,17 @@ def recv_op(op, block, scope, ctx):
 @register_special_op("send_barrier")
 def send_barrier_op(op, block, scope, ctx):
     client = global_rpc_client()
+    peer = op.attrs.get("peer_id") or None
     for ep in op.attrs["endpoints"]:
-        client.send_barrier(ep)
+        client.send_barrier(ep, peer_id=peer)
 
 
 @register_special_op("fetch_barrier")
 def fetch_barrier_op(op, block, scope, ctx):
     client = global_rpc_client()
+    peer = op.attrs.get("peer_id") or None
     for ep in op.attrs["endpoints"]:
-        client.fetch_barrier(ep)
+        client.fetch_barrier(ep, peer_id=peer)
 
 
 @register_special_op("prefetch")
@@ -282,8 +284,20 @@ def listen_and_serv_op(op, block, scope, ctx):
                 if name in grad_block_map:   # async: apply on arrival
                     ctx.run_block(grad_block_map[name], scope)
 
-    def on_send_barrier(_):
+    def _fenced_peer(peer):
+        # a fenced-but-still-alive trainer's arrivals must not count
+        # toward (or block on) barriers: it was excluded from
+        # effective_fanin, so letting it join would release barriers
+        # early and desync the generations for the true survivors
+        if peer is None:
+            return False
+        with live_lock:
+            return str(peer) in fenced
+
+    def on_send_barrier(peer):
         if not sync:
+            return
+        if _fenced_peer(peer):
             return
         idx = server.barrier_dynamic("send", effective_fanin)
         if idx == 0:
@@ -340,8 +354,8 @@ def listen_and_serv_op(op, block, scope, ctx):
             elif rows.size:
                 _apply_sparse(gsec, rows, vals)
 
-    def on_fetch_barrier(_):
-        if sync:
+    def on_fetch_barrier(peer):
+        if sync and not _fenced_peer(peer):
             server.barrier_dynamic("fetch", effective_fanin)
 
     def on_complete(peer):

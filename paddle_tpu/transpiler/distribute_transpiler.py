@@ -220,13 +220,15 @@ class DistributeTranspiler:
                     infer_shape=False)
         if self.sync_mode:
             gb.append_op(type="send_barrier", inputs={}, outputs={},
-                         attrs={"endpoints": list(eps)},
+                         attrs={"endpoints": list(eps),
+                                "peer_id": f"trainer{self.trainer_id}"},
                          infer_shape=False)
         # recv updated params
         self._append_recv_ops(gb)
         if self.sync_mode:
             gb.append_op(type="fetch_barrier", inputs={}, outputs={},
-                         attrs={"endpoints": list(eps)},
+                         attrs={"endpoints": list(eps),
+                                "peer_id": f"trainer{self.trainer_id}"},
                          infer_shape=False)
         gb.ops.extend(trainer_opt_ops)
         if self.dist_tables:

@@ -591,6 +591,155 @@ def _local_table_losses():
     return losses
 
 
+_DOWNPOUR_RUNNER = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.models.deepfm import deepfm_model
+    from paddle_tpu.transpiler import (DistributeTranspiler,
+                                       DistributeTranspilerConfig)
+
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    pserver_eps = os.environ["PADDLE_PSERVER_EPS"]
+    current_ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    VOCAB, FIELDS, DENSE = 64, 4, 3
+    np.random.seed(7)
+    model = deepfm_model(num_fields=FIELDS, vocab_size=VOCAB,
+                         embed_dim=4, dense_dim=DENSE, hidden=(16,),
+                         is_sparse=False, is_distributed=True)
+    optimizer.SGD(0.5).minimize(model["loss"])
+
+    cfg = DistributeTranspilerConfig()
+    cfg.min_block_size = 1
+    t = DistributeTranspiler(cfg)
+    t.transpile(trainer_id, pservers=pserver_eps, trainers=trainers,
+                sync_mode=False)           # Downpour is async
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == "PSERVER":
+        main = t.get_pserver_program(current_ep)
+        startup = t.get_startup_program(current_ep, main)
+        exe.run(startup)
+        exe.run(main)
+        sys.exit(0)
+
+    exe.run(t.get_trainer_startup_program())   # pushes init to the PS
+    from paddle_tpu.distributed.downpour_worker import DownpourRunner
+
+    runner = DownpourRunner(t, push_window=3, pull_dense_every=2)
+    rng = np.random.RandomState(100 + trainer_id)
+    truth = np.arange(VOCAB, dtype=np.float32) % 5 - 2.0
+    losses = []
+    for step in range(80):
+        bi = rng.randint(0, VOCAB, (64, FIELDS, 1)).astype(np.int64)
+        bx = rng.rand(64, DENSE).astype(np.float32)
+        score = truth[bi[:, :, 0]].sum(axis=1, keepdims=True)
+        by = (score > 0).astype(np.int64)
+        lv, = runner.run_step({"sparse_ids": bi, "dense_x": bx,
+                               "label": by},
+                              fetch_list=[model["loss"]])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    runner.finish()
+    from paddle_tpu.distributed.rpc import global_rpc_client
+    client = global_rpc_client()
+    for ep in pserver_eps.split(","):
+        client.send_complete(ep, peer_id="trainer%d" % trainer_id)
+    print("LOSSES " + json.dumps(losses))
+""")
+
+
+def test_downpour_worker_deepfm_cluster():
+    """Round-3 verdict do-this #7 (anchor downpour_worker.cc:369):
+    real async Downpour semantics — per-batch sparse pull ->
+    fwd/bwd (no local optimizer) -> async bounded-window push, dense
+    params refreshed every k batches — driving DeepFM against the
+    subprocess PS cluster; loss must converge on the
+    embedding-determined target."""
+    eps = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(2))
+    env_base = {
+        **os.environ,
+        "PADDLE_TRAINERS_NUM": "2",
+        "PADDLE_PSERVER_EPS": eps,
+        "JAX_PLATFORMS": "cpu",
+    }
+    procs, trainers = [], []
+    for ep in eps.split(","):
+        env = {**env_base, "PADDLE_TRAINING_ROLE": "PSERVER",
+               "PADDLE_CURRENT_ENDPOINT": ep}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _DOWNPOUR_RUNNER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for tid in range(2):
+        env = {**env_base, "PADDLE_TRAINING_ROLE": "TRAINER",
+               "PADDLE_TRAINER_ID": str(tid)}
+        trainers.append(subprocess.Popen(
+            [sys.executable, "-c", _DOWNPOUR_RUNNER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = []
+    try:
+        for p in trainers:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()[-3000:]
+            outs.append(out.decode())
+        for p in procs:
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, err.decode()[-3000:]
+    finally:
+        for p in procs + trainers:
+            if p.poll() is None:
+                p.kill()
+    for out in outs:
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("LOSSES ")]
+        assert line, out[-2000:]
+        tl = json.loads(line[0][len("LOSSES "):])
+        # async staleness tolerated: average of the last 5 steps well
+        # below the first step's loss
+        assert np.mean(tl[-5:]) < tl[0] * 0.6, tl[::8]
+
+
+def test_train_from_dataset_dispatches_downpour_runner():
+    """executor.train_from_dataset hands the loop to the Downpour
+    runner when _fleet_opt selects the DownpourSGD device worker
+    (reference RunFromDataset -> DistMultiTrainer -> DownpourWorker)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+    optimizer.SGD(0.1).minimize(loss)
+    prog = fluid.default_main_program()
+
+    seen = []
+
+    class _StubRunner:
+        def train_from_dataset(self, dataset, fetch_list):
+            seen.append((dataset, tuple(fetch_list)))
+
+    class _StubDataset:
+        _thread = 1
+
+        def _iter_batches(self):
+            return iter(())
+
+    prog._fleet_opt = {"trainer": "DistMultiTrainer",
+                       "device_worker": "DownpourSGD",
+                       "sparse_tables": [], "dense_tables": [],
+                       "skip_ops": [],
+                       "downpour_runner": _StubRunner()}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ds = _StubDataset()
+    exe.train_from_dataset(prog, ds, fetch_list=[loss])
+    assert seen and seen[0][0] is ds
+
+
 def test_distributed_lookup_table_cluster():
     """Embedding sharded across 2 pservers, 2 trainers, sync mode:
     step-0 loss identical to local (init push covers the table shards),
