@@ -85,7 +85,8 @@ class Communicator:
             gsec = self._t._grad_section_name(pname, sec)
             part = merged if (s == 0 and e == -1) else merged[s:e]
             client.send_var(self._t.endpoints[i], gsec,
-                            np.ascontiguousarray(part))
+                            np.ascontiguousarray(part),
+                            trainer_idx=int(self._t.trainer_id))
 
     def _flush(self):
         for gname, q in self._queues.items():
@@ -114,8 +115,10 @@ class Communicator:
         while self._running:
             for pname, plan in self._t.param_plan.items():
                 try:
-                    parts = [client.get_var(self._t.endpoints[i], sec)
-                             for i, sec, *_ in plan]
+                    parts = [client.get_var(
+                        self._t.endpoints[i], sec,
+                        trainer_idx=int(self._t.trainer_id))
+                        for i, sec, *_ in plan]
                 except Exception:
                     continue
                 val = parts[0] if len(parts) == 1 else \

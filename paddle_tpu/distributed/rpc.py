@@ -412,11 +412,18 @@ class RPCClient:
         return reply
 
     # reference rpc_client.h API names
-    def send_var(self, endpoint, name, value):
-        return self.call(endpoint, "send_var", (name, value))
+    def send_var(self, endpoint, name, value, trainer_idx=None):
+        """trainer_idx (int) identifies the sender — DC-ASGD pservers
+        use it to pick the per-trainer param backup."""
+        if trainer_idx is None:
+            return self.call(endpoint, "send_var", (name, value))
+        return self.call(endpoint, "send_var",
+                         (name, value, int(trainer_idx)))
 
-    def get_var(self, endpoint, name):
-        return self.call(endpoint, "get_var", name)
+    def get_var(self, endpoint, name, trainer_idx=None):
+        if trainer_idx is None:
+            return self.call(endpoint, "get_var", name)
+        return self.call(endpoint, "get_var", (name, int(trainer_idx)))
 
     def send_barrier(self, endpoint, peer_id=None):
         return self.call(endpoint, "send_barrier", peer_id)

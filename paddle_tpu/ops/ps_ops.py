@@ -34,10 +34,12 @@ def _structural(ins, attrs):  # pragma: no cover
 
 # registry entries so append_op validates attrs + programs serialize
 register_op("send", inputs=("X",), outputs=(),
-            attrs={"epmap": [], "section_names": [], "sections": []},
+            attrs={"epmap": [], "section_names": [], "sections": [],
+                   "trainer_idx": -1},
             differentiable=False, host_only=True)(_structural)
 register_op("recv", inputs=(), outputs=("Out",),
-            attrs={"epmap": [], "section_names": [], "sections": []},
+            attrs={"epmap": [], "section_names": [], "sections": [],
+                   "trainer_idx": -1},
             differentiable=False, host_only=True)(_structural)
 register_op("send_barrier", inputs=(), outputs=(),
             attrs={"endpoints": [], "peer_id": ""},
@@ -49,6 +51,7 @@ register_op("listen_and_serv", inputs=(), outputs=(),
             attrs={"endpoint": REQUIRED, "Fanin": 1, "sync_mode": True,
                    "grad_blocks": [], "lr_names": [],
                    "sparse_grad_blocks": [],
+                   "dc_pairs": [],
                    "heartbeat_timeout": 10.0},
             differentiable=False, host_only=True)(_structural)
 register_op("ps_sync_init", inputs=("X",), outputs=(),
@@ -110,27 +113,36 @@ def heartbeat_start_op(op, block, scope, ctx):
                                    op.attrs.get("interval", 1.0)))
 
 
+def _tid(op):
+    """trainer_idx attr -> int, or None when unset (-1 sentinel)."""
+    tid = op.attrs.get("trainer_idx", -1)
+    return None if tid is None or int(tid) < 0 else int(tid)
+
+
 @register_special_op("send")
 def send_op(op, block, scope, ctx):
     """Row-sliced send of a var's sections to their pservers
     (reference parameter_send.cc)."""
     client = global_rpc_client()
+    tid = _tid(op)
     x = _np(scope.find_var(op.inputs["X"][0]).get())
     for ep, name, (s, e) in zip(op.attrs["epmap"],
                                 op.attrs["section_names"],
                                 op.attrs["sections"]):
         sec = x if s == 0 and e == -1 else x[s:e]
-        client.send_var(ep, name, np.ascontiguousarray(sec))
+        client.send_var(ep, name, np.ascontiguousarray(sec),
+                        trainer_idx=tid)
 
 
 @register_special_op("recv")
 def recv_op(op, block, scope, ctx):
     client = global_rpc_client()
+    tid = _tid(op)
     parts = []
     for ep, name, _sec in zip(op.attrs["epmap"],
                               op.attrs["section_names"],
                               op.attrs["sections"]):
-        parts.append(client.get_var(ep, name))
+        parts.append(client.get_var(ep, name, trainer_idx=tid))
     val = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
     scope.var(op.outputs["Out"][0]).set(jnp.asarray(val))
 
@@ -269,19 +281,41 @@ def listen_and_serv_op(op, block, scope, ctx):
     init_evt = threading.Event()
     ncomplete = [0]
 
+    # DC-ASGD (reference _append_dc_asgd_ops + RequestGetHandler's
+    # dc_asgd branch): per-trainer param backups, snapshotted when the
+    # trainer pulls; primed lazily so a pre-first-pull gradient gets
+    # zero correction instead of w - 0
+    dc_pairs = {g: p for g, p in attrs.get("dc_pairs", [])}
+    dc_secs = set(dc_pairs.values())
+    dc_primed: set = set()
+
+    def _dc_prime(sec, tid):
+        if (sec, tid) in dc_primed:
+            return
+        dc_primed.add((sec, tid))
+        pv = scope.find_var(sec)
+        if pv is not None and pv.get() is not None:
+            scope.var(f"{sec}.bak.{tid}").set(pv.get())
+
     def _apply_sparse(gsec, rows, vals):
         scope.var(gsec + ".rows").set(jnp.asarray(rows))
         scope.var(gsec + ".values").set(jnp.asarray(vals))
         ctx.run_block(sparse_block_map[gsec], scope)
 
     def on_send_var(payload):
-        name, val = payload
+        name, val = payload[0], payload[1]
+        tid = payload[2] if len(payload) > 2 else None
         with lock:
             if sync and name in grad_block_map:
                 buffers.setdefault(name, []).append(val)
             else:
                 scope.var(name).set(jnp.asarray(val))
                 if name in grad_block_map:   # async: apply on arrival
+                    if name in dc_pairs:
+                        k = int(tid) if tid is not None else 0
+                        _dc_prime(dc_pairs[name], k)
+                        scope.var("@TRAINER_ID@").set(
+                            jnp.asarray([k], jnp.int32))
                     ctx.run_block(grad_block_map[name], scope)
 
     def _fenced_peer(peer):
@@ -325,12 +359,21 @@ def listen_and_serv_op(op, block, scope, ctx):
                         _apply_sparse(gsec, rows, vals2)
         server.barrier_dynamic("send_done", effective_fanin)
 
-    def on_get_var(name):
+    def on_get_var(payload):
+        name, tid = (payload, None) if isinstance(payload, str) \
+            else (payload[0], payload[1])
         with lock:
             var = scope.find_var(name)
             if var is None or var.get() is None:
                 raise KeyError(f"pserver has no var '{name}'")
-            return _np(var.get())
+            val = _np(var.get())
+            if tid is not None and name in dc_secs:
+                # the pull snapshot this trainer's future delayed
+                # grads will be corrected against
+                scope.var(f"{name}.bak.{int(tid)}").set(
+                    jnp.asarray(val))
+                dc_primed.add((name, int(tid)))
+            return val
 
     def on_prefetch_rows(payload):
         """Lookup rows of a table shard (reference: the pserver-side

@@ -40,6 +40,11 @@ class DistributeTranspilerConfig:
         # the survivors continue (see listen_and_serv effective_fanin)
         self.heartbeat_timeout = 10.0
         self.heartbeat_interval = 1.0
+        # delay-compensated async SGD (reference
+        # distribute_transpiler.py:1905 _append_dc_asgd_ops): corrects
+        # each delayed grad with g + g*g*(w_now - w_at_pull) using a
+        # per-trainer param backup snapshotted when the trainer pulls
+        self.enable_dc_asgd = False
 
 
 def slice_variable(shape, slice_count):
@@ -208,6 +213,7 @@ class DistributeTranspiler:
                         self._grad_section_name(pname, sec)
                         for _, sec, *_ in plan],
                     "sections": [[s, e] for _, _, s, e in plan],
+                    "trainer_idx": int(self.trainer_id),
                 }, infer_shape=False)
         # per-step learning-rate push for scheduler-produced lr vars
         for lr in self.lr_names:
@@ -288,6 +294,7 @@ class DistributeTranspiler:
                     "epmap": [self.endpoints[i] for i, *_ in plan],
                     "section_names": [sec for _, sec, *_ in plan],
                     "sections": [[s, e] for _, _, s, e in plan],
+                    "trainer_idx": int(self.trainer_id),
                 }, infer_shape=False)
 
     def _build_trainer_startup(self):
@@ -338,7 +345,12 @@ class DistributeTranspiler:
         prog = Program()
         gb = prog.global_block()
         origin_gb = self.origin_program.global_block()
+        dc = bool(self.config.enable_dc_asgd) and not self.sync_mode
+        if dc:
+            gb.create_var(name="@TRAINER_ID@", shape=(1,),
+                          dtype="int64")
         grad_blocks = []
+        dc_pairs = []
         for pname, sec, s, e in self._sections_on(endpoint):
             pvar = origin_gb.var(pname)
             shape = self._sliced_shape(pvar.shape, s, e)
@@ -349,8 +361,13 @@ class DistributeTranspiler:
             opt_op = next(op for op in self.opt_ops
                           if op.inputs["Param"][0] == pname)
             sub = prog._create_block()
+            opt_gsec = gsec
+            if dc:
+                opt_gsec = self._append_dc_asgd_ops(
+                    gb, sub, sec, gsec, shape, pvar.dtype)
+                dc_pairs.append([gsec, sec])
             self._clone_opt_op(prog, gb, sub, opt_op, pname, sec, gsec,
-                               s, e, origin_gb)
+                               s, e, origin_gb, opt_gsec=opt_gsec)
             prog._rollback()
             grad_blocks.append([gsec, sub.idx])
         # distributed lookup-table shards + their sparse-update blocks
@@ -387,19 +404,63 @@ class DistributeTranspiler:
                    "grad_blocks": grad_blocks,
                    "lr_names": list(self.lr_names),
                    "sparse_grad_blocks": sparse_grad_blocks,
+                   "dc_pairs": dc_pairs,
                    "heartbeat_timeout":
                        float(self.config.heartbeat_timeout)},
             infer_shape=False)
         return prog
 
+    def _append_dc_asgd_ops(self, gb, sub, sec, gsec, shape, dtype):
+        """Delay compensation on the pserver (reference
+        distribute_transpiler.py:1905 _append_dc_asgd_ops):
+        corrected = g + g*g*(w_now - w_bak[trainer]), where w_bak is
+        the per-trainer snapshot taken when that trainer pulled w
+        (request_handler_impl.cc RequestGetHandler dc_asgd branch).
+        Returns the corrected grad var name the optimizer consumes."""
+        bak_names = []
+        for k in range(self.trainers):
+            bn = f"{sec}.bak.{k}"
+            gb.create_var(name=bn, shape=shape, dtype=dtype,
+                          persistable=True)
+            bak_names.append(bn)
+
+        def tmp(suffix):
+            name = f"{gsec}.{suffix}"
+            sub.create_var(name=name, shape=shape, dtype=dtype)
+            return name
+
+        local_bak = tmp("local_bak")
+        sub.ops.append(OpDesc(
+            "ref_by_trainer_id",
+            {"X": bak_names, "TrainerId": ["@TRAINER_ID@"]},
+            {"Out": [local_bak]}, {}))
+        o1, o2, o3, o4 = (tmp("dc1"), tmp("dc2"), tmp("dc3"),
+                          tmp("dc"))
+        sub.ops.append(OpDesc("elementwise_sub",
+                              {"X": [sec], "Y": [local_bak]},
+                              {"Out": [o1]}, {"axis": -1}))
+        sub.ops.append(OpDesc("elementwise_mul",
+                              {"X": [o1], "Y": [gsec]},
+                              {"Out": [o2]}, {"axis": -1}))
+        sub.ops.append(OpDesc("elementwise_mul",
+                              {"X": [o2], "Y": [gsec]},
+                              {"Out": [o3]}, {"axis": -1}))
+        sub.ops.append(OpDesc("elementwise_add",
+                              {"X": [gsec], "Y": [o3]},
+                              {"Out": [o4]}, {"axis": -1}))
+        return o4
+
     def _clone_opt_op(self, prog, gb, sub, opt_op, pname, sec, gsec,
-                      s, e, origin_gb):
+                      s, e, origin_gb, opt_gsec=None):
         """Optimizer op remapped onto this param section: same-shaped
         accumulators are sliced alongside the param, scalar accumulators
         (beta pows) are copied per section (reference grad-merge +
-        optimizer blocks, distribute_transpiler.py:1967)."""
+        optimizer blocks, distribute_transpiler.py:1967).  opt_gsec
+        overrides the Grad the optimizer consumes (DC-ASGD corrected
+        grad) while gsec stays the wire/arrival name."""
         pshape = tuple(origin_gb.var(pname).shape or ())
-        name_map = {pname: sec, self.grad_of[pname]: gsec}
+        name_map = {pname: sec,
+                    self.grad_of[pname]: opt_gsec or gsec}
         for slot, names in opt_op.inputs.items():
             for n in names:
                 if n in name_map or n in self.lr_names:
@@ -435,6 +496,7 @@ class DistributeTranspiler:
         for op in origin_sb.ops:
             if op.type == "fill_constant" and op.outputs.get("Out"):
                 fills[op.outputs["Out"][0]] = op
+        dc = bool(self.config.enable_dc_asgd) and not self.sync_mode
         for pname, sec, s, e in self._sections_on(endpoint):
             pvar = origin_gb.var(pname)
             shape = self._sliced_shape(pvar.shape, s, e)
@@ -443,6 +505,19 @@ class DistributeTranspiler:
             gb.append_op(type="fill_constant", outputs={"Out": v},
                          attrs={"shape": list(shape), "dtype": pvar.dtype,
                                 "value": 0.0}, infer_shape=False)
+            if dc:
+                # per-trainer DC-ASGD param backups start at zero; the
+                # serve loop primes/snapshots them per trainer before
+                # any correction selects them
+                for k in range(self.trainers):
+                    bv = gb.create_var(name=f"{sec}.bak.{k}",
+                                       shape=shape, dtype=pvar.dtype,
+                                       persistable=True)
+                    gb.append_op(
+                        type="fill_constant", outputs={"Out": bv},
+                        attrs={"shape": list(shape),
+                               "dtype": pvar.dtype, "value": 0.0},
+                        infer_shape=False)
             # accumulators for this section
             opt_op = next(op for op in self.opt_ops
                           if op.inputs["Param"][0] == pname)

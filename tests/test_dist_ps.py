@@ -43,6 +43,7 @@ _RUNNER = textwrap.dedent("""
 
     cfg = DistributeTranspilerConfig()
     cfg.min_block_size = 1      # force row-slicing even for tiny vars
+    cfg.enable_dc_asgd = os.environ.get("PADDLE_DC_ASGD", "0") == "1"
     hb = os.environ.get("PADDLE_HB_TIMEOUT")
     if hb:
         cfg.heartbeat_timeout = float(hb)
@@ -181,6 +182,55 @@ def test_dist_ps_async_converges():
     dist = _run_cluster(sync=False)
     for tl in dist:
         assert tl[-1] < tl[0] * 0.6, tl[::5]
+
+
+def test_dist_ps_async_dc_asgd_converges():
+    """Round-3 verdict do-this #9 (anchor
+    distribute_transpiler.py:1905 _append_dc_asgd_ops): async PS with
+    delay compensation — the pserver corrects each delayed grad with
+    g + g*g*(w_now - w_at_pull) against a per-trainer backup
+    snapshotted on pull.  Cluster must converge at least as well as
+    plain async."""
+    dist = _run_cluster(sync=False,
+                        extra_env={"PADDLE_DC_ASGD": "1"})
+    for tl in dist:
+        assert tl[-1] < tl[0] * 0.6, tl[::5]
+
+
+def test_dc_asgd_pserver_program_shape():
+    """Unit-level: DC-ASGD pserver blocks carry the correction ops and
+    per-trainer backups; the optimizer consumes the corrected grad."""
+    import paddle_tpu as fluid  # noqa: F401
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.transpiler import (DistributeTranspiler,
+                                       DistributeTranspilerConfig)
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    loss = layers.mean(layers.fc(x, size=2))
+    optimizer.SGD(0.1).minimize(loss)
+    cfg = DistributeTranspilerConfig()
+    cfg.min_block_size = 1
+    cfg.enable_dc_asgd = True
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, pservers="127.0.0.1:0", trainers=3, sync_mode=False)
+    prog = t.get_pserver_program("127.0.0.1:0")
+    sub_types = [op.type for b in prog.blocks[1:] for op in b.ops]
+    assert "ref_by_trainer_id" in sub_types
+    # optimizer consumes the corrected grad, not the wire grad
+    sgd_ops = [op for b in prog.blocks[1:] for op in b.ops
+               if op.type == "sgd"]
+    assert sgd_ops and all(op.inputs["Grad"][0].endswith(".dc")
+                           for op in sgd_ops)
+    # one backup per trainer per section
+    baks = [n for n in prog.global_block().vars if ".bak." in n]
+    n_secs = len([n for n in prog.global_block().vars
+                  if n.endswith(".block0") and "@GRAD" not in n])
+    assert len(baks) == 3 * n_secs, (baks, n_secs)
+    startup = t.get_startup_program("127.0.0.1:0", prog)
+    filled = [op.outputs["Out"][0]
+              for op in startup.global_block().ops
+              if op.type == "fill_constant"]
+    assert all(b in filled for b in baks)
 
 
 def test_dist_ps_sync_survives_trainer_death():
