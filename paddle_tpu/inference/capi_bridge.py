@@ -1,0 +1,91 @@
+"""Python half of the C-ABI predictor (reference
+inference/api/paddle_api.h:202 PaddlePredictor + :338
+CreatePaddlePredictor, and the C API the reference era shipped demos
+against in inference/api/demo_ci/).
+
+native/src/predictor.cc embeds (or joins) the CPython runtime and calls
+the module-level functions here with plain buffers — no numpy C API on
+the native side, just bytes + shape lists across the boundary.  The
+heavy lifting stays in inference.Predictor, so the C surface and the
+Python surface cannot diverge.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_predictors: dict = {}
+_next_handle = [1]
+
+
+def _apply_platform_override():
+    """Standalone C hosts have no conftest to force a platform; honor
+    PADDLE_TPU_PLATFORM / JAX_PLATFORMS via the config API, which wins
+    over a sitecustomize-injected default (e.g. a wedged axon tunnel)."""
+    plat = os.environ.get("PADDLE_TPU_PLATFORM") or \
+        os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat.split(",")[0])
+        except Exception:
+            pass  # already initialized with a real platform
+
+
+def load(model_dir, prog_file=None, params_file=None):
+    """Create a Predictor over a save_inference_model artifact; returns
+    an int handle for the C side."""
+    _apply_platform_override()
+    from paddle_tpu.inference import Config, create_predictor
+
+    cfg = Config(model_dir)
+    # non-default file names inside the dir (reference AnalysisConfig
+    # SetModel(prog_file, params_file)); _model_dir stays set so
+    # Predictor resolves both
+    if prog_file is not None:
+        cfg._prog_file = os.path.join(model_dir, prog_file)
+    if params_file is not None:
+        cfg._params_file = os.path.join(model_dir, params_file)
+    pred = create_predictor(cfg)
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _predictors[h] = pred
+    return h
+
+
+def input_names(handle):
+    return list(_predictors[handle].get_input_names())
+
+
+def output_names(handle):
+    return list(_predictors[handle].get_output_names())
+
+
+def run_raw(handle, feeds):
+    """feeds: list of (name, float32_bytes, shape_list).  Returns list
+    of (float32_bytes, shape_list) in get_output_names() order."""
+    pred = _predictors[handle]
+    by_name = {}
+    for name, buf, shape in feeds:
+        by_name[name] = np.frombuffer(
+            buf, dtype=np.float32).reshape([int(d) for d in shape])
+    # every declared input must be fed, by name — a silent positional
+    # rebind of a partial feed would produce wrong numbers, not errors
+    missing = [n for n in pred.get_input_names() if n not in by_name]
+    if missing:
+        raise KeyError(f"missing feeds for inputs {missing}")
+    inputs = [by_name[n] for n in pred.get_input_names()]
+    outs = pred.run(inputs)
+    result = []
+    for o in outs:
+        arr = np.ascontiguousarray(np.asarray(o), dtype=np.float32)
+        result.append((arr.tobytes(), [int(d) for d in arr.shape]))
+    return result
+
+
+def free(handle):
+    _predictors.pop(handle, None)
+    return 0

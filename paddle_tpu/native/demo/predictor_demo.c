@@ -1,0 +1,84 @@
+/* C serving demo for the pt_predictor C-ABI (reference
+ * inference/api/demo_ci/simple_on_word2vec.cc: load a
+ * save_inference_model artifact, feed a tensor, print the output).
+ *
+ * Build: `make demo` in paddle_tpu/native (links
+ * libpaddle_tpu_native.so).  Run:
+ *   PYTHONPATH=<repo> PADDLE_TPU_PLATFORM=cpu \
+ *     ./predictor_demo <model_dir> <input_name> d0 d1 ...
+ * Feeds an arange/100 tensor of that shape, prints "OUT shape: ..."
+ * and the first few values — the test compares them against the
+ * Python Predictor. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* pt_predictor_load(const char* model_dir);
+extern int pt_predictor_run(void* h, const char** names,
+                            const float** data, const int64_t** shapes,
+                            const int* ndims, int n_in);
+extern int pt_predictor_get_output(void* h, int idx, float** out_data,
+                                   int64_t** out_shape, int* out_ndim);
+extern void pt_predictor_free(void* h);
+extern void pt_free(void* p);
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <model_dir> <input_name> d0 [d1 ...]\n",
+            argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  const char* input_name = argv[2];
+  int ndim = argc - 3;
+  if (ndim > 8) {
+    fprintf(stderr, "at most 8 dims supported\n");
+    return 2;
+  }
+  int64_t shape[8];
+  int64_t numel = 1;
+  for (int i = 0; i < ndim; ++i) {
+    shape[i] = atoll(argv[3 + i]);
+    numel *= shape[i];
+  }
+  float* data = (float*)malloc(numel * sizeof(float));
+  for (int64_t i = 0; i < numel; ++i) data[i] = (float)i / 100.0f;
+
+  void* pred = pt_predictor_load(model_dir);
+  if (!pred) {
+    fprintf(stderr, "pt_predictor_load failed\n");
+    return 1;
+  }
+  const char* names[1] = {input_name};
+  const float* bufs[1] = {data};
+  const int64_t* shapes[1] = {shape};
+  int ndims[1] = {ndim};
+  int n_out = pt_predictor_run(pred, names, bufs, shapes, ndims, 1);
+  if (n_out < 1) {
+    fprintf(stderr, "pt_predictor_run failed\n");
+    return 1;
+  }
+  float* out;
+  int64_t* oshape;
+  int ondim;
+  if (pt_predictor_get_output(pred, 0, &out, &oshape, &ondim) != 0) {
+    fprintf(stderr, "pt_predictor_get_output failed\n");
+    return 1;
+  }
+  int64_t onumel = 1;
+  printf("OUT shape:");
+  for (int d = 0; d < ondim; ++d) {
+    printf(" %lld", (long long)oshape[d]);
+    onumel *= oshape[d];
+  }
+  printf("\nOUT data:");
+  for (int64_t i = 0; i < onumel && i < 8; ++i) {
+    printf(" %.6f", out[i]);
+  }
+  printf("\n");
+  pt_free(out);
+  pt_free(oshape);
+  free(data);
+  pt_predictor_free(pred);
+  return 0;
+}

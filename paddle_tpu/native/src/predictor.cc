@@ -1,0 +1,191 @@
+// C-ABI predictor: a linkable serving surface (reference
+// inference/api/paddle_api.h:202 PaddlePredictor + :338
+// CreatePaddlePredictor; demos under inference/api/demo_ci/).
+//
+// The predictor hosts the Python runtime (SURVEY.md §7 design stance:
+// native where the reference is native; the compute itself is the
+// normal XLA path).  A C/C++ serving app links libpaddle_tpu_native.so
+// and calls:
+//
+//   void* h = pt_predictor_load("/path/to/save_inference_model_dir");
+//   int n_out = pt_predictor_run(h, names, bufs, shapes, ndims, n_in);
+//   pt_predictor_get_output(h, 0, &data, &shape, &ndim);  // pt_free both
+//   pt_predictor_free(h);
+//
+// Inside an already-running Python process (ctypes) the embedded
+// runtime is joined, not re-initialized.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include "common.h"
+
+namespace {
+
+struct PtPredictor {
+  PyObject* handle;    // int handle inside capi_bridge
+  PyObject* outputs;   // list of (bytes, shape) from the last run
+};
+
+PyObject* bridge_module() {
+  PyObject* m = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+  if (m == nullptr) PyErr_Print();
+  return m;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_predictor_load(const char* model_dir) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // drop the GIL acquired by initialization so PyGILState below
+    // owns it cleanly from any thread
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  void* out = nullptr;
+  PyObject* m = bridge_module();
+  if (m != nullptr) {
+    PyObject* h = PyObject_CallMethod(m, "load", "s", model_dir);
+    if (h != nullptr) {
+      out = new PtPredictor{h, nullptr};
+    } else {
+      PyErr_Print();
+    }
+    Py_DECREF(m);
+  }
+  PyGILState_Release(g);
+  return out;
+}
+
+// Feeds n_in float32 tensors; returns the number of outputs (>=0) or
+// -1 on failure.  Outputs are cached on the handle until the next run.
+int pt_predictor_run(void* hv, const char** names, const float** data,
+                     const int64_t** shapes, const int* ndims,
+                     int n_in) {
+  if (hv == nullptr) return -1;
+  auto* h = static_cast<PtPredictor*>(hv);
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* feeds = PyList_New(n_in);
+  bool ok = feeds != nullptr;
+  for (int i = 0; ok && i < n_in; ++i) {
+    int64_t numel = 1;
+    PyObject* shape = PyList_New(ndims[i]);
+    if (shape == nullptr) {
+      ok = false;
+      break;
+    }
+    for (int d = 0; ok && d < ndims[i]; ++d) {
+      numel *= shapes[i][d];
+      PyObject* dim = PyLong_FromLongLong(shapes[i][d]);
+      if (dim == nullptr) {
+        ok = false;
+        break;
+      }
+      PyList_SET_ITEM(shape, d, dim);
+    }
+    if (!ok) {
+      Py_DECREF(shape);
+      break;
+    }
+    PyObject* buf = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(data[i]),
+        static_cast<Py_ssize_t>(numel * sizeof(float)));
+    if (buf == nullptr) {
+      Py_DECREF(shape);
+      ok = false;
+      break;
+    }
+    PyObject* tup = Py_BuildValue("(sNN)", names[i], buf, shape);
+    if (tup == nullptr) {
+      ok = false;
+      break;
+    }
+    PyList_SET_ITEM(feeds, i, tup);
+  }
+  if (!ok && PyErr_Occurred()) {
+    // never release the GIL with a pending exception: a ctypes-joined
+    // host interpreter would trip over it at an unrelated point
+    PyErr_Print();
+  }
+  if (ok) {
+    PyObject* m = bridge_module();
+    if (m != nullptr) {
+      PyObject* res = PyObject_CallMethod(m, "run_raw", "ON",
+                                          h->handle, feeds);
+      feeds = nullptr;  // stolen by N
+      if (res != nullptr) {
+        Py_XDECREF(h->outputs);
+        h->outputs = res;
+        rc = static_cast<int>(PyList_Size(res));
+      } else {
+        PyErr_Print();
+      }
+      Py_DECREF(m);
+    }
+  }
+  Py_XDECREF(feeds);
+  PyGILState_Release(g);
+  return rc;
+}
+
+// Copies output `idx` of the last run into malloc'd buffers the caller
+// releases with pt_free.  Returns 0 on success.
+int pt_predictor_get_output(void* hv, int idx, float** out_data,
+                            int64_t** out_shape, int* out_ndim) {
+  if (hv == nullptr) return -1;
+  auto* h = static_cast<PtPredictor*>(hv);
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = -1;
+  if (h->outputs != nullptr && idx >= 0 &&
+      idx < PyList_Size(h->outputs)) {
+    PyObject* tup = PyList_GetItem(h->outputs, idx);  // borrowed
+    PyObject* buf = PyTuple_GetItem(tup, 0);
+    PyObject* shape = PyTuple_GetItem(tup, 1);
+    if (buf != nullptr && shape != nullptr) {
+      Py_ssize_t nbytes = PyBytes_Size(buf);
+      int nd = static_cast<int>(PyList_Size(shape));
+      auto* dptr = static_cast<float*>(std::malloc(nbytes));
+      auto* sptr = static_cast<int64_t*>(
+          std::malloc(sizeof(int64_t) * (nd > 0 ? nd : 1)));
+      if (dptr != nullptr && sptr != nullptr) {
+        std::memcpy(dptr, PyBytes_AsString(buf), nbytes);
+        for (int d = 0; d < nd; ++d) {
+          sptr[d] = PyLong_AsLongLong(PyList_GetItem(shape, d));
+        }
+        *out_data = dptr;
+        *out_shape = sptr;
+        *out_ndim = nd;
+        rc = 0;
+      } else {
+        std::free(dptr);
+        std::free(sptr);
+      }
+    }
+  }
+  PyGILState_Release(g);
+  return rc;
+}
+
+void pt_predictor_free(void* hv) {
+  if (hv == nullptr) return;
+  auto* h = static_cast<PtPredictor*>(hv);
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* m = bridge_module();
+  if (m != nullptr) {
+    PyObject* r = PyObject_CallMethod(m, "free", "O", h->handle);
+    Py_XDECREF(r);
+    Py_DECREF(m);
+  }
+  Py_XDECREF(h->handle);
+  Py_XDECREF(h->outputs);
+  PyGILState_Release(g);
+  delete h;
+}
+
+}  // extern "C"

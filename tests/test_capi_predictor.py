@@ -1,0 +1,140 @@
+"""C-ABI predictor (round-3 verdict do-this #8; reference
+inference/api/paddle_api.h:202 PaddlePredictor + demo_ci): a C program
+links libpaddle_tpu_native.so, loads a save_inference_model artifact
+through pt_predictor_load/run/get_output, and must produce the same
+numbers as the Python Predictor."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(ROOT, "paddle_tpu", "native")
+
+toolchain = shutil.which("make") and shutil.which("g++") \
+    and shutil.which("gcc")
+
+
+@pytest.mark.skipif(not toolchain, reason="no C toolchain")
+def test_c_demo_matches_python_predictor(tmp_path):
+    # build the library + demo
+    r = subprocess.run(["make", "-s", "demo"], cwd=NATIVE,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    demo = os.path.join(NATIVE, "demo", "predictor_demo")
+    assert os.path.exists(demo)
+
+    # save a model + compute the expected output IN A SUBPROCESS so
+    # this test's jax/program state stays untouched
+    saver = r"""
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, json, sys
+import paddle_tpu as fluid
+from paddle_tpu import layers, framework
+np.random.seed(0)
+x = layers.data("x", shape=[6], dtype="float32")
+h = layers.fc(x, 8, act="relu")
+out = layers.fc(h, 3)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(framework.default_startup_program())
+d = sys.argv[1]
+fluid.io.save_inference_model(d, ["x"], [out], exe)
+from paddle_tpu.inference import Config, create_predictor
+pred = create_predictor(Config(d))
+feed = (np.arange(12, dtype=np.float32)/100.0).reshape(2, 6)
+expect, = pred.run([feed])
+print("EXPECT " + json.dumps(
+    [float(v) for v in np.asarray(expect).ravel()]))
+"""
+    model_dir = str(tmp_path / "model")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, "-c", saver, model_dir],
+                       capture_output=True, text=True, timeout=300,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-3000:]
+    line = [ln for ln in r.stdout.splitlines()
+            if ln.startswith("EXPECT ")]
+    expect = np.asarray(json.loads(line[0][len("EXPECT "):]))
+
+    # the standalone C program hosts its own Python runtime
+    r = subprocess.run(
+        [demo, model_dir, "x", "2", "6"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PYTHONPATH": ROOT,
+             "PADDLE_TPU_PLATFORM": "cpu"})
+    assert r.returncode == 0, (r.stdout, r.stderr[-3000:])
+    lines = dict(ln.split(":", 1) for ln in r.stdout.splitlines()
+                 if ":" in ln)
+    shape = [int(v) for v in lines["OUT shape"].split()]
+    got = np.asarray([float(v) for v in lines["OUT data"].split()])
+    assert shape == [2, 3]
+    np.testing.assert_allclose(got, expect[:len(got)], rtol=1e-5,
+                               atol=1e-6)
+
+
+@pytest.mark.skipif(not toolchain, reason="no C toolchain")
+def test_capi_from_ctypes_joins_running_interpreter(tmp_path):
+    """The same C ABI must also work when the host process IS Python
+    (ctypes): the embedded-runtime path joins instead of
+    re-initializing."""
+    import ctypes
+
+    r = subprocess.run(["make", "-s"], cwd=NATIVE, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, layers
+
+    np.random.seed(0)
+    x = layers.data("x", shape=[4], dtype="float32")
+    out = layers.fc(x, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    model_dir = str(tmp_path / "m")
+    fluid.io.save_inference_model(model_dir, ["x"], [out], exe)
+
+    lib = ctypes.CDLL(os.path.join(NATIVE, "libpaddle_tpu_native.so"))
+    lib.pt_predictor_load.restype = ctypes.c_void_p
+    lib.pt_predictor_load.argtypes = [ctypes.c_char_p]
+    lib.pt_predictor_run.restype = ctypes.c_int
+    lib.pt_predictor_get_output.restype = ctypes.c_int
+    lib.pt_predictor_free.argtypes = [ctypes.c_void_p]
+    lib.pt_free.argtypes = [ctypes.c_void_p]
+
+    h = lib.pt_predictor_load(model_dir.encode())
+    assert h
+    feed = np.arange(8, dtype=np.float32).reshape(2, 4) / 10.0
+    names = (ctypes.c_char_p * 1)(b"x")
+    bufs = (ctypes.POINTER(ctypes.c_float) * 1)(
+        feed.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    shp = (ctypes.c_int64 * 2)(2, 4)
+    shapes = (ctypes.POINTER(ctypes.c_int64) * 1)(shp)
+    ndims = (ctypes.c_int * 1)(2)
+    n_out = lib.pt_predictor_run(ctypes.c_void_p(h), names, bufs,
+                                 shapes, ndims, 1)
+    assert n_out == 1
+    data = ctypes.POINTER(ctypes.c_float)()
+    oshape = ctypes.POINTER(ctypes.c_int64)()
+    ondim = ctypes.c_int()
+    rc = lib.pt_predictor_get_output(
+        ctypes.c_void_p(h), 0, ctypes.byref(data), ctypes.byref(oshape),
+        ctypes.byref(ondim))
+    assert rc == 0 and ondim.value == 2
+    dims = [oshape[i] for i in range(ondim.value)]
+    assert dims == [2, 2]
+    got = np.ctypeslib.as_array(data, shape=(4,)).copy()
+    # reference: run the same feed through the Python path
+    from paddle_tpu.inference import Config, create_predictor
+
+    expect, = create_predictor(Config(model_dir)).run([feed])
+    np.testing.assert_allclose(got, np.asarray(expect).ravel(),
+                               rtol=1e-5, atol=1e-6)
+    lib.pt_free(data)
+    lib.pt_free(oshape)
+    lib.pt_predictor_free(ctypes.c_void_p(h))
