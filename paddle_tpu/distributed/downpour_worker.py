@@ -87,9 +87,15 @@ class DownpourRunner:
         self._batch = 0
         self._lock = threading.Lock()
         # dedicated clients: pushes must never block pulls on a
-        # connection lock (reference: separate push status queues)
+        # connection lock (reference: separate push status queues);
+        # the table verbs themselves live in FleetWrapper (reference
+        # fleet_wrapper.h — DownpourWorker composes, never speaks RPC)
+        from paddle_tpu.fleet.fleet_wrapper import FleetWrapper
+
         self._pull_client = RPCClient()
         self._push_client = RPCClient()
+        self._fleet_pull = FleetWrapper(t, client=self._pull_client)
+        self._fleet_push = FleetWrapper(t, client=self._push_client)
         # liveness: announce this worker so pserver barriers/completions
         # account for it (see listen_and_serv effective_fanin); the
         # beat interval pairs with the transpiler's heartbeat_timeout
@@ -106,16 +112,13 @@ class DownpourRunner:
         PullDenseVarsAsync / pull_dense_worker.cc)."""
         import jax.numpy as jnp
 
-        for pname, plan in self.t.param_plan.items():
-            parts = [self._pull_client.get_var(
-                self.eps[ep_i], sec) for ep_i, sec, _s, _e in plan]
-            val = parts[0] if len(parts) == 1 else np.concatenate(
-                parts, axis=0)
+        for pname, val in self._fleet_pull.pull_dense_vars_sync() \
+                .items():
             self.scope.var(pname).set(jnp.asarray(val))
 
     def _push_dense(self):
         """Async dense-grad push (reference PushDenseVarsAsync)."""
-        for pname, plan in self.t.param_plan.items():
+        for pname in self.t.param_plan:
             gname = self.t.grad_of.get(pname)
             if gname is None:
                 continue
@@ -123,12 +126,8 @@ class DownpourRunner:
             if gvar is None or gvar.get() is None:
                 continue
             g = np.asarray(gvar.get())
-            for ep_i, sec, s, e in plan:
-                gsec = self.t._grad_section_name(pname, sec)
-                part = g if (s == 0 and e == -1) else g[s:e]
-                self._submit(lambda ep=self.eps[ep_i], n=gsec,
-                             v=np.ascontiguousarray(part):
-                             self._push_client.send_var(ep, n, v))
+            self._submit(lambda p=pname, v=g:
+                         self._fleet_push.push_dense_grad_sync(p, v))
 
     # ---------------------------------------------------------- sparse
     def _pull_sparse(self, feed):
@@ -140,17 +139,12 @@ class DownpourRunner:
             if not chunks:
                 continue
             ids = np.unique(np.concatenate(chunks).astype(np.int64))
+            if ids.size == 0:
+                continue
+            ids, vals = self._fleet_pull.pull_sparse_rows_sync(
+                wname, ids)
             buf = self._table_buf[wname]
-            n_rows = buf.shape[0]
-            for ep_i, sec, s, e in self.t.dist_tables[wname]:
-                hi = n_rows if e == -1 else e
-                sel = ids[(ids >= s) & (ids < hi)]
-                if sel.size == 0:
-                    continue
-                rows = self._pull_client.call(
-                    self.eps[ep_i], "prefetch_rows",
-                    (sec, (sel - s).astype(np.int64)))
-                buf[sel] = rows
+            buf[ids] = vals
             self.scope.var(wname).set(buf)
 
     def _push_sparse(self, feed):
@@ -171,18 +165,9 @@ class DownpourRunner:
                 rows = np.unique(
                     np.concatenate(chunks).astype(np.int64))
                 vals = np.asarray(g)[rows]
-            n_rows = int(self.scope.find_var(wname).get().shape[0])
-            for ep_i, sec, s, e in self.t.dist_tables[wname]:
-                hi = n_rows if e == -1 else e
-                m = (rows >= s) & (rows < hi)
-                if not m.any():
-                    continue
-                gsec = self.t._grad_section_name(wname, sec)
-                self._submit(lambda ep=self.eps[ep_i], n=gsec,
-                             r=np.ascontiguousarray(rows[m] - s),
-                             v=np.ascontiguousarray(vals[m]):
-                             self._push_client.call(
-                                 ep, "send_sparse", (n, r, v)))
+            self._submit(lambda w=wname, r=rows, v=vals:
+                         self._fleet_push.push_sparse_grad_sync(
+                             w, r, v))
 
     # ------------------------------------------------------- lifecycle
     def _submit(self, fn):

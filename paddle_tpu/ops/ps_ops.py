@@ -421,6 +421,25 @@ def listen_and_serv_op(op, block, scope, ctx):
                 "init_wait: trainer 0 never pushed initial params "
                 "(is it up? did ps_sync_init run?)")
 
+    def on_profile(payload):
+        """Remote profiling trigger (reference
+        send_recv.proto.in:81 VariableMessage.profile: a trainer flips
+        profiling on across the cluster; the server dumps a profile
+        when it flips back off).  payload: "start" | ("stop", path)."""
+        from paddle_tpu import profiler as _prof
+
+        if payload == "start" or payload == 1:
+            _prof.start_profiler()
+            return "profiling"
+        cmd, path = payload if isinstance(payload, tuple) else \
+            (payload, None)
+        if cmd in ("stop", 2):
+            path = path or ("/tmp/profile_ps_%s" %
+                            attrs["endpoint"].replace(":", "_"))
+            _prof.stop_profiler(sorted_key="total", profile_path=path)
+            return path
+        raise ValueError(f"unknown profile command {payload!r}")
+
     def on_checkpoint(dirname):
         import os
         os.makedirs(dirname, exist_ok=True)
@@ -471,6 +490,7 @@ def listen_and_serv_op(op, block, scope, ctx):
     server.register_handler("init_done", on_init_done)
     server.register_handler("init_wait", on_init_wait)
     server.register_handler("checkpoint_notify", on_checkpoint)
+    server.register_handler("profile", on_profile)
     server.start()
     try:
         while not stop.wait(timeout=0.25):

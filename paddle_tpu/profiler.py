@@ -98,3 +98,31 @@ def device_trace(logdir="/tmp/paddle_tpu_trace"):
 
 def reset_profiler():
     _events.clear()
+
+
+def start_remote_profiler(endpoints):
+    """Switch profiling ON across the cluster's pservers (reference
+    send_recv.proto.in:81 VariableMessage.profile — the trainer-driven
+    remote profiling trigger)."""
+    from paddle_tpu.distributed.rpc import global_rpc_client
+
+    client = global_rpc_client()
+    return [client.call(ep, "profile", "start") for ep in endpoints]
+
+
+def stop_remote_profiler(endpoints, profile_path=None):
+    """Switch remote profiling OFF; each pserver dumps its chrome trace
+    (default /tmp/profile_ps_<endpoint>, matching the reference's
+    /tmp/profile_ps_* convention) and returns the path.  An explicit
+    profile_path gets a per-endpoint suffix when there are several
+    endpoints — co-hosted pservers must not clobber one trace file."""
+    from paddle_tpu.distributed.rpc import global_rpc_client
+
+    client = global_rpc_client()
+    out = []
+    for ep in endpoints:
+        path = profile_path
+        if path is not None and len(endpoints) > 1:
+            path = "%s.%s" % (path, ep.replace(":", "_"))
+        out.append(client.call(ep, "profile", ("stop", path)))
+    return out
