@@ -205,8 +205,23 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=512,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if impl is None:
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        impl = "pallas" if _on_tpu() else "xla"
     return _flash(q, k, v, causal, float(scale), block_q, block_k, impl)
+
+
+def _on_tpu():
+    """True when the default device is a TPU chip.  Checked via the
+    DEVICE, not jax.default_backend(): tunnel backends (e.g. the axon
+    plugin) report their own platform name while the chip's
+    device_kind still says 'TPU ...' — keying on the backend name
+    would silently fall back to plain XLA attention on real hardware
+    (round-3 verdict do-this #2)."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    return dev.platform == "tpu" or \
+        "tpu" in str(getattr(dev, "device_kind", "")).lower()
 
 
 # ---------------------------------------------------------------------------

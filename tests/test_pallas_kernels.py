@@ -77,6 +77,27 @@ def test_flash_attention_ir_op():
     np.testing.assert_allclose(o2, np.asarray(ref), atol=1e-3)
 
 
+def test_impl_autodetect_keys_on_device_not_backend(monkeypatch):
+    """Round-3 verdict do-this #2: a tunnel backend (axon) reports its
+    own platform name while the chip's device_kind says 'TPU v5 lite';
+    auto-detection must still pick the Pallas kernel there."""
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    class _FakeDev:
+        platform = "axon"
+        device_kind = "TPU v5 lite"
+
+    monkeypatch.setattr(pk.jax, "devices", lambda: [_FakeDev()])
+    assert pk._on_tpu() is True
+
+    class _CpuDev:
+        platform = "cpu"
+        device_kind = "cpu"
+
+    monkeypatch.setattr(pk.jax, "devices", lambda: [_CpuDev()])
+    assert pk._on_tpu() is False
+
+
 def test_transformer_fused_vs_unfused():
     """Fused-attention transformer == unfused composition (is_test mode)."""
     import paddle_tpu as fluid

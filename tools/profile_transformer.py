@@ -1,0 +1,121 @@
+"""HLO-level diagnosis of the Transformer-base training step (round-3
+verdict do-this #2: drive transformer MFU toward >=50% — confirm the
+flash-attention lowering, confirm donation leaves no parameter copies,
+and expose where the update phase lands).
+
+Builds the framework's compiled train step, lowers it, and prints:
+  * XLA cost analysis (flops, bytes) + roofline times for the chip
+  * whether the attention lowered through the Pallas kernel
+    (custom_call count on TPU; 'xla' fallback elsewhere)
+  * donation/aliasing summary: every persistable state buffer must be
+    donated (input-output aliased), or the step copies weights
+  * HLO op histogram entries that betray waste (copy/transpose counts)
+
+Usage: python tools/profile_transformer.py [--batch 32] [--seq 512]
+       [--time]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import Counter
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from bench import (_build_compiled_fn, _chain_timed, _chip_peak_flops,
+                   _fresh_programs, _transformer_train_flops_per_token)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--time", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, optimizer
+    from paddle_tpu.models.transformer import transformer_encoder_model
+
+    _fresh_programs()
+    vocab, d_model, n_layer, d_inner, n_head = 32000, 512, 6, 2048, 8
+    model = transformer_encoder_model(
+        vocab_size=vocab, max_len=args.seq, d_model=d_model,
+        n_head=n_head, d_inner=d_inner, n_layer=n_layer,
+        dropout_rate=0.0)
+    optimizer.Adam(learning_rate=1e-4).minimize(model["loss"])
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab,
+                      (args.batch, args.seq, 1)).astype(np.int64)
+    feed = {"src_ids": jax.device_put(jnp.asarray(ids)),
+            "tgt_label": jax.device_put(jnp.asarray(ids))}
+    fn, state = _build_compiled_fn(compiled, feed,
+                                   [model["loss"].name])
+    lowered = fn.lower(state, feed)
+    comp = lowered.compile()
+    text = comp.as_text()
+
+    # --- cost + roofline
+    cost = comp.cost_analysis()
+    cost = cost[0] if isinstance(cost, list) else cost
+    flops = cost.get("flops", 0.0)
+    peak, kind = _chip_peak_flops()
+    fpt = _transformer_train_flops_per_token(
+        (vocab * d_model + args.seq * d_model
+         + n_layer * (4 * d_model * d_model + 2 * d_model * d_inner)
+         + d_model * vocab), d_model, n_layer, args.seq)
+    print(f"device: {kind}")
+    print(f"XLA cost analysis flops:  {flops / 1e9:10.2f} GFLOP")
+    print(f"analytic train flops:     "
+          f"{fpt * args.batch * args.seq / 1e9:10.2f} GFLOP "
+          "(6N + attn closed form)")
+
+    # --- flash attention lowering
+    n_custom = text.count("custom_call_target")
+    backend = jax.devices()[0].platform
+    print(f"backend: {backend}; custom_call sites: {n_custom} "
+          "(pallas kernels appear as custom calls on TPU; 0 on the "
+          "CPU fallback where impl='xla' is expected)")
+
+    # --- donation: every persistable state input should alias an output
+    n_alias = text.count("may-alias") + text.count("must-alias")
+    n_state = len(state)
+    verdict = "OK" if n_alias >= n_state else \
+        "MISSING ALIASES — the step copies some weights!"
+    print(f"state buffers: {n_state}; aliased in/out pairs: "
+          f"{n_alias} ({verdict})")
+
+    # --- waste indicators (HLO lines look like
+    #     %name = f32[...]{...} op-name(args), sharding=...)
+    import re
+
+    ops = Counter()
+    for m in re.finditer(r"= [a-z0-9_\[\]{},:\. ]*?([a-z][a-z\-]*)\(",
+                         text):
+        ops[m.group(1)] += 1
+    for k in ("copy", "transpose", "dot", "convolution", "fusion",
+              "custom-call", "all-reduce", "scatter", "gather",
+              "dynamic-update-slice"):
+        if ops.get(k):
+            print(f"  hlo {k:20s} x{ops[k]}")
+
+    if args.time:
+        sec, _ = _chain_timed(fn, state, feed, model["loss"].name, 10)
+        toks = args.batch * args.seq / sec
+        mfu = fpt * toks / peak
+        print(f"measured: {sec * 1e3:.1f} ms/step, "
+              f"{toks:,.0f} tok/s, MFU {100 * mfu:.2f}%")
+
+
+if __name__ == "__main__":
+    main()
