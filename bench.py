@@ -162,8 +162,26 @@ def bench_resnet50_train(batch=128, chain=30):
     }
 
 
-def bench_transformer_train(batch=32, seq=512, chain=30):
-    """Transformer-base LM (d=512, 6L, 8H, ffn 2048), seq 512."""
+# Transformer-base config shared with tools/profile_transformer.py so
+# the profiler's MFU numbers can never diverge from the bench's
+TRANSFORMER_BASE = dict(vocab=32000, d_model=512, n_layer=6,
+                        d_inner=2048, n_head=8)
+
+
+def _transformer_n_params(seq, vocab, d_model, n_layer, d_inner,
+                          n_head):
+    """embeddings + 12*d^2 per layer (attn 4d^2 + ffn 8d^2) + untied
+    output projection."""
+    return (vocab * d_model + seq * d_model
+            + n_layer * (4 * d_model * d_model
+                         + 2 * d_model * d_inner)
+            + d_model * vocab)
+
+
+def _build_transformer_train(batch, seq):
+    """Build + init the bench transformer train step; returns
+    (fn, state, feed, loss_name) — the exact path bench and profiler
+    share."""
     import jax
     import jax.numpy as jnp
 
@@ -172,32 +190,33 @@ def bench_transformer_train(batch=32, seq=512, chain=30):
     from paddle_tpu.models.transformer import transformer_encoder_model
 
     _fresh_programs()
-    vocab, d_model, n_layer, d_inner, n_head = 32000, 512, 6, 2048, 8
+    c = TRANSFORMER_BASE
     model = transformer_encoder_model(
-        vocab_size=vocab, max_len=seq, d_model=d_model, n_head=n_head,
-        d_inner=d_inner, n_layer=n_layer, dropout_rate=0.0)
-    opt = optimizer.Adam(learning_rate=1e-4)
-    opt.minimize(model["loss"])
+        vocab_size=c["vocab"], max_len=seq, d_model=c["d_model"],
+        n_head=c["n_head"], d_inner=c["d_inner"],
+        n_layer=c["n_layer"], dropout_rate=0.0)
+    optimizer.Adam(learning_rate=1e-4).minimize(model["loss"])
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(framework.default_startup_program())
     compiled = fluid.CompiledProgram(framework.default_main_program())
-
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, vocab, (batch, seq, 1)).astype(np.int64)
+    ids = rng.randint(0, c["vocab"], (batch, seq, 1)).astype(np.int64)
     feed = {"src_ids": jax.device_put(jnp.asarray(ids)),
             "tgt_label": jax.device_put(jnp.asarray(ids))}
     fn, state = _build_compiled_fn(compiled, feed, [model["loss"].name])
-    sec_per_step, _ = _chain_timed(fn, state, feed, model["loss"].name,
-                                   chain)
+    return fn, state, feed, model["loss"].name
+
+
+def bench_transformer_train(batch=32, seq=512, chain=30):
+    """Transformer-base LM (d=512, 6L, 8H, ffn 2048), seq 512."""
+    fn, state, feed, loss_name = _build_transformer_train(batch, seq)
+    sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
     toks_per_sec = batch * seq / sec_per_step
-    # param count: embeddings + 12*d^2 per layer (attn 4d^2 + ffn 8d^2)
-    n_params = (vocab * d_model + seq * d_model
-                + n_layer * (4 * d_model * d_model
-                             + 2 * d_model * d_inner)
-                + d_model * vocab)
+    c = TRANSFORMER_BASE
+    n_params = _transformer_n_params(seq, **c)
     peak, kind = _chip_peak_flops()
     fpt = _transformer_train_flops_per_token(
-        n_params, d_model, n_layer, seq)
+        n_params, c["d_model"], c["n_layer"], seq)
     mfu = fpt * toks_per_sec / peak
     return {
         "tokens_per_sec": round(toks_per_sec, 0),

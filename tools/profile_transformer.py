@@ -25,8 +25,10 @@ import numpy as np
 
 sys.path.insert(0, ".")
 
-from bench import (_build_compiled_fn, _chain_timed, _chip_peak_flops,
-                   _fresh_programs, _transformer_train_flops_per_token)
+from bench import (TRANSFORMER_BASE, _build_transformer_train,
+                   _chain_timed, _chip_peak_flops,
+                   _transformer_n_params,
+                   _transformer_train_flops_per_token)
 
 
 def main():
@@ -37,30 +39,10 @@ def main():
     args = ap.parse_args()
 
     import jax
-    import jax.numpy as jnp
 
-    import paddle_tpu as fluid
-    from paddle_tpu import framework, optimizer
-    from paddle_tpu.models.transformer import transformer_encoder_model
-
-    _fresh_programs()
-    vocab, d_model, n_layer, d_inner, n_head = 32000, 512, 6, 2048, 8
-    model = transformer_encoder_model(
-        vocab_size=vocab, max_len=args.seq, d_model=d_model,
-        n_head=n_head, d_inner=d_inner, n_layer=n_layer,
-        dropout_rate=0.0)
-    optimizer.Adam(learning_rate=1e-4).minimize(model["loss"])
-    exe = fluid.Executor(fluid.TPUPlace())
-    exe.run(framework.default_startup_program())
-    compiled = fluid.CompiledProgram(framework.default_main_program())
-
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, vocab,
-                      (args.batch, args.seq, 1)).astype(np.int64)
-    feed = {"src_ids": jax.device_put(jnp.asarray(ids)),
-            "tgt_label": jax.device_put(jnp.asarray(ids))}
-    fn, state = _build_compiled_fn(compiled, feed,
-                                   [model["loss"].name])
+    # identical build path to bench_transformer_train — shared builder
+    fn, state, feed, loss_name = _build_transformer_train(args.batch,
+                                                          args.seq)
     lowered = fn.lower(state, feed)
     comp = lowered.compile()
     text = comp.as_text()
@@ -70,22 +52,25 @@ def main():
     cost = cost[0] if isinstance(cost, list) else cost
     flops = cost.get("flops", 0.0)
     peak, kind = _chip_peak_flops()
+    c = TRANSFORMER_BASE
     fpt = _transformer_train_flops_per_token(
-        (vocab * d_model + args.seq * d_model
-         + n_layer * (4 * d_model * d_model + 2 * d_model * d_inner)
-         + d_model * vocab), d_model, n_layer, args.seq)
+        _transformer_n_params(args.seq, **c), c["d_model"],
+        c["n_layer"], args.seq)
     print(f"device: {kind}")
     print(f"XLA cost analysis flops:  {flops / 1e9:10.2f} GFLOP")
     print(f"analytic train flops:     "
           f"{fpt * args.batch * args.seq / 1e9:10.2f} GFLOP "
           "(6N + attn closed form)")
 
-    # --- flash attention lowering
-    n_custom = text.count("custom_call_target")
+    # --- flash attention lowering: count the PALLAS-specific target,
+    # not just any custom call — other custom calls (sharding
+    # annotations etc.) must not produce a false pass
+    n_pallas = text.count("tpu_custom_call") + text.count(
+        '"__gpu$xla.gpu.triton"')
     backend = jax.devices()[0].platform
-    print(f"backend: {backend}; custom_call sites: {n_custom} "
-          "(pallas kernels appear as custom calls on TPU; 0 on the "
-          "CPU fallback where impl='xla' is expected)")
+    print(f"backend: {backend}; pallas custom_call sites: {n_pallas} "
+          f"(expect >= {c['n_layer']} on TPU — one per layer's fwd "
+          "attention; 0 on the CPU fallback where impl='xla')")
 
     # --- donation: every persistable state input should alias an output
     n_alias = text.count("may-alias") + text.count("must-alias")
@@ -95,19 +80,17 @@ def main():
     print(f"state buffers: {n_state}; aliased in/out pairs: "
           f"{n_alias} ({verdict})")
 
-    # --- waste indicators (HLO lines look like
-    #     %name = f32[...]{...} op-name(args), sharding=...)
-    import re
-
+    # --- waste indicators: plain substring counts like
+    # profile_resnet.py — robust to tuple-typed results
     ops = Counter()
-    for m in re.finditer(r"= [a-z0-9_\[\]{},:\. ]*?([a-z][a-z\-]*)\(",
-                         text):
-        ops[m.group(1)] += 1
-    for k in ("copy", "transpose", "dot", "convolution", "fusion",
-              "custom-call", "all-reduce", "scatter", "gather",
-              "dynamic-update-slice"):
-        if ops.get(k):
-            print(f"  hlo {k:20s} x{ops[k]}")
+    for k in ("copy(", "transpose(", "dot(", "convolution(",
+              "fusion(", "fusion.", "custom-call(", "all-reduce(",
+              "scatter(", "gather(", "dynamic-update-slice("):
+        n = text.count(" " + k)
+        if n:
+            ops[k.rstrip("(.")] += n
+    for k, n in sorted(ops.items(), key=lambda kv: -kv[1]):
+        print(f"  hlo {k:20s} x{n}")
 
     if args.time:
         sec, _ = _chain_timed(fn, state, feed, model["loss"].name, 10)
