@@ -15,10 +15,11 @@ layout).  Grid is (B*H, Tq/block_q, Tk/block_k) with the KV dimension
 innermost so the (acc, m, l) scratch carries across KV steps.
 
 The public `flash_attention` is differentiable via custom_vjp: forward
-runs the Pallas kernel on TPU (plain XLA path elsewhere); backward
-recomputes the scores with the reference einsum formulation and lets XLA
-fuse it (O(T^2) memory in backward only — a dedicated backward kernel is
-a later optimization).
+runs the Pallas kernel on TPU (plain XLA path elsewhere) and saves
+(q, k, v, o, lse); backward runs dedicated Pallas kernels (two-pass
+FlashAttention bwd: a dq sweep and a dk/dv sweep that recompute P
+blockwise from lse) — the [Tq, Tk] matrices stay in VMEM in both
+directions.  The XLA impl keeps the plain einsum replay.
 """
 
 from __future__ import annotations
@@ -63,8 +64,9 @@ def _plain_attention(q, k, v, causal, scale):
 # pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, block_q, block_k, kv_len, q_off):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                l_ref, *, scale, causal, block_q, block_k, kv_len,
+                q_off):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -114,6 +116,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[:, 0]
         l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows -> 0 output
         o_ref[0, ...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        # log-sum-exp per row, consumed by the backward kernels; for a
+        # fully-masked row m=-inf and l was clamped to 1 -> lse=-inf,
+        # whose exp(s - lse) entries are all masked off in backward
+        lse_ref[0, :] = m_ref[:, 0] + jnp.log(l)
 
 
 def _pad_axis(x, axis, mult):
@@ -146,7 +152,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     if not interpret:
         params["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -154,8 +160,14 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq_p), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, _MIN_LANES), jnp.float32),
@@ -164,7 +176,175 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
         interpret=interpret,
         **params,
     )(qp, kp, vp)
-    return out[:, :tq, :].reshape(b, h, tq, d)
+    return (out[:, :tq, :].reshape(b, h, tq, d),
+            lse.reshape(b * h, tq_p))
+
+
+# ---------------------------------------------------------------------------
+# pallas backward kernels (standard two-pass FlashAttention bwd)
+# ---------------------------------------------------------------------------
+# Recompute P blockwise from (q, k, lse); with delta = rowsum(dO * O):
+#   dV = P^T dO
+#   dS = P * (dO V^T - delta) * scale
+#   dQ = dS K ;  dK = dS^T Q
+# The [Tq, Tk] matrices never leave VMEM — the previous bwd replayed
+# plain attention in XLA, materializing P in HBM (docs/PROFILE_r4.md
+# headroom #1).
+
+def _bwd_p_ds_block(q, k, v, do, lse, delta, *, scale, causal,
+                    block_q, block_k, kv_len, q_len, q_off, qi, ki):
+    """Recompute the probability block P [bq, bk] (forward's mask plus
+    a valid-q-row mask — padded q rows must contribute nothing to
+    dk/dv) and the score gradient dS = P * (dO V^T - delta) * scale."""
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    kpos = ki * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    qrow = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    mask = (kpos < kv_len) & (qrow < q_len)
+    if causal:
+        mask = mask & ((q_off + qrow) >= kpos)
+    # masked entries (incl. fully-masked rows where lse=-1e30) -> 0
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+    dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    return p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, scale, causal, block_q,
+                   block_k, kv_len, q_len, q_off):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    if causal:
+        run = (ki * block_k) <= (q_off + qi * block_q + block_q - 1)
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        _, ds = _bwd_p_ds_block(
+            q, k, v, do, lse_ref[0], delta_ref[0], scale=scale,
+            causal=causal, block_q=block_q, block_k=block_k,
+            kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki)
+        acc_ref[...] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, ...] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, kv_len, q_len, q_off):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    if causal:
+        # q blocks entirely above the diagonal contribute nothing
+        run = (ki * block_k) <= (q_off + qi * block_q + block_q - 1)
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _bwd_p_ds_block(
+            q, k, v, do, lse_ref[0], delta_ref[0], scale=scale,
+            causal=causal, block_q=block_q, block_k=block_k,
+            kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki)
+        dv_acc[...] += lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[...] += lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, ...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, ...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
+                      block_k, interpret=False):
+    """q/k/v: [B, H, T, D]; lse: [B*H, Tq_padded]; g = dO."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = min(block_q, max(tq, 8))
+    bk = min(block_k, max(tk, 8))
+    qp = _pad_axis(q.reshape(b * h, tq, d), 1, bq)
+    kp = _pad_axis(k.reshape(b * h, tk, d), 1, bk)
+    vp = _pad_axis(v.reshape(b * h, tk, d), 1, bk)
+    gp = _pad_axis(g.reshape(b * h, tq, d), 1, bq)
+    tq_p, tk_p = qp.shape[1], kp.shape[1]
+    # delta = rowsum(dO * O): cheap elementwise+reduce, done in XLA
+    delta = _pad_axis(
+        jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1).reshape(b * h, tq), 1, bq)
+    q_off = tk - tq if causal else 0
+    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
+                  kv_len=tk, q_len=tq, q_off=q_off)
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    qspec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
+    lspec = pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i))
+    kspec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b * h, tq_p // bq, tk_p // bk),
+        in_specs=[qspec, kspec, kspec, qspec, lspec, lspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(qp, kp, vp, gp, lse, delta)
+
+    # dkv grid: kv blocks outer, q blocks inner (accumulator carries
+    # across the q sweep); block index maps swap i<->j roles
+    qspec2 = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
+    lspec2 = pl.BlockSpec((1, bq), lambda bh, j, i: (bh, i))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(b * h, tk_p // bk, tq_p // bq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, lspec2, lspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(qp, kp, vp, gp, lse, delta)
+    return (dq[:, :tq, :].reshape(b, h, tq, d),
+            dk[:, :tk, :].reshape(b, h, tk, d),
+            dv[:, :tk, :].reshape(b, h, tk, d))
 
 
 # ---------------------------------------------------------------------------
@@ -174,19 +354,30 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, impl):
     if impl == "pallas":
-        return _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k)
+        return _flash_fwd_pallas(q, k, v, causal, scale, block_q,
+                                 block_k)[0]
     if impl == "interpret":
-        return _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                                 interpret=True)
+        return _flash_fwd_pallas(q, k, v, causal, scale, block_q,
+                                 block_k, interpret=True)[0]
     return _plain_attention(q, k, v, causal, scale)
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, impl):
-    return _flash(q, k, v, causal, scale, block_q, block_k, impl), (q, k, v)
+    if impl in ("pallas", "interpret"):
+        out, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q,
+                                     block_k,
+                                     interpret=impl == "interpret")
+        return out, (q, k, v, out, lse)
+    out = _plain_attention(q, k, v, causal, scale)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, impl, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
+    if impl in ("pallas", "interpret"):
+        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale,
+                                 block_q, block_k,
+                                 interpret=impl == "interpret")
     _, vjp = jax.vjp(
         lambda a, b, c: _plain_attention(a, b, c, causal, scale), q, k, v)
     return vjp(g)
