@@ -272,7 +272,8 @@ class CollectiveOptimizer:
             loss_name=loss.name, mesh=penv.get_mesh())
         if self._strategy.zero_stage:
             compiled = compiled.with_sharding_rules(
-                zero_sharding_rules(stage=self._strategy.zero_stage))
+                zero_sharding_rules(stage=self._strategy.zero_stage,
+                                    program=main))
         self._fleet._compiled = compiled
         return ret
 
