@@ -178,10 +178,12 @@ def _transformer_n_params(seq, vocab, d_model, n_layer, d_inner,
             + d_model * vocab)
 
 
-def _build_transformer_train(batch, seq):
+def _build_transformer_train(batch, seq, amp=True):
     """Build + init the bench transformer train step; returns
     (fn, state, feed, loss_name) — the exact path bench and profiler
-    share."""
+    share.  amp=True rewrites activations to bf16 with fp32 master
+    weights (contrib.mixed_precision), the transformer counterpart of
+    the resnet bench's AMP story."""
     import jax
     import jax.numpy as jnp
 
@@ -195,7 +197,15 @@ def _build_transformer_train(batch, seq):
         vocab_size=c["vocab"], max_len=seq, d_model=c["d_model"],
         n_head=c["n_head"], d_inner=c["d_inner"],
         n_layer=c["n_layer"], dropout_rate=0.0)
-    optimizer.Adam(learning_rate=1e-4).minimize(model["loss"])
+    opt = optimizer.Adam(learning_rate=1e-4)
+    if amp:
+        from paddle_tpu.contrib.mixed_precision import decorate
+
+        # bf16 has fp32's exponent range: static scaling 1.0 is safe
+        # (same choice as the resnet bench)
+        opt = decorate(opt, init_loss_scaling=1.0,
+                       use_dynamic_loss_scaling=False)
+    opt.minimize(model["loss"])
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(framework.default_startup_program())
     compiled = fluid.CompiledProgram(framework.default_main_program())

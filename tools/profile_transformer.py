@@ -35,14 +35,17 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--no-amp", action="store_true",
+                    help="disable the bf16 AMP rewrite (bench default "
+                         "is AMP on)")
     ap.add_argument("--time", action="store_true")
     args = ap.parse_args()
 
     import jax
 
     # identical build path to bench_transformer_train — shared builder
-    fn, state, feed, loss_name = _build_transformer_train(args.batch,
-                                                          args.seq)
+    fn, state, feed, loss_name = _build_transformer_train(
+        args.batch, args.seq, amp=not args.no_amp)
     lowered = fn.lower(state, feed)
     comp = lowered.compile()
     text = comp.as_text()
