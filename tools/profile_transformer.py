@@ -96,7 +96,7 @@ def main():
         print(f"  hlo {k:20s} x{n}")
 
     if args.time:
-        sec, _ = _chain_timed(fn, state, feed, model["loss"].name, 10)
+        sec, _ = _chain_timed(fn, state, feed, loss_name, 10)
         toks = args.batch * args.seq / sec
         mfu = fpt * toks / peak
         print(f"measured: {sec * 1e3:.1f} ms/step, "
