@@ -286,8 +286,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
-                      block_k, interpret=False):
-    """q/k/v: [B, H, T, D]; lse: [B*H, Tq_padded]; g = dO."""
+                      block_k, interpret=False, dlse=None):
+    """q/k/v: [B, H, T, D]; lse: [B*H, Tq_padded]; g = dO.
+
+    dlse ([B*H, Tq] or None): cotangent of the lse output when the
+    caller consumes it (ring attention's cross-chunk merge).  Since
+    d lse_r / d s_rc = p_rc, it folds into the delta term:
+    dS = P*(dO V^T - delta) + P*dlse = P*(dO V^T - (delta - dlse)).
+    """
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bq = min(block_q, max(tq, 8))
@@ -297,10 +303,17 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
     vp = _pad_axis(v.reshape(b * h, tk, d), 1, bk)
     gp = _pad_axis(g.reshape(b * h, tq, d), 1, bq)
     tq_p, tk_p = qp.shape[1], kp.shape[1]
-    # delta = rowsum(dO * O): cheap elementwise+reduce, done in XLA
-    delta = _pad_axis(
-        jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                axis=-1).reshape(b * h, tq), 1, bq)
+    # delta = rowsum(dO * O): cheap elementwise+reduce, done in XLA;
+    # an lse cotangent subtracts from it (see docstring)
+    delta_full = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32),
+        axis=-1).reshape(b * h, tq)
+    if dlse is not None:
+        # the lse output (and so its cotangent) is q-block padded;
+        # only the first tq rows are real
+        delta_full = delta_full - dlse.reshape(b * h, -1)[:, :tq] \
+            .astype(jnp.float32)
+    delta = _pad_axis(delta_full, 1, bq)
     q_off = tk - tq if causal else 0
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
                   kv_len=tk, q_len=tq, q_off=q_off)
@@ -384,6 +397,49 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, impl, res, g):
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# -- (out, lse) variant: the mergeable summary ring attention needs ----
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                             interpret=interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k,
+                   interpret):
+    out, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q,
+                                 block_k, interpret=interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    do, dlse = g
+    return _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale,
+                             block_q, block_k, interpret=interpret,
+                             dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q, k, v, *, causal=False, scale=None,
+                        block_q=512, block_k=512, impl=None):
+    """Like flash_attention but also returns the per-row log-sum-exp
+    ([B*H, Tq_padded_to_block]): (out, lse) is a complete mergeable
+    attention summary — two chunks combine as
+      m = max(lse1, lse2); a_i = exp(lse_i - m)
+      out = (out1*a1 + out2*a2) / (a1 + a2); lse = m + log(a1 + a2)
+    which is what ring attention accumulates across KV rotations.
+    Differentiable in q, k, v including through lse consumers."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl is None:
+        impl = "pallas" if _on_tpu() else "interpret"
+    return _flash_lse(q, k, v, causal, float(scale), block_q, block_k,
+                      impl == "interpret")
 
 
 def flash_attention(q, k, v, *, causal=False, scale=None, block_q=512,

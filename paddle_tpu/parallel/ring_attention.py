@@ -55,7 +55,7 @@ def _local_causal_bias(q_pos, k_pos):
 
 
 def ring_attention(q, k, v, mesh=None, axis="sp", causal=False,
-                   scale=None):
+                   scale=None, impl=None):
     """Exact attention with sequence sharded over ``axis``.
 
     q/k/v: [B, S, H, D] global arrays (S = full sequence).  Inside jit the
@@ -63,6 +63,12 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False,
     via lax.ppermute so every Q block attends to every K/V block while only
     ever holding one remote block — O(S/n) memory per chip, comm riding the
     ICI ring.
+
+    impl: None (auto: 'flash' on TPU, 'xla' elsewhere), 'xla' (einsum
+    per chunk — materializes the per-chunk [blk, blk] scores),
+    'flash' / 'flash_interpret' (each chunk through the Pallas kernel
+    via its (out, lse) mergeable summary — scores stay in VMEM even
+    within a chunk, forward and backward).
     """
     from paddle_tpu.parallel import env as penv
 
@@ -73,6 +79,10 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False,
     if mesh is None or axis not in mesh.axis_names \
             or mesh.shape[axis] == 1:
         return _plain_attention(q, k, v, causal, scale)
+    if impl is None:
+        from paddle_tpu.ops.pallas_kernels import _on_tpu
+
+        impl = "flash" if _on_tpu() else "xla"
 
     from paddle_tpu.parallel.env import shard_map
     from jax.sharding import PartitionSpec as P
@@ -82,6 +92,21 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False,
     assert seq % n == 0, f"seq {seq} not divisible by {axis}={n}"
     blk = seq // n
     spec = P(None, axis, None, None)
+    use_flash = impl in ("flash", "flash_interpret")
+    flash_impl = "interpret" if impl == "flash_interpret" else "pallas"
+
+    def _flash_chunk(qt, kc, vc, chunk_causal):
+        """One chunk through the Pallas kernel; returns the same
+        unnormalized-summary triple _merge consumes: with
+        (o_norm, lse) the triple (o_norm, m=lse, l=1) merges exactly
+        (merge then scales o by exp(lse-m) and sums the weights)."""
+        from paddle_tpu.ops.pallas_kernels import flash_attention_lse
+
+        o, lse = flash_attention_lse(qt, kc, vc, causal=chunk_causal,
+                                     scale=scale, impl=flash_impl)
+        b, h, t, _d = qt.shape
+        lse = lse[:, :t].reshape(b, h, t).astype(jnp.float32)
+        return o.astype(jnp.float32), lse, jnp.ones_like(lse)
 
     def local(q_blk, k_blk, v_blk):
         # [B, blk, H, D] -> [B, H, blk, D]
@@ -93,26 +118,48 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False,
 
         perm = [(i, (i + 1) % n) for i in range(n)]
 
+        def block_summary(src, kc, vc):
+            if not use_flash:
+                if causal:
+                    k_pos = src * blk + jnp.arange(blk)
+                    bias = _local_causal_bias(q_pos, k_pos)
+                else:
+                    bias = None
+                return _attention_block(
+                    qt.astype(jnp.float32), kc.astype(jnp.float32),
+                    vc.astype(jnp.float32), bias, scale)
+            if not causal:
+                return _flash_chunk(qt, kc, vc, False)
+            # causal: the diagonal chunk masks within itself, chunks
+            # before mine are fully visible, chunks after contribute
+            # nothing (empty summary)
+            empty = (jnp.zeros(qt.shape, jnp.float32),
+                     jnp.full(qt.shape[:-1], _NEG_INF, jnp.float32),
+                     jnp.zeros(qt.shape[:-1], jnp.float32))
+            return lax.cond(
+                src == my,
+                lambda _: _flash_chunk(qt, kc, vc, True),
+                lambda _: lax.cond(
+                    src < my,
+                    lambda __: _flash_chunk(qt, kc, vc, False),
+                    lambda __: empty, None),
+                None)
+
         def step(carry, i):
             o, m, l, kc, vc = carry
             src = (my - i) % n          # which block kc/vc currently is
-            if causal:
-                k_pos = src * blk + jnp.arange(blk)
-                bias = _local_causal_bias(q_pos, k_pos)
-            else:
-                bias = None
-            bo, bm, bl = _attention_block(qt, kc, vc, bias, scale)
+            bo, bm, bl = block_summary(src, kc, vc)
             o, m, l = _merge(o, m, l, bo, bm, bl)
             kc = lax.ppermute(kc, axis, perm)
             vc = lax.ppermute(vc, axis, perm)
             return (o, m, l, kc, vc), None
 
-        o0 = jnp.zeros_like(qt)
-        m0 = jnp.full(qt.shape[:-1], _NEG_INF, qt.dtype)
-        l0 = jnp.zeros(qt.shape[:-1], qt.dtype)
+        o0 = jnp.zeros(qt.shape, jnp.float32)
+        m0 = jnp.full(qt.shape[:-1], _NEG_INF, jnp.float32)
+        l0 = jnp.zeros(qt.shape[:-1], jnp.float32)
         (o, m, l, _, _), _ = lax.scan(
             step, (o0, m0, l0, kt, vt), jnp.arange(n))
-        out = o / jnp.maximum(l[..., None], 1e-30)
+        out = (o / jnp.maximum(l[..., None], 1e-30)).astype(q_blk.dtype)
         return jnp.swapaxes(out, 1, 2)          # back to [B, blk, H, D]
 
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),

@@ -86,6 +86,86 @@ def test_flash_pallas_bwd_matches_reference(shape, causal):
                                    atol=3e-5, err_msg=f"d{name}")
 
 
+def _merge_lse(o1, l1, o2, l2):
+    m = jnp.maximum(l1, l2)
+    a1 = jnp.exp(l1 - m)[..., None]
+    a2 = jnp.exp(l2 - m)[..., None]
+    o = (o1 * a1 + o2 * a2) / (a1 + a2)
+    return o, m + jnp.log(a1[..., 0] + a2[..., 0])
+
+
+def test_flash_lse_split_kv_merge_matches_whole():
+    """(out, lse) is a complete mergeable summary: attention over KV
+    split in two chunks, merged, equals attention over the whole KV —
+    for values AND gradients (grads flow through lse via the merge,
+    exercising the dlse term of the Pallas backward)."""
+    from paddle_tpu.ops.pallas_kernels import flash_attention_lse
+
+    b, h, t, d = 1, 2, 64, 32
+    rng = np.random.RandomState(3)
+    q, k, v = _rand_qkv(rng, b, h, t, t, d)
+    w = jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+    sc = 1.0 / np.sqrt(d)
+
+    def split_loss(q, k, v):
+        o1, l1 = flash_attention_lse(q, k[:, :, :t // 2],
+                                     v[:, :, :t // 2],
+                                     impl="interpret", block_q=32,
+                                     block_k=32, scale=sc)
+        o2, l2 = flash_attention_lse(q, k[:, :, t // 2:],
+                                     v[:, :, t // 2:],
+                                     impl="interpret", block_q=32,
+                                     block_k=32, scale=sc)
+        o1 = o1.astype(jnp.float32)
+        o2 = o2.astype(jnp.float32)
+        # lse is padded to the q block; t==64 is block-aligned here
+        o, _ = _merge_lse(o1, l1.reshape(b, h, t),
+                          o2, l2.reshape(b, h, t))
+        return (o * w).sum()
+
+    def whole_loss(q, k, v):
+        return (_plain_attention(q, k, v, False, sc) * w).sum()
+
+    with jax.default_matmul_precision("float32"):
+        v1, g1 = jax.value_and_grad(split_loss, argnums=(0, 1, 2))(
+            q, k, v)
+        v2, g2 = jax.value_and_grad(whole_loss, argnums=(0, 1, 2))(
+            q, k, v)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+    for name, a, bq in zip("q k v".split(), g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bq),
+                                   atol=3e-5, err_msg=f"d{name}")
+
+
+def test_flash_lse_grad_non_block_aligned():
+    """Regression: lse (and its cotangent) is q-block padded; the
+    backward must slice, not reshape — T=48 with block 32 pads to 64."""
+    from paddle_tpu.ops.pallas_kernels import flash_attention_lse
+
+    rng = np.random.RandomState(4)
+    q, k, v = _rand_qkv(rng, 1, 2, 48, 48, 16)
+    w = jnp.asarray(rng.randn(1, 2, 48, 16).astype(np.float32))
+    sc = 0.25
+
+    def loss(a, b, c):
+        o, lse = flash_attention_lse(a, b, c, impl="interpret",
+                                     block_q=32, block_k=32, scale=sc)
+        return (o * w).sum() + (lse[:, :48] * 0.01).sum()
+
+    def ref(a, b, c):
+        s = jnp.einsum("bhqd,bhkd->bhqk", a, b) * sc
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), c)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        return (o * w).sum() + (lse.reshape(2, 48) * 0.01).sum()
+
+    with jax.default_matmul_precision("float32"):
+        g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, bq in zip("q k v".split(), g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bq),
+                                   atol=3e-5, err_msg=f"d{name}")
+
+
 def test_flash_attention_ir_op():
     """The flash_attention op runs through Executor + CompiledProgram."""
     import paddle_tpu as fluid
