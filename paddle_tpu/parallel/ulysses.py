@@ -18,8 +18,13 @@ import jax.numpy as jnp
 
 
 def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False,
-                      scale=None):
-    """q/k/v: [B, S, H, D] global arrays, S sharded over ``axis``."""
+                      scale=None, impl=None):
+    """q/k/v: [B, S, H, D] global arrays, S sharded over ``axis``.
+
+    impl: None (auto: 'flash' on TPU, 'xla' elsewhere) — after the
+    all-to-all each device holds full-sequence H/n-head blocks, which
+    run through the Pallas flash kernel ('flash'/'flash_interpret') or
+    the plain einsum path ('xla')."""
     from paddle_tpu.parallel import env as penv
     from paddle_tpu.parallel.ring_attention import _plain_attention
 
@@ -30,6 +35,10 @@ def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False,
     if mesh is None or axis not in mesh.axis_names \
             or mesh.shape[axis] == 1:
         return _plain_attention(q, k, v, causal, scale)
+    if impl is None:
+        from paddle_tpu.ops.pallas_kernels import _on_tpu
+
+        impl = "flash" if _on_tpu() else "xla"
 
     from jax import lax
     from paddle_tpu.parallel.env import shard_map
@@ -40,6 +49,18 @@ def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False,
     assert s % n == 0, f"seq {s} % {axis}={n} != 0"
     assert h % n == 0, f"heads {h} % {axis}={n} != 0 (use ring attention)"
     spec = P(None, axis, None, None)
+
+    def attend(qh, kh, vh):
+        if impl in ("flash", "flash_interpret"):
+            from paddle_tpu.ops.pallas_kernels import flash_attention
+
+            o = flash_attention(
+                jnp.swapaxes(qh, 1, 2), jnp.swapaxes(kh, 1, 2),
+                jnp.swapaxes(vh, 1, 2), causal=causal, scale=scale,
+                impl="interpret" if impl == "flash_interpret"
+                else "pallas")
+            return jnp.swapaxes(o, 1, 2)
+        return _plain_attention(qh, kh, vh, causal, scale)
 
     def local(ql, kl, vl):
         # [B, S/n, H, D] --all_to_all--> [B, S, H/n, D]
@@ -52,8 +73,7 @@ def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False,
                                   tiled=True)
 
         qh, kh, vh = seq2head(ql), seq2head(kl), seq2head(vl)
-        out = _plain_attention(qh, kh, vh, causal, scale)
-        return head2seq(out)
+        return head2seq(attend(qh, kh, vh))
 
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_rep=False)(q, k, v)

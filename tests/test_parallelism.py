@@ -99,6 +99,37 @@ def test_ulysses_attention_matches_plain(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_impl_matches_plain(causal):
+    """Ulysses with the per-device attention through the Pallas kernel
+    (interpret mode): values and grads match plain attention."""
+    mesh = _mesh((4,), ("sp",))
+    rng = np.random.RandomState(6)
+    b, s, h, d = 1, 32, 4, 16
+    q, k, v = [rng.randn(b, s, h, d).astype(np.float32)
+               for _ in range(3)]
+    w = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    scale = 1.0 / np.sqrt(d)
+
+    def loss_u(q, k, v):
+        return jnp.sum(ulysses_attention(
+            q, k, v, mesh=mesh, axis="sp", causal=causal,
+            impl="flash_interpret") * w)
+
+    def loss_plain(q, k, v):
+        from paddle_tpu.parallel.ring_attention import _plain_attention
+        return jnp.sum(_plain_attention(q, k, v, causal, scale) * w)
+
+    with jax.default_matmul_precision("float32"):
+        v1, g1 = jax.value_and_grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+        v2, g2 = jax.value_and_grad(loss_plain, argnums=(0, 1, 2))(
+            q, k, v)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-4)
+    for name, a, bq in zip("q k v".split(), g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bq),
+                                   atol=5e-5, err_msg=f"d{name}")
+
+
 def test_ring_attention_gradients_flow():
     mesh = _mesh((4,), ("sp",))
     rng = np.random.RandomState(2)
