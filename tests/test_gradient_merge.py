@@ -157,6 +157,39 @@ def test_gradient_merge_with_l2decay_keeps_gate_roles(
     np.testing.assert_allclose(w_merge, w_big, rtol=1e-5, atol=1e-6)
 
 
+def test_gradient_merge_composes_with_data_parallel(
+        fresh_programs_factory):
+    """GradientMerge under with_data_parallel (8-dev mesh): k
+    microsteps of dp-sharded microbatches equal one big-batch dp step
+    — the accumulation is per-replica-local and XLA's allreduce of
+    each microstep's grads commutes with the sum."""
+    k, micro, n_up = 2, 16, 2   # micro divisible by 8 devices
+    bigs = _data(n_up, k, micro)
+
+    def compiled_run(opt_factory, batches):
+        exe, loss, pname = _build(opt_factory)
+        compiled = fluid.CompiledProgram(
+            framework.default_main_program()).with_data_parallel(
+            loss_name=loss.name)
+        for bx in batches:
+            exe.run(compiled,
+                    feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                    fetch_list=[loss])
+        return _param(pname)
+
+    with fresh_programs_factory():
+        w_big = compiled_run(lambda: optimizer.SGD(0.1), bigs)
+
+    with fresh_programs_factory():
+        micros = [bx[j * micro:(j + 1) * micro]
+                  for bx in bigs for j in range(k)]
+        w_merge = compiled_run(
+            lambda: optimizer.GradientMergeOptimizer(
+                optimizer.SGD(0.1), k_steps=k, avg=True), micros)
+
+    np.testing.assert_allclose(w_merge, w_big, rtol=1e-5, atol=1e-6)
+
+
 def test_gradient_merge_composes_with_recompute(fresh_programs_factory):
     """GradientMerge(Recompute(SGD)) still matches big-batch SGD."""
     k, micro = 2, 8
