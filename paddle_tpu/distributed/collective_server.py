@@ -17,14 +17,15 @@ import threading
 
 import numpy as np
 
-from paddle_tpu.distributed.rpc import RPCClient, RPCServer
+from paddle_tpu.distributed.rpc import (make_rpc_client,
+                                         make_rpc_server)
 
 __all__ = ["CollectiveServer", "CollectiveClient"]
 
 
 class CollectiveServer:
     def __init__(self, endpoint="127.0.0.1:0"):
-        self._server = RPCServer(endpoint)
+        self._server = make_rpc_server(endpoint)
         self.endpoint = self._server.endpoint
         self._vars: dict = {}
         self._cond = threading.Condition()
@@ -83,7 +84,7 @@ class CollectiveClient:
     """reference CollectiveClient::Gather — rank order retained."""
 
     def __init__(self):
-        self._client = RPCClient()
+        self._client = make_rpc_client()
 
     def gather(self, remote_vars, timeout=60.0):
         """remote_vars: [(endpoint, var_name), ...] in rank order.

@@ -39,7 +39,7 @@ class DownpourRunner:
                  executor=None, push_window=4, pull_dense_every=1):
         from paddle_tpu.core.program import OPTIMIZE
         from paddle_tpu.core.scope import global_scope
-        from paddle_tpu.distributed.rpc import RPCClient
+        from paddle_tpu.distributed.rpc import make_rpc_client
 
         t = transpiler
         if not t.endpoints:
@@ -92,8 +92,8 @@ class DownpourRunner:
         # fleet_wrapper.h — DownpourWorker composes, never speaks RPC)
         from paddle_tpu.fleet.fleet_wrapper import FleetWrapper
 
-        self._pull_client = RPCClient()
-        self._push_client = RPCClient()
+        self._pull_client = make_rpc_client()
+        self._push_client = make_rpc_client()
         self._fleet_pull = FleetWrapper(t, client=self._pull_client)
         self._fleet_push = FleetWrapper(t, client=self._push_client)
         # liveness: announce this worker so pserver barriers/completions

@@ -1,0 +1,151 @@
+"""Alternative HTTP/1.1 RPC transport (reference parity: the BRPC
+transport, operators/distributed/brpc/ — a second wire transport behind
+the same RPCClient/RPCServer abstraction, selected at deploy time; the
+reference picks it with WITH_BRPC at build time, here
+PADDLE_TPU_RPC_TRANSPORT=http at run time).
+
+Same tagged binary wire codec, same handler/barrier semantics — only
+the framing differs: each request is one POST /rpc with the
+wire-encoded (msg_type, payload) body; the response body is the
+wire-encoded ("ok", reply) / ("error", msg) tuple.  Keep-alive
+connections give one server thread per client connection, matching the
+socket transport's concurrency model (handlers may block in barriers).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from paddle_tpu.distributed.rpc import (RPCClient, RPCServer, WireError,
+                                        wire_dumps, wire_loads)
+
+__all__ = ["HTTPRPCServer", "HTTPRPCClient"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"   # keep-alive: thread per connection
+
+    def log_message(self, *args):   # quiet
+        pass
+
+    def do_POST(self):
+        rpc = self.server._rpc
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+        except (ValueError, OSError):
+            self.send_error(400)
+            return
+        try:
+            msg = wire_loads(body)
+        except WireError as e:
+            reply = ("error", f"bad wire frame: {e}")
+        else:
+            reply = rpc._dispatch(msg)  # shared with the socket framing
+        try:
+            out = wire_dumps(reply)
+        except WireError as e:
+            out = wire_dumps(("error",
+                              f"reply not wire-encodable: {e}"))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+class HTTPRPCServer(RPCServer):
+    """Drop-in RPCServer over HTTP framing."""
+
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        host = host or "127.0.0.1"
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd._rpc = self
+        self._httpd.daemon_threads = True
+        self.endpoint = f"{host}:{self._httpd.server_address[1]}"
+        self._handlers = {}
+        self._stop = threading.Event()
+        self._threads = []
+        self._dyn_barriers: dict = {}
+        self._barrier_lock = threading.Lock()
+
+    def start(self):
+        self._serving = True
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             kwargs={"poll_interval": 0.2}, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        # shutdown() blocks on an event only serve_forever() sets —
+        # calling it on a never-started server would deadlock
+        if getattr(self, "_serving", False):
+            self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class HTTPRPCClient(RPCClient):
+    """Drop-in RPCClient over HTTP framing: per-endpoint keep-alive
+    connection + lock, connect-retry like the socket client."""
+
+    def _get_conn(self, endpoint):
+        import time
+
+        with self._global_lock:
+            if endpoint not in self._conns:
+                host, port = endpoint.rsplit(":", 1)
+                conn = HTTPConnection(host or "127.0.0.1", int(port),
+                                      timeout=self._TIMEOUT)
+                deadline = time.monotonic() + self._TIMEOUT
+                while True:
+                    try:
+                        conn.connect()
+                        break
+                    except OSError:
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.2)
+                self._conns[endpoint] = conn
+                self._locks[endpoint] = threading.Lock()
+            return self._conns[endpoint], self._locks[endpoint]
+
+    def call(self, endpoint: str, msg_type: str, payload=None):
+        import http.client as _hc
+
+        conn, lock = self._get_conn(endpoint)
+        try:
+            with lock:
+                body = wire_dumps((msg_type, payload))
+                conn.request("POST", "/rpc", body=body, headers={
+                    "Content-Type": "application/octet-stream"})
+                resp = conn.getresponse()
+                data = resp.read()
+            status, reply = wire_loads(data)
+        except (ConnectionError, OSError, WireError,
+                _hc.HTTPException):
+            # HTTPException covers IncompleteRead/BadStatusLine/
+            # CannotSendRequest — a connection broken mid-response must
+            # be evicted like the socket client does, or the endpoint
+            # stays wedged after a pserver restart
+            with self._global_lock:
+                cached = self._conns.get(endpoint)
+                if cached is conn:
+                    try:
+                        cached.close()
+                    except OSError:
+                        pass
+                    del self._conns[endpoint]
+                    del self._locks[endpoint]
+            raise
+        if status == "error":
+            raise RuntimeError(
+                f"RPC '{msg_type}' to {endpoint} failed: {reply}")
+        return reply
+
+    # close() inherited: RPCClient.close() already iterates and closes
+    # the cached connections (HTTPConnection.close matches the shape)

@@ -307,6 +307,21 @@ class RPCServer:
             t.start()
             self._threads.append(t)
 
+    def _dispatch(self, msg):
+        """(msg_type, payload) -> ("ok", reply) | ("error", text).
+        One dispatch semantics for every transport framing."""
+        if not (isinstance(msg, tuple) and len(msg) == 2
+                and isinstance(msg[0], str)):
+            return ("error", "message must be (msg_type, payload)")
+        msg_type, payload = msg
+        fn = self._handlers.get(msg_type)
+        if fn is None:
+            return ("error", f"no handler for '{msg_type}'")
+        try:
+            return ("ok", fn(payload))
+        except Exception as e:  # surface to client
+            return ("error", repr(e))
+
     def _serve_conn(self, conn):
         try:
             while not self._stop.is_set():
@@ -319,24 +334,9 @@ class RPCServer:
                     # stream is still in sync: report and keep serving
                     _send_msg(conn, ("error", f"bad wire frame: {e}"))
                     continue
-                if not (isinstance(msg, tuple) and len(msg) == 2
-                        and isinstance(msg[0], str)):
-                    _send_msg(conn, ("error",
-                                     "message must be (msg_type, payload)"))
-                    continue
-                msg_type, payload = msg
-                fn = self._handlers.get(msg_type)
-                if fn is None:
-                    _send_msg(conn, ("error",
-                                     f"no handler for '{msg_type}'"))
-                    continue
+                reply = self._dispatch(msg)
                 try:
-                    reply = fn(payload)
-                except Exception as e:  # surface to client
-                    _send_msg(conn, ("error", repr(e)))
-                    continue
-                try:
-                    _send_msg(conn, ("ok", reply))
+                    _send_msg(conn, reply)
                 except WireError as e:
                     # handler returned something non-encodable: tell the
                     # client instead of killing the connection
@@ -450,6 +450,31 @@ class RPCClient:
             self._locks.clear()
 
 
+def _transport():
+    import os
+
+    return os.environ.get("PADDLE_TPU_RPC_TRANSPORT", "socket")
+
+
+def make_rpc_server(endpoint: str) -> "RPCServer":
+    """Transport-selected server (reference: gRPC vs BRPC behind one
+    RPCServer abstraction, chosen by WITH_BRPC at build time; here
+    PADDLE_TPU_RPC_TRANSPORT=socket|http at run time)."""
+    if _transport() == "http":
+        from paddle_tpu.distributed.http_transport import HTTPRPCServer
+
+        return HTTPRPCServer(endpoint)
+    return RPCServer(endpoint)
+
+
+def make_rpc_client() -> "RPCClient":
+    if _transport() == "http":
+        from paddle_tpu.distributed.http_transport import HTTPRPCClient
+
+        return HTTPRPCClient()
+    return RPCClient()
+
+
 _global_client = None
 _client_lock = threading.Lock()
 
@@ -458,7 +483,7 @@ def global_rpc_client() -> RPCClient:
     global _global_client
     with _client_lock:
         if _global_client is None:
-            _global_client = RPCClient()
+            _global_client = make_rpc_client()
         return _global_client
 
 
@@ -521,7 +546,7 @@ class HeartbeatSender:
 
     def __init__(self, client, endpoint, peer_id, interval=1.0):
         if client is None:
-            client = RPCClient()
+            client = make_rpc_client()
             client._TIMEOUT = max(2.0, 2 * float(interval))
             self._owns_client = True
         else:

@@ -19,11 +19,11 @@ __all__ = ["FleetWrapper"]
 
 class FleetWrapper:
     def __init__(self, transpiler, client=None):
-        from paddle_tpu.distributed.rpc import RPCClient
+        from paddle_tpu.distributed.rpc import make_rpc_client
 
         self.t = transpiler
         self.eps = list(transpiler.endpoints)
-        self.client = client or RPCClient()
+        self.client = client or make_rpc_client()
 
     # ------------------------------------------------------- sparse
     def _table_rows(self, table_name):

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.executor import register_special_op
 from paddle_tpu.core.registry import REQUIRED, register_op
-from paddle_tpu.distributed.rpc import RPCServer, global_rpc_client
+from paddle_tpu.distributed.rpc import (global_rpc_client,
+                                         make_rpc_server)
 
 
 def _structural(ins, attrs):  # pragma: no cover
@@ -273,7 +274,7 @@ def listen_and_serv_op(op, block, scope, ctx):
                      for g, b in attrs.get("sparse_grad_blocks", [])]
     sparse_block_map = dict(sparse_blocks)
 
-    server = RPCServer(attrs["endpoint"])
+    server = make_rpc_server(attrs["endpoint"])
     buffers: dict = {}
     sparse_buffers: dict = {}
     lock = threading.Lock()
