@@ -22,9 +22,16 @@ import jax; jax.config.update('jax_platforms','cpu')
 import __graft_entry__ as ge; ge.dryrun_multichip(8)
 print('dryrun_multichip(8) OK')"
 
-echo "== 5/5 benchmark (real chip if attached; tiny CPU run otherwise) =="
+echo "== 5/6 benchmark (real chip if attached; tiny CPU run otherwise) =="
 # CI keeps the TPU probe short; the 15-min retry budget is for real
 # bench rounds (driver invocation), not the validation matrix.
 BENCH_PROBE_BUDGET_S="${BENCH_PROBE_BUDGET_S:-120}" python bench.py
+
+echo "== 6/6 per-op regression gate (hot ops vs committed CPU baseline) =="
+# 3x tolerance absorbs machine load; catches order-of-magnitude
+# per-op regressions (reference op_tester role) before they surface
+# in a model bench
+python tools/op_bench.py --cpu --suite tools/op_bench_suite.json \
+  --baseline tools/op_bench_baseline_cpu.json --tolerance 3.0
 
 echo "ALL CHECKS PASSED"
