@@ -745,6 +745,20 @@ class RecomputeOptimizer:
     as jax remat instead of cloned program ops."""
 
     def __init__(self, optimizer):
+        # Recompute's backward IS append_backward(checkpoints=...): it
+        # cannot run an AMP wrapper's backward, so wrapping AMP inside
+        # it would silently skip the bf16 rewrite + loss scaling.
+        # Correct order: decorate(RecomputeOptimizer(opt)).
+        probe = optimizer
+        while probe is not None:
+            if hasattr(probe, "_amp_lists"):
+                raise ValueError(
+                    "RecomputeOptimizer cannot wrap an AMP-decorated "
+                    "optimizer (the AMP rewrite would be silently "
+                    "skipped); use decorate(RecomputeOptimizer(opt)) "
+                    "instead")
+            probe = getattr(probe, "inner_optimizer",
+                            getattr(probe, "_optimizer", None))
         self.inner_optimizer = optimizer
         self._checkpoints = None
 
@@ -809,13 +823,19 @@ class GradientMergeOptimizer:
                  no_grad_set=None):
         from paddle_tpu.core.program import BlockRef
 
-        # unwrap pass-through wrappers (e.g. Recompute) to the base
-        # Optimizer that owns lr/accumulators/update ops; backward()
-        # above still goes through the wrapper (remat-aware)
+        # unwrap pass-through wrappers (Recompute's .inner_optimizer,
+        # AMP's ._optimizer — AMP backward already appended its
+        # check_finite_and_unscale, so the accumulated grads are
+        # unscaled) down to the base Optimizer that owns
+        # lr/accumulators/update ops; backward() above still goes
+        # through the outermost wrapper
         inner = self.inner_optimizer
-        while not hasattr(inner, "_append_optimize_op") and \
-                hasattr(inner, "inner_optimizer"):
-            inner = inner.inner_optimizer
+        while not hasattr(inner, "_append_optimize_op"):
+            nxt = getattr(inner, "inner_optimizer", None) or \
+                getattr(inner, "_optimizer", None)
+            if nxt is None:
+                break
+            inner = nxt
         if self.k_steps == 1:
             return self.inner_optimizer.minimize(
                 loss, startup_program, parameter_list, no_grad_set)
