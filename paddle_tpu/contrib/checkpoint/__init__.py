@@ -95,11 +95,16 @@ class AsyncCheckpointer:
             raise RuntimeError(
                 "restore: no initialized persistables in the scope — "
                 "run the startup program before restoring")
-        # abstract template: shapes/dtypes only, no host copy of the
-        # live training state that is about to be overwritten
-        template = {k: jax.ShapeDtypeStruct(np.shape(v),
-                                            np.dtype(v.dtype))
-                    for k, v in state.items()}
+        # abstract template: shapes/dtypes (+ the live arrays'
+        # shardings, so ZeRO-sharded optimizer state restores sharded
+        # instead of replicated), no host copy of the live training
+        # state that is about to be overwritten
+        def spec(v):
+            sh = v.sharding if isinstance(v, jax.Array) else None
+            return jax.ShapeDtypeStruct(np.shape(v), np.dtype(v.dtype),
+                                        sharding=sh)
+
+        template = {k: spec(v) for k, v in state.items()}
         stored = self._mgr.item_metadata(step)
         missing = sorted(set(stored) - set(template)) \
             if hasattr(stored, "keys") else []
