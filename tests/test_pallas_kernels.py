@@ -86,6 +86,37 @@ def test_flash_pallas_bwd_matches_reference(shape, causal):
                                    atol=3e-5, err_msg=f"d{name}")
 
 
+def test_flash_bf16_fwd_bwd_close_to_f32():
+    """The AMP path feeds bf16 q/k/v into the kernel on TPU: forward
+    and backward must stay within bf16 tolerance of the f32 reference
+    (accumulation is f32 inside the kernel)."""
+    rng = np.random.RandomState(7)
+    q32, k32, v32 = _rand_qkv(rng, 1, 2, 64, 64, 32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q32, k32, v32))
+    w = jnp.asarray(rng.randn(1, 2, 64, 32).astype(np.float32))
+    sc = 1.0 / np.sqrt(32)
+
+    with jax.default_matmul_precision("float32"):
+        out_b = flash_attention(qb, kb, vb, causal=True,
+                                impl="interpret", block_q=32,
+                                block_k=32)
+        assert out_b.dtype == jnp.bfloat16
+        ref = _plain_attention(q32, k32, v32, True, sc)
+        np.testing.assert_allclose(
+            np.asarray(out_b.astype(jnp.float32)), np.asarray(ref),
+            atol=0.04)  # bf16 has ~2-3 decimal digits
+
+        g_b = jax.grad(lambda a: (flash_attention(
+            a, kb, vb, causal=True, impl="interpret", block_q=32,
+            block_k=32).astype(jnp.float32) * w).sum())(qb)
+        g_r = jax.grad(lambda a: (_plain_attention(
+            a, k32, v32, True, sc) * w).sum())(q32)
+        assert g_b.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(g_b.astype(jnp.float32)), np.asarray(g_r),
+            atol=0.1)
+
+
 def _merge_lse(o1, l1, o2, l2):
     m = jnp.maximum(l1, l2)
     a1 = jnp.exp(l1 - m)[..., None]
