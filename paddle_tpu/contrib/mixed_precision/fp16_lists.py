@@ -17,6 +17,10 @@ import copy
 # MXU-bound: always worth computing in bf16
 white_list = {
     "conv2d", "depthwise_conv2d", "conv2d_transpose", "matmul", "mul",
+    # the Pallas kernel takes bf16 q/k/v and accumulates in f32
+    # internally (softmax stats included) — leaving it unlisted would
+    # cast the attention inputs back to fp32 under AMP
+    "flash_attention",
 }
 
 # numerically sensitive: keep fp32

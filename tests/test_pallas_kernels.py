@@ -86,6 +86,30 @@ def test_flash_pallas_bwd_matches_reference(shape, causal):
                                    atol=3e-5, err_msg=f"d{name}")
 
 
+def test_amp_rewrite_keeps_flash_inputs_low_precision():
+    """flash_attention is AMP-whitelisted: under the bf16 rewrite no
+    fp32 back-cast may feed it (an unlisted op gets its low-precision
+    inputs cast BACK to fp32 — exactly what would quietly throw away
+    the kernel's bf16 bandwidth win on chip)."""
+    import paddle_tpu as fluid  # noqa: F401
+    from paddle_tpu import framework, optimizer
+    from paddle_tpu.contrib.mixed_precision import decorate
+    from paddle_tpu.models.transformer import transformer_encoder_model
+
+    np.random.seed(0)
+    model = transformer_encoder_model(
+        vocab_size=200, max_len=16, d_model=32, n_head=2, d_inner=64,
+        n_layer=1, dropout_rate=0.0)
+    decorate(optimizer.SGD(0.1), init_loss_scaling=1.0,
+             use_dynamic_loss_scaling=False).minimize(model["loss"])
+    gb = framework.default_main_program().global_block()
+    flash_ops = [op for op in gb.ops if op.type == "flash_attention"]
+    assert flash_ops
+    for op in flash_ops:
+        ins = [n for ns in op.inputs.values() for n in ns]
+        assert not [n for n in ins if n.endswith(".cast_float32")], ins
+
+
 def test_flash_bf16_fwd_bwd_close_to_f32():
     """The AMP path feeds bf16 q/k/v into the kernel on TPU: forward
     and backward must stay within bf16 tolerance of the f32 reference
