@@ -157,6 +157,45 @@ def test_int8_inference_execution():
     np.testing.assert_allclose(got2, got, rtol=1e-5, atol=1e-6)
 
 
+def test_qat_freeze_feeds_int8_execution_end_to_end():
+    """The full QAT story: clone the test program BEFORE the QAT
+    transform (reference flow), train with fake-quant ops, freeze to
+    int8+scale, convert the clean test program to TRUE int8 execution,
+    outputs within quantization error of the frozen fp32 run."""
+    from paddle_tpu.contrib.slim.quantization import (
+        QuantizationFreezePass, QuantizationTransformPass,
+        convert_to_int8_execution)
+    from paddle_tpu.core.scope import global_scope
+
+    rng = np.random.RandomState(5)
+    _, _, pred, loss = _build_net()
+    optimizer.SGD(0.05).minimize(loss)
+    prog = fluid.default_main_program()
+    infer = prog.clone(for_test=True)   # raw weights, no fake ops
+    QuantizationTransformPass().apply(prog)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for _ in range(30):
+        bx = rng.rand(16, 8).astype(np.float32)
+        exe.run(prog, feed={"x": bx,
+                            "y": np.sum(bx, 1, keepdims=True)},
+                fetch_list=[loss])
+    qw = QuantizationFreezePass(global_scope()).apply(prog)
+    assert len(qw) == 2
+
+    feed = {"x": rng.rand(8, 8).astype(np.float32),
+            "y": np.zeros((8, 1), np.float32)}
+    (ref,) = exe.run(fluid.CompiledProgram(infer), feed=feed,
+                     fetch_list=[pred])  # frozen (dequantized) weights
+    convert_to_int8_execution(infer, global_scope(), qw)
+    ops = [op.type for op in infer.global_block().ops]
+    assert ops.count("mul_int8") == 2 and "mul" not in ops
+    (got,) = exe.run(fluid.CompiledProgram(infer), feed=feed,
+                     fetch_list=[pred])
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.06, rel
+
+
 def test_int8_execution_keeps_shared_weight_for_other_consumers():
     """A quantized weight also read by a non-convertible op must NOT be
     stripped: it falls back to dequantize-on-load so every consumer
