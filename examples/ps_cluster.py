@@ -1,0 +1,110 @@
+"""Parameter-server training on localhost: this script forks itself
+into 2 pservers + 2 trainers (the reference test_dist_base pattern),
+transpiles one program into trainer/pserver halves with
+DistributeTranspiler, and trains to convergence.
+
+  python examples/ps_cluster.py                 # socket transport
+  PADDLE_TPU_RPC_TRANSPORT=http python examples/ps_cluster.py
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def run_role():
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("PADDLE_TPU_PLATFORM", "cpu"))
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.transpiler import (DistributeTranspiler,
+                                       DistributeTranspilerConfig)
+
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    pserver_eps = os.environ["PADDLE_PSERVER_EPS"]
+    current_ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    np.random.seed(7)                       # identical init everywhere
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.SGD(0.05).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.min_block_size = 1
+    t = DistributeTranspiler(cfg)
+    t.transpile(trainer_id, pservers=pserver_eps, trainers=trainers,
+                sync_mode=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == "PSERVER":
+        main = t.get_pserver_program(current_ep)
+        exe.run(t.get_startup_program(current_ep, main))
+        exe.run(main)                       # serves until completion
+        return
+
+    exe.run(t.get_trainer_startup_program())
+    main = t.get_trainer_program()
+    rng = np.random.RandomState(100 + trainer_id)
+    W = np.arange(13, dtype=np.float32)[:, None] / 13.0
+    for step in range(30):
+        bx = rng.rand(32, 13).astype(np.float32)
+        lv, = exe.run(main, feed={"x": bx, "y": bx @ W},
+                      fetch_list=[loss])
+        if step % 10 == 0:
+            print(f"[trainer {trainer_id}] step {step:3d}  "
+                  f"loss {float(np.asarray(lv).ravel()[0]):.5f}",
+                  flush=True)
+    from paddle_tpu.distributed.rpc import global_rpc_client
+
+    for ep in pserver_eps.split(","):
+        global_rpc_client().send_complete(
+            ep, peer_id=f"trainer{trainer_id}")
+    print(f"[trainer {trainer_id}] done", flush=True)
+
+
+def launch():
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    eps = ",".join(f"127.0.0.1:{free_port()}" for _ in range(2))
+    base = {**os.environ, "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_PSERVER_EPS": eps}
+    procs = []
+    for ep in eps.split(","):
+        procs.append(subprocess.Popen(
+            [sys.executable, __file__],
+            env={**base, "PADDLE_TRAINING_ROLE": "PSERVER",
+                 "PADDLE_CURRENT_ENDPOINT": ep}))
+    trainers = []
+    for tid in range(2):
+        trainers.append(subprocess.Popen(
+            [sys.executable, __file__],
+            env={**base, "PADDLE_TRAINING_ROLE": "TRAINER",
+                 "PADDLE_TRAINER_ID": str(tid)}))
+    rc = 0
+    for p in trainers + procs:
+        rc |= p.wait(timeout=300)
+    print("cluster finished", "OK" if rc == 0 else f"rc={rc}")
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    if "PADDLE_TRAINING_ROLE" in os.environ:
+        run_role()
+    else:
+        launch()

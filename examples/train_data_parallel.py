@@ -1,0 +1,58 @@
+"""Data-parallel training over a device mesh: the SAME program, batch
+sharded across every device by with_data_parallel (XLA inserts the
+gradient all-reduce).  On a laptop this runs on a virtual 8-device CPU
+mesh; on a TPU slice, over the real chips.
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/train_data_parallel.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms",
+                  os.environ.get("PADDLE_TPU_PLATFORM", "cpu"))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+
+def main():
+    print(f"devices: {len(jax.devices())}")
+    np.random.seed(0)
+    x = layers.data("x", shape=[32], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, 64, act="relu")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.SGD(0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+        loss_name=loss.name)
+
+    rng = np.random.RandomState(1)
+    W = rng.randn(32, 1).astype(np.float32)
+    batch = 16 * len(jax.devices())     # divisible across the mesh
+    for step in range(100):
+        bx = rng.rand(batch, 32).astype(np.float32)
+        lv, = exe.run(compiled, feed={"x": bx, "y": bx @ W},
+                      fetch_list=[loss])
+        if step % 25 == 0:
+            print(f"step {step:4d}  loss {float(np.asarray(lv)):.5f}")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

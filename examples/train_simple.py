@@ -1,0 +1,60 @@
+"""Minimal paddle_tpu training loop: build a program with layers.*,
+train via the whole-program-compiled executor, save + reload for
+inference.  Runs anywhere (forces CPU unless PADDLE_TPU_PLATFORM says
+otherwise).
+
+  python examples/train_simple.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  os.environ.get("PADDLE_TPU_PLATFORM", "cpu"))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+
+def main():
+    np.random.seed(0)
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    hidden = layers.fc(x, size=32, act="relu")
+    pred = layers.fc(hidden, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    compiled = fluid.CompiledProgram(fluid.default_main_program())
+
+    rng = np.random.RandomState(1)
+    W = rng.randn(13, 1).astype(np.float32)
+    for step in range(200):
+        bx = rng.rand(64, 13).astype(np.float32)
+        lv, = exe.run(compiled, feed={"x": bx, "y": bx @ W},
+                      fetch_list=[loss])
+        if step % 50 == 0:
+            print(f"step {step:4d}  loss {float(np.asarray(lv)):.5f}")
+
+    d = tempfile.mkdtemp(prefix="paddle_tpu_model_")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    from paddle_tpu.inference import Config, create_predictor
+
+    predictor = create_predictor(Config(d))
+    out, = predictor.run([rng.rand(4, 13).astype(np.float32)])
+    print("inference output shape:", np.asarray(out).shape)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""The examples/ scripts must keep running (they are the first thing a
+switching user tries)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(ROOT, "examples")
+
+
+@pytest.mark.parametrize("script,timeout", [
+    ("train_simple.py", 300),
+    ("train_data_parallel.py", 300),
+    ("ps_cluster.py", 420),
+])
+def test_example_runs(script, timeout):
+    env = {**os.environ, "PADDLE_TPU_PLATFORM": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, os.path.join(EX, script)],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-2000:])
+    assert "OK" in r.stdout or "done" in r.stdout, r.stdout[-1500:]
