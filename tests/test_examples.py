@@ -15,6 +15,7 @@ EX = os.path.join(ROOT, "examples")
     ("train_simple.py", 300),
     ("train_data_parallel.py", 300),
     ("ps_cluster.py", 420),
+    ("long_context_ring.py", 300),
 ])
 def test_example_runs(script, timeout):
     env = {**os.environ, "PADDLE_TPU_PLATFORM": "cpu"}
