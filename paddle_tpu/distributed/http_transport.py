@@ -93,26 +93,25 @@ class HTTPRPCClient(RPCClient):
     """Drop-in RPCClient over HTTP framing: per-endpoint keep-alive
     connection + lock, connect-retry like the socket client."""
 
-    def _get_conn(self, endpoint):
+    def _connect(self, endpoint):
         import time
 
-        with self._global_lock:
-            if endpoint not in self._conns:
-                host, port = endpoint.rsplit(":", 1)
-                conn = HTTPConnection(host or "127.0.0.1", int(port),
-                                      timeout=self._TIMEOUT)
-                deadline = time.monotonic() + self._TIMEOUT
-                while True:
-                    try:
-                        conn.connect()
-                        break
-                    except OSError:
-                        if time.monotonic() > deadline:
-                            raise
-                        time.sleep(0.2)
-                self._conns[endpoint] = conn
-                self._locks[endpoint] = threading.Lock()
-            return self._conns[endpoint], self._locks[endpoint]
+        host, port = endpoint.rsplit(":", 1)
+        conn = HTTPConnection(host or "127.0.0.1", int(port),
+                              timeout=self._TIMEOUT)
+        deadline = time.monotonic() + self._TIMEOUT
+        while True:
+            try:
+                conn.connect()
+                return conn
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    # _get_conn inherited from RPCClient: per-endpoint connect lock
+    # (one dead endpoint's retry never stalls the others); only
+    # _connect differs by framing
 
     def call(self, endpoint: str, msg_type: str, payload=None):
         import http.client as _hc
@@ -131,7 +130,8 @@ class HTTPRPCClient(RPCClient):
             # HTTPException covers IncompleteRead/BadStatusLine/
             # CannotSendRequest — a connection broken mid-response must
             # be evicted like the socket client does, or the endpoint
-            # stays wedged after a pserver restart
+            # stays wedged after a pserver restart (the per-endpoint
+            # lock object persists, matching RPCClient.call)
             with self._global_lock:
                 cached = self._conns.get(endpoint)
                 if cached is conn:
@@ -140,7 +140,6 @@ class HTTPRPCClient(RPCClient):
                     except OSError:
                         pass
                     del self._conns[endpoint]
-                    del self._locks[endpoint]
             raise
         if status == "error":
             raise RuntimeError(

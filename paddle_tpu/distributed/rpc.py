@@ -247,44 +247,68 @@ class RPCServer:
     # -- barrier support (reference rpc_server.h RegisterBarrier) -----------
     def barrier(self, name: str, count: int) -> int:
         """Blocks the calling handler until `count` parties arrived;
-        returns the arrival index (0..count-1) so one caller can be
-        elected to do post-barrier work.  Fixed-count convenience over
-        barrier_dynamic (one implementation, one release semantics)."""
+        returns 0 for exactly one of them (the leader, elected at
+        release) so one caller can do post-barrier work, and 1 for the
+        rest.  Fixed-count convenience over barrier_dynamic (one
+        implementation, one release semantics)."""
         return self.barrier_dynamic(name, lambda: count)
 
     def reset_barrier(self, name: str):
         with self._barrier_lock:
             self._dyn_barriers.pop(name, None)
 
-    def barrier_dynamic(self, name: str, count_fn, poll=0.25) -> int:
+    def barrier_dynamic(self, name: str, count_fn, poll=0.25,
+                        peer=None, alive_fn=None) -> int:
         """Like barrier(), but the required party count is re-evaluated
         every `poll` seconds — the survivor-continue primitive: when a
         trainer dies mid-step, count_fn (e.g. fanin - dead_trainers)
         drops and the remaining waiters release instead of deadlocking
         (reference rpc_server.h:48 barriers are fixed-count; the
-        reference cluster simply hangs on a dead trainer)."""
+        reference cluster simply hangs on a dead trainer).
+
+        peer/alive_fn: arrival identity + liveness predicate.  Only
+        LIVE arrivals satisfy the count — an arrival from a peer that
+        gets fenced while waiting must not release the barrier in place
+        of a live straggler.  Returns 0 for exactly one LIVE waiter per
+        generation (the leader, elected at release time — arrival order
+        can't elect, the first arriver might be fenced by then) and a
+        positive index for the rest."""
         with self._barrier_lock:
             b = self._dyn_barriers.get(name)
             if b is None:
                 b = self._dyn_barriers[name] = {
                     "cond": threading.Condition(),
-                    "arrived": 0, "gen": 0}
+                    "arrived": [], "gen": 0, "leader_taken": False}
         c = b["cond"]
+        token = object() if peer is None else str(peer)
         with c:
             gen = b["gen"]
-            idx = b["arrived"]
-            b["arrived"] += 1
+            b["arrived"].append(token)
             c.notify_all()
+
+            def live_count():
+                if alive_fn is None:
+                    return len(b["arrived"])
+                return sum(1 for p in b["arrived"]
+                           if not isinstance(p, str) or alive_fn(p))
+
             while b["gen"] == gen and \
-                    b["arrived"] < max(1, int(count_fn())):
+                    live_count() < max(1, int(count_fn())):
                 c.wait(poll)
+            me_alive = alive_fn is None or not isinstance(token, str) \
+                or alive_fn(token)
             if b["gen"] == gen:
                 # first waiter to observe completion advances the
                 # generation and releases everyone else
                 b["gen"] += 1
-                b["arrived"] = 0
+                b["arrived"] = []
+                b["leader_taken"] = False
                 c.notify_all()
-            return idx
+            if me_alive and not b["leader_taken"] and \
+                    b["gen"] == gen + 1:
+                b["leader_taken"] = True
+                return 0
+            return 1
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -364,28 +388,43 @@ class RPCClient:
         self._locks: dict = {}
         self._global_lock = threading.Lock()
 
-    def _get_conn(self, endpoint):
+    def _connect(self, endpoint):
+        """Blocking connect with retry (the server may not be up yet —
+        reference wait_server_ready polls the port the same way)."""
         import time
 
+        host, port = endpoint.rsplit(":", 1)
+        deadline = time.monotonic() + self._TIMEOUT
+        while True:
+            try:
+                s = socket.create_connection((host, int(port)),
+                                             timeout=self._TIMEOUT)
+                break
+            except (ConnectionRefusedError, OSError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        s.settimeout(self._TIMEOUT)
+        return s
+
+    def _get_conn(self, endpoint):
+        # connect-retry happens under the PER-ENDPOINT lock only: one
+        # dead endpoint retrying for up to _TIMEOUT must not stall this
+        # client's RPCs to every other (healthy) endpoint
         with self._global_lock:
-            if endpoint not in self._conns:
-                host, port = endpoint.rsplit(":", 1)
-                deadline = time.monotonic() + self._TIMEOUT
-                while True:
-                    # the server may not be up yet (reference
-                    # wait_server_ready polls the port the same way)
-                    try:
-                        s = socket.create_connection(
-                            (host, int(port)), timeout=self._TIMEOUT)
-                        break
-                    except (ConnectionRefusedError, OSError):
-                        if time.monotonic() > deadline:
-                            raise
-                        time.sleep(0.2)
-                s.settimeout(self._TIMEOUT)
-                self._conns[endpoint] = s
-                self._locks[endpoint] = threading.Lock()
-            return self._conns[endpoint], self._locks[endpoint]
+            conn = self._conns.get(endpoint)
+            lock = self._locks.setdefault(endpoint, threading.Lock())
+            if conn is not None:
+                return conn, lock
+        with lock:
+            with self._global_lock:
+                conn = self._conns.get(endpoint)
+                if conn is not None:
+                    return conn, lock
+            conn = self._connect(endpoint)
+            with self._global_lock:
+                self._conns[endpoint] = conn
+            return conn, lock
 
     def call(self, endpoint: str, msg_type: str, payload=None):
         conn, lock = self._get_conn(endpoint)
@@ -395,7 +434,9 @@ class RPCClient:
                 status, reply = _recv_msg(conn)
         except (ConnectionError, OSError):
             # evict the dead cached socket so the next call reconnects
-            # (e.g. a pserver restart in the elastic path)
+            # (e.g. a pserver restart in the elastic path); the
+            # per-endpoint lock object persists — recreating it would
+            # let a concurrent holder of the old lock race the new one
             with self._global_lock:
                 cached = self._conns.get(endpoint)
                 if cached is conn:
@@ -404,7 +445,6 @@ class RPCClient:
                     except OSError:
                         pass
                     del self._conns[endpoint]
-                    del self._locks[endpoint]
             raise
         if status == "error":
             raise RuntimeError(
@@ -435,8 +475,12 @@ class RPCClient:
         """Notify trainer completion (reference Executor::Close
         SendComplete).  peer_id lets the pserver retire this trainer
         from its liveness accounting instead of later declaring the
-        (now silent) trainer dead."""
-        stop_shared_heartbeats(endpoint=endpoint, peer_id=peer_id)
+        (now silent) trainer dead.  Only the COMPLETING peer's
+        heartbeat sender is stopped — with peer_id=None none are (a
+        co-hosted peer still training must keep beating); daemon
+        senders die with the process anyway."""
+        if peer_id is not None:
+            stop_shared_heartbeats(endpoint=endpoint, peer_id=peer_id)
         return self.call(endpoint, "complete", peer_id)
 
     def close(self):

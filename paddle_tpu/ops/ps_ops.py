@@ -293,10 +293,13 @@ def listen_and_serv_op(op, block, scope, ctx):
     def _dc_prime(sec, tid):
         if (sec, tid) in dc_primed:
             return
-        dc_primed.add((sec, tid))
         pv = scope.find_var(sec)
         if pv is not None and pv.get() is not None:
             scope.var(f"{sec}.bak.{tid}").set(pv.get())
+            # marked primed only on a REAL snapshot: an early grad
+            # before the init push lands must retry, or the backup
+            # stays zero and g + g*g*(w - 0) overcorrects forever
+            dc_primed.add((sec, tid))
 
     def _apply_sparse(gsec, rows, vals):
         scope.var(gsec + ".rows").set(jnp.asarray(rows))
@@ -306,9 +309,12 @@ def listen_and_serv_op(op, block, scope, ctx):
     def on_send_var(payload):
         name, val = payload[0], payload[1]
         tid = payload[2] if len(payload) > 2 else None
+        peer = None if tid is None else f"trainer{int(tid)}"
         with lock:
             if sync and name in grad_block_map:
-                buffers.setdefault(name, []).append(val)
+                # tagged with the sender so a peer fenced between push
+                # and merge can be excluded from the round
+                buffers.setdefault(name, []).append((peer, val))
             else:
                 scope.var(name).set(jnp.asarray(val))
                 if name in grad_block_map:   # async: apply on arrival
@@ -320,25 +326,39 @@ def listen_and_serv_op(op, block, scope, ctx):
                     ctx.run_block(grad_block_map[name], scope)
 
     def _fenced_peer(peer):
-        # a fenced-but-still-alive trainer's arrivals must not count
-        # toward (or block on) barriers: it was excluded from
-        # effective_fanin, so letting it join would release barriers
-        # early and desync the generations for the true survivors
+        # a fenced-but-still-alive trainer must not participate: it was
+        # excluded from effective_fanin, so letting it join would
+        # release barriers early and desync the true survivors
         if peer is None:
             return False
         with live_lock:
             return str(peer) in fenced
 
+    def _alive(peer_str):
+        with live_lock:
+            return peer_str not in fenced
+
+    def _reject_fenced(peer):
+        if _fenced_peer(peer):
+            # loud: a zombie trainer must crash, not free-run
+            # unsynchronized while its stale grads contaminate rounds
+            raise RuntimeError(
+                f"trainer '{peer}' was declared dead (missed "
+                "heartbeats) and is fenced from this cluster")
+
     def on_send_barrier(peer):
         if not sync:
             return
-        if _fenced_peer(peer):
-            return
-        idx = server.barrier_dynamic("send", effective_fanin)
-        if idx == 0:
+        _reject_fenced(peer)
+        lead = server.barrier_dynamic("send", effective_fanin,
+                                      peer=peer, alive_fn=_alive)
+        if lead == 0:
             with lock:
                 for gname, bidx in grad_blocks:
                     vals = buffers.pop(gname, None)
+                    if vals:  # drop entries a fenced peer pushed
+                        vals = [v for p, v in vals
+                                if p is None or _alive(p)]
                     if not vals:
                         continue
                     merged = vals[0] if len(vals) == 1 else \
@@ -358,7 +378,8 @@ def listen_and_serv_op(op, block, scope, ctx):
                         / float(max(len(parts), effective_fanin()))
                     if rows.size:
                         _apply_sparse(gsec, rows, vals2)
-        server.barrier_dynamic("send_done", effective_fanin)
+        server.barrier_dynamic("send_done", effective_fanin,
+                               peer=peer, alive_fn=_alive)
 
     def on_get_var(payload):
         name, tid = (payload, None) if isinstance(payload, str) \
@@ -399,8 +420,11 @@ def listen_and_serv_op(op, block, scope, ctx):
                 _apply_sparse(gsec, rows, vals)
 
     def on_fetch_barrier(peer):
-        if sync and not _fenced_peer(peer):
-            server.barrier_dynamic("fetch", effective_fanin)
+        if not sync:
+            return
+        _reject_fenced(peer)
+        server.barrier_dynamic("fetch", effective_fanin, peer=peer,
+                               alive_fn=_alive)
 
     def on_complete(peer):
         if peer is not None:

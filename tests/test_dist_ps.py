@@ -398,7 +398,7 @@ def test_communicator_async_updates_params():
             break
     comm.stop()
     from paddle_tpu.distributed.rpc import global_rpc_client
-    global_rpc_client().send_complete(ep)
+    global_rpc_client().send_complete(ep, peer_id="trainer0")
     server_thread.join(timeout=10)
     assert moved, (p0, cur)
 
@@ -444,7 +444,7 @@ def test_fleet_ps_mode_cluster():
         from paddle_tpu.distributed.rpc import global_rpc_client
         c = global_rpc_client()
         for ep in fleet.server_endpoints():
-            c.send_complete(ep)
+            c.send_complete(ep, peer_id="trainer%d" % fleet.worker_index())
         print("LOSSES " + json.dumps(losses))
     """)
     eps = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
