@@ -1,6 +1,7 @@
 """Program-rewriting transpilers (reference:
 python/paddle/fluid/transpiler/)."""
 
+from paddle_tpu.transpiler.details import wait_server_ready  # noqa: F401
 from paddle_tpu.transpiler.collective import (Collective,  # noqa: F401
                                               GradAllReduce, LocalSGD)
 from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401

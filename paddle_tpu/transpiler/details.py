@@ -1,0 +1,36 @@
+"""Transpiler utilities (reference
+python/paddle/fluid/transpiler/details/checkport.py wait_server_ready —
+the public helper launch scripts call before starting trainers)."""
+
+from __future__ import annotations
+
+import socket
+import time
+
+__all__ = ["wait_server_ready"]
+
+
+def wait_server_ready(endpoints, timeout=None, poll=0.5):
+    """Block until every endpoint accepts TCP connections (reference
+    checkport.py:21: connect_ex polling).  timeout=None waits forever,
+    matching the reference; otherwise raises TimeoutError listing the
+    endpoints that never came up."""
+    if isinstance(endpoints, str):
+        raise TypeError("endpoints must be a list, not a string")
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        not_ready = []
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            with socket.socket(socket.AF_INET,
+                               socket.SOCK_STREAM) as s:
+                s.settimeout(2.0)
+                if s.connect_ex((host or "127.0.0.1",
+                                 int(port))) != 0:
+                    not_ready.append(ep)
+        if not not_ready:
+            return
+        if deadline is not None and time.monotonic() > deadline:
+            raise TimeoutError(
+                f"servers never became ready: {not_ready}")
+        time.sleep(poll)

@@ -251,3 +251,29 @@ def test_fleet_wrapper_verbs_against_live_ps():
     finally:
         client.send_complete(ep, peer_id="trainer0")
         th.join(timeout=30)
+
+
+# -------------------------------------------------- wait_server_ready
+
+def test_wait_server_ready():
+    """reference transpiler/details/checkport.py wait_server_ready."""
+    import socket
+    import threading
+    import time
+
+    from paddle_tpu.transpiler import wait_server_ready
+
+    try:
+        wait_server_ready(["127.0.0.1:1"], timeout=1.0)
+    except TimeoutError as e:
+        assert "127.0.0.1:1" in str(e)
+    else:
+        raise AssertionError("dead endpoint not reported")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    threading.Timer(0.5, lambda: s.listen(1)).start()
+    t0 = time.monotonic()
+    wait_server_ready([f"127.0.0.1:{port}"], timeout=10)
+    assert time.monotonic() - t0 >= 0.4  # it actually waited
+    s.close()
