@@ -118,8 +118,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         o_ref[0, ...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
         # log-sum-exp per row, consumed by the backward kernels; for a
         # fully-masked row m=-inf and l was clamped to 1 -> lse=-inf,
-        # whose exp(s - lse) entries are all masked off in backward
-        lse_ref[0, :] = m_ref[:, 0] + jnp.log(l)
+        # whose exp(s - lse) entries are all masked off in backward.
+        # Stored lane-replicated ([bq, 128]): Mosaic requires the last
+        # two block dims to be (8k, 128m) or full — a [1, bq] block is
+        # rejected by the TPU lowering (caught on the first real-chip
+        # bench run; interpret-mode tests never enforce tiling).
+        lse = (m_ref[:, 0] + jnp.log(l))[:, None]
+        lse_ref[0, ...] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _pad_axis(x, axis, mult):
@@ -162,11 +167,13 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, bq, _MIN_LANES),
+                         lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq_p, _MIN_LANES),
+                                 jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -176,8 +183,9 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
         interpret=interpret,
         **params,
     )(qp, kp, vp)
-    return (out[:, :tq, :].reshape(b, h, tq, d),
-            lse.reshape(b * h, tq_p))
+    # strip the lane replication at the XLA boundary: callers see the
+    # documented [B*H, Tq_padded] lse
+    return (out[:, :tq, :].reshape(b, h, tq, d), lse[:, :, 0])
 
 
 # ---------------------------------------------------------------------------
@@ -234,7 +242,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
         do = do_ref[0].astype(jnp.float32)
         _, ds = _bwd_p_ds_block(
-            q, k, v, do, lse_ref[0], delta_ref[0], scale=scale,
+            q, k, v, do, lse_ref[0, :, 0], delta_ref[0, :, 0],
+            scale=scale,
             causal=causal, block_q=block_q, block_k=block_k,
             kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki)
         acc_ref[...] += lax.dot_general(
@@ -269,7 +278,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
         do = do_ref[0].astype(jnp.float32)
         p, ds = _bwd_p_ds_block(
-            q, k, v, do, lse_ref[0], delta_ref[0], scale=scale,
+            q, k, v, do, lse_ref[0, :, 0], delta_ref[0, :, 0],
+            scale=scale,
             causal=causal, block_q=block_q, block_k=block_k,
             kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki)
         dv_acc[...] += lax.dot_general(
@@ -314,6 +324,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
         delta_full = delta_full - dlse.reshape(b * h, -1)[:, :tq] \
             .astype(jnp.float32)
     delta = _pad_axis(delta_full, 1, bq)
+    # lane-replicate the per-row vectors: [B*H, Tq_p] -> [B*H, Tq_p, 128]
+    # (2-D [1, bq] blocks violate Mosaic's last-two-dims tiling rule;
+    # same layout the forward kernel emits for lse)
+    lse3 = jnp.broadcast_to(lse[:, :, None],
+                            (b * h, tq_p, _MIN_LANES))
+    delta3 = jnp.broadcast_to(delta[:, :, None],
+                              (b * h, tq_p, _MIN_LANES))
     q_off = tk - tq if causal else 0
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
                   kv_len=tk, q_len=tq, q_off=q_off)
@@ -323,7 +340,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
             dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     qspec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
-    lspec = pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i))
+    lspec = pl.BlockSpec((1, bq, _MIN_LANES),
+                         lambda bh, i, j: (bh, i, 0))
     kspec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
@@ -334,12 +352,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
         **params,
-    )(qp, kp, vp, gp, lse, delta)
+    )(qp, kp, vp, gp, lse3, delta3)
 
     # dkv grid: kv blocks outer, q blocks inner (accumulator carries
     # across the q sweep); block index maps swap i<->j roles
     qspec2 = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
-    lspec2 = pl.BlockSpec((1, bq), lambda bh, j, i: (bh, i))
+    lspec2 = pl.BlockSpec((1, bq, _MIN_LANES),
+                          lambda bh, j, i: (bh, i, 0))
     kspec2 = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
@@ -354,7 +373,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
         **params,
-    )(qp, kp, vp, gp, lse, delta)
+    )(qp, kp, vp, gp, lse3, delta3)
     return (dq[:, :tq, :].reshape(b, h, tq, d),
             dk[:, :tk, :].reshape(b, h, tk, d),
             dv[:, :tk, :].reshape(b, h, tk, d))

@@ -28,8 +28,19 @@ class Collective:
     def transpile(self, startup_program, main_program, rank, endpoints,
                   current_endpoint, wait_port=True):
         self.rank = rank
-        self.nranks = len(endpoints.split(",")) \
-            if isinstance(endpoints, str) else len(endpoints)
+        ep_list = (endpoints.split(",") if isinstance(endpoints, str)
+                   else list(endpoints))
+        self.nranks = len(ep_list)
+        # wait_port is accepted for reference-API parity but is a
+        # deliberate no-op here: reference trainers each run an
+        # endpoint server (gen_nccl_id) worth polling, whereas in this
+        # architecture nothing ever listens on peer *trainer*
+        # endpoints — c_comm_init/c_gen_nccl_id are no-ops
+        # (ops/collective.py) and the real rendezvous is
+        # jax.distributed.initialize, which itself blocks until the
+        # rank-0 coordinator is up.  Polling peers here would deadlock
+        # every real multi-rank run.
+        del wait_port
         self.startup_program = startup_program
         self.main_program = main_program
         self._transpile_startup_program()

@@ -22,11 +22,26 @@ def wait_server_ready(endpoints, timeout=None, poll=0.5):
         not_ready = []
         for ep in endpoints:
             host, port = ep.rsplit(":", 1)
+            # cap the per-socket wait by the remaining deadline so the
+            # total never overshoots timeout by 2s per dropped-packet
+            # endpoint
+            per_sock = 2.0
+            if deadline is not None:
+                per_sock = max(0.05,
+                               min(per_sock,
+                                   deadline - time.monotonic()))
             with socket.socket(socket.AF_INET,
                                socket.SOCK_STREAM) as s:
-                s.settimeout(2.0)
-                if s.connect_ex((host or "127.0.0.1",
-                                 int(port))) != 0:
+                s.settimeout(per_sock)
+                try:
+                    ok = s.connect_ex((host or "127.0.0.1",
+                                       int(port))) == 0
+                except OSError:
+                    # name not resolvable yet (e.g. a peer pod's DNS
+                    # record appears only once it is up) counts as
+                    # not-ready, not an error
+                    ok = False
+                if not ok:
                     not_ready.append(ep)
         if not not_ready:
             return

@@ -83,6 +83,13 @@ class DistributeTranspiler:
         return self
 
     def get_trainer_program(self, wait_port=True):
+        if wait_port and self.endpoints:
+            # reference distribute_transpiler.py blocks on the pserver
+            # ports here so a trainer never races its pservers into
+            # connection-refused at startup
+            from paddle_tpu.transpiler.details import wait_server_ready
+
+            wait_server_ready(self.endpoints)
         return self.trainer_program
 
     def get_trainer_startup_program(self):

@@ -306,3 +306,43 @@ def test_transformer_fused_vs_unfused():
         outs[fused] = float(loss)
     assert np.isfinite(outs[True])
     np.testing.assert_allclose(outs[True], outs[False], rtol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# Mosaic TPU lowering legality — interpret mode never enforces the
+# (8, 128) last-two-dims block tiling rule, so a kernel can pass every
+# CPU test and still be rejected by the real-chip lowering (this
+# exact failure shipped in round 4: a [1, bq] lse block spec crashed
+# the first on-TPU transformer bench).  jax.export cross-lowers for
+# the tpu platform on CPU, running the Mosaic block-mapping checks.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape,causal", [
+    ((32, 8, 512, 512, 64), True),    # transformer-base bench shape
+    ((8, 16, 512, 512, 64), False),   # bert-base bench shape
+    ((1, 2, 100, 100, 64), True),     # padding path
+    ((1, 1, 8, 136, 64), False),      # cross attention, tiny q
+])
+def test_flash_tpu_lowering_is_legal(shape, causal):
+    from jax import export
+
+    from paddle_tpu.ops.pallas_kernels import flash_attention_lse
+
+    b, h, tq, tk, d = shape
+    q = jnp.zeros((b, h, tq, d), jnp.bfloat16)
+    k = jnp.zeros((b, h, tk, d), jnp.bfloat16)
+    v = jnp.zeros((b, h, tk, d), jnp.bfloat16)
+
+    def step(q, k, v):
+        return jax.grad(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, impl="pallas")
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)
+
+    export.export(jax.jit(step), platforms=("tpu",))(q, k, v)
+
+    def step_lse(q, k, v):
+        return flash_attention_lse(q, k, v, causal=causal,
+                                   impl="pallas")
+
+    export.export(jax.jit(step_lse), platforms=("tpu",))(q, k, v)
