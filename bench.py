@@ -113,7 +113,11 @@ def _build_compiled_fn(compiled, feed, fetch_names):
     return fn, state
 
 
-def bench_resnet50_train(batch=128, chain=30):
+def _build_resnet50_train(batch=128):
+    """Build + init the ResNet-50 bench train step; returns
+    (fn, state, feed, loss_name).  Shared by the bench and
+    tools/tpu_lowering_check.py so the lowering gate checks exactly
+    the program the bench times."""
     import jax
     import jax.numpy as jnp
 
@@ -148,8 +152,12 @@ def bench_resnet50_train(batch=128, chain=30):
             rng.randint(0, 1000, (batch, 1)).astype(np.int64)),
     }
     fn, state = _build_compiled_fn(compiled, feed, [model["loss"].name])
-    sec_per_step, _ = _chain_timed(fn, state, feed, model["loss"].name,
-                                   chain)
+    return fn, state, feed, model["loss"].name
+
+
+def bench_resnet50_train(batch=128, chain=30):
+    fn, state, feed, loss_name = _build_resnet50_train(batch)
+    sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
     sps = batch / sec_per_step
     peak, kind = _chip_peak_flops()
     mfu = _resnet50_train_flops_per_image() * sps / peak
@@ -239,8 +247,9 @@ def bench_transformer_train(batch=32, seq=512, chain=30):
     }
 
 
-def bench_bert_train(batch=8, seq=512, chain=20):
-    """BASELINE workload 4: BERT-base pretraining seq-512 (MLM+NSP)."""
+def _build_bert_train(batch=8, seq=512):
+    """Build + init the BERT-base bench train step; returns
+    (fn, state, feed, loss_name) — shared with the lowering gate."""
     import jax
     import jax.numpy as jnp
 
@@ -266,8 +275,14 @@ def bench_bert_train(batch=8, seq=512, chain=20):
     feed = {k: jax.device_put(jnp.asarray(v))
             for k, v in bert_inputs_synthetic(batch, seq, vocab).items()}
     fn, state = _build_compiled_fn(compiled, feed, [model["loss"].name])
-    sec_per_step, _ = _chain_timed(fn, state, feed, model["loss"].name,
-                                   chain)
+    return fn, state, feed, model["loss"].name
+
+
+def bench_bert_train(batch=8, seq=512, chain=20):
+    """BASELINE workload 4: BERT-base pretraining seq-512 (MLM+NSP)."""
+    d_model, n_layer, d_inner, vocab = 768, 12, 3072, 30522
+    fn, state, feed, loss_name = _build_bert_train(batch, seq)
+    sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
     toks_per_sec = batch * seq / sec_per_step
     # embeddings + per-layer attn/FFN + the untied MLM decoder
     # projection (d_model*vocab) — same accounting as the transformer
@@ -286,8 +301,9 @@ def bench_bert_train(batch=8, seq=512, chain=20):
             "batch": batch, "seq": seq, "device": kind}
 
 
-def bench_deepfm_train(batch=2048, chain=30):
-    """BASELINE workload 5: DeepFM CTR (sparse lookup + dense DNN)."""
+def _build_deepfm_train(batch=2048):
+    """Build + init the DeepFM bench train step; returns
+    (fn, state, feed, loss_name) — shared with the lowering gate."""
     import jax
     import jax.numpy as jnp
 
@@ -312,15 +328,21 @@ def bench_deepfm_train(batch=2048, chain=30):
             rng.randint(0, 2, (batch, 1)).astype(np.int64))),
     }
     fn, state = _build_compiled_fn(compiled, feed, [model["loss"].name])
-    sec_per_step, _ = _chain_timed(fn, state, feed, model["loss"].name,
-                                   chain)
+    return fn, state, feed, model["loss"].name
+
+
+def bench_deepfm_train(batch=2048, chain=30):
+    """BASELINE workload 5: DeepFM CTR (sparse lookup + dense DNN)."""
+    fn, state, feed, loss_name = _build_deepfm_train(batch)
+    sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
     return {"examples_per_sec": round(batch / sec_per_step, 1),
             "step_ms": round(sec_per_step * 1e3, 3), "batch": batch}
 
 
-def _bench_infer(model_builder, feed_builder, fetch_key, chain):
-    """Shared bf16-inference bench: build through the IR, clone for test,
-    NHWC + bf16 transpile, compile, chain-timed run."""
+def _build_infer(model_builder, feed_builder, fetch_key):
+    """Shared bf16-inference build: build through the IR, clone for
+    test, NHWC + bf16 transpile, compile.  Returns
+    (fn, state, feed, fetch_name) — shared with the lowering gate."""
     import paddle_tpu as fluid
     from paddle_tpu import framework
     from paddle_tpu.contrib.float16 import bf16_transpile
@@ -338,8 +360,13 @@ def _bench_infer(model_builder, feed_builder, fetch_key, chain):
     feed = feed_builder()
     fn, state = _build_compiled_fn(compiled, feed,
                                    [model[fetch_key].name])
-    sec_per_step, _ = _chain_timed(fn, state, feed,
-                                   model[fetch_key].name, chain)
+    return fn, state, feed, model[fetch_key].name
+
+
+def _bench_infer(model_builder, feed_builder, fetch_key, chain):
+    fn, state, feed, fetch_name = _build_infer(model_builder,
+                                               feed_builder, fetch_key)
+    sec_per_step, _ = _chain_timed(fn, state, feed, fetch_name, chain)
     return sec_per_step
 
 
@@ -392,6 +419,18 @@ def bench_resnet50_infer_int8(batch=128, chain=100):
     inference/tests/api/int8_mkldnn_quantization.md): every conv/mul
     executes on int8 operands with int32 accumulation
     (convert_to_int8_execution), not dequantize-then-bf16."""
+    fn, state, feed, fetch_name, n_q = \
+        _build_resnet50_infer_int8(batch)
+    sec_per_step, _ = _chain_timed(fn, state, feed, fetch_name, chain)
+    return {"ms_per_batch": round(sec_per_step * 1e3, 3),
+            "batch": batch,
+            "n_int8_params": n_q}
+
+
+def _build_resnet50_infer_int8(batch=128):
+    """Build + init the true-int8 ResNet-50 inference path; returns
+    (fn, state, feed, fetch_name, n_int8_params) — shared with the
+    lowering gate."""
     import jax
     import jax.numpy as jnp
 
@@ -421,11 +460,7 @@ def bench_resnet50_infer_int8(batch=128, chain=100):
     }
     fn, state = _build_compiled_fn(compiled, feed,
                                    [model["logits"].name])
-    sec_per_step, _ = _chain_timed(fn, state, feed,
-                                   model["logits"].name, chain)
-    return {"ms_per_batch": round(sec_per_step * 1e3, 3),
-            "batch": batch,
-            "n_int8_params": len(qw)}
+    return fn, state, feed, model["logits"].name, len(qw)
 
 
 def _probe_device_once(timeout_s=180):

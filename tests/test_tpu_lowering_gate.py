@@ -1,0 +1,25 @@
+"""Full-model TPU cross-lowering gate (tools/tpu_lowering_check.py).
+
+The kernel-level legality tests in test_pallas_kernels.py check
+flash_attention in isolation; this checks the COMPLETE bench programs
+(IR build -> transpiles -> autodiff -> optimizer -> jit) cross-lowered
+for platform=tpu, i.e. exactly what bench.py will ask the chip to run.
+A fast subset runs here; tools/ci.sh runs the full sweep.
+"""
+
+import sys
+
+import pytest
+
+
+@pytest.mark.parametrize("workload", [
+    "transformer_train",       # the one that crashed on first chip run
+    "deepfm_train",
+    "resnet50_infer_int8",     # int8 dot_general path
+])
+def test_bench_workload_lowers_for_tpu(workload):
+    sys.path.insert(0, ".")
+    from tools.tpu_lowering_check import _workloads, check_workload
+
+    ok, detail, _ = check_workload(workload, _workloads()[workload])
+    assert ok, detail

@@ -4,17 +4,17 @@
 set -e
 cd "$(dirname "$0")/.."
 
-echo "== 1/5 test suite (virtual 8-device CPU mesh) =="
+echo "== 1/7 test suite (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
 
-echo "== 2/5 op inventory audit vs reference REGISTER_OPERATOR =="
+echo "== 2/7 op inventory audit vs reference REGISTER_OPERATOR =="
 JAX_PLATFORMS=cpu python tools/op_coverage.py
 
-echo "== 3/5 API stability gate =="
+echo "== 3/7 API stability gate =="
 JAX_PLATFORMS=cpu python tools/print_signatures.py paddle_tpu > /tmp/_api_now.spec
 python tools/diff_api.py API.spec /tmp/_api_now.spec
 
-echo "== 4/5 multichip dry-run (8 virtual devices) =="
+echo "== 4/7 multichip dry-run (8 virtual devices) =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 PADDLE_TPU_TEST_PLATFORM=cpu python -c "
 import os; os.environ['JAX_PLATFORMS']='cpu'
@@ -22,16 +22,21 @@ import jax; jax.config.update('jax_platforms','cpu')
 import __graft_entry__ as ge; ge.dryrun_multichip(8)
 print('dryrun_multichip(8) OK')"
 
-echo "== 5/6 benchmark (real chip if attached; tiny CPU run otherwise) =="
+echo "== 5/7 benchmark (real chip if attached; tiny CPU run otherwise) =="
 # CI keeps the TPU probe short; the 15-min retry budget is for real
 # bench rounds (driver invocation), not the validation matrix.
 BENCH_PROBE_BUDGET_S="${BENCH_PROBE_BUDGET_S:-120}" python bench.py
 
-echo "== 6/6 per-op regression gate (hot ops vs committed CPU baseline) =="
+echo "== 6/7 per-op regression gate (hot ops vs committed CPU baseline) =="
 # 3x tolerance absorbs machine load; catches order-of-magnitude
 # per-op regressions (reference op_tester role) before they surface
 # in a model bench
 python tools/op_bench.py --cpu --suite tools/op_bench_suite.json \
   --baseline tools/op_bench_baseline_cpu.json --tolerance 3.0
+
+echo "== 7/7 TPU cross-lowering gate (Mosaic legality without a chip) =="
+# interpret-mode tests never run Mosaic's block-mapping checks; this
+# cross-lowers every bench workload for platform=tpu on the CPU
+python tools/tpu_lowering_check.py
 
 echo "ALL CHECKS PASSED"
