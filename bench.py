@@ -247,6 +247,11 @@ def bench_transformer_train(batch=32, seq=512, chain=30):
     }
 
 
+# BERT-base config shared by the builder and the FLOPs accounting (one
+# source of truth, like TRANSFORMER_BASE)
+BERT_BASE = dict(d_model=768, n_layer=12, d_inner=3072, vocab=30522)
+
+
 def _build_bert_train(batch=8, seq=512):
     """Build + init the BERT-base bench train step; returns
     (fn, state, feed, loss_name) — shared with the lowering gate."""
@@ -260,7 +265,9 @@ def _build_bert_train(batch=8, seq=512):
     _fresh_programs()
     from paddle_tpu.contrib.mixed_precision import decorate
 
-    d_model, n_layer, d_inner, vocab = 768, 12, 3072, 30522
+    c = BERT_BASE
+    d_model, n_layer, d_inner, vocab = (c["d_model"], c["n_layer"],
+                                        c["d_inner"], c["vocab"])
     model = bert_model(vocab_size=vocab, max_len=seq, d_model=d_model,
                        n_head=12, d_inner=d_inner, n_layer=n_layer,
                        dropout_rate=0.0)
@@ -280,7 +287,9 @@ def _build_bert_train(batch=8, seq=512):
 
 def bench_bert_train(batch=8, seq=512, chain=20):
     """BASELINE workload 4: BERT-base pretraining seq-512 (MLM+NSP)."""
-    d_model, n_layer, d_inner, vocab = 768, 12, 3072, 30522
+    c = BERT_BASE
+    d_model, n_layer, d_inner, vocab = (c["d_model"], c["n_layer"],
+                                        c["d_inner"], c["vocab"])
     fn, state, feed, loss_name = _build_bert_train(batch, seq)
     sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
     toks_per_sec = batch * seq / sec_per_step
@@ -529,98 +538,216 @@ def _probe_device(budget_s=900):
         time.sleep(min(backoff, remaining - 5))
 
 
+# ---------------------------------------------------------------------------
+# Main: one subprocess per leg so a tunnel wedge mid-ladder loses that
+# LEG, not the whole run (on 2026-07-31 the tunnel was alive for
+# exactly one leg before wedging again — an in-process ladder returned
+# nothing).  Between legs a quick re-probe detects a died tunnel and
+# degrades only the REMAINING legs to tiny CPU shapes.
+# ---------------------------------------------------------------------------
+
+_LEG_FUNCS = {
+    "rn_train": "bench_resnet50_train",
+    "tf_train": "bench_transformer_train",
+    "bert_train": "bench_bert_train",
+    "dfm_train": "bench_deepfm_train",
+    "infer": "bench_resnet50_infer",
+    "infer_i8": "bench_resnet50_infer_int8",
+    "vgg_infer": "bench_vgg16_infer",
+}
+
+# full-size models at full chains would take hours on CPU — shrink
+# every degraded leg to keep the run bounded (~2 min total, measured)
+_TINY = {
+    "rn_train": dict(batch=8, chain=2),
+    "tf_train": dict(batch=2, seq=128, chain=2),
+    "bert_train": dict(batch=1, seq=128, chain=1),
+    "dfm_train": dict(batch=256, chain=3),
+    "infer": dict(batch=8, chain=3),
+    # int8 convs are EMULATED on the CPU backend (~50x slower than
+    # fp32 — see tools/op_bench_baseline_cpu.json); keep the
+    # degraded run bounded with the smallest honest shape
+    "infer_i8": dict(batch=2, chain=1),
+    "vgg_infer": dict(batch=4, chain=2),
+}
+
+# generous per-leg wall budgets: first compile over the tunnel takes
+# minutes; a wedge mid-leg costs at most this before the ladder
+# continues degraded
+_LEG_TIMEOUT_TPU_S = 1800
+_LEG_TIMEOUT_CPU_S = 900
+
+
+def _run_leg_child(leg, kwargs, cpu):
+    """Entry for `bench.py --leg`: run one bench leg, print its dict as
+    the last stdout line."""
+    if cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    res = globals()[_LEG_FUNCS[leg]](**kwargs)
+    print("LEGRESULT " + json.dumps(res))
+
+
+def _run_leg(leg, kwargs, cpu, timeout_s):
+    """Run one leg in a subprocess; returns (result_dict | None,
+    detail)."""
+    import subprocess
+    import sys
+
+    cmd = [sys.executable, __file__, "--leg", leg,
+           "--kwargs", json.dumps(kwargs)]
+    if cpu:
+        cmd.append("--cpu")
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, "timeout>%ds" % timeout_s
+    if out.returncode != 0:
+        return None, "exit=%d %s" % (out.returncode,
+                                     (out.stderr or "")[-300:].strip())
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("LEGRESULT "):
+            return json.loads(line[len("LEGRESULT "):]), "ok"
+    return None, "no LEGRESULT in output"
+
+
 def main():
     import os
+    import sys
 
     budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", "900"))
     platform, probe_history = _probe_device(budget_s=budget)
     degraded = platform is None or platform == "cpu"
     if degraded:
-        import sys
-
         print("WARNING: no accelerator (probe timed out or CPU-only "
               "backend) — benching on CPU with TINY shapes so the run "
               "finishes; numbers are NOT representative of TPU "
               "performance", file=sys.stderr)
-        import jax
 
-        if platform is None:
-            jax.config.update("jax_platforms", "cpu")
-    # full-size models at full chains would take hours on CPU — shrink
-    # every bench to keep the run bounded (~2 min total, measured)
-    tiny = {
-        "rn_train": dict(batch=8, chain=2),
-        "tf_train": dict(batch=2, seq=128, chain=2),
-        "bert_train": dict(batch=1, seq=128, chain=1),
-        "dfm_train": dict(batch=256, chain=3),
-        "infer": dict(batch=8, chain=3),
-        # int8 convs are EMULATED on the CPU backend (~50x slower than
-        # fp32 — see tools/op_bench_baseline_cpu.json); keep the
-        # degraded run bounded with the smallest honest shape
-        "infer_i8": dict(batch=2, chain=1),
-        "vgg_infer": dict(batch=4, chain=2),
-    } if degraded else {}
-    rn_train = bench_resnet50_train(**tiny.get("rn_train", {}))
-    tf_train = bench_transformer_train(**tiny.get("tf_train", {}))
-    bert_train = bench_bert_train(**tiny.get("bert_train", {}))
-    dfm_train = bench_deepfm_train(**tiny.get("dfm_train", {}))
-    infer = bench_resnet50_infer(**tiny.get("infer", {}))
-    infer_i8 = bench_resnet50_infer_int8(**tiny.get("infer_i8", {}))
-    vgg_infer = bench_vgg16_infer(**tiny.get("vgg_infer", {}))
-    headline = rn_train["mfu_pct"]
-    # vs-V100 ratios are only honest at the baseline's batch sizes on a
-    # real chip; degraded runs report None there
-    unit = "% of chip peak (bf16)"
-    if degraded:
-        unit += " [DEGRADED: tiny-shape CPU run]"
+    results, details = {}, {}
+    for i, leg in enumerate(_LEG_FUNCS):
+        if not degraded and i > 0:
+            # cheap liveness check so a tunnel that died during the
+            # previous leg doesn't cost a full timeout per later leg
+            alive, why = _probe_device_once(timeout_s=120)
+            if alive is None or alive == "cpu":
+                print("tunnel lost mid-ladder (%s) — remaining legs "
+                      "degrade to tiny CPU shapes" % why,
+                      file=sys.stderr)
+                probe_history.append({"mid_ladder_probe": why,
+                                      "before_leg": leg})
+                degraded = True
+        leg_cpu = degraded
+        kwargs = _TINY[leg] if leg_cpu else {}
+        res, detail = _run_leg(
+            leg, kwargs, leg_cpu,
+            _LEG_TIMEOUT_CPU_S if leg_cpu else _LEG_TIMEOUT_TPU_S)
+        if res is None and not leg_cpu:
+            # the leg (not the probe) hit the wedge: degrade from here
+            print("leg %s failed on chip (%s) — degrading remaining "
+                  "legs" % (leg, detail), file=sys.stderr)
+            degraded = leg_cpu = True
+            kwargs = _TINY[leg]
+            res, detail = _run_leg(leg, kwargs, True,
+                                   _LEG_TIMEOUT_CPU_S)
+        if res is not None:
+            res["degraded"] = leg_cpu
+        results[leg] = res
+        details[leg] = detail
+        print("leg %-10s %s %s" % (
+            leg, "DEGRADED" if leg_cpu else "chip",
+            json.dumps(res) if res else detail), file=sys.stderr)
 
-    def key(base, **shape):
-        # Degraded runs shrink the workload; the metric key must say so
+    def key(base, leg, **shape):
+        # Degraded legs shrink the workload; the metric key must say so
         # (a dashboard diffing rounds by key must never compare a
         # seq-128 run against a seq-512 one under the same name).  The
         # full-size shape baked into the base name is stripped first so
-        # the degraded key states exactly one shape.
-        if not degraded:
+        # the degraded key states exactly one shape.  `shape` maps tag
+        # name -> result-dict field, e.g. mb="batch" tags "mb8".
+        r = results[leg]
+        if r is None or not r.get("degraded"):
             return base
         import re
 
         base = re.sub(r"_(?:mb|seq)\d+", "", base)
-        tag = "_".join("%s%s" % (k, v) for k, v in shape.items())
+        tag = "_".join("%s%s" % (t, r[f]) for t, f in shape.items()
+                       if f in r)
         return "%s_DEGRADED_%s" % (base, tag) if tag else \
             "%s_DEGRADED" % base
 
+    rn = results["rn_train"]
+    headline = rn["mfu_pct"] if rn else 0.0
+    headline_degraded = rn.get("degraded", True) if rn else True
+    unit = "% of chip peak (bf16)"
+    if headline_degraded:
+        unit += " [DEGRADED: tiny-shape CPU run]"
+
+    def infer_row(leg, baseline_ms):
+        r = results[leg]
+        if r is None:
+            return {"error": details[leg]}
+        row = dict(r)
+        row["vs_v100_fp16_baseline"] = None if r.get("degraded") else \
+            round(baseline_ms / r["ms_per_batch"], 3)
+        return row
+
+    def row(leg):
+        return results[leg] if results[leg] is not None else \
+            {"error": details[leg]}
+
+    extras = {
+        key("resnet50_train", "rn_train", mb="batch"): row("rn_train"),
+        key("transformer_base_train", "tf_train", mb="batch", seq="seq"):
+            row("tf_train"),
+        key("bert_base_train_seq512", "bert_train", mb="batch", seq="seq"):
+            row("bert_train"),
+        key("deepfm_ctr_train", "dfm_train", mb="batch"): row("dfm_train"),
+        key("resnet50_infer_bf16_mb128", "infer", mb="batch"):
+            infer_row("infer", BASELINE_INFER_MS),
+        key("resnet50_infer_int8_mb128", "infer_i8", mb="batch"):
+            row("infer_i8"),
+        key("vgg16_infer_bf16_mb64", "vgg_infer", mb="batch"):
+            infer_row("vgg_infer", BASELINE_VGG16_MB64_MS),
+    }
+    metric = key("resnet50_bf16_train_mfu_pct_mb128", "rn_train",
+                 mb="batch")
+    if rn is None:
+        # never report a real-looking 0.0 under the full-shape key
+        metric = "resnet50_bf16_train_mfu_pct_ERROR"
     print(json.dumps({
-        "metric": key("resnet50_bf16_train_mfu_pct_mb128",
-                      mb=rn_train["batch"]),
+        "metric": metric,
         "value": headline,
         "unit": unit,
         # >=1.0 means the 50%-MFU north star is met
         "vs_baseline": round(headline / (100 * MFU_TARGET), 4),
-        "degraded_to_cpu": degraded,
+        "degraded_to_cpu": headline_degraded,
         "probe_history": probe_history,
-        "extras": {
-            key("resnet50_train", mb=rn_train["batch"]): rn_train,
-            key("transformer_base_train", mb=tf_train["batch"],
-                seq=tf_train["seq"]): tf_train,
-            key("bert_base_train_seq512", mb=bert_train["batch"],
-                seq=bert_train["seq"]): bert_train,
-            key("deepfm_ctr_train", mb=dfm_train["batch"]): dfm_train,
-            key("resnet50_infer_bf16_mb128", mb=infer["batch"]): {
-                **infer,
-                "vs_v100_fp16_baseline": None if degraded else round(
-                    BASELINE_INFER_MS / infer["ms_per_batch"], 3),
-            },
-            key("resnet50_infer_int8_mb128",
-                mb=infer_i8["batch"]): infer_i8,
-            key("vgg16_infer_bf16_mb64", mb=vgg_infer["batch"]): {
-                **vgg_infer,
-                "vs_v100_fp16_baseline": None if degraded else round(
-                    BASELINE_VGG16_MB64_MS / vgg_infer["ms_per_batch"],
-                    3),
-            },
-        },
+        "extras": extras,
     }))
+    # a leg that failed even after the degraded retry is a real
+    # regression (env trouble alone degrades, it doesn't error):
+    # propagate it so ci.sh (set -e) fails
+    failed = [leg for leg, r in results.items() if r is None]
+    if failed:
+        print("FAILED legs: %s" % failed, file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--leg", choices=sorted(_LEG_FUNCS))
+    ap.add_argument("--kwargs", default="{}")
+    ap.add_argument("--cpu", action="store_true")
+    a = ap.parse_args()
+    if a.leg:
+        _run_leg_child(a.leg, json.loads(a.kwargs), a.cpu)
+    else:
+        import sys
+
+        sys.exit(main())

@@ -7,9 +7,12 @@ for platform=tpu, i.e. exactly what bench.py will ask the chip to run.
 A fast subset runs here; tools/ci.sh runs the full sweep.
 """
 
+import pathlib
 import sys
 
 import pytest
+
+_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
 
 
 @pytest.mark.parametrize("workload", [
@@ -18,7 +21,8 @@ import pytest
     "resnet50_infer_int8",     # int8 dot_general path
 ])
 def test_bench_workload_lowers_for_tpu(workload):
-    sys.path.insert(0, ".")
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
     from tools.tpu_lowering_check import _workloads, check_workload
 
     ok, detail, _ = check_workload(workload, _workloads()[workload])
