@@ -27,3 +27,33 @@ def test_bench_workload_lowers_for_tpu(workload):
 
     ok, detail, _ = check_workload(workload, _workloads()[workload])
     assert ok, detail
+
+
+@pytest.mark.parametrize("which,causal", [
+    ("ring", False), ("ring", True),
+    ("ulysses", False), ("ulysses", True),
+])
+def test_sequence_parallel_flash_lowers_for_tpu(which, causal):
+    """The sp paths run the Pallas kernel on PER-CHUNK shapes inside
+    shard_map — different block shapes than the single-chip bench, so
+    they get their own Mosaic legality check (AbstractMesh lets us
+    lower for an 8-device TPU mesh from the CPU)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export
+    from jax.sharding import AbstractMesh
+
+    from paddle_tpu.parallel.ring_attention import ring_attention
+    from paddle_tpu.parallel.ulysses import ulysses_attention
+
+    fn = ring_attention if which == "ring" else ulysses_attention
+    mesh = AbstractMesh((8,), ("sp",))
+    q = jnp.zeros((2, 4096, 8, 64), jnp.bfloat16)
+
+    def step(q, k, v):
+        def loss(q, k, v):
+            return fn(q, k, v, mesh=mesh, axis="sp", causal=causal,
+                      impl="flash").astype(jnp.float32).sum()
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    export.export(jax.jit(step), platforms=("tpu",))(q, q, q)
