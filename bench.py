@@ -538,6 +538,68 @@ def _probe_device(budget_s=900):
         time.sleep(min(backoff, remaining - 5))
 
 
+def _build_longctx_train(batch=1, heads=8, seq=32768, head_dim=64):
+    """Build the long-context attention step: flash fwd+bwd at 64x the
+    reference's sequence ceiling (BERT seq-512, SURVEY §5 long-context
+    row).  Unfused attention at seq 32k materializes an ~34 GB fp32
+    score matrix (8 heads x 32768^2 x 4 B) — over twice the chip's
+    16 GB HBM before backward even starts; this workload exists
+    because the Pallas kernel keeps scores in VMEM.  Returns
+    (fn, state, feed, fetches)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import backward, framework, layers
+
+    _fresh_programs()
+    qkv = []
+    for n in "qkv":
+        x = layers.data(n, shape=[heads, seq, head_dim],
+                        dtype="bfloat16")
+        x.stop_gradient = False
+        qkv.append(x)
+    out = layers.flash_attention(*qkv, causal=True)
+    loss = layers.reduce_sum(layers.cast(out, "float32"))
+    backward.append_backward(loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(framework.default_main_program())
+    rng = np.random.RandomState(0)
+    feed = {n: jax.device_put(jnp.asarray(
+        rng.randn(batch, heads, seq, head_dim).astype(np.float32),
+        jnp.bfloat16)) for n in "qkv"}
+    # fetching the grads keeps the backward kernels live (no params
+    # here; grads flow to the data vars)
+    fetches = [loss.name, "q@GRAD", "k@GRAD", "v@GRAD"]
+    fn, state = _build_compiled_fn(compiled, feed, fetches)
+    return fn, state, feed, fetches
+
+
+def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
+                        chain=10):
+    """Long-context attention: tokens/sec + kernel MFU for causal
+    flash attention fwd+bwd at seq 32k on one chip."""
+    fn, state, feed, fetches = _build_longctx_train(batch, heads, seq,
+                                                    head_dim)
+    sec_per_step, _ = _chain_timed(fn, state, feed, fetches[0], chain)
+    toks_per_sec = batch * seq / sec_per_step
+    peak, kind = _chip_peak_flops()
+    # causal fwd = 2*B*H*T^2*D (half the full 4BHT^2D); train = 3x fwd.
+    # The kernel actually recomputes scores in backward (7 matmuls vs
+    # the standard 5) but recompute earns no MFU credit, same rule as
+    # the model benches.
+    flops = 3 * 2.0 * batch * heads * float(seq) ** 2 * head_dim
+    mfu = flops / sec_per_step / peak
+    return {
+        "tokens_per_sec": round(toks_per_sec, 1),
+        "step_ms": round(sec_per_step * 1e3, 3),
+        "mfu_pct": round(100 * mfu, 2),
+        "batch": batch, "seq": seq, "heads": heads,
+        "device": kind,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Main: one subprocess per leg so a tunnel wedge mid-ladder loses that
 # LEG, not the whole run (on 2026-07-31 the tunnel was alive for
@@ -554,6 +616,7 @@ _LEG_FUNCS = {
     "infer": "bench_resnet50_infer",
     "infer_i8": "bench_resnet50_infer_int8",
     "vgg_infer": "bench_vgg16_infer",
+    "longctx": "bench_longctx_train",
 }
 
 # full-size models at full chains would take hours on CPU — shrink
@@ -569,6 +632,10 @@ _TINY = {
     # degraded run bounded with the smallest honest shape
     "infer_i8": dict(batch=2, chain=1),
     "vgg_infer": dict(batch=4, chain=2),
+    # the degraded CPU leg runs plain XLA attention (impl auto-detect
+    # picks "xla" off-TPU) — it checks ladder liveness, not the
+    # kernel; its metric key drops the "flash" claim accordingly
+    "longctx": dict(batch=1, heads=2, seq=512, chain=1),
 }
 
 # generous per-leg wall budgets: first compile over the tunnel takes
@@ -711,6 +778,12 @@ def main():
             row("infer_i8"),
         key("vgg16_infer_bf16_mb64", "vgg_infer", mb="batch"):
             infer_row("vgg_infer", BASELINE_VGG16_MB64_MS),
+        # degraded CPU legs time plain XLA attention (auto-detect picks
+        # "xla" off-TPU), so the degraded key must not claim "flash"
+        key("longctx_flash_train_seq32768"
+            if not (results["longctx"] or {}).get("degraded")
+            else "longctx_attention_train_seq32768",
+            "longctx", mb="batch", seq="seq"): row("longctx"),
     }
     metric = key("resnet50_bf16_train_mfu_pct_mb128", "rn_train",
                  mb="batch")

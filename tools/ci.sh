@@ -40,6 +40,6 @@ echo "== 7/7 TPU cross-lowering gate (Mosaic legality without a chip) =="
 # (step 1) already lowers transformer/deepfm/int8 via
 # tests/test_tpu_lowering_gate.py, so only the rest run here.
 python tools/tpu_lowering_check.py \
-  resnet50_train bert_train resnet50_infer vgg16_infer
+  resnet50_train bert_train resnet50_infer vgg16_infer longctx_train
 
 echo "ALL CHECKS PASSED"

@@ -52,6 +52,7 @@ def _workloads():
             bench._build_resnet50_infer_int8(128)[:3],
         "resnet50_infer": lambda: _infer(bench, "resnet", 128),
         "vgg16_infer": lambda: _infer(bench, "vgg", 64),
+        "longctx_train": lambda: bench._build_longctx_train()[:3],
     }
 
 
