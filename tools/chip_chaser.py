@@ -1,0 +1,138 @@
+"""Chase an intermittent TPU tunnel: probe until it comes back, then
+drain a queue of single bench legs / sweeps, logging every result.
+
+The axon tunnel wedges for hours and recovers without notice (round-3
+and round-4 probe histories).  Sitting a human — or a builder session —
+on a polling loop wastes the window when it opens; this script owns the
+loop instead.  Each task runs in its own subprocess (`bench.py --leg`
+protocol) so a wedge mid-task costs that task only, and every outcome
+(including crashes: full stderr tail) is appended as one JSON line to
+the results file for later triage.
+
+Usage:
+    python tools/chip_chaser.py [--results PATH] [--once]
+
+Tasks are ordered most-valuable-first so a short window still yields
+the missing evidence; int8 goes last because its compile wedged the
+tunnel on 2026-07-31.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+# (name, leg, kwargs) — kwargs {} means the leg's full default shape
+TASKS = [
+    ("vgg16_infer", "vgg_infer", {}),
+    ("longctx_flash_seq32768", "longctx", {}),
+    ("rn_train_mb256", "rn_train", {"batch": 256, "chain": 20}),
+    ("tf_train_mb64", "tf_train", {"batch": 64, "chain": 20}),
+    ("tf_train_mb128", "tf_train", {"batch": 128, "chain": 10}),
+    ("bert_train_mb16", "bert_train", {"batch": 16, "chain": 10}),
+    ("rn_train_mb512", "rn_train", {"batch": 512, "chain": 10}),
+    ("int8_diagnosis", "infer_i8", {"batch": 128, "chain": 20}),
+]
+
+
+def probe(timeout_s=120):
+    code = ("import jax; d = jax.devices()[0]; "
+            "print('PROBE', d.platform, '|', d.device_kind)")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("PROBE "):
+            return line[len("PROBE "):]
+    return None
+
+
+def run_task(name, leg, kwargs, timeout_s=2400):
+    cmd = [sys.executable, BENCH, "--leg", leg,
+           "--kwargs", json.dumps(kwargs)]
+    t0 = time.time()
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"task": name, "ok": False, "took_s": round(
+            time.time() - t0, 1), "error": "timeout>%ds" % timeout_s}
+    rec = {"task": name, "leg": leg, "kwargs": kwargs,
+           "took_s": round(time.time() - t0, 1)}
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("LEGRESULT "):
+            rec.update(ok=True, result=json.loads(line[10:]))
+            return rec
+    rec.update(ok=False, error="exit=%d" % out.returncode,
+               stderr_tail=(out.stderr or "")[-2000:])
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--results",
+                    default="/tmp/chip_chaser_results.jsonl")
+    ap.add_argument("--probe-interval", type=float, default=240.0)
+    ap.add_argument("--once", action="store_true",
+                    help="exit after one pass over the queue")
+    args = ap.parse_args()
+
+    done, fails = set(), {}
+    if os.path.exists(args.results):
+        with open(args.results) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("ok"):
+                    done.add(rec["task"])
+                else:
+                    fails[rec.get("task")] = fails.get(
+                        rec.get("task"), 0) + 1
+
+    def log(rec):
+        with open(args.results, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec)[:300], flush=True)
+
+    while True:
+        # 3 strikes per task: a deterministic crasher (int8 on
+        # 2026-07-31) must not starve the rest of the queue
+        todo = [t for t in TASKS
+                if t[0] not in done and fails.get(t[0], 0) < 3]
+        if not todo:
+            print("all tasks complete", flush=True)
+            return 0
+        kind = probe()
+        if kind is None or kind.startswith("cpu"):
+            print("probe: tunnel down (%s); sleeping %.0fs — %d tasks "
+                  "pending" % (kind, args.probe_interval, len(todo)),
+                  flush=True)
+            time.sleep(args.probe_interval)
+            continue
+        name, leg, kwargs = todo[0]
+        print("tunnel UP (%s) — running %s" % (kind, name), flush=True)
+        rec = run_task(name, leg, kwargs)
+        log(rec)
+        if rec.get("ok"):
+            done.add(name)
+        else:
+            fails[name] = fails.get(name, 0) + 1
+            if args.once:
+                return 1
+        # a failed task re-queues; re-probe decides whether the tunnel
+        # died or the task itself is broken (int8 stays last either way)
+        if args.once and not todo[1:]:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
