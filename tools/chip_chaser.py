@@ -30,11 +30,24 @@ BENCH = os.path.join(REPO, "bench.py")
 TASKS = [
     ("vgg16_infer", "vgg_infer", {}),
     ("longctx_flash_seq32768", "longctx", {}),
+    # mb=1 latency anchors — the reference's float16_benchmark.md
+    # headline table is mb=1/mb=64/mb=128; BASELINE.md carries the
+    # mb=1 rows (rn50 fp16 6.13 ms, vgg16 fp16 3.32 ms on V100)
+    ("rn50_infer_mb1", "infer", {"batch": 1, "chain": 200}),
+    ("vgg16_infer_mb1", "vgg_infer", {"batch": 1, "chain": 200}),
     ("rn_train_mb256", "rn_train", {"batch": 256, "chain": 20}),
     ("tf_train_mb64", "tf_train", {"batch": 64, "chain": 20}),
     ("tf_train_mb128", "tf_train", {"batch": 128, "chain": 10}),
     ("bert_train_mb16", "bert_train", {"batch": 16, "chain": 10}),
     ("rn_train_mb512", "rn_train", {"batch": 512, "chain": 10}),
+    # "script:" tasks run a standalone tool instead of a bench leg;
+    # the primitive probe separates "int8 lowering is broken" from
+    # "the tunnel window closed" before the full leg re-runs
+    # risk-free capture BEFORE anything that compiles int8: the suite
+    # snapshot only needs a live chip, the int8 probes may wedge it
+    ("op_bench_tpu_snapshot",
+     "script:tools/op_bench_tpu_snapshot.py", {}),
+    ("int8_primitive_probe", "script:tools/int8_probe.py", {}),
     ("int8_diagnosis", "infer_i8", {"batch": 128, "chain": 20}),
 ]
 
@@ -55,8 +68,12 @@ def probe(timeout_s=120):
 
 
 def run_task(name, leg, kwargs, timeout_s=2400):
-    cmd = [sys.executable, BENCH, "--leg", leg,
-           "--kwargs", json.dumps(kwargs)]
+    if leg.startswith("script:"):
+        cmd = [sys.executable, os.path.join(REPO, leg[len("script:"):])]
+        timeout_s = 600
+    else:
+        cmd = [sys.executable, BENCH, "--leg", leg,
+               "--kwargs", json.dumps(kwargs)]
     t0 = time.time()
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
@@ -66,6 +83,12 @@ def run_task(name, leg, kwargs, timeout_s=2400):
             time.time() - t0, 1), "error": "timeout>%ds" % timeout_s}
     rec = {"task": name, "leg": leg, "kwargs": kwargs,
            "took_s": round(time.time() - t0, 1)}
+    if leg.startswith("script:"):
+        rec.update(ok=out.returncode == 0,
+                   stdout_tail=(out.stdout or "")[-2000:])
+        if out.returncode != 0:
+            rec["stderr_tail"] = (out.stderr or "")[-2000:]
+        return rec
     for line in reversed(out.stdout.splitlines()):
         if line.startswith("LEGRESULT "):
             rec.update(ok=True, result=json.loads(line[10:]))

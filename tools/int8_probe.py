@@ -1,0 +1,69 @@
+"""Minimal on-chip int8 repro: decide in <2 min whether the 2026-07-31
+bench int8-leg crash (backend UNAVAILABLE mid-device_put, 25 min into
+the leg) was an int8 lowering problem or just the tunnel window
+closing.
+
+Runs three escalating probes, each its own jit, printing PROBE-OK /
+PROBE-FAIL per stage with timings:
+  1. bf16 matmul           — is the chip alive at all?
+  2. s8xs8->s32 dot        — the mul_int8 primitive pattern
+  3. s8xs8->s32 conv       — the conv2d_int8 primitive pattern
+If 1 passes and 3 fails reproducibly, the conv int8 lowering is the
+culprit and conv2d_int8 needs an im2col+dot (or Pallas) fallback on
+TPU; if everything passes, the bench crash was the wedge.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def stage(name, fn):
+    t0 = time.time()
+    try:
+        out = fn()
+        out.block_until_ready()
+        print("PROBE-OK   %-12s %.1fs dtype=%s" %
+              (name, time.time() - t0, out.dtype), flush=True)
+        return True
+    except Exception as e:  # noqa: BLE001 - report and continue
+        print("PROBE-FAIL %-12s %.1fs %s: %s" %
+              (name, time.time() - t0, type(e).__name__,
+               str(e)[:300]), flush=True)
+        return False
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    k = jax.random.PRNGKey(0)
+    ok = stage("bf16_matmul", lambda: jax.jit(
+        lambda a: a @ a)(jnp.ones((512, 512), jnp.bfloat16)))
+    a8 = (jax.random.normal(k, (512, 512)) * 10).astype(jnp.int8)
+    ok &= stage("int8_dot", lambda: jax.jit(
+        lambda a, b: lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32))(a8, a8))
+    x8 = (jax.random.normal(k, (8, 64, 28, 28)) * 10).astype(jnp.int8)
+    w8 = (jax.random.normal(k, (64, 64, 3, 3)) * 10).astype(jnp.int8)
+    dn = lax.conv_dimension_numbers(x8.shape, w8.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    ok &= stage("int8_conv", lambda: jax.jit(
+        lambda x, w: lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn,
+            preferred_element_type=jnp.int32))(x8, w8))
+    # NHWC variant too — the bench int8 path runs after nhwc_transpile
+    xh = jnp.transpose(x8, (0, 2, 3, 1))
+    dnh = lax.conv_dimension_numbers(xh.shape, w8.shape,
+                                     ("NHWC", "OIHW", "NHWC"))
+    ok &= stage("int8_conv_nhwc", lambda: jax.jit(
+        lambda x, w: lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dnh,
+            preferred_element_type=jnp.int32))(xh, w8))
+    print("INT8PROBE " + ("ALL-OK" if ok else "FAILED"), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+"""Capture the hot-op micro-bench suite on a real TPU and write it as
+the committed TPU baseline (tools/op_bench_baseline_tpu.json).
+
+The CPU baseline (op_bench_baseline_cpu.json) gates CI hermetically;
+this one records what the ops cost on the actual target so an on-chip
+regression (e.g. a conv relayout sneaking back in) is visible next
+window.  Refuses to run off-TPU — a CPU row under the TPU filename
+would poison the gate's device check.
+
+Each spec runs in its own try so one broken op costs its row, not the
+snapshot; rows stream to stderr as they land.
+"""
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+
+def main():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    if "tpu" not in kind.lower():
+        print("not a TPU (%s) — refusing to write the TPU baseline"
+              % kind, file=sys.stderr)
+        return 1
+    from tools.op_bench import run_spec
+
+    specs = json.load(open(os.path.join(HERE, "op_bench_suite.json")))
+    # int8 specs last: their on-chip compile is the prime wedge
+    # suspect (2026-07-31), and a wedge mid-run forfeits every row
+    # after it until the next window
+    specs.sort(key=lambda s: "int8" in s["op"])
+    rows = []
+    for spec in specs:
+        try:
+            r = run_spec(spec)
+        except Exception as e:  # noqa: BLE001 - row-level isolation
+            r = {"op": spec["op"], "error":
+                 "%s: %s" % (type(e).__name__, str(e)[:200]),
+                 "device": kind}
+        rows.append(r)
+        print(json.dumps(r), file=sys.stderr, flush=True)
+    out = os.path.join(HERE, "op_bench_baseline_tpu.json")
+    good = [r for r in rows if "error" not in r]
+    if good:
+        # error rows never enter the baseline — the regression gate
+        # reads b["ms"] and a poisoned row would crash it
+        with open(out, "w") as f:
+            json.dump(good, f, indent=1)
+    n_err = len(rows) - len(good)
+    print("wrote %s (%d rows, %d errors)" % (out, len(good), n_err),
+          flush=True)
+    # partial capture exits nonzero so the chaser re-queues the task
+    # for a later window instead of marking it done
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
