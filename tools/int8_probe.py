@@ -35,32 +35,42 @@ def stage(name, fn):
         return False
 
 
+def _bf16_matmul():
+    return jax.jit(lambda a: a @ a)(jnp.ones((512, 512), jnp.bfloat16))
+
+
+def _ints(shape):
+    # host-side construction: nothing touches the device until the
+    # jitted call inside stage()'s try
+    import numpy as np
+
+    return jnp.asarray(np.random.RandomState(0)
+                       .randint(-10, 10, shape).astype("int8"))
+
+
+def _int8_dot():
+    a8 = _ints((512, 512))
+    return jax.jit(lambda a, b: lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32))(a8, a8)
+
+
+def _int8_conv(fmt):
+    shp = (8, 64, 28, 28) if fmt == "NCHW" else (8, 28, 28, 64)
+    x8, w8 = _ints(shp), _ints((64, 64, 3, 3))
+    dn = lax.conv_dimension_numbers(shp, w8.shape, (fmt, "OIHW", fmt))
+    return jax.jit(lambda x, w: lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn,
+        preferred_element_type=jnp.int32))(x8, w8)
+
+
 def main():
     print("devices:", jax.devices(), flush=True)
-    k = jax.random.PRNGKey(0)
-    ok = stage("bf16_matmul", lambda: jax.jit(
-        lambda a: a @ a)(jnp.ones((512, 512), jnp.bfloat16)))
-    a8 = (jax.random.normal(k, (512, 512)) * 10).astype(jnp.int8)
-    ok &= stage("int8_dot", lambda: jax.jit(
-        lambda a, b: lax.dot_general(
-            a, b, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32))(a8, a8))
-    x8 = (jax.random.normal(k, (8, 64, 28, 28)) * 10).astype(jnp.int8)
-    w8 = (jax.random.normal(k, (64, 64, 3, 3)) * 10).astype(jnp.int8)
-    dn = lax.conv_dimension_numbers(x8.shape, w8.shape,
-                                    ("NCHW", "OIHW", "NCHW"))
-    ok &= stage("int8_conv", lambda: jax.jit(
-        lambda x, w: lax.conv_general_dilated(
-            x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn,
-            preferred_element_type=jnp.int32))(x8, w8))
+    ok = stage("bf16_matmul", _bf16_matmul)
+    ok &= stage("int8_dot", _int8_dot)
+    ok &= stage("int8_conv", lambda: _int8_conv("NCHW"))
     # NHWC variant too — the bench int8 path runs after nhwc_transpile
-    xh = jnp.transpose(x8, (0, 2, 3, 1))
-    dnh = lax.conv_dimension_numbers(xh.shape, w8.shape,
-                                     ("NHWC", "OIHW", "NHWC"))
-    ok &= stage("int8_conv_nhwc", lambda: jax.jit(
-        lambda x, w: lax.conv_general_dilated(
-            x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dnh,
-            preferred_element_type=jnp.int32))(xh, w8))
+    ok &= stage("int8_conv_nhwc", lambda: _int8_conv("NHWC"))
     print("INT8PROBE " + ("ALL-OK" if ok else "FAILED"), flush=True)
     return 0 if ok else 1
 

@@ -1,5 +1,5 @@
-"""Capture the hot-op micro-bench suite on a real TPU and write it as
-the committed TPU baseline (tools/op_bench_baseline_tpu.json).
+"""Capture the hot-op micro-bench suite on a real TPU and merge it
+into the committed TPU baseline (tools/op_bench_baseline_tpu.json).
 
 The CPU baseline (op_bench_baseline_cpu.json) gates CI hermetically;
 this one records what the ops cost on the actual target so an on-chip
@@ -7,9 +7,20 @@ regression (e.g. a conv relayout sneaking back in) is visible next
 window.  Refuses to run off-TPU — a CPU row under the TPU filename
 would poison the gate's device check.
 
-Each spec runs in its own try so one broken op costs its row, not the
-snapshot; rows stream to stderr as they land.
+Wedge-safety (the 2026-07-31 tunnel failure mode):
+- default run SKIPS int8 specs entirely: their on-chip compile is the
+  prime wedge suspect, and this tool's job is the risk-free capture;
+  run again with --int8 (after tools/int8_probe.py has cleared the
+  lowering) to add ONLY the int8 rows
+- the baseline file is rewritten after EVERY row, so a hang killed by
+  the chaser's timeout keeps everything measured before it
+- rows MERGE into the existing file keyed by op name; a partial run
+  can never shrink coverage (the op_bench gate silently skips ops
+  missing from the baseline, so a shrink would hide regressions)
+- error rows never enter the file (the gate reads b["ms"]) and any
+  error exits nonzero so the chaser re-queues the task
 """
+import argparse
 import json
 import os
 import sys
@@ -17,8 +28,15 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(HERE))
 
+OUT = os.path.join(HERE, "op_bench_baseline_tpu.json")
+
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--int8", action="store_true",
+                    help="run ONLY the int8 specs (default skips them)")
+    args = ap.parse_args()
+
     import jax
 
     kind = jax.devices()[0].device_kind
@@ -29,32 +47,31 @@ def main():
     from tools.op_bench import run_spec
 
     specs = json.load(open(os.path.join(HERE, "op_bench_suite.json")))
-    # int8 specs last: their on-chip compile is the prime wedge
-    # suspect (2026-07-31), and a wedge mid-run forfeits every row
-    # after it until the next window
-    specs.sort(key=lambda s: "int8" in s["op"])
-    rows = []
+    specs = [s for s in specs if ("int8" in s["op"]) == args.int8]
+
+    merged = {}
+    if os.path.exists(OUT):
+        try:
+            merged = {r["op"]: r for r in json.load(open(OUT))}
+        except ValueError:
+            pass
+    n_err = 0
     for spec in specs:
         try:
             r = run_spec(spec)
         except Exception as e:  # noqa: BLE001 - row-level isolation
-            r = {"op": spec["op"], "error":
-                 "%s: %s" % (type(e).__name__, str(e)[:200]),
-                 "device": kind}
-        rows.append(r)
+            n_err += 1
+            print(json.dumps({"op": spec["op"], "error":
+                              "%s: %s" % (type(e).__name__,
+                                          str(e)[:200])}),
+                  file=sys.stderr, flush=True)
+            continue
         print(json.dumps(r), file=sys.stderr, flush=True)
-    out = os.path.join(HERE, "op_bench_baseline_tpu.json")
-    good = [r for r in rows if "error" not in r]
-    if good:
-        # error rows never enter the baseline — the regression gate
-        # reads b["ms"] and a poisoned row would crash it
-        with open(out, "w") as f:
-            json.dump(good, f, indent=1)
-    n_err = len(rows) - len(good)
-    print("wrote %s (%d rows, %d errors)" % (out, len(good), n_err),
-          flush=True)
-    # partial capture exits nonzero so the chaser re-queues the task
-    # for a later window instead of marking it done
+        merged[r["op"]] = r
+        with open(OUT, "w") as f:  # flush per row: survive a wedge
+            json.dump(list(merged.values()), f, indent=1)
+    print("%s now has %d rows (%d errors this run)" % (
+        OUT, len(merged), n_err), flush=True)
     return 1 if n_err else 0
 
 
