@@ -73,3 +73,8 @@ define_flag("profile_ops", False,
 define_flag("eager_delete_tensor_gb", 0.0,
             "GC threshold placeholder (XLA owns buffers; reference "
             "executor GC flag)")
+define_flag("int8_conv_algo", "conv",
+            "conv2d_int8 lowering: 'conv' = integer "
+            "conv_general_dilated; 'im2col' = pad/slice/concat + one "
+            "s8xs8->s32 dot_general (bit-identical; escape hatch for "
+            "backends where the integer conv hits a bad compile path)")

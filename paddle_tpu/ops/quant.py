@@ -141,6 +141,58 @@ def dequantize_weight(ins, attrs):
             / attrs["max_range"]}
 
 
+def _int8_conv_im2col(x8, q, strides, pads, dils, groups, fmt):
+    """s8 conv as pad/slice/concat + ONE s8xs8->s32 dot_general.
+
+    Alternative lowering for backends where an integer
+    conv_general_dilated hits a bad compiler path (selected via
+    FLAGS int8_conv_algo=im2col).  Patch extraction is pure data
+    movement — pad, KhxKw strided slices, concat — so the only MXU op
+    is the matmul; int32 accumulation of s8 products is exact, making
+    this bit-identical to the conv lowering.  Cost: the activation is
+    materialized Kh*Kw times (at 1 byte/elem).
+    """
+    if fmt == "NCHW":  # one internal layout; int8 transposes are cheap
+        x8 = jnp.transpose(x8, (0, 2, 3, 1))
+    O, I, KH, KW = q.shape
+    N, H, W, C = x8.shape
+    (sh, sw), (ph, pw), (dh, dw) = strides, pads, dils
+    xp = jnp.pad(x8, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    OH = (H + 2 * ph - (KH - 1) * dh - 1) // sh + 1
+    OW = (W + 2 * pw - (KW - 1) * dw - 1) // sw + 1
+    cols = [lax.slice(xp, (0, kh * dh, kw * dw, 0),
+                      (N, kh * dh + (OH - 1) * sh + 1,
+                       kw * dw + (OW - 1) * sw + 1, C),
+                      (1, sh, sw, 1))
+            for kh in range(KH) for kw in range(KW)]
+    # patches[..., (kh*KW+kw)*C + c] pairs with filter[o, c, kh, kw]
+    patches = jnp.concatenate(cols, axis=-1)  # [N,OH,OW,KH*KW*C]
+    # OIHW -> [KH*KW*I, O] in the same (kh, kw, c) minor order
+    w = jnp.transpose(q, (2, 3, 1, 0)).reshape(KH * KW * I, O)
+    if groups == 1:
+        y32 = lax.dot_general(
+            patches.reshape(N * OH * OW, KH * KW * C), w,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y32 = y32.reshape(N, OH, OW, O)
+    else:
+        ig, og = C // groups, O // groups  # ig == I
+        # one batched dot over the group dim (not G unrolled matmuls)
+        pg = patches.reshape(N * OH * OW, KH * KW, groups, ig)
+        pg = jnp.transpose(pg, (2, 0, 1, 3)).reshape(
+            groups, N * OH * OW, KH * KW * ig)
+        wg = w.reshape(KH * KW, ig, groups, og)  # O = (g, og) split
+        wg = jnp.transpose(wg, (2, 0, 1, 3)).reshape(
+            groups, KH * KW * ig, og)
+        y32 = lax.dot_general(pg, wg, (((2,), (1,)), ((0,), (0,))),
+                              preferred_element_type=jnp.int32)
+        # [G, N*OH*OW, og] -> [N, OH, OW, G*og] with O = g*og + o
+        y32 = jnp.transpose(y32, (1, 0, 2)).reshape(N, OH, OW, O)
+    if fmt == "NCHW":
+        y32 = jnp.transpose(y32, (0, 3, 1, 2))
+    return y32
+
+
 @register_op("conv2d_int8", inputs=("Input", "Filter", "FilterScale"),
              outputs=("Output",),
              attrs={"strides": [1, 1], "paddings": [0, 0],
@@ -158,6 +210,8 @@ def conv2d_int8(ins, attrs):
     the MACs themselves run on 1-byte operands."""
     from paddle_tpu.ops.nn import _pair
 
+    from paddle_tpu.flags import get_flag
+
     x, q, ws = ins["Input"], ins["Filter"], ins["FilterScale"]
     bnd = attrs["max_range"]
     sx = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
@@ -165,14 +219,17 @@ def conv2d_int8(ins, attrs):
     s, p, d = (_pair(attrs["strides"]), _pair(attrs["paddings"]),
                _pair(attrs["dilations"]))
     fmt = attrs.get("data_format", "NCHW")
-    dn = lax.conv_dimension_numbers(x.shape, q.shape,
-                                    (fmt, "OIHW", fmt))
-    y32 = lax.conv_general_dilated(
-        x8, q, window_strides=s,
-        padding=[(p[0], p[0]), (p[1], p[1])],
-        rhs_dilation=d, dimension_numbers=dn,
-        feature_group_count=attrs["groups"],
-        preferred_element_type=jnp.int32)
+    if get_flag("int8_conv_algo") == "im2col":
+        y32 = _int8_conv_im2col(x8, q, s, p, d, attrs["groups"], fmt)
+    else:
+        dn = lax.conv_dimension_numbers(x.shape, q.shape,
+                                        (fmt, "OIHW", fmt))
+        y32 = lax.conv_general_dilated(
+            x8, q, window_strides=s,
+            padding=[(p[0], p[0]), (p[1], p[1])],
+            rhs_dilation=d, dimension_numbers=dn,
+            feature_group_count=attrs["groups"],
+            preferred_element_type=jnp.int32)
     oscale = ws.reshape(-1)  # per-out-channel (O,1,1,1) -> (O,)
     sc = (oscale.reshape(1, -1, 1, 1) if fmt == "NCHW"
           else oscale.reshape(1, 1, 1, -1))

@@ -291,3 +291,49 @@ def test_int8_true_execution_int8_macs():
     assert "i8[" in s and "conv_general_dilated" in s, s
     assert "i32[4,8,16,16] = conv_general_dilated" in s.replace(
         "\n", " ").replace("  ", " ") or "i32[" in s, s
+
+
+def test_int8_conv_im2col_bit_identical_to_conv():
+    """FLAGS int8_conv_algo=im2col (escape hatch for backends where an
+    integer conv_general_dilated hits a bad compile path) must agree
+    BIT-FOR-BIT with the conv lowering: int32 accumulation of s8
+    products is exact, so any difference is a layout/indexing bug."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def
+    from paddle_tpu.flags import set_flags
+
+    d = get_op_def("conv2d_int8")
+    rng = np.random.RandomState(7)
+    cases = [
+        # (xshape NCHW, wshape OIHW, attrs)
+        ((2, 6, 13, 11), (4, 6, 3, 3), {"paddings": [1, 1]}),
+        ((2, 6, 14, 14), (4, 6, 3, 3), {"strides": [2, 2],
+                                        "paddings": [1, 1]}),
+        ((1, 4, 9, 9), (8, 4, 1, 1), {}),
+        ((2, 6, 15, 15), (4, 6, 3, 3), {"dilations": [2, 2],
+                                        "paddings": [2, 2]}),
+        ((2, 8, 10, 10), (8, 2, 3, 3), {"groups": 4,
+                                        "paddings": [1, 1]}),
+        ((2, 6, 12, 12), (6, 6, 5, 5), {"strides": [2, 2],
+                                        "paddings": [2, 2],
+                                        "dilations": [1, 1]}),
+    ]
+    for xs, fs, at in cases:
+        x = rng.randn(*xs).astype(np.float32) * 3
+        w8 = rng.randint(-127, 128, fs).astype(np.int8)
+        wsc = (rng.rand(fs[0], 1, 1, 1).astype(np.float32) + 0.1)
+        for fmt in ("NCHW", "NHWC"):
+            xin = x if fmt == "NCHW" else np.transpose(x, (0, 2, 3, 1))
+            ins = {"Input": jnp.asarray(xin), "Filter": jnp.asarray(w8),
+                   "FilterScale": jnp.asarray(wsc)}
+            ca = d.canonical_attrs(dict(at, data_format=fmt))
+            set_flags({"int8_conv_algo": "conv"})
+            ref = np.asarray(d.compute(ins, ca)["Output"])
+            try:
+                set_flags({"int8_conv_algo": "im2col"})
+                got = np.asarray(d.compute(ins, ca)["Output"])
+            finally:
+                set_flags({"int8_conv_algo": "conv"})
+            np.testing.assert_array_equal(
+                got, ref, err_msg="%s %s %s %s" % (xs, fs, at, fmt))

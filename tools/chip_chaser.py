@@ -92,9 +92,22 @@ def run_task(name, leg, kwargs, timeout_s=None):
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=timeout_s)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # keep whatever the child printed before the kill — for a
+        # wedge, the partial output IS the triage evidence
+        def _txt(b):
+            return b.decode("utf-8", "replace") if isinstance(
+                b, bytes) else (b or "")
+
+        full = "/tmp/chaser_%s.out" % name
+        with open(full, "w") as f:
+            f.write("== TIMEOUT after %ds ==\n== stdout ==\n%s\n"
+                    "== stderr ==\n%s"
+                    % (timeout_s, _txt(e.stdout), _txt(e.stderr)))
         return {"task": name, "ok": False, "took_s": round(
-            time.time() - t0, 1), "error": "timeout>%ds" % timeout_s}
+            time.time() - t0, 1), "error": "timeout>%ds" % timeout_s,
+            "full_output": full,
+            "stderr_tail": _txt(e.stderr)[-1000:]}
     rec = {"task": name, "leg": leg, "kwargs": kwargs,
            "took_s": round(time.time() - t0, 1)}
     if leg.startswith("script:"):

@@ -54,7 +54,8 @@ def main():
         try:
             merged = {r["op"]: r for r in json.load(open(OUT))}
         except ValueError:
-            pass
+            print("WARNING: existing %s is corrupt — starting fresh"
+                  % OUT, file=sys.stderr)
     n_err = 0
     for spec in specs:
         try:
@@ -68,8 +69,11 @@ def main():
             continue
         print(json.dumps(r), file=sys.stderr, flush=True)
         merged[r["op"]] = r
-        with open(OUT, "w") as f:  # flush per row: survive a wedge
+        # per-row flush so a wedge keeps prior rows; tmp+replace so a
+        # kill MID-WRITE can't leave a truncated baseline behind
+        with open(OUT + ".tmp", "w") as f:
             json.dump(list(merged.values()), f, indent=1)
+        os.replace(OUT + ".tmp", OUT)
     print("%s now has %d rows (%d errors this run)" % (
         OUT, len(merged), n_err), flush=True)
     return 1 if n_err else 0
