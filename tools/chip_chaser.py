@@ -42,6 +42,7 @@ TASKS = [
      "script:tools/profile_transformer.py --time", {}),
     ("profile_resnet_onchip",
      "script:tools/profile_resnet.py --nhwc --bf16 --time", {}),
+    ("flash_block_sweep", "script:tools/flash_block_sweep.py", {}),
     ("rn_train_mb256", "rn_train", {"batch": 256, "chain": 20}),
     ("tf_train_mb64", "tf_train", {"batch": 64, "chain": 20}),
     ("tf_train_mb128", "tf_train", {"batch": 128, "chain": 10}),
@@ -89,6 +90,7 @@ def run_task(name, leg, kwargs, timeout_s=None):
                "--kwargs", json.dumps(kwargs)]
         timeout_s = timeout_s or 2400
     t0 = time.time()
+    rec = {"task": name, "leg": leg, "kwargs": kwargs}
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=timeout_s)
@@ -104,12 +106,11 @@ def run_task(name, leg, kwargs, timeout_s=None):
             f.write("== TIMEOUT after %ds ==\n== stdout ==\n%s\n"
                     "== stderr ==\n%s"
                     % (timeout_s, _txt(e.stdout), _txt(e.stderr)))
-        return {"task": name, "ok": False, "took_s": round(
-            time.time() - t0, 1), "error": "timeout>%ds" % timeout_s,
-            "full_output": full,
-            "stderr_tail": _txt(e.stderr)[-1000:]}
-    rec = {"task": name, "leg": leg, "kwargs": kwargs,
-           "took_s": round(time.time() - t0, 1)}
+        rec.update(ok=False, took_s=round(time.time() - t0, 1),
+                   error="timeout>%ds" % timeout_s, full_output=full,
+                   stderr_tail=_txt(e.stderr)[-1000:])
+        return rec
+    rec["took_s"] = round(time.time() - t0, 1)
     if leg.startswith("script:"):
         full = "/tmp/chaser_%s.out" % name
         with open(full, "w") as f:
@@ -176,6 +177,15 @@ def main():
         print("tunnel UP (%s) — running %s" % (kind, name), flush=True)
         rec = run_task(name, leg, kwargs)
         log(rec)
+        if "PADDLE_TPU_INT8_CONV_ALGO=im2col" in rec.get(
+                "stdout_tail", ""):
+            # the probe diagnosed a broken integer-conv lowering with
+            # a working im2col hatch: every later child (int8 rows,
+            # full int8 leg) must inherit the switch or it re-wedges
+            os.environ["PADDLE_TPU_INT8_CONV_ALGO"] = "im2col"
+            print("probe VERDICT: exporting "
+                  "PADDLE_TPU_INT8_CONV_ALGO=im2col for later tasks",
+                  flush=True)
         if rec.get("ok"):
             done.add(name)
         else:

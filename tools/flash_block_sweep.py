@@ -1,0 +1,103 @@
+"""Sweep flash-attention Pallas block sizes on a real chip.
+
+The kernel defaults to block_q = block_k = 512 (ops/pallas_kernels.py
+flash_attention), a size chosen off-chip.  This tool times fwd and
+fwd+bwd at the transformer-bench shape (and the long-context shape)
+across block combos so the default can be re-pinned to what the v5e
+actually prefers.  Prints one JSON line per combo; errors (e.g. a
+combo exceeding VMEM) are reported per-combo, not fatal.
+
+Run on chip (the chaser queues it): python tools/flash_block_sweep.py
+"""
+import itertools
+import json
+import sys
+import time
+
+
+def time_fn(fn, *args, repeat=20, warmup=3):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / repeat * 1e3
+
+
+def main():
+    import os
+
+    smoke = "--smoke" in sys.argv
+    import jax
+
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.ops.pallas_kernels import flash_attention
+
+    print("devices:", jax.devices(), flush=True)
+    impl = "interpret" if smoke else "pallas"
+    if smoke:  # tiny plumbing check, interpret-mode kernel on CPU
+        shapes = [dict(name="smoke", b=1, h=2, t=128, d=32,
+                       causal=True)]
+        combos = [(64, 64), (128, 64)]
+    else:
+        shapes = [
+            # transformer-base bench: batch 32, 8 heads, seq 512, d 64
+            dict(name="tf_base", b=32, h=8, t=512, d=64, causal=True),
+            # long-context leg shape (single chip)
+            dict(name="longctx", b=1, h=8, t=32768, d=64, causal=True),
+        ]
+        combos = [(256, 256), (256, 512), (512, 256), (512, 512),
+                  (512, 1024), (1024, 512), (1024, 1024)]
+    key = jax.random.PRNGKey(0)
+    shapes_ok = 0
+    for s in shapes:
+        n_good = 0
+        q = jax.random.normal(
+            key, (s["b"], s["h"], s["t"], s["d"]), jnp.bfloat16)
+        for bq, bk in combos:
+            if bq > s["t"] or bk > s["t"]:
+                continue
+            try:
+                fwd = jax.jit(lambda q, k, v, bq=bq, bk=bk:
+                              flash_attention(q, k, v, causal=s["causal"],
+                                              block_q=bq, block_k=bk,
+                                              impl=impl))
+                ms_f = time_fn(fwd, q, q, q)
+
+                def loss(qq, kk, vv, bq=bq, bk=bk):
+                    return flash_attention(
+                        qq, kk, vv, causal=s["causal"], block_q=bq,
+                        block_k=bk, impl=impl).astype(
+                        jnp.float32).sum()
+
+                gfn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                ms_fb = time_fn(gfn, q, q, q)
+                print(json.dumps({
+                    "shape": s["name"], "block_q": bq, "block_k": bk,
+                    "fwd_ms": round(ms_f, 3),
+                    "fwd_bwd_ms": round(ms_fb, 3)}), flush=True)
+                n_good += 1
+            except Exception as e:  # noqa: BLE001 - per-combo isolation
+                print(json.dumps({
+                    "shape": s["name"], "block_q": bq, "block_k": bk,
+                    "error": "%s: %s" % (type(e).__name__,
+                                         str(e)[:200])}), flush=True)
+        shapes_ok += n_good > 0
+    # a shape with zero surviving combos (e.g. mid-sweep wedge) must
+    # exit nonzero so the chaser re-queues instead of marking done
+    return 0 if shapes_ok == len(shapes) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
