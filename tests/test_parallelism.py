@@ -365,6 +365,45 @@ def test_zero_training_matches_replicated():
     zero = build_and_train(zero_sharding_rules(stage=1, axis="dp",
                                                min_size=4))
     np.testing.assert_allclose(zero, base, rtol=1e-4)
+    # stage 3: parameters themselves sharded — XLA all-gathers each
+    # weight at its use sites (DeepSpeed-3's communication pattern,
+    # emitted by the SPMD partitioner); numerics must be unchanged
+    zero3 = build_and_train(zero_sharding_rules(stage=3, axis="dp",
+                                                min_size=4))
+    np.testing.assert_allclose(zero3, base, rtol=1e-4)
+
+
+def test_zero3_params_actually_sharded_on_device():
+    """ZeRO-3's claim is per-device parameter memory 1/ndev: assert the
+    committed weight really is dim-0 sharded over the mesh after a
+    compiled step (companion to the stage-1 accumulator-shard test)."""
+    import jax
+
+    from paddle_tpu import framework, layers, optimizer
+    from paddle_tpu.core.scope import global_scope
+
+    np.random.seed(3)
+    x = layers.data("x", shape=[64], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, 1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.Adam(0.01).minimize(loss)
+    main = framework.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name).with_sharding_rules(
+        zero_sharding_rules(stage=3, axis="dp", min_size=16,
+                            program=main))
+    bx = np.random.RandomState(4).rand(16, 64).astype(np.float32)
+    exe.run(compiled, feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+            fetch_list=[loss])
+    ndev = len(jax.devices())
+    pname = main.all_parameters()[0].name
+    parr = global_scope().find_var(pname).get()
+    shard_rows = parr.addressable_shards[0].data.shape[0]
+    assert shard_rows == parr.shape[0] // ndev, (
+        shard_rows, parr.shape, ndev)
 
 
 def test_parallel_ops_via_program_ir():
