@@ -113,7 +113,7 @@ def _build_compiled_fn(compiled, feed, fetch_names):
     return fn, state
 
 
-def _build_resnet50_train(batch=128):
+def _build_resnet50_train(batch=128, s2d=False):
     """Build + init the ResNet-50 bench train step; returns
     (fn, state, feed, loss_name).  Shared by the bench and
     tools/tpu_lowering_check.py so the lowering gate checks exactly
@@ -135,6 +135,13 @@ def _build_resnet50_train(batch=128):
     # then AMP-rewrite to bf16 activations with fp32 master weights —
     # the moral equivalent of the reference's float16 training story
     # (contrib/float16/float16_benchmark.md)
+    if s2d:
+        # A/B lever: space-to-depth stem (exact-equivalence rewrite,
+        # tests/test_layout.py).  MFU keeps the ORIGINAL model's
+        # analytic numerator, so compare variants by step time.
+        from paddle_tpu.transpiler import space_to_depth_stem
+
+        space_to_depth_stem(framework.default_main_program())
     nhwc_transpile(framework.default_main_program())
     opt = decorate(optimizer.Momentum(learning_rate=0.1, momentum=0.9),
                    init_loss_scaling=1.0,
@@ -155,19 +162,22 @@ def _build_resnet50_train(batch=128):
     return fn, state, feed, model["loss"].name
 
 
-def bench_resnet50_train(batch=128, chain=30):
-    fn, state, feed, loss_name = _build_resnet50_train(batch)
+def bench_resnet50_train(batch=128, chain=30, s2d=False):
+    fn, state, feed, loss_name = _build_resnet50_train(batch, s2d=s2d)
     sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
     sps = batch / sec_per_step
     peak, kind = _chip_peak_flops()
     mfu = _resnet50_train_flops_per_image() * sps / peak
-    return {
+    res = {
         "samples_per_sec": round(sps, 1),
         "step_ms": round(sec_per_step * 1e3, 3),
         "mfu_pct": round(100 * mfu, 2),
         "batch": batch,
         "device": kind,
     }
+    if s2d:
+        res["s2d_stem"] = True
+    return res
 
 
 # Transformer-base config shared with tools/profile_transformer.py so

@@ -41,7 +41,7 @@ gray_list = {
     "dropout", "reshape2", "transpose2", "transpose", "concat", "split",
     "slice", "flatten2", "stack", "unstack", "expand", "scale", "cast",
     "elementwise_op", "squeeze2", "unsqueeze2", "pad", "pad2d", "gather",
-    "swapaxes", "flip", "assign",
+    "swapaxes", "flip", "assign", "space_to_depth",
 }
 
 # normalization ops whose output dtype follows X (statistics stay fp32

@@ -9,7 +9,7 @@ from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401
 from paddle_tpu.transpiler.inference_transpiler import (  # noqa: F401
     FuseElewiseAddActTranspiler, FuseFCTranspiler, InferenceTranspiler)
 from paddle_tpu.transpiler.layout_transpiler import (  # noqa: F401
-    nhwc_transpile)
+    nhwc_transpile, space_to_depth_stem)
 from paddle_tpu.transpiler.memory_optimization_transpiler import (  # noqa: F401
     memory_optimize, release_memory)
 from paddle_tpu.transpiler.ps_dispatcher import (HashName,  # noqa: F401

@@ -217,3 +217,108 @@ def test_resnet_data_format_nhwc_builds(fresh_programs_factory):
                       fetch_list=[model["logits"]])[0]
         assert out.shape == (2, 10)
         assert np.isfinite(out).all()
+
+
+# ---------------------------------------------------------------------------
+# space_to_depth_stem
+# ---------------------------------------------------------------------------
+
+def _stem_net(is_test=False):
+    """7x7/s2/p3 C=3 image stem (the resnet stem shape, small spatial)
+    + head: the one conv space_to_depth_stem targets."""
+    img = layers.data("image", shape=[3, 16, 16], dtype="float32")
+    lbl = layers.data("label", shape=[1], dtype="int64")
+    h = layers.conv2d(img, num_filters=8, filter_size=7, stride=2,
+                      padding=3, bias_attr=False)
+    h = layers.batch_norm(h, is_test=is_test)
+    h = layers.relu(h)
+    h = layers.conv2d(h, num_filters=8, filter_size=3, padding=1,
+                      bias_attr=False)   # non-stem: must stay untouched
+    h = layers.pool2d(h, pool_size=8, pool_type="avg")
+    logits = layers.fc(h, size=10)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, lbl))
+    return logits, loss
+
+
+def _stem_batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(4, 3, 16, 16).astype(np.float32),
+            rng.randint(0, 10, (4, 1)).astype(np.int64))
+
+
+def test_s2d_stem_forward_equivalence(fresh_programs_factory):
+    from paddle_tpu.transpiler import space_to_depth_stem
+
+    img, lbl = _stem_batch()
+    outs = {}
+    for use_s2d in (False, True):
+        with fresh_programs_factory():
+            np.random.seed(11)
+            logits, loss = _stem_net(is_test=True)
+            if use_s2d:
+                space_to_depth_stem(fluid.default_main_program())
+                ops = [op.type for op in
+                       fluid.default_main_program().global_block().ops]
+                assert ops.count("space_to_depth") == 2, ops
+                convs = [op for op in
+                         fluid.default_main_program().global_block().ops
+                         if op.type == "conv2d"]
+                # stem conv rewritten to 4x4/s1/p0 on 12 channels;
+                # the 3x3 conv untouched
+                stem = convs[0]
+                assert stem.attrs["strides"] == [1, 1]
+                assert stem.attrs["paddings"] == [0, 0]
+                assert convs[1].attrs["strides"] == [1, 1]
+                assert convs[1].attrs["paddings"] == [1, 1]
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            outs[use_s2d] = exe.run(
+                feed={"image": img, "label": lbl},
+                fetch_list=[logits])[0]
+    np.testing.assert_allclose(outs[True], outs[False], rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_s2d_stem_training_trajectory_with_nhwc(fresh_programs_factory):
+    """Grads flow through the in-graph filter rearrangement back to the
+    ORIGINAL [O,C,7,7] weight: the full composition (s2d stem ->
+    nhwc_transpile -> minimize) must track the plain net step for
+    step."""
+    from paddle_tpu.transpiler import nhwc_transpile, space_to_depth_stem
+
+    trajs = {}
+    for use_s2d in (False, True):
+        with fresh_programs_factory():
+            np.random.seed(13)
+            logits, loss = _stem_net(is_test=False)
+            if use_s2d:
+                space_to_depth_stem(fluid.default_main_program())
+                nhwc_transpile(fluid.default_main_program())
+            optimizer.Momentum(learning_rate=0.05,
+                               momentum=0.9).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            losses = []
+            for i in range(6):
+                bi, bl = _stem_batch(seed=i)
+                (lv,) = exe.run(feed={"image": bi, "label": bl},
+                                fetch_list=[loss])
+                losses.append(float(lv))
+            trajs[use_s2d] = losses
+    np.testing.assert_allclose(trajs[True], trajs[False], rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_s2d_stem_ignores_non_stem_convs(fresh_programs_factory):
+    from paddle_tpu.transpiler import space_to_depth_stem
+
+    with fresh_programs_factory():
+        img = layers.data("image", shape=[3, 16, 16], dtype="float32")
+        layers.conv2d(img, num_filters=4, filter_size=3, padding=1)
+        before = [op.type for op in
+                  fluid.default_main_program().global_block().ops]
+        space_to_depth_stem(fluid.default_main_program())
+        after = [op.type for op in
+                 fluid.default_main_program().global_block().ops]
+        assert before == after

@@ -44,6 +44,10 @@ TASKS = [
      "script:tools/profile_resnet.py --nhwc --bf16 --time", {}),
     ("flash_block_sweep", "script:tools/flash_block_sweep.py", {}),
     ("rn_train_mb256", "rn_train", {"batch": 256, "chain": 20}),
+    # A/B: space-to-depth stem (exact-equivalence rewrite) — compare
+    # step_ms against the plain mb128/mb256 rows
+    ("rn_train_mb128_s2d", "rn_train",
+     {"batch": 128, "chain": 20, "s2d": True}),
     ("tf_train_mb64", "tf_train", {"batch": 64, "chain": 20}),
     ("tf_train_mb128", "tf_train", {"batch": 128, "chain": 10}),
     ("bert_train_mb16", "bert_train", {"batch": 16, "chain": 10}),

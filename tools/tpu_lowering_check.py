@@ -46,6 +46,8 @@ def _workloads():
         "transformer_train": lambda: bench._build_transformer_train(
             32, 512)[:3],
         "resnet50_train": lambda: bench._build_resnet50_train(128)[:3],
+        "resnet50_train_s2d": lambda: bench._build_resnet50_train(
+            128, s2d=True)[:3],
         "bert_train": lambda: bench._build_bert_train(8, 512)[:3],
         "deepfm_train": lambda: bench._build_deepfm_train(2048)[:3],
         "resnet50_infer_int8": lambda:
