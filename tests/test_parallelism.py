@@ -515,3 +515,84 @@ class TestDGCSparseAllreduce:
         # second step: residuals rejoin and eventually get sent
         avg2, u3, v3 = f(grads, u2, v2)
         assert float(np.abs(np.asarray(avg2)).sum()) > 0
+
+
+def test_hybrid_mesh_dcn_ici_trains_like_flat():
+    """make_hybrid_mesh (multi-slice: data over DCN, tensor over ICI —
+    SURVEY §5's hierarchical-allreduce replacement) must be a drop-in
+    mesh: same axis names, same sharding rules, same losses as the
+    flat make_mesh on the virtual 8-device topology."""
+    from paddle_tpu import layers, optimizer
+
+    rng = np.random.RandomState(17)
+    W = rng.randn(16, 1).astype(np.float32)
+
+    def train(mesh):
+        from paddle_tpu import framework, unique_name
+        from paddle_tpu.core.program import Program
+        from paddle_tpu.core.scope import Scope, scope_guard
+
+        framework.switch_main_program(Program())
+        framework.switch_startup_program(Program())
+        unique_name.switch({})
+        penv.reset()
+        penv.set_mesh(mesh)
+        x = layers.data("x", shape=[16], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        optimizer.SGD(0.05).minimize(loss)
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            np.random.seed(21)
+            exe.run(fluid.default_startup_program())
+            compiled = fluid.CompiledProgram(
+                fluid.default_main_program()).with_data_parallel(
+                loss_name=loss.name, mesh=mesh)
+            losses = []
+            r2 = np.random.RandomState(22)
+            for _ in range(5):
+                bx = r2.rand(16, 16).astype(np.float32)
+                lv, = exe.run(compiled, feed={"x": bx, "y": bx @ W},
+                              fetch_list=[loss])
+                losses.append(float(lv))
+        return losses
+
+    hybrid = penv.make_hybrid_mesh({"dp": 2}, {"tp": 4})
+    assert hybrid.axis_names == ("dp", "tp")
+    assert hybrid.devices.shape == (2, 4)
+    base = train(penv.make_mesh(shape=(2, 4), axis_names=("dp", "tp")))
+    hyb = train(hybrid)
+    np.testing.assert_allclose(hyb, base, rtol=1e-5)
+
+
+def test_hybrid_mesh_device_count_mismatch_raises():
+    with pytest.raises(ValueError, match="needs"):
+        penv.make_hybrid_mesh({"dp": 3}, {"tp": 4})
+
+
+def test_hybrid_mesh_multislice_axis_assignment():
+    """On a (faked) 2-slice topology every dcn index must hold exactly
+    one slice — DCN traffic rides ONLY the dcn axes; a wrong-rank call
+    into create_hybrid_device_mesh would interleave slices (the bug
+    this test pins).  Also: a dcn/slice mismatch raises rather than
+    silently degrading."""
+    from paddle_tpu.parallel.env import _hybrid_device_array
+
+    class D:
+        platform = "cpu"
+        device_kind = "cpu"
+
+        def __init__(self, i, sl):
+            self.id = i
+            self.slice_index = sl
+            self.process_index = sl
+
+    devs = [D(i, i // 4) for i in range(8)]
+    arr = _hybrid_device_array((2,), (2, 2), devs)
+    assert arr.shape == (2, 2, 2)
+    for dp in range(2):
+        slices = {d.slice_index for d in arr[dp].ravel()}
+        assert len(slices) == 1, (dp, slices)
+    with pytest.raises(ValueError, match="slices"):
+        _hybrid_device_array((4,), (2,), devs)
