@@ -16,6 +16,7 @@ EX = os.path.join(ROOT, "examples")
     ("train_data_parallel.py", 300),
     ("ps_cluster.py", 420),
     ("long_context_ring.py", 300),
+    ("scale_out_hybrid.py", 300),
 ])
 def test_example_runs(script, timeout):
     env = {**os.environ, "PADDLE_TPU_PLATFORM": "cpu"}
