@@ -38,11 +38,16 @@ from paddle_tpu.parallel.zero import zero_sharding_rules
 
 
 def main():
-    print(f"devices: {len(jax.devices())}")
+    n = len(jax.devices())
+    print(f"devices: {n}")
     np.random.seed(0)
 
-    # dp=2 rides DCN between slices, tp=4 rides ICI within a slice
-    mesh = penv.set_mesh(penv.make_hybrid_mesh({"dp": 2}, {"tp": 4}))
+    # dp rides DCN between slices, tp rides ICI within a slice; size
+    # from whatever topology we actually got (a pre-set XLA_FLAGS can
+    # leave fewer than 8 virtual devices)
+    dp = 2 if n % 2 == 0 and n > 1 else 1
+    mesh = penv.set_mesh(penv.make_hybrid_mesh({"dp": dp},
+                                               {"tp": n // dp}))
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
     x = layers.data("x", shape=[64], dtype="float32")

@@ -118,6 +118,12 @@ class InferenceTranspiler:
             if self._consumers(block, x_name) != 1 or \
                     x_name in getattr(self, "_protected", frozenset()):
                 continue
+            # the filter must be a real scope-resident param: a conv
+            # whose Filter is a derived intermediate (e.g. the
+            # space_to_depth_stem @S2D rearrangement) can't be folded
+            # into — its weights live upstream
+            if scope.find_var(conv.inputs["Filter"][0]) is None:
+                continue
             y_name = op.outputs["Y"][0]
             self._fold(block, scope, conv, bias_op, op, x_name, y_name)
             if bias_op is not None:

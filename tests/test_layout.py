@@ -234,6 +234,7 @@ def _stem_net(is_test=False):
     h = layers.relu(h)
     h = layers.conv2d(h, num_filters=8, filter_size=3, padding=1,
                       bias_attr=False)   # non-stem: must stay untouched
+    h = layers.batch_norm(h, is_test=is_test)
     h = layers.pool2d(h, pool_size=8, pool_type="avg")
     logits = layers.fc(h, size=10)
     loss = layers.mean(
@@ -322,3 +323,34 @@ def test_s2d_stem_ignores_non_stem_convs(fresh_programs_factory):
         after = [op.type for op in
                  fluid.default_main_program().global_block().ops]
         assert before == after
+
+
+def test_s2d_stem_composes_with_conv_bn_fold(fresh_programs_factory):
+    """InferenceTranspiler's conv-bn fold must SKIP a stem whose
+    Filter is the @S2D derived intermediate (its weights live
+    upstream) instead of crashing, and the composed program must stay
+    numerically equal to the plain net."""
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.transpiler import (InferenceTranspiler,
+                                       space_to_depth_stem)
+
+    img, lbl = _stem_batch()
+    outs = {}
+    for transpile in (False, True):
+        with fresh_programs_factory():
+            np.random.seed(19)
+            logits, loss = _stem_net(is_test=True)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            prog = fluid.default_main_program()
+            if transpile:
+                space_to_depth_stem(prog)
+                InferenceTranspiler().transpile(prog, scope=global_scope())
+                ops = [op.type for op in prog.global_block().ops]
+                # the NON-stem conv's bn folded away; the stem's kept
+                assert ops.count("batch_norm") == 1, ops
+            outs[transpile] = exe.run(
+                prog, feed={"image": img, "label": lbl},
+                fetch_list=[logits])[0]
+    np.testing.assert_allclose(outs[True], outs[False], rtol=2e-5,
+                               atol=2e-5)
