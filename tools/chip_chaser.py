@@ -42,7 +42,12 @@ TASKS = [
      "script:tools/profile_transformer.py --time", {}),
     ("profile_resnet_onchip",
      "script:tools/profile_resnet.py --nhwc --bf16 --time", {}),
-    ("flash_block_sweep", "script:tools/flash_block_sweep.py", {}),
+    # split per shape with generous timeouts: each seq-32k fwd+bwd
+    # compile is minutes over the tunnel
+    ("flash_block_sweep_tf",
+     "script:tools/flash_block_sweep.py --shape tf_base", {}, 1500),
+    ("flash_block_sweep_longctx",
+     "script:tools/flash_block_sweep.py --shape longctx", {}, 1800),
     ("rn_train_mb256", "rn_train", {"batch": 256, "chain": 20}),
     # A/B: space-to-depth stem (exact-equivalence rewrite) — compare
     # step_ms against the plain mb128/mb256 rows
@@ -177,9 +182,10 @@ def main():
                   flush=True)
             time.sleep(args.probe_interval)
             continue
-        name, leg, kwargs = todo[0]
+        name, leg, kwargs = todo[0][:3]
+        timeout = todo[0][3] if len(todo[0]) > 3 else None
         print("tunnel UP (%s) — running %s" % (kind, name), flush=True)
-        rec = run_task(name, leg, kwargs)
+        rec = run_task(name, leg, kwargs, timeout_s=timeout)
         log(rec)
         if "PADDLE_TPU_INT8_CONV_ALGO=im2col" in rec.get(
                 "stdout_tail", ""):

@@ -33,7 +33,15 @@ def time_fn(fn, *args, repeat=20, warmup=3):
 def main():
     import os
 
-    smoke = "--smoke" in sys.argv
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny interpret-mode plumbing check on CPU")
+    ap.add_argument("--shape", default=None,
+                    help="sweep only this shape (tf_base | longctx)")
+    args = ap.parse_args()
+    smoke, only = args.smoke, args.shape
     import jax
 
     if smoke:
@@ -48,24 +56,33 @@ def main():
     impl = "interpret" if smoke else "pallas"
     if smoke:  # tiny plumbing check, interpret-mode kernel on CPU
         shapes = [dict(name="smoke", b=1, h=2, t=128, d=32,
-                       causal=True)]
-        combos = [(64, 64), (128, 64)]
+                       causal=True, combos=[(64, 64), (128, 64)])]
     else:
         shapes = [
             # transformer-base bench: batch 32, 8 heads, seq 512, d 64
-            dict(name="tf_base", b=32, h=8, t=512, d=64, causal=True),
-            # long-context leg shape (single chip)
-            dict(name="longctx", b=1, h=8, t=32768, d=64, causal=True),
+            dict(name="tf_base", b=32, h=8, t=512, d=64, causal=True,
+                 combos=[(256, 256), (256, 512), (512, 256),
+                         (512, 512)]),
+            # long-context leg shape (single chip); fewer combos —
+            # each fwd+bwd compile at seq 32k is minutes over the
+            # tunnel, and the per-task window budget is finite
+            dict(name="longctx", b=1, h=8, t=32768, d=64, causal=True,
+                 combos=[(512, 512), (512, 1024), (1024, 1024)]),
         ]
-        combos = [(256, 256), (256, 512), (512, 256), (512, 512),
-                  (512, 1024), (1024, 512), (1024, 1024)]
+        if only:
+            shapes = [s for s in shapes if s["name"] == only]
+            if not shapes:
+                # an unknown name must NOT exit 0 — the chaser would
+                # mark the task done with zero data collected
+                print("unknown --shape %r" % only, file=sys.stderr)
+                return 2
     key = jax.random.PRNGKey(0)
     shapes_ok = 0
     for s in shapes:
         n_good = 0
         q = jax.random.normal(
             key, (s["b"], s["h"], s["t"], s["d"]), jnp.bfloat16)
-        for bq, bk in combos:
+        for bq, bk in s["combos"]:
             if bq > s["t"] or bk > s["t"]:
                 continue
             try:
