@@ -33,6 +33,8 @@ import numpy as np
 
 BASELINE_INFER_MS = 64.52  # V100 fp16 mb=128, float16_benchmark.md:42-44
 BASELINE_VGG16_MB64_MS = 60.23  # V100 fp16 mb=64, float16_benchmark.md:23-25
+BASELINE_VGG16_CIFAR_MS = 17.37  # V100 fp16 mb=512, float16_benchmark.md:61-63
+BASELINE_RN32_CIFAR_MS = 11.02  # V100 fp16 mb=512, float16_benchmark.md:72-74
 MFU_TARGET = 0.50          # BASELINE.md north star
 
 # bf16 peak FLOP/s per chip by device kind (public spec sheets)
@@ -433,6 +435,52 @@ def bench_vgg16_infer(batch=64, chain=60):
     return {"ms_per_batch": round(sec * 1e3, 3), "batch": batch}
 
 
+def bench_vgg16_cifar_infer(batch=512, chain=60):
+    """The reference's cifar10 fp16 table (float16_benchmark.md:61-63:
+    VGG16 cifar10 fp32 44.97 / fp16 17.37 ms at mb=512 on V100) —
+    bf16 on TPU via the same transpiles as the ImageNet legs."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.vgg import vgg
+
+    rng = np.random.RandomState(0)
+
+    def feed():
+        return {"image": jax.device_put(jnp.asarray(
+            rng.rand(batch, 3, 32, 32).astype(np.float32),
+            jnp.bfloat16))}
+
+    sec = _bench_infer(
+        lambda: vgg(16, class_dim=10, img_shape=(3, 32, 32),
+                    is_test=True),
+        feed, "logits", chain)
+    return {"ms_per_batch": round(sec * 1e3, 3), "batch": batch}
+
+
+def bench_resnet32_cifar_infer(batch=512, chain=100):
+    """The reference's cifar10 fp16 table (float16_benchmark.md:72-74:
+    ResNet32 cifar10 fp32 21.16 / fp16 11.02 ms at mb=512 on V100)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.resnet import resnet_cifar10
+
+    rng = np.random.RandomState(0)
+
+    def feed():
+        return {
+            "image": jax.device_put(jnp.asarray(
+                rng.rand(batch, 3, 32, 32).astype(np.float32),
+                jnp.bfloat16)),
+            "label": jax.device_put(np.zeros((batch, 1), np.int64)),
+        }
+
+    sec = _bench_infer(lambda: resnet_cifar10(is_test=True), feed,
+                       "logits", chain)
+    return {"ms_per_batch": round(sec * 1e3, 3), "batch": batch}
+
+
 def bench_resnet50_infer_int8(batch=128, chain=100):
     """True-int8 inference (round-3 verdict do-this #3; reference
     inference/tests/api/int8_mkldnn_quantization.md): every conv/mul
@@ -626,6 +674,10 @@ _LEG_FUNCS = {
     "infer": "bench_resnet50_infer",
     "vgg_infer": "bench_vgg16_infer",
     "longctx": "bench_longctx_train",
+    # the reference's cifar10 fp16 table rows (float16_benchmark.md
+    # :56-74) — cheap bf16 legs, so they ride ahead of int8
+    "vgg_cifar": "bench_vgg16_cifar_infer",
+    "rn32_cifar": "bench_resnet32_cifar_infer",
     # int8 LAST: on 2026-07-31 its on-chip compile died with a backend
     # UNAVAILABLE that wedged the tunnel for every later leg; running
     # it at the end means a repeat costs only this leg
@@ -645,6 +697,8 @@ _TINY = {
     # degraded run bounded with the smallest honest shape
     "infer_i8": dict(batch=2, chain=1),
     "vgg_infer": dict(batch=4, chain=2),
+    "vgg_cifar": dict(batch=16, chain=2),
+    "rn32_cifar": dict(batch=32, chain=2),
     # the degraded CPU leg runs plain XLA attention (impl auto-detect
     # picks "xla" off-TPU) — it checks ladder liveness, not the
     # kernel; its metric key drops the "flash" claim accordingly
@@ -791,6 +845,11 @@ def main():
             row("infer_i8"),
         key("vgg16_infer_bf16_mb64", "vgg_infer", mb="batch"):
             infer_row("vgg_infer", BASELINE_VGG16_MB64_MS),
+        key("vgg16_cifar10_infer_bf16_mb512", "vgg_cifar", mb="batch"):
+            infer_row("vgg_cifar", BASELINE_VGG16_CIFAR_MS),
+        key("resnet32_cifar10_infer_bf16_mb512", "rn32_cifar",
+            mb="batch"):
+            infer_row("rn32_cifar", BASELINE_RN32_CIFAR_MS),
         # degraded CPU legs time plain XLA attention (auto-detect picks
         # "xla" off-TPU), so the degraded key must not claim "flash"
         key("longctx_flash_train_seq32768"

@@ -81,3 +81,30 @@ def resnet(depth=50, num_classes=1000, image_shape=(3, 224, 224),
 
 def resnet50(**kwargs):
     return resnet(depth=50, **kwargs)
+
+
+def resnet_cifar10(depth=32, num_classes=10, image_shape=(3, 32, 32),
+                   is_test=False):
+    """The classic CIFAR ResNet (reference resnet_cifar10,
+    tests/book/test_image_classification.py:28 — also the ResNet32 row
+    of contrib/float16/float16_benchmark.md:72-74): 3x3/16ch stem, three
+    stages of (depth-2)/6 basic blocks at widths 16/32/64 with strides
+    1/2/2, global average pool, fc head."""
+    if (depth - 2) % 6 != 0:
+        raise ValueError("cifar resnet depth must be 6n+2, got %d"
+                         % depth)
+    n = (depth - 2) // 6
+    image = layers.data("image", shape=list(image_shape),
+                        dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    x = _conv_bn(image, 16, 3, act="relu", is_test=is_test)
+    for stage, width in enumerate((16, 32, 64)):
+        for i in range(n):
+            stride = 2 if i == 0 and stage > 0 else 1
+            x = _basic_block(x, width, stride, is_test=is_test)
+    pool = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    logits = layers.fc(pool, size=num_classes)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return {"image": image, "label": label, "logits": logits,
+            "loss": loss, "acc": acc}

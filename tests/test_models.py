@@ -41,6 +41,31 @@ def test_resnet_tiny_cifar_trains():
     assert losses[-1] < losses[0], losses
 
 
+def test_resnet_cifar10_trains_and_benches():
+    """resnet_cifar10 (reference tests/book/test_image_classification
+    .py:28, the ResNet32 row of float16_benchmark.md:72-74): trains,
+    and the bench leg's bf16+NHWC inference build runs on CPU."""
+    from paddle_tpu.models.resnet import resnet_cifar10
+
+    with pytest.raises(ValueError):
+        resnet_cifar10(depth=33)
+    model = resnet_cifar10(depth=8)  # 6n+2, n=1: one block per stage
+    rng = np.random.RandomState(0)
+    img = rng.rand(8, 3, 32, 32).astype(np.float32)
+    lab = rng.randint(0, 10, (8, 1)).astype(np.int64)
+    losses = _train(model["loss"],
+                    lambda i: {"image": img, "label": lab},
+                    steps=12, lr=1e-3)
+    assert losses[-1] < losses[0], losses
+
+    import bench
+
+    for leg in ("vgg_cifar", "rn32_cifar"):
+        res = getattr(bench, bench._LEG_FUNCS[leg])(
+            **{**bench._TINY[leg], "chain": 1})
+        assert res["ms_per_batch"] > 0, (leg, res)
+
+
 def test_transformer_tiny_trains():
     model = transformer_encoder_model(
         vocab_size=128, max_len=16, d_model=32, n_head=4, d_inner=64,

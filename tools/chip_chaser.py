@@ -57,6 +57,10 @@ TASKS = [
     ("tf_train_mb128", "tf_train", {"batch": 128, "chain": 10}),
     ("bert_train_mb16", "bert_train", {"batch": 16, "chain": 10}),
     ("rn_train_mb512", "rn_train", {"batch": 512, "chain": 10}),
+    # the reference's cifar10 fp16 table rows (float16_benchmark.md
+    # :56-74) — cheap bf16 legs
+    ("vgg16_cifar_infer_mb512", "vgg_cifar", {}),
+    ("resnet32_cifar_infer_mb512", "rn32_cifar", {}),
     # "script:" tasks run a standalone tool instead of a bench leg;
     # the primitive probe separates "int8 lowering is broken" from
     # "the tunnel window closed" before the full leg re-runs

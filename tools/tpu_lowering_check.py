@@ -54,6 +54,9 @@ def _workloads():
             bench._build_resnet50_infer_int8(128)[:3],
         "resnet50_infer": lambda: _infer(bench, "resnet", 128),
         "vgg16_infer": lambda: _infer(bench, "vgg", 64),
+        "vgg16_cifar_infer": lambda: _infer(bench, "vgg_cifar", 512),
+        "resnet32_cifar_infer": lambda: _infer(bench, "rn32_cifar",
+                                               512),
         "longctx_train": lambda: bench._build_longctx_train()[:3],
     }
 
@@ -71,6 +74,25 @@ def _infer(bench, which, batch):
                 rng.rand(batch, 3, 224, 224).astype(np.float32),
                 jnp.bfloat16),
             "label": jnp.zeros((batch, 1), jnp.int32)}
+    elif which == "rn32_cifar":
+        from paddle_tpu.models.resnet import resnet_cifar10 as build
+
+        feed = lambda: {  # noqa: E731
+            "image": jnp.asarray(
+                rng.rand(batch, 3, 32, 32).astype(np.float32),
+                jnp.bfloat16),
+            "label": jnp.zeros((batch, 1), jnp.int32)}
+    elif which == "vgg_cifar":
+        from paddle_tpu.models.vgg import vgg
+
+        def build(is_test):
+            return vgg(16, class_dim=10, img_shape=(3, 32, 32),
+                       is_test=is_test)
+
+        feed = lambda: {  # noqa: E731
+            "image": jnp.asarray(
+                rng.rand(batch, 3, 32, 32).astype(np.float32),
+                jnp.bfloat16)}
     else:
         from paddle_tpu.models.vgg import vgg16 as build
 
