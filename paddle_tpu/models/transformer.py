@@ -12,6 +12,19 @@ from __future__ import annotations
 import numpy as np
 
 from paddle_tpu import layers
+from paddle_tpu.param_attr import ParamAttr as _ParamAttr
+
+
+def _w(pfx, part):
+    """Deterministic weight name under a prefix (None -> auto names).
+    Explicit names let a separately-built program (e.g. the KV-cache
+    decode loop) share this model's trained parameters through the
+    scope, the fluid ParamAttr(name=...) sharing idiom."""
+    return _ParamAttr(name=f"{pfx}_{part}.w") if pfx else None
+
+
+def _b(pfx, part):
+    return _ParamAttr(name=f"{pfx}_{part}.b") if pfx else None
 
 
 def _positional_encoding(max_len, d_model, dtype="float32"):
@@ -26,7 +39,8 @@ def _positional_encoding(max_len, d_model, dtype="float32"):
 
 def multi_head_attention(q_in, kv_in, d_model, n_head, dropout_rate=0.0,
                          causal=False, is_test=False, seq_len_q=None,
-                         seq_len_kv=None, name=None, use_flash=True):
+                         seq_len_kv=None, name=None, use_flash=True,
+                         pfx=None):
     """q_in: [B, Tq, D]; kv_in: [B, Tk, D].
 
     When attention-weight dropout is off the score+softmax+weighted-sum is
@@ -38,17 +52,16 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout_rate=0.0,
     tq = q_in.shape[1]
     tk = kv_in.shape[1]
     head_dim = d_model // n_head
-    q = layers.fc(q_in, d_model, num_flatten_dims=2, bias_attr=False)
-    k = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False)
-    v = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False)
+    q = layers.fc(q_in, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=_w(pfx, "q"))
+    k = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=_w(pfx, "k"))
+    v = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False,
+                  param_attr=_w(pfx, "v"))
 
-    def split_heads(x, t):
-        x = layers.reshape(x, [-1, t, n_head, head_dim])
-        return layers.transpose(x, [0, 2, 1, 3])  # [B, H, T, hd]
-
-    q = split_heads(q, tq)
-    k = split_heads(k, tk)
-    v = split_heads(v, tk)
+    q = _split_heads(q, tq, n_head, head_dim)
+    k = _split_heads(k, tk, n_head, head_dim)
+    v = _split_heads(v, tk, n_head, head_dim)
     weight_dropout = bool(dropout_rate) and not is_test
     if use_flash and not weight_dropout:
         out = layers.flash_attention(q, k, v, causal=causal)
@@ -71,49 +84,63 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout_rate=0.0,
 
     out = layers.transpose(out, [0, 2, 1, 3])
     out = layers.reshape(out, [-1, tq, d_model])
-    return layers.fc(out, d_model, num_flatten_dims=2, bias_attr=False)
+    return layers.fc(out, d_model, num_flatten_dims=2, bias_attr=False,
+                     param_attr=_w(pfx, "out"))
 
 
-def _ffn(x, d_model, d_inner, dropout_rate, is_test):
-    h = layers.fc(x, d_inner, num_flatten_dims=2, act="relu")
+def _ffn(x, d_model, d_inner, dropout_rate, is_test, pfx=None):
+    h = layers.fc(x, d_inner, num_flatten_dims=2, act="relu",
+                  param_attr=_w(pfx, "fc1"), bias_attr=_b(pfx, "fc1"))
     if dropout_rate and not is_test:
         h = layers.dropout(h, dropout_rate,
                            dropout_implementation="upscale_in_train")
-    return layers.fc(h, d_model, num_flatten_dims=2)
+    return layers.fc(h, d_model, num_flatten_dims=2,
+                     param_attr=_w(pfx, "fc2"), bias_attr=_b(pfx, "fc2"))
 
 
-def _residual_norm(x, sub, dropout_rate, is_test):
+def _residual_norm(x, sub, dropout_rate, is_test, pfx=None):
     if dropout_rate and not is_test:
         sub = layers.dropout(sub, dropout_rate,
                              dropout_implementation="upscale_in_train")
-    return layers.layer_norm(layers.elementwise_add(x, sub),
-                             begin_norm_axis=2)
+    return layers.layer_norm(
+        layers.elementwise_add(x, sub), begin_norm_axis=2,
+        param_attr=(_ParamAttr(name=f"{pfx}.scale") if pfx else None),
+        bias_attr=(_ParamAttr(name=f"{pfx}.bias") if pfx else None))
 
 
 def encoder_layer(x, d_model, n_head, d_inner, dropout_rate=0.1,
-                  is_test=False):
+                  is_test=False, pfx=None):
+    sp = (lambda s: f"{pfx}_{s}") if pfx else (lambda s: None)
     attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
-                                is_test=is_test)
-    x = _residual_norm(x, attn, dropout_rate, is_test)
-    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
-    return _residual_norm(x, ffn, dropout_rate, is_test)
+                                is_test=is_test, pfx=sp("self"))
+    x = _residual_norm(x, attn, dropout_rate, is_test, pfx=sp("ln1"))
+    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test,
+               pfx=sp("ffn"))
+    return _residual_norm(x, ffn, dropout_rate, is_test, pfx=sp("ln2"))
 
 
 def decoder_layer(x, enc_out, d_model, n_head, d_inner, dropout_rate=0.1,
-                  is_test=False):
+                  is_test=False, pfx=None):
+    sp = (lambda s: f"{pfx}_{s}") if pfx else (lambda s: None)
     self_attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
-                                     causal=True, is_test=is_test)
-    x = _residual_norm(x, self_attn, dropout_rate, is_test)
+                                     causal=True, is_test=is_test,
+                                     pfx=sp("self"))
+    x = _residual_norm(x, self_attn, dropout_rate, is_test,
+                       pfx=sp("ln1"))
     cross = multi_head_attention(x, enc_out, d_model, n_head,
-                                 dropout_rate, is_test=is_test)
-    x = _residual_norm(x, cross, dropout_rate, is_test)
-    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
-    return _residual_norm(x, ffn, dropout_rate, is_test)
+                                 dropout_rate, is_test=is_test,
+                                 pfx=sp("cross"))
+    x = _residual_norm(x, cross, dropout_rate, is_test, pfx=sp("ln2"))
+    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test,
+               pfx=sp("ffn"))
+    return _residual_norm(x, ffn, dropout_rate, is_test, pfx=sp("ln3"))
 
 
 def _embed(ids, vocab_size, d_model, max_len, dropout_rate, is_test,
-           scale_embedding=True):
-    emb = layers.embedding(ids, size=[vocab_size, d_model])
+           scale_embedding=True, pfx=None):
+    emb = layers.embedding(
+        ids, size=[vocab_size, d_model],
+        param_attr=(_ParamAttr(name=f"{pfx}.w") if pfx else None))
     if scale_embedding:
         emb = layers.scale(emb, scale=float(d_model) ** 0.5)
     pe = layers.assign(
@@ -160,23 +187,186 @@ def transformer_encoder_model(
 def transformer_nmt_model(
     src_vocab_size=32000, tgt_vocab_size=32000, max_len=256, d_model=512,
     n_head=8, d_inner=2048, n_layer=6, dropout_rate=0.1, is_test=False,
+    param_prefix=None,
 ):
-    """Encoder-decoder NMT transformer (Transformer-base when defaults)."""
+    """Encoder-decoder NMT transformer (Transformer-base when defaults).
+
+    param_prefix: when set, every parameter gets a deterministic name
+    under the prefix so a separately-built program — the KV-cache
+    `transformer_nmt_greedy_decode` loop — shares the trained weights
+    through the scope."""
+    p = param_prefix
+    sp = (lambda s: f"{p}_{s}") if p else (lambda s: None)
     src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
     tgt = layers.data("tgt_ids", shape=[max_len, 1], dtype="int64")
     label = layers.data("tgt_label", shape=[max_len, 1], dtype="int64")
     enc = _embed(src, src_vocab_size, d_model, max_len, dropout_rate,
-                 is_test)
-    for _ in range(n_layer):
+                 is_test, pfx=sp("src_emb"))
+    for li in range(n_layer):
         enc = encoder_layer(enc, d_model, n_head, d_inner, dropout_rate,
-                            is_test)
+                            is_test, pfx=sp(f"enc{li}"))
     dec = _embed(tgt, tgt_vocab_size, d_model, max_len, dropout_rate,
-                 is_test)
-    for _ in range(n_layer):
+                 is_test, pfx=sp("tgt_emb"))
+    for li in range(n_layer):
         dec = decoder_layer(dec, enc, d_model, n_head, d_inner,
-                            dropout_rate, is_test)
+                            dropout_rate, is_test, pfx=sp(f"dec{li}"))
     logits = layers.fc(dec, tgt_vocab_size, num_flatten_dims=2,
-                       bias_attr=False)
+                       bias_attr=False, param_attr=_w(p, "out_fc"))
     loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
     return {"src_ids": src, "tgt_ids": tgt, "tgt_label": label,
             "logits": logits, "loss": loss}
+
+
+def _split_heads(x, t, n_head, head_dim):
+    x = layers.reshape(x, [-1, t, n_head, head_dim])
+    return layers.transpose(x, [0, 2, 1, 3])          # [B, H, T, hd]
+
+
+def transformer_nmt_greedy_decode(
+    src_vocab_size=32000, tgt_vocab_size=32000, max_len=256, d_model=512,
+    n_head=8, d_inner=2048, n_layer=6, param_prefix="tfm",
+    decode_len=32, bos_id=1,
+):
+    """Autoregressive greedy decoding with per-layer KV caches — the
+    modern TPU-native successor of the reference's RNN-era
+    BeamSearchDecoder (contrib/decoder/beam_search_decoder.py:523): one
+    `lax.scan` (via StaticRNN) whose carry holds the last token and the
+    self-attention K/V caches, all static shapes.  Each step attends
+    the single new query against the cache (O(T) per step instead of
+    re-running the O(T^2) decoder stack), writes its K/V at the step
+    index, and feeds the argmax token back.
+
+    Build this in its OWN program (fresh program_guard) with the same
+    `param_prefix` used for `transformer_nmt_model`: the deterministic
+    parameter names make the decode program read the trained weights
+    from the scope.  Do not run its startup program.
+
+    Returns {"src_ids": data var, "out_ids": [B, decode_len, 1] int64,
+    "step_logits": [B, decode_len, vocab]}.
+    """
+    from paddle_tpu.layers.control_flow import StaticRNN
+
+    if not param_prefix:
+        raise ValueError(
+            "transformer_nmt_greedy_decode needs the param_prefix the "
+            "training model was built with (weight sharing is by name)")
+    p = param_prefix
+    hd = d_model // n_head
+    src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
+    enc = _embed(src, src_vocab_size, d_model, max_len, 0.0, True,
+                 pfx=f"{p}_src_emb")
+    for li in range(n_layer):
+        enc = encoder_layer(enc, d_model, n_head, d_inner, 0.0, True,
+                            pfx=f"{p}_enc{li}")
+    # cross-attention K/V depend only on the encoder output: compute
+    # them ONCE outside the decode loop (the KV-cache trick's encoder
+    # half), with the weight names the training build gave these fc's
+    cross_kv = []
+    for li in range(n_layer):
+        ck = layers.fc(enc, d_model, num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=_w(f"{p}_dec{li}_cross", "k"))
+        cv = layers.fc(enc, d_model, num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=_w(f"{p}_dec{li}_cross", "v"))
+        cross_kv.append((_split_heads(ck, max_len, n_head, hd),
+                         _split_heads(cv, max_len, n_head, hd)))
+
+    pe = layers.assign(_positional_encoding(decode_len, d_model))
+    pos_seq = layers.assign(
+        np.arange(decode_len, dtype=np.int64)[:, None])   # [T, 1]
+    kpos = layers.assign(np.arange(decode_len, dtype=np.int64))
+    # ids stay 3-D [B, 1, 1] like the training feed: lookup_table's
+    # 2-D-ids form returns [B, D] (reference semantics), which would
+    # broadcast the positional add into the wrong rank
+    bos = layers.fill_constant_batch_size_like(
+        src, shape=[-1, 1, 1], dtype="int64", value=float(bos_id))
+    cache_init = [
+        (layers.fill_constant_batch_size_like(
+            src, shape=[decode_len, -1, d_model], dtype="float32",
+            value=0.0, output_dim_idx=1),
+         layers.fill_constant_batch_size_like(
+            src, shape=[decode_len, -1, d_model], dtype="float32",
+            value=0.0, output_dim_idx=1))
+        for _ in range(n_layer)]
+
+    rnn = StaticRNN()
+    with rnn.step():
+        pos = rnn.step_input(pos_seq)                     # [1] int64
+        cur = rnn.memory(init=bos)                        # [B, 1, 1]
+        caches = [(rnn.memory(init=k0), rnn.memory(init=v0))
+                  for k0, v0 in cache_init]               # [T, B, D]
+        x = layers.embedding(
+            cur, size=[tgt_vocab_size, d_model],
+            param_attr=_ParamAttr(name=f"{p}_tgt_emb.w"))  # [B, 1, D]
+        x = layers.scale(x, scale=float(d_model) ** 0.5)
+        pe_t = layers.gather(pe, pos)                     # [1, D]
+        x = layers.elementwise_add(
+            x, layers.reshape(pe_t, [1, 1, d_model]))
+        for li in range(n_layer):
+            sp = f"{p}_dec{li}"
+            kc_pre, vc_pre = caches[li]
+            # self-attention: new token's q against the cache
+            q = layers.fc(x, d_model, num_flatten_dims=2,
+                          bias_attr=False,
+                          param_attr=_w(f"{sp}_self", "q"))
+            k = layers.fc(x, d_model, num_flatten_dims=2,
+                          bias_attr=False,
+                          param_attr=_w(f"{sp}_self", "k"))
+            v = layers.fc(x, d_model, num_flatten_dims=2,
+                          bias_attr=False,
+                          param_attr=_w(f"{sp}_self", "v"))
+            kc = layers.scatter(kc_pre, pos,
+                                layers.transpose(k, [1, 0, 2]))
+            vc = layers.scatter(vc_pre, pos,
+                                layers.transpose(v, [1, 0, 2]))
+            rnn.update_memory(kc_pre, kc)
+            rnn.update_memory(vc_pre, vc)
+            q_h = _split_heads(q, 1, n_head, hd)          # [B, H, 1, hd]
+            ck = layers.transpose(layers.reshape(
+                kc, [decode_len, -1, n_head, hd]), [1, 2, 0, 3])
+            cv = layers.transpose(layers.reshape(
+                vc, [decode_len, -1, n_head, hd]), [1, 2, 0, 3])
+            s = layers.matmul(q_h, ck, transpose_y=True,
+                              alpha=float(hd) ** -0.5)    # [B, H, 1, T]
+            # positions beyond the current step hold zeros: mask them
+            valid = layers.cast(layers.less_equal(kpos, pos), "float32")
+            s = layers.elementwise_add(s, layers.reshape(
+                layers.scale(valid, scale=1e9, bias=-1e9),
+                [1, 1, 1, decode_len]))
+            o = layers.matmul(layers.softmax(s), cv)      # [B, H, 1, hd]
+            o = layers.reshape(layers.transpose(o, [0, 2, 1, 3]),
+                               [-1, 1, d_model])
+            o = layers.fc(o, d_model, num_flatten_dims=2,
+                          bias_attr=False,
+                          param_attr=_w(f"{sp}_self", "out"))
+            x = _residual_norm(x, o, 0.0, True, pfx=f"{sp}_ln1")
+            # cross-attention against the precomputed encoder K/V
+            q2 = layers.fc(x, d_model, num_flatten_dims=2,
+                           bias_attr=False,
+                           param_attr=_w(f"{sp}_cross", "q"))
+            enc_k, enc_v = cross_kv[li]
+            s2 = layers.matmul(_split_heads(q2, 1, n_head, hd), enc_k,
+                               transpose_y=True,
+                               alpha=float(hd) ** -0.5)
+            o2 = layers.matmul(layers.softmax(s2), enc_v)
+            o2 = layers.reshape(layers.transpose(o2, [0, 2, 1, 3]),
+                                [-1, 1, d_model])
+            o2 = layers.fc(o2, d_model, num_flatten_dims=2,
+                           bias_attr=False,
+                           param_attr=_w(f"{sp}_cross", "out"))
+            x = _residual_norm(x, o2, 0.0, True, pfx=f"{sp}_ln2")
+            ffn = _ffn(x, d_model, d_inner, 0.0, True, pfx=f"{sp}_ffn")
+            x = _residual_norm(x, ffn, 0.0, True, pfx=f"{sp}_ln3")
+        logits = layers.fc(x, tgt_vocab_size, num_flatten_dims=2,
+                           bias_attr=False,
+                           param_attr=_w(p, "out_fc"))    # [B, 1, V]
+        nxt = layers.argmax(logits, axis=-1)              # [B, 1] int64
+        rnn.update_memory(cur, layers.reshape(nxt, [-1, 1, 1]))
+        rnn.step_output(nxt)
+        rnn.step_output(layers.reshape(logits, [-1, tgt_vocab_size]))
+    ids_tm, logits_tm = rnn()            # [T, B, 1], [T, B, V]
+    out_ids = layers.transpose(ids_tm, [1, 0, 2])         # [B, T, 1]
+    step_logits = layers.transpose(logits_tm, [1, 0, 2])  # [B, T, V]
+    return {"src_ids": src, "out_ids": out_ids,
+            "step_logits": step_logits}
