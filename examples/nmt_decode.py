@@ -1,0 +1,79 @@
+"""Train a tiny NMT transformer, then generate with the KV-cache
+greedy decode loop — autoregressive inference as ONE compiled XLA
+module (a lax.scan whose carry holds the token + per-layer K/V caches).
+
+The training model is built with `param_prefix` so its parameters get
+deterministic names; the decode program, built separately, shares the
+trained weights through the scope by those names (never run the decode
+startup program).
+
+  python examples/nmt_decode.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  os.environ.get("PADDLE_TPU_PLATFORM", "cpu"))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import optimizer
+from paddle_tpu.framework import Program, program_guard
+from paddle_tpu.models.transformer import (
+    transformer_nmt_greedy_decode,
+    transformer_nmt_model,
+)
+
+
+def main():
+    np.random.seed(0)
+    vocab, seq = 32, 8
+    cfg = dict(d_model=32, n_head=4, d_inner=64, n_layer=2)
+    model = transformer_nmt_model(
+        src_vocab_size=vocab, tgt_vocab_size=vocab, max_len=seq,
+        dropout_rate=0.0, param_prefix="nmt", **cfg)
+    optimizer.Adam(5e-3).minimize(model["loss"])
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    compiled = fluid.CompiledProgram(fluid.default_main_program())
+
+    # copy task: the decoder must learn to reproduce the source
+    rng = np.random.RandomState(1)
+    src = rng.randint(2, vocab, (8, seq, 1)).astype(np.int64)
+    tgt_in = np.concatenate(
+        [np.ones((8, 1, 1), np.int64), src[:, :-1]], axis=1)
+    for step in range(200):
+        (loss,) = exe.run(
+            compiled,
+            feed={"src_ids": src, "tgt_ids": tgt_in, "tgt_label": src},
+            fetch_list=[model["loss"]])
+        if step % 50 == 0:
+            print(f"step {step:3d} loss {float(loss):.4f}")
+
+    decode_prog, decode_startup = Program(), Program()
+    with program_guard(decode_prog, decode_startup):
+        dec = transformer_nmt_greedy_decode(
+            src_vocab_size=vocab, tgt_vocab_size=vocab, max_len=seq,
+            param_prefix="nmt", decode_len=seq, bos_id=1, **cfg)
+    (out_ids,) = exe.run(
+        fluid.CompiledProgram(decode_prog), feed={"src_ids": src},
+        fetch_list=[dec["out_ids"]])
+    acc = float((out_ids[:, :, 0] == src[:, :, 0]).mean())
+    print("greedy decode reproduces the source:",
+          f"{100 * acc:.0f}% token match")
+    print("src[0]    :", src[0, :, 0].tolist())
+    print("decoded[0]:", out_ids[0, :, 0].tolist())
+    assert acc > 0.6
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

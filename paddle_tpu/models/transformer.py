@@ -27,6 +27,12 @@ def _b(pfx, part):
     return _ParamAttr(name=f"{pfx}_{part}.b") if pfx else None
 
 
+def _sub(pfx):
+    """Sub-prefix builder: _sub(\"tfm_enc0\")(\"self\") -> \"tfm_enc0_self\";
+    a None prefix propagates None (auto names)."""
+    return (lambda s: f"{pfx}_{s}") if pfx else (lambda s: None)
+
+
 def _positional_encoding(max_len, d_model, dtype="float32"):
     pos = np.arange(max_len)[:, None]
     i = np.arange(d_model)[None, :]
@@ -110,7 +116,7 @@ def _residual_norm(x, sub, dropout_rate, is_test, pfx=None):
 
 def encoder_layer(x, d_model, n_head, d_inner, dropout_rate=0.1,
                   is_test=False, pfx=None):
-    sp = (lambda s: f"{pfx}_{s}") if pfx else (lambda s: None)
+    sp = _sub(pfx)
     attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
                                 is_test=is_test, pfx=sp("self"))
     x = _residual_norm(x, attn, dropout_rate, is_test, pfx=sp("ln1"))
@@ -121,7 +127,7 @@ def encoder_layer(x, d_model, n_head, d_inner, dropout_rate=0.1,
 
 def decoder_layer(x, enc_out, d_model, n_head, d_inner, dropout_rate=0.1,
                   is_test=False, pfx=None):
-    sp = (lambda s: f"{pfx}_{s}") if pfx else (lambda s: None)
+    sp = _sub(pfx)
     self_attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
                                      causal=True, is_test=is_test,
                                      pfx=sp("self"))
@@ -196,7 +202,7 @@ def transformer_nmt_model(
     `transformer_nmt_greedy_decode` loop — shares the trained weights
     through the scope."""
     p = param_prefix
-    sp = (lambda s: f"{p}_{s}") if p else (lambda s: None)
+    sp = _sub(p)
     src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
     tgt = layers.data("tgt_ids", shape=[max_len, 1], dtype="int64")
     label = layers.data("tgt_label", shape=[max_len, 1], dtype="int64")
@@ -224,7 +230,7 @@ def _split_heads(x, t, n_head, head_dim):
 
 def transformer_nmt_greedy_decode(
     src_vocab_size=32000, tgt_vocab_size=32000, max_len=256, d_model=512,
-    n_head=8, d_inner=2048, n_layer=6, param_prefix="tfm",
+    n_head=8, d_inner=2048, n_layer=6, param_prefix=None,
     decode_len=32, bos_id=1,
 ):
     """Autoregressive greedy decoding with per-layer KV caches — the

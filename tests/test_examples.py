@@ -17,6 +17,7 @@ EX = os.path.join(ROOT, "examples")
     ("ps_cluster.py", 420),
     ("long_context_ring.py", 300),
     ("scale_out_hybrid.py", 300),
+    ("nmt_decode.py", 420),
 ])
 def test_example_runs(script, timeout):
     env = {**os.environ, "PADDLE_TPU_PLATFORM": "cpu"}
