@@ -308,6 +308,16 @@ class StaticRNN:
             return self._outputs[0]
         return list(self._outputs)
 
+    def final(self, mem):
+        """Outer var holding `mem`'s value after the last step (mem: the
+        pre var returned by memory())."""
+        if self._outputs is None:
+            raise RuntimeError("StaticRNN used before step block closed")
+        for (_, pre), fv in zip(self._memories, self._finals):
+            if pre.name == mem.name:
+                return fv
+        raise ValueError(f"'{mem.name}' is not a memory of this RNN")
+
 
 class DynamicRNN:
     """Variable-length RNN over batch-major [B, T, D] inputs with a

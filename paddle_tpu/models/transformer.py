@@ -228,6 +228,111 @@ def _split_heads(x, t, n_head, head_dim):
     return layers.transpose(x, [0, 2, 1, 3])          # [B, H, T, hd]
 
 
+def _decode_encoder(p, src_vocab_size, max_len, d_model, n_head,
+                    d_inner, n_layer):
+    """Encoder pass for the decode builders + per-layer cross-attention
+    K/V, computed ONCE outside the decode loop (the KV-cache trick's
+    encoder half) with the weight names the training build gave these
+    fc's.  Returns (src data var, [(enc_k, enc_v)] per layer,
+    each [B, H, Tsrc, hd])."""
+    hd = d_model // n_head
+    src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
+    enc = _embed(src, src_vocab_size, d_model, max_len, 0.0, True,
+                 pfx=f"{p}_src_emb")
+    for li in range(n_layer):
+        enc = encoder_layer(enc, d_model, n_head, d_inner, 0.0, True,
+                            pfx=f"{p}_enc{li}")
+    cross_kv = []
+    for li in range(n_layer):
+        ck = layers.fc(enc, d_model, num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=_w(f"{p}_dec{li}_cross", "k"))
+        cv = layers.fc(enc, d_model, num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=_w(f"{p}_dec{li}_cross", "v"))
+        cross_kv.append((_split_heads(ck, max_len, n_head, hd),
+                         _split_heads(cv, max_len, n_head, hd)))
+    return src, cross_kv
+
+
+def _cache_attention(q, kc, vc, pos, kpos, decode_len, n_head, hd):
+    """Single-query attention against a [T, N, D] cache: positions
+    beyond the current step hold zeros and are masked off."""
+    q_h = _split_heads(q, 1, n_head, hd)                  # [N, H, 1, hd]
+    ck = layers.transpose(layers.reshape(
+        kc, [decode_len, -1, n_head, hd]), [1, 2, 0, 3])
+    cv = layers.transpose(layers.reshape(
+        vc, [decode_len, -1, n_head, hd]), [1, 2, 0, 3])
+    s = layers.matmul(q_h, ck, transpose_y=True,
+                      alpha=float(hd) ** -0.5)            # [N, H, 1, T]
+    valid = layers.cast(layers.less_equal(kpos, pos), "float32")
+    s = layers.elementwise_add(s, layers.reshape(
+        layers.scale(valid, scale=1e9, bias=-1e9),
+        [1, 1, 1, decode_len]))
+    o = layers.matmul(layers.softmax(s), cv)              # [N, H, 1, hd]
+    return layers.reshape(layers.transpose(o, [0, 2, 1, 3]),
+                          [-1, 1, hd * n_head])
+
+
+def _decode_step(cur, pos, caches, cross_kv, p, tgt_vocab_size,
+                 decode_len, d_model, n_head, d_inner, n_layer, kpos,
+                 pe):
+    """One decoder-stack step on the current token(s): embeds `cur`
+    ([N, 1, 1] ids), writes each layer's new K/V into its cache at
+    `pos`, attends cache + precomputed cross K/V.  Returns
+    ([N, 1, V] logits, [(kc, vc)] updated caches — the caller registers
+    them as memory updates, possibly after beam reordering).  N is B
+    for greedy decode, B*beam for beam search — every op is row-wise
+    in N, so the same step serves both."""
+    hd = d_model // n_head
+    x = layers.embedding(
+        cur, size=[tgt_vocab_size, d_model],
+        param_attr=_ParamAttr(name=f"{p}_tgt_emb.w"))     # [N, 1, D]
+    x = layers.scale(x, scale=float(d_model) ** 0.5)
+    pe_t = layers.gather(pe, pos)                         # [1, D]
+    x = layers.elementwise_add(
+        x, layers.reshape(pe_t, [1, 1, d_model]))
+    new_caches = []
+    for li in range(n_layer):
+        sp = f"{p}_dec{li}"
+        kc_pre, vc_pre = caches[li]
+        # self-attention: new token's q against the cache
+        q = layers.fc(x, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=_w(f"{sp}_self", "q"))
+        k = layers.fc(x, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=_w(f"{sp}_self", "k"))
+        v = layers.fc(x, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=_w(f"{sp}_self", "v"))
+        kc = layers.scatter(kc_pre, pos,
+                            layers.transpose(k, [1, 0, 2]))
+        vc = layers.scatter(vc_pre, pos,
+                            layers.transpose(v, [1, 0, 2]))
+        new_caches.append((kc, vc))
+        o = _cache_attention(q, kc, vc, pos, kpos, decode_len, n_head,
+                             hd)
+        o = layers.fc(o, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=_w(f"{sp}_self", "out"))
+        x = _residual_norm(x, o, 0.0, True, pfx=f"{sp}_ln1")
+        # cross-attention against the precomputed encoder K/V
+        q2 = layers.fc(x, d_model, num_flatten_dims=2, bias_attr=False,
+                       param_attr=_w(f"{sp}_cross", "q"))
+        enc_k, enc_v = cross_kv[li]
+        s2 = layers.matmul(_split_heads(q2, 1, n_head, hd), enc_k,
+                           transpose_y=True, alpha=float(hd) ** -0.5)
+        o2 = layers.matmul(layers.softmax(s2), enc_v)
+        o2 = layers.reshape(layers.transpose(o2, [0, 2, 1, 3]),
+                            [-1, 1, d_model])
+        o2 = layers.fc(o2, d_model, num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=_w(f"{sp}_cross", "out"))
+        x = _residual_norm(x, o2, 0.0, True, pfx=f"{sp}_ln2")
+        ffn = _ffn(x, d_model, d_inner, 0.0, True, pfx=f"{sp}_ffn")
+        x = _residual_norm(x, ffn, 0.0, True, pfx=f"{sp}_ln3")
+    logits = layers.fc(x, tgt_vocab_size, num_flatten_dims=2,
+                       bias_attr=False, param_attr=_w(p, "out_fc"))
+    return logits, new_caches
+
+
 def transformer_nmt_greedy_decode(
     src_vocab_size=32000, tgt_vocab_size=32000, max_len=256, d_model=512,
     n_head=8, d_inner=2048, n_layer=6, param_prefix=None,
@@ -257,27 +362,8 @@ def transformer_nmt_greedy_decode(
             "transformer_nmt_greedy_decode needs the param_prefix the "
             "training model was built with (weight sharing is by name)")
     p = param_prefix
-    hd = d_model // n_head
-    src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
-    enc = _embed(src, src_vocab_size, d_model, max_len, 0.0, True,
-                 pfx=f"{p}_src_emb")
-    for li in range(n_layer):
-        enc = encoder_layer(enc, d_model, n_head, d_inner, 0.0, True,
-                            pfx=f"{p}_enc{li}")
-    # cross-attention K/V depend only on the encoder output: compute
-    # them ONCE outside the decode loop (the KV-cache trick's encoder
-    # half), with the weight names the training build gave these fc's
-    cross_kv = []
-    for li in range(n_layer):
-        ck = layers.fc(enc, d_model, num_flatten_dims=2,
-                       bias_attr=False,
-                       param_attr=_w(f"{p}_dec{li}_cross", "k"))
-        cv = layers.fc(enc, d_model, num_flatten_dims=2,
-                       bias_attr=False,
-                       param_attr=_w(f"{p}_dec{li}_cross", "v"))
-        cross_kv.append((_split_heads(ck, max_len, n_head, hd),
-                         _split_heads(cv, max_len, n_head, hd)))
-
+    src, cross_kv = _decode_encoder(p, src_vocab_size, max_len, d_model,
+                                    n_head, d_inner, n_layer)
     pe = layers.assign(_positional_encoding(decode_len, d_model))
     pos_seq = layers.assign(
         np.arange(decode_len, dtype=np.int64)[:, None])   # [T, 1]
@@ -302,71 +388,12 @@ def transformer_nmt_greedy_decode(
         cur = rnn.memory(init=bos)                        # [B, 1, 1]
         caches = [(rnn.memory(init=k0), rnn.memory(init=v0))
                   for k0, v0 in cache_init]               # [T, B, D]
-        x = layers.embedding(
-            cur, size=[tgt_vocab_size, d_model],
-            param_attr=_ParamAttr(name=f"{p}_tgt_emb.w"))  # [B, 1, D]
-        x = layers.scale(x, scale=float(d_model) ** 0.5)
-        pe_t = layers.gather(pe, pos)                     # [1, D]
-        x = layers.elementwise_add(
-            x, layers.reshape(pe_t, [1, 1, d_model]))
-        for li in range(n_layer):
-            sp = f"{p}_dec{li}"
-            kc_pre, vc_pre = caches[li]
-            # self-attention: new token's q against the cache
-            q = layers.fc(x, d_model, num_flatten_dims=2,
-                          bias_attr=False,
-                          param_attr=_w(f"{sp}_self", "q"))
-            k = layers.fc(x, d_model, num_flatten_dims=2,
-                          bias_attr=False,
-                          param_attr=_w(f"{sp}_self", "k"))
-            v = layers.fc(x, d_model, num_flatten_dims=2,
-                          bias_attr=False,
-                          param_attr=_w(f"{sp}_self", "v"))
-            kc = layers.scatter(kc_pre, pos,
-                                layers.transpose(k, [1, 0, 2]))
-            vc = layers.scatter(vc_pre, pos,
-                                layers.transpose(v, [1, 0, 2]))
+        logits, new_caches = _decode_step(
+            cur, pos, caches, cross_kv, p, tgt_vocab_size, decode_len,
+            d_model, n_head, d_inner, n_layer, kpos, pe)
+        for (kc_pre, vc_pre), (kc, vc) in zip(caches, new_caches):
             rnn.update_memory(kc_pre, kc)
             rnn.update_memory(vc_pre, vc)
-            q_h = _split_heads(q, 1, n_head, hd)          # [B, H, 1, hd]
-            ck = layers.transpose(layers.reshape(
-                kc, [decode_len, -1, n_head, hd]), [1, 2, 0, 3])
-            cv = layers.transpose(layers.reshape(
-                vc, [decode_len, -1, n_head, hd]), [1, 2, 0, 3])
-            s = layers.matmul(q_h, ck, transpose_y=True,
-                              alpha=float(hd) ** -0.5)    # [B, H, 1, T]
-            # positions beyond the current step hold zeros: mask them
-            valid = layers.cast(layers.less_equal(kpos, pos), "float32")
-            s = layers.elementwise_add(s, layers.reshape(
-                layers.scale(valid, scale=1e9, bias=-1e9),
-                [1, 1, 1, decode_len]))
-            o = layers.matmul(layers.softmax(s), cv)      # [B, H, 1, hd]
-            o = layers.reshape(layers.transpose(o, [0, 2, 1, 3]),
-                               [-1, 1, d_model])
-            o = layers.fc(o, d_model, num_flatten_dims=2,
-                          bias_attr=False,
-                          param_attr=_w(f"{sp}_self", "out"))
-            x = _residual_norm(x, o, 0.0, True, pfx=f"{sp}_ln1")
-            # cross-attention against the precomputed encoder K/V
-            q2 = layers.fc(x, d_model, num_flatten_dims=2,
-                           bias_attr=False,
-                           param_attr=_w(f"{sp}_cross", "q"))
-            enc_k, enc_v = cross_kv[li]
-            s2 = layers.matmul(_split_heads(q2, 1, n_head, hd), enc_k,
-                               transpose_y=True,
-                               alpha=float(hd) ** -0.5)
-            o2 = layers.matmul(layers.softmax(s2), enc_v)
-            o2 = layers.reshape(layers.transpose(o2, [0, 2, 1, 3]),
-                                [-1, 1, d_model])
-            o2 = layers.fc(o2, d_model, num_flatten_dims=2,
-                           bias_attr=False,
-                           param_attr=_w(f"{sp}_cross", "out"))
-            x = _residual_norm(x, o2, 0.0, True, pfx=f"{sp}_ln2")
-            ffn = _ffn(x, d_model, d_inner, 0.0, True, pfx=f"{sp}_ffn")
-            x = _residual_norm(x, ffn, 0.0, True, pfx=f"{sp}_ln3")
-        logits = layers.fc(x, tgt_vocab_size, num_flatten_dims=2,
-                           bias_attr=False,
-                           param_attr=_w(p, "out_fc"))    # [B, 1, V]
         nxt = layers.argmax(logits, axis=-1)              # [B, 1] int64
         rnn.update_memory(cur, layers.reshape(nxt, [-1, 1, 1]))
         rnn.step_output(nxt)
@@ -376,3 +403,133 @@ def transformer_nmt_greedy_decode(
     step_logits = layers.transpose(logits_tm, [1, 0, 2])  # [B, T, V]
     return {"src_ids": src, "out_ids": out_ids,
             "step_logits": step_logits}
+
+
+def transformer_nmt_beam_decode(
+    src_vocab_size=32000, tgt_vocab_size=32000, max_len=256, d_model=512,
+    n_head=8, d_inner=2048, n_layer=6, param_prefix=None,
+    decode_len=32, beam_size=4, bos_id=1, eos_id=None,
+):
+    """Beam-search decoding on the KV-cache loop (the transformer
+    successor of the reference's dense `beam_search` op + RNN-era
+    BeamSearchDecoder, contrib/decoder/beam_search_decoder.py:523) —
+    still ONE lax.scan with static shapes.  Beams ride the batch axis
+    (N = B*beam rows through the shared `_decode_step`); each step
+    joint-scores [B, beam*V], takes the top `beam_size`, reorders every
+    layer's K/V cache by the surviving parents with a one-hot batched
+    matmul (gather-free, MXU-friendly), and `gather_tree` resolves the
+    parent pointers into full sequences after the scan.
+
+    EOS handling: once a beam emits `eos_id` its score freezes — the
+    only continuation is another EOS at zero log-prob (the reference
+    beam_search op's finished-hypothesis rule; no length normalization).
+
+    Build in its own program with the training `param_prefix` (weight
+    sharing by name; never run the decode startup program).  Returns
+    {"src_ids", "out_ids": [B, beam, decode_len] int64 (best beam
+    first), "scores": [B, beam] cumulative log-probs}.
+    """
+    from paddle_tpu.layers.control_flow import StaticRNN
+
+    if not param_prefix:
+        raise ValueError(
+            "transformer_nmt_beam_decode needs the param_prefix the "
+            "training model was built with (weight sharing is by name)")
+    p = param_prefix
+    K, V = beam_size, tgt_vocab_size
+    src, cross_kv = _decode_encoder(p, src_vocab_size, max_len, d_model,
+                                    n_head, d_inner, n_layer)
+    hd = d_model // n_head
+    # replicate each batch row's encoder K/V across its K beams:
+    # [B, H, T, hd] -> [B, K, H, T, hd] -> [B*K, H, T, hd]
+    def _to_beams(t):
+        t = layers.reshape(t, [-1, 1, n_head, max_len, hd])
+        t = layers.expand(t, [1, K, 1, 1, 1])
+        return layers.reshape(t, [-1, n_head, max_len, hd])
+
+    cross_kv = [(_to_beams(ck), _to_beams(cv)) for ck, cv in cross_kv]
+
+    pe = layers.assign(_positional_encoding(decode_len, d_model))
+    pos_seq = layers.assign(
+        np.arange(decode_len, dtype=np.int64)[:, None])   # [T, 1]
+    kpos = layers.assign(np.arange(decode_len, dtype=np.int64))
+    # a [B*K, 1] reference var so every *K-batch init sizes off B*K
+    bk_ref = layers.reshape(layers.expand(
+        layers.fill_constant_batch_size_like(
+            src, shape=[-1, 1], dtype="float32", value=0.0),
+        [1, K]), [-1, 1])
+    bos = layers.fill_constant_batch_size_like(
+        bk_ref, shape=[-1, 1, 1], dtype="int64", value=float(bos_id))
+    # step-0 collapse: only beam 0 live, so the K identical BOS rows
+    # don't flood the first top-k with duplicates
+    score_init = layers.elementwise_add(
+        layers.fill_constant_batch_size_like(
+            src, shape=[-1, K], dtype="float32", value=0.0),
+        layers.assign(np.array(
+            [[0.0] + [-1e9] * (K - 1)], np.float32)))
+    cache_init = [
+        (layers.fill_constant_batch_size_like(
+            bk_ref, shape=[decode_len, -1, d_model], dtype="float32",
+            value=0.0, output_dim_idx=1),
+         layers.fill_constant_batch_size_like(
+            bk_ref, shape=[decode_len, -1, d_model], dtype="float32",
+            value=0.0, output_dim_idx=1))
+        for _ in range(n_layer)]
+    if eos_id is not None:
+        # allowed continuation row for a finished beam: EOS at 0 logp
+        eos_row = np.full((1, 1, V), -1e9, np.float32)
+        eos_row[0, 0, eos_id] = 0.0
+        eos_row = layers.assign(eos_row)
+
+    rnn = StaticRNN()
+    with rnn.step():
+        pos = rnn.step_input(pos_seq)                     # [1] int64
+        cur = rnn.memory(init=bos)                        # [BK, 1, 1]
+        scores = rnn.memory(init=score_init)              # [B, K]
+        caches = [(rnn.memory(init=k0), rnn.memory(init=v0))
+                  for k0, v0 in cache_init]               # [T, BK, D]
+        logits, new_caches = _decode_step(
+            cur, pos, caches, cross_kv, p, tgt_vocab_size, decode_len,
+            d_model, n_head, d_inner, n_layer, kpos, pe)
+        # log_softmax, not log(softmax): softmax underflow would put
+        # -inf in logp, and the done-mask's 0 * -inf would NaN-poison
+        # topk for any finished beam
+        logp = layers.log_softmax(logits)                 # [BK, 1, V]
+        logp = layers.reshape(logp, [-1, K, V])           # [B, K, V]
+        if eos_id is not None:
+            done = layers.cast(layers.equal(
+                layers.reshape(cur, [-1, K]),
+                layers.fill_constant([1], "int64", eos_id)), "float32")
+            d3 = layers.reshape(done, [-1, K, 1])
+            logp = layers.elementwise_add(
+                layers.elementwise_mul(logp, layers.scale(
+                    d3, scale=-1.0, bias=1.0)),
+                layers.elementwise_mul(
+                    layers.expand(eos_row, [1, K, 1]), d3))
+        total = layers.elementwise_add(
+            logp, layers.reshape(scores, [-1, K, 1]))     # [B, K, V]
+        val, idx = layers.topk(
+            layers.reshape(total, [-1, K * V]), K)        # [B, K] both
+        kv_const = layers.fill_constant([1], "int64", V)
+        parent = layers.elementwise_floordiv(idx, kv_const)  # [B, K]
+        token = layers.elementwise_mod(idx, kv_const)        # [B, K]
+        rnn.update_memory(scores, val)
+        rnn.update_memory(cur, layers.reshape(token, [-1, 1, 1]))
+        # reorder every cache by the surviving parents: a one-hot
+        # batched matmul (sel[b,k,j] picks old beam j for new beam k)
+        sel = layers.one_hot(layers.reshape(parent, [-1, K, 1]), K)
+        for (kc_pre, vc_pre), (kc, vc) in zip(caches, new_caches):
+            for pre, upd in ((kc_pre, kc), (vc_pre, vc)):
+                c = layers.reshape(layers.transpose(upd, [1, 0, 2]),
+                                   [-1, K, decode_len * d_model])
+                c = layers.matmul(sel, c)                 # [B, K, T*D]
+                c = layers.transpose(layers.reshape(
+                    c, [-1, decode_len, d_model]), [1, 0, 2])
+                rnn.update_memory(pre, c)
+        rnn.step_output(token)                            # [B, K]
+        rnn.step_output(parent)
+    tokens_tm, parents_tm = rnn()        # [T, B, K] each
+    seqs = layers.gather_tree(tokens_tm, parents_tm)      # [T, B, K]
+    out_ids = layers.transpose(seqs, [1, 2, 0])           # [B, K, T]
+    return {"src_ids": src, "out_ids": out_ids,
+            "scores": rnn.final(scores)}
