@@ -161,23 +161,32 @@ def _embed(ids, vocab_size, d_model, max_len, dropout_rate, is_test,
 def transformer_encoder_model(
     vocab_size=32000, max_len=256, d_model=512, n_head=8, d_inner=2048,
     n_layer=6, dropout_rate=0.1, is_test=False, tie_embeddings=False,
-    label_smooth_eps=0.0,
+    label_smooth_eps=0.0, param_prefix=None,
 ):
     """Encoder-only LM-style transformer: next-token prediction over a
     single stream (the flagship shape for bench/graft entry; the NMT
-    encoder-decoder variant is `transformer_nmt_model`)."""
+    encoder-decoder variant is `transformer_nmt_model`).  param_prefix:
+    deterministic parameter names so `transformer_lm_sample_decode`
+    shares the trained weights by name."""
+    p = param_prefix
+    sp = _sub(p)
     src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
     label = layers.data("tgt_label", shape=[max_len, 1], dtype="int64")
-    x = _embed(src, vocab_size, d_model, max_len, dropout_rate, is_test)
+    x = _embed(src, vocab_size, d_model, max_len, dropout_rate, is_test,
+               pfx=sp("emb"))
     # causal self-attention stack
-    for _ in range(n_layer):
+    for li in range(n_layer):
+        lp = _sub(sp(f"l{li}"))
         attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
-                                    causal=True, is_test=is_test)
-        x = _residual_norm(x, attn, dropout_rate, is_test)
-        ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
-        x = _residual_norm(x, ffn, dropout_rate, is_test)
+                                    causal=True, is_test=is_test,
+                                    pfx=lp("self"))
+        x = _residual_norm(x, attn, dropout_rate, is_test,
+                           pfx=lp("ln1"))
+        ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test,
+                   pfx=lp("ffn"))
+        x = _residual_norm(x, ffn, dropout_rate, is_test, pfx=lp("ln2"))
     logits = layers.fc(x, vocab_size, num_flatten_dims=2,
-                       bias_attr=False)
+                       bias_attr=False, param_attr=_w(p, "out_fc"))
     if label_smooth_eps:
         one_hot = layers.one_hot(label, vocab_size)
         smoothed = layers.label_smooth(one_hot, epsilon=label_smooth_eps)
@@ -533,3 +542,140 @@ def transformer_nmt_beam_decode(
     out_ids = layers.transpose(seqs, [1, 2, 0])           # [B, K, T]
     return {"src_ids": src, "out_ids": out_ids,
             "scores": rnn.final(scores)}
+
+
+def transformer_lm_sample_decode(
+    vocab_size=32000, prompt_len=64, d_model=512, n_head=8,
+    d_inner=2048, n_layer=6, param_prefix=None, gen_len=32,
+    temperature=1.0, top_k=0, seed=0,
+):
+    """GPT-style generation for `transformer_encoder_model`: PREFILL
+    the prompt through the causal stack once (full parallel attention,
+    seeding every layer's K/V cache with the prompt rows), then one
+    `lax.scan` samples `gen_len` tokens incrementally against the
+    cache.  temperature=0 is greedy argmax; top_k>0 keeps only the k
+    most likely tokens before sampling.  Each step's categorical draw
+    folds the step position into the RNG key (`sampling_id` SeedOffset)
+    so draws vary across scan iterations.
+
+    Build in its own program with the `param_prefix` the training model
+    used (weight sharing by name; never run the decode startup
+    program).  Returns {"prompt_ids": data var [B, prompt_len, 1],
+    "out_ids": [B, gen_len] int64 sampled continuation}.
+    """
+    from paddle_tpu.layers.control_flow import StaticRNN
+
+    if not param_prefix:
+        raise ValueError(
+            "transformer_lm_sample_decode needs the param_prefix the "
+            "training model was built with (weight sharing is by name)")
+    p = param_prefix
+    hd = d_model // n_head
+    T = prompt_len + gen_len
+    prompt = layers.data("prompt_ids", shape=[prompt_len, 1],
+                         dtype="int64")
+
+    def _lm_fcs(x, lp):
+        q = layers.fc(x, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=_w(f"{lp}_self", "q"))
+        k = layers.fc(x, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=_w(f"{lp}_self", "k"))
+        v = layers.fc(x, d_model, num_flatten_dims=2, bias_attr=False,
+                      param_attr=_w(f"{lp}_self", "v"))
+        return q, k, v
+
+    def _lm_tail(x, attn_out, lp):
+        o = layers.fc(attn_out, d_model, num_flatten_dims=2,
+                      bias_attr=False,
+                      param_attr=_w(f"{lp}_self", "out"))
+        x = _residual_norm(x, o, 0.0, True, pfx=f"{lp}_ln1")
+        ffn = _ffn(x, d_model, d_inner, 0.0, True, pfx=f"{lp}_ffn")
+        return _residual_norm(x, ffn, 0.0, True, pfx=f"{lp}_ln2")
+
+    # ---- prefill: full causal pass over the prompt, capturing K/V ----
+    x = _embed(prompt, vocab_size, d_model, prompt_len, 0.0, True,
+               pfx=f"{p}_emb")
+    cache_init = []
+    for li in range(n_layer):
+        lp = f"{p}_l{li}"
+        q, k, v = _lm_fcs(x, lp)
+        # seed the cache: prompt rows first, zeros for the gen rows
+        zeros = layers.fill_constant_batch_size_like(
+            prompt, shape=[gen_len, -1, d_model], dtype="float32",
+            value=0.0, output_dim_idx=1)
+        cache_init.append(
+            (layers.concat([layers.transpose(k, [1, 0, 2]), zeros],
+                           axis=0),
+             layers.concat([layers.transpose(v, [1, 0, 2]), zeros],
+                           axis=0)))                      # [T, B, D]
+        attn = layers.flash_attention(
+            _split_heads(q, prompt_len, n_head, hd),
+            _split_heads(k, prompt_len, n_head, hd),
+            _split_heads(v, prompt_len, n_head, hd), causal=True)
+        attn = layers.reshape(layers.transpose(attn, [0, 2, 1, 3]),
+                              [-1, prompt_len, d_model])
+        x = _lm_tail(x, attn, lp)
+    # only the last prompt position seeds generation: slice BEFORE the
+    # [D, vocab] projection so prefill doesn't pay prompt_len times the
+    # logits matmul and a [B, P, vocab] intermediate
+    x_last = layers.slice(x, axes=[1], starts=[prompt_len - 1],
+                          ends=[prompt_len])              # [B, 1, D]
+    last = layers.fc(x_last, vocab_size, num_flatten_dims=2,
+                     bias_attr=False, param_attr=_w(p, "out_fc"))
+
+    def _pick(logits3, off):
+        """[N, 1, V] logits -> [N, 1] sampled/argmax ids."""
+        if temperature == 0.0:
+            return layers.argmax(logits3, axis=-1)
+        lg = layers.scale(logits3, scale=1.0 / float(temperature))
+        if top_k:
+            vals, _ = layers.topk(lg, top_k)              # [N, 1, k]
+            kth = layers.slice(vals, axes=[2], starts=[top_k - 1],
+                               ends=[top_k])              # [N, 1, 1]
+            keep = layers.cast(layers.less_equal(kth, lg), "float32")
+            lg = layers.elementwise_add(lg, layers.scale(
+                keep, scale=1e9, bias=-1e9))
+        probs = layers.reshape(layers.softmax(lg), [-1, vocab_size])
+        out = layers.sampling_id(probs, seedoffset=off, seed=int(seed))
+        return layers.reshape(out, [-1, 1])
+
+    pe = layers.assign(_positional_encoding(T, d_model))
+    pos_seq = layers.assign(
+        np.arange(prompt_len, T, dtype=np.int64)[:, None])  # [G, 1]
+    kpos = layers.assign(np.arange(T, dtype=np.int64))
+    first = layers.reshape(_pick(last, layers.assign(
+        np.array([prompt_len - 1], np.int64))), [-1, 1, 1])
+
+    rnn = StaticRNN()
+    with rnn.step():
+        pos = rnn.step_input(pos_seq)                     # [1] int64
+        cur = rnn.memory(init=first)                      # [B, 1, 1]
+        caches = [(rnn.memory(init=k0), rnn.memory(init=v0))
+                  for k0, v0 in cache_init]
+        x = layers.embedding(
+            cur, size=[vocab_size, d_model],
+            param_attr=_ParamAttr(name=f"{p}_emb.w"))     # [B, 1, D]
+        x = layers.scale(x, scale=float(d_model) ** 0.5)
+        x = layers.elementwise_add(
+            x, layers.reshape(layers.gather(pe, pos), [1, 1, d_model]))
+        for li in range(n_layer):
+            lp = f"{p}_l{li}"
+            kc_pre, vc_pre = caches[li]
+            q, k, v = _lm_fcs(x, lp)
+            kc = layers.scatter(kc_pre, pos,
+                                layers.transpose(k, [1, 0, 2]))
+            vc = layers.scatter(vc_pre, pos,
+                                layers.transpose(v, [1, 0, 2]))
+            rnn.update_memory(kc_pre, kc)
+            rnn.update_memory(vc_pre, vc)
+            o = _cache_attention(q, kc, vc, pos, kpos, T, n_head, hd)
+            x = _lm_tail(x, o, lp)
+        logits = layers.fc(x, vocab_size, num_flatten_dims=2,
+                           bias_attr=False, param_attr=_w(p, "out_fc"))
+        rnn.step_output(layers.reshape(cur, [-1, 1]))     # emit, then
+        nxt = _pick(logits, pos)                          # pick next
+        rnn.update_memory(cur, layers.reshape(nxt, [-1, 1, 1]))
+    ids_tm = rnn()                                        # [G, B, 1]
+    out_ids = layers.reshape(layers.transpose(ids_tm, [1, 0, 2]),
+                             [-1, gen_len])               # [B, G]
+    return {"prompt_ids": prompt, "out_ids": out_ids}

@@ -220,14 +220,21 @@ def cvm(ins, attrs):
     return {"Y": x[:, 2:]}
 
 
-@register_op("sampling_id", inputs=("X",), outputs=("Out",),
+@register_op("sampling_id", inputs=("X", "SeedOffset"),
+             outputs=("Out",), optional=("SeedOffset",),
              differentiable=False,
              attrs={"min": 0.0, "max": 1.0, "seed": 0})
 def sampling_id(ins, attrs):
     """sampling_id_op.cc: sample a column index per row of the prob
-    matrix X (categorical draw)."""
+    matrix X (categorical draw).  Optional SeedOffset tensor is folded
+    into the key (the dropout-op pattern) so draws inside a lax.scan
+    vary per step — a bare attr seed is traced once and would repeat
+    the same draw every iteration."""
     x = ins["X"]
     key = jax.random.PRNGKey(attrs["seed"] or 0)
+    off = ins.get("SeedOffset")
+    if off is not None:
+        key = jax.random.fold_in(key, off.reshape(()).astype(jnp.uint32))
     u = jax.random.uniform(key, (x.shape[0], 1), x.dtype,
                            attrs["min"], attrs["max"])
     cdf = jnp.cumsum(x, axis=1)

@@ -207,6 +207,84 @@ def test_transformer_beam_decode():
     assert seen_eos, "eos never emitted; property check was vacuous"
 
 
+def test_transformer_lm_sample_decode():
+    """GPT-style prefill + sampling loop on the encoder-only LM:
+    temperature=0 greedily continues and its step-0 token equals the
+    teacher-forced argmax at the prompt's last position; different
+    seeds give different samples at temperature>0; top_k=1 collapses
+    to greedy regardless of seed."""
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.models.transformer import (
+        transformer_encoder_model, transformer_lm_sample_decode)
+
+    np.random.seed(0)
+    vocab, t_len = 32, 8
+    cfg = dict(d_model=32, n_head=4, d_inner=48, n_layer=2)
+    m = transformer_encoder_model(
+        vocab_size=vocab, max_len=t_len, dropout_rate=0.0,
+        param_prefix="lm", **cfg)
+    eval_prog = fluid.default_main_program().clone(for_test=True)
+    rng = np.random.RandomState(0)
+    seq = rng.randint(2, vocab, (4, t_len, 1)).astype(np.int64)
+    _train(m["loss"], lambda i: {"src_ids": seq, "tgt_label": seq},
+           steps=60, lr=3e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def build(**kw):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            d = transformer_lm_sample_decode(
+                vocab_size=vocab, prompt_len=t_len, param_prefix="lm",
+                gen_len=4, **cfg, **kw)
+        return prog, d
+
+    gp, g = build(temperature=0.0)
+    (greedy,) = exe.run(gp, feed={"prompt_ids": seq},
+                        fetch_list=[g["out_ids"]])
+    # the first generated token is the argmax of the training model's
+    # logits at the prompt's last position
+    (tf_logits,) = exe.run(eval_prog,
+                           feed={"src_ids": seq,
+                                 "tgt_label": np.zeros_like(seq)},
+                           fetch_list=[m["logits"]])
+    np.testing.assert_array_equal(greedy[:, 0],
+                                  tf_logits[:, -1].argmax(-1))
+
+    s1p, s1 = build(temperature=1.0, seed=7)
+    s2p, s2 = build(temperature=1.0, seed=8)
+    (samp1,) = exe.run(s1p, feed={"prompt_ids": seq},
+                       fetch_list=[s1["out_ids"]])
+    (samp2,) = exe.run(s2p, feed={"prompt_ids": seq},
+                       fetch_list=[s2["out_ids"]])
+    assert (samp1 != samp2).any(), "seeds 7/8 gave identical samples"
+
+    k1p, k1 = build(temperature=1.0, top_k=1, seed=9)
+    (topk1,) = exe.run(k1p, feed={"prompt_ids": seq},
+                       fetch_list=[k1["out_ids"]])
+    np.testing.assert_array_equal(topk1, greedy)
+
+    # per-step draw variation needs a FLAT distribution (the trained
+    # model above is an identity-copier, so constant rows are correct
+    # for it): an untrained model's near-uniform logits must yield
+    # varying tokens within a row — a traced-once RNG key would repeat
+    # every step's draw and make each row constant
+    up, us = Program(), Program()
+    with program_guard(up, us):
+        transformer_encoder_model(
+            vocab_size=vocab, max_len=t_len, dropout_rate=0.0,
+            param_prefix="lm_untrained", **cfg)
+    exe.run(us)
+    vp, v = Program(), Program()
+    with program_guard(vp, v):
+        dv = transformer_lm_sample_decode(
+            vocab_size=vocab, prompt_len=t_len,
+            param_prefix="lm_untrained", gen_len=8, temperature=3.0,
+            seed=11, **cfg)
+    (flat,) = exe.run(vp, feed={"prompt_ids": seq},
+                      fetch_list=[dv["out_ids"]])
+    assert (flat != flat[:, :1]).any(), flat
+
+
 def test_bert_tiny_trains():
     model = bert_model(vocab_size=128, max_len=16, d_model=32, n_head=4,
                        d_inner=64, n_layer=2, dropout_rate=0.0)
