@@ -244,6 +244,57 @@ def test_decode_under_data_parallel_mesh():
     np.testing.assert_array_equal(single, sharded)
 
 
+def test_decode_program_exports_and_serves():
+    """The generator is servable: save_inference_model prunes+saves the
+    decode program (including its scan sub-block), load_inference_model
+    round-trips it in a fresh scope, and the inference Predictor serves
+    it — all token-identical to the direct run."""
+    import tempfile
+
+    from paddle_tpu import inference
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.models.transformer import (
+        transformer_nmt_greedy_decode, transformer_nmt_model)
+
+    np.random.seed(0)
+    vocab, t_len = 16, 6
+    cfg = dict(d_model=32, n_head=4, d_inner=48, n_layer=1)
+    m = transformer_nmt_model(
+        src_vocab_size=vocab, tgt_vocab_size=vocab, max_len=t_len,
+        dropout_rate=0.0, param_prefix="tfm", **cfg)
+    rng = np.random.RandomState(0)
+    src = rng.randint(2, vocab, (4, t_len, 1)).astype(np.int64)
+    tin = np.concatenate(
+        [np.ones((4, 1, 1), np.int64), src[:, :-1]], axis=1)
+    _train(m["loss"],
+           lambda i: {"src_ids": src, "tgt_ids": tin,
+                      "tgt_label": src}, steps=40, lr=5e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        d = transformer_nmt_greedy_decode(
+            src_vocab_size=vocab, tgt_vocab_size=vocab, max_len=t_len,
+            param_prefix="tfm", decode_len=t_len, bos_id=1, **cfg)
+    (ref,) = exe.run(prog, feed={"src_ids": src},
+                     fetch_list=[d["out_ids"]])
+    dirn = tempfile.mkdtemp()
+    fluid.io.save_inference_model(dirn, ["src_ids"], [d["out_ids"]],
+                                  exe, main_program=prog)
+    with scope_guard(Scope()):
+        prog2, feeds, fetches = fluid.io.load_inference_model(dirn, exe)
+        (out2,) = exe.run(prog2, feed={"src_ids": src},
+                          fetch_list=fetches)
+    np.testing.assert_array_equal(out2, ref)
+    pred = inference.Predictor(inference.Config(dirn))
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(src)
+    pred.run()
+    out3 = pred.get_output_handle(
+        pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_array_equal(out3, ref)
+
+
 def test_transformer_lm_sample_decode():
     """GPT-style prefill + sampling loop on the encoder-only LM:
     temperature=0 greedily continues and its step-0 token equals the
