@@ -207,34 +207,41 @@ def test_transformer_beam_decode():
     assert seen_eos, "eos never emitted; property check was vacuous"
 
 
-def test_decode_under_data_parallel_mesh():
-    """Generation scales like training: the KV-cache greedy decode
-    program runs batch-sharded over the 8-device mesh and matches the
-    single-device output token for token (the scan carry — token +
-    caches — shards on its batch dims)."""
+def _tiny_nmt_with_decode_prog(batch, vocab=16, t_len=6, steps=40):
+    """Train the tiny copy NMT (param_prefix='tfm') and build its
+    greedy-decode program.  Returns (exe, decode_prog, decode_outs,
+    src) — shared by the mesh/export decode tests."""
     from paddle_tpu.framework import Program, program_guard
     from paddle_tpu.models.transformer import (
         transformer_nmt_greedy_decode, transformer_nmt_model)
 
     np.random.seed(0)
-    vocab, t_len = 16, 6
     cfg = dict(d_model=32, n_head=4, d_inner=48, n_layer=1)
     m = transformer_nmt_model(
         src_vocab_size=vocab, tgt_vocab_size=vocab, max_len=t_len,
         dropout_rate=0.0, param_prefix="tfm", **cfg)
     rng = np.random.RandomState(0)
-    src = rng.randint(2, vocab, (8, t_len, 1)).astype(np.int64)
+    src = rng.randint(2, vocab, (batch, t_len, 1)).astype(np.int64)
     tin = np.concatenate(
-        [np.ones((8, 1, 1), np.int64), src[:, :-1]], axis=1)
+        [np.ones((batch, 1, 1), np.int64), src[:, :-1]], axis=1)
     _train(m["loss"],
            lambda i: {"src_ids": src, "tgt_ids": tin,
-                      "tgt_label": src}, steps=40, lr=5e-3)
+                      "tgt_label": src}, steps=steps, lr=5e-3)
     exe = fluid.Executor(fluid.CPUPlace())
     prog, startup = Program(), Program()
     with program_guard(prog, startup):
         d = transformer_nmt_greedy_decode(
             src_vocab_size=vocab, tgt_vocab_size=vocab, max_len=t_len,
             param_prefix="tfm", decode_len=t_len, bos_id=1, **cfg)
+    return exe, prog, d, src
+
+
+def test_decode_under_data_parallel_mesh():
+    """Generation scales like training: the KV-cache greedy decode
+    program runs batch-sharded over the 8-device mesh and matches the
+    single-device output token for token (the scan carry — token +
+    caches — shards on its batch dims)."""
+    exe, prog, d, src = _tiny_nmt_with_decode_prog(batch=8)
     (single,) = exe.run(fluid.CompiledProgram(prog),
                         feed={"src_ids": src},
                         fetch_list=[d["out_ids"]])
@@ -244,41 +251,18 @@ def test_decode_under_data_parallel_mesh():
     np.testing.assert_array_equal(single, sharded)
 
 
-def test_decode_program_exports_and_serves():
+def test_decode_program_exports_and_serves(tmp_path):
     """The generator is servable: save_inference_model prunes+saves the
     decode program (including its scan sub-block), load_inference_model
     round-trips it in a fresh scope, and the inference Predictor serves
     it — all token-identical to the direct run."""
-    import tempfile
-
     from paddle_tpu import inference
     from paddle_tpu.core.scope import Scope, scope_guard
-    from paddle_tpu.framework import Program, program_guard
-    from paddle_tpu.models.transformer import (
-        transformer_nmt_greedy_decode, transformer_nmt_model)
 
-    np.random.seed(0)
-    vocab, t_len = 16, 6
-    cfg = dict(d_model=32, n_head=4, d_inner=48, n_layer=1)
-    m = transformer_nmt_model(
-        src_vocab_size=vocab, tgt_vocab_size=vocab, max_len=t_len,
-        dropout_rate=0.0, param_prefix="tfm", **cfg)
-    rng = np.random.RandomState(0)
-    src = rng.randint(2, vocab, (4, t_len, 1)).astype(np.int64)
-    tin = np.concatenate(
-        [np.ones((4, 1, 1), np.int64), src[:, :-1]], axis=1)
-    _train(m["loss"],
-           lambda i: {"src_ids": src, "tgt_ids": tin,
-                      "tgt_label": src}, steps=40, lr=5e-3)
-    exe = fluid.Executor(fluid.CPUPlace())
-    prog, startup = Program(), Program()
-    with program_guard(prog, startup):
-        d = transformer_nmt_greedy_decode(
-            src_vocab_size=vocab, tgt_vocab_size=vocab, max_len=t_len,
-            param_prefix="tfm", decode_len=t_len, bos_id=1, **cfg)
+    exe, prog, d, src = _tiny_nmt_with_decode_prog(batch=4)
     (ref,) = exe.run(prog, feed={"src_ids": src},
                      fetch_list=[d["out_ids"]])
-    dirn = tempfile.mkdtemp()
+    dirn = str(tmp_path)
     fluid.io.save_inference_model(dirn, ["src_ids"], [d["out_ids"]],
                                   exe, main_program=prog)
     with scope_guard(Scope()):
