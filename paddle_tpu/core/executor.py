@@ -84,7 +84,9 @@ class Executor:
             if runner is None:
                 runner = PipelineRunner(
                     program, popt["sections"], popt["loss_stage"],
-                    popt["loss_name"], popt["num_microbatches"], scope)
+                    popt["loss_name"], popt["num_microbatches"], scope,
+                    shared=popt.get("shared"),
+                    schedule=popt.get("schedule", "gpipe"))
                 popt["_runner"] = runner
             elif runner.scope is not scope:
                 # keep the jitted per-stage functions; just re-point the

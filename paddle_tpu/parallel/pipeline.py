@@ -98,6 +98,82 @@ def stack_stage_params(per_stage_params):
         lambda *leaves: jnp.stack(leaves), *per_stage_params)
 
 
+def make_pipeline_schedule(kind, M, S):
+    """Host dispatch order for the section runner: list of
+    (stage, 'F'|'B', microbatch).
+
+    "gpipe": all M forwards, then all M backwards (reference
+    SectionWorker's queue-driven sweep) — every stage holds M saved
+    activation sets at the fwd/bwd boundary.
+    "1f1b": PipeDream-flush — stage i starts draining backwards once
+    min(M, S - i) microbatches are in flight, bounding saved
+    activations at min(M, S - i) instead of M.  Grad accumulation is
+    order-independent, so numerics match gpipe exactly."""
+    if kind == "gpipe":
+        return ([(s, "F", m) for m in range(M) for s in range(S)] +
+                [(s, "B", m) for m in range(M)
+                 for s in range(S - 1, -1, -1)])
+    if kind != "1f1b":
+        raise ValueError(f"unknown pipeline schedule {kind!r}; "
+                         "choose 'gpipe' or '1f1b'")
+    sched = []
+    fdone, bdone = [0] * S, [0] * S
+    max_inflight = [min(M, S - i) for i in range(S)]
+    while any(b < M for b in bdone):
+        made = False
+        for i in range(S):
+            f_ready = fdone[i] < M and (i == 0 or fdone[i - 1] > fdone[i])
+            b_ready = bdone[i] < fdone[i] and \
+                (i == S - 1 or bdone[i + 1] > bdone[i])
+            if f_ready and fdone[i] - bdone[i] < max_inflight[i]:
+                sched.append((i, "F", fdone[i]))
+                fdone[i] += 1
+                made = True
+            elif b_ready:
+                sched.append((i, "B", bdone[i]))
+                bdone[i] += 1
+                made = True
+        if not made:  # pragma: no cover - the policy above always moves
+            raise RuntimeError("1f1b schedule deadlocked "
+                               f"(M={M}, S={S}, f={fdone}, b={bdone})")
+    return sched
+
+
+def schedule_stats(sched, M, S):
+    """Measure a schedule by unit-time simulation: stages run in
+    parallel, each serially, F/B cost one tick, deps respected
+    (F(s,m) after F(s-1,m); B(s,m) after F(s,m) and B(s+1,m)).
+    Returns makespan, per-stage ideal work (2M), the bubble fraction
+    idle/makespan, and the peak saved-activation count per stage."""
+    end = {}
+    stage_free = [0] * S
+    inflight = [0] * S
+    peak = [0] * S
+    for (s, kind, m) in sched:
+        deps = []
+        if kind == "F":
+            if s > 0:
+                deps.append(("F", s - 1, m))
+        else:
+            deps.append(("F", s, m))
+            if s < S - 1:
+                deps.append(("B", s + 1, m))
+        start = max([stage_free[s]] + [end[d] for d in deps])
+        end[(kind, s, m)] = stage_free[s] = start + 1
+        if kind == "F":
+            inflight[s] += 1
+            peak[s] = max(peak[s], inflight[s])
+        else:
+            inflight[s] -= 1
+    makespan = max(end.values())
+    return {
+        "makespan": makespan,
+        "ideal": 2 * M,
+        "bubble_frac": round((makespan - 2 * M) / makespan, 6),
+        "peak_inflight": peak,
+    }
+
+
 # ---------------------------------------------------------------------------
 # IR-level pipeline: PipelineOptimizer cuts the Program into per-stage
 # sections at `fluid.pipeline_stage(i)` annotations (reference
@@ -130,6 +206,8 @@ class _StageSection:
         self.bwd_in = []       # gradients from later stages
         self.bwd_out = []      # gradients for earlier stages
         self.param_grads = []  # canonical grads consumed by opt ops
+        self.shared_partials = []  # partial grads of cross-stage params
+        #                            produced by this stage's bwd ops
 
 
 def build_pipeline_plan(program, loss_name):
@@ -178,11 +256,16 @@ def build_pipeline_plan(program, loss_name):
     def is_data(n):
         return block.has_var(n) and block.var(n).is_data
 
-    # a persistable WRITTEN on one stage but read on another would
-    # silently desynchronize (each stage holds its own device copy and
-    # only the owner's is updated) — reject weight sharing across stages.
-    # Read-only persistables (constant lr) replicate safely.
+    # A persistable READ on several stages but UPDATED only by optimizer
+    # ops on one stage is a shared parameter (tied embeddings): each
+    # holding stage keeps a replica, partial grads are summed across
+    # stages by the runner, and the updated value is re-broadcast after
+    # the optimizer apply — the reference SectionWorker's cross-section
+    # param sync (section_worker.cc:30).  Any OTHER cross-stage write
+    # pattern (fwd/bwd ops mutating a persistable seen elsewhere) would
+    # silently desynchronize the replicas and is rejected.
     reads, writes = {}, {}
+    write_roles = {}
     lrsched_written = {n for op in lr_ops for n in op.output_names()}
     for s in secs:
         for op in s.fwd_ops + s.bwd_ops + s.opt_ops:
@@ -194,16 +277,48 @@ def build_pipeline_plan(program, loss_name):
             for n in op.output_names():
                 if is_persistable(n):
                     writes.setdefault(n, set()).add(s.idx)
+                    write_roles.setdefault(n, set()).add(op.op_role)
+    shared = {"params": {}, "owner": {}, "grads": {}}
     for n, wstages in writes.items():
         if n in lrsched_written:
             continue
         span = wstages | reads.get(n, set())
-        if len(span) > 1:
-            raise NotImplementedError(
-                f"pipeline: persistable '{n}' is written on stage(s) "
-                f"{sorted(wstages)} but used on stages {sorted(span)} — "
-                "cross-stage weight sharing is not supported; keep each "
-                "parameter inside one pipeline_stage block")
+        if len(span) <= 1:
+            continue
+        if write_roles[n] == {OPTIMIZE} and len(wstages) == 1:
+            shared["params"][n] = sorted(span)
+            shared["owner"][n] = next(iter(wstages))
+            continue
+        raise NotImplementedError(
+            f"pipeline: persistable '{n}' is written on stage(s) "
+            f"{sorted(wstages)} (roles {sorted(write_roles[n])}) but "
+            f"used on stages {sorted(span)} — only optimizer-updated "
+            "shared parameters may span stages; keep other state "
+            "inside one pipeline_stage block")
+
+    # For each shared param whose partial grads come from different
+    # stages, the merging `sum` op (backward.py merged_grad) is
+    # unrunnable in-section: within a microbatch stages step backward
+    # S-1 -> 0, so an earlier stage's partial doesn't exist yet when
+    # the sum's (later) stage runs.  Strip it and let the runner do
+    # the cross-stage accumulation instead.
+    shared_grad_names = {p + "@GRAD": p for p in shared["params"]}
+    for s in secs:
+        kept = []
+        for op in s.bwd_ops:
+            outs = op.output_names()
+            if op.type == "sum" and len(outs) == 1 \
+                    and outs[0] in shared_grad_names:
+                parts = [(producer[n], n) for n in op.input_names()]
+                if len({st for st, _ in parts}) > 1:
+                    shared["grads"][outs[0]] = sorted(parts)
+                    continue  # stripped: runner sums across stages
+            kept.append(op)
+        s.bwd_ops = kept
+    for gname, parts in shared["grads"].items():
+        for st, pname in parts:
+            if pname not in secs[st].shared_partials:
+                secs[st].shared_partials.append(pname)
 
     fwd_producer = {}
     for s in secs:
@@ -263,7 +378,7 @@ def build_pipeline_plan(program, loss_name):
                     for slot, names in op.inputs.items()
                     if slot == "Grad" for n in names}
         s.param_grads = sorted(grad_ins)
-    return secs, loss_stage
+    return secs, loss_stage, shared
 
 
 def OpDescCopy(op):
@@ -279,7 +394,7 @@ class PipelineRunner:
     PipelineTrainer/SectionWorker semantics)."""
 
     def __init__(self, program, sections, loss_stage, loss_name,
-                 num_microbatches, scope):
+                 num_microbatches, scope, shared=None, schedule="gpipe"):
         import types
 
         from paddle_tpu.core.compiler import (_TraceEnv,
@@ -291,10 +406,26 @@ class PipelineRunner:
         self.loss_name = loss_name
         self.M = num_microbatches
         self.scope = scope
+        self.shared = shared or {"params": {}, "owner": {}, "grads": {}}
         devs = jax.devices()
         S = len(sections)
         self.devices = [devs[i % len(devs)] for i in range(S)] \
             if len(devs) > 1 else [None] * S
+        self.schedule_name = schedule
+        self._sched = make_pipeline_schedule(schedule, self.M, S)
+        self.schedule_stats = schedule_stats(self._sched, self.M, S)
+        # how many stages consume each boundary activation / gradient —
+        # run() frees the buffer after its last consumer so in-flight
+        # memory actually honours the schedule bound
+        self._act_consumers = {}
+        self._grad_consumers = {}
+        for s in sections:
+            for n in s.fwd_in:
+                self._act_consumers[n] = \
+                    self._act_consumers.get(n, 0) + 1
+            for n in s.bwd_in:
+                self._grad_consumers[n] = \
+                    self._grad_consumers.get(n, 0) + 1
 
         def make_fn(ops, out_names):
             shim = types.SimpleNamespace(blocks=list(program.blocks))
@@ -319,7 +450,8 @@ class PipelineRunner:
                 s.fwd_out + s.saved + pers_out +
                 ([loss_name] if s.idx == loss_stage else [])))
             self._fwd.append(make_fn(s.fwd_ops, fwd_outs))
-            bwd_outs = list(dict.fromkeys(s.bwd_out + s.param_grads))
+            bwd_outs = list(dict.fromkeys(
+                s.bwd_out + s.param_grads + s.shared_partials))
             self._bwd.append(make_fn(s.bwd_ops, bwd_outs)
                              if s.bwd_ops else None)
             self._opt.append(make_fn(s.opt_ops, s.state)
@@ -327,6 +459,7 @@ class PipelineRunner:
         self._state = None
 
     def _pull_state(self):
+        self._pushed = None
         self._state = []
         for s, dev in zip(self.sections, self.devices):
             st = {}
@@ -341,9 +474,15 @@ class PipelineRunner:
             self._state.append(st)
 
     def _push_state(self):
+        # remember exactly which object landed in the scope per name: a
+        # shared param holds per-stage replicas (distinct device arrays
+        # with equal values), and freshness must compare against the
+        # one that won the push, not against every replica
+        self._pushed = {}
         for st in self._state:
             for n, v in st.items():
                 self.scope.var(n).set(v)
+                self._pushed[n] = v
 
     def _state_is_fresh(self):
         """True while the scope still holds exactly the arrays we pushed;
@@ -351,10 +490,12 @@ class PipelineRunner:
         identity and forces a re-pull."""
         if self._state is None:
             return False
+        pushed = getattr(self, "_pushed", None)
         for s, st in zip(self.sections, self._state):
             for n in s.state:
                 var = self.scope.find_var(n)
-                if var is None or var.get() is not st[n]:
+                ref = pushed[n] if pushed and n in pushed else st[n]
+                if var is None or var.get() is not ref:
                     return False
         return True
 
@@ -377,58 +518,89 @@ class PipelineRunner:
             for m, part in enumerate(jnp.split(arr, M, axis=0)):
                 mb_feeds[m][name] = part
 
-        saved = [[None] * S for _ in range(M)]
-        losses = []
-        # forward sweep (python drives; jax async dispatch pipelines the
-        # per-device work like the reference's section scope-queues)
-        for m in range(M):
-            acts = {}
-            for s, sec in enumerate(self.sections):
-                dev = self.devices[s]
+        # schedule-driven sweep (python drives; jax async dispatch
+        # pipelines the per-device work like the reference's section
+        # scope-queues).  saved activations live only between F(s,m)
+        # and B(s,m) — under 1f1b that bounds them at min(M, S - s)
+        # sets per stage instead of M.
+        saved = {}
+        acts = [dict() for _ in range(M)]
+        grads = [dict() for _ in range(M)]
+        act_left = [dict() for _ in range(M)]
+        grad_left = [dict() for _ in range(M)]
+        grad_acc = [dict() for _ in range(S)]
+        losses = [None] * M
+        inflight, peak_inflight = [0] * S, [0] * S
+
+        def put(v, dev):
+            return jax.device_put(v, dev) if dev is not None else v
+
+        def consume(store, left, m, n):
+            v = store[m][n]
+            left[m][n] -= 1
+            if left[m][n] == 0:
+                del store[m][n], left[m][n]
+            return v
+
+        for (s, kind, m) in self._sched:
+            sec = self.sections[s]
+            dev = self.devices[s]
+            if kind == "F":
                 env = dict(self._state[s])
                 for n in sec.feeds:
-                    v = mb_feeds[m][n]
-                    env[n] = jax.device_put(v, dev) if dev is not None \
-                        else v
+                    env[n] = put(mb_feeds[m][n], dev)
                 for n in sec.fwd_in:
-                    v = acts[n]
-                    env[n] = jax.device_put(v, dev) if dev is not None \
-                        else v
+                    env[n] = put(consume(acts, act_left, m, n), dev)
                 outs = self._fwd[s](env)
                 for n in sec.state:
                     if n in outs:
                         self._state[s][n] = outs[n]
-                saved[m][s] = {n: outs[n] for n in sec.saved
-                               if n in outs}
+                saved[(m, s)] = {n: outs[n] for n in sec.saved
+                                 if n in outs}
+                inflight[s] += 1
+                peak_inflight[s] = max(peak_inflight[s], inflight[s])
                 for n in sec.fwd_out:
-                    acts[n] = outs[n]
+                    acts[m][n] = outs[n]
+                    act_left[m][n] = self._act_consumers.get(n, 1)
                 if s == self.loss_stage and self.loss_name in outs:
-                    losses.append(outs[self.loss_name])
-        # backward sweep with gradient accumulation
-        grad_acc = [dict() for _ in range(S)]
-        for m in range(M):
-            grads = {}
-            for s in range(S - 1, -1, -1):
-                sec = self.sections[s]
+                    losses[m] = outs[self.loss_name]
+            else:
+                env_saved = saved.pop((m, s), {})
+                inflight[s] -= 1
                 if self._bwd[s] is None:
                     continue
-                dev = self.devices[s]
                 env = dict(self._state[s])
-                env.update(saved[m][s])
+                env.update(env_saved)
                 for n in sec.bwd_in:
-                    v = grads[n]
-                    env[n] = jax.device_put(v, dev) if dev is not None \
-                        else v
+                    env[n] = put(consume(grads, grad_left, m, n), dev)
                 outs = self._bwd[s](env)
                 for n in sec.bwd_out:
-                    grads[n] = outs[n]
-                for n in sec.param_grads:
+                    grads[m][n] = outs[n]
+                    grad_left[m][n] = self._grad_consumers.get(n, 1)
+                for n in sec.param_grads + sec.shared_partials:
                     if n not in outs:
                         continue
                     if n in grad_acc[s]:
                         grad_acc[s][n] = grad_acc[s][n] + outs[n]
                     else:
                         grad_acc[s][n] = outs[n]
+        self.last_peak_inflight = peak_inflight
+        # cross-stage shared-param grads: sum the per-stage partials
+        # into the canonical grad on the owner's device (the stripped
+        # `sum` op from build_pipeline_plan, done where data lives)
+        shared_total = {}
+        for gname, parts in self.shared["grads"].items():
+            owner = self.shared["owner"].get(gname[:-len("@GRAD")])
+            dev = self.devices[owner] if owner is not None else None
+            tot = None
+            for ps, pname in parts:
+                v = grad_acc[ps].pop(pname, None)
+                if v is None:
+                    continue
+                v = put(v, dev)
+                tot = v if tot is None else tot + v
+            if tot is not None:
+                shared_total[gname] = tot
         # optimizer apply (mean of microbatch grads == full-batch grad)
         for s, sec in enumerate(self.sections):
             if self._opt[s] is None:
@@ -436,14 +608,28 @@ class PipelineRunner:
             env = dict(self._state[s])
             for n, g in grad_acc[s].items():
                 env[n] = g / float(M)
+            for n in sec.param_grads:
+                if n in shared_total:
+                    env[n] = shared_total[n] / float(M)
             outs = self._opt[s](env)
             for n in sec.state:
                 if n in outs:
                     self._state[s][n] = outs[n]
+        # re-broadcast updated shared params to every holding stage
+        # (reference SectionWorker param sync, section_worker.cc:30)
+        for p, holders in self.shared["params"].items():
+            owner = self.shared["owner"][p]
+            val = self._state[owner].get(p)
+            if val is None:
+                continue
+            for h in holders:
+                if h != owner:
+                    self._state[h][p] = put(val, self.devices[h])
         self._push_state()
 
         results = []
         loss_val = None
+        losses = [v for v in losses if v is not None]
         if losses:
             loss_val = sum(jnp.mean(v) for v in losses) / float(len(losses))
         for f in fetch_list or []:
@@ -471,9 +657,14 @@ class PipelineOptimizer:
     GPipe section runner.  Programs with no stage annotations fall back
     to plain single-section execution."""
 
-    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0,
+                 schedule="gpipe"):
         self._optimizer = optimizer
         self._num_microbatches = num_microbatches
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                             "choose 'gpipe' or '1f1b'")
+        self._schedule = schedule
 
     @property
     def num_microbatches(self):
@@ -488,11 +679,14 @@ class PipelineOptimizer:
         annotated = any(op.stage is not None
                         for op in program.global_block().ops)
         if annotated:
-            sections, loss_stage = build_pipeline_plan(program, loss.name)
+            sections, loss_stage, shared = build_pipeline_plan(
+                program, loss.name)
             program._pipeline_opt = {
                 "sections": sections,
                 "loss_stage": loss_stage,
                 "loss_name": loss.name,
                 "num_microbatches": self._num_microbatches,
+                "shared": shared,
+                "schedule": self._schedule,
             }
         return result

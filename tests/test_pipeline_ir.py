@@ -102,6 +102,168 @@ def test_pipeline_matches_single_device(fresh_programs_factory,
     assert trajs[True][-1] < trajs[True][0]
 
 
+def test_pipeline_schedules_bubble_and_memory():
+    """1F1B (PipeDream-flush) has the same bubble fraction as GPipe,
+    (S-1)/(M+S-1), but bounds saved activations at min(M, S-s) per
+    stage instead of M (reference SectionWorker runs GPipe only)."""
+    from paddle_tpu.parallel.pipeline import (make_pipeline_schedule,
+                                              schedule_stats)
+
+    M, S = 8, 4
+    stats = {}
+    for kind in ("gpipe", "1f1b"):
+        sched = make_pipeline_schedule(kind, M, S)
+        assert len(sched) == 2 * M * S
+        # every (stage, microbatch) does exactly one F and one B, and
+        # the per-stage order respects data dependencies
+        assert sorted(sched) == sorted(
+            (s, k, m) for s in range(S) for k in "BF" for m in range(M))
+        seen = set()
+        for (s, k, m) in sched:
+            if k == "F":
+                assert s == 0 or (s - 1, "F", m) in seen, (s, m)
+            else:
+                assert (s, "F", m) in seen, (s, m)
+                assert s == S - 1 or (s + 1, "B", m) in seen, (s, m)
+            seen.add((s, k, m))
+        stats[kind] = schedule_stats(sched, M, S)
+        assert stats[kind]["bubble_frac"] == pytest.approx(
+            (S - 1) / (M + S - 1), abs=1e-6), (kind, stats[kind])
+    assert stats["gpipe"]["peak_inflight"] == [M] * S
+    assert stats["1f1b"]["peak_inflight"] == \
+        [min(M, S - i) for i in range(S)]
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_1f1b_matches_single_device(fresh_programs_factory,
+                                             schedule):
+    """Both schedules produce the exact same trajectory (grad
+    accumulation is order-independent); 1f1b additionally keeps the
+    measured in-flight activation count at its schedule bound."""
+    from paddle_tpu.parallel import PipelineOptimizer
+
+    trajs = {}
+    for pipelined in (False, True):
+        with fresh_programs_factory():
+            np.random.seed(42)
+            _, _, loss = _staged_mlp(annotate=pipelined)
+            if pipelined:
+                opt = PipelineOptimizer(optimizer.SGD(learning_rate=0.02),
+                                        num_microbatches=8,
+                                        schedule=schedule)
+                opt.minimize(loss)
+            else:
+                optimizer.SGD(learning_rate=0.02).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            losses = []
+            for bx, by in _batches(6):
+                (lv,) = exe.run(feed={"x": bx, "y": by},
+                                fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+            if pipelined:
+                runner = fluid.default_main_program() \
+                    ._pipeline_opt["_runner"]
+                expect = [8] * 4 if schedule == "gpipe" \
+                    else [min(8, 4 - i) for i in range(4)]
+                assert runner.last_peak_inflight == expect
+                assert runner.schedule_stats["bubble_frac"] == \
+                    pytest.approx(3 / 11, abs=1e-6)
+            trajs[pipelined] = losses
+    np.testing.assert_allclose(trajs[True], trajs[False], rtol=2e-4,
+                               atol=1e-6)
+
+
+def _tied_lm(annotate=True):
+    """3-stage MLP whose first and last matmuls share one weight — the
+    tied-embedding pattern the reference SectionWorker supports via
+    cross-section param sync (section_worker.cc:30)."""
+    import contextlib
+
+    from paddle_tpu.param_attr import ParamAttr
+
+    x = layers.data("x", shape=[16], dtype="float32")
+    y = layers.data("y", shape=[16], dtype="float32")
+
+    def ctx(s):
+        return fluid.pipeline_stage(s) if annotate \
+            else contextlib.nullcontext()
+
+    with ctx(0):
+        h = layers.fc(x, size=16, act="tanh",
+                      param_attr=ParamAttr(name="tied_w"), name="embed")
+    with ctx(1):
+        h = layers.fc(h, size=16, act="tanh", name="mid")
+    with ctx(2):
+        out = layers.fc(h, size=16,
+                        param_attr=ParamAttr(name="tied_w"), name="proj")
+        loss = layers.mean(layers.square_error_cost(out, y))
+    return loss
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_tied_embedding_matches_single_device(
+        fresh_programs_factory, schedule):
+    """A tied-weight LM pipelines: partial grads from stages 0 and 2
+    are summed by the runner, the stage-2 optimizer applies the update,
+    and the fresh value re-broadcasts to stage 0 — trajectory equals
+    the unpipelined run, where backward.py's sum op does the merge."""
+    from paddle_tpu.parallel import PipelineOptimizer
+
+    rng = np.random.RandomState(7)
+    Wt = rng.randn(16, 16).astype(np.float32) * 0.3
+    batches = [(rng.rand(16, 16).astype(np.float32),) for _ in range(6)]
+    trajs = {}
+    for pipelined in (False, True):
+        with fresh_programs_factory():
+            np.random.seed(11)
+            loss = _tied_lm(annotate=pipelined)
+            if pipelined:
+                PipelineOptimizer(optimizer.SGD(learning_rate=0.05),
+                                  num_microbatches=4,
+                                  schedule=schedule).minimize(loss)
+                popt = fluid.default_main_program()._pipeline_opt
+                assert popt["shared"]["params"] == {"tied_w": [0, 2]}
+                assert popt["shared"]["owner"]["tied_w"] == 2
+                assert popt["shared"]["grads"], "sum op not stripped"
+                secs = popt["sections"]
+                assert secs[0].shared_partials or secs[2].shared_partials
+            else:
+                optimizer.SGD(learning_rate=0.05).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            losses = []
+            for (bx,) in batches:
+                (lv,) = exe.run(
+                    feed={"x": bx, "y": np.tanh(bx @ Wt)},
+                    fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+            trajs[pipelined] = losses
+    np.testing.assert_allclose(trajs[True], trajs[False], rtol=2e-4,
+                               atol=1e-6)
+    assert trajs[True][-1] < trajs[True][0]
+
+
+def test_pipeline_rejects_fwd_written_cross_stage_state():
+    """Only optimizer-updated params may span stages; a persistable
+    WRITTEN by forward ops on one stage and read on another still
+    raises (replicas would silently desynchronize)."""
+    from paddle_tpu.parallel import PipelineOptimizer
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    with fluid.pipeline_stage(0):
+        h = layers.fc(x, size=4, act="tanh")
+        counter = layers.create_global_var(
+            shape=[1], value=0.0, dtype="float32", persistable=True)
+        layers.increment(counter)
+    with fluid.pipeline_stage(1):
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(pred + counter)
+    with pytest.raises(NotImplementedError, match="pipeline"):
+        PipelineOptimizer(optimizer.SGD(learning_rate=0.1),
+                          num_microbatches=2).minimize(loss)
+
+
 def test_pipeline_stage_annotation_on_grad_ops():
     _, _, loss = _staged_mlp(n_stages=2)
     from paddle_tpu.parallel import PipelineOptimizer
