@@ -46,7 +46,7 @@ def _positional_encoding(max_len, d_model, dtype="float32"):
 def multi_head_attention(q_in, kv_in, d_model, n_head, dropout_rate=0.0,
                          causal=False, is_test=False, seq_len_q=None,
                          seq_len_kv=None, name=None, use_flash=True,
-                         pfx=None):
+                         pfx=None, attn_bias=None):
     """q_in: [B, Tq, D]; kv_in: [B, Tk, D].
 
     When attention-weight dropout is off the score+softmax+weighted-sum is
@@ -54,6 +54,11 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout_rate=0.0,
     the [Tq, Tk] matrix never touches HBM.  With weight dropout on, the
     unfused composition is kept so the reference's dropout-on-weights
     semantics hold exactly.
+
+    attn_bias: optional additive score bias broadcastable to
+    [B, H, Tq, Tk] (e.g. a [B, 1, 1, Tk] source-padding mask, the
+    reference NMT decoders' LoD-derived attention bias); forces the
+    unfused composition.
     """
     tq = q_in.shape[1]
     tk = kv_in.shape[1]
@@ -69,7 +74,7 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout_rate=0.0,
     k = _split_heads(k, tk, n_head, head_dim)
     v = _split_heads(v, tk, n_head, head_dim)
     weight_dropout = bool(dropout_rate) and not is_test
-    if use_flash and not weight_dropout:
+    if use_flash and not weight_dropout and attn_bias is None:
         out = layers.flash_attention(q, k, v, causal=causal)
     else:
         attn = layers.matmul(q, k, transpose_y=True,
@@ -81,6 +86,8 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout_rate=0.0,
                            k=1 + tk - tq)
             mask_var = layers.assign(mask.reshape(1, 1, tq, tk))
             attn = layers.elementwise_add(attn, mask_var)
+        if attn_bias is not None:
+            attn = layers.elementwise_add(attn, attn_bias)
         weights = layers.softmax(attn)
         if weight_dropout:
             weights = layers.dropout(
@@ -115,10 +122,11 @@ def _residual_norm(x, sub, dropout_rate, is_test, pfx=None):
 
 
 def encoder_layer(x, d_model, n_head, d_inner, dropout_rate=0.1,
-                  is_test=False, pfx=None):
+                  is_test=False, pfx=None, attn_bias=None):
     sp = _sub(pfx)
     attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
-                                is_test=is_test, pfx=sp("self"))
+                                is_test=is_test, pfx=sp("self"),
+                                attn_bias=attn_bias)
     x = _residual_norm(x, attn, dropout_rate, is_test, pfx=sp("ln1"))
     ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test,
                pfx=sp("ffn"))
@@ -126,7 +134,7 @@ def encoder_layer(x, d_model, n_head, d_inner, dropout_rate=0.1,
 
 
 def decoder_layer(x, enc_out, d_model, n_head, d_inner, dropout_rate=0.1,
-                  is_test=False, pfx=None):
+                  is_test=False, pfx=None, cross_attn_bias=None):
     sp = _sub(pfx)
     self_attn = multi_head_attention(x, x, d_model, n_head, dropout_rate,
                                      causal=True, is_test=is_test,
@@ -135,7 +143,8 @@ def decoder_layer(x, enc_out, d_model, n_head, d_inner, dropout_rate=0.1,
                        pfx=sp("ln1"))
     cross = multi_head_attention(x, enc_out, d_model, n_head,
                                  dropout_rate, is_test=is_test,
-                                 pfx=sp("cross"))
+                                 pfx=sp("cross"),
+                                 attn_bias=cross_attn_bias)
     x = _residual_norm(x, cross, dropout_rate, is_test, pfx=sp("ln2"))
     ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test,
                pfx=sp("ffn"))
@@ -199,32 +208,53 @@ def transformer_encoder_model(
             "loss": loss}
 
 
+def _src_pad_bias(src, max_len, pad_id):
+    """[B, T, 1] int64 ids -> [B, 1, 1, T] additive attention bias:
+    -1e9 on padding positions, 0 elsewhere (the reference NMT models'
+    LoD-derived src_slf/src_attn bias, e.g.
+    tests/unittests/dist_transformer.py pad-mask construction)."""
+    ids = layers.reshape(src, [-1, max_len])
+    pad = layers.fill_constant([1], "int64", float(pad_id))
+    is_pad = layers.cast(layers.equal(ids, pad), "float32")
+    return layers.reshape(layers.scale(is_pad, scale=-1e9),
+                          [-1, 1, 1, max_len])
+
+
 def transformer_nmt_model(
     src_vocab_size=32000, tgt_vocab_size=32000, max_len=256, d_model=512,
     n_head=8, d_inner=2048, n_layer=6, dropout_rate=0.1, is_test=False,
-    param_prefix=None,
+    param_prefix=None, use_src_pad_mask=False, pad_id=0,
 ):
     """Encoder-decoder NMT transformer (Transformer-base when defaults).
 
     param_prefix: when set, every parameter gets a deterministic name
     under the prefix so a separately-built program — the KV-cache
     `transformer_nmt_greedy_decode` loop — shares the trained weights
-    through the scope."""
+    through the scope.
+
+    use_src_pad_mask: mask `pad_id` source positions out of encoder
+    self-attention and decoder cross-attention with a -1e9 score bias,
+    so variable-length padded batches don't attend padding.  Pass the
+    same flag to the decode builders to keep train/decode parity."""
     p = param_prefix
     sp = _sub(p)
     src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
     tgt = layers.data("tgt_ids", shape=[max_len, 1], dtype="int64")
     label = layers.data("tgt_label", shape=[max_len, 1], dtype="int64")
+    src_bias = _src_pad_bias(src, max_len, pad_id) \
+        if use_src_pad_mask else None
     enc = _embed(src, src_vocab_size, d_model, max_len, dropout_rate,
                  is_test, pfx=sp("src_emb"))
     for li in range(n_layer):
         enc = encoder_layer(enc, d_model, n_head, d_inner, dropout_rate,
-                            is_test, pfx=sp(f"enc{li}"))
+                            is_test, pfx=sp(f"enc{li}"),
+                            attn_bias=src_bias)
     dec = _embed(tgt, tgt_vocab_size, d_model, max_len, dropout_rate,
                  is_test, pfx=sp("tgt_emb"))
     for li in range(n_layer):
         dec = decoder_layer(dec, enc, d_model, n_head, d_inner,
-                            dropout_rate, is_test, pfx=sp(f"dec{li}"))
+                            dropout_rate, is_test, pfx=sp(f"dec{li}"),
+                            cross_attn_bias=src_bias)
     logits = layers.fc(dec, tgt_vocab_size, num_flatten_dims=2,
                        bias_attr=False, param_attr=_w(p, "out_fc"))
     loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
@@ -238,19 +268,21 @@ def _split_heads(x, t, n_head, head_dim):
 
 
 def _decode_encoder(p, src_vocab_size, max_len, d_model, n_head,
-                    d_inner, n_layer):
+                    d_inner, n_layer, use_src_pad_mask=False, pad_id=0):
     """Encoder pass for the decode builders + per-layer cross-attention
     K/V, computed ONCE outside the decode loop (the KV-cache trick's
     encoder half) with the weight names the training build gave these
     fc's.  Returns (src data var, [(enc_k, enc_v)] per layer,
-    each [B, H, Tsrc, hd])."""
+    each [B, H, Tsrc, hd], src_bias [B, 1, 1, Tsrc] or None)."""
     hd = d_model // n_head
     src = layers.data("src_ids", shape=[max_len, 1], dtype="int64")
+    src_bias = _src_pad_bias(src, max_len, pad_id) \
+        if use_src_pad_mask else None
     enc = _embed(src, src_vocab_size, d_model, max_len, 0.0, True,
                  pfx=f"{p}_src_emb")
     for li in range(n_layer):
         enc = encoder_layer(enc, d_model, n_head, d_inner, 0.0, True,
-                            pfx=f"{p}_enc{li}")
+                            pfx=f"{p}_enc{li}", attn_bias=src_bias)
     cross_kv = []
     for li in range(n_layer):
         ck = layers.fc(enc, d_model, num_flatten_dims=2,
@@ -261,7 +293,7 @@ def _decode_encoder(p, src_vocab_size, max_len, d_model, n_head,
                        param_attr=_w(f"{p}_dec{li}_cross", "v"))
         cross_kv.append((_split_heads(ck, max_len, n_head, hd),
                          _split_heads(cv, max_len, n_head, hd)))
-    return src, cross_kv
+    return src, cross_kv, src_bias
 
 
 def _cache_attention(q, kc, vc, pos, kpos, decode_len, n_head, hd):
@@ -285,7 +317,7 @@ def _cache_attention(q, kc, vc, pos, kpos, decode_len, n_head, hd):
 
 def _decode_step(cur, pos, caches, cross_kv, p, tgt_vocab_size,
                  decode_len, d_model, n_head, d_inner, n_layer, kpos,
-                 pe):
+                 pe, src_bias=None):
     """One decoder-stack step on the current token(s): embeds `cur`
     ([N, 1, 1] ids), writes each layer's new K/V into its cache at
     `pos`, attends cache + precomputed cross K/V.  Returns
@@ -328,6 +360,8 @@ def _decode_step(cur, pos, caches, cross_kv, p, tgt_vocab_size,
         enc_k, enc_v = cross_kv[li]
         s2 = layers.matmul(_split_heads(q2, 1, n_head, hd), enc_k,
                            transpose_y=True, alpha=float(hd) ** -0.5)
+        if src_bias is not None:
+            s2 = layers.elementwise_add(s2, src_bias)
         o2 = layers.matmul(layers.softmax(s2), enc_v)
         o2 = layers.reshape(layers.transpose(o2, [0, 2, 1, 3]),
                             [-1, 1, d_model])
@@ -345,7 +379,7 @@ def _decode_step(cur, pos, caches, cross_kv, p, tgt_vocab_size,
 def transformer_nmt_greedy_decode(
     src_vocab_size=32000, tgt_vocab_size=32000, max_len=256, d_model=512,
     n_head=8, d_inner=2048, n_layer=6, param_prefix=None,
-    decode_len=32, bos_id=1,
+    decode_len=32, bos_id=1, use_src_pad_mask=False, pad_id=0,
 ):
     """Autoregressive greedy decoding with per-layer KV caches — the
     modern TPU-native successor of the reference's RNN-era
@@ -371,8 +405,9 @@ def transformer_nmt_greedy_decode(
             "transformer_nmt_greedy_decode needs the param_prefix the "
             "training model was built with (weight sharing is by name)")
     p = param_prefix
-    src, cross_kv = _decode_encoder(p, src_vocab_size, max_len, d_model,
-                                    n_head, d_inner, n_layer)
+    src, cross_kv, src_bias = _decode_encoder(
+        p, src_vocab_size, max_len, d_model, n_head, d_inner, n_layer,
+        use_src_pad_mask=use_src_pad_mask, pad_id=pad_id)
     pe = layers.assign(_positional_encoding(decode_len, d_model))
     pos_seq = layers.assign(
         np.arange(decode_len, dtype=np.int64)[:, None])   # [T, 1]
@@ -399,7 +434,8 @@ def transformer_nmt_greedy_decode(
                   for k0, v0 in cache_init]               # [T, B, D]
         logits, new_caches = _decode_step(
             cur, pos, caches, cross_kv, p, tgt_vocab_size, decode_len,
-            d_model, n_head, d_inner, n_layer, kpos, pe)
+            d_model, n_head, d_inner, n_layer, kpos, pe,
+            src_bias=src_bias)
         for (kc_pre, vc_pre), (kc, vc) in zip(caches, new_caches):
             rnn.update_memory(kc_pre, kc)
             rnn.update_memory(vc_pre, vc)
@@ -418,6 +454,7 @@ def transformer_nmt_beam_decode(
     src_vocab_size=32000, tgt_vocab_size=32000, max_len=256, d_model=512,
     n_head=8, d_inner=2048, n_layer=6, param_prefix=None,
     decode_len=32, beam_size=4, bos_id=1, eos_id=None,
+    use_src_pad_mask=False, pad_id=0,
 ):
     """Beam-search decoding on the KV-cache loop (the transformer
     successor of the reference's dense `beam_search` op + RNN-era
@@ -446,8 +483,9 @@ def transformer_nmt_beam_decode(
             "training model was built with (weight sharing is by name)")
     p = param_prefix
     K, V = beam_size, tgt_vocab_size
-    src, cross_kv = _decode_encoder(p, src_vocab_size, max_len, d_model,
-                                    n_head, d_inner, n_layer)
+    src, cross_kv, src_bias = _decode_encoder(
+        p, src_vocab_size, max_len, d_model, n_head, d_inner, n_layer,
+        use_src_pad_mask=use_src_pad_mask, pad_id=pad_id)
     hd = d_model // n_head
     # replicate each batch row's encoder K/V across its K beams:
     # [B, H, T, hd] -> [B, K, H, T, hd] -> [B*K, H, T, hd]
@@ -457,6 +495,11 @@ def transformer_nmt_beam_decode(
         return layers.reshape(t, [-1, n_head, max_len, hd])
 
     cross_kv = [(_to_beams(ck), _to_beams(cv)) for ck, cv in cross_kv]
+    if src_bias is not None:
+        # beam rows share their batch row's mask: [B,1,1,T] -> [BK,1,1,T]
+        src_bias = layers.reshape(
+            layers.expand(src_bias, [1, K, 1, 1]),
+            [-1, 1, 1, max_len])
 
     pe = layers.assign(_positional_encoding(decode_len, d_model))
     pos_seq = layers.assign(
@@ -499,7 +542,8 @@ def transformer_nmt_beam_decode(
                   for k0, v0 in cache_init]               # [T, BK, D]
         logits, new_caches = _decode_step(
             cur, pos, caches, cross_kv, p, tgt_vocab_size, decode_len,
-            d_model, n_head, d_inner, n_layer, kpos, pe)
+            d_model, n_head, d_inner, n_layer, kpos, pe,
+            src_bias=src_bias)
         # log_softmax, not log(softmax): softmax underflow would put
         # -inf in logp, and the done-mask's 0 * -inf would NaN-poison
         # topk for any finished beam

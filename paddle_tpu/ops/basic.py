@@ -805,7 +805,9 @@ def dropout(ins, attrs):
     key = jax.random.key(attrs["seed"] or 42)
     off = ins.get("SeedOffset")
     if off is not None:
-        key = jax.random.fold_in(key, off.reshape(()).astype(jnp.uint32))
+        from paddle_tpu.ops.rng import fold_seed_offset
+
+        key = fold_seed_offset(key, off)
     keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
     mask = keep.astype(x.dtype)
     out = x * mask

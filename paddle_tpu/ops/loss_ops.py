@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.core.registry import REQUIRED, register_op
+from paddle_tpu.ops.rng import fold_seed_offset
 
 _NEG_INF = -1e30
 
@@ -134,8 +135,7 @@ def crf_decoding(ins, attrs):
 
 
 def _sample_ids(seed, offset, k, num_classes):
-    key = jax.random.fold_in(jax.random.PRNGKey(seed),
-                             jnp.asarray(offset, jnp.int32).reshape(()))
+    key = fold_seed_offset(jax.random.PRNGKey(seed), offset)
     return jax.random.randint(key, (k,), 0, num_classes)
 
 
@@ -249,9 +249,8 @@ def sampled_uniform(ins, attrs):
     """Jit-deterministic uniform sampling: unlike uniform_random (host
     numpy, startup-program initializer), this re-randomizes every step
     under jit via the SeedOffset counter (the dropout pattern)."""
-    key = jax.random.fold_in(
-        jax.random.PRNGKey(attrs["seed"]),
-        jnp.asarray(ins.get("SeedOffset", 0), jnp.int32).reshape(()))
+    key = fold_seed_offset(jax.random.PRNGKey(attrs["seed"]),
+                           ins.get("SeedOffset", 0))
     return {"Out": jax.random.uniform(
         key, tuple(attrs["shape"]), jnp.float32,
         attrs["min"], attrs["max"])}
@@ -262,9 +261,8 @@ def sampled_uniform(ins, attrs):
              attrs={"shape": REQUIRED, "mean": 0.0, "std": 1.0, "seed": 0},
              differentiable=False)
 def sampled_gaussian(ins, attrs):
-    key = jax.random.fold_in(
-        jax.random.PRNGKey(attrs["seed"]),
-        jnp.asarray(ins.get("SeedOffset", 0), jnp.int32).reshape(()))
+    key = fold_seed_offset(jax.random.PRNGKey(attrs["seed"]),
+                           ins.get("SeedOffset", 0))
     return {"Out": attrs["mean"] + attrs["std"] * jax.random.normal(
         key, tuple(attrs["shape"]), jnp.float32)}
 

@@ -229,12 +229,19 @@ def sampling_id(ins, attrs):
     matrix X (categorical draw).  Optional SeedOffset tensor is folded
     into the key (the dropout-op pattern) so draws inside a lax.scan
     vary per step — a bare attr seed is traced once and would repeat
-    the same draw every iteration."""
+    the same draw every iteration.
+
+    SeedOffset contract: a small non-negative integer scalar (a step
+    position).  With jax x64 disabled an int64 offset silently narrows
+    to int32, so a negative value would wrap differently per x64 mode;
+    the clamp below pins the behavior (negatives fold as 0)."""
     x = ins["X"]
     key = jax.random.PRNGKey(attrs["seed"] or 0)
     off = ins.get("SeedOffset")
     if off is not None:
-        key = jax.random.fold_in(key, off.reshape(()).astype(jnp.uint32))
+        from paddle_tpu.ops.rng import fold_seed_offset
+
+        key = fold_seed_offset(key, off)
     u = jax.random.uniform(key, (x.shape[0], 1), x.dtype,
                            attrs["min"], attrs["max"])
     cdf = jnp.cumsum(x, axis=1)

@@ -1,0 +1,22 @@
+"""Shared SeedOffset fold for jit-deterministic randomness.
+
+Sampling ops re-randomize under jit by folding a SeedOffset counter
+into their PRNG key (the dropout-op pattern; reference ops instead
+re-seed per execution on the host, e.g. dropout_op.cc's
+std::minstd_rand).  Contract: SeedOffset is a small non-negative
+integer scalar (a step position).  With jax x64 disabled an int64
+offset silently narrows to int32, so a negative value would wrap
+differently per x64 mode; the clamp pins the behavior (negatives fold
+as 0) uniformly across every op that uses the pattern.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_seed_offset(key, off):
+    """Fold a SeedOffset scalar (array or python int) into a PRNG key."""
+    off = jnp.maximum(jnp.asarray(off).reshape(()), 0)
+    return jax.random.fold_in(key, off.astype(jnp.uint32))

@@ -451,8 +451,9 @@ def random_crop(ins, attrs):
     seed = ins.get("Seed")
     if seed is None:
         seed = jnp.asarray([attrs["startup_seed"]], jnp.int64)
-    key = jax.random.fold_in(
-        jax.random.PRNGKey(0), seed.reshape(()).astype(jnp.uint32))
+    from paddle_tpu.ops.rng import fold_seed_offset
+
+    key = fold_seed_offset(jax.random.PRNGKey(0), seed)
     k = len(crop_shape)
     lead = x.ndim - k
     maxs = np.array([x.shape[lead + i] - crop_shape[i]

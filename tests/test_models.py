@@ -134,6 +134,105 @@ def test_transformer_kv_cache_greedy_decode():
                                rtol=2e-3)
 
 
+def test_transformer_src_pad_mask_truncation_equivalence():
+    """use_src_pad_mask semantics: with the mask on, a source padded
+    from length L to max_len produces — at the first L target
+    positions (causal tgt self-attention sees only <= own position) —
+    EXACTLY the logits of the same weights built at max_len=L on the
+    unpadded source; without the mask the padded run differs.  The
+    KV-cache greedy decode threads the same bias, so its step logits
+    match the short-program decode too (advisor r4: reference NMT
+    decoders mask padding via the LoD-derived attention bias)."""
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.models.transformer import (
+        transformer_nmt_greedy_decode, transformer_nmt_model)
+
+    np.random.seed(5)
+    vocab, T, L = 32, 8, 5
+    cfg = dict(src_vocab_size=vocab, tgt_vocab_size=vocab,
+               d_model=32, n_head=4, d_inner=64, n_layer=2,
+               dropout_rate=0.0, is_test=True, param_prefix="tfpm")
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    progs = {}
+    for key, max_len, masked in (("pad", T, True), ("ref", L, True),
+                                 ("nomask", T, False)):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            np.random.seed(5)  # identical param init draws
+            m = transformer_nmt_model(max_len=max_len,
+                                      use_src_pad_mask=masked, **cfg)
+        progs[key] = (prog, startup, m)
+    # one scope, one startup run: deterministic param names share the
+    # weights across all three programs
+    exe.run(progs["pad"][1])
+
+    rng = np.random.RandomState(2)
+    srcL = rng.randint(2, vocab, (4, L, 1)).astype(np.int64)
+    srcT = np.concatenate(
+        [srcL, np.zeros((4, T - L, 1), np.int64)], axis=1)  # 0 = pad
+    tgtT = rng.randint(2, vocab, (4, T, 1)).astype(np.int64)
+    tgtT[:, 0] = 1
+
+    def logits(key, src, tgt):
+        prog, _, m = progs[key]
+        (lg,) = exe.run(prog, feed={"src_ids": src, "tgt_ids": tgt,
+                                    "tgt_label": np.zeros_like(tgt)},
+                        fetch_list=[m["logits"]])
+        return lg
+
+    lg_pad = logits("pad", srcT, tgtT)
+    lg_ref = logits("ref", srcL, tgtT[:, :L])
+    np.testing.assert_allclose(lg_pad[:, :L], lg_ref, atol=2e-5,
+                               rtol=1e-4)
+    lg_nomask = logits("nomask", srcT, tgtT)
+    assert np.abs(lg_nomask[:, :L] - lg_ref).max() > 1e-3, \
+        "unmasked padded run should differ — mask is a no-op?"
+
+    # greedy decode threads the same bias: padded decode == short decode
+    dec = {}
+    for key, max_len in (("pad", T), ("ref", L)):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            d = transformer_nmt_greedy_decode(
+                src_vocab_size=vocab, tgt_vocab_size=vocab,
+                max_len=max_len, d_model=32, n_head=4, d_inner=64,
+                n_layer=2, param_prefix="tfpm", decode_len=6, bos_id=1,
+                use_src_pad_mask=True)
+        dec[key] = (prog, d)
+    out_p, lg_p = exe.run(dec["pad"][0], feed={"src_ids": srcT},
+                          fetch_list=[dec["pad"][1]["out_ids"],
+                                      dec["pad"][1]["step_logits"]])
+    out_r, lg_r = exe.run(dec["ref"][0], feed={"src_ids": srcL},
+                          fetch_list=[dec["ref"][1]["out_ids"],
+                                      dec["ref"][1]["step_logits"]])
+    np.testing.assert_allclose(lg_p, lg_r, atol=2e-5, rtol=1e-4)
+    assert (out_p == out_r).all()
+
+    # beam decode replicates each row's mask across its beams
+    # ([B,1,1,T] -> [B*K,1,1,T]): padded == short, per beam and score
+    from paddle_tpu.models.transformer import transformer_nmt_beam_decode
+
+    beams = {}
+    for key, max_len in (("pad", T), ("ref", L)):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            b = transformer_nmt_beam_decode(
+                src_vocab_size=vocab, tgt_vocab_size=vocab,
+                max_len=max_len, d_model=32, n_head=4, d_inner=64,
+                n_layer=2, param_prefix="tfpm", decode_len=6, bos_id=1,
+                beam_size=2, use_src_pad_mask=True)
+        beams[key] = (prog, b)
+    bo_p, sc_p = exe.run(beams["pad"][0], feed={"src_ids": srcT},
+                         fetch_list=[beams["pad"][1]["out_ids"],
+                                     beams["pad"][1]["scores"]])
+    bo_r, sc_r = exe.run(beams["ref"][0], feed={"src_ids": srcL},
+                         fetch_list=[beams["ref"][1]["out_ids"],
+                                     beams["ref"][1]["scores"]])
+    assert (bo_p == bo_r).all()
+    np.testing.assert_allclose(sc_p, sc_r, atol=1e-4, rtol=1e-4)
+
+
 def test_transformer_beam_decode():
     """Beam search on the KV-cache loop: beam=1 reproduces greedy
     exactly; beam=4 solves the trained copy task with descending
