@@ -38,6 +38,7 @@ class Config:
         self._use_feed_fetch_ops = False
         self._memory_optim = True
         self._glog_info = True
+        self._bf16 = False
 
     def set_model(self, model_dir_or_prog, params_file=None):
         if params_file is None:
@@ -62,6 +63,12 @@ class Config:
 
     def enable_mkldnn(self):
         pass
+
+    def enable_mkldnn_bfloat16(self):
+        """reference paddle_analysis_config.h EnableMkldnnBfloat16: on
+        this runtime the params fold to bfloat16 and the compute runs
+        bf16 on the MXU (contrib.float16.bf16_transpile)."""
+        self._bf16 = True
 
     def switch_ir_optim(self, enable=True):
         """Toggle the analysis pass pipeline (reference
@@ -152,6 +159,10 @@ class Predictor:
                                              protected=protected)
                 FuseElewiseAddActTranspiler().transpile(
                     self._program, protected=protected)
+            if config._bf16:
+                from paddle_tpu.contrib.float16 import bf16_transpile
+
+                bf16_transpile(self._program, scope=self._scope)
         self._compiled = CompiledProgram(self._program) \
             .with_inference_optimize(config)
         self._inputs = {n: PaddleTensor(n) for n in self._feed_names}

@@ -35,9 +35,27 @@ def _apply_platform_override():
             pass  # already initialized with a real platform
 
 
-def load(model_dir, prog_file=None, params_file=None):
-    """Create a Predictor over a save_inference_model artifact; returns
-    an int handle for the C side."""
+# PtDType codes (include/pt_predictor.h) <-> numpy dtypes.  bfloat16
+# payloads cross the boundary as raw 2-byte words via ml_dtypes.
+def _dtype_map():
+    import ml_dtypes
+
+    return {0: np.float32, 1: np.int64, 2: np.int32, 3: np.float64,
+            4: ml_dtypes.bfloat16}
+
+
+def _dtype_code(np_dtype):
+    for code, dt in _dtype_map().items():
+        if np.dtype(np_dtype) == np.dtype(dt):
+            return code
+    return None
+
+
+def load_cfg(model_dir, prog_file=None, params_file=None,
+             enable_bf16=0, disable_ir_optim=0):
+    """Create a Predictor from the PtConfig fields (reference
+    AnalysisConfig paddle_analysis_config.h:40); returns an int handle
+    for the C side."""
     _apply_platform_override()
     from paddle_tpu.inference import Config, create_predictor
 
@@ -49,11 +67,19 @@ def load(model_dir, prog_file=None, params_file=None):
         cfg._prog_file = os.path.join(model_dir, prog_file)
     if params_file is not None:
         cfg._params_file = os.path.join(model_dir, params_file)
+    if enable_bf16:
+        cfg.enable_mkldnn_bfloat16()
+    if disable_ir_optim:
+        cfg.switch_ir_optim(False)
     pred = create_predictor(cfg)
     h = _next_handle[0]
     _next_handle[0] += 1
     _predictors[h] = pred
     return h
+
+
+def load(model_dir, prog_file=None, params_file=None):
+    return load_cfg(model_dir, prog_file, params_file)
 
 
 def input_names(handle):
@@ -64,14 +90,18 @@ def output_names(handle):
     return list(_predictors[handle].get_output_names())
 
 
-def run_raw(handle, feeds):
-    """feeds: list of (name, float32_bytes, shape_list).  Returns list
-    of (float32_bytes, shape_list) in get_output_names() order."""
+def run_typed(handle, feeds):
+    """feeds: list of (name, bytes, shape_list, dtype_code).  Returns
+    list of (bytes, shape_list, dtype_code) in get_output_names()
+    order; each output keeps its natural dtype."""
     pred = _predictors[handle]
+    dmap = _dtype_map()
     by_name = {}
-    for name, buf, shape in feeds:
+    for name, buf, shape, code in feeds:
+        if code not in dmap:
+            raise ValueError(f"unknown dtype code {code} for '{name}'")
         by_name[name] = np.frombuffer(
-            buf, dtype=np.float32).reshape([int(d) for d in shape])
+            buf, dtype=dmap[code]).reshape([int(d) for d in shape])
     # every declared input must be fed, by name — a silent positional
     # rebind of a partial feed would produce wrong numbers, not errors
     missing = [n for n in pred.get_input_names() if n not in by_name]
@@ -81,8 +111,15 @@ def run_raw(handle, feeds):
     outs = pred.run(inputs)
     result = []
     for o in outs:
-        arr = np.ascontiguousarray(np.asarray(o), dtype=np.float32)
-        result.append((arr.tobytes(), [int(d) for d in arr.shape]))
+        arr = np.ascontiguousarray(np.asarray(o))
+        code = _dtype_code(arr.dtype)
+        if code is None:
+            # dtype with no C-side code (e.g. bool): negotiate down
+            # to float32 rather than hand over uninterpretable bytes
+            arr = np.ascontiguousarray(arr, dtype=np.float32)
+            code = 0
+        result.append((arr.tobytes(), [int(d) for d in arr.shape],
+                       code))
     return result
 
 

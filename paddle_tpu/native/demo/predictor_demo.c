@@ -2,25 +2,23 @@
  * inference/api/demo_ci/simple_on_word2vec.cc: load a
  * save_inference_model artifact, feed a tensor, print the output).
  *
+ * Exercises the full surface: PtConfig (bf16 toggle via PT_DEMO_BF16=1,
+ * ir-optim toggle via PT_DEMO_NO_IR=1), named input/output discovery,
+ * the typed run, and get-output-by-name.
+ *
  * Build: `make demo` in paddle_tpu/native (links
  * libpaddle_tpu_native.so).  Run:
  *   PYTHONPATH=<repo> PADDLE_TPU_PLATFORM=cpu \
  *     ./predictor_demo <model_dir> <input_name> d0 d1 ...
- * Feeds an arange/100 tensor of that shape, prints "OUT shape: ..."
- * and the first few values — the test compares them against the
- * Python Predictor. */
+ * Feeds an arange/100 tensor of that shape, prints the IO names,
+ * "OUT shape: ..." and the first few values — the test compares them
+ * against the Python Predictor. */
 #include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 
-extern void* pt_predictor_load(const char* model_dir);
-extern int pt_predictor_run(void* h, const char** names,
-                            const float** data, const int64_t** shapes,
-                            const int* ndims, int n_in);
-extern int pt_predictor_get_output(void* h, int idx, float** out_data,
-                                   int64_t** out_shape, int* out_ndim);
-extern void pt_predictor_free(void* h);
-extern void pt_free(void* p);
+#include "../include/pt_predictor.h"
 
 int main(int argc, char** argv) {
   if (argc < 4) {
@@ -44,41 +42,102 @@ int main(int argc, char** argv) {
   float* data = (float*)malloc(numel * sizeof(float));
   for (int64_t i = 0; i < numel; ++i) data[i] = (float)i / 100.0f;
 
-  void* pred = pt_predictor_load(model_dir);
+  PtConfig cfg;
+  memset(&cfg, 0, sizeof(cfg));
+  cfg.model_dir = model_dir;
+  const char* bf16 = getenv("PT_DEMO_BF16");
+  cfg.enable_bf16 = (bf16 != NULL && bf16[0] == '1');
+  const char* noir = getenv("PT_DEMO_NO_IR");
+  cfg.disable_ir_optim = (noir != NULL && noir[0] == '1');
+  void* pred = pt_predictor_create(&cfg);
   if (!pred) {
-    fprintf(stderr, "pt_predictor_load failed\n");
+    fprintf(stderr, "pt_predictor_create failed\n");
     return 1;
   }
-  const char* names[1] = {input_name};
-  const float* bufs[1] = {data};
-  const int64_t* shapes[1] = {shape};
-  int ndims[1] = {ndim};
-  int n_out = pt_predictor_run(pred, names, bufs, shapes, ndims, 1);
-  if (n_out < 1) {
-    fprintf(stderr, "pt_predictor_run failed\n");
-    return 1;
+
+  /* named IO discovery */
+  int n_in_names = pt_predictor_num_inputs(pred);
+  int n_out_names = pt_predictor_num_outputs(pred);
+  printf("IN names:");
+  for (int i = 0; i < n_in_names; ++i) {
+    char* nm = pt_predictor_input_name(pred, i);
+    printf(" %s", nm ? nm : "?");
+    pt_free(nm);
   }
-  float* out;
-  int64_t* oshape;
-  int ondim;
-  if (pt_predictor_get_output(pred, 0, &out, &oshape, &ondim) != 0) {
-    fprintf(stderr, "pt_predictor_get_output failed\n");
-    return 1;
-  }
-  int64_t onumel = 1;
-  printf("OUT shape:");
-  for (int d = 0; d < ondim; ++d) {
-    printf(" %lld", (long long)oshape[d]);
-    onumel *= oshape[d];
-  }
-  printf("\nOUT data:");
-  for (int64_t i = 0; i < onumel && i < 8; ++i) {
-    printf(" %.6f", out[i]);
+  printf("\nOUT names:");
+  char* first_out = NULL;
+  for (int i = 0; i < n_out_names; ++i) {
+    char* nm = pt_predictor_output_name(pred, i);
+    printf(" %s", nm ? nm : "?");
+    if (i == 0) {
+      first_out = nm;
+    } else {
+      pt_free(nm);
+    }
   }
   printf("\n");
-  pt_free(out);
-  pt_free(oshape);
-  free(data);
+  if (!first_out) {
+    fprintf(stderr, "no outputs\n");
+    return 1;
+  }
+
+  const char* names[1] = {input_name};
+  const void* bufs[1] = {data};
+  const int dtypes[1] = {PT_FLOAT32};
+  const int64_t* shapes[1] = {shape};
+  int ndims[1] = {ndim};
+  int n_out = pt_predictor_run_typed(pred, names, bufs, dtypes, shapes,
+                                     ndims, 1);
+  if (n_out < 1) {
+    fprintf(stderr, "pt_predictor_run_typed failed\n");
+    return 1;
+  }
+
+  /* fetch by NAME, with dtype negotiation */
+  void* out_data = NULL;
+  int out_dtype = -1;
+  int64_t* out_shape = NULL;
+  int out_ndim = 0;
+  if (pt_predictor_get_output_by_name(pred, first_out, &out_data,
+                                      &out_dtype, &out_shape,
+                                      &out_ndim) != 0) {
+    fprintf(stderr, "pt_predictor_get_output_by_name failed\n");
+    return 1;
+  }
+  printf("OUT dtype: %d\nOUT shape:", out_dtype);
+  int64_t out_numel = 1;
+  for (int d = 0; d < out_ndim; ++d) {
+    printf(" %lld", (long long)out_shape[d]);
+    out_numel *= out_shape[d];
+  }
+  printf("\nOUT data:");
+  int64_t show = out_numel < 16 ? out_numel : 16;
+  for (int64_t i = 0; i < show; ++i) {
+    if (out_dtype == PT_FLOAT32) {
+      printf(" %.6f", ((float*)out_data)[i]);
+    } else if (out_dtype == PT_INT64) {
+      printf(" %lld", (long long)((int64_t*)out_data)[i]);
+    } else if (out_dtype == PT_INT32) {
+      printf(" %d", ((int32_t*)out_data)[i]);
+    } else if (out_dtype == PT_FLOAT64) {
+      printf(" %.6f", ((double*)out_data)[i]);
+    } else if (out_dtype == PT_BFLOAT16) {
+      /* decode bf16: upper 16 bits of a float32 */
+      uint16_t raw = ((uint16_t*)out_data)[i];
+      uint32_t bits = ((uint32_t)raw) << 16;
+      float v;
+      memcpy(&v, &bits, sizeof(v));
+      printf(" %.6f", v);
+    } else {
+      printf(" ?");
+    }
+  }
+  printf("\n");
+
+  pt_free(first_out);
+  pt_free(out_data);
+  pt_free(out_shape);
   pt_predictor_free(pred);
+  free(data);
   return 0;
 }

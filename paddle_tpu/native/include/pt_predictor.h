@@ -1,0 +1,96 @@
+/* C-ABI predictor surface (reference inference/api/paddle_api.h:202
+ * PaddlePredictor + paddle_analysis_config.h:40 AnalysisConfig; the C
+ * API the reference shipped demos against in inference/api/demo_ci/).
+ *
+ * Lifecycle:
+ *   PtConfig cfg = {0};
+ *   cfg.model_dir = "/path/to/save_inference_model_dir";
+ *   cfg.enable_bf16 = 1;                     // optional
+ *   void* h = pt_predictor_create(&cfg);     // or pt_predictor_load(dir)
+ *   ... pt_predictor_run_typed(...) / pt_predictor_get_output_by_name(...)
+ *   pt_predictor_free(h);
+ *
+ * Every buffer returned through an out-parameter is malloc'd; release
+ * it with pt_free. */
+#ifndef PT_PREDICTOR_H_
+#define PT_PREDICTOR_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* dtype negotiation codes (reference PaddleDType, paddle_api.h:32) */
+typedef enum PtDType {
+  PT_FLOAT32 = 0,
+  PT_INT64 = 1,
+  PT_INT32 = 2,
+  PT_FLOAT64 = 3,
+  PT_BFLOAT16 = 4, /* raw 2-byte bfloat16 payload */
+} PtDType;
+
+/* reference AnalysisConfig (paddle_analysis_config.h:40): model
+ * location + the knobs that mean something on this runtime.  Optional
+ * pointers may be NULL; file names are relative to model_dir. */
+typedef struct PtConfig {
+  const char* model_dir;   /* required */
+  const char* prog_file;   /* non-default program file name */
+  const char* params_file; /* non-default params file name */
+  int enable_bf16;         /* EnableMkldnnBfloat16 analog: fold params
+                              to bfloat16 and compute in bf16 */
+  int disable_ir_optim;    /* SwitchIrOptim(false): skip conv-bn fold
+                              + fc/add-act fusion passes on load */
+} PtConfig;
+
+/* Create from a config; returns NULL on failure. */
+void* pt_predictor_create(const PtConfig* cfg);
+
+/* Shorthand: defaults + model_dir only. */
+void* pt_predictor_load(const char* model_dir);
+
+/* Named IO discovery (reference GetInputNames/GetOutputNames).  The
+ * returned name is malloc'd; pt_free it. */
+int pt_predictor_num_inputs(void* h);
+int pt_predictor_num_outputs(void* h);
+char* pt_predictor_input_name(void* h, int idx);
+char* pt_predictor_output_name(void* h, int idx);
+
+/* Feed n_in named tensors with per-tensor dtype codes; returns the
+ * number of outputs (>= 0) or -1.  Outputs are cached on the handle
+ * until the next run. */
+int pt_predictor_run_typed(void* h, const char** names,
+                           const void** data, const int* dtypes,
+                           const int64_t** shapes, const int* ndims,
+                           int n_in);
+
+/* float32-only legacy form of the above. */
+int pt_predictor_run(void* h, const char** names, const float** data,
+                     const int64_t** shapes, const int* ndims, int n_in);
+
+/* Copy output `idx` of the last run; *out_dtype receives the PtDType
+ * of the malloc'd payload. */
+int pt_predictor_get_output_typed(void* h, int idx, void** out_data,
+                                  int* out_dtype, int64_t** out_shape,
+                                  int* out_ndim);
+
+/* Same, addressed by output name (reference GetOutputTensor(name)). */
+int pt_predictor_get_output_by_name(void* h, const char* name,
+                                    void** out_data, int* out_dtype,
+                                    int64_t** out_shape, int* out_ndim);
+
+/* Legacy accessor: the payload is CONVERTED to float32 whatever the
+ * output's natural dtype (the historical contract). */
+int pt_predictor_get_output(void* h, int idx, float** out_data,
+                            int64_t** out_shape, int* out_ndim);
+
+void pt_predictor_free(void* h);
+
+/* Release any buffer returned through an out-parameter. */
+void pt_free(void* p);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* PT_PREDICTOR_H_ */

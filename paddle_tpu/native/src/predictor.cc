@@ -1,32 +1,27 @@
 // C-ABI predictor: a linkable serving surface (reference
 // inference/api/paddle_api.h:202 PaddlePredictor + :338
-// CreatePaddlePredictor; demos under inference/api/demo_ci/).
+// CreatePaddlePredictor + paddle_analysis_config.h:40 AnalysisConfig;
+// demos under inference/api/demo_ci/).  Full API: include/pt_predictor.h.
 //
 // The predictor hosts the Python runtime (SURVEY.md §7 design stance:
 // native where the reference is native; the compute itself is the
-// normal XLA path).  A C/C++ serving app links libpaddle_tpu_native.so
-// and calls:
-//
-//   void* h = pt_predictor_load("/path/to/save_inference_model_dir");
-//   int n_out = pt_predictor_run(h, names, bufs, shapes, ndims, n_in);
-//   pt_predictor_get_output(h, 0, &data, &shape, &ndim);  // pt_free both
-//   pt_predictor_free(h);
-//
-// Inside an already-running Python process (ctypes) the embedded
-// runtime is joined, not re-initialized.
+// normal XLA path).  Inside an already-running Python process (ctypes)
+// the embedded runtime is joined, not re-initialized.
 #include <Python.h>
 
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
+#include "../include/pt_predictor.h"
 #include "common.h"
 
 namespace {
 
 struct PtPredictor {
   PyObject* handle;    // int handle inside capi_bridge
-  PyObject* outputs;   // list of (bytes, shape) from the last run
+  PyObject* outputs;   // list of (bytes, shape, dtype) from the last run
 };
 
 PyObject* bridge_module() {
@@ -35,22 +30,202 @@ PyObject* bridge_module() {
   return m;
 }
 
-}  // namespace
-
-extern "C" {
-
-void* pt_predictor_load(const char* model_dir) {
+void ensure_runtime() {
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
     // drop the GIL acquired by initialization so PyGILState below
     // owns it cleanly from any thread
     PyEval_SaveThread();
   }
+}
+
+// bytes-per-element for each PtDType payload
+size_t dtype_size(int dt) {
+  switch (dt) {
+    case PT_FLOAT32:
+    case PT_INT32:
+      return 4;
+    case PT_INT64:
+    case PT_FLOAT64:
+      return 8;
+    case PT_BFLOAT16:
+      return 2;
+    default:
+      return 0;
+  }
+}
+
+// Copies a malloc'd C string out of a Python str; nullptr on failure.
+char* str_to_c(PyObject* s) {
+  if (s == nullptr) return nullptr;
+  const char* utf = PyUnicode_AsUTF8(s);
+  if (utf == nullptr) {
+    PyErr_Print();  // never leave a live exception behind
+    return nullptr;
+  }
+  char* out = static_cast<char*>(std::malloc(std::strlen(utf) + 1));
+  if (out != nullptr) std::strcpy(out, utf);
+  return out;
+}
+
+// Shared body of the name accessors: calls bridge fn(handle) -> list
+// of str and returns a malloc'd copy of entry idx.
+char* name_at(void* hv, const char* fn, int idx) {
+  if (hv == nullptr) return nullptr;
+  auto* h = static_cast<PtPredictor*>(hv);
+  PyGILState_STATE g = PyGILState_Ensure();
+  char* out = nullptr;
+  PyObject* m = bridge_module();
+  if (m != nullptr) {
+    PyObject* names = PyObject_CallMethod(m, fn, "O", h->handle);
+    if (names != nullptr) {
+      if (idx >= 0 && PyList_Check(names) &&
+          idx < PyList_Size(names)) {
+        out = str_to_c(PyList_GetItem(names, idx));
+      }
+      Py_DECREF(names);
+    } else {
+      PyErr_Print();
+    }
+    Py_DECREF(m);
+  }
+  if (PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(g);
+  return out;
+}
+
+int count_of(void* hv, const char* fn) {
+  if (hv == nullptr) return -1;
+  auto* h = static_cast<PtPredictor*>(hv);
+  PyGILState_STATE g = PyGILState_Ensure();
+  int out = -1;
+  PyObject* m = bridge_module();
+  if (m != nullptr) {
+    PyObject* names = PyObject_CallMethod(m, fn, "O", h->handle);
+    if (names != nullptr) {
+      if (PyList_Check(names)) {
+        out = static_cast<int>(PyList_Size(names));
+      }
+      Py_DECREF(names);
+    } else {
+      PyErr_Print();
+    }
+    Py_DECREF(m);
+  }
+  if (PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(g);
+  return out;
+}
+
+// Converts one element at index i of a typed payload to float.
+float elem_as_float(const char* src, int dt, int64_t i) {
+  switch (dt) {
+    case PT_INT64:
+      return static_cast<float>(
+          reinterpret_cast<const int64_t*>(src)[i]);
+    case PT_INT32:
+      return static_cast<float>(
+          reinterpret_cast<const int32_t*>(src)[i]);
+    case PT_FLOAT64:
+      return static_cast<float>(
+          reinterpret_cast<const double*>(src)[i]);
+    case PT_BFLOAT16: {
+      uint32_t bits =
+          static_cast<uint32_t>(
+              reinterpret_cast<const uint16_t*>(src)[i])
+          << 16;
+      float v;
+      std::memcpy(&v, &bits, sizeof(v));
+      return v;
+    }
+    default:
+      return 0.0f;
+  }
+}
+
+// Copies the (bytes, shape[, dtype]) tuple at `idx` of h->outputs into
+// malloc'd buffers.  to_f32 keeps the legacy pt_predictor_get_output
+// contract: every payload CONVERTS to float32 (the pre-typed-API
+// bridge did the same on the Python side, so old callers keep
+// working).  Returns 0 on success; never leaves a live CPython
+// exception behind.
+int copy_output(PtPredictor* h, int idx, void** out_data, int* out_dtype,
+                int64_t** out_shape, int* out_ndim, bool to_f32) {
+  if (h->outputs == nullptr || idx < 0 ||
+      idx >= PyList_Size(h->outputs)) {
+    return -1;
+  }
+  PyObject* tup = PyList_GetItem(h->outputs, idx);  // borrowed
+  PyObject* buf = PyTuple_GetItem(tup, 0);
+  PyObject* shape = PyTuple_GetItem(tup, 1);
+  int dt = PT_FLOAT32;
+  if (PyTuple_Size(tup) > 2) {
+    dt = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(tup, 2)));
+  }
+  if (buf == nullptr || shape == nullptr || PyErr_Occurred()) {
+    PyErr_Print();
+    return -1;
+  }
+  Py_ssize_t nbytes = PyBytes_Size(buf);
+  size_t esize = dtype_size(dt);
+  if (nbytes < 0 || esize == 0) {
+    PyErr_Print();
+    return -1;
+  }
+  int64_t numel = static_cast<int64_t>(nbytes) /
+                  static_cast<int64_t>(esize);
+  bool convert = to_f32 && dt != PT_FLOAT32;
+  Py_ssize_t out_bytes =
+      convert ? static_cast<Py_ssize_t>(numel * sizeof(float)) : nbytes;
+  int nd = static_cast<int>(PyList_Size(shape));
+  auto* dptr = std::malloc(out_bytes > 0 ? out_bytes : 1);
+  auto* sptr = static_cast<int64_t*>(
+      std::malloc(sizeof(int64_t) * (nd > 0 ? nd : 1)));
+  if (dptr == nullptr || sptr == nullptr) {
+    std::free(dptr);
+    std::free(sptr);
+    return -1;
+  }
+  const char* src = PyBytes_AsString(buf);
+  if (convert) {
+    auto* f = static_cast<float*>(dptr);
+    for (int64_t i = 0; i < numel; ++i) {
+      f[i] = elem_as_float(src, dt, i);
+    }
+    dt = PT_FLOAT32;
+  } else {
+    std::memcpy(dptr, src, nbytes);
+  }
+  for (int d = 0; d < nd; ++d) {
+    sptr[d] = PyLong_AsLongLong(PyList_GetItem(shape, d));
+  }
+  if (PyErr_Occurred()) {
+    PyErr_Print();
+    std::free(dptr);
+    std::free(sptr);
+    return -1;
+  }
+  *out_data = dptr;
+  *out_shape = sptr;
+  *out_ndim = nd;
+  if (out_dtype != nullptr) *out_dtype = dt;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_predictor_create(const PtConfig* cfg) {
+  if (cfg == nullptr || cfg->model_dir == nullptr) return nullptr;
+  ensure_runtime();
   PyGILState_STATE g = PyGILState_Ensure();
   void* out = nullptr;
   PyObject* m = bridge_module();
   if (m != nullptr) {
-    PyObject* h = PyObject_CallMethod(m, "load", "s", model_dir);
+    PyObject* h = PyObject_CallMethod(
+        m, "load_cfg", "szzii", cfg->model_dir, cfg->prog_file,
+        cfg->params_file, cfg->enable_bf16, cfg->disable_ir_optim);
     if (h != nullptr) {
       out = new PtPredictor{h, nullptr};
     } else {
@@ -62,11 +237,35 @@ void* pt_predictor_load(const char* model_dir) {
   return out;
 }
 
-// Feeds n_in float32 tensors; returns the number of outputs (>=0) or
-// -1 on failure.  Outputs are cached on the handle until the next run.
-int pt_predictor_run(void* hv, const char** names, const float** data,
-                     const int64_t** shapes, const int* ndims,
-                     int n_in) {
+void* pt_predictor_load(const char* model_dir) {
+  PtConfig cfg = {};
+  cfg.model_dir = model_dir;
+  return pt_predictor_create(&cfg);
+}
+
+int pt_predictor_num_inputs(void* hv) {
+  return count_of(hv, "input_names");
+}
+
+int pt_predictor_num_outputs(void* hv) {
+  return count_of(hv, "output_names");
+}
+
+char* pt_predictor_input_name(void* hv, int idx) {
+  return name_at(hv, "input_names", idx);
+}
+
+char* pt_predictor_output_name(void* hv, int idx) {
+  return name_at(hv, "output_names", idx);
+}
+
+// Feeds n_in tensors with per-tensor dtype codes; returns the number
+// of outputs (>=0) or -1 on failure.  Outputs are cached on the
+// handle until the next run.
+int pt_predictor_run_typed(void* hv, const char** names,
+                           const void** data, const int* dtypes,
+                           const int64_t** shapes, const int* ndims,
+                           int n_in) {
   if (hv == nullptr) return -1;
   auto* h = static_cast<PtPredictor*>(hv);
   PyGILState_STATE g = PyGILState_Ensure();
@@ -74,6 +273,11 @@ int pt_predictor_run(void* hv, const char** names, const float** data,
   PyObject* feeds = PyList_New(n_in);
   bool ok = feeds != nullptr;
   for (int i = 0; ok && i < n_in; ++i) {
+    size_t esize = dtype_size(dtypes[i]);
+    if (esize == 0) {
+      ok = false;
+      break;
+    }
     int64_t numel = 1;
     PyObject* shape = PyList_New(ndims[i]);
     if (shape == nullptr) {
@@ -94,14 +298,15 @@ int pt_predictor_run(void* hv, const char** names, const float** data,
       break;
     }
     PyObject* buf = PyBytes_FromStringAndSize(
-        reinterpret_cast<const char*>(data[i]),
-        static_cast<Py_ssize_t>(numel * sizeof(float)));
+        static_cast<const char*>(data[i]),
+        static_cast<Py_ssize_t>(numel * esize));
     if (buf == nullptr) {
       Py_DECREF(shape);
       ok = false;
       break;
     }
-    PyObject* tup = Py_BuildValue("(sNN)", names[i], buf, shape);
+    PyObject* tup = Py_BuildValue("(sNNi)", names[i], buf, shape,
+                                  dtypes[i]);
     if (tup == nullptr) {
       ok = false;
       break;
@@ -116,7 +321,7 @@ int pt_predictor_run(void* hv, const char** names, const float** data,
   if (ok) {
     PyObject* m = bridge_module();
     if (m != nullptr) {
-      PyObject* res = PyObject_CallMethod(m, "run_raw", "ON",
+      PyObject* res = PyObject_CallMethod(m, "run_typed", "ON",
                                           h->handle, feeds);
       feeds = nullptr;  // stolen by N
       if (res != nullptr) {
@@ -134,41 +339,90 @@ int pt_predictor_run(void* hv, const char** names, const float** data,
   return rc;
 }
 
-// Copies output `idx` of the last run into malloc'd buffers the caller
-// releases with pt_free.  Returns 0 on success.
+int pt_predictor_run(void* hv, const char** names, const float** data,
+                     const int64_t** shapes, const int* ndims,
+                     int n_in) {
+  if (n_in < 0 || n_in > 1024) return -1;
+  const void** vdata = static_cast<const void**>(
+      std::malloc(sizeof(void*) * (n_in > 0 ? n_in : 1)));
+  int* dtypes = static_cast<int*>(
+      std::malloc(sizeof(int) * (n_in > 0 ? n_in : 1)));
+  if (vdata == nullptr || dtypes == nullptr) {
+    std::free(vdata);
+    std::free(dtypes);
+    return -1;
+  }
+  for (int i = 0; i < n_in; ++i) {
+    vdata[i] = data[i];
+    dtypes[i] = PT_FLOAT32;
+  }
+  int rc = pt_predictor_run_typed(hv, names, vdata, dtypes, shapes,
+                                  ndims, n_in);
+  std::free(vdata);
+  std::free(dtypes);
+  return rc;
+}
+
+int pt_predictor_get_output_typed(void* hv, int idx, void** out_data,
+                                  int* out_dtype, int64_t** out_shape,
+                                  int* out_ndim) {
+  if (hv == nullptr) return -1;
+  auto* h = static_cast<PtPredictor*>(hv);
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = copy_output(h, idx, out_data, out_dtype, out_shape,
+                       out_ndim, false);
+  PyGILState_Release(g);
+  return rc;
+}
+
+int pt_predictor_get_output_by_name(void* hv, const char* name,
+                                    void** out_data, int* out_dtype,
+                                    int64_t** out_shape, int* out_ndim) {
+  if (hv == nullptr || name == nullptr) return -1;
+  auto* h = static_cast<PtPredictor*>(hv);
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* m = bridge_module();
+  if (m != nullptr) {
+    PyObject* names = PyObject_CallMethod(m, "output_names", "O",
+                                          h->handle);
+    if (names != nullptr) {
+      for (Py_ssize_t i = 0;
+           PyList_Check(names) && i < PyList_Size(names); ++i) {
+        const char* n = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+        if (n == nullptr) {
+          PyErr_Print();
+          continue;
+        }
+        if (std::strcmp(n, name) == 0) {
+          rc = copy_output(h, static_cast<int>(i), out_data, out_dtype,
+                           out_shape, out_ndim, false);
+          break;
+        }
+      }
+      Py_DECREF(names);
+    } else {
+      PyErr_Print();
+    }
+    Py_DECREF(m);
+  }
+  if (PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(g);
+  return rc;
+}
+
+// Legacy accessor: every payload converts to float32 (the pre-typed
+// bridge converted on the Python side; old callers rely on it).
 int pt_predictor_get_output(void* hv, int idx, float** out_data,
                             int64_t** out_shape, int* out_ndim) {
   if (hv == nullptr) return -1;
   auto* h = static_cast<PtPredictor*>(hv);
   PyGILState_STATE g = PyGILState_Ensure();
-  int rc = -1;
-  if (h->outputs != nullptr && idx >= 0 &&
-      idx < PyList_Size(h->outputs)) {
-    PyObject* tup = PyList_GetItem(h->outputs, idx);  // borrowed
-    PyObject* buf = PyTuple_GetItem(tup, 0);
-    PyObject* shape = PyTuple_GetItem(tup, 1);
-    if (buf != nullptr && shape != nullptr) {
-      Py_ssize_t nbytes = PyBytes_Size(buf);
-      int nd = static_cast<int>(PyList_Size(shape));
-      auto* dptr = static_cast<float*>(std::malloc(nbytes));
-      auto* sptr = static_cast<int64_t*>(
-          std::malloc(sizeof(int64_t) * (nd > 0 ? nd : 1)));
-      if (dptr != nullptr && sptr != nullptr) {
-        std::memcpy(dptr, PyBytes_AsString(buf), nbytes);
-        for (int d = 0; d < nd; ++d) {
-          sptr[d] = PyLong_AsLongLong(PyList_GetItem(shape, d));
-        }
-        *out_data = dptr;
-        *out_shape = sptr;
-        *out_ndim = nd;
-        rc = 0;
-      } else {
-        std::free(dptr);
-        std::free(sptr);
-      }
-    }
-  }
+  void* dptr = nullptr;
+  int rc = copy_output(h, idx, &dptr, nullptr, out_shape, out_ndim,
+                       true);
   PyGILState_Release(g);
+  if (rc == 0) *out_data = static_cast<float*>(dptr);
   return rc;
 }
 

@@ -78,6 +78,95 @@ print("EXPECT " + json.dumps(
 
 
 @pytest.mark.skipif(not toolchain, reason="no C toolchain")
+def test_c_demo_named_io_config_and_dtypes(tmp_path):
+    """The round-5 C-API depth surface (reference paddle_api.h:202
+    GetInputNames/GetOutputTensor + paddle_analysis_config.h:40): the
+    demo discovers IO names, creates from a PtConfig (bf16 toggle),
+    runs typed, fetches by name — and dtype negotiation hands an
+    argmax model's int64 output across unconverted."""
+    r = subprocess.run(["make", "-s", "demo"], cwd=NATIVE,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    demo = os.path.join(NATIVE, "demo", "predictor_demo")
+
+    saver = r"""
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, json, sys
+import paddle_tpu as fluid
+from paddle_tpu import layers, framework
+np.random.seed(0)
+x = layers.data("x", shape=[6], dtype="float32")
+h = layers.fc(x, 8, act="relu")
+out = layers.fc(h, 3)
+ids = layers.argmax(out, axis=1)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(framework.default_startup_program())
+d32, dids = sys.argv[1], sys.argv[2]
+fluid.io.save_inference_model(d32, ["x"], [out], exe)
+fluid.io.save_inference_model(dids, ["x"], [ids], exe)
+from paddle_tpu.inference import Config, create_predictor
+feed = (np.arange(12, dtype=np.float32)/100.0).reshape(2, 6)
+expect, = create_predictor(Config(d32)).run([feed])
+print("EXPECT " + json.dumps(
+    [float(v) for v in np.asarray(expect).ravel()]))
+cfg = Config(d32); cfg.enable_mkldnn_bfloat16()
+e16, = create_predictor(cfg).run([feed])
+print("EXPECT16 " + json.dumps(
+    [float(v) for v in np.asarray(e16, dtype=np.float32).ravel()]))
+eids, = create_predictor(Config(dids)).run([feed])
+print("EXPECTIDS " + json.dumps(
+    [int(v) for v in np.asarray(eids).ravel()]))
+"""
+    d32, dids = str(tmp_path / "m32"), str(tmp_path / "mids")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, "-c", saver, d32, dids],
+                       capture_output=True, text=True, timeout=300,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-3000:]
+    exp = {}
+    for ln in r.stdout.splitlines():
+        for key in ("EXPECT16", "EXPECTIDS", "EXPECT"):
+            if ln.startswith(key + " "):
+                exp[key] = json.loads(ln[len(key) + 1:])
+                break
+
+    def run_demo(model_dir, extra_env=None):
+        r = subprocess.run(
+            [demo, model_dir, "x", "2", "6"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "PYTHONPATH": ROOT,
+                 "PADDLE_TPU_PLATFORM": "cpu", **(extra_env or {})})
+        assert r.returncode == 0, (r.stdout, r.stderr[-3000:])
+        return dict(ln.split(":", 1) for ln in r.stdout.splitlines()
+                    if ":" in ln)
+
+    # f32: named IO + by-name fetch, exact match
+    lines = run_demo(d32)
+    assert lines["IN names"].split() == ["x"]
+    assert len(lines["OUT names"].split()) == 1
+    assert int(lines["OUT dtype"]) == 0
+    np.testing.assert_allclose(
+        [float(v) for v in lines["OUT data"].split()],
+        exp["EXPECT"], rtol=1e-5, atol=1e-6)
+
+    # PtConfig.enable_bf16: output arrives as raw bfloat16 (code 4)
+    # and decodes to the Python bf16 predictor's values exactly
+    lines = run_demo(d32, {"PT_DEMO_BF16": "1"})
+    assert int(lines["OUT dtype"]) == 4
+    np.testing.assert_allclose(
+        [float(v) for v in lines["OUT data"].split()],
+        exp["EXPECT16"], rtol=0, atol=1e-6)
+
+    # integer negotiation: the argmax model's ids cross with their
+    # actual integer payload dtype (PT_INT32 under jax's default
+    # x64-off, PT_INT64 with x64 on) — never silently as float bytes
+    lines = run_demo(dids)
+    assert int(lines["OUT dtype"]) in (1, 2)
+    assert [int(v) for v in lines["OUT data"].split()] == \
+        exp["EXPECTIDS"]
+
+
+@pytest.mark.skipif(not toolchain, reason="no C toolchain")
 def test_capi_from_ctypes_joins_running_interpreter(tmp_path):
     """The same C ABI must also work when the host process IS Python
     (ctypes): the embedded-runtime path joins instead of
@@ -138,3 +227,29 @@ def test_capi_from_ctypes_joins_running_interpreter(tmp_path):
     lib.pt_free(data)
     lib.pt_free(oshape)
     lib.pt_predictor_free(ctypes.c_void_p(h))
+
+    # legacy-contract compatibility: pt_predictor_get_output CONVERTS
+    # integer outputs to float32 (the pre-typed bridge did the same),
+    # so old clients pointed at e.g. an argmax model keep working
+    ids_var = layers.argmax(out, axis=1)
+    ids_dir = str(tmp_path / "ids")
+    fluid.io.save_inference_model(ids_dir, ["x"], [ids_var], exe)
+    h2 = lib.pt_predictor_load(ids_dir.encode())
+    assert h2
+    n_out = lib.pt_predictor_run(ctypes.c_void_p(h2), names, bufs,
+                                 shapes, ndims, 1)
+    assert n_out == 1
+    rc = lib.pt_predictor_get_output(
+        ctypes.c_void_p(h2), 0, ctypes.byref(data), ctypes.byref(oshape),
+        ctypes.byref(ondim))
+    assert rc == 0
+    got_ids = np.ctypeslib.as_array(data, shape=(2,)).copy()
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    with scope_guard(Scope()):
+        expect_ids, = create_predictor(Config(ids_dir)).run([feed])
+    np.testing.assert_allclose(
+        got_ids, np.asarray(expect_ids).astype(np.float32).ravel())
+    lib.pt_free(data)
+    lib.pt_free(oshape)
+    lib.pt_predictor_free(ctypes.c_void_p(h2))
