@@ -37,18 +37,26 @@ def _apply_platform_override():
 
 # PtDType codes (include/pt_predictor.h) <-> numpy dtypes.  bfloat16
 # payloads cross the boundary as raw 2-byte words via ml_dtypes.
-def _dtype_map():
-    import ml_dtypes
+# Built lazily once: ml_dtypes stays a soft dependency of the typed
+# path and the hot serving loop doesn't rebuild dicts per request.
+_dtype_cache: list = []
 
-    return {0: np.float32, 1: np.int64, 2: np.int32, 3: np.float64,
-            4: ml_dtypes.bfloat16}
+
+def _dtype_map():
+    if not _dtype_cache:
+        import ml_dtypes
+
+        fwd = {0: np.float32, 1: np.int64, 2: np.int32, 3: np.float64,
+               4: ml_dtypes.bfloat16}
+        _dtype_cache.append(fwd)
+        _dtype_cache.append({np.dtype(dt): code
+                             for code, dt in fwd.items()})
+    return _dtype_cache[0]
 
 
 def _dtype_code(np_dtype):
-    for code, dt in _dtype_map().items():
-        if np.dtype(np_dtype) == np.dtype(dt):
-            return code
-    return None
+    _dtype_map()
+    return _dtype_cache[1].get(np.dtype(np_dtype))
 
 
 def load_cfg(model_dir, prog_file=None, params_file=None,
@@ -121,6 +129,21 @@ def run_typed(handle, feeds):
         result.append((arr.tobytes(), [int(d) for d in arr.shape],
                        code))
     return result
+
+
+def run_raw(handle, feeds):
+    """Pre-typed-API compat (the load -> load_cfg aliasing pattern):
+    float32 feeds in, float32 outputs back, dtype codes hidden."""
+    typed = [(name, buf, shape, 0) for name, buf, shape in feeds]
+    dmap = _dtype_map()
+    out = []
+    for buf, shape, code in run_typed(handle, typed):
+        if code != 0:
+            arr = np.frombuffer(buf, dtype=dmap[code]).astype(
+                np.float32)
+            buf = arr.tobytes()
+        out.append((buf, shape))
+    return out
 
 
 def free(handle):

@@ -342,7 +342,7 @@ int pt_predictor_run_typed(void* hv, const char** names,
 int pt_predictor_run(void* hv, const char** names, const float** data,
                      const int64_t** shapes, const int* ndims,
                      int n_in) {
-  if (n_in < 0 || n_in > 1024) return -1;
+  if (n_in < 0) return -1;
   const void** vdata = static_cast<const void**>(
       std::malloc(sizeof(void*) * (n_in > 0 ? n_in : 1)));
   int* dtypes = static_cast<int*>(
