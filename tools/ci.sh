@@ -4,8 +4,15 @@
 set -e
 cd "$(dirname "$0")/.."
 
-echo "== 1/7 test suite (virtual 8-device CPU mesh) =="
-python -m pytest tests/ -q
+echo "== 1/7 test suite (virtual 8-device CPU mesh; two lanes) =="
+# fast lane first: cheap tests fail the matrix within ~5 min before
+# the subprocess-cluster/compile-heavy slow lane spends half an hour.
+# Together the lanes are the identical full suite (conftest assigns
+# `slow` from tools/test_durations.json).
+# a missing/empty manifest marks nothing slow; exit code 5 (nothing
+# collected) from the then-empty slow lane must not fail the matrix
+python -m pytest tests/ -q -m "not slow"
+python -m pytest tests/ -q -m "slow" || { rc=$?; [ "$rc" -eq 5 ]; }
 
 echo "== 2/7 op inventory audit vs reference REGISTER_OPERATOR =="
 JAX_PLATFORMS=cpu python tools/op_coverage.py

@@ -1,0 +1,67 @@
+"""Refresh tools/test_durations.json from a `pytest --durations=0` log.
+
+Usage:
+    python -m pytest tests/ -q --durations=0 > /tmp/d.log
+    python tools/update_test_durations.py /tmp/d.log
+
+The manifest drives the two-lane suite: conftest marks any test whose
+summed (setup+call+teardown) time exceeds the threshold as `slow`, so
+`pytest tests/ -m "not slow"` is the <5-min inner loop while the bare
+run keeps the full matrix.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import sys
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "test_durations.json")
+
+
+def parse(path):
+    dur = collections.Counter()
+    pat = re.compile(r"([0-9.]+)s (call|setup|teardown)\s+(\S+)")
+    with open(path) as f:
+        for ln in f:
+            m = pat.match(ln.strip())
+            if m and m.group(3).startswith("tests/"):
+                dur[m.group(3)] += float(m.group(1))
+    return dur
+
+
+def main(argv):
+    if len(argv) != 2:
+        print(__doc__)
+        return 2
+    dur = parse(argv[1])
+    if not dur:
+        print("no duration lines found in %s (need --durations=0)"
+              % argv[1])
+        return 1
+    # MERGE into the existing manifest: a log from a partial run (one
+    # file, -k filter) must only refresh the tests it actually timed —
+    # a blind overwrite would silently drop every other test's entry
+    # and demote all slow tests to the fast lane
+    merged = {}
+    try:
+        with open(OUT) as f:
+            merged = json.load(f)
+    except (OSError, ValueError):
+        pass
+    stale = len(merged)
+    merged.update({k: round(v, 2) for k, v in dur.items()})
+    with open(OUT, "w") as f:
+        json.dump(dict(sorted(merged.items())), f, indent=0)
+        f.write("\n")
+    print("wrote %s: %d entries (%d refreshed from log, %d kept)"
+          % (OUT, len(merged), len(dur),
+             max(0, stale - len(dur))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
