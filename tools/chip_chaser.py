@@ -26,8 +26,20 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
-# (name, leg, kwargs) — kwargs {} means the leg's full default shape
+# (name, leg, kwargs) — kwargs {} means the leg's full default shape.
+# ROUND-5 ORDER (VERDICT r4 next-round #1): the unmet north star is
+# ResNet-50 >=50% MFU, so the batch-knee sweep and the space-to-depth
+# A/B bank FIRST in any window; anchors/profiles/sweeps follow; int8
+# (the known 2026-07-31 tunnel-wedger) stays last.
 TASKS = [
+    ("rn_train_mb256", "rn_train", {"batch": 256, "chain": 20}),
+    # A/B: space-to-depth stem (exact-equivalence rewrite) — compare
+    # step_ms against the plain mb128/mb256 rows
+    ("rn_train_mb128_s2d", "rn_train",
+     {"batch": 128, "chain": 20, "s2d": True}),
+    ("rn_train_mb512", "rn_train", {"batch": 512, "chain": 10}),
+    ("tf_train_mb64", "tf_train", {"batch": 64, "chain": 20}),
+    ("bert_train_mb16", "bert_train", {"batch": 16, "chain": 10}),
     ("vgg16_infer", "vgg_infer", {}),
     ("longctx_flash_seq32768", "longctx", {}),
     # mb=1 latency anchors — the reference's float16_benchmark.md
@@ -35,6 +47,12 @@ TASKS = [
     # mb=1 rows (rn50 fp16 6.13 ms, vgg16 fp16 3.32 ms on V100)
     ("rn50_infer_mb1", "infer", {"batch": 1, "chain": 200}),
     ("vgg16_infer_mb1", "vgg_infer", {"batch": 1, "chain": 200}),
+    # split per shape with generous timeouts: each seq-32k fwd+bwd
+    # compile is minutes over the tunnel
+    ("flash_block_sweep_tf",
+     "script:tools/flash_block_sweep.py --shape tf_base", {}, 1500),
+    ("flash_block_sweep_longctx",
+     "script:tools/flash_block_sweep.py --shape longctx", {}, 1800),
     # on-chip HLO evidence the r3 verdict asked for: Pallas
     # custom_call count in the TPU lowering + copy/transpose
     # histogram under the real layout assignment
@@ -42,21 +60,7 @@ TASKS = [
      "script:tools/profile_transformer.py --time", {}),
     ("profile_resnet_onchip",
      "script:tools/profile_resnet.py --nhwc --bf16 --time", {}),
-    # split per shape with generous timeouts: each seq-32k fwd+bwd
-    # compile is minutes over the tunnel
-    ("flash_block_sweep_tf",
-     "script:tools/flash_block_sweep.py --shape tf_base", {}, 1500),
-    ("flash_block_sweep_longctx",
-     "script:tools/flash_block_sweep.py --shape longctx", {}, 1800),
-    ("rn_train_mb256", "rn_train", {"batch": 256, "chain": 20}),
-    # A/B: space-to-depth stem (exact-equivalence rewrite) — compare
-    # step_ms against the plain mb128/mb256 rows
-    ("rn_train_mb128_s2d", "rn_train",
-     {"batch": 128, "chain": 20, "s2d": True}),
-    ("tf_train_mb64", "tf_train", {"batch": 64, "chain": 20}),
     ("tf_train_mb128", "tf_train", {"batch": 128, "chain": 10}),
-    ("bert_train_mb16", "bert_train", {"batch": 16, "chain": 10}),
-    ("rn_train_mb512", "rn_train", {"batch": 512, "chain": 10}),
     # the reference's cifar10 fp16 table rows (float16_benchmark.md
     # :56-74) — cheap bf16 legs
     ("vgg16_cifar_infer_mb512", "vgg_cifar", {}),
@@ -77,18 +81,10 @@ TASKS = [
 
 
 def probe(timeout_s=120):
-    code = ("import jax; d = jax.devices()[0]; "
-            "print('PROBE', d.platform, '|', d.device_kind)")
-    try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True,
-                             timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return None
-    for line in out.stdout.splitlines():
-        if line.startswith("PROBE "):
-            return line[len("PROBE "):]
-    return None
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from probe_tpu import probe as _probe
+
+    return _probe(timeout_s)
 
 
 def run_task(name, leg, kwargs, timeout_s=None):

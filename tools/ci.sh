@@ -40,6 +40,18 @@ echo "== 6/7 per-op regression gate (hot ops vs committed CPU baseline) =="
 # in a model bench
 python tools/op_bench.py --cpu --suite tools/op_bench_suite.json \
   --baseline tools/op_bench_baseline_cpu.json --tolerance 3.0
+# chip-conditional: once a tunnel window banks a TPU baseline
+# (tools/op_bench_tpu_snapshot.py -> op_bench_baseline_tpu.json), the
+# same gate also guards on-chip per-op timings whenever a chip is
+# attached at CI time; skipped silently on CPU-only runs
+if [ -f tools/op_bench_baseline_tpu.json ]; then
+  # timeout-bounded: the tunnel can answer the probe then wedge
+  # mid-bench (observed 2026-07-31); never let that hang the matrix
+  timeout 1800 python tools/op_bench.py \
+    --suite tools/op_bench_suite.json \
+    --baseline tools/op_bench_baseline_tpu.json --tolerance 3.0 \
+    --require-tpu-or-skip
+fi
 
 echo "== 7/7 TPU cross-lowering gate (Mosaic legality without a chip) =="
 # interpret-mode tests never run Mosaic's block-mapping checks; this

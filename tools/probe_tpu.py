@@ -1,0 +1,34 @@
+"""Shared TPU probe: ask for the device in a TIMEOUT-WRAPPED
+subprocess, because an inline jax call on a wedged axon tunnel hangs
+forever (memory: tpu-tunnel-behavior).  Returns the probe string
+"<platform> | <device_kind>" or None when nothing answered in time.
+
+Key on the device kind ("TPU" in the string), never on the platform
+name — the tunnel reports platform "axon".
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+_CODE = ("import jax; d = jax.devices()[0]; "
+         "print('PROBE', d.platform, '|', d.device_kind)")
+
+
+def probe(timeout_s=120):
+    try:
+        out = subprocess.run([sys.executable, "-c", _CODE],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("PROBE "):
+            return line[len("PROBE "):]
+    return None
+
+
+def on_tpu(timeout_s=120):
+    got = probe(timeout_s)
+    return got is not None and "TPU" in got

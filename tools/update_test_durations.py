@@ -52,14 +52,12 @@ def main(argv):
             merged = json.load(f)
     except (OSError, ValueError):
         pass
-    stale = len(merged)
     merged.update({k: round(v, 2) for k, v in dur.items()})
     with open(OUT, "w") as f:
         json.dump(dict(sorted(merged.items())), f, indent=0)
         f.write("\n")
     print("wrote %s: %d entries (%d refreshed from log, %d kept)"
-          % (OUT, len(merged), len(dur),
-             max(0, stale - len(dur))))
+          % (OUT, len(merged), len(dur), len(merged) - len(dur)))
     return 0
 
 
