@@ -53,6 +53,11 @@ TASKS = [
      "script:tools/flash_block_sweep.py --shape tf_base", {}, 1500),
     ("flash_block_sweep_longctx",
      "script:tools/flash_block_sweep.py --shape longctx", {}, 1800),
+    # 4x the 32k leg: causal flash fwd+bwd at seq 128k on ONE chip
+    # (QKV ~400 MB; scores never materialize).  16x the FLOPs of the
+    # 32k leg -> long compile + ~3 s steps: generous timeout, chain 5
+    ("longctx_flash_seq131072", "longctx",
+     {"seq": 131072, "chain": 5}, 3000),
     # on-chip HLO evidence the r3 verdict asked for: Pallas
     # custom_call count in the TPU lowering + copy/transpose
     # histogram under the real layout assignment
