@@ -203,6 +203,19 @@ def main():
                   flush=True)
         if rec.get("ok"):
             done.add(name)
+            # auto-bank after every success: bench.py globs the newest
+            # docs/bench_onchip_*.json from the working tree, so the
+            # round's bench artifact improves even if no one is at the
+            # keyboard when the window opens ("z_latest" sorts after
+            # every date-stamped artifact)
+            try:
+                subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "tools", "bank_onchip.py"),
+                     "--stamp", "z_latest"],
+                    capture_output=True, timeout=180)
+            except Exception as e:  # banking must never stall the queue
+                print("auto-bank failed: %s" % e, flush=True)
         else:
             fails[name] = fails.get(name, 0) + 1
             if args.once:
