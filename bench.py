@@ -883,7 +883,9 @@ def main():
                 with open(arts[-1]) as f:
                     prior = json.load(f)
                 src = os.path.basename(arts[-1])
-                run_date = _re.sub(r"\D", "", src)
+                run_date = _re.sub(r"\D", "", src) or \
+                    src.replace("bench_onchip_", "").replace(
+                        ".json", "")
                 # non-degraded live rows keep their exact base key
                 # (key() only decorates degraded rows), so exact-key
                 # comparison decides shadowing — shape tags stay
