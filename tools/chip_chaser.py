@@ -49,6 +49,21 @@ TASKS = [
     ("vgg16_infer_mb1", "vgg_infer", {"batch": 1, "chain": 200}),
     # split per shape with generous timeouts: each seq-32k fwd+bwd
     # compile is minutes over the tunnel
+    # CHEAP DIAGNOSTICS BEFORE LONG SWEEPS: mb256 banked flat vs mb128
+    # (29.71 vs 30.41% MFU), so the rn50 copy/transpose histogram is
+    # the live lever for the unmet north star — run it before the
+    # 25-50-min flash sweeps so a short window still yields it
+    ("profile_resnet_onchip",
+     "script:tools/profile_resnet.py --nhwc --bf16 --time", {}),
+    ("profile_transformer_onchip",
+     "script:tools/profile_transformer.py --time", {}),
+    ("op_bench_tpu_snapshot",
+     "script:tools/op_bench_tpu_snapshot.py", {}),
+    ("tf_train_mb128", "tf_train", {"batch": 128, "chain": 10}),
+    # the reference's cifar10 fp16 table rows (float16_benchmark.md
+    # :56-74) — cheap bf16 legs
+    ("vgg16_cifar_infer_mb512", "vgg_cifar", {}),
+    ("resnet32_cifar_infer_mb512", "rn32_cifar", {}),
     ("flash_block_sweep_tf",
      "script:tools/flash_block_sweep.py --shape tf_base", {}, 1500),
     ("flash_block_sweep_longctx",
@@ -58,26 +73,12 @@ TASKS = [
     # 32k leg -> long compile + ~3 s steps: generous timeout, chain 5
     ("longctx_flash_seq131072", "longctx",
      {"seq": 131072, "chain": 5}, 3000),
-    # on-chip HLO evidence the r3 verdict asked for: Pallas
-    # custom_call count in the TPU lowering + copy/transpose
-    # histogram under the real layout assignment
-    ("profile_transformer_onchip",
-     "script:tools/profile_transformer.py --time", {}),
-    ("profile_resnet_onchip",
-     "script:tools/profile_resnet.py --nhwc --bf16 --time", {}),
-    ("tf_train_mb128", "tf_train", {"batch": 128, "chain": 10}),
-    # the reference's cifar10 fp16 table rows (float16_benchmark.md
-    # :56-74) — cheap bf16 legs
-    ("vgg16_cifar_infer_mb512", "vgg_cifar", {}),
-    ("resnet32_cifar_infer_mb512", "rn32_cifar", {}),
     # "script:" tasks run a standalone tool instead of a bench leg;
     # the primitive probe separates "int8 lowering is broken" from
     # "the tunnel window closed" before the full leg re-runs
     # risk-free capture first (int8 specs excluded by default), then
     # the cheap int8 lowering probe, then the int8 rows and the full
     # int8 leg — everything that compiles int8 stays at the tail
-    ("op_bench_tpu_snapshot",
-     "script:tools/op_bench_tpu_snapshot.py", {}),
     ("int8_primitive_probe", "script:tools/int8_probe.py", {}),
     ("op_bench_tpu_snapshot_int8",
      "script:tools/op_bench_tpu_snapshot.py --int8", {}),
