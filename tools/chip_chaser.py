@@ -55,8 +55,13 @@ TASKS = [
     # 25-50-min flash sweeps so a short window still yields it
     ("profile_resnet_onchip",
      "script:tools/profile_resnet.py --nhwc --bf16 --time", {}),
+    # 2026-08-01 window verdict: rn50 train is HBM-bound (62 ms memory
+    # roofline vs 15.6 ms compute) — name the layout traffic before
+    # spending more chip time on sweeps
+    ("hlo_traffic_rn50",
+     "script:tools/hlo_traffic.py --batch 128 --top 30", {}, 1200),
     ("profile_transformer_onchip",
-     "script:tools/profile_transformer.py --time", {}),
+     "script:tools/profile_transformer.py --time", {}, 1500),
     ("op_bench_tpu_snapshot",
      "script:tools/op_bench_tpu_snapshot.py", {}),
     ("tf_train_mb128", "tf_train", {"batch": 128, "chain": 10}),

@@ -69,8 +69,10 @@ def scan_hlo(hlo_text, kinds=("transpose", "copy", "bitcast-convert")):
         if op not in kinds:
             continue
         nm = _OPNAME_RE.search(s)
-        yield op, shape_bytes(shape_str), (nm.group(1) if nm else "?"), \
-            in_fusion, s
+        sm = _SHAPE_RE.match(shape_str)
+        shape = (f"{sm.group(1)}[{sm.group(2)}]" if sm else shape_str)
+        name = nm.group(1) if nm else shape
+        yield op, shape_bytes(shape_str), name, in_fusion, s
 
 
 def build_resnet(batch, nhwc=True, bf16=True):
