@@ -82,27 +82,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         run = (ki * block_k) <= (q_off + qi * block_q + block_q - 1)
     else:
         run = True
+    # interior blocks (every position valid, fully below the causal
+    # diagonal) skip mask construction entirely: the two [bq, bk]
+    # iotas + compares + selects are VPU work on par with the exp
+    # itself at head_dim 64, so specializing nearly halves VPU cost
+    # on the dominant block population
+    interior = (ki + 1) * block_k <= kv_len
+    if causal:
+        interior &= (ki * block_k + block_k - 1) <= (q_off + qi * block_q)
 
-    @pl.when(run)
-    def _compute():
+    def _accumulate(masked):
         q = q_ref[0]                      # [bq, d]
         k = k_ref[0]                      # [bk, d]
         v = v_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        kpos = ki * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = kpos < kv_len              # padded keys contribute nothing
-        if causal:
-            qpos = q_off + qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            mask = mask & (qpos >= kpos)
-        s = jnp.where(mask, s, _NEG_INF)
+        if masked:
+            kpos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = kpos < kv_len          # padded keys contribute nothing
+            if causal:
+                qpos = q_off + qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                mask = mask & (qpos >= kpos)
+            s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_ref[:, 0]
         m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        # explicit zero for masked entries: a fully-masked row would
-        # otherwise see exp(-1e30 - (-1e30)) = 1 and accumulate garbage
-        p = jnp.where(mask, jnp.exp(s - m_next[:, None]), 0.0)
+        p = jnp.exp(s - m_next[:, None])
+        if masked:
+            # explicit zero for masked entries: a fully-masked row would
+            # otherwise see exp(-1e30 - (-1e30)) = 1 and accumulate
+            # garbage
+            p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_next)
         l_next = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + lax.dot_general(
@@ -110,6 +121,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
             preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_next[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_next[:, None], l_ref.shape)
+
+    @pl.when(run & interior)
+    def _compute_fast():
+        _accumulate(masked=False)
+
+    @pl.when(run & ~interior)
+    def _compute_edge():
+        _accumulate(masked=True)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -199,22 +218,39 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
 # plain attention in XLA, materializing P in HBM (docs/PROFILE_r4.md
 # headroom #1).
 
+def _bwd_interior(*, causal, block_q, block_k, kv_len, q_len, q_off,
+                  qi, ki):
+    """Traced predicate: this (qi, ki) block needs no mask — all kv
+    and q positions valid, fully below the causal diagonal."""
+    interior = ((ki + 1) * block_k <= kv_len) \
+        & ((qi + 1) * block_q <= q_len)
+    if causal:
+        interior &= (ki * block_k + block_k - 1) <= (q_off + qi * block_q)
+    return interior
+
+
 def _bwd_p_ds_block(q, k, v, do, lse, delta, *, scale, causal,
-                    block_q, block_k, kv_len, q_len, q_off, qi, ki):
+                    block_q, block_k, kv_len, q_len, q_off, qi, ki,
+                    masked=True):
     """Recompute the probability block P [bq, bk] (forward's mask plus
     a valid-q-row mask — padded q rows must contribute nothing to
-    dk/dv) and the score gradient dS = P * (dO V^T - delta) * scale."""
+    dk/dv) and the score gradient dS = P * (dO V^T - delta) * scale.
+    With masked=False (interior blocks, see _bwd_interior) the mask
+    iotas/compares/selects are skipped entirely."""
     s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * scale
-    kpos = ki * block_k + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    qrow = qi * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    mask = (kpos < kv_len) & (qrow < q_len)
-    if causal:
-        mask = mask & ((q_off + qrow) >= kpos)
-    # masked entries (incl. fully-masked rows where lse=-1e30) -> 0
-    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+    if masked:
+        kpos = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        qrow = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = (kpos < kv_len) & (qrow < q_len)
+        if causal:
+            mask = mask & ((q_off + qrow) >= kpos)
+        # masked entries (incl. fully-masked rows where lse=-1e30) -> 0
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+    else:
+        p = jnp.exp(s - lse[:, None])
     dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32)
     ds = p * (dp - delta[:, None]) * scale
@@ -236,19 +272,30 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         run = (ki * block_k) <= (q_off + qi * block_q + block_q - 1)
     else:
         run = True
+    interior = _bwd_interior(causal=causal, block_q=block_q,
+                             block_k=block_k, kv_len=kv_len,
+                             q_len=q_len, q_off=q_off, qi=qi, ki=ki)
 
-    @pl.when(run)
-    def _compute():
+    def _accumulate(masked):
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
         do = do_ref[0].astype(jnp.float32)
         _, ds = _bwd_p_ds_block(
             q, k, v, do, lse_ref[0, :, 0], delta_ref[0, :, 0],
             scale=scale,
             causal=causal, block_q=block_q, block_k=block_k,
-            kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki)
+            kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki,
+            masked=masked)
         acc_ref[...] += lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    @pl.when(run & interior)
+    def _compute_fast():
+        _accumulate(masked=False)
+
+    @pl.when(run & ~interior)
+    def _compute_edge():
+        _accumulate(masked=True)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -272,22 +319,33 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         run = (ki * block_k) <= (q_off + qi * block_q + block_q - 1)
     else:
         run = True
+    interior = _bwd_interior(causal=causal, block_q=block_q,
+                             block_k=block_k, kv_len=kv_len,
+                             q_len=q_len, q_off=q_off, qi=qi, ki=ki)
 
-    @pl.when(run)
-    def _compute():
+    def _accumulate(masked):
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
         do = do_ref[0].astype(jnp.float32)
         p, ds = _bwd_p_ds_block(
             q, k, v, do, lse_ref[0, :, 0], delta_ref[0, :, 0],
             scale=scale,
             causal=causal, block_q=block_q, block_k=block_k,
-            kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki)
+            kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki,
+            masked=masked)
         dv_acc[...] += lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_acc[...] += lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    @pl.when(run & interior)
+    def _compute_fast():
+        _accumulate(masked=False)
+
+    @pl.when(run & ~interior)
+    def _compute_edge():
+        _accumulate(masked=True)
 
     @pl.when(qi == nq - 1)
     def _finalize():
