@@ -54,6 +54,10 @@ TASK_KEYS = {
     "rn50_infer_mb1": ("resnet50_infer_bf16_mb1", 6.13),
     "longctx_flash_seq32768": ("longctx_flash_train_mb1_seq32768",
                                None),
+    "longctx_flash_seq32768_d128": (
+        "longctx_flash_train_mb1_seq32768_d128", None),
+    "longctx_flash_seq32768_fastpath": (
+        "longctx_flash_train_mb1_seq32768", None),
     "longctx_flash_seq131072": ("longctx_flash_train_mb1_seq131072",
                                 None),
     "vgg16_cifar_infer_mb512": ("vgg16_cifar10_infer_bf16_mb512",

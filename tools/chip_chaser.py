@@ -42,6 +42,14 @@ TASKS = [
     ("bert_train_mb16", "bert_train", {"batch": 16, "chain": 10}),
     ("vgg16_infer", "vgg_infer", {}),
     ("longctx_flash_seq32768", "longctx", {}),
+    # LLM-style head_dim 128: doubles MXU work per softmax element, so
+    # the kernel's MFU ceiling is ~2x the d=64 leg's; also the first
+    # row benched with the interior-block fast path (7ef0952)
+    ("longctx_flash_seq32768_d128", "longctx",
+     {"head_dim": 128, "chain": 10}),
+    # re-bench of the banked seq-32k row under the interior-block
+    # fast path (same artifact key: latest banked run wins)
+    ("longctx_flash_seq32768_fastpath", "longctx", {}),
     # mb=1 latency anchors — the reference's float16_benchmark.md
     # headline table is mb=1/mb=64/mb=128; BASELINE.md carries the
     # mb=1 rows (rn50 fp16 6.13 ms, vgg16 fp16 3.32 ms on V100)
