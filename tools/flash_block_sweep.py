@@ -67,7 +67,8 @@ def main():
             # each fwd+bwd compile at seq 32k is minutes over the
             # tunnel, and the per-task window budget is finite
             dict(name="longctx", b=1, h=8, t=32768, d=64, causal=True,
-                 combos=[(512, 512), (512, 1024), (1024, 1024)]),
+                 combos=[(512, 512), (512, 1024), (1024, 512),
+                         (1024, 1024)]),
         ]
         if only:
             shapes = [s for s in shapes if s["name"] == only]
