@@ -48,6 +48,7 @@ TASK_KEYS = {
     "tf_train_mb64": ("transformer_base_train_mb64", None),
     "tf_train_mb128": ("transformer_base_train_mb128", None),
     "bert_train_mb16": ("bert_base_train_seq512_mb16", None),
+    "bert_train_mb24": ("bert_base_train_seq512_mb24", None),
     "vgg16_infer": ("vgg16_infer_bf16_mb64",
                     bench.BASELINE_VGG16_MB64_MS),
     "vgg16_infer_mb1": ("vgg16_infer_bf16_mb1", 3.32),
@@ -76,7 +77,8 @@ PRIMARY = {
                                "transformer_base_train_mb64",
                                "transformer_base_train_mb128"],
     "bert_base_train_seq512": ["bert_base_train_seq512",
-                               "bert_base_train_seq512_mb16"],
+                               "bert_base_train_seq512_mb16",
+                               "bert_base_train_seq512_mb24"],
 }
 
 

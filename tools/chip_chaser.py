@@ -40,6 +40,7 @@ TASKS = [
     ("rn_train_mb512", "rn_train", {"batch": 512, "chain": 10}),
     ("tf_train_mb64", "tf_train", {"batch": 64, "chain": 20}),
     ("bert_train_mb16", "bert_train", {"batch": 16, "chain": 10}),
+    ("bert_train_mb24", "bert_train", {"batch": 24, "chain": 10}),
     ("vgg16_infer", "vgg_infer", {}),
     ("longctx_flash_seq32768", "longctx", {}),
     # LLM-style head_dim 128: doubles MXU work per softmax element, so
