@@ -153,8 +153,9 @@ def main(argv=None):
                 art["extras"][prim] = dict(art["extras"][best_key])
     rn = art["extras"].get("resnet50_train")
     if rn and "mfu_pct" in rn:
-        art["metric"] = ("resnet50_bf16_train_mfu_pct_mb%d"
-                         % rn.get("batch", 128))
+        art["metric"] = ("resnet50_bf16_train_mfu_pct_mb%d%s"
+                         % (rn.get("batch", 128),
+                            "_s2d" if rn.get("s2d_stem") else ""))
         art["value"] = rn["mfu_pct"]
         art["vs_baseline"] = round(
             rn["mfu_pct"] / (100 * bench.MFU_TARGET), 4)
