@@ -66,9 +66,12 @@ def main():
             # long-context leg shape (single chip); fewer combos —
             # each fwd+bwd compile at seq 32k is minutes over the
             # tunnel, and the per-task window budget is finite
+            # bigger block_q cuts K/V streaming passes linearly (the
+            # dominant HBM traffic at seq 32k: T/bq full K+V reads per
+            # head); VMEM stays comfortable through bq=2048 at d=64
             dict(name="longctx", b=1, h=8, t=32768, d=64, causal=True,
                  combos=[(512, 512), (512, 1024), (1024, 512),
-                         (1024, 1024)]),
+                         (1024, 1024), (2048, 512)]),
         ]
         if only:
             shapes = [s for s in shapes if s["name"] == only]
