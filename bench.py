@@ -598,7 +598,8 @@ def _probe_device(budget_s=900):
         time.sleep(min(backoff, remaining - 5))
 
 
-def _build_longctx_train(batch=1, heads=8, seq=32768, head_dim=64):
+def _build_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
+                         block_q=None, block_k=None):
     """Build the long-context attention step: flash fwd+bwd at 64x the
     reference's sequence ceiling (BERT seq-512, SURVEY §5 long-context
     row).  Unfused attention at seq 32k materializes an ~34 GB fp32
@@ -619,7 +620,8 @@ def _build_longctx_train(batch=1, heads=8, seq=32768, head_dim=64):
                         dtype="bfloat16")
         x.stop_gradient = False
         qkv.append(x)
-    out = layers.flash_attention(*qkv, causal=True)
+    out = layers.flash_attention(*qkv, causal=True, block_q=block_q,
+                                 block_k=block_k)
     loss = layers.reduce_sum(layers.cast(out, "float32"))
     backward.append_backward(loss)
     exe = fluid.Executor(fluid.TPUPlace())
@@ -645,11 +647,11 @@ def bench_longctx_train_d128(head_dim=128, **kw):
 
 
 def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
-                        chain=10):
+                        chain=10, block_q=None, block_k=None):
     """Long-context attention: tokens/sec + kernel MFU for causal
     flash attention fwd+bwd at seq 32k on one chip."""
-    fn, state, feed, fetches = _build_longctx_train(batch, heads, seq,
-                                                    head_dim)
+    fn, state, feed, fetches = _build_longctx_train(
+        batch, heads, seq, head_dim, block_q=block_q, block_k=block_k)
     sec_per_step, _ = _chain_timed(fn, state, feed, fetches[0], chain)
     toks_per_sec = batch * seq / sec_per_step
     peak, kind = _chip_peak_flops()
@@ -665,6 +667,8 @@ def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
         "mfu_pct": round(100 * mfu, 2),
         "batch": batch, "seq": seq, "heads": heads,
         "head_dim": head_dim,
+        **({"block_q": block_q or 512, "block_k": block_k or 512}
+           if block_q or block_k else {}),
         "device": kind,
     }
 

@@ -920,16 +920,21 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
                        out_dtype=dtype, out_slot="Y")
 
 
-def flash_attention(q, k, v, causal=False, scale=None, name=None):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, name=None):
     """Fused blockwise attention (Pallas TPU kernel; ops/pallas_kernels.py).
 
     q/k/v: [B, H, T, D] post-split-heads.  Replaces the reference's
     matmul+softmax+matmul composition (nets.py scaled_dot_product_attention)
     with a single kernel that never materializes the [Tq, Tk] score matrix.
+    block_q/block_k override the kernel tile sizes (default 512/512;
+    K/V streaming traffic scales as T/block_q, so long sequences may
+    prefer larger q blocks — see tools/flash_block_sweep.py).
     """
     return _single_out(
         "flash_attention", q,
-        {"causal": causal, "scale": float(scale or 0.0)},
+        {"causal": causal, "scale": float(scale or 0.0),
+         "block_q": int(block_q or 0), "block_k": int(block_k or 0)},
         ins_extra={"K": k, "V": v}, in_slot="Q")
 
 

@@ -556,9 +556,12 @@ from paddle_tpu.core.registry import register_op  # noqa: E402
 
 
 @register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out",),
-             attrs={"causal": False, "scale": 0.0})
+             attrs={"causal": False, "scale": 0.0, "block_q": 0,
+                    "block_k": 0})
 def _flash_attention_op(ins, attrs):
     scale = attrs.get("scale") or None
     return {"Out": flash_attention(ins["Q"], ins["K"], ins["V"],
                                    causal=bool(attrs.get("causal")),
-                                   scale=scale)}
+                                   scale=scale,
+                                   block_q=attrs.get("block_q") or 512,
+                                   block_k=attrs.get("block_k") or 512)}
