@@ -88,8 +88,10 @@ TASKS = [
     # 4x the 32k leg: causal flash fwd+bwd at seq 128k on ONE chip
     # (QKV ~400 MB; scores never materialize).  16x the FLOPs of the
     # 32k leg -> long compile + ~3 s steps: generous timeout, chain 5
+    # block_q=1024 up front: K/V streaming passes scale as T/block_q
+    # and dominate at 128k; the 32k sweep cross-checks the choice
     ("longctx_flash_seq131072", "longctx",
-     {"seq": 131072, "chain": 5}, 3000),
+     {"seq": 131072, "chain": 5, "block_q": 1024}, 3000),
     # "script:" tasks run a standalone tool instead of a bench leg;
     # the primitive probe separates "int8 lowering is broken" from
     # "the tunnel window closed" before the full leg re-runs
