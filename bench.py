@@ -115,7 +115,7 @@ def _build_compiled_fn(compiled, feed, fetch_names):
     return fn, state
 
 
-def _build_resnet50_train(batch=128, s2d=False):
+def _build_resnet50_train(batch=128, s2d=False, maxpool_grad=None):
     """Build + init the ResNet-50 bench train step; returns
     (fn, state, feed, loss_name).  Shared by the bench and
     tools/tpu_lowering_check.py so the lowering gate checks exactly
@@ -131,6 +131,13 @@ def _build_resnet50_train(batch=128, s2d=False):
     from paddle_tpu.contrib.mixed_precision import decorate
     from paddle_tpu.transpiler import nhwc_transpile
 
+    # A/B lever: 'compare' routes max-pool grads via k*k shifted
+    # compares instead of select_and_scatter (flags.py).  Always set
+    # explicitly: None means the sas default, not "inherit whatever a
+    # previous in-process build left behind"
+    from paddle_tpu.flags import set_flags
+
+    set_flags({"maxpool_grad_algo": maxpool_grad or "sas"})
     model = resnet50(is_test=False)
     # TPU fast path: rewrite the conv stack NHWC before autodiff so the
     # whole step (fwd+bwd) avoids MXU relayouts (see tests/test_layout.py),
@@ -164,10 +171,12 @@ def _build_resnet50_train(batch=128, s2d=False):
     return fn, state, feed, model["loss"].name
 
 
-def bench_resnet50_train(batch=128, chain=30, s2d=True):
+def bench_resnet50_train(batch=128, chain=30, s2d=True,
+                         maxpool_grad=None):
     # s2d default flipped after the 2026-08-01 on-chip A/B: mb128+s2d
     # 30.65% MFU vs 30.41% plain (docs/bench_onchip_20260801_0302.json)
-    fn, state, feed, loss_name = _build_resnet50_train(batch, s2d=s2d)
+    fn, state, feed, loss_name = _build_resnet50_train(
+        batch, s2d=s2d, maxpool_grad=maxpool_grad)
     sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
     sps = batch / sec_per_step
     peak, kind = _chip_peak_flops()
@@ -181,6 +190,8 @@ def bench_resnet50_train(batch=128, chain=30, s2d=True):
     }
     if s2d:
         res["s2d_stem"] = True
+    if maxpool_grad:
+        res["maxpool_grad"] = maxpool_grad
     return res
 
 

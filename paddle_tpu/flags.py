@@ -73,6 +73,13 @@ define_flag("profile_ops", False,
 define_flag("eager_delete_tensor_gb", 0.0,
             "GC threshold placeholder (XLA owns buffers; reference "
             "executor GC flag)")
+define_flag("maxpool_grad_algo", "sas",
+            "max-pool backward: 'sas' = XLA's select_and_scatter vjp "
+            "(routes dy to one maximum); 'compare' = k*k shifted "
+            "compare-and-route passes, routing dy to EVERY tied "
+            "maximum — a different, still-valid subgradient (ties are "
+            "common on post-ReLU inputs where the window max is 0); "
+            "candidate when select_and_scatter lowers slowly")
 define_flag("int8_conv_algo", "conv",
             "conv2d_int8 lowering: 'conv' = integer "
             "conv_general_dilated; 'im2col' = pad/slice/concat + one "

@@ -20,6 +20,8 @@ weight's layout.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -102,6 +104,55 @@ def conv2d_transpose(ins, attrs):
     return {"Output": out}
 
 
+def _maxpool_cmp_bwd_impl(window, strides, pads, x, out, dy):
+    """Compare-and-route max-pool backward: dx[i] = sum over window
+    offsets o of dy[w]*(x[i] == out[w]) with w the window whose offset-o
+    element is i.  Expressed as prod(window) shifted elementwise passes
+    over stride-dilated out/dy — all fusable by XLA into one loop over
+    dx, with no select_and_scatter (FLAGS maxpool_grad_algo=compare).
+    Ties route to every maximum (the sas path routes once); identical
+    on ties-free float data."""
+    import itertools
+
+    up_shape = tuple((o - 1) * s + 1
+                     for o, s in zip(out.shape, strides))
+    up_idx = tuple(slice(None, None, s) for s in strides)
+    neg = jnp.asarray(-jnp.inf, out.dtype)
+    out_up = jnp.full(up_shape, neg, out.dtype).at[up_idx].set(out)
+    dy_up = jnp.zeros(up_shape, dy.dtype).at[up_idx].set(dy)
+    base = tuple(k - 1 + s for k, s in zip(window, strides))
+    wpad = [(b, b) for b in base]
+    p_out = jnp.pad(out_up, wpad, constant_values=neg)
+    p_dy = jnp.pad(dy_up, wpad)
+    acc = jnp.zeros(x.shape, jnp.float32)
+    for off in itertools.product(*[range(k) for k in window]):
+        start = tuple(b + p[0] - o
+                      for b, p, o in zip(base, pads, off))
+        sl = tuple(slice(st, st + n) for st, n in zip(start, x.shape))
+        acc = acc + jnp.where(x == p_out[sl], p_dy[sl], 0).astype(
+            jnp.float32)
+    return acc.astype(dy.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _maxpool_cmp(x, window, strides, pads):
+    return lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                             pads)
+
+
+def _maxpool_cmp_fwd(x, window, strides, pads):
+    out = _maxpool_cmp(x, window, strides, pads)
+    return out, (x, out)
+
+
+def _maxpool_cmp_bwd(window, strides, pads, res, dy):
+    x, out = res
+    return (_maxpool_cmp_bwd_impl(window, strides, pads, x, out, dy),)
+
+
+_maxpool_cmp.defvjp(_maxpool_cmp_fwd, _maxpool_cmp_bwd)
+
+
 @register_op("pool2d", inputs=("X",), outputs=("Out",),
              attrs={"pooling_type": "max", "ksize": REQUIRED,
                     "global_pooling": False, "strides": [1, 1],
@@ -141,8 +192,12 @@ def pool2d(ins, attrs):
         strides = (1,) + s + (1,)
         pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
     if attrs["pooling_type"] == "max":
-        init = -jnp.inf
-        out = lax.reduce_window(x, init, lax.max, window, strides, pads)
+        from paddle_tpu.flags import get_flag
+
+        if get_flag("maxpool_grad_algo") == "compare":
+            return {"Out": _maxpool_cmp(x, window, strides, pads)}
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                pads)
         return {"Out": out}
     out = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
     if attrs["exclusive"] and (p[0] or p[1]):

@@ -1,0 +1,85 @@
+"""maxpool_grad_algo=compare must match the select_and_scatter vjp
+bit-for-bit on ties-free float data (flags.py; the compare path is the
+escape hatch if the rn50 ablate pins maxpool-bwd as a TPU time sink).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import paddle_tpu as fluid
+from paddle_tpu.ops.nn import _maxpool_cmp
+
+
+def _grads(fn, x, g):
+    return jax.value_and_grad(
+        lambda x: jnp.sum(fn(x) * g))(x)
+
+
+def _check(shape, window, strides, pads):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    out_shape = lax.reduce_window(
+        x, -jnp.inf, lax.max, window, strides, pads).shape
+    g = jnp.asarray(rng.randn(*out_shape), jnp.float32)
+    o_ref, d_ref = _grads(
+        lambda x: lax.reduce_window(x, -jnp.inf, lax.max, window,
+                                    strides, pads), x, g)
+    o_cmp, d_cmp = _grads(
+        lambda x: _maxpool_cmp(x, window, strides, pads), x, g)
+    np.testing.assert_allclose(o_ref, o_cmp, rtol=1e-6)
+    np.testing.assert_allclose(d_ref, d_cmp, rtol=1e-5, atol=1e-5)
+
+
+def test_compare_grad_matches_sas_rn50_stem_nhwc():
+    _check((2, 16, 16, 8), (1, 3, 3, 1), (1, 2, 2, 1),
+           ((0, 0), (1, 1), (1, 1), (0, 0)))
+
+
+def test_compare_grad_matches_sas_nchw():
+    _check((2, 8, 16, 16), (1, 1, 3, 3), (1, 1, 2, 2),
+           ((0, 0), (0, 0), (1, 1), (1, 1)))
+
+
+def test_compare_grad_matches_sas_vgg_and_odd_tail():
+    _check((1, 13, 13, 4), (1, 2, 2, 1), (1, 2, 2, 1),
+           ((0, 0),) * 4)
+
+
+def test_compare_grad_matches_sas_overlap_stride1():
+    _check((1, 10, 10, 2), (1, 3, 3, 1), (1, 1, 1, 1),
+           ((0, 0), (1, 1), (1, 1), (0, 0)))
+
+
+def test_flag_routes_pool2d_training(fresh_programs_factory):
+    """Through the framework surface: a conv+maxpool train step under
+    the compare flag matches the default path's loss trajectory."""
+    from paddle_tpu import framework, layers, optimizer
+
+    def build_and_step():
+        np.random.seed(0)
+        x = layers.data("x", shape=[4, 12, 12], dtype="float32")
+        y = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                          bias_attr=False)
+        p = layers.pool2d(y, pool_size=3, pool_stride=2,
+                          pool_padding=1, pool_type="max")
+        loss = layers.mean(p)
+        optimizer.SGD(0.5).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(framework.default_startup_program())
+        feed = {"x": np.random.RandomState(1).rand(
+            2, 4, 12, 12).astype(np.float32)}
+        return np.asarray(
+            [exe.run(framework.default_main_program(), feed=feed,
+                     fetch_list=[loss])[0] for _ in range(3)])
+
+    with fresh_programs_factory():
+        ref = build_and_step()
+    fluid.set_flags({"maxpool_grad_algo": "compare"})
+    try:
+        with fresh_programs_factory():
+            got = build_and_step()
+    finally:
+        fluid.set_flags({"maxpool_grad_algo": "sas"})
+    np.testing.assert_allclose(ref, got, rtol=1e-6, atol=1e-6)

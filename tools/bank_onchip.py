@@ -45,6 +45,7 @@ TASK_KEYS = {
     "rn_train_mb256": ("resnet50_train_mb256", None),
     "rn_train_mb512": ("resnet50_train_mb512", None),
     "rn_train_mb128_s2d": ("resnet50_train_mb128_s2d", None),
+    "rn_train_mb128_cmp_pool": ("resnet50_train_mb128_cmp_pool", None),
     "tf_train_mb64": ("transformer_base_train_mb64", None),
     "tf_train_mb128": ("transformer_base_train_mb128", None),
     "bert_train_mb16": ("bert_base_train_seq512_mb16", None),
@@ -72,7 +73,8 @@ TASK_KEYS = {
 PRIMARY = {
     "resnet50_train": ["resnet50_train", "resnet50_train_mb256",
                        "resnet50_train_mb512",
-                       "resnet50_train_mb128_s2d"],
+                       "resnet50_train_mb128_s2d",
+                       "resnet50_train_mb128_cmp_pool"],
     "transformer_base_train": ["transformer_base_train",
                                "transformer_base_train_mb64",
                                "transformer_base_train_mb128"],
@@ -153,9 +155,12 @@ def main(argv=None):
                 art["extras"][prim] = dict(art["extras"][best_key])
     rn = art["extras"].get("resnet50_train")
     if rn and "mfu_pct" in rn:
-        art["metric"] = ("resnet50_bf16_train_mfu_pct_mb%d%s"
+        art["metric"] = ("resnet50_bf16_train_mfu_pct_mb%d%s%s"
                          % (rn.get("batch", 128),
-                            "_s2d" if rn.get("s2d_stem") else ""))
+                            "_s2d" if rn.get("s2d_stem") else "",
+                            "_cmp_pool"
+                            if rn.get("maxpool_grad") == "compare"
+                            else ""))
         art["value"] = rn["mfu_pct"]
         art["vs_baseline"] = round(
             rn["mfu_pct"] / (100 * bench.MFU_TARGET), 4)

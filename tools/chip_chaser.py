@@ -72,6 +72,14 @@ TASKS = [
     # 5 one-change-each variants decompose the 52 ms step (stats
     # passes / maxpool-bwd select_and_scatter / layout / fwd floor)
     ("rn50_ablate", "script:tools/rn50_ablate.py", {}, 1800),
+    # the pre-built fix for the select_and_scatter suspect (flags.py
+    # maxpool_grad_algo=compare) — compare step_ms against mb128+s2d.
+    # NOT gradient-identical: post-ReLU bf16 windows tie at 0.0
+    # routinely, and the compare path routes dy to every tied maximum
+    # where sas routes once (a different, still-valid subgradient; the
+    # banked row and metric carry a cmp_pool marker)
+    ("rn_train_mb128_cmp_pool", "rn_train",
+     {"batch": 128, "chain": 20, "maxpool_grad": "compare"}),
     ("profile_transformer_onchip",
      "script:tools/profile_transformer.py --time", {}, 1500),
     ("op_bench_tpu_snapshot",
