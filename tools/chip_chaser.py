@@ -28,42 +28,19 @@ BENCH = os.path.join(REPO, "bench.py")
 
 # (name, leg, kwargs) — kwargs {} means the leg's full default shape.
 # ROUND-5 ORDER (VERDICT r4 next-round #1): the unmet north star is
-# ResNet-50 >=50% MFU, so the batch-knee sweep and the space-to-depth
-# A/B bank FIRST in any window; anchors/profiles/sweeps follow; int8
+# ResNet-50 >=50% MFU — its open diagnostics (hlo_traffic, ablate,
+# cmp_pool A/B) bank FIRST in any window; the already-banked batch-knee
+# sweep and anchors sit at the tail for fresh-results-file runs; int8
 # (the known 2026-07-31 tunnel-wedger) stays last.
 TASKS = [
-    ("rn_train_mb256", "rn_train", {"batch": 256, "chain": 20}),
-    # A/B: space-to-depth stem (exact-equivalence rewrite) — compare
-    # step_ms against the plain mb128/mb256 rows
-    ("rn_train_mb128_s2d", "rn_train",
-     {"batch": 128, "chain": 20, "s2d": True}),
-    ("rn_train_mb512", "rn_train", {"batch": 512, "chain": 10}),
-    ("tf_train_mb64", "tf_train", {"batch": 64, "chain": 20}),
-    ("bert_train_mb16", "bert_train", {"batch": 16, "chain": 10}),
-    ("bert_train_mb24", "bert_train", {"batch": 24, "chain": 10}),
-    ("vgg16_infer", "vgg_infer", {}),
-    ("longctx_flash_seq32768", "longctx", {}),
-    # LLM-style head_dim 128: doubles MXU work per softmax element, so
-    # the kernel's MFU ceiling is ~2x the d=64 leg's; also the first
-    # row benched with the interior-block fast path (7ef0952)
-    ("longctx_flash_seq32768_d128", "longctx",
-     {"head_dim": 128, "chain": 10}),
-    # re-bench of the banked seq-32k row under the interior-block
-    # fast path (same artifact key: latest banked run wins)
-    ("longctx_flash_seq32768_fastpath", "longctx", {}),
-    # mb=1 latency anchors — the reference's float16_benchmark.md
-    # headline table is mb=1/mb=64/mb=128; BASELINE.md carries the
-    # mb=1 rows (rn50 fp16 6.13 ms, vgg16 fp16 3.32 ms on V100)
-    ("rn50_infer_mb1", "infer", {"batch": 1, "chain": 200}),
-    ("vgg16_infer_mb1", "vgg_infer", {"batch": 1, "chain": 200}),
-    # split per shape with generous timeouts: each seq-32k fwd+bwd
-    # compile is minutes over the tunnel
-    # CHEAP DIAGNOSTICS BEFORE LONG SWEEPS: mb256 banked flat vs mb128
-    # (29.71 vs 30.41% MFU), so the rn50 copy/transpose histogram is
-    # the live lever for the unmet north star — run it before the
-    # 25-50-min flash sweeps so a short window still yields it
-    ("profile_resnet_onchip",
-     "script:tools/profile_resnet.py --nhwc --bf16 --time", {}),
+    # ---- 2026-08-01 afternoon reorder: the morning window banked the
+    # rn50 batch sweep (mb256/mb512/s2d), the tf/bert/vgg anchors, and
+    # profile_resnet; those tasks are pre-seeded done in the results
+    # file.  What remains, most-valuable-first: (1) name the rn50 HBM
+    # traffic (hlo_traffic + ablate + the cmp_pool A/B) — the unmet
+    # north star; (2) longctx under the interior-block fast path +
+    # block sweep — the 10%->20% MFU item; (3) the TPU per-op baseline
+    # snapshot (ci gate deliverable); then profiles/sweeps; int8 last.
     # 2026-08-01 window verdict: rn50 train is HBM-bound (62 ms memory
     # roofline vs 15.6 ms compute) — name the layout traffic before
     # spending more chip time on sweeps
@@ -80,10 +57,20 @@ TASKS = [
     # banked row and metric carry a cmp_pool marker)
     ("rn_train_mb128_cmp_pool", "rn_train",
      {"batch": 128, "chain": 20, "maxpool_grad": "compare"}),
-    ("profile_transformer_onchip",
-     "script:tools/profile_transformer.py --time", {}, 1500),
+    # re-bench of the banked seq-32k row under the interior-block
+    # fast path (same artifact key: latest banked run wins)
+    ("longctx_flash_seq32768_fastpath", "longctx", {}),
+    ("flash_block_sweep_longctx",
+     "script:tools/flash_block_sweep.py --shape longctx", {}, 1800),
+    # LLM-style head_dim 128: doubles MXU work per softmax element, so
+    # the kernel's MFU ceiling is ~2x the d=64 leg's
+    ("longctx_flash_seq32768_d128", "longctx",
+     {"head_dim": 128, "chain": 10}),
     ("op_bench_tpu_snapshot",
      "script:tools/op_bench_tpu_snapshot.py", {}),
+    ("profile_transformer_onchip",
+     "script:tools/profile_transformer.py --time", {}, 1500),
+    ("bert_train_mb24", "bert_train", {"batch": 24, "chain": 10}),
     ("tf_train_mb128", "tf_train", {"batch": 128, "chain": 10}),
     # the reference's cifar10 fp16 table rows (float16_benchmark.md
     # :56-74) — cheap bf16 legs
@@ -91,8 +78,24 @@ TASKS = [
     ("resnet32_cifar_infer_mb512", "rn32_cifar", {}),
     ("flash_block_sweep_tf",
      "script:tools/flash_block_sweep.py --shape tf_base", {}, 1500),
-    ("flash_block_sweep_longctx",
-     "script:tools/flash_block_sweep.py --shape longctx", {}, 1800),
+    # ---- banked 2026-08-01 morning (kept for fresh-results-file runs)
+    ("rn_train_mb256", "rn_train", {"batch": 256, "chain": 20}),
+    # A/B: space-to-depth stem (exact-equivalence rewrite) — compare
+    # step_ms against the plain mb128/mb256 rows
+    ("rn_train_mb128_s2d", "rn_train",
+     {"batch": 128, "chain": 20, "s2d": True}),
+    ("rn_train_mb512", "rn_train", {"batch": 512, "chain": 10}),
+    ("tf_train_mb64", "tf_train", {"batch": 64, "chain": 20}),
+    ("bert_train_mb16", "bert_train", {"batch": 16, "chain": 10}),
+    ("vgg16_infer", "vgg_infer", {}),
+    ("longctx_flash_seq32768", "longctx", {}),
+    # mb=1 latency anchors — the reference's float16_benchmark.md
+    # headline table is mb=1/mb=64/mb=128; BASELINE.md carries the
+    # mb=1 rows (rn50 fp16 6.13 ms, vgg16 fp16 3.32 ms on V100)
+    ("rn50_infer_mb1", "infer", {"batch": 1, "chain": 200}),
+    ("vgg16_infer_mb1", "vgg_infer", {"batch": 1, "chain": 200}),
+    ("profile_resnet_onchip",
+     "script:tools/profile_resnet.py --nhwc --bf16 --time", {}),
     # 4x the 32k leg: causal flash fwd+bwd at seq 128k on ONE chip
     # (QKV ~400 MB; scores never materialize).  16x the FLOPs of the
     # 32k leg -> long compile + ~3 s steps: generous timeout, chain 5
