@@ -62,7 +62,14 @@ def scan_hlo(hlo_text, kinds=("transpose", "copy", "bitcast-convert")):
             in_fusion = False
             continue
         # result lines look like:  %name = bf16[...]{...} transpose(...)
-        m = re.match(r"%?[\w.\-]+ = ([\w\[\],]+)\{[\d,]*\} (\w[\w\-]*)\(", s)
+        # TPU layouts carry tile/memory-space annotations inside the
+        # braces — "{3,2,1,0:T(8,128)(2,1)S(3)}" — so the layout part
+        # must match any non-brace run, not just digits and commas
+        # (the digits-only pattern matched ZERO ops on the first
+        # on-chip run, 2026-08-01)
+        m = re.match(
+            r"(?:ROOT )?%?[\w.\-]+ = ([\w\[\],]+)(?:\{[^}]*\})? "
+            r"(\w[\w\-]*)\(", s)
         if not m:
             continue
         shape_str, op = m.groups()
@@ -127,6 +134,17 @@ def main():
     hlo = comp.as_text()
 
     rows = list(scan_hlo(hlo))
+    if not rows:
+        # never return blind again: if the line format drifted, show
+        # raw samples of the ops we failed to parse
+        print("!! scan matched ZERO ops — raw transpose/copy samples:")
+        shown = 0
+        for line in hlo.splitlines():
+            if " transpose(" in line or " copy(" in line:
+                print("   ", line.strip()[:200])
+                shown += 1
+                if shown >= 5:
+                    break
     total = collections.Counter()
     by_name = collections.Counter()
     for op, nbytes, name, fused, _ in rows:
