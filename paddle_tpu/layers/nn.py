@@ -927,9 +927,9 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     q/k/v: [B, H, T, D] post-split-heads.  Replaces the reference's
     matmul+softmax+matmul composition (nets.py scaled_dot_product_attention)
     with a single kernel that never materializes the [Tq, Tk] score matrix.
-    block_q/block_k override the kernel tile sizes (default 512/512;
-    K/V streaming traffic scales as T/block_q, so long sequences may
-    prefer larger q blocks — see tools/flash_block_sweep.py).
+    block_q/block_k override the kernel tile sizes (default picked by
+    sequence length: 1024 for T >= 1024, else 512 — pinned by the
+    2026-08-01 v5e sweep, tools/flash_block_sweep.py).
     """
     return _single_out(
         "flash_attention", q,

@@ -282,13 +282,17 @@ def test_flash_attention_ir_op_block_override(monkeypatch):
     ref = _plain_attention(jnp.asarray(qkv[0]), jnp.asarray(qkv[1]),
                            jnp.asarray(qkv[2]), True, 8 ** -0.5)
     np.testing.assert_allclose(o1, np.asarray(ref), atol=1e-3)
-    # unset blocks reach the kernel as its documented 512 defaults
+    # unset blocks reach the kernel entry unset (None/0) so the
+    # kernel's size-aware default (_default_block) decides
     seen.clear()
     q2 = layers.data("q2", shape=[2, 40, 8], dtype="float32")
     out2 = layers.flash_attention(q2, k, v, causal=True)
     exe.run(framework.default_main_program(),
             feed={**feed, "q2": qkv[0]}, fetch_list=[out2])
-    assert seen.get("block_q") == 512 and seen.get("block_k") == 512
+    assert not seen.get("block_q") and not seen.get("block_k")
+    from paddle_tpu.ops.pallas_kernels import _default_block
+    assert _default_block(40) == 512      # short seq keeps 512
+    assert _default_block(32768) == 1024  # long seq gets the sweep pick
 
 
 def test_impl_autodetect_keys_on_device_not_backend(monkeypatch):

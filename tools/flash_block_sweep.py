@@ -15,18 +15,34 @@ import sys
 import time
 
 
-def time_fn(fn, *args, repeat=20, warmup=3):
-    import jax
+def time_fn(fn, q, k, v, repeat=20, warmup=3, pick=None):
+    """Chained timing: feed each call's output back as the next q and
+    sync by fetching a scalar reduction to host.
 
-    out = fn(*args)
-    jax.block_until_ready(out)
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
+    block_until_ready is NOT a reliable fence over the axon tunnel —
+    the first on-chip sweep (2026-08-01) "measured" 0.02 ms for a
+    seq-32k flash forward whose compute ideal is ~5.6 ms.  The data
+    dependency chain plus a host transfer (the same pattern as
+    bench._chain_timed) forces real execution into the timed window.
+    `pick` maps fn's output to a q-shaped array (identity by default;
+    grad callers pick dq)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    pick = pick or (lambda o: o)
+
+    def sync(x):
+        return float(np.asarray(jnp.sum(x.astype(jnp.float32))))
+
+    x = q
+    for _ in range(warmup + 1):  # +1 covers compile
+        x = pick(fn(x, k, v))
+    sync(x)
     t0 = time.perf_counter()
+    x = q
     for _ in range(repeat):
-        out = fn(*args)
-    jax.block_until_ready(out)
+        x = pick(fn(x, k, v))
+    sync(x)
     return (time.perf_counter() - t0) / repeat * 1e3
 
 
@@ -103,7 +119,8 @@ def main():
                         jnp.float32).sum()
 
                 gfn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-                ms_fb = time_fn(gfn, q, q, q)
+                # chain dq (q-shaped) into the next call's q
+                ms_fb = time_fn(gfn, q, q, q, pick=lambda o: o[0])
                 print(json.dumps({
                     "shape": s["name"], "block_q": bq, "block_k": bk,
                     "fwd_ms": round(ms_f, 3),
