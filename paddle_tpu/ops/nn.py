@@ -211,6 +211,41 @@ def pool2d(ins, attrs):
     return {"Out": out}
 
 
+def _moments_1pass(xf, axes):
+    """Batch mean/variance as SIBLING reductions over one input.
+
+    jnp.var's two-pass form (mean, then mean((x-mean)^2)) chains the
+    second reduction on the first, forcing two HBM passes over x.
+    Shifted one-pass moments — subtract a per-channel probe value
+    (one sampled element, so the shift is near the data's scale),
+    then sum(y) and sum(y*y) as independent siblings — let XLA
+    multi-output-fuse both reductions into ONE read pass; the
+    2026-08-01 rn50 on-chip ablation priced BN batch-stats traffic at
+    9.3 ms of a 53.6 ms step.  The shift kills the E[x^2]-E[x]^2
+    cancellation blow-up for channels with |mean| >> std (the raw
+    form loses all precision once mean^2 dominates var in fp32).
+    Mean/var are shift-invariant, including their gradients, so
+    exactness is preserved.  Both batch_norm and batch_norm_grad MUST
+    build stats through this one helper so the backward's recompute
+    CSEs with the forward under the one-module executor.
+    """
+    m = float(np.prod([xf.shape[a] for a in axes]))
+    probe_idx = tuple(0 if a in axes else slice(None)
+                      for a in range(xf.ndim))
+    shift = xf[probe_idx]  # per-channel, broadcasts against xf
+    shape = [1] * xf.ndim
+    for a in range(xf.ndim):
+        if a not in axes:
+            shape[a] = xf.shape[a]
+    y = xf - shift.reshape(shape)
+    s1 = jnp.sum(y, axis=axes)
+    s2 = jnp.sum(y * y, axis=axes)
+    mean_y = s1 / m
+    mean = shift + mean_y
+    var = jnp.maximum(s2 / m - mean_y * mean_y, 0.0)
+    return mean, var
+
+
 @register_op("batch_norm",
              inputs=("X", "Scale", "Bias", "Mean", "Variance"),
              outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
@@ -237,8 +272,7 @@ def batch_norm(ins, attrs):
         saved_mean = jnp.zeros_like(mean)
         saved_var = jnp.zeros_like(var)
     else:
-        use_mean = jnp.mean(xf, axis=axes)
-        use_var = jnp.var(xf, axis=axes)
+        use_mean, use_var = _moments_1pass(xf, axes)
         mean_out = mean * mom + lax.stop_gradient(use_mean) * (1 - mom)
         var_out = var * mom + lax.stop_gradient(use_var) * (1 - mom)
         saved_mean = use_mean
@@ -299,8 +333,7 @@ def batch_norm_grad(ins, attrs):
         return {"X@GRAD": dx.astype(x.dtype), "Scale@GRAD": dscale,
                 "Bias@GRAD": dbias}
     m = float(np.prod([x.shape[a] for a in axes]))
-    mean = jnp.mean(xf, axis=axes)
-    var = jnp.var(xf, axis=axes)
+    mean, var = _moments_1pass(xf, axes)
     rstd = lax.rsqrt(var + eps)
     x_hat = (xf - mean.reshape(shape)) * rstd.reshape(shape)
     dbias = jnp.sum(dyf, axis=axes)
