@@ -193,29 +193,46 @@ def _int8_conv_im2col(x8, q, strides, pads, dils, groups, fmt):
     return y32
 
 
-@register_op("conv2d_int8", inputs=("Input", "Filter", "FilterScale"),
-             outputs=("Output",),
+@register_op("conv2d_int8", inputs=("Input", "Filter", "FilterScale",
+                                    "InScale"),
+             outputs=("Output",), optional=("InScale",),
              attrs={"strides": [1, 1], "paddings": [0, 0],
                     "dilations": [1, 1], "groups": 1,
-                    "data_format": "NCHW", "max_range": 127.0},
+                    "data_format": "NCHW", "max_range": 127.0,
+                    "out_dtype": "float32"},
              differentiable=False)
 def conv2d_int8(ins, attrs):
     """True-int8 convolution (reference int8 execution path,
     inference/tests/api/int8_mkldnn_quantization.md — there via mkldnn
-    u8s8 kernels; here the MXU): dynamically quantize the activation
-    per-tensor to int8, convolve int8 x int8 with int32 accumulation
+    u8s8 kernels; here the MXU): quantize the activation per-tensor to
+    int8, convolve int8 x int8 with int32 accumulation
     (lax.conv_general_dilated preferred_element_type=int32), then apply
     the combined activation x per-out-channel filter scale.  Unlike
     dequantize_weight (which saves bytes but computes in fp32/bf16),
-    the MACs themselves run on 1-byte operands."""
+    the MACs themselves run on 1-byte operands.
+
+    The activation scale comes from the optional InScale input (a
+    calibrated per-tensor abs-max, post_training_quantize) when wired;
+    otherwise it is derived dynamically with a max-reduction.  On an
+    HBM-bound chip the dynamic path costs an extra full read of the
+    activation per conv (the 2026-08-01 on-chip int8 row ran 2x SLOWER
+    than bf16 because of it), so the calibrated path is what the bench
+    and any serious deployment should use.  out_dtype="bfloat16" halves
+    inter-layer activation traffic; quantization noise (7-bit mantissa
+    vs the int8 lattice) dwarfs the bf16 rounding."""
     from paddle_tpu.ops.nn import _pair
 
     from paddle_tpu.flags import get_flag
 
     x, q, ws = ins["Input"], ins["Filter"], ins["FilterScale"]
     bnd = attrs["max_range"]
-    sx = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
-    x8 = jnp.clip(jnp.round(x / sx * bnd), -bnd, bnd).astype(jnp.int8)
+    if "InScale" in ins:
+        sx = jnp.maximum(ins["InScale"].reshape(()).astype(jnp.float32),
+                         1e-8)
+    else:
+        sx = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    xf = x.astype(jnp.float32)
+    x8 = jnp.clip(jnp.round(xf / sx * bnd), -bnd, bnd).astype(jnp.int8)
     s, p, d = (_pair(attrs["strides"]), _pair(attrs["paddings"]),
                _pair(attrs["dilations"]))
     fmt = attrs.get("data_format", "NCHW")
@@ -234,12 +251,13 @@ def conv2d_int8(ins, attrs):
     sc = (oscale.reshape(1, -1, 1, 1) if fmt == "NCHW"
           else oscale.reshape(1, 1, 1, -1))
     y = y32.astype(jnp.float32) * (sx / (bnd * bnd)) * sc
-    return {"Output": y}
+    return {"Output": y.astype(jnp.dtype(attrs["out_dtype"]))}
 
 
-@register_op("mul_int8", inputs=("X", "Y", "Scale"), outputs=("Out",),
+@register_op("mul_int8", inputs=("X", "Y", "Scale", "InScale"),
+             outputs=("Out",), optional=("InScale",),
              attrs={"x_num_col_dims": 1, "y_num_col_dims": 1,
-                    "max_range": 127.0},
+                    "max_range": 127.0, "out_dtype": "float32"},
              differentiable=False)
 def mul_int8(ins, attrs):
     """True-int8 mul: int8 x int8 matmul with int32 accumulation.
@@ -279,13 +297,29 @@ def mul_int8(ins, attrs):
         post = (ws2 / bnd).reshape(1, n)
     else:                   # per-tensor
         post = ws2.reshape(()) / bnd
-    sx = jnp.maximum(jnp.max(jnp.abs(x2)), 1e-8)
-    x8 = jnp.clip(jnp.round(x2 / sx * bnd), -bnd, bnd).astype(jnp.int8)
+    if "InScale" in ins:
+        cal = jnp.maximum(ins["InScale"].reshape(()).astype(jnp.float32),
+                          1e-8)
+        if per_row:
+            # the per-row weight scale folds into the activation BEFORE
+            # quantization, so the calibrated raw-activation scale must
+            # be widened by the largest row factor: |x_k*s_k/bnd| <=
+            # cal*max(s)/bnd.  max over the K-vector of weight scales
+            # is a trace-time-tiny reduction, not an activation read —
+            # the whole point of InScale is avoiding the latter.
+            sx = cal * jnp.max(ws2) / bnd
+        else:
+            sx = cal
+    else:
+        sx = jnp.maximum(jnp.max(jnp.abs(x2)), 1e-8)
+    x8 = jnp.clip(jnp.round(x2.astype(jnp.float32) / sx * bnd),
+                  -bnd, bnd).astype(jnp.int8)
     y32 = lax.dot_general(x8, q2, (((1,), (0,)), ((), ())),
                           preferred_element_type=jnp.int32)
     y = y32.astype(jnp.float32) * (sx / bnd)
     if post is not None:
         y = y * post
+    y = y.astype(jnp.dtype(attrs["out_dtype"]))
     return {"Out": y.reshape(x.shape[:xnc] + q.shape[ync:])}
 
 

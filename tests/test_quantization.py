@@ -337,3 +337,52 @@ def test_int8_conv_im2col_bit_identical_to_conv():
                 set_flags({"int8_conv_algo": "conv"})
             np.testing.assert_array_equal(
                 got, ref, err_msg="%s %s %s %s" % (xs, fs, at, fmt))
+
+
+def test_int8_execution_calibrated_scales_and_bf16_out():
+    """act_scales wires a static InScale into every converted op (the
+    dynamic max-reduction re-reads each activation — it made the first
+    on-chip int8 row 2x slower than bf16, 2026-08-01) and
+    out_dtype="bfloat16" flows between layers; numerics stay within
+    quantization error of the dynamic-scale fp32 path."""
+    from paddle_tpu.contrib.slim.quantization import (
+        convert_to_int8_execution, quantize_weights_abs_max)
+    from paddle_tpu.core.scope import global_scope
+
+    np.random.seed(3)
+    xin = layers.data("x", shape=[2, 8, 8], dtype="float32")
+    c = layers.conv2d(xin, num_filters=4, filter_size=3, padding=1,
+                      act="relu", bias_attr=False)
+    h = layers.fc(c, size=16, act="relu", bias_attr=False)
+    pred = layers.fc(h, size=4, bias_attr=False)
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    infer = prog.clone(for_test=True)
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.rand(8, 2, 8, 8).astype(np.float32)}
+    (ref,) = exe.run(fluid.CompiledProgram(infer), feed=feed,
+                     fetch_list=[pred])
+
+    # calibrate from the executor-run intermediates of a batch
+    calib_scales, _ = post_training_quantize(
+        infer, global_scope(), exe, [dict(feed)], fetch_list=[pred])
+    assert any(s > 0 for s in calib_scales.values())
+    qw = quantize_weights_abs_max(infer, global_scope())
+    convert_to_int8_execution(infer, global_scope(), qw,
+                              act_scales=calib_scales,
+                              out_dtype="bfloat16")
+    ops = {op.type: op for op in infer.global_block().ops}
+    assert "mul_int8" in ops and "mul" not in ops
+    assert "conv2d_int8" in ops and "conv2d" not in ops
+    converted = [op for op in infer.global_block().ops
+                 if op.type in ("mul_int8", "conv2d_int8")]
+    # every converted op got a calibrated InScale and the bf16 tag
+    for op in converted:
+        assert op.inputs.get("InScale"), op.inputs
+        assert op.attrs["out_dtype"] == "bfloat16"
+    (got,) = exe.run(fluid.CompiledProgram(infer), feed=feed,
+                     fetch_list=[pred])
+    rel = np.abs(got.astype(np.float32) - ref).max() / \
+        (np.abs(ref).max() + 1e-9)
+    assert rel < 0.08, rel
