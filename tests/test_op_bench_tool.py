@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import numpy as np
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -17,7 +19,9 @@ def test_bench_op_api():
     ms = op_bench.bench_op(
         "scale", {"X": ("float32", (64, 64))}, {"scale": 2.0},
         repeat=3, warmup=1)
-    assert ms > 0
+    # difference timing (2n vs n on-device iterations) falls back to
+    # the 2n upper bound when below resolution, so ms stays positive
+    assert ms > 0 and np.isfinite(ms)
 
 
 def test_cli_single_op_and_gate(tmp_path):

@@ -46,6 +46,9 @@ TASK_KEYS = {
     "rn_train_mb512": ("resnet50_train_mb512", None),
     "rn_train_mb128_s2d": ("resnet50_train_mb128_s2d", None),
     "rn_train_mb128_cmp_pool": ("resnet50_train_mb128_cmp_pool", None),
+    # one-pass BN batch stats (ops/nn.py _moments_1pass) — the leg is
+    # the plain default build, so this IS the new default graph
+    "rn_train_mb128_bn1p": ("resnet50_train_mb128_bn1p", None),
     "tf_train_mb64": ("transformer_base_train_mb64", None),
     "tf_train_mb128": ("transformer_base_train_mb128", None),
     "bert_train_mb16": ("bert_base_train_seq512_mb16", None),
@@ -62,11 +65,22 @@ TASK_KEYS = {
         "longctx_flash_train_mb1_seq32768", None),
     "longctx_flash_seq131072": ("longctx_flash_train_mb1_seq131072",
                                 None),
+    # re-benches under the 1024x1024 _default_block defaults — same
+    # artifact keys, so the newest (faster) run replaces the old row
+    "longctx_seq32768_blk1024": (
+        "longctx_flash_train_mb1_seq32768", None),
+    "longctx_seq32768_d128_blk1024": (
+        "longctx_flash_train_mb1_seq32768_d128", None),
+    "longctx_seq131072_blk1024": (
+        "longctx_flash_train_mb1_seq131072", None),
     "vgg16_cifar_infer_mb512": ("vgg16_cifar10_infer_bf16_mb512",
                                 bench.BASELINE_VGG16_CIFAR_MS),
     "resnet32_cifar_infer_mb512": ("resnet32_cifar10_infer_bf16_mb512",
                                    bench.BASELINE_RN32_CIFAR_MS),
     "int8_diagnosis": ("resnet50_infer_int8_mb128", None),
+    # calibrated static-scale + bf16-activation rebuild of the same
+    # leg — replaces the dynamic-scale row (22.2 ms) on re-bank
+    "int8_infer_calibrated": ("resnet50_infer_int8_mb128", None),
 }
 
 # primary key <- best (by mfu_pct) among these variant keys
@@ -74,7 +88,8 @@ PRIMARY = {
     "resnet50_train": ["resnet50_train", "resnet50_train_mb256",
                        "resnet50_train_mb512",
                        "resnet50_train_mb128_s2d",
-                       "resnet50_train_mb128_cmp_pool"],
+                       "resnet50_train_mb128_cmp_pool",
+                       "resnet50_train_mb128_bn1p"],
     "transformer_base_train": ["transformer_base_train",
                                "transformer_base_train_mb64",
                                "transformer_base_train_mb128"],

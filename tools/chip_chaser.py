@@ -44,6 +44,33 @@ TASKS = [
     # 2026-08-01 window verdict: rn50 train is HBM-bound (62 ms memory
     # roofline vs 15.6 ms compute) — name the layout traffic before
     # spending more chip time on sweeps
+    # re-bench the longctx legs under the swept 1024x1024 block
+    # defaults (_default_block; the 2026-08-01 sweep showed fwd+bwd
+    # 76.9 ms vs 116.8 at seq 32k) — no explicit blocks, so these rows
+    # measure what a user gets out of the box
+    ("longctx_seq32768_blk1024", "longctx", {}),
+    ("longctx_seq32768_d128_blk1024", "longctx",
+     {"head_dim": 128, "chain": 10}),
+    ("longctx_seq131072_blk1024", "longctx",
+     {"seq": 131072, "chain": 5}, 3000),
+    # A/B the one-pass BN batch-stats rewrite (ops/nn.py
+    # _moments_1pass; the ablation priced two-pass stats at 9.3 ms of
+    # the 53.6 ms step) — default leg, compare against the banked
+    # mb128+s2d 52.155 ms row
+    ("rn_train_mb128_bn1p", "rn_train", {"batch": 128, "chain": 20}),
+    # v2: full roofline attribution (result+operand bytes per
+    # top-level op) — the first run showed transpose/copy are NOT the
+    # traffic (0.5 of 46.5 GB); this names the real consumers
+    ("hlo_traffic_rn50_v2",
+     "script:tools/hlo_traffic.py --batch 128 --top 30", {}, 1200),
+    # calibrated int8: static InScale kills the per-conv max-reduction
+    # and bf16 inter-layer activations halve the traffic that made the
+    # dynamic int8 row 2x slower than bf16 (22.2 vs 11.35 ms)
+    ("int8_infer_calibrated", "infer_i8", {"batch": 128, "chain": 20}),
+    # v2: on-device fori_loop timing (the host-loop snapshot timed the
+    # ~3.5 ms tunnel dispatch, not the ops)
+    ("op_bench_tpu_snapshot_v2",
+     "script:tools/op_bench_tpu_snapshot.py", {}),
     ("hlo_traffic_rn50",
      "script:tools/hlo_traffic.py --batch 128 --top 30", {}, 1200),
     # 5 one-change-each variants decompose the 52 ms step (stats

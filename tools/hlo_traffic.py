@@ -35,15 +35,9 @@ _OPNAME_RE = re.compile(r'op_name="([^"]+)"')
 
 
 def shape_bytes(shape_str):
-    m = _SHAPE_RE.match(shape_str)
-    if not m:
-        return 0
-    dt, dims = m.groups()
-    n = 1
-    for d in dims.split(","):
-        if d:
-            n *= int(d)
-    return n * _DTYPE_BYTES.get(dt, 4)
+    # delegates to the tuple-capable parser so the two reports can
+    # never disagree on how a shape is sized
+    return _shape_part_bytes(shape_str)
 
 
 def scan_hlo(hlo_text, kinds=("transpose", "copy", "bitcast-convert")):
@@ -80,6 +74,81 @@ def scan_hlo(hlo_text, kinds=("transpose", "copy", "bitcast-convert")):
         shape = (f"{sm.group(1)}[{sm.group(2)}]" if sm else shape_str)
         name = nm.group(1) if nm else shape
         yield op, shape_bytes(shape_str), name, in_fusion, s
+
+
+_ENTRY_LINE_RE = re.compile(
+    r"(?:ROOT )?%?([\w.\-]+) = (\([^)]*\)|[\w\[\],]+) "
+    r"(\w[\w\-]*)\((.*)$")
+
+
+def _shape_part_bytes(shape_part):
+    """Total bytes of a result shape string — handles tuple shapes
+    "(bf16[...], f32[...])" by summing every array in it."""
+    total = 0
+    for m in re.finditer(r"(\w+)\[([\d,]*)\]", shape_part):
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(m.group(1), 4)
+    return total
+
+
+def _strip_braces(s):
+    """Remove every {...} group (layout/tile annotations, metadata,
+    window configs).  Tile annotations contain parens —
+    "{0:T(256)}" — which would otherwise break tuple-shape parsing
+    (a ')' inside the layout terminates a naive "\\([^)]*\\)").
+    op_name must be extracted BEFORE stripping."""
+    prev = None
+    while prev != s:
+        prev = s
+        s = re.sub(r"\{[^{}]*\}", "", s)
+    return s
+
+
+def roofline_rows(hlo_text):
+    """Attribute HBM traffic to every TOP-LEVEL op of the entry
+    computation: bytes = result bytes + sum of operand result bytes
+    (operand names resolved against earlier result lines).  Fusion
+    interiors are skipped — a fusion's traffic is its boundary.
+    Yields (opcode, bytes, op_name)."""
+    depth_skip = False
+    sizes = {}
+    rows = []
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        if re.match(r"%?[\w.\-]+ ", s) and s.endswith("{") \
+                and " = " not in s:
+            # a computation definition header (fusion body, reduce
+            # body, ENTRY, ...) — entry is handled like the rest:
+            # every computation's results land in `sizes`, but only
+            # rows whose line carries op_name metadata AND whose
+            # opcode isn't parameter/constant matter for the report
+            depth_skip = "ENTRY" not in s and not s.startswith("ENTRY")
+            continue
+        if s.startswith("}"):
+            depth_skip = False
+            continue
+        nm = _OPNAME_RE.search(s)  # before brace-stripping eats it
+        m = _ENTRY_LINE_RE.match(_strip_braces(s))
+        if not m:
+            continue
+        name, shape_part, opcode, rest = m.groups()
+        nbytes = _shape_part_bytes(shape_part)
+        sizes[name] = nbytes
+        if depth_skip or opcode in ("parameter", "constant", "tuple",
+                                    "get-tuple-element", "bitcast"):
+            continue
+        # operand names: %refs inside the call parens (metadata comes
+        # after the closing paren of the operand list; harmless extras
+        # like computation refs resolve to 0)
+        operand_part = rest.split("),", 1)[0]
+        reads = sum(sizes.get(r, 0) for r in
+                    re.findall(r"%([\w.\-]+)", operand_part))
+        rows.append((opcode, nbytes + reads,
+                     nm.group(1) if nm else name))
+    return rows
 
 
 def build_resnet(batch, nhwc=True, bf16=True):
@@ -166,6 +235,27 @@ def main():
         n = sum(1 for r in rows
                 if r[0] == op and r[2] == name and not r[3])
         print(f"  {b/1e9:7.3f} GB  {n:3d}x {op:10s} {name}")
+
+    # full roofline attribution: every top-level op, result+operand
+    # bytes — names where the step's HBM traffic actually lives
+    # (the 2026-08-01 run showed transpose/copy are NOT it: 0.5 GB of
+    # 46.5 GB total)
+    rr = roofline_rows(hlo)
+    by_kind = collections.Counter()
+    n_kind = collections.Counter()
+    for opcode, b, _ in rr:
+        by_kind[opcode] += b
+        n_kind[opcode] += 1
+    print("\n== top-level bytes (result+operands) by opcode ==")
+    for opcode, b in by_kind.most_common(12):
+        print(f"  {opcode:22s} {n_kind[opcode]:4d} ops  "
+              f"{b/1e9:7.3f} GB")
+    by_op = collections.Counter()
+    for opcode, b, name in rr:
+        by_op[(opcode, name)] += b
+    print(f"\n== top {args.top} top-level ops by bytes ==")
+    for (opcode, name), b in by_op.most_common(args.top):
+        print(f"  {b/1e9:7.3f} GB  {opcode:12s} {name[:90]}")
 
     ca = comp.cost_analysis()
     if isinstance(ca, list):

@@ -74,18 +74,78 @@ def bench_op(op_type, inputs, attrs=None, repeat=30, warmup=3, seed=0):
         ins[slot] = jax.device_put(v)
     cattrs = d.canonical_attrs(attrs or {})
 
-    fn = jax.jit(lambda i: d.compute(i, cattrs))
-    out = fn(ins)
-    jax.block_until_ready(out)  # compile
-    for _ in range(warmup):
-        out = fn(ins)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(repeat):
-        out = fn(ins)
-    jax.block_until_ready(out)
-    ms = (time.perf_counter() - t0) / repeat * 1e3
-    return ms
+    # Two timing hazards over the axon tunnel, both hit on 2026-08-01:
+    # (1) block_until_ready is not a reliable fence (a conv2d
+    # "measured" faster than chip peak), and (2) per-dispatch RTT is
+    # ~3.5 ms, so a host-side repeat loop times the tunnel, not the op
+    # (every op in that snapshot pinned at a 3-8 ms floor).  So the
+    # repeat loop runs ON DEVICE (lax.fori_loop, one dispatch): a
+    # scalar from each iteration's output folds into the next
+    # iteration's input, making the loop body un-hoistable, and the
+    # carried scalar is fetched to host as the fence.  Timing n and 2n
+    # iterations and taking the difference cancels the remaining
+    # constant dispatch+fence cost.
+    import jax.numpy as jnp
+    from jax import lax
+
+    if not ins:
+        # zero-input generators (gaussian_random, fill_constant, ...)
+        # have nothing to thread a loop-carried dependency through, so
+        # an on-device loop would be hoistable; fall back to host
+        # dispatch with a scalar-fetch fence and accept the dispatch
+        # floor (these ops are gated on relative regression only)
+        fn0 = jax.jit(lambda: d.compute({}, cattrs))
+
+        def fence():
+            leaf = jax.tree_util.tree_leaves(fn0())[0]
+            return float(np.asarray(
+                leaf.reshape(-1)[0].astype(jnp.float32)))
+
+        fence()
+        for _ in range(warmup):
+            fence()
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            fence()
+        return (time.perf_counter() - t0) / repeat * 1e3
+
+    slot0 = next((s for s in ins
+                  if ins[s].dtype != jnp.bool_), next(iter(ins)))
+
+    def body(_, t):
+        j = dict(ins)
+        # value-preserving for floats (t ~ 1e-38 * out[0]); for int
+        # slots the cast truncates to 0 but the dependency remains
+        if j[slot0].dtype == jnp.bool_:
+            j[slot0] = jnp.logical_xor(j[slot0], t != t)  # always False
+        else:
+            j[slot0] = j[slot0] + t.astype(j[slot0].dtype)
+        out = d.compute(j, cattrs)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return leaf.reshape(-1)[0].astype(jnp.float32) * 1e-38
+
+    def run_n(n):
+        return lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+    fn = jax.jit(run_n, static_argnums=0)
+
+    def timed(n):
+        float(np.asarray(fn(n)))  # compile + warm this trip count
+        for _ in range(warmup):
+            fn(n)
+        float(np.asarray(fn(n)))
+        t0 = time.perf_counter()
+        float(np.asarray(fn(n)))
+        return time.perf_counter() - t0
+
+    t_n, t_2n = timed(repeat), timed(2 * repeat)
+    per_iter = max(t_2n - t_n, 0.0) / repeat
+    if per_iter == 0.0:
+        # below difference-timing resolution (overhead jitter >= op
+        # cost): report the 2n-run upper bound instead of a flat 0 so
+        # downstream ratio gates never divide by zero
+        per_iter = t_2n / (2 * repeat)
+    return per_iter * 1e3
 
 
 def run_spec(spec, repeat_override=None):
