@@ -67,6 +67,19 @@ TASKS = [
     # and bf16 inter-layer activations halve the traffic that made the
     # dynamic int8 row 2x slower than bf16 (22.2 vs 11.35 ms)
     ("int8_infer_calibrated", "infer_i8", {"batch": 128, "chain": 20}),
+    # d128 at seq 128k: at 32k, d128 doubled MFU at the same wall time
+    # (MXU contractions full-width); expect the same here
+    ("longctx_seq131072_d128", "longctx",
+     {"seq": 131072, "head_dim": 128, "chain": 3}, 3000),
+    # single-chip capability ladder: 256k and 1M causal tokens (QKV
+    # streams from HBM, scores never materialize; steps ~6 s / ~95 s)
+    ("longctx_seq262144", "longctx",
+     {"seq": 262144, "chain": 3}, 3000),
+    ("longctx_seq1048576", "longctx",
+     {"seq": 1048576, "chain": 1}, 3600),
+    # decompose the 49.7 ms step again now one-pass BN is the default
+    # (the 9.3 ms bn_global delta was measured against two-pass stats)
+    ("rn50_ablate_v2", "script:tools/rn50_ablate.py", {}, 1800),
     # v2: on-device fori_loop timing (the host-loop snapshot timed the
     # ~3.5 ms tunnel dispatch, not the ops)
     ("op_bench_tpu_snapshot_v2",
