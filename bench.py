@@ -671,6 +671,14 @@ def bench_longctx_train_d128(head_dim=128, **kw):
     return bench_longctx_train(head_dim=head_dim, **kw)
 
 
+def _resolved_block(seq):
+    """What an unset block_q/block_k actually resolves to in the
+    kernel — keeps banked rows honest when only one block is pinned."""
+    from paddle_tpu.ops.pallas_kernels import _default_block
+
+    return _default_block(seq)
+
+
 def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
                         chain=10, block_q=None, block_k=None):
     """Long-context attention: tokens/sec + kernel MFU for causal
@@ -692,7 +700,8 @@ def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
         "mfu_pct": round(100 * mfu, 2),
         "batch": batch, "seq": seq, "heads": heads,
         "head_dim": head_dim,
-        **({"block_q": block_q or 512, "block_k": block_k or 512}
+        **({"block_q": block_q or _resolved_block(seq),
+            "block_k": block_k or _resolved_block(seq)}
            if block_q or block_k else {}),
         "device": kind,
     }

@@ -84,7 +84,10 @@ TASK_KEYS = {
     "longctx_seq131072_d128": (
         "longctx_flash_train_mb1_seq131072_d128", None),
     "longctx_seq262144": ("longctx_flash_train_mb1_seq262144", None),
+    "longctx_seq524288": ("longctx_flash_train_mb1_seq524288", None),
     "longctx_seq1048576": ("longctx_flash_train_mb1_seq1048576", None),
+    "longctx_seq1048576_h4": (
+        "longctx_flash_train_mb1_seq1048576_h4", None),
 }
 
 # primary key <- best (by mfu_pct) among these variant keys
