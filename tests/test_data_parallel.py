@@ -136,11 +136,13 @@ def test_dp_resnet_loss_trajectory_matches_single_device(
                                 fetch_list=[model["loss"]])
                 losses.append(float(np.asarray(lv).reshape(-1)[0]))
             trajs[parallel] = losses
-    # step 0 is bit-identical; later steps drift via XLA's sharded
-    # reduction order through BN's rsqrt (the reference comparison
-    # tolerates similar deltas: test_dist_base.py check_with_place
-    # delta ~1e-2 on losses)
-    assert trajs[True][0] == trajs[False][0]
+    # step 0 agrees to float-rounding (the shifted one-pass BN moments
+    # sum (x - x[0]) whose sharded reduction rounds differently from
+    # the unsharded order — ~1e-6 relative); later steps drift more
+    # via rsqrt (the reference comparison tolerates delta ~1e-2 on
+    # losses, test_dist_base.py check_with_place)
+    np.testing.assert_allclose(trajs[True][0], trajs[False][0],
+                               rtol=1e-4)
     np.testing.assert_allclose(trajs[True], trajs[False], rtol=2e-2,
                                atol=1e-5)
     assert trajs[True][-1] < trajs[True][0]

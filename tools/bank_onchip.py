@@ -81,6 +81,7 @@ TASK_KEYS = {
     # calibrated static-scale + bf16-activation rebuild of the same
     # leg — replaces the dynamic-scale row (22.2 ms) on re-bank
     "int8_infer_calibrated": ("resnet50_infer_int8_mb128", None),
+    "int8_infer_folded": ("resnet50_infer_int8_mb128", None),
     "longctx_seq131072_d128": (
         "longctx_flash_train_mb1_seq131072_d128", None),
     "longctx_seq262144": ("longctx_flash_train_mb1_seq262144", None),

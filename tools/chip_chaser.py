@@ -66,7 +66,13 @@ TASKS = [
     # calibrated int8: static InScale kills the per-conv max-reduction
     # and bf16 inter-layer activations halve the traffic that made the
     # dynamic int8 row 2x slower than bf16 (22.2 vs 11.35 ms)
-    ("int8_infer_calibrated", "infer_i8", {"batch": 128, "chain": 20}),
+    # fold=False: calibrated scales + bf16 activations but BN left in
+    # the graph — the banked 9.56 ms row; keeps the A/B real
+    ("int8_infer_calibrated", "infer_i8",
+     {"batch": 128, "chain": 20, "fold": False}),
+    # conv+bn folded before quantization (53 BN ops leave the graph;
+    # their scale/shift lands in the per-channel weight scales)
+    ("int8_infer_folded", "infer_i8", {"batch": 128, "chain": 20}),
     # d128 at seq 128k: at 32k, d128 doubled MFU at the same wall time
     # (MXU contractions full-width); expect the same here
     ("longctx_seq131072_d128", "longctx",
