@@ -92,6 +92,13 @@ TASKS = [
     # decompose the 49.7 ms step again now one-pass BN is the default
     # (the 9.3 ms bn_global delta was measured against two-pass stats)
     ("rn50_ablate_v2", "script:tools/rn50_ablate.py", {}, 1800),
+    # block probes past 1024x1024 and the d128 optimum
+    ("flash_block_sweep_big",
+     "script:tools/flash_block_sweep.py --shape longctx_big", {},
+     1800),
+    ("flash_block_sweep_d128",
+     "script:tools/flash_block_sweep.py --shape longctx_d128", {},
+     1800),
     # v2: on-device fori_loop timing (the host-loop snapshot timed the
     # ~3.5 ms tunnel dispatch, not the ops)
     ("op_bench_tpu_snapshot_v2",

@@ -88,6 +88,19 @@ def main():
             dict(name="longctx", b=1, h=8, t=32768, d=64, causal=True,
                  combos=[(512, 512), (512, 1024), (1024, 512),
                          (1024, 1024), (2048, 512)]),
+            # past the 1024x1024 winner (2026-08-01: 1.5x over the old
+            # 512x512 default) — scores VMEM at 2048x2048 is 16 MB f32,
+            # comfortably inside v5e VMEM
+            dict(name="longctx_big", b=1, h=8, t=32768, d=64,
+                 causal=True,
+                 combos=[(1024, 1024), (1024, 2048), (2048, 1024),
+                         (2048, 2048)]),
+            # LLM head width: the d128 legs run at ~2x the d64 MFU, so
+            # their block optimum deserves its own probe
+            dict(name="longctx_d128", b=1, h=8, t=32768, d=128,
+                 causal=True,
+                 combos=[(512, 1024), (1024, 1024), (1024, 2048),
+                         (2048, 1024)]),
         ]
         if only:
             shapes = [s for s in shapes if s["name"] == only]
