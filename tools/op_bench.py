@@ -78,15 +78,37 @@ def bench_op(op_type, inputs, attrs=None, repeat=30, warmup=3, seed=0):
     # (1) block_until_ready is not a reliable fence (a conv2d
     # "measured" faster than chip peak), and (2) per-dispatch RTT is
     # ~3.5 ms, so a host-side repeat loop times the tunnel, not the op
-    # (every op in that snapshot pinned at a 3-8 ms floor).  So the
-    # repeat loop runs ON DEVICE (lax.fori_loop, one dispatch): a
-    # scalar from each iteration's output folds into the next
-    # iteration's input, making the loop body un-hoistable, and the
-    # carried scalar is fetched to host as the fence.  Timing n and 2n
-    # iterations and taking the difference cancels the remaining
-    # constant dispatch+fence cost.
+    # (every op in that snapshot pinned at a 3-8 ms floor).  On TPU
+    # the repeat loop therefore runs ON DEVICE (lax.fori_loop, one
+    # dispatch): a scalar from each iteration's output folds into the
+    # next iteration's input, making the loop body un-hoistable, and
+    # the carried scalar is fetched to host as the fence.  Timing n
+    # and 2n iterations and taking the difference cancels the
+    # remaining constant dispatch+fence cost.
+    # On CPU the host loop stays: XLA:CPU runs while-loop bodies
+    # single-threaded, so a looped conv2d times ~20x slower than the
+    # standalone op the committed baseline measured (the gate tripped
+    # exactly this way); local dispatch is cheap and block_until_ready
+    # is a real fence there.
     import jax.numpy as jnp
     from jax import lax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu" or \
+        "tpu" in str(getattr(dev, "device_kind", "")).lower()
+
+    if ins and not on_tpu:
+        fn1 = jax.jit(lambda i: d.compute(i, cattrs))
+        out = fn1(ins)
+        jax.block_until_ready(out)  # compile
+        for _ in range(warmup):
+            out = fn1(ins)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            out = fn1(ins)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / repeat * 1e3
 
     if not ins:
         # zero-input generators (gaussian_random, fill_constant, ...)
