@@ -143,9 +143,16 @@ def roofline_rows(hlo_text):
         # operand names: %refs inside the call parens (metadata comes
         # after the closing paren of the operand list; harmless extras
         # like computation refs resolve to 0)
-        operand_part = rest.split("),", 1)[0]
-        reads = sum(sizes.get(r, 0) for r in
-                    re.findall(r"%([\w.\-]+)", operand_part))
+        if opcode in ("slice", "dynamic-slice", "gather"):
+            # these read only what they output (plus an index vector);
+            # counting full operand bytes inflated 1-element BN probe
+            # slices to the whole activation (2 GB of phantom "slice"
+            # traffic in the 2026-08-01 roofline)
+            reads = nbytes
+        else:
+            operand_part = rest.split("),", 1)[0]
+            reads = sum(sizes.get(r, 0) for r in
+                        re.findall(r"%([\w.\-]+)", operand_part))
         rows.append((opcode, nbytes + reads,
                      nm.group(1) if nm else name))
     return rows
