@@ -51,8 +51,10 @@ TASK_KEYS = {
     "rn_train_mb128_bn1p": ("resnet50_train_mb128_bn1p", None),
     "tf_train_mb64": ("transformer_base_train_mb64", None),
     "tf_train_mb128": ("transformer_base_train_mb128", None),
+    "tf_train_mb48": ("transformer_base_train_mb48", None),
     "bert_train_mb16": ("bert_base_train_seq512_mb16", None),
     "bert_train_mb24": ("bert_base_train_seq512_mb24", None),
+    "bert_train_mb32": ("bert_base_train_seq512_mb32", None),
     "vgg16_infer": ("vgg16_infer_bf16_mb64",
                     bench.BASELINE_VGG16_MB64_MS),
     "vgg16_infer_mb1": ("vgg16_infer_bf16_mb1", 3.32),
@@ -100,10 +102,12 @@ PRIMARY = {
                        "resnet50_train_mb128_bn1p"],
     "transformer_base_train": ["transformer_base_train",
                                "transformer_base_train_mb64",
-                               "transformer_base_train_mb128"],
+                               "transformer_base_train_mb128",
+                               "transformer_base_train_mb48"],
     "bert_base_train_seq512": ["bert_base_train_seq512",
                                "bert_base_train_seq512_mb16",
-                               "bert_base_train_seq512_mb24"],
+                               "bert_base_train_seq512_mb24",
+                               "bert_base_train_seq512_mb32"],
 }
 
 

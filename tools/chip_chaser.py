@@ -99,6 +99,11 @@ TASKS = [
     ("flash_block_sweep_d128",
      "script:tools/flash_block_sweep.py --shape longctx_d128", {},
      1800),
+    # un-probed interior batch points: bert peaked at the mb24 edge
+    # (43.72 @16 -> 46.23 @24), tf peaked between 32 (50.17) and 64
+    # (48.41)
+    ("bert_train_mb32", "bert_train", {"batch": 32, "chain": 10}),
+    ("tf_train_mb48", "tf_train", {"batch": 48, "chain": 15}),
     # v2: on-device fori_loop timing (the host-loop snapshot timed the
     # ~3.5 ms tunnel dispatch, not the ops)
     ("op_bench_tpu_snapshot_v2",
