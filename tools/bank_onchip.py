@@ -4,9 +4,10 @@ The chaser (tools/chip_chaser.py) drains bench legs into
 /tmp/chip_chaser_results.jsonl whenever the tunnel opens; this tool
 folds every successful on-chip record into the bench-artifact format
 (same shape as bench.py's JSON line), MERGED over the newest committed
-artifact so rows not re-measured survive.  bench.py auto-promotes the
-newest docs/bench_onchip_*.json into degraded runs, so banking is the
-only step between "window happened" and "BENCH_r05 shows it".
+artifact so rows not re-measured survive.  bench.py merges the newest
+docs/bench_onchip_*.json into EVERY run (live rows win by exact key or
+alias; banked-only rows ride with a provenance stamp), so banking is
+the only step between "window happened" and "BENCH_r05 shows it".
 
 Usage:
     python tools/bank_onchip.py                 # writes docs/bench_onchip_<stamp>.json
@@ -206,9 +207,14 @@ def main(argv=None):
         print("nothing new to bank; not writing", file=sys.stderr)
         return 0
     if not args.dry_run:
-        with open(out, "w") as f:
+        # atomic replace: bench.py may read the newest artifact at any
+        # moment (the chaser re-banks after every task), and a torn
+        # read would silently drop every banked row from its merge
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(art, f, indent=1)
             f.write("\n")
+        os.replace(tmp, out)
     return 0
 
 
