@@ -21,6 +21,10 @@ white_list = {
     # internally (softmax stats included) — leaving it unlisted would
     # cast the attention inputs back to fp32 under AMP
     "flash_attention",
+    # fused conv+bias+residual+relu (ops/pallas_conv.py): bf16
+    # operands, f32 accumulation in VMEM — same story as the conv it
+    # replaces
+    "conv2d_epilogue",
 }
 
 # numerically sensitive: keep fp32

@@ -46,6 +46,21 @@ def conv2d(ins, attrs):
     s, p, d = _pair(attrs["strides"]), _pair(attrs["paddings"]), _pair(
         attrs["dilations"])
     fmt = attrs.get("data_format", "NCHW")
+    if fmt == "NHWC" and attrs["groups"] == 1 and d == (1, 1) \
+            and x.ndim == 4:
+        # flag-gated Pallas fused-conv dispatch (default off -> this
+        # branch is never taken): even an epilogue-less conv benefits
+        # from the kernel's single-pass accumulator, and routing here
+        # keeps the A/B honest — one flag flips EVERY conv in the
+        # step, not just the rewritten chains
+        from paddle_tpu.flags import get_flag
+
+        if get_flag("conv_epilogue") != "off":
+            from paddle_tpu.ops.pallas_conv import (_impl_from_flag,
+                                                    conv2d_epilogue)
+
+            return {"Output": conv2d_epilogue(
+                x, w, strides=s, paddings=p, impl=_impl_from_flag())}
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
                                     (fmt, "OIHW", fmt))
     out = lax.conv_general_dilated(
@@ -217,22 +232,37 @@ def _moments_1pass(xf, axes):
     jnp.var's two-pass form (mean, then mean((x-mean)^2)) chains the
     second reduction on the first, forcing two HBM passes over x.
     Shifted one-pass moments — subtract a per-channel probe value
-    (one sampled element, so the shift is near the data's scale),
-    then sum(y) and sum(y*y) as independent siblings — let XLA
-    multi-output-fuse both reductions into ONE read pass; the
-    2026-08-01 rn50 on-chip ablation priced BN batch-stats traffic at
-    9.3 ms of a 53.6 ms step.  The shift kills the E[x^2]-E[x]^2
-    cancellation blow-up for channels with |mean| >> std (the raw
-    form loses all precision once mean^2 dominates var in fp32).
-    Mean/var are shift-invariant, including their gradients, so
-    exactness is preserved.  Both batch_norm and batch_norm_grad MUST
-    build stats through this one helper so the backward's recompute
-    CSEs with the forward under the one-module executor.
+    near the data's scale, then sum(y) and sum(y*y) as independent
+    siblings — let XLA multi-output-fuse both reductions into ONE
+    read pass; the 2026-08-01 rn50 on-chip ablation priced BN
+    batch-stats traffic at 9.3 ms of a 53.6 ms step.  The shift kills
+    the E[x^2]-E[x]^2 cancellation blow-up for channels with
+    |mean| >> std (the raw form loses all precision once mean^2
+    dominates var in fp32).  Mean/var are shift-invariant, including
+    their gradients, so exactness is preserved.  Both batch_norm and
+    batch_norm_grad MUST build stats through this one helper so the
+    backward's recompute CSEs with the forward under the one-module
+    executor.
+
+    Robustness (ADVICE r5): the shift is a SMALL-SLICE mean (up to 8
+    elements along the first reduced axis), not one sampled element —
+    a lone x[0,c,0,0] probe that happens to be ~0 on a post-ReLU
+    sparse channel while |mean| >> std degrades to the raw
+    cancellation-prone form.  And when E[y^2] and mean_y^2 still
+    agree within a few ulps (shift missed the data's scale anyway),
+    the affected channels fall back to an exact two-pass variance —
+    the second read pass costs only when cancellation actually bites.
     """
     m = float(np.prod([xf.shape[a] for a in axes]))
-    probe_idx = tuple(0 if a in axes else slice(None)
+    a0 = axes[0]
+    k = min(8, xf.shape[a0])
+    probe_idx = tuple(slice(0, k) if a == a0
+                      else (slice(0, 1) if a in axes else slice(None))
                       for a in range(xf.ndim))
-    shift = xf[probe_idx]  # per-channel, broadcasts against xf
+    # per-channel probe mean; stop_gradient: mean/var are
+    # shift-invariant, so the shift must carry no gradient of its own
+    shift = lax.stop_gradient(
+        jnp.mean(xf[probe_idx], axis=axes, keepdims=False))
     shape = [1] * xf.ndim
     for a in range(xf.ndim):
         if a not in axes:
@@ -242,7 +272,22 @@ def _moments_1pass(xf, axes):
     s2 = jnp.sum(y * y, axis=axes)
     mean_y = s1 / m
     mean = shift + mean_y
-    var = jnp.maximum(s2 / m - mean_y * mean_y, 0.0)
+    e2 = s2 / m
+    var = e2 - mean_y * mean_y
+    # cancellation guard: channels where the subtraction consumed all
+    # but a few ulps of E[y^2] get the exact two-pass variance; the
+    # cond skips the extra pass entirely on the (overwhelmingly
+    # common) clean step
+    eps = float(jnp.finfo(xf.dtype).eps) if jnp.issubdtype(
+        xf.dtype, jnp.floating) else float(jnp.finfo(jnp.float32).eps)
+    need = var <= 8.0 * eps * e2
+
+    def _twopass(_):
+        d = xf - lax.stop_gradient(mean).reshape(shape)
+        return jnp.sum(d * d, axis=axes) / m
+
+    var2 = lax.cond(jnp.any(need), _twopass, lambda _: var, None)
+    var = jnp.maximum(jnp.where(need, var2, var), 0.0)
     return mean, var
 
 

@@ -1,0 +1,369 @@
+"""Pallas TPU kernel: fused convolution + epilogue (bias/residual/ReLU).
+
+Capability anchor: the 2026-08-01 rn50 diagnosis (tools/hlo_traffic.py,
+VERDICT round 5) showed the ResNet-50 train step is HBM-bound with
+~9.3 GB/step of residual-add/ReLU/bias elementwise glue that XLA will
+NOT fuse into its convolution custom-calls — every bottleneck block
+writes the conv result to HBM, reads it back for the add, writes the
+sum, reads it back for the ReLU.  This kernel computes
+
+    out = act(conv(x, w) + bias + residual)
+
+in ONE VMEM-resident pass: the conv accumulator never leaves VMEM
+between the matmul and the epilogue, so the glue bytes disappear from
+the HBM roofline entirely.
+
+Layout: NHWC activations (the TPU fast path nhwc_transpile produces),
+OIHW filters (the repo's layout-independent param convention; the
+transpose to HWIO is folded by XLA into the weight layout).  The
+kernel grid is (N, Cout/bco): each cell holds one image's padded input
+and one Cout tile of the filter in VMEM and runs the KH*KW tap loop as
+static MXU dot_generals over [OH*OW, Cin] patches — im2col without the
+materialization (taps are strided VMEM slices of the resident image).
+Stride is handled by strided slicing inside VMEM; padding is applied
+once in XLA before the call.
+
+Backward: `jax.custom_vjp`.  The epilogue backward is closed-form
+(mask by the saved post-ReLU output, reduce for the bias), and dx/dw
+reuse the existing XLA conv gradients via jax.vjp of the plain conv
+core — under jit the unused primal is DCE'd, leaving exactly the two
+transposed convolutions XLA already runs for the unfused graph.
+
+Dispatch is behind the typed flag ``conv_epilogue`` (flags.py, default
+"off"): ops/nn.py conv2d routes NHWC convs here when the flag is on,
+and transpiler.fuse_conv_epilogue rewrites conv+bias+residual+ReLU IR
+chains onto the registered ``conv2d_epilogue`` op.  ``interpret=True``
+(impl="interpret") runs the same kernel under the Pallas interpreter
+for CPU-parity tests (tests/test_pallas_conv.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# jax renamed TPUCompilerParams -> CompilerParams around 0.5; support
+# both so the kernel lowers under the CI jax as well as the chip
+# host's (the seed's TPU cross-lowering tests failed on exactly this
+# drift)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
+# VMEM budget for the compiled kernel: one image block + filter tile +
+# accumulator + residual tile, doubled for Pallas' input double
+# buffering, must fit comfortably in ~16 MB/core.  Shapes over budget
+# fall back to the XLA composite (still correct, just unfused).
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+_DEFAULT_BLOCK_CO = 256
+
+
+# ---------------------------------------------------------------------------
+# reference (XLA) implementation — also the fallback path
+# ---------------------------------------------------------------------------
+
+def _conv_core(x, w, strides, padding):
+    """Plain NHWC conv with OIHW filters — the op the unfused graph
+    runs and the backward's gradient source."""
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "OIHW", "NHWC"))
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=list(padding),
+        dimension_numbers=dn)
+
+
+def _epilogue_xla(y, bias, residual, act):
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    if residual is not None:
+        y = y + residual.astype(y.dtype)
+    if act == "relu":
+        y = jnp.maximum(y, 0)
+    return y
+
+
+def _reference(x, w, bias, residual, strides, padding, act):
+    """Unfused composite: exactly the op sequence the IR runs when the
+    flag is off (conv -> bias add -> residual add -> act)."""
+    return _epilogue_xla(_conv_core(x, w, strides, padding), bias,
+                         residual, act)
+
+
+# ---------------------------------------------------------------------------
+# pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _conv_ep_kernel(*refs, kh, kw, sh, sw, oh, ow, act, has_bias,
+                    has_res):
+    """One grid cell = one (image, Cout-tile): full KH*KW*Cin reduction
+    plus the whole epilogue, accumulator resident in VMEM throughout.
+
+    refs: x[1,HP,WP,Cin], w[KH,KW,Cin,bco], (bias[1,bco]),
+    (residual[1,OH,OW,bco]), out[1,OH,OW,bco]."""
+    x_ref, w_ref = refs[0], refs[1]
+    i = 2
+    b_ref = refs[i] if has_bias else None
+    i += int(has_bias)
+    r_ref = refs[i] if has_res else None
+    o_ref = refs[-1]
+
+    x = x_ref[0]                                   # [HP, WP, Cin]
+    cin = x.shape[-1]
+    bco = o_ref.shape[-1]
+    ct = jnp.promote_types(x_ref.dtype, w_ref.dtype)
+    acc = jnp.zeros((oh * ow, bco), jnp.float32)
+    # static tap loop: each (i, j) filter tap is a VMEM slice of the
+    # resident image — [OH, OW, Cin] flattened onto the MXU as an
+    # [OH*OW, Cin] x [Cin, bco] contraction (im2col with no
+    # materialized patch matrix).  Stride > 1 is a contiguous slice +
+    # reshape + unit-index, NOT a strided slice: Mosaic's
+    # vector.extract_strided_slice only allows strides in [1, 2)
+    # (caught by tools/tpu_lowering_check.py cross-lowering — never
+    # cost a chip window)
+    for ti in range(kh):
+        for tj in range(kw):
+            p = lax.slice(x, (ti, tj, 0),
+                          (ti + oh * sh - (sh - 1),
+                           tj + ow * sw - (sw - 1), cin))
+            if sh > 1:
+                # pad the tail so rows split evenly, then keep phase 0
+                p = jnp.pad(p, ((0, sh - 1), (0, 0), (0, 0)))
+                p = p.reshape(oh, sh, p.shape[1], cin)[:, 0]
+            if sw > 1:
+                p = jnp.pad(p, ((0, 0), (0, sw - 1), (0, 0)))
+                p = p.reshape(oh, ow, sw, cin)[:, :, 0]
+            acc = acc + lax.dot_general(
+                p.reshape(oh * ow, cin).astype(ct),
+                w_ref[ti, tj].astype(ct),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    if has_bias:
+        acc = acc + b_ref[0].astype(jnp.float32)[None, :]
+    if has_res:
+        acc = acc + r_ref[0].reshape(oh * ow, bco).astype(jnp.float32)
+    if act == "relu":
+        acc = jnp.maximum(acc, 0.0)
+    o_ref[0] = acc.reshape(oh, ow, bco).astype(o_ref.dtype)
+
+
+def _out_spatial(h, w, kh, kw, sh, sw, padding):
+    (ph0, ph1), (pw0, pw1) = padding
+    oh = (h + ph0 + ph1 - kh) // sh + 1
+    ow = (w + pw0 + pw1 - kw) // sw + 1
+    return oh, ow
+
+
+def _block_co(cout):
+    if cout <= _DEFAULT_BLOCK_CO:
+        return cout
+    return _DEFAULT_BLOCK_CO
+
+
+def _vmem_estimate(xp_shape, w_shape, oh, ow, bco, has_res, x_itemsize,
+                   w_itemsize, o_itemsize):
+    _, hp, wp, cin = xp_shape
+    kh, kw = w_shape[0], w_shape[1]
+    x_b = hp * wp * cin * x_itemsize
+    w_b = kh * kw * cin * bco * w_itemsize
+    o_b = oh * ow * bco * o_itemsize
+    r_b = oh * ow * bco * o_itemsize if has_res else 0
+    acc_b = oh * ow * bco * 4
+    # inputs/outputs are double buffered by the pipeline; the
+    # accumulator lives once
+    return 2 * (x_b + w_b + o_b + r_b) + acc_b
+
+
+def _conv_ep_pallas(x, w, bias, residual, strides, padding, act,
+                    interpret=False):
+    """x: [N,H,W,Cin] NHWC; w: [O,Cin,KH,KW] OIHW."""
+    n, h, wd, cin = x.shape
+    cout, _, kh, kw = w.shape
+    sh, sw = strides
+    oh, ow = _out_spatial(h, wd, kh, kw, sh, sw, padding)
+    (ph0, _), (pw0, _) = padding
+    # pad once in XLA to exactly the span the tap loop reads:
+    # HP = (OH-1)*sh + KH (bottom/right padding beyond what the conv
+    # needs is sliced off so kernel slices stay in bounds)
+    hp = (oh - 1) * sh + kh
+    wp = (ow - 1) * sw + kw
+    xp = jnp.pad(x, ((0, 0),
+                     (ph0, max(hp - h - ph0, 0)),
+                     (pw0, max(wp - wd - pw0, 0)),
+                     (0, 0)))[:, :hp, :wp, :]
+    w_hwio = jnp.transpose(w, (2, 3, 1, 0))        # [KH,KW,Cin,O]
+    out_dtype = jnp.promote_types(x.dtype, w.dtype)
+
+    bco = _block_co(cout)
+    if not interpret:
+        est = _vmem_estimate(xp.shape, (kh, kw), oh, ow, bco,
+                             residual is not None, xp.dtype.itemsize,
+                             w_hwio.dtype.itemsize,
+                             jnp.dtype(out_dtype).itemsize)
+        if est > _VMEM_BUDGET_BYTES:
+            return _reference(x, w, bias, residual, strides, padding,
+                              act)
+
+    grid = (n, pl.cdiv(cout, bco))
+    in_specs = [
+        pl.BlockSpec((1, hp, wp, cin), lambda ni, co: (ni, 0, 0, 0)),
+        pl.BlockSpec((kh, kw, cin, bco), lambda ni, co: (0, 0, 0, co)),
+    ]
+    operands = [xp, w_hwio]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, bco), lambda ni, co: (0, co)))
+        operands.append(bias.reshape(1, cout))
+    if residual is not None:
+        in_specs.append(pl.BlockSpec((1, oh, ow, bco),
+                                     lambda ni, co: (ni, 0, 0, co)))
+        operands.append(residual)
+    params = {}
+    if not interpret:
+        params["compiler_params"] = _CompilerParams(
+            dimension_semantics=("parallel", "parallel"))
+    kernel = functools.partial(
+        _conv_ep_kernel, kh=kh, kw=kw, sh=sh, sw=sw, oh=oh, ow=ow,
+        act=act, has_bias=bias is not None,
+        has_res=residual is not None)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, oh, ow, bco),
+                               lambda ni, co: (ni, 0, 0, co)),
+        out_shape=jax.ShapeDtypeStruct((n, oh, ow, cout), out_dtype),
+        interpret=interpret,
+        **params,
+    )(*operands)
+
+
+# ---------------------------------------------------------------------------
+# public differentiable entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _conv_ep(x, w, bias, residual, strides, padding, act, impl):
+    if impl in ("pallas", "interpret"):
+        return _conv_ep_pallas(x, w, bias, residual, strides, padding,
+                               act, interpret=impl == "interpret")
+    return _reference(x, w, bias, residual, strides, padding, act)
+
+
+def _conv_ep_fwd(x, w, bias, residual, strides, padding, act, impl):
+    y = _conv_ep(x, w, bias, residual, strides, padding, act, impl)
+    return y, (x, w, bias, residual, y)
+
+
+def _conv_ep_bwd(strides, padding, act, impl, res, g):
+    x, w, bias, residual, y = res
+    gf = g
+    if act == "relu":
+        # the saved output IS post-ReLU: y > 0 <=> pre-activation > 0
+        gf = jnp.where(y > 0, g, jnp.zeros_like(g))
+    # dx/dw via the existing XLA conv gradients: vjp of the plain conv
+    # core — the unused primal conv is DCE'd under jit, leaving the
+    # same transposed convs the unfused graph runs
+    ct = jnp.promote_types(x.dtype, w.dtype)
+    _, vjp = jax.vjp(
+        lambda a, b: _conv_core(a, b, strides, padding), x, w)
+    dx, dw = vjp(gf.astype(ct))
+    db = None
+    if bias is not None:
+        db = jnp.sum(gf.astype(jnp.float32),
+                     axis=(0, 1, 2)).astype(bias.dtype)
+    dres = None
+    if residual is not None:
+        dres = gf.astype(residual.dtype)
+    return dx, dw, db, dres
+
+
+_conv_ep.defvjp(_conv_ep_fwd, _conv_ep_bwd)
+
+
+def _norm_padding(paddings):
+    """[ph, pw] or ((ph0,ph1),(pw0,pw1)) -> ((ph0,ph1),(pw0,pw1))."""
+    p = tuple(paddings)
+    if len(p) == 2 and not isinstance(p[0], (tuple, list)):
+        return ((int(p[0]), int(p[0])), (int(p[1]), int(p[1])))
+    return tuple((int(a), int(b)) for a, b in p)
+
+
+def conv2d_epilogue(x, w, bias=None, residual=None, *, strides=(1, 1),
+                    paddings=(0, 0), act=None, impl=None):
+    """Fused NHWC conv + bias + residual + act in one VMEM pass.
+
+    x: [N, H, W, Cin]; w: [O, Cin, KH, KW] (OIHW); bias: [O];
+    residual: [N, OH, OW, O]; act: None or "relu".
+
+    impl: None (auto: pallas on TPU, XLA composite elsewhere),
+    "pallas", "interpret" (Pallas interpreter, for CPU tests), or
+    "xla" (the unfused composite — the exact op sequence the flag-off
+    graph runs).  Differentiable in x/w/bias/residual via custom_vjp;
+    dx/dw reuse the XLA conv gradients.
+    """
+    if impl is None:
+        impl = "pallas" if _on_tpu() else "xla"
+    strides = tuple(int(s) for s in strides)
+    padding = _norm_padding(paddings)
+    return _conv_ep(x, w, bias, residual, strides, padding,
+                    act or "", impl)
+
+
+def _on_tpu():
+    from paddle_tpu.ops.pallas_kernels import _on_tpu as _chip
+
+    return _chip()
+
+
+def _impl_from_flag():
+    """Map the conv_epilogue flag to an impl name ("off" still returns
+    a correct impl — the op may exist in a program loaded under a
+    different flag state)."""
+    from paddle_tpu.flags import get_flag
+
+    mode = get_flag("conv_epilogue")
+    if mode in ("pallas", "interpret", "xla"):
+        return mode
+    if mode == "on":
+        return None                     # auto: pallas on TPU else xla
+    return "xla"                        # "off" (or unknown): unfused
+
+
+# ---------------------------------------------------------------------------
+# IR op registration — the target of transpiler.fuse_conv_epilogue
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.core.registry import register_op  # noqa: E402
+
+
+@register_op("conv2d_epilogue",
+             inputs=("Input", "Filter", "Bias", "Residual"),
+             outputs=("Output",),
+             optional=("Bias", "Residual"),
+             attrs={"strides": [1, 1], "paddings": [0, 0], "act": "",
+                    "groups": 1, "data_format": "NCHW"})
+def _conv2d_epilogue_op(ins, attrs):
+    """conv2d + channel bias + residual add + activation as ONE op.
+    NCHW programs are normalized to NHWC internally (the layout
+    transpiler rewrites the op to native NHWC on the TPU path, making
+    these transposes vanish)."""
+    x, w = ins["Input"], ins["Filter"]
+    bias = ins.get("Bias")
+    residual = ins.get("Residual")
+    fmt = attrs.get("data_format", "NCHW")
+    if fmt == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        if residual is not None:
+            residual = jnp.transpose(residual, (0, 2, 3, 1))
+    out = conv2d_epilogue(
+        x, w, bias, residual,
+        strides=attrs.get("strides", [1, 1]),
+        paddings=attrs.get("paddings", [0, 0]),
+        act=attrs.get("act") or None,
+        impl=_impl_from_flag())
+    if fmt == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return {"Output": out}

@@ -36,6 +36,12 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 _MIN_LANES = 128  # TPU vector lane count; m/l scratch padded to this
 
+# jax renamed TPUCompilerParams -> CompilerParams around 0.5; support
+# both so the kernels lower under the CI jax as well as the chip
+# host's (the TPU cross-lowering tests failed on exactly this drift)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 
 # ---------------------------------------------------------------------------
 # reference (XLA) implementation — also the backward path
@@ -174,7 +180,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
         kv_len=tk, q_off=tk - tq if causal else 0)
     params = {}
     if not interpret:
-        params["compiler_params"] = pltpu.CompilerParams(
+        params["compiler_params"] = _CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
     out, lse = pl.pallas_call(
         kernel,
@@ -394,7 +400,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
                   kv_len=tk, q_len=tq, q_off=q_off)
     params = {}
     if not interpret:
-        params["compiler_params"] = pltpu.CompilerParams(
+        params["compiler_params"] = _CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     qspec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))

@@ -112,6 +112,18 @@ class _Rewriter:
 
     def rewrite(self, op):
         t = op.type
+        if t == "conv2d_epilogue":
+            # fused conv+epilogue (ops/pallas_conv.py): Input AND the
+            # optional Residual ride in NHWC; the 1-D Bias is
+            # layout-independent; Filter stays OIHW like plain conv2d
+            op.inputs["Input"][0] = self.as_nhwc(op.inputs["Input"][0])
+            if "Residual" in op.inputs:
+                op.inputs["Residual"][0] = self.as_nhwc(
+                    op.inputs["Residual"][0])
+            op.attrs["data_format"] = "NHWC"
+            self.new_ops.append(op)
+            self.mark_out_nhwc(op, "Output")
+            return
         if t in _CONV_LIKE:
             slot = "Input" if "Input" in op.inputs else "X"
             src = op.inputs[slot][0]

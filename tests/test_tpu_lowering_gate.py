@@ -47,7 +47,11 @@ def test_sequence_parallel_flash_lowers_for_tpu(which, causal):
     from paddle_tpu.parallel.ulysses import ulysses_attention
 
     fn = ring_attention if which == "ring" else ulysses_attention
-    mesh = AbstractMesh((8,), ("sp",))
+    try:
+        mesh = AbstractMesh((8,), ("sp",))
+    except TypeError:
+        # jax <= 0.4.x spells it AbstractMesh(((name, size), ...))
+        mesh = AbstractMesh((("sp", 8),))
     q = jnp.zeros((2, 4096, 8, 64), jnp.bfloat16)
 
     def step(q, k, v):

@@ -50,6 +50,12 @@ TASK_KEYS = {
     # one-pass BN batch stats (ops/nn.py _moments_1pass) — the leg is
     # the plain default build, so this IS the new default graph
     "rn_train_mb128_bn1p": ("resnet50_train_mb128_bn1p", None),
+    # fused conv-epilogue Pallas kernel A/B (ops/pallas_conv.py,
+    # round-6 tentpole): train-side (flag flips every conv onto the
+    # kernel) and inference-side (conv-bn fold + full chain fusion)
+    "rn_train_mb128_convep": ("resnet50_train_mb128_convep", None),
+    "rn_infer_mb128_convep": ("resnet50_infer_bf16_convep_mb128",
+                              bench.BASELINE_INFER_MS),
     "tf_train_mb64": ("transformer_base_train_mb64", None),
     "tf_train_mb128": ("transformer_base_train_mb128", None),
     "tf_train_mb48": ("transformer_base_train_mb48", None),
@@ -100,7 +106,8 @@ PRIMARY = {
                        "resnet50_train_mb512",
                        "resnet50_train_mb128_s2d",
                        "resnet50_train_mb128_cmp_pool",
-                       "resnet50_train_mb128_bn1p"],
+                       "resnet50_train_mb128_bn1p",
+                       "resnet50_train_mb128_convep"],
     "transformer_base_train": ["transformer_base_train",
                                "transformer_base_train_mb64",
                                "transformer_base_train_mb128",

@@ -33,6 +33,23 @@ BENCH = os.path.join(REPO, "bench.py")
 # sweep and anchors sit at the tail for fresh-results-file runs; int8
 # (the known 2026-07-31 tunnel-wedger) stays last.
 TASKS = [
+    # ---- ROUND-6 HEAD: the fused conv-epilogue A/B (VERDICT r5
+    # next-round #1 — the one unmet north-star number).  The pair
+    # banks FIRST in any window: baseline rn_train re-run under
+    # current code, then the same workload with every conv routed
+    # through the Pallas fused kernel (ops/pallas_conv.py,
+    # flag conv_epilogue=on).  Target: >=40% MFU (stretch 50) on the
+    # resnet50_train row; bank_onchip promotes the best variant to
+    # the primary key automatically.
+    ("rn_train_mb128_convep", "rn_train_convep",
+     {"batch": 128, "chain": 20}),
+    # int8/inference side of the same kernel: after the conv-bn fold
+    # the whole conv->bias->residual->relu chain collapses into ONE
+    # fused op (transpiler.fuse_conv_epilogue) — this leg prices that
+    # full-fusion graph where the train path can only fuse the conv
+    # itself (BN batch stats sit between conv and the residual add)
+    ("rn_infer_mb128_convep", "infer",
+     {"batch": 128, "chain": 60, "conv_epilogue": True}),
     # ---- 2026-08-01 afternoon reorder: the morning window banked the
     # rn50 batch sweep (mb256/mb512/s2d), the tf/bert/vgg anchors, and
     # profile_resnet; those tasks are pre-seeded done in the results

@@ -107,6 +107,26 @@ def _strip_braces(s):
     return s
 
 
+def _operand_span(rest):
+    """`rest` is everything after the opcode's opening '(' (braces
+    already stripped): return the slice up to the MATCHING close
+    paren.  Everything after it is metadata/attributes — scanning the
+    whole tail for %refs let an op_name or sharding string that
+    mentions an instruction name misattribute that instruction's
+    bytes as a read (ADVICE r5).  Nested parens (tuple operands,
+    computation refs) are depth-tracked; an unterminated line returns
+    the whole rest (harmless: unmatched refs resolve to 0)."""
+    depth = 0
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            if depth == 0:
+                return rest[:i]
+            depth -= 1
+    return rest
+
+
 def roofline_rows(hlo_text):
     """Attribute HBM traffic to every TOP-LEVEL op of the entry
     computation: bytes = result bytes + sum of operand result bytes
@@ -114,6 +134,11 @@ def roofline_rows(hlo_text):
     interiors are skipped — a fusion's traffic is its boundary.
     Yields (opcode, bytes, op_name)."""
     depth_skip = False
+    # operand sizes are NAMESPACED per computation: HLO instruction
+    # names are only unique within their computation, and a fusion
+    # body reusing an entry-computation name (common for %param-style
+    # locals) would otherwise overwrite the entry's recorded size and
+    # misattribute bytes in the report (ADVICE r5)
     sizes = {}
     rows = []
     for line in hlo_text.splitlines():
@@ -126,6 +151,7 @@ def roofline_rows(hlo_text):
             # rows whose line carries op_name metadata AND whose
             # opcode isn't parameter/constant matter for the report
             depth_skip = "ENTRY" not in s and not s.startswith("ENTRY")
+            sizes = {}          # fresh namespace per computation
             continue
         if s.startswith("}"):
             depth_skip = False
@@ -140,9 +166,9 @@ def roofline_rows(hlo_text):
         if depth_skip or opcode in ("parameter", "constant", "tuple",
                                     "get-tuple-element", "bitcast"):
             continue
-        # operand names: %refs inside the call parens (metadata comes
-        # after the closing paren of the operand list; harmless extras
-        # like computation refs resolve to 0)
+        # operand names: %refs inside the call parens ONLY (the span
+        # ends at the matching close paren; computation refs and
+        # other non-result names resolve to 0)
         if opcode in ("slice", "dynamic-slice", "gather"):
             # these read only what they output (plus an index vector);
             # counting full operand bytes inflated 1-element BN probe
@@ -150,7 +176,7 @@ def roofline_rows(hlo_text):
             # traffic in the 2026-08-01 roofline)
             reads = nbytes
         else:
-            operand_part = rest.split("),", 1)[0]
+            operand_part = _operand_span(rest)
             reads = sum(sizes.get(r, 0) for r in
                         re.findall(r"%([\w.\-]+)", operand_part))
         rows.append((opcode, nbytes + reads,

@@ -48,6 +48,15 @@ def _workloads():
         "resnet50_train": lambda: bench._build_resnet50_train(128)[:3],
         "resnet50_train_s2d": lambda: bench._build_resnet50_train(
             128, s2d=True)[:3],
+        # fused conv-epilogue Pallas graphs (ops/pallas_conv.py):
+        # interpret-mode tests never enforce Mosaic's tiling/lowering
+        # rules, so the convep A/B legs must cross-lower here BEFORE
+        # the chaser spends a tunnel window on them (the flash [1,bq]
+        # lse lesson)
+        "resnet50_train_convep": lambda: bench._build_resnet50_train(
+            128, conv_epilogue=True)[:3],
+        "resnet50_infer_convep": lambda: _infer(
+            bench, "resnet", 128, conv_epilogue=True),
         "bert_train": lambda: bench._build_bert_train(8, 512)[:3],
         "deepfm_train": lambda: bench._build_deepfm_train(2048)[:3],
         "resnet50_infer_int8": lambda:
@@ -61,7 +70,7 @@ def _workloads():
     }
 
 
-def _infer(bench, which, batch):
+def _infer(bench, which, batch, conv_epilogue=False):
     import jax.numpy as jnp
     import numpy as np
 
@@ -101,7 +110,8 @@ def _infer(bench, which, batch):
                 rng.rand(batch, 3, 224, 224).astype(np.float32),
                 jnp.bfloat16)}
     return bench._build_infer(lambda: build(is_test=True), feed,
-                              "logits")[:3]
+                              "logits",
+                              conv_epilogue=conv_epilogue)[:3]
 
 
 FAST_SKIP = ("resnet50_train", "bert_train")
