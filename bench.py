@@ -37,6 +37,23 @@ BASELINE_VGG16_CIFAR_MS = 17.37  # V100 fp16 mb=512, float16_benchmark.md:61-63
 BASELINE_RN32_CIFAR_MS = 11.02  # V100 fp16 mb=512, float16_benchmark.md:72-74
 MFU_TARGET = 0.50          # BASELINE.md north star
 
+# peak HBM bandwidth per chip by device kind (public spec sheets) —
+# the denominator of the BW% bound for memory-bound rows (DeepFM CTR:
+# the step is a gather/scatter over the embedding tables, so MFU alone
+# says nothing — VERDICT r5 next-round #7)
+_PEAK_BW_BY_KIND = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1638e9,
+    "TPU v6e": 1638e9,
+    "TPU7x": 7370e9,
+}
+
 # bf16 peak FLOP/s per chip by device kind (public spec sheets)
 _PEAK_BY_KIND = {
     "TPU v2": 46e12,
@@ -61,6 +78,17 @@ def _chip_peak_flops():
             return v, kind
     # unknown kind (CPU dev runs): report MFU vs an arbitrary 1 TFLOP/s
     return 1e12, kind
+
+
+def _chip_peak_bw():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for k, v in _PEAK_BW_BY_KIND.items():
+        if kind.lower().startswith(k.lower()):
+            return v, kind
+    # unknown kind (CPU dev runs): BW% vs an arbitrary 100 GB/s
+    return 1e11, kind
 
 
 def _fresh_programs():
@@ -239,12 +267,20 @@ def _transformer_n_params(seq, vocab, d_model, n_layer, d_inner,
             + d_model * vocab)
 
 
-def _build_transformer_train(batch, seq, amp=True):
+def _build_transformer_train(batch, seq, amp=True, fused_adam=False):
     """Build + init the bench transformer train step; returns
     (fn, state, feed, loss_name) — the exact path bench and profiler
     share.  amp=True rewrites activations to bf16 with fp32 master
     weights (contrib.mixed_precision), the transformer counterpart of
-    the resnet bench's AMP story."""
+    the resnet bench's AMP story.
+
+    fused_adam=True emits ONE multi-tensor fused_adam op over every
+    (param, grad) pair instead of ~100 per-param adam ops — the
+    Adam-tail A/B deliberately deferred in PROFILE_r4 §5.3, queued to
+    diagnose the 50.17->42.02% batch slide (VERDICT r5 next-round #6):
+    at mb128 the optimizer tail is the step fraction that GROWS with
+    batch the least, so if the slide is scheduling overhead across the
+    many small elementwise kernels, fusing them names it."""
     import jax
     import jax.numpy as jnp
 
@@ -258,7 +294,7 @@ def _build_transformer_train(batch, seq, amp=True):
         vocab_size=c["vocab"], max_len=seq, d_model=c["d_model"],
         n_head=c["n_head"], d_inner=c["d_inner"],
         n_layer=c["n_layer"], dropout_rate=0.0)
-    opt = optimizer.Adam(learning_rate=1e-4)
+    opt = optimizer.Adam(learning_rate=1e-4, fuse=fused_adam)
     if amp:
         from paddle_tpu.contrib.mixed_precision import decorate
 
@@ -278,9 +314,11 @@ def _build_transformer_train(batch, seq, amp=True):
     return fn, state, feed, model["loss"].name
 
 
-def bench_transformer_train(batch=32, seq=512, chain=30):
+def bench_transformer_train(batch=32, seq=512, chain=30,
+                            fused_adam=False):
     """Transformer-base LM (d=512, 6L, 8H, ffn 2048), seq 512."""
-    fn, state, feed, loss_name = _build_transformer_train(batch, seq)
+    fn, state, feed, loss_name = _build_transformer_train(
+        batch, seq, fused_adam=fused_adam)
     sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
     toks_per_sec = batch * seq / sec_per_step
     c = TRANSFORMER_BASE
@@ -289,7 +327,7 @@ def bench_transformer_train(batch=32, seq=512, chain=30):
     fpt = _transformer_train_flops_per_token(
         n_params, c["d_model"], c["n_layer"], seq)
     mfu = fpt * toks_per_sec / peak
-    return {
+    res = {
         "tokens_per_sec": round(toks_per_sec, 0),
         "samples_per_sec": round(batch / sec_per_step, 2),
         "step_ms": round(sec_per_step * 1e3, 3),
@@ -298,6 +336,9 @@ def bench_transformer_train(batch=32, seq=512, chain=30):
         "seq": seq,
         "device": kind,
     }
+    if fused_adam:
+        res["fused_adam"] = True
+    return res
 
 
 # BERT-base config shared by the builder and the FLOPs accounting (one
@@ -363,6 +404,26 @@ def bench_bert_train(batch=8, seq=512, chain=20):
             "batch": batch, "seq": seq, "device": kind}
 
 
+def _deepfm_train_flops_per_example(num_fields=26, embed_dim=16,
+                                    dense_dim=13,
+                                    hidden=(400, 400, 400)):
+    """Analytic DeepFM train FLOPs/example (3x fwd, 2*MACs), closed
+    form from the deepfm_model defaults — like every other leg, NOT
+    XLA cost analysis, so fusion tricks can't inflate MFU.  MLP MACs
+    dominate; the FM/embedding elementwise terms ride along for
+    honesty (~1% of the total)."""
+    mlp_in = num_fields * embed_dim + dense_dim
+    macs = 0
+    prev = mlp_in
+    for w in hidden:
+        macs += prev * w
+        prev = w
+    macs += prev * 1
+    # FM second order: square/sum over [F, E] twice + first-order sum
+    fm_elem = 3 * num_fields * embed_dim + 2 * embed_dim + num_fields
+    return 3 * (2.0 * macs + fm_elem)
+
+
 def _build_deepfm_train(batch=2048):
     """Build + init the DeepFM bench train step; returns
     (fn, state, feed, loss_name) — shared with the lowering gate."""
@@ -394,11 +455,39 @@ def _build_deepfm_train(batch=2048):
 
 
 def bench_deepfm_train(batch=2048, chain=30):
-    """BASELINE workload 5: DeepFM CTR (sparse lookup + dense DNN)."""
+    """BASELINE workload 5: DeepFM CTR (sparse lookup + dense DNN).
+
+    The row carries its roofline context (VERDICT r5 next-round #7):
+    MFU from the analytic MLP/FM FLOPs (tiny — CTR is not a FLOPs
+    workload) and the achieved-vs-peak HBM BW% from the compiled
+    step's bytes accessed — the bound that actually prices the
+    embedding gather/scatter + optimizer traffic this leg is made of.
+    tools/hlo_traffic.py --model deepfm names the per-op consumers."""
     fn, state, feed, loss_name = _build_deepfm_train(batch)
+    # bytes accessed of the EXACT compiled step (the jit cache reuses
+    # this compile for the timed calls)
+    bytes_step = None
+    try:
+        ca = fn.lower(state, feed).compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        bytes_step = float(ca.get("bytes accessed", 0.0)) or None
+    except Exception:  # noqa: BLE001 — roofline is best-effort context
+        pass
     sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
-    return {"examples_per_sec": round(batch / sec_per_step, 1),
-            "step_ms": round(sec_per_step * 1e3, 3), "batch": batch}
+    eps = batch / sec_per_step
+    peak, kind = _chip_peak_flops()
+    mfu = _deepfm_train_flops_per_example() * eps / peak
+    res = {"examples_per_sec": round(eps, 1),
+           "step_ms": round(sec_per_step * 1e3, 3), "batch": batch,
+           "mfu_pct": round(100 * mfu, 3),
+           "device": kind}
+    if bytes_step:
+        bw, _ = _chip_peak_bw()
+        res["hbm_gb_per_step"] = round(bytes_step / 1e9, 3)
+        res["hbm_bw_pct"] = round(
+            100 * bytes_step / sec_per_step / bw, 2)
+    return res
 
 
 def _build_infer(model_builder, feed_builder, fetch_key,
@@ -710,7 +799,8 @@ def _probe_device(budget_s=900):
 
 
 def _build_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
-                         block_q=None, block_k=None):
+                         block_q=None, block_k=None,
+                         packed_stats=False, head_pack=False):
     """Build the long-context attention step: flash fwd+bwd at 64x the
     reference's sequence ceiling (BERT seq-512, SURVEY §5 long-context
     row).  Unfused attention at seq 32k materializes an ~34 GB fp32
@@ -725,6 +815,15 @@ def _build_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
     from paddle_tpu import backward, framework, layers
 
     _fresh_programs()
+    # A/B levers: the flash memory-layout variants (packed [T/128,128]
+    # row-stats; two d<=64 heads per grid block — ops/pallas_kernels.py,
+    # docs/FLASH_ATTENTION.md).  Always set explicitly, like
+    # conv_epilogue: "off" is the default graph, not "whatever a
+    # previous in-process build left behind"
+    from paddle_tpu.flags import set_flags
+
+    set_flags({"flash_packed_stats": "on" if packed_stats else "off",
+               "flash_head_pack": "on" if head_pack else "off"})
     qkv = []
     for n in "qkv":
         x = layers.data(n, shape=[heads, seq, head_dim],
@@ -766,11 +865,19 @@ def _resolved_block(seq):
 
 
 def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
-                        chain=10, block_q=None, block_k=None):
+                        chain=10, block_q=None, block_k=None,
+                        packed_stats=False, head_pack=False):
     """Long-context attention: tokens/sec + kernel MFU for causal
-    flash attention fwd+bwd at seq 32k on one chip."""
+    flash attention fwd+bwd at seq 32k on one chip.
+
+    packed_stats=True runs the packed row-stats layout (the seq-1M
+    enabler: drops ~12 GB of lane replication at 1M x 8 heads);
+    head_pack=True packs two d<=64 heads per kernel block (the d64
+    ladder re-key).  Both default off — the plain legs stay the
+    banked A/B baselines."""
     fn, state, feed, fetches = _build_longctx_train(
-        batch, heads, seq, head_dim, block_q=block_q, block_k=block_k)
+        batch, heads, seq, head_dim, block_q=block_q, block_k=block_k,
+        packed_stats=packed_stats, head_pack=head_pack)
     sec_per_step, _ = _chain_timed(fn, state, feed, fetches[0], chain)
     toks_per_sec = batch * seq / sec_per_step
     peak, kind = _chip_peak_flops()
@@ -780,7 +887,7 @@ def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
     # the model benches.
     flops = 3 * 2.0 * batch * heads * float(seq) ** 2 * head_dim
     mfu = flops / sec_per_step / peak
-    return {
+    res = {
         "tokens_per_sec": round(toks_per_sec, 1),
         "step_ms": round(sec_per_step * 1e3, 3),
         "mfu_pct": round(100 * mfu, 2),
@@ -791,6 +898,14 @@ def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
            if block_q or block_k else {}),
         "device": kind,
     }
+    # variant markers ride in the row (the re-key rule: a dashboard
+    # diffing rounds must never read a layout flip as a same-graph
+    # perf change) — _workload_sig keys on them too
+    if packed_stats:
+        res["packed_stats"] = True
+    if head_pack:
+        res["head_pack"] = True
+    return res
 
 
 # ---------------------------------------------------------------------------
@@ -908,13 +1023,15 @@ def _workload_sig(key, row):
 
     fam = re.sub(r"_DEGRADED.*$", "", key)
     fam = re.sub(r"_(?:mb|seq|h|d|blk)\d+", "", fam)
-    fam = re.sub(r"_(?:s2d|convep|cmp_pool|bn1p|fastpath)(?=_|$)", "",
-                 fam)
+    fam = re.sub(r"_(?:s2d|convep|cmp_pool|bn1p|fastpath|packed|hp2|"
+                 r"fusedadam)(?=_|$)", "", fam)
     return (fam, row.get("batch"), row.get("seq"), row.get("heads"),
             row.get("head_dim"), bool(row.get("s2d_stem")),
             bool(row.get("conv_epilogue")),
             row.get("maxpool_grad") or "",
-            bool(row.get("conv_bn_folded")))
+            bool(row.get("conv_bn_folded")),
+            bool(row.get("packed_stats")), bool(row.get("head_pack")),
+            bool(row.get("fused_adam")))
 
 
 def main():

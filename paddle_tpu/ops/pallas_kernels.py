@@ -11,8 +11,10 @@ accumulation in VMEM scratch — the [Tq, Tk] matrix never leaves VMEM
 (FlashAttention pattern).
 
 Layout: q/k/v are [B, H, T, D] (the transformer model's post-split-heads
-layout).  Grid is (B*H, Tq/block_q, Tk/block_k) with the KV dimension
-innermost so the (acc, m, l) scratch carries across KV steps.
+layout).  Grid is (B*H/hpb, Tq/block_q, Tk/block_k) with the KV
+dimension innermost so the (acc, m, l) scratch carries across KV steps;
+hpb is the heads-per-block packing factor (1, or 2 under the
+`flash_head_pack` flag — see below).
 
 The public `flash_attention` is differentiable via custom_vjp: forward
 runs the Pallas kernel on TPU (plain XLA path elsewhere) and saves
@@ -20,6 +22,32 @@ runs the Pallas kernel on TPU (plain XLA path elsewhere) and saves
 FlashAttention bwd: a dq sweep and a dk/dv sweep that recompute P
 blockwise from lse) — the [Tq, Tk] matrices stay in VMEM in both
 directions.  The XLA impl keeps the plain einsum replay.
+
+Memory-layout variants (docs/FLASH_ATTENTION.md; both default OFF until
+the chip chaser validates them — zero behavior change under the
+defaults):
+
+* packed row-stats (`flash_packed_stats`): the per-row log-sum-exp is
+  stored packed as [B*H, T/128, 128] f32 (row r -> (r//128, r%128))
+  instead of 128x lane-replicated [B*H, T, 128], and the backward reads
+  lse/delta through the same packed layout instead of materializing two
+  more replicated broadcasts as kernel inputs.  At seq-1M x 8 heads the
+  replicated layout is ~12 GB of pure replication — the OOM that capped
+  the long-context ladder (docs/NEXT.md item 5).  Mosaic's f32 (8, 128)
+  sublane rule makes the packed (bq/128, 128) output block legal only
+  for block_q >= 1024; smaller blocks silently keep the replicated
+  layout (the documented fallback).
+
+* head packing (`flash_head_pack`): at head_dim <= 64 the MXU runs
+  half-width (a d-64 contraction pads to the 128-deep systolic array),
+  so d64 wall time equals d128's with half the useful FLOPs banked
+  (16.46% vs 32.99% MFU at seq 32k).  With packing, TWO (batch, head)
+  rows ride in each grid step (block leading dim 2, grid dim 0 halved):
+  the two heads are independent MXU/VPU dependency chains inside one
+  step, so the Mosaic scheduler can overlap head A's VPU softmax with
+  head B's matmuls instead of serializing them across grid steps (the
+  (m, l, acc) carry forces sequential KV steps per head).  Requires an
+  even B*H; odd products fall back to one head per step.
 """
 
 from __future__ import annotations
@@ -35,6 +63,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 _MIN_LANES = 128  # TPU vector lane count; m/l scratch padded to this
+_F32_SUBLANES = 8  # f32 min sublane tile — gates the packed-stats block
 
 # jax renamed TPUCompilerParams -> CompilerParams around 0.5; support
 # both so the kernels lower under the CI jax as well as the chip
@@ -67,12 +96,93 @@ def _plain_attention(q, k, v, causal, scale):
 
 
 # ---------------------------------------------------------------------------
+# layout-variant gates + in-kernel row-stats relayout
+# ---------------------------------------------------------------------------
+
+def _packed_geom_ok(bq):
+    """The packed [T/128, 128] row-stats block is (bq/128, 128): Mosaic
+    requires the last two block dims to be (8k, 128m) for f32, so the
+    packing is legal only when bq/128 >= 8 -> bq >= 1024."""
+    return bq % _MIN_LANES == 0 and bq // _MIN_LANES >= _F32_SUBLANES
+
+
+def _head_pack_geom_ok(bh, d):
+    """Two heads per block: only profitable when the MXU runs
+    half-width (d <= 64) and only legal when B*H pairs up evenly.
+    Pairing is over the flattened B*H axis — any two rows are
+    independent attention problems, so crossing a batch boundary is
+    fine."""
+    return d <= 64 and bh % 2 == 0
+
+
+def _resolve_variants(packed_stats, head_pack):
+    """None -> the typed flags; explicit bools win (tests, ring/Ulysses
+    chunk dispatch)."""
+    from paddle_tpu.flags import get_flag
+
+    if packed_stats is None:
+        packed_stats = get_flag("flash_packed_stats") == "on"
+    if head_pack is None:
+        head_pack = get_flag("flash_head_pack") == "on"
+    return bool(packed_stats), bool(head_pack)
+
+
+def _relayout_how():
+    from paddle_tpu.flags import get_flag
+
+    return get_flag("flash_relayout")
+
+
+def _rows_to_packed(rows, bq):
+    """Per-row vector [bq] -> packed [bq/128, 128] (row r -> (r//128,
+    r%128)).  'reshape' lowers under Mosaic on jax 0.4.37 (verified via
+    the cross-lowering gate); 'dot' is the guaranteed-lowerable escape
+    hatch — iota/compare/select plus one indicator matmul (bq^2 MACs,
+    once per q-block finalize, negligible)."""
+    if _relayout_how() == "dot":
+        rows_repl = jnp.broadcast_to(rows[:, None], (bq, _MIN_LANES))
+        r = lax.broadcasted_iota(jnp.int32, (bq, _MIN_LANES), 0)
+        c = lax.broadcasted_iota(jnp.int32, (bq, _MIN_LANES), 1)
+        sel = jnp.where((r % _MIN_LANES) == c, rows_repl, 0.0)
+        gi = lax.broadcasted_iota(jnp.int32, (bq // _MIN_LANES, bq), 0)
+        gr = lax.broadcasted_iota(jnp.int32, (bq // _MIN_LANES, bq), 1)
+        ind = ((gr // _MIN_LANES) == gi).astype(jnp.float32)
+        return lax.dot_general(ind, sel, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    return rows.reshape(bq // _MIN_LANES, _MIN_LANES)
+
+
+def _packed_to_rows(packed, bq):
+    """Packed [bq/128, 128] -> per-row vector [bq] (inverse of
+    _rows_to_packed; same strategy flag)."""
+    if _relayout_how() == "dot":
+        gr = lax.broadcasted_iota(jnp.int32, (bq, bq // _MIN_LANES), 0)
+        gi = lax.broadcasted_iota(jnp.int32, (bq, bq // _MIN_LANES), 1)
+        ind = ((gr // _MIN_LANES) == gi).astype(jnp.float32)
+        u = lax.dot_general(ind, packed, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        r = lax.broadcasted_iota(jnp.int32, (bq, _MIN_LANES), 0)
+        c = lax.broadcasted_iota(jnp.int32, (bq, _MIN_LANES), 1)
+        return jnp.sum(jnp.where((r % _MIN_LANES) == c, u, 0.0), axis=1)
+    return packed.reshape(bq)
+
+
+def _stat_rows(ref, h, block_q, packed):
+    """Per-row stats vector [bq] for head-slot h from a backward stats
+    input block: [hpb, bq, 128] lane-replicated (read lane 0) or packed
+    [hpb, bq/128, 128]."""
+    if packed:
+        return _packed_to_rows(ref[h], block_q)
+    return ref[h, :, 0]
+
+
+# ---------------------------------------------------------------------------
 # pallas forward kernel
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
                 l_ref, *, scale, causal, block_q, block_k, kv_len,
-                q_off):
+                q_off, packed, hpb):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -98,11 +208,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         interior &= (ki * block_k + block_k - 1) <= (q_off + qi * block_q)
 
     def _accumulate(masked):
-        q = q_ref[0]                      # [bq, d]
-        k = k_ref[0]                      # [bk, d]
-        v = v_ref[0]
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+        # the mask depends only on (qi, ki) geometry — one per step,
+        # shared by every packed head
+        mask = None
         if masked:
             kpos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -111,22 +219,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
                 qpos = q_off + qi * block_q + lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0)
                 mask = mask & (qpos >= kpos)
-            s = jnp.where(mask, s, _NEG_INF)
-        m_prev = m_ref[:, 0]
-        m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_next[:, None])
-        if masked:
-            # explicit zero for masked entries: a fully-masked row would
-            # otherwise see exp(-1e30 - (-1e30)) = 1 and accumulate
-            # garbage
-            p = jnp.where(mask, p, 0.0)
-        alpha = jnp.exp(m_prev - m_next)
-        l_next = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
-        acc_ref[...] = acc_ref[...] * alpha[:, None] + lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[...] = jnp.broadcast_to(m_next[:, None], m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_next[:, None], l_ref.shape)
+        # the heads are independent dependency chains — the scheduler
+        # interleaves their MXU and VPU work within the step (the whole
+        # point of hpb=2 at d<=64)
+        for h in range(hpb):
+            q = q_ref[h]                  # [bq, d]
+            k = k_ref[h]                  # [bk, d]
+            v = v_ref[h]
+            s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            if masked:
+                s = jnp.where(mask, s, _NEG_INF)
+            m_prev = m_ref[h, :, 0]
+            m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_next[:, None])
+            if masked:
+                # explicit zero for masked entries: a fully-masked row
+                # would otherwise see exp(-1e30 - (-1e30)) = 1 and
+                # accumulate garbage
+                p = jnp.where(mask, p, 0.0)
+            alpha = jnp.exp(m_prev - m_next)
+            l_next = l_ref[h, :, 0] * alpha + jnp.sum(p, axis=-1)
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[h] = jnp.broadcast_to(m_next[:, None],
+                                        m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_next[:, None],
+                                        l_ref.shape[1:])
 
     @pl.when(run & interior)
     def _compute_fast():
@@ -138,18 +258,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = l_ref[:, 0]
-        l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows -> 0 output
-        o_ref[0, ...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
-        # log-sum-exp per row, consumed by the backward kernels; for a
-        # fully-masked row m=-inf and l was clamped to 1 -> lse=-inf,
-        # whose exp(s - lse) entries are all masked off in backward.
-        # Stored lane-replicated ([bq, 128]): Mosaic requires the last
-        # two block dims to be (8k, 128m) or full — a [1, bq] block is
-        # rejected by the TPU lowering (caught on the first real-chip
-        # bench run; interpret-mode tests never enforce tiling).
-        lse = (m_ref[:, 0] + jnp.log(l))[:, None]
-        lse_ref[0, ...] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        for h in range(hpb):
+            l = l_ref[h, :, 0]
+            l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 out
+            o_ref[h, ...] = (acc_ref[h] / l[:, None]).astype(o_ref.dtype)
+            # log-sum-exp per row, consumed by the backward kernels; for
+            # a fully-masked row m=-inf and l was clamped to 1 ->
+            # lse=-inf, whose exp(s - lse) entries are all masked off in
+            # backward.
+            rows = m_ref[h, :, 0] + jnp.log(l)
+            if packed:
+                # packed [bq/128, 128] block (row r -> (r//128, r%128)):
+                # 128x less HBM than the replicated layout; legal only
+                # for bq >= 1024 (f32 (8,128) sublane rule)
+                lse_ref[h, ...] = _rows_to_packed(rows, block_q)
+            else:
+                # lane-replicated ([bq, 128]): Mosaic requires the last
+                # two block dims to be (8k, 128m) or full — a [1, bq]
+                # block is rejected by the TPU lowering (caught on the
+                # first real-chip bench run; interpret-mode tests never
+                # enforce tiling)
+                lse_ref[h, ...] = jnp.broadcast_to(rows[:, None],
+                                                   lse_ref.shape[1:])
 
 
 def _pad_axis(x, axis, mult):
@@ -163,8 +293,9 @@ def _pad_axis(x, axis, mult):
 
 
 def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                      interpret=False):
-    """q/k/v: [B, H, T, D] -> [B, H, Tq, D]."""
+                      interpret=False, packed_stats=False,
+                      head_pack=False):
+    """q/k/v: [B, H, T, D] -> ([B, H, Tq, D], lse [B*H, Tq_padded])."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bq = min(block_q, max(tq, 8))
@@ -173,44 +304,53 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     kp = _pad_axis(k.reshape(b * h, tk, d), 1, bk)
     vp = _pad_axis(v.reshape(b * h, tk, d), 1, bk)
     tq_p, tk_p = qp.shape[1], kp.shape[1]
-    grid = (b * h, tq_p // bq, tk_p // bk)
+    packed = packed_stats and _packed_geom_ok(bq)
+    hpb = 2 if (head_pack and _head_pack_geom_ok(b * h, d)) else 1
+    grid = (b * h // hpb, tq_p // bq, tk_p // bk)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        kv_len=tk, q_off=tk - tq if causal else 0)
+        kv_len=tk, q_off=tk - tq if causal else 0, packed=packed,
+        hpb=hpb)
     params = {}
     if not interpret:
         params["compiler_params"] = _CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if packed:
+        lse_shape = (b * h, tq_p // _MIN_LANES, _MIN_LANES)
+        lse_block = (hpb, bq // _MIN_LANES, _MIN_LANES)
+    else:
+        lse_shape = (b * h, tq_p, _MIN_LANES)
+        lse_block = (hpb, bq, _MIN_LANES)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((hpb, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((hpb, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((hpb, bk, d), lambda bh, i, j: (bh, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq, _MIN_LANES),
-                         lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((hpb, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec(lse_block, lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, tq_p, _MIN_LANES),
-                                 jnp.float32),
+            jax.ShapeDtypeStruct(lse_shape, jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, _MIN_LANES), jnp.float32),
-            pltpu.VMEM((bq, _MIN_LANES), jnp.float32),
+            pltpu.VMEM((hpb, bq, d), jnp.float32),
+            pltpu.VMEM((hpb, bq, _MIN_LANES), jnp.float32),
+            pltpu.VMEM((hpb, bq, _MIN_LANES), jnp.float32),
         ],
         interpret=interpret,
         **params,
     )(qp, kp, vp)
-    # strip the lane replication at the XLA boundary: callers see the
-    # documented [B*H, Tq_padded] lse
-    return (out[:, :tq, :].reshape(b, h, tq, d), lse[:, :, 0])
+    # callers see the documented [B*H, Tq_padded] lse in EVERY layout:
+    # packed unpacks with a free row-major reshape at the XLA boundary,
+    # replicated strips the lanes
+    lse2 = lse.reshape(b * h, tq_p) if packed else lse[:, :, 0]
+    return (out[:, :tq, :].reshape(b, h, tq, d), lse2)
 
 
 # ---------------------------------------------------------------------------
@@ -265,7 +405,7 @@ def _bwd_p_ds_block(q, k, v, do, lse, delta, *, scale, causal,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, acc_ref, *, scale, causal, block_q,
-                   block_k, kv_len, q_len, q_off):
+                   block_k, kv_len, q_len, q_off, packed, hpb):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -283,17 +423,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                              q_len=q_len, q_off=q_off, qi=qi, ki=ki)
 
     def _accumulate(masked):
-        q, k, v = q_ref[0], k_ref[0], v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
-        _, ds = _bwd_p_ds_block(
-            q, k, v, do, lse_ref[0, :, 0], delta_ref[0, :, 0],
-            scale=scale,
-            causal=causal, block_q=block_q, block_k=block_k,
-            kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki,
-            masked=masked)
-        acc_ref[...] += lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        for h in range(hpb):
+            q, k, v = q_ref[h], k_ref[h], v_ref[h]
+            do = do_ref[h].astype(jnp.float32)
+            _, ds = _bwd_p_ds_block(
+                q, k, v, do,
+                _stat_rows(lse_ref, h, block_q, packed),
+                _stat_rows(delta_ref, h, block_q, packed),
+                scale=scale,
+                causal=causal, block_q=block_q, block_k=block_k,
+                kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki,
+                masked=masked)
+            acc_ref[h] += lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
     @pl.when(run & interior)
     def _compute_fast():
@@ -305,12 +448,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        dq_ref[0, ...] = acc_ref[...].astype(dq_ref.dtype)
+        for h in range(hpb):
+            dq_ref[h, ...] = acc_ref[h].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, kv_len, q_len, q_off):
+                    block_q, block_k, kv_len, q_len, q_off, packed,
+                    hpb):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -330,20 +475,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                              q_len=q_len, q_off=q_off, qi=qi, ki=ki)
 
     def _accumulate(masked):
-        q, k, v = q_ref[0], k_ref[0], v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
-        p, ds = _bwd_p_ds_block(
-            q, k, v, do, lse_ref[0, :, 0], delta_ref[0, :, 0],
-            scale=scale,
-            causal=causal, block_q=block_q, block_k=block_k,
-            kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki,
-            masked=masked)
-        dv_acc[...] += lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dk_acc[...] += lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        for h in range(hpb):
+            q, k, v = q_ref[h], k_ref[h], v_ref[h]
+            do = do_ref[h].astype(jnp.float32)
+            p, ds = _bwd_p_ds_block(
+                q, k, v, do,
+                _stat_rows(lse_ref, h, block_q, packed),
+                _stat_rows(delta_ref, h, block_q, packed),
+                scale=scale,
+                causal=causal, block_q=block_q, block_k=block_k,
+                kv_len=kv_len, q_len=q_len, q_off=q_off, qi=qi, ki=ki,
+                masked=masked)
+            dv_acc[h] += lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_acc[h] += lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
     @pl.when(run & interior)
     def _compute_fast():
@@ -355,18 +503,26 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        dk_ref[0, ...] = dk_acc[...].astype(dk_ref.dtype)
-        dv_ref[0, ...] = dv_acc[...].astype(dv_ref.dtype)
+        for h in range(hpb):
+            dk_ref[h, ...] = dk_acc[h].astype(dk_ref.dtype)
+            dv_ref[h, ...] = dv_acc[h].astype(dv_ref.dtype)
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
-                      block_k, interpret=False, dlse=None):
+                      block_k, interpret=False, dlse=None,
+                      packed_stats=False, head_pack=False):
     """q/k/v: [B, H, T, D]; lse: [B*H, Tq_padded]; g = dO.
 
     dlse ([B*H, Tq] or None): cotangent of the lse output when the
     caller consumes it (ring attention's cross-chunk merge).  Since
     d lse_r / d s_rc = p_rc, it folds into the delta term:
     dS = P*(dO V^T - delta) + P*dlse = P*(dO V^T - (delta - dlse)).
+
+    Under the packed-stats layout, lse and delta ride into the kernels
+    as [B*H, Tq_p/128, 128] free reshapes of the per-row vectors; the
+    replicated layout instead materializes TWO 128x lane-broadcasts in
+    HBM as kernel inputs (~8 GB at seq-1M x 8 heads — with the fwd lse
+    the third, the seq-1M OOM).
     """
     b, h, tq, d = q.shape
     tk = k.shape[2]
@@ -377,6 +533,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
     vp = _pad_axis(v.reshape(b * h, tk, d), 1, bk)
     gp = _pad_axis(g.reshape(b * h, tq, d), 1, bq)
     tq_p, tk_p = qp.shape[1], kp.shape[1]
+    packed = packed_stats and _packed_geom_ok(bq)
+    hpb = 2 if (head_pack and _head_pack_geom_ok(b * h, d)) else 1
     # delta = rowsum(dO * O): cheap elementwise+reduce, done in XLA;
     # an lse cotangent subtracts from it (see docstring)
     delta_full = jnp.sum(
@@ -388,53 +546,61 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
         delta_full = delta_full - dlse.reshape(b * h, -1)[:, :tq] \
             .astype(jnp.float32)
     delta = _pad_axis(delta_full, 1, bq)
-    # lane-replicate the per-row vectors: [B*H, Tq_p] -> [B*H, Tq_p, 128]
-    # (2-D [1, bq] blocks violate Mosaic's last-two-dims tiling rule;
-    # same layout the forward kernel emits for lse)
-    lse3 = jnp.broadcast_to(lse[:, :, None],
-                            (b * h, tq_p, _MIN_LANES))
-    delta3 = jnp.broadcast_to(delta[:, :, None],
-                              (b * h, tq_p, _MIN_LANES))
+    if packed:
+        # free row-major reshapes of the [B*H, Tq_p] vectors — nothing
+        # is materialized beyond the vectors themselves
+        lse3 = lse.reshape(b * h, tq_p // _MIN_LANES, _MIN_LANES)
+        delta3 = delta.reshape(b * h, tq_p // _MIN_LANES, _MIN_LANES)
+        lblk = (hpb, bq // _MIN_LANES, _MIN_LANES)
+    else:
+        # lane-replicate the per-row vectors: [B*H, Tq_p] ->
+        # [B*H, Tq_p, 128] (2-D [1, bq] blocks violate Mosaic's
+        # last-two-dims tiling rule; same layout the forward kernel
+        # emits for lse)
+        lse3 = jnp.broadcast_to(lse[:, :, None],
+                                (b * h, tq_p, _MIN_LANES))
+        delta3 = jnp.broadcast_to(delta[:, :, None],
+                                  (b * h, tq_p, _MIN_LANES))
+        lblk = (hpb, bq, _MIN_LANES)
     q_off = tk - tq if causal else 0
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
-                  kv_len=tk, q_len=tq, q_off=q_off)
+                  kv_len=tk, q_len=tq, q_off=q_off, packed=packed,
+                  hpb=hpb)
     params = {}
     if not interpret:
         params["compiler_params"] = _CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
 
-    qspec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
-    lspec = pl.BlockSpec((1, bq, _MIN_LANES),
-                         lambda bh, i, j: (bh, i, 0))
-    kspec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))
+    qspec = pl.BlockSpec((hpb, bq, d), lambda bh, i, j: (bh, i, 0))
+    lspec = pl.BlockSpec(lblk, lambda bh, i, j: (bh, i, 0))
+    kspec = pl.BlockSpec((hpb, bk, d), lambda bh, i, j: (bh, j, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
-        grid=(b * h, tq_p // bq, tk_p // bk),
+        grid=(b * h // hpb, tq_p // bq, tk_p // bk),
         in_specs=[qspec, kspec, kspec, qspec, lspec, lspec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((hpb, bq, d), jnp.float32)],
         interpret=interpret,
         **params,
     )(qp, kp, vp, gp, lse3, delta3)
 
     # dkv grid: kv blocks outer, q blocks inner (accumulator carries
     # across the q sweep); block index maps swap i<->j roles
-    qspec2 = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
-    lspec2 = pl.BlockSpec((1, bq, _MIN_LANES),
-                          lambda bh, j, i: (bh, i, 0))
-    kspec2 = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))
+    qspec2 = pl.BlockSpec((hpb, bq, d), lambda bh, j, i: (bh, i, 0))
+    lspec2 = pl.BlockSpec(lblk, lambda bh, j, i: (bh, i, 0))
+    kspec2 = pl.BlockSpec((hpb, bk, d), lambda bh, j, i: (bh, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
-        grid=(b * h, tk_p // bk, tq_p // bq),
+        grid=(b * h // hpb, tk_p // bk, tq_p // bq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, lspec2, lspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((hpb, bk, d), jnp.float32),
+                        pltpu.VMEM((hpb, bk, d), jnp.float32)],
         interpret=interpret,
         **params,
     )(qp, kp, vp, gp, lse3, delta3)
@@ -447,33 +613,43 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, block_q,
 # public differentiable entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, impl):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, scale, block_q, block_k, impl,
+           packed_stats, head_pack):
     if impl == "pallas":
         return _flash_fwd_pallas(q, k, v, causal, scale, block_q,
-                                 block_k)[0]
+                                 block_k, packed_stats=packed_stats,
+                                 head_pack=head_pack)[0]
     if impl == "interpret":
         return _flash_fwd_pallas(q, k, v, causal, scale, block_q,
-                                 block_k, interpret=True)[0]
+                                 block_k, interpret=True,
+                                 packed_stats=packed_stats,
+                                 head_pack=head_pack)[0]
     return _plain_attention(q, k, v, causal, scale)
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, impl):
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, impl,
+                    packed_stats, head_pack):
     if impl in ("pallas", "interpret"):
         out, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q,
                                      block_k,
-                                     interpret=impl == "interpret")
+                                     interpret=impl == "interpret",
+                                     packed_stats=packed_stats,
+                                     head_pack=head_pack)
         return out, (q, k, v, out, lse)
     out = _plain_attention(q, k, v, causal, scale)
     return out, (q, k, v, None, None)
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, impl, res, g):
+def _flash_bwd_rule(causal, scale, block_q, block_k, impl,
+                    packed_stats, head_pack, res, g):
     q, k, v, o, lse = res
     if impl in ("pallas", "interpret"):
         return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale,
                                  block_q, block_k,
-                                 interpret=impl == "interpret")
+                                 interpret=impl == "interpret",
+                                 packed_stats=packed_stats,
+                                 head_pack=head_pack)
     _, vjp = jax.vjp(
         lambda a, b, c: _plain_attention(a, b, c, causal, scale), q, k, v)
     return vjp(g)
@@ -484,47 +660,60 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 # -- (out, lse) variant: the mergeable summary ring attention needs ----
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret,
+               packed_stats, head_pack):
     return _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                             interpret=interpret)
+                             interpret=interpret,
+                             packed_stats=packed_stats,
+                             head_pack=head_pack)
 
 
 def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k,
-                   interpret):
+                   interpret, packed_stats, head_pack):
     out, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q,
-                                 block_k, interpret=interpret)
+                                 block_k, interpret=interpret,
+                                 packed_stats=packed_stats,
+                                 head_pack=head_pack)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret,
+                   packed_stats, head_pack, res, g):
     q, k, v, o, lse = res
     do, dlse = g
     return _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale,
                              block_q, block_k, interpret=interpret,
-                             dlse=dlse)
+                             dlse=dlse, packed_stats=packed_stats,
+                             head_pack=head_pack)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention_lse(q, k, v, *, causal=False, scale=None,
-                        block_q=None, block_k=None, impl=None):
+                        block_q=None, block_k=None, impl=None,
+                        packed_stats=None, head_pack=None):
     """Like flash_attention but also returns the per-row log-sum-exp
     ([B*H, Tq_padded_to_block]): (out, lse) is a complete mergeable
     attention summary — two chunks combine as
       m = max(lse1, lse2); a_i = exp(lse_i - m)
       out = (out1*a1 + out2*a2) / (a1 + a2); lse = m + log(a1 + a2)
     which is what ring attention accumulates across KV rotations.
-    Differentiable in q, k, v including through lse consumers."""
+    Differentiable in q, k, v including through lse consumers.
+
+    packed_stats/head_pack: None -> the `flash_packed_stats` /
+    `flash_head_pack` flags; explicit bools override.  The returned lse
+    is layout-independent ([B*H, Tq_padded]) in every mode."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if impl is None:
         impl = "pallas" if _on_tpu() else "interpret"
     block_q = block_q or _default_block(q.shape[-2])
     block_k = block_k or _default_block(k.shape[-2])
+    packed_stats, head_pack = _resolve_variants(packed_stats, head_pack)
     return _flash_lse(q, k, v, causal, float(scale), block_q, block_k,
-                      impl == "interpret")
+                      impl == "interpret", packed_stats, head_pack)
 
 
 def _default_block(t):
@@ -539,13 +728,20 @@ def _default_block(t):
 
 
 def flash_attention(q, k, v, *, causal=False, scale=None, block_q=None,
-                    block_k=None, impl=None):
+                    block_k=None, impl=None, packed_stats=None,
+                    head_pack=None):
     """Fused attention. q/k/v: [B, H, T, D]; returns [B, H, Tq, D].
 
     impl: None (auto: pallas on TPU, XLA elsewhere), "pallas",
     "interpret" (pallas interpret mode, for CPU tests), or "xla".
     block_q/block_k default to a size picked by sequence length
     (_default_block).
+
+    packed_stats / head_pack: memory-layout variants (module
+    docstring, docs/FLASH_ATTENTION.md).  None defers to the
+    `flash_packed_stats` / `flash_head_pack` flags (both default off);
+    explicit bools override — outputs are identical in every mode, only
+    the kernel's HBM layout and grid packing change.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -553,7 +749,9 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=None,
         impl = "pallas" if _on_tpu() else "xla"
     block_q = block_q or _default_block(q.shape[-2])
     block_k = block_k or _default_block(k.shape[-2])
-    return _flash(q, k, v, causal, float(scale), block_q, block_k, impl)
+    packed_stats, head_pack = _resolve_variants(packed_stats, head_pack)
+    return _flash(q, k, v, causal, float(scale), block_q, block_k, impl,
+                  packed_stats, head_pack)
 
 
 def _on_tpu():

@@ -195,13 +195,49 @@ LarsMomentumOptimizer = LarsMomentum
 
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, lazy_mode=False, **kwargs):
+                 epsilon=1e-8, lazy_mode=False, fuse=False, **kwargs):
         super().__init__(learning_rate, **kwargs)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._lazy_mode = lazy_mode
+        # fuse=True emits ONE multi-tensor fused_adam op over every
+        # (param, grad) pair instead of a per-param adam op — the
+        # optimizer tail becomes a single elementwise pass over one
+        # concatenated buffer per dtype (ops/optim.py fused_adam; the
+        # transformer batch-slide A/B lever).  Static-graph only:
+        # dygraph's eager hook applies per-param ops and ignores it.
+        self._fuse = fuse
 
     op_type = "adam"
     extra_attrs = {}
+
+    def apply_gradients(self, params_grads):
+        if not (self._fuse and self.op_type == "adam"
+                and params_grads):
+            return super().apply_gradients(params_grads)
+        block = params_grads[0][0].block.program.global_block()
+        self._create_lr_var(block)
+        params_grads = self._append_regularization(block, params_grads)
+        ps = [p for p, _ in params_grads]
+        gs = [g for _, g in params_grads]
+        m1s = [self._add_accumulator("moment1", p) for p in ps]
+        m2s = [self._add_accumulator("moment2", p) for p in ps]
+        # accumulator names match the unfused layout param-for-param
+        # (a checkpoint round-trips between fuse on/off); beta pows are
+        # shared — one schedule, anchored on the first param
+        b1p = self._add_accumulator("beta1_pow", ps[0], self._beta1, [1])
+        b2p = self._add_accumulator("beta2_pow", ps[0], self._beta2, [1])
+        block.append_op(
+            type="fused_adam",
+            inputs={"Param": ps, "Grad": gs, "Moment1": m1s,
+                    "Moment2": m2s, "Beta1Pow": b1p, "Beta2Pow": b2p,
+                    "LearningRate": self._lr_var},
+            outputs={"ParamOut": ps, "Moment1Out": m1s,
+                     "Moment2Out": m2s, "Beta1PowOut": b1p,
+                     "Beta2PowOut": b2p},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+            op_role=OPTIMIZE, infer_shape=False)
+        return []
 
     def _append_optimize_op(self, block, pg):
         p, g = pg

@@ -55,7 +55,8 @@ def _local_causal_bias(q_pos, k_pos):
 
 
 def ring_attention(q, k, v, mesh=None, axis="sp", causal=False,
-                   scale=None, impl=None):
+                   scale=None, impl=None, block_q=None, block_k=None,
+                   packed_stats=None, head_pack=None):
     """Exact attention with sequence sharded over ``axis``.
 
     q/k/v: [B, S, H, D] global arrays (S = full sequence).  Inside jit the
@@ -69,6 +70,14 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False,
     'flash' / 'flash_interpret' (each chunk through the Pallas kernel
     via its (out, lse) mergeable summary — scores stay in VMEM even
     within a chunk, forward and backward).
+
+    block_q/block_k: kernel tile override for the per-chunk flash
+    calls — the chunk length is S/n, not S, so the kernel's
+    seq-length-keyed default can land differently than a whole-seq
+    call's; pin them when sweeping.  packed_stats/head_pack: the flash
+    memory-layout variants (ops/pallas_kernels.py; None defers to the
+    flags) — at ring scale the packed row-stats matter most, since
+    every chunk of every rotation materializes its own lse.
     """
     from paddle_tpu.parallel import env as penv
 
@@ -103,7 +112,10 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=False,
         from paddle_tpu.ops.pallas_kernels import flash_attention_lse
 
         o, lse = flash_attention_lse(qt, kc, vc, causal=chunk_causal,
-                                     scale=scale, impl=flash_impl)
+                                     scale=scale, impl=flash_impl,
+                                     block_q=block_q, block_k=block_k,
+                                     packed_stats=packed_stats,
+                                     head_pack=head_pack)
         b, h, t, _d = qt.shape
         lse = lse[:, :t].reshape(b, h, t).astype(jnp.float32)
         return o.astype(jnp.float32), lse, jnp.ones_like(lse)

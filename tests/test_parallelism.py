@@ -130,6 +130,42 @@ def test_ulysses_flash_impl_matches_plain(causal):
                                    atol=5e-5, err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("which", ["ring", "ulysses"])
+def test_seq_parallel_flash_variant_dispatch(which):
+    """The flash memory-overhaul variants thread through the
+    sequence-parallel dispatch: ring/Ulysses with head_pack=True (two
+    heads per kernel block inside each chunk) and packed_stats=True
+    (falls back to replicated at these chunk sizes — the gate is
+    geometric, not an error) still match plain attention, values and
+    grads."""
+    mesh = _mesh((4,), ("sp",))
+    rng = np.random.RandomState(21)
+    b, s, h, d = 1, 32, 4, 16
+    q, k, v = [rng.randn(b, s, h, d).astype(np.float32)
+               for _ in range(3)]
+    w = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    scale = 1.0 / np.sqrt(d)
+    fn = ring_attention if which == "ring" else ulysses_attention
+
+    def loss_v(q, k, v):
+        return jnp.sum(fn(
+            q, k, v, mesh=mesh, axis="sp", causal=True,
+            impl="flash_interpret", block_q=8, block_k=8,
+            packed_stats=True, head_pack=True) * w)
+
+    def loss_plain(q, k, v):
+        return jnp.sum(_plain_attention(q, k, v, True, scale) * w)
+
+    with jax.default_matmul_precision("float32"):
+        v1, g1 = jax.value_and_grad(loss_v, argnums=(0, 1, 2))(q, k, v)
+        v2, g2 = jax.value_and_grad(loss_plain, argnums=(0, 1, 2))(
+            q, k, v)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-4)
+    for name, a, bq in zip("q k v".split(), g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bq),
+                                   atol=5e-5, err_msg=f"d{name}")
+
+
 def test_ring_attention_gradients_flow():
     mesh = _mesh((4,), ("sp",))
     rng = np.random.RandomState(2)

@@ -59,6 +59,17 @@ TASK_KEYS = {
     "tf_train_mb64": ("transformer_base_train_mb64", None),
     "tf_train_mb128": ("transformer_base_train_mb128", None),
     "tf_train_mb48": ("transformer_base_train_mb48", None),
+    # Adam-tail fused-optimizer A/B (optimizer.py Adam(fuse=True)) —
+    # same workload graph with the optimizer tail as one multi-tensor
+    # op; diagnoses the mb32->mb128 batch slide (VERDICT r5 #6)
+    "tf_train_mb128_fusedadam": (
+        "transformer_base_train_mb128_fusedadam", None),
+    "tf_train_mb32_fusedadam": (
+        "transformer_base_train_mb32_fusedadam", None),
+    # DeepFM roofline re-key (VERDICT r5 #7): same primary key — the
+    # re-banked row carries mfu_pct/hbm_bw_pct so the CTR leg is
+    # judged like the others
+    "dfm_train_roofline": ("deepfm_ctr_train", None),
     "bert_train_mb16": ("bert_base_train_seq512_mb16", None),
     "bert_train_mb24": ("bert_base_train_seq512_mb24", None),
     "bert_train_mb32": ("bert_base_train_seq512_mb32", None),
@@ -98,6 +109,20 @@ TASK_KEYS = {
     "longctx_seq1048576": ("longctx_flash_train_mb1_seq1048576", None),
     "longctx_seq1048576_h4": (
         "longctx_flash_train_mb1_seq1048576_h4", None),
+    # flash memory-overhaul A/B rows (PR-2 head of the queue): the
+    # 32k variants land under shape-tagged keys NEXT TO the banked
+    # plain rows (the re-key rule — a layout flip must never read as
+    # a same-graph perf change), and the 1M rows are new ladder
+    # rungs.  Rows carry packed_stats/head_pack markers for
+    # bench._workload_sig.
+    "longctx_seq32768_hp2": (
+        "longctx_flash_train_mb1_seq32768_hp2", None),
+    "longctx_seq32768_packed": (
+        "longctx_flash_train_mb1_seq32768_packed", None),
+    "longctx_seq1048576_packed": (
+        "longctx_flash_train_mb1_seq1048576_packed", None),
+    "longctx_seq1048576_packed_hp2": (
+        "longctx_flash_train_mb1_seq1048576_packed_hp2", None),
 }
 
 # primary key <- best (by mfu_pct) among these variant keys
@@ -111,7 +136,9 @@ PRIMARY = {
     "transformer_base_train": ["transformer_base_train",
                                "transformer_base_train_mb64",
                                "transformer_base_train_mb128",
-                               "transformer_base_train_mb48"],
+                               "transformer_base_train_mb48",
+                               "transformer_base_train_mb128_fusedadam",
+                               "transformer_base_train_mb32_fusedadam"],
     "bert_base_train_seq512": ["bert_base_train_seq512",
                                "bert_base_train_seq512_mb16",
                                "bert_base_train_seq512_mb24",
@@ -179,6 +206,25 @@ def main(argv=None):
                 base_ms / res["ms_per_batch"], 3)
         art["extras"][key] = res
         banked += 1
+
+    # the CPU-measured int8 accuracy bound (tools/int8_accuracy.py)
+    # rides NEXT TO the int8 latency rows in the artifact — the
+    # reference publishes accuracy alongside throughput, so the banked
+    # record should too (VERDICT r5 next-round #4, accuracy half).
+    # Not a chip row: provenance is explicit in the record itself.
+    acc_path = os.path.join(REPO, "docs",
+                            "int8_accuracy_rn32cifar.json")
+    if os.path.exists(acc_path):
+        try:
+            with open(acc_path) as f:
+                acc = json.load(f)
+            acc["degraded"] = False
+            acc["provenance_note"] = ("CPU/interpret-mode harness "
+                                      "(tools/int8_accuracy.py), not "
+                                      "an on-chip measurement")
+            art["extras"]["resnet32_cifar10_int8_top1_accuracy"] = acc
+        except ValueError:
+            pass
 
     # promote best variants to primary keys
     for prim, variants in PRIMARY.items():

@@ -33,14 +33,37 @@ BENCH = os.path.join(REPO, "bench.py")
 # sweep and anchors sit at the tail for fresh-results-file runs; int8
 # (the known 2026-07-31 tunnel-wedger) stays last.
 TASKS = [
-    # ---- ROUND-6 HEAD: the fused conv-epilogue A/B (VERDICT r5
-    # next-round #1 — the one unmet north-star number).  The pair
-    # banks FIRST in any window: baseline rn_train re-run under
-    # current code, then the same workload with every conv routed
-    # through the Pallas fused kernel (ops/pallas_conv.py,
-    # flag conv_epilogue=on).  Target: >=40% MFU (stretch 50) on the
-    # resnet50_train row; bank_onchip promotes the best variant to
-    # the primary key automatically.
+    # ---- PR-2 HEAD: flash memory-overhaul A/B legs (VERDICT r5
+    # next-round #2/#3; ISSUE 2 acceptance).  All behind default-off
+    # flags validated bit-parity in interpret mode + Mosaic
+    # cross-lowering; these rows are the on-chip half of the evidence.
+    # (1) d64 @32k head-packed vs the banked 16.46% plain row —
+    # expectation >=25% MFU (two heads per grid block fill the
+    # half-idle MXU/VPU bubble; d128 banks 32.99% at the same wall)
+    ("longctx_seq32768_hp2", "longctx",
+     {"head_pack": True, "chain": 10}),
+    # (2) packed row-stats at 32k: the no-regression guard for the
+    # layout flip (same workload as the banked 1024x1024 row)
+    ("longctx_seq32768_packed", "longctx",
+     {"packed_stats": True, "chain": 10}),
+    # (3) THE ladder unlock: seq-1M x 8 heads, which OOMed on ~12 GB
+    # of lane-replicated row-stats (fwd lse + bwd lse3/delta3); the
+    # packed layout cuts that to ~96 MB.  Expectation: compiles and
+    # banks a no-OOM row (QKV+grads ~8 GB of 16 GB HBM)
+    ("longctx_seq1048576_packed", "longctx",
+     {"seq": 1048576, "packed_stats": True, "chain": 1}, 3600),
+    # (4) packed + head-packed together at 1M: the full overhaul
+    # (d64 rate + packed stats) — the ladder's new top rung
+    ("longctx_seq1048576_packed_hp2", "longctx",
+     {"seq": 1048576, "packed_stats": True, "head_pack": True,
+      "chain": 1}, 3600),
+    # ---- ROUND-6: the fused conv-epilogue A/B (VERDICT r5
+    # next-round #1 — the one unmet north-star number): baseline
+    # rn_train re-run under current code, then the same workload with
+    # every conv routed through the Pallas fused kernel
+    # (ops/pallas_conv.py, flag conv_epilogue=on).  Target: >=40% MFU
+    # (stretch 50) on the resnet50_train row; bank_onchip promotes the
+    # best variant to the primary key automatically.
     ("rn_train_mb128_convep", "rn_train_convep",
      {"batch": 128, "chain": 20}),
     # int8/inference side of the same kernel: after the conv-bn fold
@@ -50,6 +73,26 @@ TASKS = [
     # itself (BN batch stats sit between conv and the residual add)
     ("rn_infer_mb128_convep", "infer",
      {"batch": 128, "chain": 60, "conv_epilogue": True}),
+    # ---- transformer batch-slide diagnosis (VERDICT r5 next-round
+    # #6: 50.17% @mb32 -> 42.02% @mb128 with no banked explanation).
+    # The un-probed interior batch points plus the Adam-tail
+    # fused-optimizer A/B deferred in PROFILE_r4 §5.3: ONE multi-
+    # tensor fused_adam op (optimizer.py Adam(fuse=True)) vs ~100
+    # per-param adam kernels at the step tail.  If mb128's slide is
+    # optimizer-tail scheduling, the fused row recovers points; if
+    # it's flat, the tail is exonerated and the roofline moves to the
+    # attention/FFN body.
+    ("tf_train_mb48", "tf_train", {"batch": 48, "chain": 15}),
+    ("bert_train_mb32", "bert_train", {"batch": 32, "chain": 10}),
+    ("tf_train_mb128_fusedadam", "tf_train",
+     {"batch": 128, "chain": 10, "fused_adam": True}),
+    ("tf_train_mb32_fusedadam", "tf_train",
+     {"batch": 32, "chain": 15, "fused_adam": True}),
+    # DeepFM re-key (VERDICT r5 next-round #7): the leg now computes
+    # its own roofline context (analytic MFU + achieved-vs-peak HBM
+    # BW% from compiled bytes-accessed) — re-bank the 252k ex/s row
+    # with the bound attached
+    ("dfm_train_roofline", "dfm_train", {"chain": 20}),
     # ---- 2026-08-01 afternoon reorder: the morning window banked the
     # rn50 batch sweep (mb256/mb512/s2d), the tf/bert/vgg anchors, and
     # profile_resnet; those tasks are pre-seeded done in the results
@@ -109,6 +152,14 @@ TASKS = [
     # decompose the 49.7 ms step again now one-pass BN is the default
     # (the 9.3 ms bn_global delta was measured against two-pass stats)
     ("rn50_ablate_v2", "script:tools/rn50_ablate.py", {}, 1800),
+    # block optima for the overhaul variants (the 1024x1024 default
+    # was pinned on the UNPACKED kernel; hp2 doubles per-step VMEM)
+    ("flash_block_sweep_hp2",
+     "script:tools/flash_block_sweep.py --shape longctx_hp2", {},
+     1800),
+    ("flash_block_sweep_packed",
+     "script:tools/flash_block_sweep.py --shape longctx_packed", {},
+     1800),
     # block probes past 1024x1024 and the d128 optimum
     ("flash_block_sweep_big",
      "script:tools/flash_block_sweep.py --shape longctx_big", {},
@@ -116,11 +167,8 @@ TASKS = [
     ("flash_block_sweep_d128",
      "script:tools/flash_block_sweep.py --shape longctx_d128", {},
      1800),
-    # un-probed interior batch points: bert peaked at the mb24 edge
-    # (43.72 @16 -> 46.23 @24), tf peaked between 32 (50.17) and 64
-    # (48.41)
-    ("bert_train_mb32", "bert_train", {"batch": 32, "chain": 10}),
-    ("tf_train_mb48", "tf_train", {"batch": 48, "chain": 15}),
+    # (bert mb32 / tf mb48 interior batch points moved up into the
+    # batch-slide diagnosis block with the fused-adam A/B)
     # v2: on-device fori_loop timing (the host-loop snapshot timed the
     # ~3.5 ms tunnel dispatch, not the ops)
     ("op_bench_tpu_snapshot_v2",

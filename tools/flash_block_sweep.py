@@ -101,6 +101,22 @@ def main():
                  causal=True,
                  combos=[(512, 1024), (1024, 1024), (1024, 2048),
                          (2048, 1024)]),
+            # flash memory-overhaul variants (ops/pallas_kernels.py):
+            # the 1024x1024 default was pinned on the UNPACKED kernel;
+            # head packing doubles per-step VMEM (two heads of q/k/v +
+            # two score blocks), so its optimum may sit at smaller
+            # tiles — probe around the default before trusting the
+            # d64 A/B verdict
+            dict(name="longctx_hp2", b=1, h=8, t=32768, d=64,
+                 causal=True, kw=dict(head_pack=True),
+                 combos=[(512, 512), (512, 1024), (1024, 1024),
+                         (1024, 2048)]),
+            # packed row-stats only gates ON at bq >= 1024 — sweep
+            # the legal range (2048 halves the relayout count/step)
+            dict(name="longctx_packed", b=1, h=8, t=32768, d=64,
+                 causal=True, kw=dict(packed_stats=True),
+                 combos=[(1024, 1024), (1024, 2048), (2048, 1024),
+                         (2048, 2048)]),
         ]
         if only:
             shapes = [s for s in shapes if s["name"] == only]
@@ -115,6 +131,7 @@ def main():
         n_good = 0
         q = jax.random.normal(
             key, (s["b"], s["h"], s["t"], s["d"]), jnp.bfloat16)
+        kw = s.get("kw", {})
         for bq, bk in s["combos"]:
             if bq > s["t"] or bk > s["t"]:
                 continue
@@ -122,13 +139,13 @@ def main():
                 fwd = jax.jit(lambda q, k, v, bq=bq, bk=bk:
                               flash_attention(q, k, v, causal=s["causal"],
                                               block_q=bq, block_k=bk,
-                                              impl=impl))
+                                              impl=impl, **kw))
                 ms_f = time_fn(fwd, q, q, q)
 
                 def loss(qq, kk, vv, bq=bq, bk=bk):
                     return flash_attention(
                         qq, kk, vv, causal=s["causal"], block_q=bq,
-                        block_k=bk, impl=impl).astype(
+                        block_k=bk, impl=impl, **kw).astype(
                         jnp.float32).sum()
 
                 gfn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
@@ -136,6 +153,7 @@ def main():
                 ms_fb = time_fn(gfn, q, q, q, pick=lambda o: o[0])
                 print(json.dumps({
                     "shape": s["name"], "block_q": bq, "block_k": bk,
+                    **{k: v for k, v in kw.items() if v},
                     "fwd_ms": round(ms_f, 3),
                     "fwd_bwd_ms": round(ms_fb, 3)}), flush=True)
                 n_good += 1

@@ -57,6 +57,28 @@ def _workloads():
             128, conv_epilogue=True)[:3],
         "resnet50_infer_convep": lambda: _infer(
             bench, "resnet", 128, conv_epilogue=True),
+        # flash memory-overhaul variants (ops/pallas_kernels.py): the
+        # packed (bq/128, 128) row-stats block and the in-kernel
+        # (bq,)<->(bq/128, 128) relayout are EXACTLY the construct
+        # class Mosaic may reject while interpret mode stays green —
+        # the ISSUE's stated risk; these must cross-lower BEFORE the
+        # chaser spends a window on the A/B legs (the strided-slice
+        # lesson from the convep round).  seq 4096 keeps the build
+        # fast while block_q=1024 makes the packed gate real.
+        "longctx_train_packed": lambda: bench._build_longctx_train(
+            1, 8, 4096, 64, block_q=1024, block_k=1024,
+            packed_stats=True)[:3],
+        "longctx_train_hp2": lambda: bench._build_longctx_train(
+            1, 8, 4096, 64, block_q=1024, block_k=1024,
+            head_pack=True)[:3],
+        "longctx_train_packed_hp2": lambda: bench._build_longctx_train(
+            1, 8, 4096, 64, block_q=1024, block_k=1024,
+            packed_stats=True, head_pack=True)[:3],
+        # the fused multi-tensor Adam tail (optimizer.py
+        # Adam(fuse=True)): concat/split over every param must lower
+        # for tpu before the batch-slide A/B leg runs
+        "transformer_train_fusedadam": lambda:
+            bench._build_transformer_train(8, 512, fused_adam=True)[:3],
         "bert_train": lambda: bench._build_bert_train(8, 512)[:3],
         "deepfm_train": lambda: bench._build_deepfm_train(2048)[:3],
         "resnet50_infer_int8": lambda:
@@ -130,6 +152,12 @@ def check_workload(name, build):
 
     orig = pk._on_tpu
     pk._on_tpu = lambda: True
+    # flag hygiene: variant builds (packed/hp2) set process-global
+    # flags; reset to defaults so a variant workload can never leak
+    # its layout into the next build's trace
+    from paddle_tpu.flags import set_flags
+
+    set_flags({"flash_packed_stats": "off", "flash_head_pack": "off"})
     try:
         fn, state, feed = build()
         export.export(fn, platforms=("tpu",))(state, feed)
