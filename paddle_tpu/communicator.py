@@ -7,12 +7,23 @@ Reference parity:
     /root/reference/paddle/fluid/operators/distributed/communicator.h:160-184
   - python wrapper: python/paddle/fluid/communicator.py
 
-The trainer pushes grads with put() (non-blocking); the send thread
-merges up to max_merge_var_num queued grads per var (mean) and ships
-their sections to the pservers; the recv thread refreshes params into
-the given scope every recv_interval.  Decouples compute from comm the
-same way the reference's fully-async mode does (staleness semantics
-included).
+The trainer pushes grads with put() (non-blocking up to the queue
+bound); the send thread merges up to max_merge_var_num queued grads per
+var (mean) and ships their sections to the pservers; the recv thread
+refreshes params into the given scope every recv_interval.  Decouples
+compute from comm the same way the reference's fully-async mode does
+(staleness semantics included).
+
+Failure semantics (the reference's C++ threads log-and-die; ours must
+survive unattended runs):
+  - the send/recv loops run under a guard that reports any escaped
+    exception into an error queue (errors()) instead of dying silently;
+  - a supervisor thread restarts a dead worker with exponential backoff
+    (a transient pserver outage costs restarts, not the job);
+  - per-var queues are BOUNDED (backpressure: a producer outrunning a
+    wedged sender blocks in put() instead of growing without bound);
+  - stop() drains every queued grad to the pservers before returning,
+    so a short job's last updates are never abandoned.
 """
 
 from __future__ import annotations
@@ -30,44 +41,101 @@ from paddle_tpu.distributed.rpc import global_rpc_client
 
 class Communicator:
     def __init__(self, transpiler, scope, max_merge_var_num=20,
-                 send_wait_times=0.005, recv_interval=0.02):
+                 send_wait_times=0.005, recv_interval=0.02,
+                 max_queue_per_var=0, restart_backoff=0.1):
         """transpiler: a transpiled DistributeTranspiler (source of the
-        section plan); scope: where received params land."""
+        section plan); scope: where received params land.
+        max_queue_per_var: put() backpressure bound (0 -> 8x
+        max_merge_var_num); restart_backoff: first supervisor restart
+        delay (doubles per consecutive restart, capped at 2s)."""
         self._t = transpiler
         self._scope = scope
         self._max_merge = max_merge_var_num
         self._send_wait = send_wait_times
         self._recv_interval = recv_interval
-        self._queues = {g: queue.Queue()
+        self._max_queue = int(max_queue_per_var) or 8 * max_merge_var_num
+        self._restart_backoff = float(restart_backoff)
+        self._queues = {g: queue.Queue(maxsize=self._max_queue)
                         for g in (transpiler.grad_of[p]
                                   for p in transpiler.param_plan)}
         self._grad_to_param = {g: p
                                for p, g in transpiler.grad_of.items()}
         self._running = False
-        self._threads = []
+        self._threads: dict = {}        # name -> Thread (send/recv)
+        self._supervisor = None
+        self._errors = queue.Queue()    # (thread_name, exception)
+        self._error_log = []            # drained copy, errors() returns it
+        self._restarts = {"send": 0, "recv": 0}
 
     # -- trainer-facing -----------------------------------------------------
-    def put(self, grad_name, value):
+    def put(self, grad_name, value, block=True, timeout=None):
+        """Queue a grad for the send thread.  Blocks when the per-var
+        queue is full (backpressure) unless block=False (raises
+        queue.Full)."""
         q = self._queues.get(grad_name)
         if q is None:
             raise KeyError(f"Communicator: unknown grad '{grad_name}'")
-        q.put(np.asarray(value))
+        q.put(np.asarray(value), block=block, timeout=timeout)
 
     def start(self):
         self._running = True
-        for fn in (self._send_loop, self._recv_loop):
-            th = threading.Thread(target=fn, daemon=True)
-            th.start()
-            self._threads.append(th)
+        self._spawn("send", self._send_loop)
+        self._spawn("recv", self._recv_loop)
+        self._supervisor = threading.Thread(target=self._supervise,
+                                            daemon=True)
+        self._supervisor.start()
         return self
 
     def stop(self):
         self._running = False
-        for th in self._threads:
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5.0)
+        for th in self._threads.values():
             th.join(timeout=5.0)
         self._flush()
 
+    def errors(self):
+        """Every exception a worker thread reported (name, exc), oldest
+        first; empty when the communicator has been healthy."""
+        while True:
+            try:
+                self._error_log.append(self._errors.get_nowait())
+            except queue.Empty:
+                break
+        return list(self._error_log)
+
+    def restarts(self):
+        return dict(self._restarts)
+
     # -- internals ----------------------------------------------------------
+    def _spawn(self, name, fn):
+        def guarded():
+            try:
+                fn()
+            except Exception as e:   # report, never die silently
+                self._errors.put((name, e))
+
+        th = threading.Thread(target=guarded, daemon=True)
+        th.start()
+        self._threads[name] = th
+
+    def _supervise(self):
+        """Restart dead workers with exponential backoff while running
+        (reference contrast: a dead C++ SendThread ends the job)."""
+        loops = {"send": self._send_loop, "recv": self._recv_loop}
+        while self._running:
+            for name, fn in loops.items():
+                th = self._threads.get(name)
+                if th is not None and not th.is_alive() and self._running:
+                    n = self._restarts[name]
+                    delay = min(self._restart_backoff * (2 ** n), 2.0)
+                    time.sleep(delay)
+                    if not self._running:
+                        return
+                    self._restarts[name] = n + 1
+                    self._spawn(name, fn)
+            time.sleep(0.05)
+
     def _drain(self, q):
         vals = []
         while len(vals) < self._max_merge:
@@ -76,6 +144,10 @@ class Communicator:
             except queue.Empty:
                 break
         return vals
+
+    def _merge(self, vals):
+        return vals[0] if len(vals) == 1 else \
+            np.mean(np.stack(vals), axis=0)
 
     def _send_grad(self, gname, merged):
         client = global_rpc_client()
@@ -89,12 +161,22 @@ class Communicator:
                             trainer_idx=int(self._t.trainer_id))
 
     def _flush(self):
+        """Drain EVERY queued grad (not just one merge window per var):
+        short jobs stop() right after their last put(), and abandoning
+        the tail silently loses updates the pserver never saw."""
         for gname, q in self._queues.items():
-            vals = self._drain(q)
-            if vals:
-                merged = vals[0] if len(vals) == 1 else \
-                    np.mean(np.stack(vals), axis=0)
-                self._send_grad(gname, merged)
+            while True:
+                vals = self._drain(q)
+                if not vals:
+                    break
+                try:
+                    self._send_grad(gname, self._merge(vals))
+                except Exception as e:
+                    # endpoint gone at shutdown: record, stop trying
+                    # this var (the remaining items would fail the same
+                    # way), keep flushing the others
+                    self._errors.put(("flush", e))
+                    break
 
     def _send_loop(self):
         while self._running:
@@ -103,9 +185,17 @@ class Communicator:
                 vals = self._drain(q)
                 if not vals:
                     continue
-                merged = vals[0] if len(vals) == 1 else \
-                    np.mean(np.stack(vals), axis=0)
-                self._send_grad(gname, merged)
+                try:
+                    self._send_grad(gname, self._merge(vals))
+                except Exception:
+                    # requeue before dying: the supervisor restarts the
+                    # loop and these updates ship late instead of never
+                    for v in vals:
+                        try:
+                            q.put_nowait(v)
+                        except queue.Full:
+                            break
+                    raise
                 sent_any = True
             if not sent_any:
                 time.sleep(self._send_wait)

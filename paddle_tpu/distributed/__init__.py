@@ -10,4 +10,9 @@ completion, checkpoint notify — served by the socket RPC layer here
 (rpc.py), the moral equivalent of grpc_client.h/grpc_server.h.
 """
 
-from paddle_tpu.distributed.rpc import RPCClient, RPCServer  # noqa: F401
+from paddle_tpu.distributed.elastic import ElasticTrainer  # noqa: F401
+from paddle_tpu.distributed.faultinject import (FaultInjector,  # noqa: F401
+                                                FaultPlan)
+from paddle_tpu.distributed.rpc import (BarrierTimeoutError,  # noqa: F401
+                                        CircuitOpenError, RPCClient,
+                                        RPCDeadlineExceeded, RPCServer)

@@ -1,0 +1,147 @@
+"""Crash-resume elasticity for (PS-)training loops.
+
+Checkpoint-based elastic training in the Varuna / Elastic-Horovod
+style: the train loop is wrapped so that (a) the persistable state is
+checkpointed asynchronously every `save_every` steps via the existing
+contrib.checkpoint.AsyncCheckpointer, and (b) a relaunched trainer
+process resumes from the latest checkpoint, re-registers with the
+pservers (un-fencing its peer id and restarting heartbeats), and —
+when a transpiler is given — rolls the pserver shards back to the
+checkpointed params so the whole cluster replays from a consistent
+cut.  With step-keyed data, the post-crash trajectory is bit-identical
+to the uninterrupted run (tests/test_fault_tolerance.py proves it).
+
+Resume contract (docs/FAULT_TOLERANCE.md):
+  - checkpoint step S == "state after completing steps [0, S)"; resume
+    returns S and the loop continues at step index S;
+  - the caller must run its startup program FIRST (restore needs an
+    initialized scope template), and the resumed process must come up
+    within the pservers' heartbeat_timeout of the crash OR use a
+    timeout generous enough to cover relaunch (a fenced peer is
+    un-fenced by the reregister RPC, but a pserver whose every trainer
+    is fenced shuts itself down);
+  - trainer-side persistables only: with optimizer state living on the
+    pservers (momentum etc.), bit-parity additionally needs the
+    pserver-side checkpoint_notify path — SGD-style stateless-pserver
+    setups resume exactly from the trainer checkpoint alone.
+
+    ck = AsyncCheckpointer(dirname)
+    el = ElasticTrainer(ck, transpiler=t, save_every=5)
+    start = el.resume()            # 0 on a fresh start
+    for step in range(start, n_steps):
+        ... exe.run(...) ...
+        el.step_done(step)
+    el.finish()
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ElasticTrainer"]
+
+
+class ElasticTrainer:
+    def __init__(self, checkpointer, transpiler=None, endpoints=(),
+                 peer_id=None, save_every=10, program=None, scope=None,
+                 wait_each_save=False):
+        """checkpointer: contrib.checkpoint.AsyncCheckpointer.
+        transpiler: a transpiled DistributeTranspiler — supplies the
+        pserver endpoints, the peer id, and the section plan for the
+        rollback push; endpoints/peer_id override or stand in for it
+        (endpoints may be empty for single-process elasticity).
+        program/scope: forwarded to the checkpointer (defaults:
+        default_main_program / global scope).  wait_each_save: block
+        until each checkpoint is durable before continuing — slower,
+        but a crash can then lose at most save_every steps (async
+        saves in flight at crash time are not durable)."""
+        self._ck = checkpointer
+        self._t = transpiler
+        self._endpoints = list(endpoints) or (
+            list(transpiler.endpoints) if transpiler is not None else [])
+        if peer_id is None and transpiler is not None:
+            peer_id = f"trainer{transpiler.trainer_id}"
+        self._peer_id = peer_id
+        self._save_every = int(save_every)
+        self._program = program
+        self._scope = scope
+        self._wait_each_save = bool(wait_each_save)
+
+    # ------------------------------------------------------------ resume
+    def resume(self):
+        """Restore the latest checkpoint (if any) into the scope,
+        re-register with every pserver, and — when a transpiler is
+        available — push the restored param sections back so the
+        pserver shards match the checkpoint cut.  Returns the step
+        index to continue from (0 when no checkpoint exists)."""
+        step = self._ck.latest_step()
+        if step is not None:
+            self._ck.restore(step, program=self._program,
+                             scope=self._scope)
+        self.reregister()
+        if step is not None and self._t is not None:
+            self._push_restored_params()
+        return 0 if step is None else int(step)
+
+    def reregister(self):
+        """Announce this trainer to the pservers again: un-fence the
+        peer id (a crashed trainer was declared dead by the heartbeat
+        monitor) and restart the shared heartbeat senders.  Idempotent
+        and retry-safe."""
+        if not self._endpoints:
+            return
+        from paddle_tpu.distributed.rpc import (global_rpc_client,
+                                                start_shared_heartbeat)
+
+        client = global_rpc_client()
+        for ep in self._endpoints:
+            client.call(ep, "reregister", self._peer_id)
+            if self._peer_id is not None:
+                start_shared_heartbeat(ep, self._peer_id)
+
+    def _push_restored_params(self):
+        """Roll the pserver shards back to the restored params (the
+        same section plan ps_sync_init seeds them with): every peer
+        then replays from one consistent cut instead of mixing a
+        step-S trainer with step-(S+k) shards."""
+        from paddle_tpu.core.scope import global_scope
+        from paddle_tpu.distributed.rpc import global_rpc_client
+
+        scope = self._scope or global_scope()
+        client = global_rpc_client()
+        t = self._t
+        for pname, plan in t.param_plan.items():
+            var = scope.find_var(pname)
+            if var is None or var.get() is None:
+                continue
+            x = np.asarray(var.get())
+            for i, sec, s, e in plan:
+                part = x if (s == 0 and e == -1) else x[s:e]
+                client.send_var(t.endpoints[i], sec,
+                                np.ascontiguousarray(part))
+
+    # ------------------------------------------------------------- loop
+    def step_done(self, step):
+        """Call after completing step index `step`; checkpoints
+        (asynchronously) every save_every steps."""
+        if self._save_every > 0 and (int(step) + 1) % self._save_every == 0:
+            self._ck.save(int(step) + 1, program=self._program,
+                          scope=self._scope)
+            if self._wait_each_save:
+                self._ck.wait()
+
+    def run(self, n_steps, step_fn, start_step=None):
+        """Convenience loop: resume, then step_fn(step) for each
+        remaining step with periodic checkpoints; returns the list of
+        step_fn results (steps actually run this incarnation)."""
+        start = self.resume() if start_step is None else int(start_step)
+        results = []
+        for step in range(start, int(n_steps)):
+            results.append(step_fn(step))
+            self.step_done(step)
+        self.finish()
+        return results
+
+    def finish(self):
+        """Barrier on outstanding async checkpoint writes."""
+        self._ck.wait()

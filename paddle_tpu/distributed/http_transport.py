@@ -10,16 +10,28 @@ wire-encoded (msg_type, payload) body; the response body is the
 wire-encoded ("ok", reply) / ("error", msg) tuple.  Keep-alive
 connections give one server thread per client connection, matching the
 socket transport's concurrency model (handlers may block in barriers).
+
+Failure semantics ride the shared RPCClient machinery: this class only
+provides the framing-specific single exchange (_call_once) and widens
+the retryable-exception set with http.client.HTTPException
+(IncompleteRead/BadStatusLine/CannotSendRequest — a connection broken
+mid-response must be evicted and retried exactly like a broken
+socket).  Fault injection (distributed/faultinject.py) hooks the
+server's do_POST the same way the socket framing hooks _serve_conn.
 """
 
 from __future__ import annotations
 
+import socket
 import threading
-from http.client import HTTPConnection
+import time
+from http.client import HTTPConnection, HTTPException
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from paddle_tpu.distributed.rpc import (RPCClient, RPCServer, WireError,
-                                        wire_dumps, wire_loads)
+from paddle_tpu.distributed import faultinject
+from paddle_tpu.distributed.rpc import (_RETRYABLE_EXCS, RPCClient,
+                                        RPCServer, WireError, wire_dumps,
+                                        wire_loads)
 
 __all__ = ["HTTPRPCServer", "HTTPRPCClient"]
 
@@ -29,6 +41,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, *args):   # quiet
         pass
+
+    def _abort(self):
+        """Sever the connection without a response: the client sees a
+        RemoteDisconnected/IncompleteRead, evicts, and (when the msg
+        type allows) retries."""
+        self.close_connection = True
+        try:
+            self.connection.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
 
     def do_POST(self):
         rpc = self.server._rpc
@@ -43,7 +65,36 @@ class _Handler(BaseHTTPRequestHandler):
         except WireError as e:
             reply = ("error", f"bad wire frame: {e}")
         else:
-            reply = rpc._dispatch(msg)  # shared with the socket framing
+            fault = None
+            inj = faultinject.maybe_injector()
+            if inj is not None and isinstance(msg, tuple) \
+                    and len(msg) == 2 and isinstance(msg[0], str):
+                fault = inj.decide(msg[0])
+            if fault is not None:
+                kind, arg = fault
+                if kind in ("close", "kill"):
+                    # request-loss: the handler never runs
+                    self._abort()
+                    return
+                reply = rpc._dispatch(msg)  # shared with socket framing
+                if kind == "drop":
+                    self._abort()           # executed, reply discarded
+                    return
+                if kind == "truncate":
+                    out = wire_dumps(reply)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    self.wfile.write(out[:max(1, int(len(out) * arg))])
+                    self.wfile.flush()
+                    self._abort()           # mid-body close
+                    return
+                if kind == "delay":
+                    time.sleep(arg)
+            else:
+                reply = rpc._dispatch(msg)  # shared with socket framing
         try:
             out = wire_dumps(reply)
         except WireError as e:
@@ -66,11 +117,7 @@ class HTTPRPCServer(RPCServer):
         self._httpd._rpc = self
         self._httpd.daemon_threads = True
         self.endpoint = f"{host}:{self._httpd.server_address[1]}"
-        self._handlers = {}
-        self._stop = threading.Event()
-        self._threads = []
-        self._dyn_barriers: dict = {}
-        self._barrier_lock = threading.Lock()
+        self._init_rpc_state()   # handlers/barriers/dedup + health RPC
 
     def start(self):
         self._serving = True
@@ -91,15 +138,17 @@ class HTTPRPCServer(RPCServer):
 
 class HTTPRPCClient(RPCClient):
     """Drop-in RPCClient over HTTP framing: per-endpoint keep-alive
-    connection + lock, connect-retry like the socket client."""
+    connection + lock, connect-retry, and the shared deadline/retry/
+    dedup/circuit-breaker loop from RPCClient.call."""
 
-    def _connect(self, endpoint):
-        import time
+    _RETRYABLE = _RETRYABLE_EXCS + (HTTPException,)
 
+    def _connect(self, endpoint, timeout=None):
+        timeout = self._TIMEOUT if timeout is None else timeout
         host, port = endpoint.rsplit(":", 1)
         conn = HTTPConnection(host or "127.0.0.1", int(port),
-                              timeout=self._TIMEOUT)
-        deadline = time.monotonic() + self._TIMEOUT
+                              timeout=timeout)
+        deadline = time.monotonic() + timeout
         while True:
             try:
                 conn.connect()
@@ -113,34 +162,31 @@ class HTTPRPCClient(RPCClient):
     # (one dead endpoint's retry never stalls the others); only
     # _connect differs by framing
 
-    def call(self, endpoint: str, msg_type: str, payload=None):
-        import http.client as _hc
+    def _set_attempt_timeout(self, conn, timeout):
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
 
-        conn, lock = self._get_conn(endpoint)
+    def _call_once(self, endpoint, msg_type, payload, timeout):
+        conn, lock = self._get_conn(endpoint, timeout=timeout)
         try:
             with lock:
+                self._set_attempt_timeout(conn, timeout)
                 body = wire_dumps((msg_type, payload))
                 conn.request("POST", "/rpc", body=body, headers={
                     "Content-Type": "application/octet-stream"})
                 resp = conn.getresponse()
                 data = resp.read()
             status, reply = wire_loads(data)
-        except (ConnectionError, OSError, WireError,
-                _hc.HTTPException):
+        except self._RETRYABLE:
             # HTTPException covers IncompleteRead/BadStatusLine/
             # CannotSendRequest — a connection broken mid-response must
             # be evicted like the socket client does, or the endpoint
             # stays wedged after a pserver restart (the per-endpoint
-            # lock object persists, matching RPCClient.call)
-            with self._global_lock:
-                cached = self._conns.get(endpoint)
-                if cached is conn:
-                    try:
-                        cached.close()
-                    except OSError:
-                        pass
-                    del self._conns[endpoint]
+            # lock object persists, matching RPCClient._evict)
+            self._evict(endpoint, conn)
             raise
+        self._breaker_ok(endpoint)
         if status == "error":
             raise RuntimeError(
                 f"RPC '{msg_type}' to {endpoint} failed: {reply}")

@@ -21,11 +21,17 @@ IO instead.
 
 from __future__ import annotations
 
+import itertools
+import os
 import socket
 import struct
 import threading
+import uuid
+from collections import OrderedDict
 
 import numpy as np
+
+from paddle_tpu.distributed import faultinject
 
 _LEN = struct.Struct("!Q")
 _I64 = struct.Struct("!q")
@@ -36,6 +42,59 @@ _U32 = struct.Struct("!I")
 class WireError(ValueError):
     """Malformed or forbidden wire content (never code execution — the
     codec has no notion of callables or class reconstruction)."""
+
+
+class RPCDeadlineExceeded(TimeoutError):
+    """A call (including its transparent retries) ran out of its
+    deadline budget.  TimeoutError subclass, so it is also an OSError —
+    existing broad handlers keep working."""
+
+
+class CircuitOpenError(ConnectionError):
+    """Fail-fast: the per-endpoint circuit breaker is open after
+    consecutive transport failures; retried after the cooldown."""
+
+
+class BarrierTimeoutError(RuntimeError):
+    """A server-side barrier missed its deadline.  The message is the
+    one-line diagnostic contract tools/check_test_hung.py parses:
+
+      barrier 'NAME' @ ENDPOINT timed out after T s: K/N arrivals,
+      waiters=[...]
+    """
+
+    def __init__(self, name, endpoint, timeout, arrived, needed):
+        self.barrier_name = name
+        self.endpoint = endpoint
+        self.arrived = list(arrived)
+        self.needed = int(needed)
+        waiters = [p for p in self.arrived if isinstance(p, str)]
+        super().__init__(
+            f"barrier '{name}' @ {endpoint} timed out after "
+            f"{float(timeout):g}s: {len(self.arrived)}/{self.needed} "
+            f"arrivals, waiters={waiters!r}")
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return float(v)
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+# transport-level failures worth a transparent retry; handler ("error",
+# ...) replies are application errors and are NEVER retried
+_RETRYABLE_EXCS = (ConnectionError, TimeoutError, OSError, WireError)
+
+_DEDUP_CACHE_SIZE = 4096
+_DEDUP_TAG = "__seq1__"
 
 
 _MAX_DEPTH = 32
@@ -235,30 +294,48 @@ class RPCServer:
         self._sock.bind((host or "127.0.0.1", int(port)))
         self._sock.listen(128)
         self.endpoint = f"{host or '127.0.0.1'}:{self._sock.getsockname()[1]}"
+        self._init_rpc_state()
+
+    def _init_rpc_state(self):
+        """Framing-independent server state (socket + HTTP subclasses)."""
         self._handlers = {}
         self._stop = threading.Event()
         self._threads = []
         self._dyn_barriers: dict = {}
         self._barrier_lock = threading.Lock()
+        # exactly-once dedup: (client_id, seq) -> cached ok-reply for
+        # msg types the client marks non-idempotent (send_var & co);
+        # a retry whose original DID execute returns the cached reply
+        # instead of re-running the handler
+        self._dedup: OrderedDict = OrderedDict()
+        self._dedup_lock = threading.Lock()
+        self.register_handler("health", self._health)
+
+    def _health(self, _payload=None):
+        """Built-in liveness/readiness RPC (reference: BRPC health
+        checks); clients probe it with a short deadline and no retry."""
+        return {"status": "ok", "endpoint": self.endpoint,
+                "pid": os.getpid(),
+                "msg_types": sorted(self._handlers)}
 
     def register_handler(self, msg_type: str, fn):
         self._handlers[msg_type] = fn
 
     # -- barrier support (reference rpc_server.h RegisterBarrier) -----------
-    def barrier(self, name: str, count: int) -> int:
+    def barrier(self, name: str, count: int, timeout=None) -> int:
         """Blocks the calling handler until `count` parties arrived;
         returns 0 for exactly one of them (the leader, elected at
         release) so one caller can do post-barrier work, and 1 for the
         rest.  Fixed-count convenience over barrier_dynamic (one
         implementation, one release semantics)."""
-        return self.barrier_dynamic(name, lambda: count)
+        return self.barrier_dynamic(name, lambda: count, timeout=timeout)
 
     def reset_barrier(self, name: str):
         with self._barrier_lock:
             self._dyn_barriers.pop(name, None)
 
     def barrier_dynamic(self, name: str, count_fn, poll=0.25,
-                        peer=None, alive_fn=None) -> int:
+                        peer=None, alive_fn=None, timeout=None) -> int:
         """Like barrier(), but the required party count is re-evaluated
         every `poll` seconds — the survivor-continue primitive: when a
         trainer dies mid-step, count_fn (e.g. fanin - dead_trainers)
@@ -269,10 +346,24 @@ class RPCServer:
         peer/alive_fn: arrival identity + liveness predicate.  Only
         LIVE arrivals satisfy the count — an arrival from a peer that
         gets fenced while waiting must not release the barrier in place
-        of a live straggler.  Returns 0 for exactly one LIVE waiter per
-        generation (the leader, elected at release time — arrival order
-        can't elect, the first arriver might be fenced by then) and a
-        positive index for the rest."""
+        of a live straggler.  A DUPLICATE arrival from a peer already
+        waiting in this generation (a transparently retried barrier RPC
+        whose reply was lost) does not add a second count — barriers
+        retry freely without phantom releases.  Returns 0 for exactly
+        one LIVE waiter per generation (the leader, elected at release
+        time — arrival order can't elect, the first arriver might be
+        fenced by then) and a positive index for the rest.
+
+        timeout: seconds before a waiter gives up with a
+        BarrierTimeoutError naming the barrier, the endpoint, and the
+        waiters seen (instead of hanging the job forever).  None reads
+        PADDLE_TPU_BARRIER_TIMEOUT (default 600s); <= 0 disables."""
+        import time
+
+        if timeout is None:
+            timeout = _env_float("PADDLE_TPU_BARRIER_TIMEOUT", 600.0)
+        deadline = (time.monotonic() + float(timeout)) \
+            if timeout and timeout > 0 else None
         with self._barrier_lock:
             b = self._dyn_barriers.get(name)
             if b is None:
@@ -283,7 +374,8 @@ class RPCServer:
         token = object() if peer is None else str(peer)
         with c:
             gen = b["gen"]
-            b["arrived"].append(token)
+            if not (isinstance(token, str) and token in b["arrived"]):
+                b["arrived"].append(token)
             c.notify_all()
 
             def live_count():
@@ -294,6 +386,18 @@ class RPCServer:
 
             while b["gen"] == gen and \
                     live_count() < max(1, int(count_fn())):
+                if deadline is not None and time.monotonic() > deadline:
+                    err = BarrierTimeoutError(
+                        name, self.endpoint, timeout,
+                        list(b["arrived"]), max(1, int(count_fn())))
+                    # withdraw our arrival: a stale token must not
+                    # satisfy (and silently desync) a later generation
+                    try:
+                        b["arrived"].remove(token)
+                    except ValueError:
+                        pass
+                    c.notify_all()
+                    raise err
                 c.wait(poll)
             me_alive = alive_fn is None or not isinstance(token, str) \
                 or alive_fn(token)
@@ -333,7 +437,14 @@ class RPCServer:
 
     def _dispatch(self, msg):
         """(msg_type, payload) -> ("ok", reply) | ("error", text).
-        One dispatch semantics for every transport framing."""
+        One dispatch semantics for every transport framing.
+
+        Exactly-once envelope: a payload shaped
+        (_DEDUP_TAG, client_id, seq, inner) is unwrapped here; if
+        (client_id, seq) already executed, the cached ok-reply is
+        returned WITHOUT re-running the handler — a retried send_var
+        whose reply was lost lands once, not twice.  Handlers only ever
+        see the inner payload."""
         if not (isinstance(msg, tuple) and len(msg) == 2
                 and isinstance(msg[0], str)):
             return ("error", "message must be (msg_type, payload)")
@@ -341,10 +452,26 @@ class RPCServer:
         fn = self._handlers.get(msg_type)
         if fn is None:
             return ("error", f"no handler for '{msg_type}'")
+        dedup_key = None
+        if (isinstance(payload, tuple) and len(payload) == 4
+                and payload[0] == _DEDUP_TAG):
+            dedup_key = (payload[1], payload[2])
+            payload = payload[3]
+            with self._dedup_lock:
+                cached = self._dedup.get(dedup_key)
+                if cached is not None:
+                    self._dedup.move_to_end(dedup_key)
+                    return cached
         try:
-            return ("ok", fn(payload))
+            reply = ("ok", fn(payload))
         except Exception as e:  # surface to client
             return ("error", repr(e))
+        if dedup_key is not None:
+            with self._dedup_lock:
+                self._dedup[dedup_key] = reply
+                while len(self._dedup) > _DEDUP_CACHE_SIZE:
+                    self._dedup.popitem(last=False)
+        return reply
 
     def _serve_conn(self, conn):
         try:
@@ -358,7 +485,34 @@ class RPCServer:
                     # stream is still in sync: report and keep serving
                     _send_msg(conn, ("error", f"bad wire frame: {e}"))
                     continue
-                reply = self._dispatch(msg)
+                fault = None
+                inj = faultinject.maybe_injector()
+                if inj is not None and isinstance(msg, tuple) \
+                        and len(msg) == 2 and isinstance(msg[0], str):
+                    fault = inj.decide(msg[0])
+                if fault is not None:
+                    kind, arg = fault
+                    if kind in ("close", "kill"):
+                        # request-loss: handler never runs (kill = the
+                        # handler thread crashed at entry)
+                        return
+                    reply = self._dispatch(msg)
+                    if kind == "drop":
+                        return  # reply-loss: executed, reply discarded
+                    if kind == "truncate":
+                        try:
+                            data = wire_dumps(reply)
+                            frame = _LEN.pack(len(data)) + data
+                            conn.sendall(
+                                frame[:max(1, int(len(frame) * arg))])
+                        except (WireError, OSError):
+                            pass
+                        return  # mid-frame close
+                    if kind == "delay":
+                        import time
+                        time.sleep(arg)
+                else:
+                    reply = self._dispatch(msg)
                 try:
                     _send_msg(conn, reply)
                 except WireError as e:
@@ -379,35 +533,80 @@ class RPCServer:
 
 class RPCClient:
     """Per-endpoint persistent connections (reference grpc_client.h:176
-    channel cache); thread-safe via per-connection locks."""
+    channel cache); thread-safe via per-connection locks.
+
+    Failure semantics (reference grpc_client deadline/retry loops):
+    every call() runs under a deadline; transport failures on msg types
+    classified idempotent retry transparently with exponential backoff
+    + jitter; non-idempotent types (IDEMPOTENT_UNSAFE) carry a
+    (client_id, seq) envelope the server dedups, making their retries
+    exactly-once.  Unclassified types never retry.  A per-endpoint
+    circuit breaker fails fast after consecutive terminal failures.
+
+    Env knobs (all optional; see docs/FAULT_TOLERANCE.md):
+      PADDLE_TPU_RPC_DEADLINE      per-call budget incl. retries (120s)
+      PADDLE_TPU_RPC_RETRIES       max transparent retries (5; 0 = off,
+                                   exact pre-retry wire + behavior)
+      PADDLE_TPU_RPC_BACKOFF       first backoff (0.05s; doubles, 2s
+                                   cap, +/-50% jitter)
+      PADDLE_TPU_RPC_CB_THRESHOLD  breaker opens after N consecutive
+                                   terminal failures (8; 0 = disabled)
+      PADDLE_TPU_RPC_CB_COOLDOWN   breaker open time (1s)
+    """
 
     _TIMEOUT = 120.0
+    _RETRYABLE = _RETRYABLE_EXCS   # framings may widen (HTTP adds
+    #                                http.client.HTTPException)
+
+    # transparent-retry classification (the idempotence table in
+    # docs/FAULT_TOLERANCE.md)
+    IDEMPOTENT = frozenset({
+        "get_var", "prefetch_rows", "heartbeat", "health",
+        "live_trainers", "dead_trainers", "init_done", "init_wait",
+        "checkpoint_notify", "reregister",
+    })
+    # non-idempotent but retry-safe through the server-side dedup
+    # cache.  Barriers are here on purpose: a retried barrier whose
+    # ORIGINAL released must get the cached release reply — a fresh
+    # arrival would land one generation late and let the next round's
+    # grad merge run before this trainer's push (parity loss).  The
+    # server-side same-peer arrival dedup in barrier_dynamic is the
+    # second line of defense for non-enveloped re-invocations.
+    IDEMPOTENT_UNSAFE = frozenset({
+        "send_var", "send_sparse", "complete", "send_barrier",
+        "fetch_barrier",
+    })
 
     def __init__(self):
         self._conns: dict = {}
         self._locks: dict = {}
         self._global_lock = threading.Lock()
+        self._client_id = uuid.uuid4().hex
+        self._seq = itertools.count(1)
+        self._DEADLINE = None       # per-instance override of the env
+        self._breaker: dict = {}    # endpoint -> [consec_fails, open_until]
 
-    def _connect(self, endpoint):
+    def _connect(self, endpoint, timeout=None):
         """Blocking connect with retry (the server may not be up yet —
         reference wait_server_ready polls the port the same way)."""
         import time
 
+        timeout = self._TIMEOUT if timeout is None else timeout
         host, port = endpoint.rsplit(":", 1)
-        deadline = time.monotonic() + self._TIMEOUT
+        deadline = time.monotonic() + timeout
         while True:
             try:
                 s = socket.create_connection((host, int(port)),
-                                             timeout=self._TIMEOUT)
+                                             timeout=timeout)
                 break
             except (ConnectionRefusedError, OSError):
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.2)
-        s.settimeout(self._TIMEOUT)
+        s.settimeout(timeout)
         return s
 
-    def _get_conn(self, endpoint):
+    def _get_conn(self, endpoint, timeout=None):
         # connect-retry happens under the PER-ENDPOINT lock only: one
         # dead endpoint retrying for up to _TIMEOUT must not stall this
         # client's RPCs to every other (healthy) endpoint
@@ -421,35 +620,139 @@ class RPCClient:
                 conn = self._conns.get(endpoint)
                 if conn is not None:
                     return conn, lock
-            conn = self._connect(endpoint)
+            conn = self._connect(endpoint, timeout=timeout)
             with self._global_lock:
                 self._conns[endpoint] = conn
             return conn, lock
 
-    def call(self, endpoint: str, msg_type: str, payload=None):
-        conn, lock = self._get_conn(endpoint)
+    def _evict(self, endpoint, conn):
+        """Drop (and close) a broken cached connection so the next call
+        reconnects (e.g. a pserver restart in the elastic path); the
+        per-endpoint lock object persists — recreating it would let a
+        concurrent holder of the old lock race the new one."""
+        with self._global_lock:
+            cached = self._conns.get(endpoint)
+            if cached is conn:
+                try:
+                    cached.close()
+                except OSError:
+                    pass
+                del self._conns[endpoint]
+
+    def _set_attempt_timeout(self, conn, timeout):
+        conn.settimeout(timeout)
+
+    def _call_once(self, endpoint, msg_type, payload, timeout):
+        """One request/reply exchange.  Any transport failure — refused,
+        reset, a socket timeout mid-_recv_exact (which leaves a
+        half-read frame on the cached connection), or a WireError from
+        a garbled reply — EVICTS the connection: reusing it would read
+        the previous call's late bytes as this call's reply and desync
+        the wire for every call after."""
+        conn, lock = self._get_conn(endpoint, timeout=timeout)
         try:
             with lock:
+                self._set_attempt_timeout(conn, timeout)
                 _send_msg(conn, (msg_type, payload))
                 status, reply = _recv_msg(conn)
-        except (ConnectionError, OSError):
-            # evict the dead cached socket so the next call reconnects
-            # (e.g. a pserver restart in the elastic path); the
-            # per-endpoint lock object persists — recreating it would
-            # let a concurrent holder of the old lock race the new one
-            with self._global_lock:
-                cached = self._conns.get(endpoint)
-                if cached is conn:
-                    try:
-                        cached.close()
-                    except OSError:
-                        pass
-                    del self._conns[endpoint]
+        except (ConnectionError, TimeoutError, OSError, WireError):
+            self._evict(endpoint, conn)
             raise
+        self._breaker_ok(endpoint)
         if status == "error":
             raise RuntimeError(
                 f"RPC '{msg_type}' to {endpoint} failed: {reply}")
         return reply
+
+    # -- circuit breaker (per endpoint, consecutive terminal failures) ------
+    def _breaker_gate(self, endpoint):
+        import time
+
+        thresh = _env_int("PADDLE_TPU_RPC_CB_THRESHOLD", 8)
+        if thresh <= 0:
+            return
+        st = self._breaker.get(endpoint)
+        if st and st[0] >= thresh:
+            now = time.monotonic()
+            if now < st[1]:
+                raise CircuitOpenError(
+                    f"circuit open for {endpoint}: {st[0]} consecutive "
+                    f"call failures; retry in {st[1] - now:.2f}s")
+            # half-open: let this probe through, push the window so
+            # concurrent callers don't stampede the recovering server
+            st[1] = now + _env_float("PADDLE_TPU_RPC_CB_COOLDOWN", 1.0)
+
+    def _breaker_ok(self, endpoint):
+        self._breaker.pop(endpoint, None)
+
+    def _breaker_fail(self, endpoint):
+        import time
+
+        st = self._breaker.setdefault(endpoint, [0, 0.0])
+        st[0] += 1
+        st[1] = time.monotonic() + \
+            _env_float("PADDLE_TPU_RPC_CB_COOLDOWN", 1.0)
+
+    def call(self, endpoint: str, msg_type: str, payload=None,
+             deadline=None, retries=None):
+        """Request/reply with deadline + idempotence-aware retry.
+
+        deadline: total budget in seconds for this call INCLUDING
+        retries (None -> instance override -> PADDLE_TPU_RPC_DEADLINE
+        -> _TIMEOUT).  retries: max transparent retries on transport
+        failure (None -> PADDLE_TPU_RPC_RETRIES, default 5); only
+        msg types in IDEMPOTENT retry as-is, IDEMPOTENT_UNSAFE types
+        retry under the exactly-once dedup envelope, and unclassified
+        types never retry unless `retries` is passed explicitly.
+        Handler errors raise RuntimeError and are never retried."""
+        import random
+        import time
+
+        if deadline is None:
+            deadline = self._DEADLINE if self._DEADLINE is not None \
+                else _env_float("PADDLE_TPU_RPC_DEADLINE", self._TIMEOUT)
+        explicit_retries = retries is not None
+        if retries is None:
+            retries = _env_int("PADDLE_TPU_RPC_RETRIES", 5)
+        if msg_type in self.IDEMPOTENT_UNSAFE and retries > 0:
+            payload = (_DEDUP_TAG, self._client_id,
+                       next(self._seq), payload)
+        elif msg_type not in self.IDEMPOTENT and not explicit_retries:
+            retries = 0
+        self._breaker_gate(endpoint)
+        deadline_t = time.monotonic() + float(deadline)
+        backoff = _env_float("PADDLE_TPU_RPC_BACKOFF", 0.05)
+        attempt = 0
+        while True:
+            budget = deadline_t - time.monotonic()
+            if budget <= 0:
+                self._breaker_fail(endpoint)
+                raise RPCDeadlineExceeded(
+                    f"RPC '{msg_type}' to {endpoint}: deadline "
+                    f"{deadline:g}s exhausted after {attempt} attempts")
+            try:
+                return self._call_once(endpoint, msg_type, payload,
+                                       min(budget, self._TIMEOUT))
+            except self._RETRYABLE as e:
+                attempt += 1
+                if attempt > retries:
+                    self._breaker_fail(endpoint)
+                    raise
+                sleep = min(backoff * (2 ** (attempt - 1)), 2.0) \
+                    * (0.5 + random.random())
+                if time.monotonic() + sleep >= deadline_t:
+                    self._breaker_fail(endpoint)
+                    raise RPCDeadlineExceeded(
+                        f"RPC '{msg_type}' to {endpoint}: deadline "
+                        f"{deadline:g}s exhausted after {attempt} "
+                        f"attempts (last: {e!r})") from e
+                time.sleep(sleep)
+
+    def health(self, endpoint, deadline=2.0):
+        """Probe the server's built-in 'health' handler: short deadline,
+        no retries — the caller decides what unhealthy means."""
+        return self.call(endpoint, "health", deadline=deadline,
+                         retries=0)
 
     # reference rpc_client.h API names
     def send_var(self, endpoint, name, value, trainer_idx=None):
@@ -592,6 +895,9 @@ class HeartbeatSender:
         if client is None:
             client = make_rpc_client()
             client._TIMEOUT = max(2.0, 2 * float(interval))
+            # per-instance deadline beats any PADDLE_TPU_RPC_DEADLINE:
+            # a beat must never hold its dedicated client for minutes
+            client._DEADLINE = client._TIMEOUT
             self._owns_client = True
         else:
             self._owns_client = False

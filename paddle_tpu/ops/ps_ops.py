@@ -53,7 +53,8 @@ register_op("listen_and_serv", inputs=(), outputs=(),
                    "grad_blocks": [], "lr_names": [],
                    "sparse_grad_blocks": [],
                    "dc_pairs": [],
-                   "heartbeat_timeout": 10.0},
+                   "heartbeat_timeout": 10.0,
+                   "barrier_timeout": 0.0},
             differentiable=False, host_only=True)(_structural)
 register_op("ps_sync_init", inputs=("X",), outputs=(),
             duplicable=("X",), optional=("X",),
@@ -346,12 +347,18 @@ def listen_and_serv_op(op, block, scope, ctx):
                 f"trainer '{peer}' was declared dead (missed "
                 "heartbeats) and is fenced from this cluster")
 
+    # barrier deadline: 0.0 -> env PADDLE_TPU_BARRIER_TIMEOUT (600s
+    # default) — a wedged round raises a BarrierTimeoutError naming the
+    # barrier + waiters at every party instead of hanging the job
+    barrier_timeout = float(attrs.get("barrier_timeout", 0.0)) or None
+
     def on_send_barrier(peer):
         if not sync:
             return
         _reject_fenced(peer)
         lead = server.barrier_dynamic("send", effective_fanin,
-                                      peer=peer, alive_fn=_alive)
+                                      peer=peer, alive_fn=_alive,
+                                      timeout=barrier_timeout)
         if lead == 0:
             with lock:
                 for gname, bidx in grad_blocks:
@@ -379,7 +386,8 @@ def listen_and_serv_op(op, block, scope, ctx):
                     if rows.size:
                         _apply_sparse(gsec, rows, vals2)
         server.barrier_dynamic("send_done", effective_fanin,
-                               peer=peer, alive_fn=_alive)
+                               peer=peer, alive_fn=_alive,
+                               timeout=barrier_timeout)
 
     def on_get_var(payload):
         name, tid = (payload, None) if isinstance(payload, str) \
@@ -424,7 +432,8 @@ def listen_and_serv_op(op, block, scope, ctx):
             return
         _reject_fenced(peer)
         server.barrier_dynamic("fetch", effective_fanin, peer=peer,
-                               alive_fn=_alive)
+                               alive_fn=_alive,
+                               timeout=barrier_timeout)
 
     def on_complete(peer):
         if peer is not None:
@@ -436,6 +445,19 @@ def listen_and_serv_op(op, block, scope, ctx):
             ncomplete[0] += 1
             if ncomplete[0] >= outstanding_completions():
                 stop.set()
+
+    def on_reregister(peer):
+        """Elastic resume (distributed/elastic.py): a relaunched
+        trainer re-joins under its old peer id — un-fence it (its crash
+        got it declared dead), un-retire it, and reset its liveness
+        clock so effective_fanin counts it again.  Idempotent and
+        retry-safe; returns the fanin the caller rejoins."""
+        if peer is not None:
+            with live_lock:
+                fenced.discard(str(peer))
+                completed.discard(str(peer))
+            hb_monitor.forget(peer)
+        return effective_fanin()
 
     def on_init_done(_):
         init_evt.set()
@@ -512,6 +534,7 @@ def listen_and_serv_op(op, block, scope, ctx):
     server.register_handler("send_sparse", on_send_sparse)
     server.register_handler("fetch_barrier", on_fetch_barrier)
     server.register_handler("complete", on_complete)
+    server.register_handler("reregister", on_reregister)
     server.register_handler("init_done", on_init_done)
     server.register_handler("init_wait", on_init_wait)
     server.register_handler("checkpoint_notify", on_checkpoint)

@@ -40,6 +40,11 @@ class DistributeTranspilerConfig:
         # the survivors continue (see listen_and_serv effective_fanin)
         self.heartbeat_timeout = 10.0
         self.heartbeat_interval = 1.0
+        # pserver barrier deadline: a wedged sync round raises a
+        # diagnostic BarrierTimeoutError (naming barrier + waiters)
+        # instead of hanging forever; 0.0 defers to the
+        # PADDLE_TPU_BARRIER_TIMEOUT env (default 600s)
+        self.barrier_timeout = 0.0
         # delay-compensated async SGD (reference
         # distribute_transpiler.py:1905 _append_dc_asgd_ops): corrects
         # each delayed grad with g + g*g*(w_now - w_at_pull) using a
@@ -413,7 +418,9 @@ class DistributeTranspiler:
                    "sparse_grad_blocks": sparse_grad_blocks,
                    "dc_pairs": dc_pairs,
                    "heartbeat_timeout":
-                       float(self.config.heartbeat_timeout)},
+                       float(self.config.heartbeat_timeout),
+                   "barrier_timeout":
+                       float(self.config.barrier_timeout)},
             infer_shape=False)
         return prog
 

@@ -1,0 +1,846 @@
+"""Tier-1 CPU chaos suite for the fault-tolerant distributed stack.
+
+Covers (ISSUE 3): the deterministic fault-injection shim over both wire
+transports (drop/close/kill/delay/truncate keyed by (msg_type,
+call_index)), idempotence-aware retry with per-call deadlines +
+exactly-once send_var dedup, connection eviction on timeout (wire
+desync regression), barrier deadlines with parseable diagnostics,
+the per-endpoint circuit breaker, Communicator supervisor restart and
+stop()-drain, and crash-resume bit-parity through AsyncCheckpointer +
+ElasticTrainer.  Subprocess cluster legs (slow lane) prove the
+acceptance criterion: a faulted 2x2 sync PS run lands on the SAME
+losses and final params as the fault-free run on both transports, and
+a killed-and-resumed trainer reproduces the uninterrupted loss
+trajectory.
+"""
+
+import importlib.util
+import json
+import os
+import socket as socket_mod
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import faultinject
+from paddle_tpu.distributed.faultinject import FaultInjector, FaultPlan
+from paddle_tpu.distributed.rpc import (BarrierTimeoutError,
+                                        CircuitOpenError, RPCClient,
+                                        RPCDeadlineExceeded, RPCServer)
+
+
+def _free_port():
+    s = socket_mod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(params=["socket", "http"])
+def transport(request):
+    """(server, client) over either framing; server started, both torn
+    down (and any fault plan uninstalled) afterwards."""
+    if request.param == "socket":
+        server, client = RPCServer("127.0.0.1:0"), RPCClient()
+    else:
+        from paddle_tpu.distributed.http_transport import (HTTPRPCClient,
+                                                           HTTPRPCServer)
+
+        server, client = HTTPRPCServer("127.0.0.1:0"), HTTPRPCClient()
+    server.start()
+    yield server, client
+    faultinject.uninstall()
+    server.stop()
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# fault plan grammar
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_grammar_roundtrip():
+    text = ("seed=11;rate=0.25;actions=drop,delay=0.1;max=9;"
+            "send_var@0:drop;get_var@2:delay=0.5;*@7:close;"
+            "send_var@3:truncate=0.25")
+    plan = FaultPlan.parse(text)
+    assert plan.seed == 11 and plan.rate == 0.25 and plan.max_faults == 9
+    assert plan.rules[("send_var", 0)] == ("drop", None)
+    assert plan.rules[("get_var", 2)] == ("delay", 0.5)
+    assert plan.rules[("*", 7)] == ("close", None)
+    assert plan.rules[("send_var", 3)] == ("truncate", 0.25)
+    # parse(to_text) is the identity on the rule set + knobs
+    plan2 = FaultPlan.parse(plan.to_text())
+    assert plan2.rules == plan.rules and plan2.seed == plan.seed
+    assert plan2.rate == plan.rate and plan2.max_faults == plan.max_faults
+
+
+@pytest.mark.parametrize("bad", [
+    "send_var@x:drop", "send_var@0:explode", "rate=0.5",   # rate w/o seed
+    "send_var@0:delay", "send_var@0:truncate=1.5", "garbage",
+    "send_var@0:drop=1",
+])
+def test_fault_plan_rejects_bad_items(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_seeded_random_plan_is_deterministic():
+    mk = lambda: FaultInjector(FaultPlan(seed=7, rate=0.5,  # noqa: E731
+                                         actions=("drop", "close")))
+    a, b = mk(), mk()
+    seq_a = [a.decide(t) for t in ["send_var", "get_var"] * 50]
+    seq_b = [b.decide(t) for t in ["send_var", "get_var"] * 50]
+    assert seq_a == seq_b
+    assert any(seq_a)                       # rate=0.5 really faults
+    assert a.log == b.log
+    # a different seed gives a different schedule
+    c = FaultInjector(FaultPlan(seed=8, rate=0.5,
+                                actions=("drop", "close")))
+    seq_c = [c.decide(t) for t in ["send_var", "get_var"] * 50]
+    assert seq_c != seq_a
+
+
+def test_injector_off_is_noop(monkeypatch):
+    """Flag-off contract: nothing installed and no env -> the per-call
+    hook returns None (one dict lookup), and the wire behaves exactly
+    as before."""
+    monkeypatch.delenv("PADDLE_TPU_FAULT_PLAN", raising=False)
+    faultinject.uninstall()
+    assert faultinject.maybe_injector() is None
+    monkeypatch.setenv("PADDLE_TPU_FAULT_PLAN", "send_var@0:drop")
+    inj = faultinject.maybe_injector()
+    assert inj is not None and inj.plan.rules == {
+        ("send_var", 0): ("drop", None)}
+    monkeypatch.delenv("PADDLE_TPU_FAULT_PLAN")
+    assert faultinject.maybe_injector() is None
+
+
+def test_max_faults_bounds_injection():
+    inj = FaultInjector(FaultPlan(max_faults=1).on("e", 0, "close")
+                        .on("e", 1, "close"))
+    assert inj.decide("e") is not None
+    assert inj.decide("e") is None          # budget spent
+    assert len(inj.log) == 1
+
+
+# ---------------------------------------------------------------------------
+# transports under injected faults
+# ---------------------------------------------------------------------------
+
+def test_drop_reply_retried_idempotent(transport):
+    """Reply-loss on an idempotent-style call: explicit retries re-run
+    the handler and the caller still gets the right answer."""
+    server, client = transport
+    calls = []
+    server.register_handler("echo", lambda p: calls.append(p) or p)
+    with faultinject.installed(FaultPlan().on("echo", 0, "drop")) as inj:
+        out = client.call(server.endpoint, "echo", 41, retries=3)
+    assert out == 41
+    assert calls == [41, 41]                # executed twice: no dedup
+    assert inj.log == [("echo", 0, "drop")]
+
+
+def test_send_var_exactly_once_under_reply_loss(transport):
+    """The acceptance-criterion core: the first send_var reply is
+    dropped AFTER the handler ran; the transparent retry must hit the
+    server's dedup cache, NOT apply the gradient twice."""
+    server, client = transport
+    calls = []
+    server.register_handler("send_var",
+                            lambda p: calls.append(p) or "applied")
+    with faultinject.installed(FaultPlan().on("send_var", 0, "drop")):
+        out = client.send_var(server.endpoint, "w", np.ones(2))
+    assert out == "applied"
+    assert len(calls) == 1                  # exactly once
+    name, val = calls[0][0], calls[0][1]    # envelope stripped for the
+    assert name == "w"                      # handler
+    np.testing.assert_array_equal(val, np.ones(2))
+
+
+def test_send_var_exactly_once_under_request_loss(transport):
+    """close = the request never reached the handler; the retry is the
+    FIRST execution — still exactly once."""
+    server, client = transport
+    calls = []
+    server.register_handler("send_var",
+                            lambda p: calls.append(p) or "applied")
+    with faultinject.installed(FaultPlan().on("send_var", 0, "close")):
+        out = client.send_var(server.endpoint, "w", np.zeros(3))
+    assert out == "applied" and len(calls) == 1
+
+
+def test_truncated_reply_resyncs_connection(transport):
+    """A connection closed mid-reply-frame must be evicted; the retry
+    and every later call read clean frames (no wire desync)."""
+    server, client = transport
+    server.register_handler("echo", lambda p: p)
+    plan = FaultPlan().on("echo", 0, "truncate")
+    with faultinject.installed(plan):
+        assert client.call(server.endpoint, "echo",
+                           {"k": np.arange(5.0)}, retries=3)["k"][4] == 4.0
+    for i in range(3):                       # stream healthy afterwards
+        assert client.call(server.endpoint, "echo", i) == i
+
+
+def test_kill_handler_retried(transport):
+    """kill: the handler thread dies at entry without a reply — the
+    retry runs it for real."""
+    server, client = transport
+    calls = []
+    server.register_handler("send_var",
+                            lambda p: calls.append(p) or "ok")
+    with faultinject.installed(FaultPlan().on("send_var", 0, "kill")):
+        assert client.send_var(server.endpoint, "w", np.ones(1)) == "ok"
+    assert len(calls) == 1
+
+
+def test_delayed_reply_past_deadline_does_not_desync(transport):
+    """Satellite regression: a reply delayed past the per-call deadline
+    leaves a half-read (or in-flight) frame on the cached connection.
+    The timeout must EVICT it — the next call must get ITS OWN reply,
+    never the stale delayed one."""
+    server, client = transport
+    server.register_handler("echo", lambda p: p)
+    with faultinject.installed(FaultPlan().on("echo", 0, "delay=1.0")):
+        with pytest.raises(OSError):         # TimeoutError is-a OSError
+            client.call(server.endpoint, "echo", "STALE",
+                        deadline=0.25, retries=0)
+        # the endpoint's cached connection is gone (evicted + closed)
+        assert server.endpoint not in client._conns
+        out = client.call(server.endpoint, "echo", "FRESH", retries=0)
+    assert out == "FRESH"
+
+
+def test_delay_within_deadline_is_just_latency(transport):
+    server, client = transport
+    server.register_handler("echo", lambda p: p)
+    with faultinject.installed(FaultPlan().on("echo", 0, "delay=0.2")):
+        t0 = time.monotonic()
+        assert client.call(server.endpoint, "echo", 5, retries=0) == 5
+        assert time.monotonic() - t0 >= 0.2
+
+
+def test_health_rpc(transport):
+    """Built-in health handler: status/endpoint/registered msg types,
+    probed with a short no-retry deadline."""
+    server, client = transport
+    server.register_handler("echo", lambda p: p)
+    h = client.health(server.endpoint)
+    assert h["status"] == "ok" and h["endpoint"] == server.endpoint
+    assert "echo" in h["msg_types"] and "health" in h["msg_types"]
+
+
+def test_retries_off_restores_seed_behavior(transport, monkeypatch):
+    """PADDLE_TPU_RPC_RETRIES=0: no envelope, no transparent retry — a
+    dropped reply surfaces as a transport error exactly like the
+    pre-retry stack (the flag-off no-op guarantee)."""
+    monkeypatch.setenv("PADDLE_TPU_RPC_RETRIES", "0")
+    server, client = transport
+    seen = []
+    server.register_handler("send_var", lambda p: seen.append(p) or "ok")
+    with faultinject.installed(FaultPlan().on("send_var", 0, "drop")):
+        with pytest.raises(Exception) as ei:
+            client.send_var(server.endpoint, "w", np.ones(2))
+    assert not isinstance(ei.value, RuntimeError)   # transport, not app
+    assert len(seen) == 1
+    # raw (name, value) payload — no dedup envelope on the wire
+    assert seen[0][0] == "w" and len(seen[0]) == 2
+
+
+# ---------------------------------------------------------------------------
+# deadlines, circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_deadline_exceeded_raises_dedicated_error():
+    client = RPCClient()
+    t0 = time.monotonic()
+    with pytest.raises(RPCDeadlineExceeded):
+        client.call(f"127.0.0.1:{_free_port()}", "get_var", "w",
+                    deadline=0.6, retries=8)
+    assert 0.3 < time.monotonic() - t0 < 5.0
+    client.close()
+
+
+def test_circuit_breaker_fails_fast(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_RPC_CB_THRESHOLD", "2")
+    monkeypatch.setenv("PADDLE_TPU_RPC_CB_COOLDOWN", "30")
+    client = RPCClient()
+    dead = f"127.0.0.1:{_free_port()}"
+    for _ in range(2):
+        with pytest.raises(OSError):
+            client.call(dead, "get_var", "w", deadline=0.3, retries=0)
+    t0 = time.monotonic()
+    with pytest.raises(CircuitOpenError):
+        client.call(dead, "get_var", "w")
+    assert time.monotonic() - t0 < 0.05      # failed fast, no connect
+    client.close()
+
+
+def test_circuit_breaker_recovers_after_cooldown(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_RPC_CB_THRESHOLD", "1")
+    monkeypatch.setenv("PADDLE_TPU_RPC_CB_COOLDOWN", "0.2")
+    server = RPCServer("127.0.0.1:0")
+    server.register_handler("echo", lambda p: p)
+    client = RPCClient()
+    dead = f"127.0.0.1:{_free_port()}"
+    with pytest.raises(OSError):
+        client.call(dead, "get_var", "w", deadline=0.2, retries=0)
+    with pytest.raises(CircuitOpenError):
+        client.call(dead, "get_var", "w")
+    time.sleep(0.25)
+    # half-open probe goes through; against a live server it heals
+    server.start()
+    assert client.call(server.endpoint, "echo", 1, retries=0) == 1
+    server.stop()
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# barrier deadline + arrival dedup
+# ---------------------------------------------------------------------------
+
+def _tools_mod(name):
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_barrier_deadline_diagnostic_is_parseable():
+    """The wedged-barrier error names the barrier, the endpoint, and
+    the waiters seen — and tools/check_test_hung.py parses it, so a
+    hung distributed test reports WHICH barrier stalled."""
+    server = RPCServer("127.0.0.1:0")
+    with pytest.raises(BarrierTimeoutError) as ei:
+        server.barrier_dynamic("send", lambda: 3, poll=0.05,
+                               peer="trainer0", timeout=0.3)
+    msg = str(ei.value)
+    assert "'send'" in msg and server.endpoint in msg
+    assert "1/3 arrivals" in msg and "trainer0" in msg
+    hung = _tools_mod("check_test_hung")
+    found = hung.scan_barriers([f"E   RuntimeError: {msg}"])
+    assert found == [{"name": "send", "endpoint": server.endpoint,
+                      "timeout_s": 0.3, "arrived": 1, "needed": 3,
+                      "waiters": ["trainer0"]}]
+    # the timed-out arrival was withdrawn: a later round is clean
+    assert server._dyn_barriers["send"]["arrived"] == []
+    server.stop()
+
+
+def test_barrier_timeout_zero_means_no_deadline():
+    server = RPCServer("127.0.0.1:0")
+    done = []
+
+    def other():
+        done.append(server.barrier_dynamic("b0", lambda: 2, poll=0.02,
+                                           peer="t1", timeout=0))
+
+    th = threading.Thread(target=other, daemon=True)
+    th.start()
+    time.sleep(0.2)
+    r = server.barrier_dynamic("b0", lambda: 2, poll=0.02, peer="t0",
+                               timeout=0)
+    th.join(timeout=5)
+    assert sorted(done + [r]) == [0, 1]
+    server.stop()
+
+
+def test_barrier_duplicate_peer_arrival_is_deduped():
+    """A duplicate arrival from a peer already waiting (an app-level
+    barrier re-invocation) must NOT satisfy the count in place of the
+    missing peer — no phantom release."""
+    server = RPCServer("127.0.0.1:0")
+    results = []
+
+    def arrive(peer):
+        results.append(server.barrier_dynamic(
+            "bd", lambda: 2, poll=0.02, peer=peer, timeout=10.0))
+
+    t1 = threading.Thread(target=arrive, args=("t0",), daemon=True)
+    t1.start()
+    time.sleep(0.2)
+    t2 = threading.Thread(target=arrive, args=("t0",), daemon=True)
+    t2.start()
+    time.sleep(0.3)
+    assert results == []                    # duplicate didn't release
+    t3 = threading.Thread(target=arrive, args=("t1",), daemon=True)
+    t3.start()
+    for t in (t1, t2, t3):
+        t.join(timeout=10)
+    assert len(results) == 3 and sorted(results) == [0, 1, 1]
+    server.stop()
+
+
+def test_dropped_barrier_reply_returns_cached_release(transport):
+    """Reply-loss on a released barrier: the retry must get the CACHED
+    release (exactly-once envelope), not re-arrive a generation late —
+    that offset is what desyncs grad-merge rounds."""
+    server, client = transport
+    server.register_handler(
+        "send_barrier",
+        lambda peer: server.barrier_dynamic("sb", lambda: 2, poll=0.02,
+                                            peer=peer, timeout=10.0))
+    other = type(client)()    # second party, its own connection
+    results = []
+
+    def arrive_other():
+        results.append(other.call(server.endpoint, "send_barrier", "t1"))
+
+    th = threading.Thread(target=arrive_other, daemon=True)
+    plan = FaultPlan().on("send_barrier", 0, "drop")
+    with faultinject.installed(plan):
+        th.start()
+        time.sleep(0.2)
+        r = client.send_barrier(server.endpoint, peer_id="t0")
+        th.join(timeout=10)
+    # exactly one release per party, one leader between them
+    assert sorted(results + [r]) == [0, 1]
+    # and the NEXT round still needs both parties (no phantom arrival)
+    assert server._dyn_barriers["sb"]["arrived"] == []
+    other.close()
+
+
+# ---------------------------------------------------------------------------
+# communicator hardening
+# ---------------------------------------------------------------------------
+
+class _StubTranspiler:
+    """Minimal section-plan surface Communicator needs."""
+
+    def __init__(self, ep):
+        self.endpoints = [ep]
+        self.trainer_id = 0
+        self.param_plan = {"w": [(0, "w.block0", 0, -1)]}
+        self.grad_of = {"w": "w@GRAD"}
+
+    def _grad_section_name(self, pname, sec):
+        return sec.replace(pname, self.grad_of[pname], 1)
+
+
+def _comm_server():
+    server = RPCServer("127.0.0.1:0")
+    got = []
+    server.register_handler(
+        "send_var", lambda p: got.append(np.asarray(p[1]).copy()))
+    server.register_handler("get_var", lambda p: np.zeros(4, np.float32))
+    server.start()
+    return server, got
+
+
+def test_communicator_stop_drains_every_queued_grad():
+    """Satellite: stop() must flush ALL pending merges — a short job's
+    last updates reach the pserver, none are abandoned."""
+    from paddle_tpu.communicator import Communicator
+    from paddle_tpu.core.scope import Scope
+
+    server, got = _comm_server()
+    try:
+        comm = Communicator(_StubTranspiler(server.endpoint), Scope(),
+                            max_merge_var_num=1, send_wait_times=0.01)
+        comm.start()
+        for i in range(40):
+            comm.put("w@GRAD", np.full(4, float(i), np.float32))
+        comm.stop()
+        assert len(got) == 40                         # every put arrived
+        assert sorted(float(g[0]) for g in got) == \
+            [float(i) for i in range(40)]             # no dup, no loss
+        assert comm.errors() == []
+    finally:
+        server.stop()
+
+
+def test_communicator_supervisor_restarts_dead_send_thread():
+    """A send thread killed by an escaped exception reports into the
+    error queue and is restarted with backoff; the requeued grad ships
+    after recovery (late, not never)."""
+    from paddle_tpu.communicator import Communicator
+    from paddle_tpu.core.scope import Scope
+
+    server, got = _comm_server()
+
+    class _Flaky(Communicator):
+        fail_remaining = 2
+
+        def _send_grad(self, g, m):
+            if self.fail_remaining > 0:
+                self.fail_remaining -= 1
+                raise RuntimeError("induced send failure")
+            super()._send_grad(g, m)
+
+    try:
+        comm = _Flaky(_StubTranspiler(server.endpoint), Scope(),
+                      max_merge_var_num=1, send_wait_times=0.01,
+                      restart_backoff=0.02)
+        comm.start()
+        comm.put("w@GRAD", np.full(4, 7.0, np.float32))
+        deadline = time.monotonic() + 20
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.05)
+        comm.stop()
+        assert len(got) == 1 and got[0][0] == 7.0     # delivered once
+        errs = comm.errors()
+        assert len(errs) == 2 and all(n == "send" for n, _ in errs)
+        assert comm.restarts()["send"] >= 2
+    finally:
+        server.stop()
+
+
+def test_communicator_bounded_queue_backpressure():
+    from paddle_tpu.communicator import Communicator
+    from paddle_tpu.core.scope import Scope
+    import queue as queue_mod
+
+    comm = Communicator(_StubTranspiler("127.0.0.1:1"), Scope(),
+                        max_merge_var_num=2, max_queue_per_var=3)
+    for i in range(3):
+        comm.put("w@GRAD", np.ones(2))
+    with pytest.raises(queue_mod.Full):     # not started: queue fills
+        comm.put("w@GRAD", np.ones(2), block=False)
+
+
+# ---------------------------------------------------------------------------
+# crash-resume elasticity (in-process, bit parity)
+# ---------------------------------------------------------------------------
+
+def _elastic_net():
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, layers, optimizer
+
+    np.random.seed(0)
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, 1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+
+    def step_fn(step):
+        rng = np.random.RandomState(100 + step)   # step-keyed data
+        bx = rng.rand(16, 8).astype(np.float32)
+        lv, = exe.run(feed={"x": bx, "y": bx.sum(1, keepdims=True)},
+                      fetch_list=[loss])
+        return float(np.asarray(lv))
+
+    return step_fn
+
+
+def test_elastic_crash_resume_bit_parity(fresh_programs_factory,
+                                         tmp_path):
+    """Kill-and-resume reproduces the uninterrupted trajectory
+    BIT-FOR-BIT: restore brings back params + Adam moments, the loop
+    re-enters at the checkpointed step, step-keyed data replays."""
+    from paddle_tpu.contrib.checkpoint import AsyncCheckpointer
+    from paddle_tpu.distributed.elastic import ElasticTrainer
+
+    with fresh_programs_factory():
+        step_fn = _elastic_net()
+        ck = AsyncCheckpointer(str(tmp_path / "ref"))
+        ref = ElasticTrainer(ck, save_every=4,
+                             wait_each_save=True).run(12, step_fn)
+        ck.close()
+    assert len(ref) == 12
+
+    with fresh_programs_factory():          # incarnation 1: crashes
+        step_fn = _elastic_net()
+        ck = AsyncCheckpointer(str(tmp_path / "crash"))
+        el = ElasticTrainer(ck, save_every=4, wait_each_save=True)
+        assert el.resume() == 0
+        for step in range(9):               # dies after step 8;
+            assert step_fn(step) == ref[step]
+            el.step_done(step)              # ckpt@4, ckpt@8 durable
+        ck.close()                          # scope abandoned = crash
+
+    with fresh_programs_factory():          # incarnation 2: resumes
+        step_fn = _elastic_net()
+        ck = AsyncCheckpointer(str(tmp_path / "crash"))
+        el = ElasticTrainer(ck, save_every=4, wait_each_save=True)
+        start = el.resume()
+        assert start == 8                   # latest durable checkpoint
+        tail = el.run(12, step_fn, start_step=start)
+        ck.close()
+    assert tail == ref[8:12]                # bit-for-bit
+
+
+# ---------------------------------------------------------------------------
+# subprocess cluster legs (slow lane): acceptance-criterion parity
+# ---------------------------------------------------------------------------
+
+_CLUSTER_RUNNER = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.transpiler import (DistributeTranspiler,
+                                       DistributeTranspilerConfig)
+
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    pserver_eps = os.environ["PADDLE_PSERVER_EPS"]
+    current_ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    np.random.seed(7)
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.SGD(0.05).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.min_block_size = 1
+    cfg.heartbeat_timeout = float(os.environ.get("PADDLE_HB_TIMEOUT",
+                                                 "60.0"))
+    t = DistributeTranspiler(cfg)
+    t.transpile(trainer_id, pservers=pserver_eps, trainers=trainers,
+                sync_mode=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == "PSERVER":
+        main = t.get_pserver_program(current_ep)
+        exe.run(t.get_startup_program(current_ep, main))
+        exe.run(main)          # blocks until trainers complete
+        from paddle_tpu.distributed import faultinject
+        inj = faultinject.maybe_injector()
+        print("FAULTS " + json.dumps(inj.log if inj else []))
+        sys.exit(0)
+
+    exe.run(t.get_trainer_startup_program())
+    main = t.get_trainer_program()
+    W = np.arange(13, dtype=np.float32)[:, None] / 13.0
+    losses = []
+    for step in range(20):
+        rng = np.random.RandomState(1000 * (trainer_id + 1) + step)
+        bx = rng.rand(32, 13).astype(np.float32)
+        lv, = exe.run(main, feed={"x": bx, "y": bx @ W},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    from paddle_tpu.distributed.rpc import global_rpc_client
+    client = global_rpc_client()
+    params = {}
+    if trainer_id == 0:        # final pserver-side params, bit-exact
+        for pname, plan in sorted(t.param_plan.items()):
+            for i, sec, s, e in plan:
+                params[sec] = np.asarray(
+                    client.get_var(t.endpoints[i], sec)).tolist()
+    for ep in pserver_eps.split(","):
+        client.send_complete(ep, peer_id="trainer%d" % trainer_id)
+    print("LOSSES " + json.dumps(losses))
+    print("PARAMS " + json.dumps(params))
+""")
+
+
+def _run_chaos_cluster(fault_plan="", rpc_transport="socket",
+                       timeout=240):
+    eps = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(2))
+    env_base = {
+        **os.environ,
+        "PADDLE_TRAINERS_NUM": "2",
+        "PADDLE_PSERVER_EPS": eps,
+        "PADDLE_TPU_RPC_TRANSPORT": rpc_transport,
+        "JAX_PLATFORMS": "cpu",
+    }
+    env_base.pop("PADDLE_TPU_FAULT_PLAN", None)
+    procs, trainers = [], []
+    for ep in eps.split(","):
+        env = {**env_base, "PADDLE_TRAINING_ROLE": "PSERVER",
+               "PADDLE_CURRENT_ENDPOINT": ep}
+        if fault_plan:             # faults injected at the pservers
+            env["PADDLE_TPU_FAULT_PLAN"] = fault_plan
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CLUSTER_RUNNER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for tid in range(2):
+        env = {**env_base, "PADDLE_TRAINING_ROLE": "TRAINER",
+               "PADDLE_TRAINER_ID": str(tid)}
+        trainers.append(subprocess.Popen(
+            [sys.executable, "-c", _CLUSTER_RUNNER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    losses, params, faults = {}, None, []
+    try:
+        for tid, p in enumerate(trainers):
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, err.decode()[-3000:]
+            for ln in out.decode().splitlines():
+                if ln.startswith("LOSSES "):
+                    losses[tid] = json.loads(ln[len("LOSSES "):])
+                if tid == 0 and ln.startswith("PARAMS "):
+                    params = json.loads(ln[len("PARAMS "):])
+        for p in procs:
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, err.decode()[-3000:]
+            for ln in out.decode().splitlines():
+                if ln.startswith("FAULTS "):
+                    faults.extend(json.loads(ln[len("FAULTS "):]))
+    finally:
+        for p in procs + trainers:
+            if p.poll() is None:
+                p.kill()
+    assert sorted(losses) == [0, 1] and params is not None
+    return losses, params, faults
+
+
+# the acceptance plan: first send_var reply dropped, a connection
+# closed mid-frame (truncate), plus request-loss/latency/barrier-reply
+# loss sprinkled across msg types — all must be absorbed exactly-once
+_CHAOS_PLAN = ("send_var@0:drop;send_var@7:truncate;send_var@13:close;"
+               "get_var@3:drop;get_var@11:delay=0.1;get_var@17:close;"
+               "send_barrier@1:drop;fetch_barrier@2:close")
+
+
+@pytest.mark.parametrize("rpc_transport", ["socket", "http"])
+def test_chaos_cluster_parity(rpc_transport):
+    """ISSUE 3 acceptance: under a fault plan that drops the first
+    send_var reply and closes a connection mid-frame (and more), the
+    2-trainer/2-pserver sync run completes with the SAME per-step
+    losses and the SAME final pserver params as the fault-free run —
+    exactly-once dedup proven end-to-end, on both transports."""
+    clean_losses, clean_params, _ = _run_chaos_cluster(
+        "", rpc_transport)
+    chaos_losses, chaos_params, faults = _run_chaos_cluster(
+        _CHAOS_PLAN, rpc_transport)
+    # the plan really fired (on each pserver, at least the send_var
+    # reply-drop)
+    assert [f for f in faults if f[0] == "send_var" and
+            f[2] == "drop"], faults
+    assert chaos_losses == clean_losses          # bit-for-bit
+    assert chaos_params == clean_params
+
+
+_ELASTIC_RUNNER = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.transpiler import (DistributeTranspiler,
+                                       DistributeTranspilerConfig)
+
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+    pserver_eps = os.environ["PADDLE_PSERVER_EPS"]
+    current_ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+    die_at = int(os.environ.get("PADDLE_DIE_AT", "-1"))
+
+    np.random.seed(7)
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.SGD(0.05).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.min_block_size = 1
+    cfg.heartbeat_timeout = 120.0   # survive the dead window
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, pservers=pserver_eps, trainers=1, sync_mode=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == "PSERVER":
+        main = t.get_pserver_program(current_ep)
+        exe.run(t.get_startup_program(current_ep, main))
+        exe.run(main)
+        sys.exit(0)
+
+    exe.run(t.get_trainer_startup_program())
+    main = t.get_trainer_program()
+    from paddle_tpu.contrib.checkpoint import AsyncCheckpointer
+    from paddle_tpu.distributed.elastic import ElasticTrainer
+    ck = AsyncCheckpointer(os.environ["PADDLE_ELASTIC_DIR"])
+    el = ElasticTrainer(ck, transpiler=t, save_every=5,
+                        wait_each_save=True)
+    start = el.resume()             # restores + reregisters + rolls
+    W = np.arange(13, dtype=np.float32)[:, None] / 13.0   # back shards
+    losses = {}
+    for step in range(start, 20):
+        rng = np.random.RandomState(5000 + step)
+        bx = rng.rand(32, 13).astype(np.float32)
+        lv, = exe.run(main, feed={"x": bx, "y": bx @ W},
+                      fetch_list=[loss])
+        losses[str(step)] = float(np.asarray(lv).reshape(-1)[0])
+        el.step_done(step)
+        if die_at >= 0 and step == die_at:
+            os._exit(41)            # crash: no goodbye, no complete
+    el.finish()
+    from paddle_tpu.distributed.rpc import global_rpc_client
+    client = global_rpc_client()
+    for ep in pserver_eps.split(","):
+        client.send_complete(ep, peer_id="trainer0")
+    print("START " + str(start))
+    print("LOSSES " + json.dumps(losses))
+""")
+
+
+def _elastic_leg(ck_dir, die_at=None, timeout=180):
+    """One pserver + a trainer (which may crash and get relaunched);
+    returns {step: loss} union across trainer incarnations."""
+    ep = f"127.0.0.1:{_free_port()}"
+    env_base = {
+        **os.environ,
+        "PADDLE_TRAINERS_NUM": "1",
+        "PADDLE_PSERVER_EPS": ep,
+        "PADDLE_ELASTIC_DIR": str(ck_dir),
+        "JAX_PLATFORMS": "cpu",
+    }
+    env_base.pop("PADDLE_TPU_FAULT_PLAN", None)
+    procs = []
+    ps = subprocess.Popen(
+        [sys.executable, "-c", _ELASTIC_RUNNER],
+        env={**env_base, "PADDLE_TRAINING_ROLE": "PSERVER",
+             "PADDLE_CURRENT_ENDPOINT": ep},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    procs.append(ps)
+    losses = {}
+    try:
+        tr_env = {**env_base, "PADDLE_TRAINING_ROLE": "TRAINER",
+                  "PADDLE_TRAINER_ID": "0"}
+        if die_at is not None:
+            crash = subprocess.Popen(
+                [sys.executable, "-c", _ELASTIC_RUNNER],
+                env={**tr_env, "PADDLE_DIE_AT": str(die_at)},
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            procs.append(crash)
+            out, err = crash.communicate(timeout=timeout)
+            assert crash.returncode == 41, (crash.returncode,
+                                            err.decode()[-2000:])
+        resumed = subprocess.Popen(
+            [sys.executable, "-c", _ELASTIC_RUNNER], env=tr_env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        procs.append(resumed)
+        out, err = resumed.communicate(timeout=timeout)
+        assert resumed.returncode == 0, err.decode()[-3000:]
+        start = None
+        for ln in out.decode().splitlines():
+            if ln.startswith("START "):
+                start = int(ln[len("START "):])
+            if ln.startswith("LOSSES "):
+                losses.update(json.loads(ln[len("LOSSES "):]))
+        _, pserr = ps.communicate(timeout=60)
+        assert ps.returncode == 0, pserr.decode()[-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return start, losses
+
+
+def test_elastic_ps_resume_matches_uninterrupted(tmp_path):
+    """ISSUE 3 acceptance: a trainer killed mid-run (os._exit at step
+    12, checkpoints every 5) is relaunched, restores ckpt@10 via
+    AsyncCheckpointer, re-registers with the pserver, rolls the shards
+    back to the checkpoint cut — and its steps 10..19 reproduce the
+    uninterrupted run's loss trajectory bit-for-bit."""
+    start_u, uninterrupted = _elastic_leg(tmp_path / "clean")
+    assert start_u == 0 and len(uninterrupted) == 20
+    start_r, resumed = _elastic_leg(tmp_path / "crash", die_at=12)
+    assert start_r == 10                     # latest durable checkpoint
+    for step in range(10, 20):
+        assert resumed[str(step)] == uninterrupted[str(step)], step

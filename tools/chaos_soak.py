@@ -1,0 +1,217 @@
+"""Randomized-seed chaos soak of a loopback PS cluster.
+
+Runs N minutes (or --iterations runs) of a 2-trainer/2-pserver sync
+training job with a seeded random fault plan injected at the pservers
+(PADDLE_TPU_FAULT_PLAN: drop/close/delay/truncate at rate --rate,
+bounded by --max-faults), asserting every iteration that the cluster
+completes and converges despite the faults.  Each iteration's plan is
+fully determined by its seed, so any failure replays exactly:
+
+    python tools/chaos_soak.py --seed 1234 --iterations 1   # CI leg
+    python tools/chaos_soak.py --minutes 10                 # soak
+
+Prints one line of JSON to stdout as the verdict:
+    {"ok": true, "iterations": 7, "failures": [], "seeds": [...],
+     "transport": "socket", "wall_s": 123.4}
+Exit code 0 iff every iteration passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+_RUNNER = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.transpiler import (DistributeTranspiler,
+                                       DistributeTranspilerConfig)
+
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    pserver_eps = os.environ["PADDLE_PSERVER_EPS"]
+    current_ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    np.random.seed(7)
+    x = layers.data("x", shape=[13], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    optimizer.SGD(0.05).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.min_block_size = 1
+    cfg.heartbeat_timeout = 30.0
+    t = DistributeTranspiler(cfg)
+    t.transpile(trainer_id, pservers=pserver_eps, trainers=trainers,
+                sync_mode=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == "PSERVER":
+        main = t.get_pserver_program(current_ep)
+        exe.run(t.get_startup_program(current_ep, main))
+        exe.run(main)
+        from paddle_tpu.distributed import faultinject
+        inj = faultinject.maybe_injector()
+        print("FAULTS " + json.dumps(inj.log if inj else []))
+        sys.exit(0)
+
+    exe.run(t.get_trainer_startup_program())
+    main = t.get_trainer_program()
+    W = np.arange(13, dtype=np.float32)[:, None] / 13.0
+    losses = []
+    for step in range(12):
+        rng = np.random.RandomState(1000 * (trainer_id + 1) + step)
+        bx = rng.rand(32, 13).astype(np.float32)
+        lv, = exe.run(main, feed={"x": bx, "y": bx @ W},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    from paddle_tpu.distributed.rpc import global_rpc_client
+    client = global_rpc_client()
+    for ep in pserver_eps.split(","):
+        client.send_complete(ep, peer_id="trainer%d" % trainer_id)
+    print("LOSSES " + json.dumps(losses))
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_iteration(seed, rate, max_faults, transport, timeout):
+    """One faulted cluster run; returns (ok, detail, n_faults)."""
+    plan = (f"seed={seed};rate={rate};"
+            f"actions=drop,close,delay=0.05,truncate;max={max_faults}")
+    eps = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(2))
+    env_base = {
+        **os.environ,
+        "PADDLE_TRAINERS_NUM": "2",
+        "PADDLE_PSERVER_EPS": eps,
+        "PADDLE_TPU_RPC_TRANSPORT": transport,
+        "JAX_PLATFORMS": "cpu",
+    }
+    env_base.pop("PADDLE_TPU_FAULT_PLAN", None)
+    procs, trainers = [], []
+    for ep in eps.split(","):
+        env = {**env_base, "PADDLE_TRAINING_ROLE": "PSERVER",
+               "PADDLE_CURRENT_ENDPOINT": ep,
+               "PADDLE_TPU_FAULT_PLAN": plan}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _RUNNER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for tid in range(2):
+        env = {**env_base, "PADDLE_TRAINING_ROLE": "TRAINER",
+               "PADDLE_TRAINER_ID": str(tid)}
+        trainers.append(subprocess.Popen(
+            [sys.executable, "-c", _RUNNER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    n_faults = 0
+    try:
+        for tid, p in enumerate(trainers):
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                return False, f"trainer{tid} timed out (plan={plan})", 0
+            if p.returncode != 0:
+                return (False, f"trainer{tid} rc={p.returncode}: "
+                        f"{err.decode()[-500:]} (plan={plan})", 0)
+            lines = [ln for ln in out.decode().splitlines()
+                     if ln.startswith("LOSSES ")]
+            if not lines:
+                return False, f"trainer{tid}: no LOSSES (plan={plan})", 0
+            losses = json.loads(lines[0][len("LOSSES "):])
+            if not losses[-1] < losses[0] * 0.6:
+                return (False, f"trainer{tid} did not converge: "
+                        f"{losses[::4]} (plan={plan})", 0)
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                return False, f"pserver hung at shutdown (plan={plan})", 0
+            if p.returncode != 0:
+                return (False, f"pserver rc={p.returncode}: "
+                        f"{err.decode()[-500:]} (plan={plan})", 0)
+            for ln in out.decode().splitlines():
+                if ln.startswith("FAULTS "):
+                    n_faults += len(json.loads(ln[len("FAULTS "):]))
+        return True, "", n_faults
+    finally:
+        for p in procs + trainers:
+            if p.poll() is None:
+                p.kill()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="randomized chaos soak of a loopback PS cluster")
+    ap.add_argument("--minutes", type=float, default=2.0,
+                    help="soak duration budget (ignored with "
+                         "--iterations)")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="exact iteration count (0 = fill --minutes)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="base seed (default: time-derived); iteration "
+                         "i uses seed+i")
+    ap.add_argument("--rate", type=float, default=0.03,
+                    help="per-call fault probability at each pserver")
+    ap.add_argument("--max-faults", type=int, default=12,
+                    help="fault budget per pserver per iteration")
+    ap.add_argument("--transport", choices=["socket", "http", "both"],
+                    default="socket")
+    ap.add_argument("--timeout", type=float, default=240.0,
+                    help="per-iteration trainer timeout (s)")
+    args = ap.parse_args(argv)
+
+    base_seed = args.seed if args.seed is not None \
+        else int(time.time()) % 1_000_000
+    t0 = time.monotonic()
+    seeds, failures, total_faults = [], [], 0
+    i = 0
+    while True:
+        if args.iterations and i >= args.iterations:
+            break
+        if not args.iterations and \
+                time.monotonic() - t0 > args.minutes * 60:
+            break
+        seed = base_seed + i
+        transport = args.transport if args.transport != "both" else \
+            ("socket", "http")[i % 2]
+        ok, detail, n_faults = run_iteration(
+            seed, args.rate, args.max_faults, transport, args.timeout)
+        seeds.append(seed)
+        total_faults += n_faults
+        if not ok:
+            failures.append(detail)
+        print(f"# iter {i} seed={seed} transport={transport} "
+              f"faults={n_faults} {'ok' if ok else 'FAIL: ' + detail}",
+              file=sys.stderr)
+        i += 1
+    verdict = {
+        "ok": not failures and bool(seeds),
+        "iterations": len(seeds),
+        "failures": failures,
+        "seeds": seeds,
+        "faults_injected": total_faults,
+        "transport": args.transport,
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

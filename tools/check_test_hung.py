@@ -6,6 +6,13 @@ tools/check_ctest_hung.py, adapted from ctest logs to `pytest -v` /
     python tools/check_test_hung.py run.log
 
 Prints the set of test ids with no recorded outcome — the hang suspects.
+
+Distributed-test diagnosis: the RPC layer's barrier deadline
+(paddle_tpu/distributed/rpc.py BarrierTimeoutError) prints a one-line
+diagnostic naming the stalled barrier, the serving endpoint, and the
+waiters seen; this tool surfaces those lines next to the hang suspects
+so a wedged cluster test reports WHICH barrier/endpoint stalled rather
+than a bare timeout.
 """
 
 from __future__ import annotations
@@ -20,6 +27,12 @@ _OUTCOME = re.compile(
 _INLINE = re.compile(
     r"^(tests/[\w/]+\.py::[\w\[\]\-\.]+)\s+"
     r"(PASSED|FAILED|ERROR|SKIPPED|XFAIL|XPASS)")
+# the BarrierTimeoutError message contract (rpc.py): barrier 'NAME'
+# @ ENDPOINT timed out after Ts: K/N arrivals, waiters=[...]
+_BARRIER = re.compile(
+    r"barrier '(?P<name>[^']+)' @ (?P<endpoint>\S+) timed out after "
+    r"(?P<timeout>[0-9.]+)s: (?P<arrived>\d+)/(?P<needed>\d+) "
+    r"arrivals, waiters=\[(?P<waiters>[^\]]*)\]")
 
 
 def scan(lines):
@@ -40,18 +53,53 @@ def scan(lines):
     return started - finished
 
 
+def scan_barriers(lines):
+    """Barrier-deadline diagnostics found in the log: a list of dicts
+    with name/endpoint/timeout/arrived/needed/waiters, deduplicated in
+    first-seen order."""
+    out, seen = [], set()
+    for line in lines:
+        m = _BARRIER.search(line)
+        if not m:
+            continue
+        key = (m.group("name"), m.group("endpoint"),
+               m.group("arrived"), m.group("waiters"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append({
+            "name": m.group("name"),
+            "endpoint": m.group("endpoint"),
+            "timeout_s": float(m.group("timeout")),
+            "arrived": int(m.group("arrived")),
+            "needed": int(m.group("needed")),
+            "waiters": [w.strip(" '\"") for w in
+                        m.group("waiters").split(",") if w.strip()],
+        })
+    return out
+
+
 def main():
     if len(sys.argv) < 2:
         print(__doc__)
         return 0
     with open(sys.argv[1], errors="replace") as f:
-        hung = scan(f)
+        lines = f.readlines()
+    hung = scan(lines)
+    barriers = scan_barriers(lines)
+    if barriers:
+        print("Stalled barriers (deadline diagnostics):")
+        for b in barriers:
+            print(f"  barrier '{b['name']}' @ {b['endpoint']}: "
+                  f"{b['arrived']}/{b['needed']} arrivals after "
+                  f"{b['timeout_s']:g}s, waiters={b['waiters']}")
     if hung:
         print("Hung (started, no outcome):")
         for t in sorted(hung):
             print(" ", t)
         return 1
-    print("No hung tests found.")
+    if not barriers:
+        print("No hung tests found.")
     return 0
 
 

@@ -4,7 +4,7 @@
 set -e
 cd "$(dirname "$0")/.."
 
-echo "== 1/7 test suite (virtual 8-device CPU mesh; two lanes) =="
+echo "== 1/8 test suite (virtual 8-device CPU mesh; two lanes) =="
 # fast lane first: cheap tests fail the matrix within ~5 min before
 # the subprocess-cluster/compile-heavy slow lane spends half an hour.
 # Together the lanes are the identical full suite (conftest assigns
@@ -14,14 +14,14 @@ echo "== 1/7 test suite (virtual 8-device CPU mesh; two lanes) =="
 python -m pytest tests/ -q -m "not slow"
 python -m pytest tests/ -q -m "slow" || { rc=$?; [ "$rc" -eq 5 ]; }
 
-echo "== 2/7 op inventory audit vs reference REGISTER_OPERATOR =="
+echo "== 2/8 op inventory audit vs reference REGISTER_OPERATOR =="
 JAX_PLATFORMS=cpu python tools/op_coverage.py
 
-echo "== 3/7 API stability gate =="
+echo "== 3/8 API stability gate =="
 JAX_PLATFORMS=cpu python tools/print_signatures.py paddle_tpu > /tmp/_api_now.spec
 python tools/diff_api.py API.spec /tmp/_api_now.spec
 
-echo "== 4/7 multichip dry-run (8 virtual devices) =="
+echo "== 4/8 multichip dry-run (8 virtual devices) =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 PADDLE_TPU_TEST_PLATFORM=cpu python -c "
 import os; os.environ['JAX_PLATFORMS']='cpu'
@@ -29,12 +29,12 @@ import jax; jax.config.update('jax_platforms','cpu')
 import __graft_entry__ as ge; ge.dryrun_multichip(8)
 print('dryrun_multichip(8) OK')"
 
-echo "== 5/7 benchmark (real chip if attached; tiny CPU run otherwise) =="
+echo "== 5/8 benchmark (real chip if attached; tiny CPU run otherwise) =="
 # CI keeps the TPU probe short; the 15-min retry budget is for real
 # bench rounds (driver invocation), not the validation matrix.
 BENCH_PROBE_BUDGET_S="${BENCH_PROBE_BUDGET_S:-120}" python bench.py
 
-echo "== 6/7 per-op regression gate (hot ops vs committed CPU baseline) =="
+echo "== 6/8 per-op regression gate (hot ops vs committed CPU baseline) =="
 # 3x tolerance absorbs machine load; catches order-of-magnitude
 # per-op regressions (reference op_tester role) before they surface
 # in a model bench
@@ -53,12 +53,21 @@ if [ -f tools/op_bench_baseline_tpu.json ]; then
     --require-tpu-or-skip
 fi
 
-echo "== 7/7 TPU cross-lowering gate (Mosaic legality without a chip) =="
+echo "== 7/8 TPU cross-lowering gate (Mosaic legality without a chip) =="
 # interpret-mode tests never run Mosaic's block-mapping checks; this
 # cross-lowers bench workloads for platform=tpu on the CPU.  The suite
 # (step 1) already lowers transformer/deepfm/int8 via
 # tests/test_tpu_lowering_gate.py, so only the rest run here.
 python tools/tpu_lowering_check.py \
   resnet50_train bert_train resnet50_infer vgg16_infer longctx_train
+
+echo "== 8/8 chaos soak (deterministic seed; both transports) =="
+# short fault-injection leg of the distributed stack: a seeded random
+# plan (replayable from the seed in the verdict line) drops/closes/
+# delays/truncates pserver RPCs; the cluster must complete + converge.
+# tools/chaos_soak.py --minutes N is the long-soak form for unattended
+# runs (docs/FAULT_TOLERANCE.md).
+JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+  --iterations 2 --seed 1234 --transport both
 
 echo "ALL CHECKS PASSED"
