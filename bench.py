@@ -144,7 +144,7 @@ def _build_compiled_fn(compiled, feed, fetch_names):
 
 
 def _build_resnet50_train(batch=128, s2d=False, maxpool_grad=None,
-                          conv_epilogue=False):
+                          conv_epilogue=False, conv_bn_stats=False):
     """Build + init the ResNet-50 bench train step; returns
     (fn, state, feed, loss_name).  Shared by the bench and
     tools/tpu_lowering_check.py so the lowering gate checks exactly
@@ -174,6 +174,12 @@ def _build_resnet50_train(batch=128, s2d=False, maxpool_grad=None,
     # maxpool_grad_algo: "off" is the default graph, not "whatever a
     # previous in-process build left behind"
     set_flags({"conv_epilogue": "on" if conv_epilogue else "off"})
+    # A/B lever: the conv+BN-stats train-chain fusion
+    # (ops/pallas_conv.py conv2d_bn_train) — the IR pass below rewrites
+    # every conv+BN(train)[+residual][+relu] chain onto the two-kernel
+    # fused path (stats as conv sibling outputs + ONE
+    # normalize+residual+relu pass).  Always set explicitly, same rule
+    set_flags({"conv_bn_stats": "on" if conv_bn_stats else "off"})
     model = resnet50(is_test=False)
     # TPU fast path: rewrite the conv stack NHWC before autodiff so the
     # whole step (fwd+bwd) avoids MXU relayouts (see tests/test_layout.py),
@@ -191,6 +197,13 @@ def _build_resnet50_train(batch=128, s2d=False, maxpool_grad=None,
         from paddle_tpu.transpiler import fuse_conv_epilogue
 
         fuse_conv_epilogue(framework.default_main_program(),
+                           protected=[model["loss"].name,
+                                      model["logits"].name,
+                                      model["acc"].name])
+    if conv_bn_stats:
+        from paddle_tpu.transpiler import fuse_conv_bn_train
+
+        fuse_conv_bn_train(framework.default_main_program(),
                            protected=[model["loss"].name,
                                       model["logits"].name,
                                       model["acc"].name])
@@ -215,12 +228,13 @@ def _build_resnet50_train(batch=128, s2d=False, maxpool_grad=None,
 
 
 def bench_resnet50_train(batch=128, chain=30, s2d=True,
-                         maxpool_grad=None, conv_epilogue=False):
+                         maxpool_grad=None, conv_epilogue=False,
+                         conv_bn_stats=False):
     # s2d default flipped after the 2026-08-01 on-chip A/B: mb128+s2d
     # 30.65% MFU vs 30.41% plain (docs/bench_onchip_20260801_0302.json)
     fn, state, feed, loss_name = _build_resnet50_train(
         batch, s2d=s2d, maxpool_grad=maxpool_grad,
-        conv_epilogue=conv_epilogue)
+        conv_epilogue=conv_epilogue, conv_bn_stats=conv_bn_stats)
     sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
     sps = batch / sec_per_step
     peak, kind = _chip_peak_flops()
@@ -238,7 +252,23 @@ def bench_resnet50_train(batch=128, chain=30, s2d=True,
         res["maxpool_grad"] = maxpool_grad
     if conv_epilogue:
         res["conv_epilogue"] = True
+    if conv_bn_stats:
+        res["conv_bn_stats"] = True
     return res
+
+
+def bench_resnet50_train_convbnstats(**kw):
+    """The conv+BN-stats train-chain fusion A/B leg: identical workload
+    and analytic-MFU numerator as rn_train, with every
+    conv+BN(train)[+residual][+relu] chain rewritten onto
+    conv2d_bn_train (ops/pallas_conv.py) — per-channel Σy/Σy² ride out
+    of the conv kernel as sibling outputs and ONE fused
+    normalize+residual+ReLU pass finishes the chain, so the train
+    graph's BN-moment re-read of the conv output disappears.  Queued
+    right behind the convep pair (the train path's structural cut where
+    convep could only fuse the conv itself)."""
+    kw.setdefault("conv_bn_stats", True)
+    return bench_resnet50_train(**kw)
 
 
 def bench_resnet50_train_convep(**kw):
@@ -922,6 +952,10 @@ _LEG_FUNCS = {
     # Pallas kernel graph; rides right after the baseline leg so an
     # on-chip window banks the A/B pair together
     "rn_train_convep": "bench_resnet50_train_convep",
+    # conv+BN-stats train-chain fusion A/B (ops/pallas_conv.py
+    # conv2d_bn_train) — the train path's structural cut; rides behind
+    # the convep pair so a window banks the full A/B/C set together
+    "rn_train_convbnstats": "bench_resnet50_train_convbnstats",
     "tf_train": "bench_transformer_train",
     "bert_train": "bench_bert_train",
     "dfm_train": "bench_deepfm_train",
@@ -947,6 +981,10 @@ _TINY = {
     # off-TPU the conv_epilogue=on auto-impl is the XLA composite, so
     # this checks build/rewrite/dispatch liveness, not the kernel
     "rn_train_convep": dict(batch=8, chain=2),
+    # off-TPU the conv_bn_stats=on auto-impl is the unfused composite,
+    # so the degraded leg checks build/rewrite/dispatch liveness of the
+    # fused train graph, not the kernels
+    "rn_train_convbnstats": dict(batch=8, chain=2),
     "tf_train": dict(batch=2, seq=128, chain=2),
     "bert_train": dict(batch=1, seq=128, chain=1),
     "dfm_train": dict(batch=256, chain=3),
@@ -1023,11 +1061,12 @@ def _workload_sig(key, row):
 
     fam = re.sub(r"_DEGRADED.*$", "", key)
     fam = re.sub(r"_(?:mb|seq|h|d|blk)\d+", "", fam)
-    fam = re.sub(r"_(?:s2d|convep|cmp_pool|bn1p|fastpath|packed|hp2|"
-                 r"fusedadam)(?=_|$)", "", fam)
+    fam = re.sub(r"_(?:s2d|convep|convbnstats|cmp_pool|bn1p|fastpath|"
+                 r"packed|hp2|fusedadam)(?=_|$)", "", fam)
     return (fam, row.get("batch"), row.get("seq"), row.get("heads"),
             row.get("head_dim"), bool(row.get("s2d_stem")),
             bool(row.get("conv_epilogue")),
+            bool(row.get("conv_bn_stats")),
             row.get("maxpool_grad") or "",
             bool(row.get("conv_bn_folded")),
             bool(row.get("packed_stats")), bool(row.get("head_pack")),
@@ -1130,6 +1169,9 @@ def main():
             row("rn_train"),
         key("resnet50_train_convep", "rn_train_convep", mb="batch"):
             row("rn_train_convep"),
+        key("resnet50_train_convbnstats", "rn_train_convbnstats",
+            mb="batch"):
+            row("rn_train_convbnstats"),
         key("transformer_base_train", "tf_train", mb="batch", seq="seq"):
             row("tf_train"),
         key("bert_base_train_seq512", "bert_train", mb="batch", seq="seq"):

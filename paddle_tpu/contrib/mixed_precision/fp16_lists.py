@@ -25,6 +25,11 @@ white_list = {
     # operands, f32 accumulation in VMEM — same story as the conv it
     # replaces
     "conv2d_epilogue",
+    # fused conv+BN(train)+residual+relu (ops/pallas_conv.py): the
+    # conv half is MXU-bound like conv2d; the BN statistics/params
+    # (Scale/BNBias/Mean/Variance) are pinned fp32 by fp16_utils
+    # (_WHITE_KEEP_FP32), matching batch_norm's gray-list treatment
+    "conv2d_bn_train",
 }
 
 # numerically sensitive: keep fp32

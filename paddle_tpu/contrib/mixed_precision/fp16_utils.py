@@ -15,6 +15,24 @@ from paddle_tpu.contrib.mixed_precision.fp16_lists import follow_x_list \
 
 _FLOATS = {"float32", "float64"}
 
+# white-list ops whose numerically sensitive slots must NOT be cast to
+# the low dtype: conv2d_bn_train's BN statistics/params stay fp32 (the
+# unfused graph's batch_norm is gray-listed, so its Scale/Bias/Mean/
+# Variance are never cast — the fused op must match, or running stats
+# would accumulate in bf16)
+_WHITE_KEEP_FP32 = {
+    "conv2d_bn_train": frozenset(
+        {"Scale", "BNBias", "Mean", "Variance"}),
+}
+
+# white-list ops with multiple outputs where only SOME are emitted in
+# the low dtype (conv2d_bn_train: Output follows the bf16 inputs; the
+# stat outputs MeanOut/VarianceOut/SavedMean/SavedVariance stay fp32,
+# like batch_norm's non-Y outputs under the follow-X rule)
+_WHITE_LOWP_OUT = {
+    "conv2d_bn_train": frozenset({"Output"}),
+}
+
 
 def rewrite_program(program, amp_lists, dest_dtype="bfloat16"):
     """Rewrite the global block in place.  White-list ops get their float
@@ -50,7 +68,10 @@ def rewrite_program(program, amp_lists, dest_dtype="bfloat16"):
     for op in block.ops:
         cache = {}
         if op.type in amp_lists.white_list:
+            keep = _WHITE_KEEP_FP32.get(op.type, frozenset())
             for slot, names in list(op.inputs.items()):
+                if slot in keep:
+                    continue
                 out = []
                 for n in names:
                     if eligible(n) and n not in lowp:
@@ -88,6 +109,9 @@ def rewrite_program(program, amp_lists, dest_dtype="bfloat16"):
         for slot, names in op.outputs.items():
             slot_lowp = out_lowp and (
                 op.type not in _FOLLOW_X or slot == "Y")
+            if op.type in _WHITE_LOWP_OUT:
+                slot_lowp = out_lowp and \
+                    slot in _WHITE_LOWP_OUT[op.type]
             for n in names:
                 if slot_lowp and eligible(n):
                     lowp.add(n)

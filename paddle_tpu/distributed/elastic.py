@@ -20,10 +20,18 @@ Resume contract (docs/FAULT_TOLERANCE.md):
     timeout generous enough to cover relaunch (a fenced peer is
     un-fenced by the reregister RPC, but a pserver whose every trainer
     is fenced shuts itself down);
-  - trainer-side persistables only: with optimizer state living on the
-    pservers (momentum etc.), bit-parity additionally needs the
-    pserver-side checkpoint_notify path — SGD-style stateless-pserver
-    setups resume exactly from the trainer checkpoint alone.
+  - with optimizer state living on the pservers (momentum/Adam
+    shards), pass ``ps_state_dir``: every trainer checkpoint then also
+    triggers a ``checkpoint_notify`` snapshot of each pserver's WHOLE
+    scope (param sections + optimizer accumulators) at the same step
+    cut, and resume() rolls the shards back via ``checkpoint_restore``
+    — exact resume under stateful pserver optimizers.  In sync mode
+    the cut is consistent for free: the pserver can't apply the next
+    round until EVERY trainer reaches the send barrier, and the
+    notifying trainer hasn't.  Without ``ps_state_dir`` (or when the
+    snapshot is missing, e.g. a pserver relaunched on a fresh disk)
+    resume falls back to the params-only section push — exact for
+    SGD-style stateless-pserver setups only.
 
     ck = AsyncCheckpointer(dirname)
     el = ElasticTrainer(ck, transpiler=t, save_every=5)
@@ -44,7 +52,7 @@ __all__ = ["ElasticTrainer"]
 class ElasticTrainer:
     def __init__(self, checkpointer, transpiler=None, endpoints=(),
                  peer_id=None, save_every=10, program=None, scope=None,
-                 wait_each_save=False):
+                 wait_each_save=False, ps_state_dir=None):
         """checkpointer: contrib.checkpoint.AsyncCheckpointer.
         transpiler: a transpiled DistributeTranspiler — supplies the
         pserver endpoints, the peer id, and the section plan for the
@@ -54,7 +62,15 @@ class ElasticTrainer:
         default_main_program / global scope).  wait_each_save: block
         until each checkpoint is durable before continuing — slower,
         but a crash can then lose at most save_every steps (async
-        saves in flight at crash time are not durable)."""
+        saves in flight at crash time are not durable).
+        ps_state_dir: directory (shared or per-host) for pserver-side
+        scope snapshots — each trainer checkpoint also sends a
+        ``checkpoint_notify`` so the pservers snapshot their params AND
+        optimizer accumulators at the same step cut, and resume() rolls
+        them back via ``checkpoint_restore`` (exact resume under
+        momentum/Adam pserver shards; see the resume contract above).
+        Only trainer 0 should pass it in multi-trainer setups (one
+        snapshot per cut suffices)."""
         self._ck = checkpointer
         self._t = transpiler
         self._endpoints = list(endpoints) or (
@@ -66,6 +82,7 @@ class ElasticTrainer:
         self._program = program
         self._scope = scope
         self._wait_each_save = bool(wait_each_save)
+        self._ps_dir = None if ps_state_dir is None else str(ps_state_dir)
 
     # ------------------------------------------------------------ resume
     def resume(self):
@@ -79,9 +96,52 @@ class ElasticTrainer:
             self._ck.restore(step, program=self._program,
                              scope=self._scope)
         self.reregister()
-        if step is not None and self._t is not None:
-            self._push_restored_params()
+        if step is not None:
+            # exact path first: roll every pserver's scope (params +
+            # optimizer accumulators) back to the same step cut; only
+            # when a shard has no snapshot fall back to the params-only
+            # section push (exact for stateless pserver optimizers)
+            if not self._restore_ps_state(int(step)) and \
+                    self._t is not None:
+                self._push_restored_params()
         return 0 if step is None else int(step)
+
+    def _restore_ps_state(self, step):
+        """checkpoint_restore on every pserver; True iff EVERY endpoint
+        restored a non-empty snapshot for `step` (partial restores fall
+        back to the push so params at least stay consistent)."""
+        if not self._ps_dir or not self._endpoints:
+            return False
+        from paddle_tpu.distributed.rpc import global_rpc_client
+
+        client = global_rpc_client()
+        ok = True
+        for ep in self._endpoints:
+            try:
+                n = client.call(ep, "checkpoint_restore",
+                                (self._ps_dir, int(step)))
+            except Exception:
+                n = 0
+            ok = ok and bool(n)
+        return ok
+
+    def _notify_ps_snapshot(self, step):
+        """Ask every pserver to snapshot its scope at this step cut
+        (sync mode makes the cut consistent: the next round can't apply
+        until this trainer reaches the send barrier).  Best-effort — a
+        failed snapshot degrades that step's resume to the params-only
+        push, it must not kill training."""
+        if not self._ps_dir or not self._endpoints:
+            return
+        from paddle_tpu.distributed.rpc import global_rpc_client
+
+        client = global_rpc_client()
+        for ep in self._endpoints:
+            try:
+                client.call(ep, "checkpoint_notify",
+                            (self._ps_dir, int(step)))
+            except Exception:
+                pass
 
     def reregister(self):
         """Announce this trainer to the pservers again: un-fence the
@@ -127,6 +187,7 @@ class ElasticTrainer:
         if self._save_every > 0 and (int(step) + 1) % self._save_every == 0:
             self._ck.save(int(step) + 1, program=self._program,
                           scope=self._scope)
+            self._notify_ps_snapshot(int(step) + 1)
             if self._wait_each_save:
                 self._ck.wait()
 

@@ -563,7 +563,7 @@ class RPCClient:
     IDEMPOTENT = frozenset({
         "get_var", "prefetch_rows", "heartbeat", "health",
         "live_trainers", "dead_trainers", "init_done", "init_wait",
-        "checkpoint_notify", "reregister",
+        "checkpoint_notify", "checkpoint_restore", "reregister",
     })
     # non-idempotent but retry-safe through the server-side dedup
     # cache.  Barriers are here on purpose: a retried barrier whose

@@ -90,6 +90,20 @@ define_flag("conv_epilogue", "off",
             "tests).  Built for the rn50 HBM-bound diagnosis: ~9.3 "
             "GB/step of residual/ReLU glue XLA won't fuse into its "
             "conv custom-calls (VERDICT r5)")
+define_flag("conv_bn_stats", "off",
+            "fused conv+BN(train) Pallas path (ops/pallas_conv.py "
+            "conv2d_bn_stats / bn_normalize_epilogue) for the rewritten "
+            "conv2d_bn_train op: 'off' = the exact unfused composite "
+            "(default; zero behavior change — conv, _moments_1pass "
+            "stats, normalize, residual, relu), 'on' = two one-pass "
+            "Pallas kernels on TPU / unfused composite elsewhere, "
+            "'pallas' / 'interpret' / 'xla' force one impl.  The TRAIN-"
+            "side sibling of conv_epilogue: BN batch stats sit between "
+            "conv and residual add, so the train chain re-reads the "
+            "conv output twice (moments, then normalize); the stats "
+            "ride out of the conv kernel as sibling outputs and ONE "
+            "fused normalize+residual+ReLU pass finishes the chain "
+            "(ROADMAP rn50 >=50% MFU item, ISSUE 4)")
 define_flag("flash_packed_stats", "off",
             "flash-attention row-stats layout: 'off' = the validated "
             "lane-replicated [B*H, T, 128] f32 log-sum-exp (plus two "

@@ -292,15 +292,25 @@ def _moments_1pass(xf, axes):
 
 
 @register_op("batch_norm",
-             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             inputs=("X", "Scale", "Bias", "Mean", "Variance",
+                     "BatchMean", "BatchVariance"),
              outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
                       "SavedVariance"),
+             optional=("BatchMean", "BatchVariance"),
              attrs={"epsilon": 1e-5, "momentum": 0.9, "is_test": False,
                     "data_layout": "NCHW", "use_global_stats": False})
 def batch_norm(ins, attrs):
     """reference batch_norm_op.cc.  Running stats are data inputs/outputs so
     the op stays pure; the layer wires MeanOut/VarianceOut back onto the same
-    persistable vars (in-place update, like the reference)."""
+    persistable vars (in-place update, like the reference).
+
+    Optional BatchMean/BatchVariance inputs supply PRECOMPUTED batch
+    statistics for train mode, skipping the `_moments_1pass` reduction
+    over X entirely — the consumer half of the conv+BN-stats fusion
+    (ops/pallas_conv.py conv2d_bn_stats emits the moments as sibling
+    outputs of the conv kernel, so the extra read pass over the conv
+    output disappears from the HBM roofline).  Ignored in eval/global-
+    stats mode, where the running stats already serve that role."""
     x = ins["X"]
     scale, bias = ins["Scale"], ins["Bias"]
     mean, var = ins["Mean"], ins["Variance"]
@@ -317,7 +327,11 @@ def batch_norm(ins, attrs):
         saved_mean = jnp.zeros_like(mean)
         saved_var = jnp.zeros_like(var)
     else:
-        use_mean, use_var = _moments_1pass(xf, axes)
+        if "BatchMean" in ins and "BatchVariance" in ins:
+            use_mean = ins["BatchMean"].astype(mean.dtype)
+            use_var = ins["BatchVariance"].astype(var.dtype)
+        else:
+            use_mean, use_var = _moments_1pass(xf, axes)
         mean_out = mean * mom + lax.stop_gradient(use_mean) * (1 - mom)
         var_out = var * mom + lax.stop_gradient(use_var) * (1 - mom)
         saved_mean = use_mean
@@ -335,11 +349,13 @@ def batch_norm(ins, attrs):
 
 
 @register_op("batch_norm_grad",
-             inputs=("X", "Scale", "Bias", "Mean", "Variance", "Y@GRAD",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance",
+                     "BatchMean", "BatchVariance", "Y@GRAD",
                      "MeanOut@GRAD", "VarianceOut@GRAD", "SavedMean@GRAD",
                      "SavedVariance@GRAD"),
              outputs=("X@GRAD", "Scale@GRAD", "Bias@GRAD"),
-             optional=("Bias", "Mean", "Variance", "MeanOut@GRAD",
+             optional=("Bias", "Mean", "Variance", "BatchMean",
+                       "BatchVariance", "MeanOut@GRAD",
                        "VarianceOut@GRAD", "SavedMean@GRAD",
                        "SavedVariance@GRAD"),
              attrs={"epsilon": 1e-5, "momentum": 0.9, "is_test": False,
@@ -356,7 +372,14 @@ def batch_norm_grad(ins, attrs):
     The auto-vjp grad would store fp32 intermediates of X's size (x_hat and
     the f32 upcast of x); this saves only X itself — mean/var recomputation
     CSEs with the forward pass under the compiled executor.  Statistics math
-    in fp32, dx emitted in X's dtype (AMP-friendly)."""
+    in fp32, dx emitted in X's dtype (AMP-friendly).
+
+    Optional BatchMean/BatchVariance mirror the forward op: when the
+    forward consumed precomputed batch stats, the backward must use the
+    SAME values (the train formula above already accounts for the
+    stats' dependence on X analytically, so it applies unchanged) —
+    and skips its own `_moments_1pass` recompute, the second read pass
+    the conv+BN-stats fusion removes."""
     x, dy, scale = ins["X"], ins["Y@GRAD"], ins["Scale"]
     eps = attrs["epsilon"]
     axes = (0, 2, 3) if (x.ndim == 4 and attrs["data_layout"] == "NCHW") \
@@ -378,7 +401,11 @@ def batch_norm_grad(ins, attrs):
         return {"X@GRAD": dx.astype(x.dtype), "Scale@GRAD": dscale,
                 "Bias@GRAD": dbias}
     m = float(np.prod([x.shape[a] for a in axes]))
-    mean, var = _moments_1pass(xf, axes)
+    if "BatchMean" in ins and "BatchVariance" in ins:
+        mean = ins["BatchMean"].astype(f32)
+        var = ins["BatchVariance"].astype(f32)
+    else:
+        mean, var = _moments_1pass(xf, axes)
     rstd = lax.rsqrt(var + eps)
     x_hat = (xf - mean.reshape(shape)) * rstd.reshape(shape)
     dbias = jnp.sum(dyf, axis=axes)

@@ -487,16 +487,72 @@ def listen_and_serv_op(op, block, scope, ctx):
             return path
         raise ValueError(f"unknown profile command {payload!r}")
 
-    def on_checkpoint(dirname):
+    def _ckpt_step_dir(dirname, step):
         import os
-        os.makedirs(dirname, exist_ok=True)
+        ep_san = attrs["endpoint"].replace(":", "_").replace("/", "_")
+        return os.path.join(str(dirname), "ps_%s" % ep_san,
+                            "step_%d" % int(step))
+
+    def on_checkpoint(payload):
+        """Snapshot the WHOLE pserver scope — param sections AND the
+        optimizer accumulators the optimize blocks created (momentum
+        velocities, Adam moments) — so ElasticTrainer.resume() is exact
+        under stateful pserver optimizers (ROADMAP open item from
+        PR 3).  payload: a plain dirname (legacy flat snapshot) or
+        (dirname, step) — then the snapshot lands in a per-endpoint
+        per-step subdir, written to a tmp dir and atomically renamed so
+        a crash mid-snapshot can never leave a torn step dir a later
+        restore would half-load.  A MANIFEST.json maps files back to
+        var names ('/' is mangled in filenames)."""
+        import json as _json
+        import os
+        stepped = isinstance(payload, (tuple, list))
+        dirname = _ckpt_step_dir(*payload) if stepped else str(payload)
+        # per-thread tmp suffix: a transparently retried notify must
+        # never race the original onto the same staging dir
+        outdir = "%s.tmp%d" % (dirname, threading.get_ident()) \
+            if stepped else dirname
+        os.makedirs(outdir, exist_ok=True)
+        manifest = {}
         with lock:
             for name, var in scope.vars.items():
                 v = var.get()
                 if v is not None and hasattr(v, "dtype"):
-                    np.save(os.path.join(
-                        dirname, name.replace("/", "_") + ".npy"),
-                        _np(v))
+                    fname = name.replace("/", "_") + ".npy"
+                    np.save(os.path.join(outdir, fname), _np(v))
+                    manifest[fname] = name
+        with open(os.path.join(outdir, "MANIFEST.json"), "w") as f:
+            _json.dump(manifest, f)
+        if stepped:
+            import shutil
+            if os.path.isdir(dirname):
+                shutil.rmtree(dirname)
+            os.replace(outdir, dirname)
+        return len(manifest)
+
+    def on_checkpoint_restore(payload):
+        """Load a (dirname, step) snapshot back into the scope: params
+        roll back to the checkpoint cut AND the optimizer state comes
+        with them.  Returns the number of vars restored; 0 when no such
+        snapshot exists (the caller falls back to the params-only
+        push).  Idempotent."""
+        import json as _json
+        import os
+        dirname = _ckpt_step_dir(*payload)
+        man_path = os.path.join(dirname, "MANIFEST.json")
+        if not os.path.isdir(dirname) or not os.path.exists(man_path):
+            return 0
+        with open(man_path) as f:
+            manifest = _json.load(f)
+        n = 0
+        with lock:
+            for fname, name in manifest.items():
+                path = os.path.join(dirname, fname)
+                if not os.path.exists(path):
+                    continue
+                scope.var(name).set(jnp.asarray(np.load(path)))
+                n += 1
+        return n
 
     # elastic liveness: trainers heartbeat; sync barriers re-count to
     # the live non-completed trainer set so survivors CONTINUE when a
@@ -538,6 +594,7 @@ def listen_and_serv_op(op, block, scope, ctx):
     server.register_handler("init_done", on_init_done)
     server.register_handler("init_wait", on_init_wait)
     server.register_handler("checkpoint_notify", on_checkpoint)
+    server.register_handler("checkpoint_restore", on_checkpoint_restore)
     server.register_handler("profile", on_profile)
     server.start()
     try:

@@ -6,6 +6,8 @@ from paddle_tpu.transpiler.collective import (Collective,  # noqa: F401
                                               GradAllReduce, LocalSGD)
 from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401
     DistributeTranspiler, DistributeTranspilerConfig, slice_variable)
+from paddle_tpu.transpiler.conv_bn_train_transpiler import (  # noqa: F401
+    FuseConvBnTrainTranspiler, fuse_conv_bn_train)
 from paddle_tpu.transpiler.conv_epilogue_transpiler import (  # noqa: F401
     FuseConvEpilogueTranspiler, fuse_conv_epilogue)
 from paddle_tpu.transpiler.inference_transpiler import (  # noqa: F401

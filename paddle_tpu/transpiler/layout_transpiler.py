@@ -112,10 +112,11 @@ class _Rewriter:
 
     def rewrite(self, op):
         t = op.type
-        if t == "conv2d_epilogue":
-            # fused conv+epilogue (ops/pallas_conv.py): Input AND the
-            # optional Residual ride in NHWC; the 1-D Bias is
-            # layout-independent; Filter stays OIHW like plain conv2d
+        if t in ("conv2d_epilogue", "conv2d_bn_train"):
+            # fused conv+epilogue / conv+BN-train (ops/pallas_conv.py):
+            # Input AND the optional Residual ride in NHWC; the 1-D
+            # Bias/Scale/BNBias/Mean/Variance are layout-independent;
+            # Filter stays OIHW like plain conv2d
             op.inputs["Input"][0] = self.as_nhwc(op.inputs["Input"][0])
             if "Residual" in op.inputs:
                 op.inputs["Residual"][0] = self.as_nhwc(
@@ -210,8 +211,10 @@ def nhwc_transpile(program):
     append_backward/minimize); raises otherwise.  Returns the program.
     """
     _assert_forward_only(program, "nhwc_transpile")
+    _fused_conv = {"conv2d_epilogue", "conv2d_bn_train"}
     for block in program.blocks:
-        if not any(op.type in _CONV_LIKE for op in block.ops):
+        if not any(op.type in _CONV_LIKE or op.type in _fused_conv
+                   for op in block.ops):
             continue
         rw = _Rewriter(block)
         for op in block.ops:

@@ -54,6 +54,12 @@ TASK_KEYS = {
     # round-6 tentpole): train-side (flag flips every conv onto the
     # kernel) and inference-side (conv-bn fold + full chain fusion)
     "rn_train_mb128_convep": ("resnet50_train_mb128_convep", None),
+    # conv+BN-stats train-chain fusion (ops/pallas_conv.py
+    # conv2d_bn_train, ISSUE 4): stats as conv sibling outputs + one
+    # fused normalize+residual+relu pass — the train-path structural
+    # cut behind the convep pair
+    "rn_train_mb128_convbnstats": (
+        "resnet50_train_mb128_convbnstats", None),
     "rn_infer_mb128_convep": ("resnet50_infer_bf16_convep_mb128",
                               bench.BASELINE_INFER_MS),
     "tf_train_mb64": ("transformer_base_train_mb64", None),
@@ -132,7 +138,8 @@ PRIMARY = {
                        "resnet50_train_mb128_s2d",
                        "resnet50_train_mb128_cmp_pool",
                        "resnet50_train_mb128_bn1p",
-                       "resnet50_train_mb128_convep"],
+                       "resnet50_train_mb128_convep",
+                       "resnet50_train_mb128_convbnstats"],
     "transformer_base_train": ["transformer_base_train",
                                "transformer_base_train_mb64",
                                "transformer_base_train_mb128",

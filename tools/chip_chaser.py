@@ -73,6 +73,17 @@ TASKS = [
     # itself (BN batch stats sit between conv and the residual add)
     ("rn_infer_mb128_convep", "infer",
      {"batch": 128, "chain": 60, "conv_epilogue": True}),
+    # ---- ISSUE 4: conv+BN-STATS train-chain fusion, queued right
+    # behind the convep pair.  The train graph's structural cut: convep
+    # can only fuse the conv itself on the train path (BN batch stats
+    # sit between conv and residual add), so this leg prices the full
+    # chain — per-channel Σy/Σy² as conv-kernel sibling outputs + ONE
+    # fused normalize+residual+relu pass (flag conv_bn_stats,
+    # transpiler.fuse_conv_bn_train).  Compare against the rn_train /
+    # rn_train_convep rows: the ~9.3 GB/step of BN/residual/relu glue
+    # plus the BN-moment re-read should leave the roofline.
+    ("rn_train_mb128_convbnstats", "rn_train_convbnstats",
+     {"batch": 128, "chain": 20}),
     # ---- transformer batch-slide diagnosis (VERDICT r5 next-round
     # #6: 50.17% @mb32 -> 42.02% @mb128 with no banked explanation).
     # The un-probed interior batch points plus the Adam-tail

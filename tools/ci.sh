@@ -32,7 +32,28 @@ print('dryrun_multichip(8) OK')"
 echo "== 5/8 benchmark (real chip if attached; tiny CPU run otherwise) =="
 # CI keeps the TPU probe short; the 15-min retry budget is for real
 # bench rounds (driver invocation), not the validation matrix.
-BENCH_PROBE_BUDGET_S="${BENCH_PROBE_BUDGET_S:-120}" python bench.py
+# stdout is captured and gated: the driver parses bench stdout as ONE
+# JSON line, and twice (BENCH_r04/r05) extra/oversized output left the
+# round artifact with parsed=null — this guard makes that a CI failure
+# instead of a silent dead round.
+BENCH_PROBE_BUDGET_S="${BENCH_PROBE_BUDGET_S:-120}" python bench.py \
+  > /tmp/_bench_stdout.json
+cat /tmp/_bench_stdout.json
+python - <<'PY'
+import json
+lines = [ln for ln in open("/tmp/_bench_stdout.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, (
+    "bench.py stdout must be exactly ONE JSON line (driver contract; "
+    "BENCH_r04/r05 regression) — got %d lines" % len(lines))
+rec = json.loads(lines[0])
+missing = {"metric", "value", "unit", "vs_baseline", "degraded_to_cpu",
+           "headline_source", "rows_file", "n_rows"} - set(rec)
+assert not missing, "bench JSON line missing headline fields: %s" % (
+    sorted(missing),)
+assert isinstance(rec["value"], (int, float)), rec["value"]
+print("bench stdout contract OK: 1 line, %d headline fields" % len(rec))
+PY
 
 echo "== 6/8 per-op regression gate (hot ops vs committed CPU baseline) =="
 # 3x tolerance absorbs machine load; catches order-of-magnitude
@@ -59,7 +80,8 @@ echo "== 7/8 TPU cross-lowering gate (Mosaic legality without a chip) =="
 # (step 1) already lowers transformer/deepfm/int8 via
 # tests/test_tpu_lowering_gate.py, so only the rest run here.
 python tools/tpu_lowering_check.py \
-  resnet50_train bert_train resnet50_infer vgg16_infer longctx_train
+  resnet50_train resnet50_train_convbnstats bert_train resnet50_infer \
+  vgg16_infer longctx_train
 
 echo "== 8/8 chaos soak (deterministic seed; both transports) =="
 # short fault-injection leg of the distributed stack: a seeded random

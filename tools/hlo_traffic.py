@@ -184,7 +184,35 @@ def roofline_rows(hlo_text):
     return rows
 
 
-def build_resnet(batch, nhwc=True, bf16=True):
+def build_resnet(batch, nhwc=True, bf16=True, conv_bn_stats=False):
+    """conv_bn_stats=True builds the EXACT bench graph of the
+    rn_train_convbnstats leg (fuse_conv_bn_train + AMP + NHWC) so the
+    roofline can show the BN-moment re-read of the conv output is gone
+    — the ISSUE 4 acceptance check.  The default build stays the plain
+    local construction below (kept so historical reports diff)."""
+    if conv_bn_stats:
+        import jax
+
+        from bench import _build_resnet50_train
+        from paddle_tpu.flags import set_flags
+
+        out = _build_resnet50_train(batch, conv_bn_stats=True)[:3]
+        if jax.devices()[0].platform != "tpu":
+            # off-chip the "on" auto-impl is the unfused composite,
+            # which would make this report identical to the plain one;
+            # interpret mode keeps the kernel structure (stats as conv
+            # sibling outputs, one normalize pass) in the compiled
+            # graph so the moments-re-read check below is real.  The
+            # roofline NUMBERS of an interpreted kernel are not — only
+            # the on-chip run prices the fused graph.
+            print("(CPU host: conv_bn_stats=interpret — structure "
+                  "check only, not a roofline)", file=sys.stderr)
+            set_flags({"conv_bn_stats": "interpret"})
+        return out
+    return _build_resnet_plain(batch, nhwc=nhwc, bf16=bf16)
+
+
+def _build_resnet_plain(batch, nhwc=True, bf16=True):
     import jax
     import jax.numpy as jnp
 
@@ -237,10 +265,17 @@ def main():
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--min-mb", type=float, default=1.0)
+    ap.add_argument("--conv-bn-stats", action="store_true",
+                    help="build the fused conv+BN-stats train graph "
+                         "(flag conv_bn_stats, fuse_conv_bn_train) — "
+                         "the report should show the standalone "
+                         "BN-moment reduction re-read of the conv "
+                         "output is gone (ISSUE 4 acceptance)")
     args = ap.parse_args()
 
     if args.model == "resnet50":
-        fn, state, feed = build_resnet(args.batch)
+        fn, state, feed = build_resnet(
+            args.batch, conv_bn_stats=args.conv_bn_stats)
     else:
         fn, state, feed = build_deepfm(args.batch if args.batch != 128
                                        else 2048)
@@ -302,6 +337,23 @@ def main():
     print(f"\n== top {args.top} top-level ops by bytes ==")
     for (opcode, name), b in by_op.most_common(args.top):
         print(f"  {b/1e9:7.3f} GB  {opcode:12s} {name[:90]}")
+
+    # the ISSUE 4 acceptance probe: the train graph's standalone
+    # BN-moment reduction re-reads the full conv output once per BN —
+    # in the fused graph those moments ride out of the conv kernel as
+    # sibling outputs, so the big top-level reduces must be gone.
+    # Printed for every run so the plain-vs-fused A/B is one diff.
+    act_bytes = 4 * args.batch * 56 * 56 * 64   # smallest rn50 conv out
+    big_red = [(b, name) for opcode, b, name in rr
+               if opcode == "reduce" and b >= act_bytes]
+    print(f"\n== BN-moments check: top-level reduce ops reading "
+          f">= one conv activation ({act_bytes / 1e6:.0f} MB) ==")
+    print(f"  {len(big_red)} ops, {sum(b for b, _ in big_red) / 1e9:.3f}"
+          f" GB")
+    print("  (the fused conv_bn_stats graph drops every FORWARD "
+          "BN-moment re-read of the conv output — stats ride out of "
+          "the conv kernel; the backward's dbias/dscale sums remain "
+          "in both graphs)")
 
     ca = comp.cost_analysis()
     if isinstance(ca, list):

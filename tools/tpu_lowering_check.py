@@ -57,6 +57,13 @@ def _workloads():
             128, conv_epilogue=True)[:3],
         "resnet50_infer_convep": lambda: _infer(
             bench, "resnet", 128, conv_epilogue=True),
+        # conv+BN-stats train-chain fusion (ISSUE 4): the stat sibling
+        # outputs' (1, bco) blocks and the one-pass normalize kernel's
+        # row blocks are exactly the construct class Mosaic may reject
+        # while interpret mode stays green — cross-lower BEFORE the
+        # chaser spends a window on the rn_train_convbnstats leg
+        "resnet50_train_convbnstats": lambda:
+            bench._build_resnet50_train(128, conv_bn_stats=True)[:3],
         # flash memory-overhaul variants (ops/pallas_kernels.py): the
         # packed (bq/128, 128) row-stats block and the in-kernel
         # (bq,)<->(bq/128, 128) relayout are EXACTLY the construct
