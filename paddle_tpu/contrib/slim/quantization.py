@@ -179,12 +179,34 @@ def quant_aware(program, scope=None, weight_bits=8, activation_bits=8,
         startup_program=startup_program).apply(program)
 
 
+# ops whose outputs are int8-interlayer fold-boundary candidates: the
+# tensor a fused requantize would emit (and a downstream quantized op
+# would consume) can sit behind a BN-fold bias add and/or a ReLU, not
+# just directly on a conv input
+_FOLD_BOUNDARY_OPS = ("relu", "elementwise_add")
+
+_warned_zero_scale = [False]
+
+
 def post_training_quantize(program, scope, executor, feed_batches,
                            fetch_list=None, weight_bits=8,
-                           activation_bits=8):
+                           activation_bits=8, fold_boundaries=False):
     """PTQ: run calibration batches, collect per-tensor abs-max for every
     quantizable-op input, return {var: scale} + int8 weights
-    (reference contrib/quantize post-training path)."""
+    (reference contrib/quantize post-training path).
+
+    fold_boundaries=True additionally records scales at every int8
+    fold boundary — quantizable-op OUTPUTS and relu/elementwise_add
+    outputs — which the interlayer pass
+    (convert_to_int8_execution(int8_activations=True)) needs: the
+    tensor its fused requantize emits is a chain TAIL, not necessarily
+    the raw conv input name (ISSUE 5).
+
+    Scales for tensors the calibration batches actually observed are
+    floored at 1e-8 at record time: an all-zero batch used to record
+    0.0, which convert_to_int8_execution reads as "never calibrated"
+    and silently routes down the 2x-slower dynamic path.  0.0 still
+    means "never observed" (e.g. a scope-retention miss)."""
     block = program.global_block()
     act_names = set()
     params = {v.name for v in program.all_parameters()}
@@ -198,16 +220,38 @@ def post_training_quantize(program, scope, executor, feed_batches,
                         weight_names.add(n)
                     else:
                         act_names.add(n)
+        if fold_boundaries and op.type in (
+                _QUANTIZABLE + _FOLD_BOUNDARY_OPS):
+            for names in op.outputs.values():
+                act_names.update(names)
+    act_names -= params
     scales = {n: 0.0 for n in act_names}
+    observed = set()
     for feed in feed_batches:
         executor.run(program, feed=feed,
                      fetch_list=fetch_list or [], scope=scope)
         for n in act_names:
             var = scope.find_var(n)
             if var is not None and var.get() is not None:
+                observed.add(n)
                 scales[n] = max(scales[n],
                                 float(np.max(np.abs(np.asarray(
                                     var.get())))))
+    zeros = [n for n in observed if scales[n] <= 0.0]
+    if zeros:
+        if not _warned_zero_scale[0]:
+            import warnings
+
+            warnings.warn(
+                "post_training_quantize: %d activation(s) were observed "
+                "all-zero during calibration (e.g. %s); flooring their "
+                "recorded scales at 1e-8 so they stay on the calibrated "
+                "static-scale path instead of silently falling back to "
+                "the dynamic max-reduction" % (len(zeros), zeros[0]),
+                stacklevel=2)
+            _warned_zero_scale[0] = True
+        for n in zeros:
+            scales[n] = 1e-8
     bnd = float(2 ** (weight_bits - 1) - 1)
     weights = {}
     for n in weight_names:
@@ -276,7 +320,8 @@ _INT8_EXEC_WSLOT = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
 
 def convert_to_int8_execution(program, scope, quant_weights,
                               weight_bits=8, act_scales=None,
-                              out_dtype="float32"):
+                              out_dtype="float32",
+                              int8_activations=None, protected=None):
     """Rewrite a frozen inference program so quantized matmuls/convs
     EXECUTE on int8 operands with int32 accumulation (round-3 verdict
     weak #2: convert_to_int8_inference saves bytes but still computes
@@ -293,7 +338,23 @@ def convert_to_int8_execution(program, scope, quant_weights,
     without a calibrated scale quantize dynamically as before.
     out_dtype="bfloat16" halves inter-layer activation traffic.
     Quantized weights consumed by unsupported ops fall back to the
-    dequantize-on-load path."""
+    dequantize-on-load path.
+
+    int8_activations (ISSUE 5; None = read typed flag
+    ``int8_interlayer``, default off): a second pass folds, for every
+    quantized-op -> quantized-op edge, the producer's dequant, the
+    folded-BN bias add, the ReLU, and the consumer's quant into ONE
+    per-channel ``requantize`` op — the producer emits its raw int32
+    accumulator (out_dtype="int32") and the tensor crossing the layer
+    boundary in HBM is int8.  Requires calibrated scales on both sides
+    of every folded edge (calibrate with
+    post_training_quantize(fold_boundaries=True)).  Edges whose chain
+    feeds a non-quantized consumer (residual adds, pools, fetch
+    targets, `protected` names) keep the unfused float path — flag-off
+    output is bit-identical to the calibrated path, flag-on output is
+    bit-identical too (the requantize mirrors the unfused chain op for
+    op; asserted in tests/test_quantization.py).  Fold statistics land
+    on ``program._int8_interlayer_stats``."""
     block = program.global_block()
     bnd = float(2 ** (weight_bits - 1) - 1)
     act_scales = act_scales or {}
@@ -375,7 +436,159 @@ def convert_to_int8_execution(program, scope, quant_weights,
                  if k not in converted and k in block.vars}
     if leftovers:
         convert_to_int8_inference(program, scope, leftovers, weight_bits)
+    if int8_activations is None:
+        from paddle_tpu.flags import get_flag
+
+        int8_activations = get_flag("int8_interlayer")
+    if int8_activations:
+        program._int8_interlayer_stats = _fold_int8_interlayer(
+            program, block, out_dtype, weight_bits,
+            frozenset(protected or ()))
     return program
+
+
+def _fold_int8_interlayer(program, block, out_dtype, weight_bits,
+                          protected):
+    """ISSUE-5 stage 2: fold quantized-op -> quantized-op edges so the
+    inter-layer tensor is int8.
+
+    For each ``conv2d_int8`` producer with a calibrated InScale, walk
+    its epilogue chain — optional per-channel bias ``elementwise_add``
+    (the folded-BN shift: Y 1-D persistable; a residual add never
+    matches) then optional ``relu`` — each link sole-consumed.  If
+    EVERY consumer of the chain tail is a converted int8 op reading it
+    as its activation with a calibrated InScale (and no per-input-row
+    mul scale, which folds into the activation pre-quantization), the
+    FULL fold applies: the requantize epilogue rides inside the
+    producer op (Bias + fuse_relu + OutScale = the consumers' shared
+    calibrated scale), the chain ops are deleted, and the producer
+    emits the tail var as int8 — one byte per element crosses the op
+    boundary, and the consumers' int8-in path skips re-quantization.
+
+    Edges whose tail feeds a non-quantized consumer (residual adds,
+    pools, fetch targets) get the PARTIAL fold instead: bias and a
+    sole-consumed tail ReLU still fold into the producer (no OutScale,
+    float out) — fewer op boundaries, identical values.
+
+    The in-op epilogue mirrors the unfused chain's op order, dtypes
+    (out_dtype stays the unfused inter-layer dtype) and rounding
+    points exactly, so fused and unfused graphs produce bit-identical
+    logits.  The standalone ``requantize`` op implements the same
+    contract for raw-int32-accumulator producers and anchors the
+    parity tests.  Returns fold statistics."""
+    del weight_bits  # the epilogue reuses the producer's max_range
+    consumers = {}
+    for op in block.ops:
+        for slot, names in op.inputs.items():
+            for n in names:
+                consumers.setdefault(n, []).append((op, slot))
+    sub_read = set()
+    for blk in program.blocks:
+        if blk is block:
+            continue
+        for op in blk.ops:
+            for names in op.inputs.values():
+                sub_read.update(names)
+
+    def _is_bias_add(op):
+        if op.type != "elementwise_add":
+            return False
+        y = op.inputs.get("Y", [None])[0]
+        v = block.vars.get(y)
+        return (v is not None and v.persistable and v.shape is not None
+                and len(v.shape) == 1)
+
+    def _quantized_consumer(op, slot, tail):
+        """True when (op, slot) is an int8 op consuming `tail` as its
+        activation with a calibrated InScale on that exact tensor."""
+        scale_name = tail + "@ACT_SCALE"
+        if op.inputs.get("InScale", [None])[0] != scale_name:
+            return False
+        if op.type == "conv2d_int8":
+            return slot == "Input"
+        if op.type == "mul_int8":
+            if slot != "X":
+                return False
+            sv = block.vars.get(op.inputs["Scale"][0])
+            if sv is None or sv.shape is None:
+                return False
+            shp = tuple(sv.shape)
+            # per-input-row scales ((K,1...) or 1-D of length K) fold
+            # into the activation pre-quantization: reject (mirrors
+            # mul_int8's runtime guard)
+            if len(shp) >= 2 and int(np.prod(shp[1:])) == 1 and \
+                    shp[0] != 1:
+                return False
+            yv = block.vars.get(op.inputs["Y"][0])
+            k = yv.shape[0] if yv is not None and yv.shape else None
+            if len(shp) == 1 and shp[0] == k and shp[0] != 1:
+                return False
+            return True
+        return False
+
+    stats = {"n_producers": 0, "n_edges_folded": 0,
+             "n_partial_folds": 0, "n_rejected": 0}
+    n_int8_in = 0
+    for P in list(block.ops):
+        if P.type != "conv2d_int8" or not P.inputs.get("InScale"):
+            continue
+        if P.attrs.get("out_dtype") == "int32" or \
+                P.inputs.get("OutScale"):
+            continue
+        stats["n_producers"] += 1
+        t0 = P.outputs["Output"][0]
+        chain = []          # epilogue ops to delete, in order
+        bias_op = relu_op = None
+        cur = t0
+        cons = consumers.get(cur, [])
+        if len(cons) == 1 and _is_bias_add(cons[0][0]) and \
+                cons[0][1] == "X" and cur not in sub_read and \
+                cur not in protected:
+            bias_op = cons[0][0]
+            chain.append(bias_op)
+            cur = bias_op.outputs["Out"][0]
+            cons = consumers.get(cur, [])
+        if len(cons) == 1 and cons[0][0].type == "relu" and \
+                cur not in sub_read and cur not in protected:
+            relu_op = cons[0][0]
+            chain.append(relu_op)
+            cur = relu_op.outputs["Out"][0]
+            cons = consumers.get(cur, [])
+        tail = cur
+        if not chain and not cons:
+            continue        # nothing to fold, nowhere to quantize into
+        full = (bool(cons)
+                and all(_quantized_consumer(op, slot, tail)
+                        for op, slot in cons)
+                and tail not in protected and tail not in sub_read
+                and (tail + "@ACT_SCALE") in block.vars)
+        if not full and not chain:
+            stats["n_rejected"] += 1
+            continue
+        # both fold flavors attach the chain to the producer op:
+        # Bias/fuse_relu (and OutScale for the full fold) become the
+        # conv's in-op epilogue; chain ops leave the graph
+        if bias_op is not None:
+            P.inputs["Bias"] = list(bias_op.inputs["Y"])
+            P.set_attr("bias_axis", bias_op.attrs.get("axis", -1))
+        # set_attr (not a raw attrs write) on every fold so the
+        # compiled-program fingerprint always sees the rewrite — the
+        # no-chain full fold otherwise only touches op.inputs
+        P.set_attr("fuse_relu", relu_op is not None)
+        if chain:
+            P.outputs["Output"] = [tail]
+            block.ops = [o for o in block.ops if o not in chain]
+        if full:
+            P.inputs["OutScale"] = [tail + "@ACT_SCALE"]
+            tv = block.vars.get(tail)
+            if tv is not None:
+                tv.dtype = "int8"
+            n_int8_in += len(cons)
+            stats["n_edges_folded"] += 1
+        else:
+            stats["n_partial_folds"] += 1
+    stats["n_int8_inputs"] = n_int8_in
+    return stats
 
 
 def quantize_weights_abs_max(program, scope, weight_bits=8,

@@ -135,6 +135,17 @@ define_flag("flash_relayout", "reshape",
             "lowerable escape hatch if the chip host's Mosaic rejects "
             "the reshape — the same class of drift the "
             "CompilerParams shim covers)")
+define_flag("int8_interlayer", False,
+            "int8 end-to-end activation flow (ISSUE 5): "
+            "convert_to_int8_execution folds, for every quantized-op -> "
+            "quantized-op edge, the producer's dequant + folded-BN "
+            "shift + ReLU + the consumer's quant into ONE per-channel "
+            "requantize op, so the tensor that hits HBM between layers "
+            "is int8 instead of bf16/f32 (~30%% traffic cut on the "
+            "HBM-bound int8 infer row).  Default off: flag-off graphs "
+            "are bit-identical to the calibrated int8 path (asserted "
+            "in tests/test_quantization.py); flip per-call via "
+            "convert_to_int8_execution(int8_activations=True)")
 define_flag("int8_conv_algo", "conv",
             "conv2d_int8 lowering: 'conv' = integer "
             "conv_general_dilated; 'im2col' = pad/slice/concat + one "

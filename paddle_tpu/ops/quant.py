@@ -83,7 +83,11 @@ def fake_quantize_moving_average_abs_max(ins, attrs):
     accum = ins.get("InAccum", in_scale.reshape((1,))).reshape(())
     state_out = rate * state + 1.0
     accum_out = rate * accum + cur
-    scale = accum_out / state_out
+    # floor at WRITE time: an all-zero calibration batch would otherwise
+    # persist a 0.0 OutScale, which downstream consumers
+    # (convert_to_int8_execution) read as "never calibrated" and
+    # silently route to the 2x-slower dynamic path (ISSUE 5 satellite)
+    scale = jnp.maximum(accum_out / state_out, 1e-8)
     q = _quantize(x, scale, attrs["bit_length"])
     return {"Out": _ste(x, q), "OutScale": scale.reshape((1,)),
             "OutState": state_out.reshape((1,)),
@@ -119,14 +123,67 @@ def dequantize(ins, attrs):
     return {"Output": ins["Input"].astype(jnp.float32) / attrs["Scale"]}
 
 
-@register_op("requantize", inputs=("Input",), outputs=("Output",),
-             attrs={"Scale_in": 1.0, "Scale_out": 1.0},
+@register_op("requantize",
+             inputs=("Input", "InScale", "FilterScale", "Bias",
+                     "OutScale"),
+             outputs=("Output",),
+             optional=("InScale", "FilterScale", "Bias", "OutScale"),
+             attrs={"Scale_in": 1.0, "Scale_out": 1.0,
+                    "max_range": 127.0, "fuse_relu": False,
+                    "data_format": "NCHW", "bias_axis": -1,
+                    "ref_dtype": "float32"},
              differentiable=False)
 def requantize(ins, attrs):
-    """requantize_op.cc: rescale int8 between quantization domains."""
-    x = ins["Input"].astype(jnp.float32)
-    y = x * (attrs["Scale_out"] / attrs["Scale_in"])
-    return {"Output": jnp.clip(jnp.round(y), -128, 127).astype(jnp.int8)}
+    """Two modes.
+
+    Legacy (no OutScale input, requantize_op.cc): rescale int8 between
+    per-tensor quantization domains via the Scale_in/Scale_out attrs.
+
+    Fused interlayer epilogue (OutScale wired; the ISSUE-5 int8
+    activation-flow op): Input is a conv/mul int32 ACCUMULATOR and this
+    op folds the producer's dequant (InScale x per-channel FilterScale),
+    the folded-BN shift (Bias, broadcast exactly like elementwise_add's
+    bias_axis), ReLU (fuse_relu — with symmetric quantization the zero
+    point is 0, so ReLU IS the clamp-at-zero-point), and the consumer's
+    quant (OutScale) into one pass — the tensor that leaves for HBM is
+    int8, not bf16/f32.
+
+    Bit-parity contract: every arithmetic step below mirrors the
+    UNFUSED chain op for op — conv2d_int8's epilogue order
+    (acc*(sx/bnd^2) then *scale), the cast to ref_dtype (the dtype the
+    unfused graph flowed between layers, e.g. bfloat16), elementwise_add
+    promotion, jax.nn.relu, then the consumer's astype(f32)/clip/round.
+    tests/test_quantization.py asserts array_equal against the unfused
+    dequant -> BN-shift -> ReLU -> quant chain AND end-to-end logits
+    bit-identity of the interlayer-converted graph."""
+    x = ins["Input"]
+    if "OutScale" not in ins:
+        xf = x.astype(jnp.float32)
+        y = xf * (attrs["Scale_out"] / attrs["Scale_in"])
+        return {"Output": jnp.clip(jnp.round(y), -128,
+                                   127).astype(jnp.int8)}
+    bnd = attrs["max_range"]
+    sx = jnp.maximum(ins["InScale"].reshape(()).astype(jnp.float32),
+                     1e-8)
+    y = x.astype(jnp.float32) * (sx / (bnd * bnd))
+    oscale = ins["FilterScale"].reshape(-1)
+    if x.ndim == 4 and attrs["data_format"] == "NCHW":
+        sc = oscale.reshape(1, -1, 1, 1)
+    else:
+        sc = oscale.reshape((1,) * (x.ndim - 1) + (-1,))
+    y = y * sc
+    y = y.astype(jnp.dtype(attrs.get("ref_dtype", "float32")))
+    if "Bias" in ins:
+        from paddle_tpu.ops.basic import _bcast_y
+
+        y = y + _bcast_y(y, ins["Bias"], attrs.get("bias_axis", -1))
+    if attrs.get("fuse_relu"):
+        y = jax.nn.relu(y)
+    so = jnp.maximum(ins["OutScale"].reshape(()).astype(jnp.float32),
+                     1e-8)
+    y8 = jnp.clip(jnp.round(y.astype(jnp.float32) / so * bnd),
+                  -bnd, bnd).astype(jnp.int8)
+    return {"Output": y8}
 
 
 @register_op("dequantize_weight", inputs=("X", "Scale"),
@@ -194,12 +251,14 @@ def _int8_conv_im2col(x8, q, strides, pads, dils, groups, fmt):
 
 
 @register_op("conv2d_int8", inputs=("Input", "Filter", "FilterScale",
-                                    "InScale"),
-             outputs=("Output",), optional=("InScale",),
+                                    "InScale", "Bias", "OutScale"),
+             outputs=("Output",),
+             optional=("InScale", "Bias", "OutScale"),
              attrs={"strides": [1, 1], "paddings": [0, 0],
                     "dilations": [1, 1], "groups": 1,
                     "data_format": "NCHW", "max_range": 127.0,
-                    "out_dtype": "float32"},
+                    "out_dtype": "float32", "fuse_relu": False,
+                    "bias_axis": -1},
              differentiable=False)
 def conv2d_int8(ins, attrs):
     """True-int8 convolution (reference int8 execution path,
@@ -219,20 +278,49 @@ def conv2d_int8(ins, attrs):
     than bf16 because of it), so the calibrated path is what the bench
     and any serious deployment should use.  out_dtype="bfloat16" halves
     inter-layer activation traffic; quantization noise (7-bit mantissa
-    vs the int8 lattice) dwarfs the bf16 rounding."""
+    vs the int8 lattice) dwarfs the bf16 rounding.
+
+    Interlayer extensions (ISSUE 5, all optional/off by default):
+      * int8 INPUT: accepted as-is (the producer already quantized to
+        this op's calibrated InScale — mandatory then);
+      * Bias / fuse_relu: the requantize epilogue's folded-BN shift and
+        ReLU ride inside the conv op, mirroring the unfused
+        elementwise_add/relu chain's op order, dtypes and broadcast
+        (bias_axis) bit-exactly;
+      * OutScale: quantize the epilogue result to the CONSUMER's
+        calibrated scale and emit int8 — the int8-out variant; the
+        tensor crossing the op boundary is 1 byte/elem;
+      * out_dtype="int32": emit the RAW accumulator (scales applied by
+        a downstream standalone `requantize`)."""
     from paddle_tpu.ops.nn import _pair
 
     from paddle_tpu.flags import get_flag
 
     x, q, ws = ins["Input"], ins["Filter"], ins["FilterScale"]
     bnd = attrs["max_range"]
-    if "InScale" in ins:
+    if x.dtype == jnp.int8:
+        # int8-in (interlayer mode): the producer's fused requantize
+        # already quantized the activation to THIS op's calibrated
+        # InScale — quantizing again would double-round.  A dynamic
+        # scale is meaningless here (the int8 lattice was fixed by the
+        # producer), so InScale is mandatory.
+        if "InScale" not in ins:
+            raise ValueError(
+                "conv2d_int8: int8 input requires a calibrated InScale "
+                "(the producer quantized to it); dynamic scaling of an "
+                "already-quantized tensor is ill-defined")
         sx = jnp.maximum(ins["InScale"].reshape(()).astype(jnp.float32),
                          1e-8)
+        x8 = x
     else:
-        sx = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
-    xf = x.astype(jnp.float32)
-    x8 = jnp.clip(jnp.round(xf / sx * bnd), -bnd, bnd).astype(jnp.int8)
+        if "InScale" in ins:
+            sx = jnp.maximum(
+                ins["InScale"].reshape(()).astype(jnp.float32), 1e-8)
+        else:
+            sx = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+        xf = x.astype(jnp.float32)
+        x8 = jnp.clip(jnp.round(xf / sx * bnd),
+                      -bnd, bnd).astype(jnp.int8)
     s, p, d = (_pair(attrs["strides"]), _pair(attrs["paddings"]),
                _pair(attrs["dilations"]))
     fmt = attrs.get("data_format", "NCHW")
@@ -247,20 +335,47 @@ def conv2d_int8(ins, attrs):
             rhs_dilation=d, dimension_numbers=dn,
             feature_group_count=attrs["groups"],
             preferred_element_type=jnp.int32)
+    if attrs["out_dtype"] == "int32":
+        # int32-out (interlayer mode): hand the RAW accumulator to a
+        # standalone requantize, which owns every scale/shift —
+        # applying them here too would double-scale
+        return {"Output": y32}
     oscale = ws.reshape(-1)  # per-out-channel (O,1,1,1) -> (O,)
     sc = (oscale.reshape(1, -1, 1, 1) if fmt == "NCHW"
           else oscale.reshape(1, 1, 1, -1))
     y = y32.astype(jnp.float32) * (sx / (bnd * bnd)) * sc
-    return {"Output": y.astype(jnp.dtype(attrs["out_dtype"]))}
+    y = y.astype(jnp.dtype(attrs["out_dtype"]))
+    if "Bias" in ins:
+        from paddle_tpu.ops.basic import _bcast_y
+
+        # mirrors the unfused elementwise_add exactly, including its
+        # dtype promotion (bf16 out + f32 bias -> f32) — bit-parity
+        # with the never-folded chain is the contract
+        y = y + _bcast_y(y, ins["Bias"], attrs.get("bias_axis", -1))
+    if attrs.get("fuse_relu"):
+        y = jax.nn.relu(y)
+    if "OutScale" in ins:
+        so = jnp.maximum(
+            ins["OutScale"].reshape(()).astype(jnp.float32), 1e-8)
+        y = jnp.clip(jnp.round(y.astype(jnp.float32) / so * bnd),
+                     -bnd, bnd).astype(jnp.int8)
+    return {"Output": y}
 
 
-@register_op("mul_int8", inputs=("X", "Y", "Scale", "InScale"),
-             outputs=("Out",), optional=("InScale",),
+@register_op("mul_int8", inputs=("X", "Y", "Scale", "InScale", "Bias",
+                                 "OutScale"),
+             outputs=("Out",), optional=("InScale", "Bias", "OutScale"),
              attrs={"x_num_col_dims": 1, "y_num_col_dims": 1,
-                    "max_range": 127.0, "out_dtype": "float32"},
+                    "max_range": 127.0, "out_dtype": "float32",
+                    "fuse_relu": False, "bias_axis": -1},
              differentiable=False)
 def mul_int8(ins, attrs):
     """True-int8 mul: int8 x int8 matmul with int32 accumulation.
+    Interlayer extensions mirror conv2d_int8's: int8-in (InScale
+    mandatory), Bias/fuse_relu/OutScale requantize epilogue (int8-out),
+    out_dtype="int32" raw accumulator — all except the per-input-row
+    weight-scale convention, which folds into the activation BEFORE
+    quantization and is therefore rejected in interlayer modes.
 
     Weight scale conventions (w ~= q * scale / max_range), decided by
     the scale's SHAPE so a square weight (K == N) stays unambiguous:
@@ -297,30 +412,66 @@ def mul_int8(ins, attrs):
         post = (ws2 / bnd).reshape(1, n)
     else:                   # per-tensor
         post = ws2.reshape(()) / bnd
-    if "InScale" in ins:
-        cal = jnp.maximum(ins["InScale"].reshape(()).astype(jnp.float32),
-                          1e-8)
+    if x.dtype == jnp.int8 or attrs["out_dtype"] == "int32":
+        # interlayer mode (int8-in and/or raw-accumulator-out): the
+        # per-row convention folds the weight scale into the ACTIVATION
+        # before quantization, which is impossible once the activation
+        # arrives pre-quantized (and makes a raw accumulator
+        # scale-entangled) — the slim pass rejects such edges; the op
+        # enforces the same contract
         if per_row:
-            # the per-row weight scale folds into the activation BEFORE
-            # quantization, so the calibrated raw-activation scale must
-            # be widened by the largest row factor: |x_k*s_k/bnd| <=
-            # cal*max(s)/bnd.  max over the K-vector of weight scales
-            # is a trace-time-tiny reduction, not an activation read —
-            # the whole point of InScale is avoiding the latter.
-            sx = cal * jnp.max(ws2) / bnd
-        else:
-            sx = cal
+            raise ValueError(
+                "mul_int8: per-input-row weight scales are incompatible "
+                "with int8-in/int32-out interlayer execution (the row "
+                "scale folds into the activation pre-quantization)")
+    if x.dtype == jnp.int8:
+        if "InScale" not in ins:
+            raise ValueError(
+                "mul_int8: int8 input requires a calibrated InScale "
+                "(the producer quantized to it)")
+        sx = jnp.maximum(ins["InScale"].reshape(()).astype(jnp.float32),
+                         1e-8)
+        x8 = x2
     else:
-        sx = jnp.maximum(jnp.max(jnp.abs(x2)), 1e-8)
-    x8 = jnp.clip(jnp.round(x2.astype(jnp.float32) / sx * bnd),
-                  -bnd, bnd).astype(jnp.int8)
+        if "InScale" in ins:
+            cal = jnp.maximum(
+                ins["InScale"].reshape(()).astype(jnp.float32), 1e-8)
+            if per_row:
+                # the per-row weight scale folds into the activation
+                # BEFORE quantization, so the calibrated raw-activation
+                # scale must be widened by the largest row factor:
+                # |x_k*s_k/bnd| <= cal*max(s)/bnd.  max over the
+                # K-vector of weight scales is a trace-time-tiny
+                # reduction, not an activation read — the whole point
+                # of InScale is avoiding the latter.
+                sx = cal * jnp.max(ws2) / bnd
+            else:
+                sx = cal
+        else:
+            sx = jnp.maximum(jnp.max(jnp.abs(x2)), 1e-8)
+        x8 = jnp.clip(jnp.round(x2.astype(jnp.float32) / sx * bnd),
+                      -bnd, bnd).astype(jnp.int8)
     y32 = lax.dot_general(x8, q2, (((1,), (0,)), ((), ())),
                           preferred_element_type=jnp.int32)
+    if attrs["out_dtype"] == "int32":
+        return {"Out": y32.reshape(x.shape[:xnc] + q.shape[ync:])}
     y = y32.astype(jnp.float32) * (sx / bnd)
     if post is not None:
         y = y * post
     y = y.astype(jnp.dtype(attrs["out_dtype"]))
-    return {"Out": y.reshape(x.shape[:xnc] + q.shape[ync:])}
+    y = y.reshape(x.shape[:xnc] + q.shape[ync:])
+    if "Bias" in ins:
+        from paddle_tpu.ops.basic import _bcast_y
+
+        y = y + _bcast_y(y, ins["Bias"], attrs.get("bias_axis", -1))
+    if attrs.get("fuse_relu"):
+        y = jax.nn.relu(y)
+    if "OutScale" in ins:
+        so = jnp.maximum(
+            ins["OutScale"].reshape(()).astype(jnp.float32), 1e-8)
+        y = jnp.clip(jnp.round(y.astype(jnp.float32) / so * bnd),
+                     -bnd, bnd).astype(jnp.int8)
+    return {"Out": y}
 
 
 @register_op("fake_quantize_range_abs_max",
@@ -423,6 +574,9 @@ def moving_average_abs_max_scale(ins, attrs):
              if state0 is not None else jnp.asarray(1.0))
     accum = (accum0.reshape(()) * rate + cur
              if accum0 is not None else cur)
-    return {"OutScale": (accum / state).reshape(1),
+    # write-time floor: a 0.0 scale recorded from an all-zero batch
+    # reads as "uncalibrated" downstream (see
+    # fake_quantize_moving_average_abs_max above)
+    return {"OutScale": jnp.maximum(accum / state, 1e-8).reshape(1),
             "OutAccum": jnp.reshape(accum, (1,)),
             "OutState": jnp.reshape(state, (1,))}

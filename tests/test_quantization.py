@@ -409,6 +409,374 @@ def test_int8_accuracy_harness_rn32_cifar():
     assert row["bf16_vs_f32_pp"] <= 25.0, row  # sanity, not the bound
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 5: int8 inter-layer activation flow
+# ---------------------------------------------------------------------------
+
+def _build_interlayer_net():
+    """conv(+bias,relu) x2 -> conv(+bias) -> fc: two fully foldable
+    edges, one partial-fold edge (fc consumer has a per-row scale)."""
+    from paddle_tpu import framework, unique_name
+    from paddle_tpu.core.program import Program
+
+    framework.switch_main_program(Program())
+    framework.switch_startup_program(Program())
+    unique_name.switch({})
+    np.random.seed(0)
+    xin = layers.data("x", shape=[2, 8, 8], dtype="float32")
+    c1 = layers.conv2d(xin, num_filters=4, filter_size=3, padding=1,
+                       act="relu", bias_attr=True)
+    c2 = layers.conv2d(c1, num_filters=4, filter_size=3, padding=1,
+                       act="relu", bias_attr=True)
+    c3 = layers.conv2d(c2, num_filters=4, filter_size=3, padding=1,
+                       bias_attr=True)
+    pred = layers.fc(c3, size=4, bias_attr=False)
+    return pred
+
+
+def _convert_interlayer_net(int8_acts, reject_extra=False):
+    """Build, calibrate and convert the net; returns
+    (logits ndarray, op-type list, stats, infer_prog, exe, feed,
+    fetch)."""
+    from paddle_tpu import framework
+    from paddle_tpu.contrib.slim.quantization import (
+        convert_to_int8_execution, post_training_quantize,
+        quantize_weights_abs_max)
+    from paddle_tpu.core.scope import global_scope
+
+    pred = _build_interlayer_net()
+    prog = framework.default_main_program()
+    if reject_extra:
+        # a NON-quantized second consumer of the first relu output:
+        # that edge must keep the float path
+        relu_out = [op.outputs["Out"][0]
+                    for op in prog.global_block().ops
+                    if op.type == "relu"][0]
+        extra = layers.reduce_sum(prog.global_block().vars[relu_out])
+        pred = layers.elementwise_add(
+            pred, layers.reshape(extra, shape=[1, 1]))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    infer = prog.clone(for_test=True)
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.rand(4, 2, 8, 8).astype(np.float32)}
+    scales, _ = post_training_quantize(
+        infer, global_scope(), exe, [dict(feed)], fetch_list=[pred],
+        fold_boundaries=True)
+    qw = quantize_weights_abs_max(infer, global_scope())
+    convert_to_int8_execution(infer, global_scope(), qw,
+                              act_scales=scales,
+                              out_dtype="bfloat16",
+                              int8_activations=int8_acts,
+                              protected=[pred.name])
+    (out,) = exe.run(fluid.CompiledProgram(infer), feed=feed,
+                     fetch_list=[pred])
+    ops = [op.type for op in infer.global_block().ops]
+    stats = getattr(infer, "_int8_interlayer_stats", None)
+    return np.asarray(out), ops, stats, infer, exe, feed, pred
+
+
+def test_int8_interlayer_end_to_end_bit_identical():
+    """The fused requantize epilogue mirrors the unfused
+    dequant -> BN-shift -> ReLU -> quant chain op for op, so the
+    interlayer graph's logits must be BIT-identical to the calibrated
+    graph's — compiled AND interpreter paths."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    with scope_guard(Scope()):
+        ref, ops_off, stats_off, infer0, exe0, feed0, pred0 = \
+            _convert_interlayer_net(False)
+        (ref_i,) = exe0.run(infer0, feed=feed0, fetch_list=[pred0])
+    with scope_guard(Scope()):
+        got, ops_on, stats, infer1, exe1, feed1, pred1 = \
+            _convert_interlayer_net(True)
+        (got_i,) = exe1.run(infer1, feed=feed1, fetch_list=[pred1])
+    # flag-off graph untouched by the pass
+    assert stats_off is None
+    assert ops_off == ["conv2d_int8", "elementwise_add", "relu",
+                       "conv2d_int8", "elementwise_add", "relu",
+                       "conv2d_int8", "elementwise_add", "mul_int8"]
+    # interlayer: both foldable edges fused into the producer conv
+    # (bias+relu+OutScale in-op), the fc edge partial-folds (bias only)
+    assert ops_on == ["conv2d_int8", "conv2d_int8", "conv2d_int8",
+                      "mul_int8"]
+    assert stats["n_edges_folded"] == 2
+    assert stats["n_partial_folds"] == 1
+    assert stats["n_int8_inputs"] == 2
+    convs = [op for op in infer1.global_block().ops
+             if op.type == "conv2d_int8"]
+    assert [bool(op.inputs.get("OutScale")) for op in convs] == \
+        [True, True, False]
+    assert all(op.inputs.get("Bias") for op in convs)
+    np.testing.assert_array_equal(ref, got)
+    np.testing.assert_array_equal(np.asarray(ref_i), np.asarray(got_i))
+    np.testing.assert_array_equal(got, np.asarray(got_i))
+
+
+def test_int8_interlayer_flag_off_graph_bit_identical():
+    """Default (flag off) conversion must produce the exact
+    pre-interlayer graph: no epilogue inputs, no int8 inter-layer
+    vars, bit-identical outputs across two identical builds."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+    from paddle_tpu.flags import get_flag
+
+    assert get_flag("int8_interlayer") is False
+    outs = []
+    for int8_acts in (None, False):  # None = read the default-off flag
+        with scope_guard(Scope()):
+            out, ops, stats, infer, _exe, _feed, _pred = \
+                _convert_interlayer_net(int8_acts)
+        assert stats is None
+        assert "requantize" not in ops
+        for op in infer.global_block().ops:
+            assert not op.inputs.get("OutScale"), op.type
+            assert not op.inputs.get("Bias"), op.type
+        outs.append(out)
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_int8_interlayer_rejects_nonquantized_consumer():
+    """An edge whose chain tensor is also read by a NON-quantized op
+    must keep the float path for that edge (the fold would starve the
+    other consumer)."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    with scope_guard(Scope()):
+        ref, _ops, _st, _i, _e, _f, _p = _convert_interlayer_net(
+            False, reject_extra=True)
+    with scope_guard(Scope()):
+        got, ops, stats, infer, _exe, _feed, _pred = \
+            _convert_interlayer_net(True, reject_extra=True)
+    # first edge: the relu output also feeds reduce_sum, so the QUANT
+    # half is rejected — it degrades to a PARTIAL fold (bias+relu into
+    # the conv, float out, tensor unchanged for both consumers); the
+    # second edge still folds fully
+    assert stats["n_edges_folded"] == 1
+    assert stats["n_partial_folds"] == 2
+    convs = [op for op in infer.global_block().ops
+             if op.type == "conv2d_int8"]
+    assert [bool(op.inputs.get("OutScale")) for op in convs] == \
+        [False, True, False]
+    # the rejected edge's tail stays float (int8 would starve
+    # reduce_sum's read)
+    relu_out_var = convs[0].outputs["Output"][0]
+    assert infer.global_block().vars[relu_out_var].dtype != "int8"
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_requantize_bit_parity_vs_unfused_chain():
+    """The standalone requantize op (raw int32 accumulator in) must be
+    bit-identical to the unfused dequant -> bias -> ReLU -> quant
+    chain, per-channel, for both the bf16 and f32 reference dtypes and
+    both layouts."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def
+
+    conv = get_op_def("conv2d_int8")
+    req = get_op_def("requantize")
+    add = get_op_def("elementwise_add")
+    relu = get_op_def("relu")
+    rng = np.random.RandomState(11)
+    x = (rng.randn(2, 6, 9, 9) * 3).astype(np.float32)
+    w8 = rng.randint(-127, 128, (4, 6, 3, 3)).astype(np.int8)
+    wsc = (rng.rand(4, 1, 1, 1).astype(np.float32) + 0.05)
+    bias = rng.randn(4).astype(np.float32)
+    in_scale = np.asarray([float(np.abs(x).max())], np.float32)
+    out_scale = np.asarray([2.37], np.float32)
+    for fmt, bias_axis in (("NCHW", 1), ("NHWC", -1)):
+        xin = x if fmt == "NCHW" else np.transpose(x, (0, 2, 3, 1))
+        for ref_dtype in ("bfloat16", "float32"):
+            base = {"Input": jnp.asarray(xin),
+                    "Filter": jnp.asarray(w8),
+                    "FilterScale": jnp.asarray(wsc),
+                    "InScale": jnp.asarray(in_scale)}
+            cattrs = conv.canonical_attrs(
+                {"paddings": [1, 1], "data_format": fmt,
+                 "out_dtype": ref_dtype})
+            # unfused: conv -> elementwise_add -> relu -> consumer
+            # quantize (the consumer's exact in-op sequence)
+            y = conv.compute(base, cattrs)["Output"]
+            y = add.compute({"X": y, "Y": jnp.asarray(bias)},
+                            {"axis": bias_axis})["Out"]
+            y = relu.compute({"X": y}, {})["Out"]
+            so = jnp.maximum(
+                jnp.asarray(out_scale).reshape(()), 1e-8)
+            expect = jnp.clip(
+                jnp.round(y.astype(jnp.float32) / so * 127.0),
+                -127.0, 127.0).astype(jnp.int8)
+            # fused: raw accumulator -> ONE requantize
+            acc = conv.compute(
+                base, dict(cattrs, out_dtype="int32"))["Output"]
+            assert acc.dtype == jnp.int32
+            got = req.compute(
+                {"Input": acc, "InScale": jnp.asarray(in_scale),
+                 "FilterScale": jnp.asarray(wsc),
+                 "Bias": jnp.asarray(bias),
+                 "OutScale": jnp.asarray(out_scale)},
+                req.canonical_attrs(
+                    {"fuse_relu": True, "data_format": fmt,
+                     "bias_axis": bias_axis,
+                     "ref_dtype": ref_dtype}))["Output"]
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(expect),
+                err_msg="%s %s" % (fmt, ref_dtype))
+            # and the in-conv epilogue form (what the pass emits)
+            got2 = conv.compute(
+                dict(base, Bias=jnp.asarray(bias),
+                     OutScale=jnp.asarray(out_scale)),
+                dict(cattrs, fuse_relu=True,
+                     bias_axis=bias_axis))["Output"]
+            np.testing.assert_array_equal(
+                np.asarray(got2), np.asarray(expect),
+                err_msg="epilogue %s %s" % (fmt, ref_dtype))
+
+
+def test_requantize_legacy_mode_unchanged():
+    """No OutScale input -> the original int8->int8 Scale_in/Scale_out
+    rescale semantics."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def
+
+    d = get_op_def("requantize")
+    x = np.arange(-8, 8, dtype=np.int8)
+    out = d.compute({"Input": jnp.asarray(x)},
+                    d.canonical_attrs({"Scale_in": 2.0,
+                                       "Scale_out": 3.0}))["Output"]
+    np.testing.assert_array_equal(
+        np.asarray(out),
+        np.clip(np.round(x.astype(np.float32) * 1.5), -128,
+                127).astype(np.int8))
+
+
+def test_int8_in_conv_requires_inscale_and_skips_requant():
+    """int8 input + InScale -> used as-is (no double rounding); int8
+    input without InScale -> loud error, not a silent wrong scale."""
+    import jax.numpy as jnp
+    import pytest
+
+    from paddle_tpu.core.registry import get_op_def
+
+    d = get_op_def("conv2d_int8")
+    rng = np.random.RandomState(3)
+    x8 = rng.randint(-127, 128, (2, 4, 6, 6)).astype(np.int8)
+    w8 = rng.randint(-127, 128, (4, 4, 1, 1)).astype(np.int8)
+    wsc = np.ones((4, 1, 1, 1), np.float32)
+    ins = {"Input": jnp.asarray(x8), "Filter": jnp.asarray(w8),
+           "FilterScale": jnp.asarray(wsc),
+           "InScale": jnp.asarray([1.0], np.float32)}
+    acc = d.compute(ins, d.canonical_attrs(
+        {"out_dtype": "int32"}))["Output"]
+    from jax import lax as _lax
+
+    dn = _lax.conv_dimension_numbers(x8.shape, w8.shape,
+                                     ("NCHW", "OIHW", "NCHW"))
+    ref = _lax.conv_general_dilated(
+        jnp.asarray(x8), jnp.asarray(w8), (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=dn, preferred_element_type=jnp.int32)
+    np.testing.assert_array_equal(np.asarray(acc), np.asarray(ref))
+    with pytest.raises(Exception, match="InScale"):
+        d.compute({k: v for k, v in ins.items() if k != "InScale"},
+                  d.canonical_attrs({}))
+
+
+def test_fold_boundary_scale_recording():
+    """post_training_quantize(fold_boundaries=True) must record scales
+    for relu/elementwise_add outputs and quantizable-op outputs — the
+    tensors the interlayer pass quantizes into."""
+    from paddle_tpu import framework
+    from paddle_tpu.core.scope import Scope, scope_guard, global_scope
+
+    with scope_guard(Scope()):
+        pred = _build_interlayer_net()
+        prog = framework.default_main_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(framework.default_startup_program())
+        infer = prog.clone(for_test=True)
+        feed = {"x": np.random.RandomState(4).rand(
+            4, 2, 8, 8).astype(np.float32)}
+        plain, _ = post_training_quantize(
+            infer, global_scope(), exe, [feed], fetch_list=[pred])
+        full, _ = post_training_quantize(
+            infer, global_scope(), exe, [feed], fetch_list=[pred],
+            fold_boundaries=True)
+        relu_outs = [op.outputs["Out"][0]
+                     for op in infer.global_block().ops
+                     if op.type == "relu"]
+        add_outs = [op.outputs["Out"][0]
+                    for op in infer.global_block().ops
+                    if op.type == "elementwise_add"]
+        for n in relu_outs + add_outs:
+            assert n in full and full[n] > 0, n
+        # plain mode records quantizable-op INPUTS only — the relu-
+        # consumed bias-add intermediates are new in boundary mode
+        # (the LAST add output feeds the fc mul, so plain mode already
+        # has it)
+        assert set(plain) < set(full)
+        for n in add_outs[:2]:
+            assert n not in plain
+
+
+def test_zero_scale_floor_and_warn_once():
+    """An all-zero calibration batch must floor observed scales at
+    1e-8 (staying on the calibrated static path) instead of recording
+    0.0 ('never observed' -> silent dynamic fallback), warning once."""
+    import warnings
+
+    from paddle_tpu import framework
+    from paddle_tpu.contrib.slim import quantization as qz
+    from paddle_tpu.core.scope import Scope, scope_guard, global_scope
+
+    with scope_guard(Scope()):
+        pred = _build_interlayer_net()
+        prog = framework.default_main_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(framework.default_startup_program())
+        infer = prog.clone(for_test=True)
+        feed = {"x": np.zeros((4, 2, 8, 8), np.float32)}
+        qz._warned_zero_scale[0] = False
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            scales, _ = qz.post_training_quantize(
+                infer, global_scope(), exe, [feed], fetch_list=[pred])
+            assert any("all-zero" in str(x.message) for x in w)
+        # the input itself was all-zero: floored, not 0.0
+        assert scales["x"] == 1e-8
+        assert all(v > 0 for v in scales.values()), scales
+        # second call: warned once already, stays silent
+        with warnings.catch_warnings(record=True) as w2:
+            warnings.simplefilter("always")
+            qz.post_training_quantize(
+                infer, global_scope(), exe, [feed], fetch_list=[pred])
+            assert not any("all-zero" in str(x.message) for x in w2)
+        qz._warned_zero_scale[0] = False
+
+
+def test_moving_average_scale_ops_floor_at_write():
+    """The moving-average observers must never WRITE a 0.0 scale (an
+    all-zero batch + zero accum state used to) — downstream readers
+    treat 0.0 as 'uncalibrated'."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def
+
+    zeros = jnp.zeros((4, 4), jnp.float32)
+    d = get_op_def("moving_average_abs_max_scale")
+    outs = d.compute(
+        {"X": zeros, "InAccum": jnp.zeros((1,), jnp.float32),
+         "InState": jnp.ones((1,), jnp.float32)},
+        d.canonical_attrs({}))
+    assert float(outs["OutScale"][0]) > 0.0
+    d2 = get_op_def("fake_quantize_moving_average_abs_max")
+    outs2 = d2.compute(
+        {"X": zeros, "InScale": jnp.zeros((1,), jnp.float32),
+         "InState": jnp.zeros((1,), jnp.float32),
+         "InAccum": jnp.zeros((1,), jnp.float32)},
+        d2.canonical_attrs({}))
+    assert float(outs2["OutScale"][0]) > 0.0
+
+
 def test_fused_adam_matches_per_param_adam():
     """optimizer.Adam(fuse=True): ONE multi-tensor fused_adam op vs
     the per-param adam ops — identical losses step for step (the

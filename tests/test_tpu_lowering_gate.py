@@ -19,6 +19,9 @@ _ROOT = str(pathlib.Path(__file__).resolve().parents[1])
     "transformer_train",       # the one that crashed on first chip run
     "deepfm_train",
     "resnet50_infer_int8",     # int8 dot_general path
+    # ISSUE 5: s8-in convs + fused requantize epilogues — the
+    # interlayer lowering surface
+    "resnet50_infer_int8_interlayer",
 ])
 def test_bench_workload_lowers_for_tpu(workload):
     if _ROOT not in sys.path:

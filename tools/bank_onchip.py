@@ -108,6 +108,11 @@ TASK_KEYS = {
     # leg — replaces the dynamic-scale row (22.2 ms) on re-bank
     "int8_infer_calibrated": ("resnet50_infer_int8_mb128", None),
     "int8_infer_folded": ("resnet50_infer_int8_mb128", None),
+    # ISSUE 5: int8 inter-layer activations — the re-key rule again
+    # (a graph-variant flip must never read as a same-graph perf
+    # change); joins the int8 best-variant promotion below
+    "rn_infer_int8_interlayer": (
+        "resnet50_infer_int8_interlayer_mb128", None),
     "longctx_seq131072_d128": (
         "longctx_flash_train_mb1_seq131072_d128", None),
     "longctx_seq262144": ("longctx_flash_train_mb1_seq262144", None),
@@ -129,6 +134,18 @@ TASK_KEYS = {
         "longctx_flash_train_mb1_seq1048576_packed", None),
     "longctx_seq1048576_packed_hp2": (
         "longctx_flash_train_mb1_seq1048576_packed_hp2", None),
+}
+
+# primary key <- best (by LOWEST ms_per_batch) among these variant
+# keys — the int8 inference promotion (ISSUE 5): train rows promote on
+# mfu_pct (PRIMARY below), latency rows on measured ms; the primary
+# int8 key always carries the fastest non-degraded int8 graph, with
+# its variant markers (int8_interlayer/conv_bn_folded) preserved so
+# bench._workload_sig still tells the graphs apart
+PRIMARY_MIN_MS = {
+    "resnet50_infer_int8_mb128": [
+        "resnet50_infer_int8_mb128",
+        "resnet50_infer_int8_interlayer_mb128"],
 }
 
 # primary key <- best (by mfu_pct) among these variant keys
@@ -232,6 +249,17 @@ def main(argv=None):
             art["extras"]["resnet32_cifar10_int8_top1_accuracy"] = acc
         except ValueError:
             pass
+
+    # promote best int8 variant (lowest latency) to the primary key
+    for prim, variants in PRIMARY_MIN_MS.items():
+        rows = [(art["extras"][k]["ms_per_batch"], k)
+                for k in variants if k in art["extras"]
+                and isinstance(art["extras"][k].get("ms_per_batch"),
+                               (int, float))]
+        if rows:
+            _best_ms, best_key = min(rows)
+            if best_key != prim:
+                art["extras"][prim] = dict(art["extras"][best_key])
 
     # promote best variants to primary keys
     for prim, variants in PRIMARY.items():

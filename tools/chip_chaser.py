@@ -144,6 +144,26 @@ TASKS = [
     # conv+bn folded before quantization (53 BN ops leave the graph;
     # their scale/shift lands in the per-channel weight scales)
     ("int8_infer_folded", "infer_i8", {"batch": 128, "chain": 20}),
+    # ---- ISSUE 5: int8 inter-layer activations.  The probe runs
+    # FIRST and is cheap: it jits the exact interlayer primitive
+    # pattern (s8 conv -> s32 accumulator -> fused requantize -> s8
+    # feeding a second s8 conv) and records a per-stage verdict JSON,
+    # so a broken lowering is diagnosed in <2 min instead of wedging
+    # the queue 25 min into the leg (the 2026-07-31 lesson)
+    ("int8_interlayer_probe",
+     "script:tools/int8_probe.py --json /tmp/int8_probe_verdict.json",
+     {}),
+    # the A/B leg vs the calibrated/folded rows above: fused
+    # per-channel requantize through BN-fold bias + ReLU, inter-layer
+    # tensors s8 in HBM (~30% traffic cut expected on this HBM-bound
+    # row; flag int8_interlayer stays default-off until this banks)
+    ("rn_infer_int8_interlayer", "infer_i8",
+     {"batch": 128, "chain": 20, "int8_activations": True}),
+    # compiled-graph evidence for the same cut: inter-layer tensors
+    # are s8 + bytes-accessed delta vs the calibrated graph
+    ("hlo_traffic_int8_interlayer",
+     "script:tools/hlo_traffic.py --int8-interlayer --batch 128", {},
+     1800),
     # d128 at seq 128k: at 32k, d128 doubled MFU at the same wall time
     # (MXU contractions full-width); expect the same here
     ("longctx_seq131072_d128", "longctx",

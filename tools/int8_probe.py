@@ -3,15 +3,30 @@ bench int8-leg crash (backend UNAVAILABLE mid-device_put, 25 min into
 the leg) was an int8 lowering problem or just the tunnel window
 closing.
 
-Runs three escalating probes, each its own jit, printing PROBE-OK /
+Runs escalating probes, each its own jit, printing PROBE-OK /
 PROBE-FAIL per stage with timings:
   1. bf16 matmul           — is the chip alive at all?
   2. s8xs8->s32 dot        — the mul_int8 primitive pattern
   3. s8xs8->s32 conv       — the conv2d_int8 primitive pattern
+  4. im2col escape hatch   — FLAGS int8_conv_algo=im2col
+  5. requantize chain      — the ISSUE-5 interlayer pattern: s8 conv
+     -> s32 accumulator -> fused per-channel requantize (scale + bias
+     + ReLU + round/clip -> s8) -> a SECOND s8 conv consuming the s8
+     tensor.  Run before the chip window so the
+     rn_infer_int8_interlayer leg can't wedge the chaser queue.
+  6. requantize cross-lowering — the same chain jax.export-lowered for
+     platform=tpu (Mosaic legality without needing the device; gives a
+     verdict even when probing from a CPU-only host).
 If 1 passes and 3 fails reproducibly, the conv int8 lowering is the
 culprit and conv2d_int8 needs an im2col+dot (or Pallas) fallback on
 TPU; if everything passes, the bench crash was the wedge.
+
+--json PATH records the per-stage verdict
+({"stages": {name: ok}, "verdict": "ALL-OK"|"FAILED"}) for the chaser
+and post-mortems.
 """
+import argparse
+import json
 import sys
 import time
 
@@ -19,19 +34,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+RESULTS = {}
+
 
 def stage(name, fn):
     t0 = time.time()
     try:
         out = fn()
-        out.block_until_ready()
-        print("PROBE-OK   %-12s %.1fs dtype=%s" %
-              (name, time.time() - t0, out.dtype), flush=True)
+        if hasattr(out, "block_until_ready"):
+            out.block_until_ready()
+        print("PROBE-OK   %-18s %.1fs dtype=%s" %
+              (name, time.time() - t0, getattr(out, "dtype", "-")),
+              flush=True)
+        RESULTS[name] = True
         return True
     except Exception as e:  # noqa: BLE001 - report and continue
-        print("PROBE-FAIL %-12s %.1fs %s: %s" %
+        print("PROBE-FAIL %-18s %.1fs %s: %s" %
               (name, time.time() - t0, type(e).__name__,
                str(e)[:300]), flush=True)
+        RESULTS[name] = False
         return False
 
 
@@ -80,7 +101,57 @@ def _int8_im2col():
         x, w, (1, 1), (1, 1), (1, 1), 1, "NHWC"))(x8, w8)
 
 
+def _requant_chain_fn():
+    """The exact interlayer primitive pattern the
+    rn_infer_int8_interlayer leg compiles, shapes shrunk: s8xs8->s32
+    conv, fused per-channel requantize epilogue (scale mult + bias +
+    ReLU + round/clip -> s8), and a second conv consuming the s8
+    tensor (int8-in)."""
+    sc = jnp.linspace(0.005, 0.02, 64, dtype=jnp.float32)
+    b = jnp.linspace(-1.0, 1.0, 64, dtype=jnp.float32)
+    shp = (8, 28, 28, 64)
+    dn = lax.conv_dimension_numbers(shp, (64, 64, 3, 3),
+                                    ("NHWC", "OIHW", "NHWC"))
+
+    def f(x, w):
+        acc = lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn,
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * sc.reshape(1, 1, 1, -1)
+        y = y.astype(jnp.bfloat16) + b.reshape(1, 1, 1, -1)
+        y = jax.nn.relu(y)
+        y8 = jnp.clip(jnp.round(y.astype(jnp.float32) / 0.05 * 127.0),
+                      -127, 127).astype(jnp.int8)
+        return lax.conv_general_dilated(
+            y8, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn,
+            preferred_element_type=jnp.int32)
+
+    return f, shp
+
+
+def _int8_requant_chain():
+    f, shp = _requant_chain_fn()
+    return jax.jit(f)(_ints(shp), _ints((64, 64, 3, 3)))
+
+
+def _int8_requant_xlower():
+    """Device-free Mosaic/TPU cross-lowering of the same chain
+    (jax.export): a verdict exists even when the tunnel is down."""
+    from jax import export
+
+    f, shp = _requant_chain_fn()
+    export.export(jax.jit(f), platforms=("tpu",))(
+        jax.ShapeDtypeStruct(shp, jnp.int8),
+        jax.ShapeDtypeStruct((64, 64, 3, 3), jnp.int8))
+    return None
+
+
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None,
+                    help="write the per-stage verdict JSON here")
+    args = ap.parse_args()
+
     print("devices:", jax.devices(), flush=True)
     ok = stage("bf16_matmul", _bf16_matmul)
     ok &= stage("int8_dot", _int8_dot)
@@ -94,7 +165,20 @@ def main():
               "im2col escape hatch works — set "
               "PADDLE_TPU_INT8_CONV_ALGO=im2col for the bench",
               flush=True)
-    print("INT8PROBE " + ("ALL-OK" if ok else "FAILED"), flush=True)
+    # ISSUE 5: the interlayer pattern must prove out BEFORE the
+    # rn_infer_int8_interlayer leg spends (and possibly wedges) a
+    # tunnel window on a 25-minute compile
+    ok &= stage("int8_requant", _int8_requant_chain)
+    ok &= stage("int8_requant_xlower", _int8_requant_xlower)
+    verdict = "ALL-OK" if ok else "FAILED"
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"stages": dict(RESULTS), "verdict": verdict,
+                       "devices": [str(d) for d in jax.devices()]},
+                      f, indent=1)
+            f.write("\n")
+        print("verdict JSON -> %s" % args.json, flush=True)
+    print("INT8PROBE " + verdict, flush=True)
     return 0 if ok else 1
 
 

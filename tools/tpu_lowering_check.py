@@ -90,6 +90,14 @@ def _workloads():
         "deepfm_train": lambda: bench._build_deepfm_train(2048)[:3],
         "resnet50_infer_int8": lambda:
             bench._build_resnet50_infer_int8(128)[:3],
+        # ISSUE 5: the int8-interlayer graph — s8-in convs, raw-s32
+        # accumulator outputs and the fused requantize epilogue are
+        # exactly the lowering surface Mosaic/XLA:TPU may reject while
+        # the CPU suite stays green; cross-lower BEFORE the chaser
+        # spends a window on the rn_infer_int8_interlayer leg
+        "resnet50_infer_int8_interlayer": lambda:
+            bench._build_resnet50_infer_int8(
+                128, int8_activations=True)[:3],
         "resnet50_infer": lambda: _infer(bench, "resnet", 128),
         "vgg16_infer": lambda: _infer(bench, "vgg", 64),
         "vgg16_cifar_infer": lambda: _infer(bench, "vgg_cifar", 512),
