@@ -56,6 +56,7 @@ from paddle_tpu import distributed
 from paddle_tpu import decode
 from paddle_tpu.dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from paddle_tpu import inference
+from paddle_tpu import serving
 from paddle_tpu import fleet as fleet_pkg
 from paddle_tpu import flags as flags_mod
 from paddle_tpu import debugger

@@ -22,20 +22,24 @@ survive unattended runs):
     (a transient pserver outage costs restarts, not the job);
   - per-var queues are BOUNDED (backpressure: a producer outrunning a
     wedged sender blocks in put() instead of growing without bound);
-  - stop() drains every queued grad to the pservers before returning,
+  - stop() drains EVERY queued grad to the pservers before returning,
     so a short job's last updates are never abandoned.
+
+The bounded-queue + supervised-worker machinery itself lives in
+paddle_tpu/concurrency.py (BoundedQueue / Supervisor) — the serving
+tier (paddle_tpu/serving/) runs its admission/dispatch queues and
+replica workers on the same primitives.
 """
 
 from __future__ import annotations
 
-import queue
-import threading
 import time
 
 import numpy as np
 
 import jax.numpy as jnp
 
+from paddle_tpu.concurrency import BoundedQueue, Supervisor
 from paddle_tpu.distributed.rpc import global_rpc_client
 
 
@@ -54,18 +58,19 @@ class Communicator:
         self._send_wait = send_wait_times
         self._recv_interval = recv_interval
         self._max_queue = int(max_queue_per_var) or 8 * max_merge_var_num
-        self._restart_backoff = float(restart_backoff)
-        self._queues = {g: queue.Queue(maxsize=self._max_queue)
+        self._queues = {g: BoundedQueue(maxsize=self._max_queue)
                         for g in (transpiler.grad_of[p]
                                   for p in transpiler.param_plan)}
         self._grad_to_param = {g: p
                                for p, g in transpiler.grad_of.items()}
-        self._running = False
-        self._threads: dict = {}        # name -> Thread (send/recv)
-        self._supervisor = None
-        self._errors = queue.Queue()    # (thread_name, exception)
-        self._error_log = []            # drained copy, errors() returns it
-        self._restarts = {"send": 0, "recv": 0}
+        self._sup = Supervisor(restart_backoff=restart_backoff,
+                               max_backoff=2.0)
+        self._sup.add_worker("send", self._send_loop)
+        self._sup.add_worker("recv", self._recv_loop)
+
+    @property
+    def _running(self):
+        return self._sup.running
 
     # -- trainer-facing -----------------------------------------------------
     def put(self, grad_name, value, block=True, timeout=None):
@@ -78,73 +83,22 @@ class Communicator:
         q.put(np.asarray(value), block=block, timeout=timeout)
 
     def start(self):
-        self._running = True
-        self._spawn("send", self._send_loop)
-        self._spawn("recv", self._recv_loop)
-        self._supervisor = threading.Thread(target=self._supervise,
-                                            daemon=True)
-        self._supervisor.start()
+        self._sup.start()
         return self
 
     def stop(self):
-        self._running = False
-        if self._supervisor is not None:
-            self._supervisor.join(timeout=5.0)
-        for th in self._threads.values():
-            th.join(timeout=5.0)
+        self._sup.stop(join_timeout=5.0)
         self._flush()
 
     def errors(self):
         """Every exception a worker thread reported (name, exc), oldest
         first; empty when the communicator has been healthy."""
-        while True:
-            try:
-                self._error_log.append(self._errors.get_nowait())
-            except queue.Empty:
-                break
-        return list(self._error_log)
+        return self._sup.errors()
 
     def restarts(self):
-        return dict(self._restarts)
+        return self._sup.restarts()
 
     # -- internals ----------------------------------------------------------
-    def _spawn(self, name, fn):
-        def guarded():
-            try:
-                fn()
-            except Exception as e:   # report, never die silently
-                self._errors.put((name, e))
-
-        th = threading.Thread(target=guarded, daemon=True)
-        th.start()
-        self._threads[name] = th
-
-    def _supervise(self):
-        """Restart dead workers with exponential backoff while running
-        (reference contrast: a dead C++ SendThread ends the job)."""
-        loops = {"send": self._send_loop, "recv": self._recv_loop}
-        while self._running:
-            for name, fn in loops.items():
-                th = self._threads.get(name)
-                if th is not None and not th.is_alive() and self._running:
-                    n = self._restarts[name]
-                    delay = min(self._restart_backoff * (2 ** n), 2.0)
-                    time.sleep(delay)
-                    if not self._running:
-                        return
-                    self._restarts[name] = n + 1
-                    self._spawn(name, fn)
-            time.sleep(0.05)
-
-    def _drain(self, q):
-        vals = []
-        while len(vals) < self._max_merge:
-            try:
-                vals.append(q.get_nowait())
-            except queue.Empty:
-                break
-        return vals
-
     def _merge(self, vals):
         return vals[0] if len(vals) == 1 else \
             np.mean(np.stack(vals), axis=0)
@@ -166,7 +120,7 @@ class Communicator:
         the tail silently loses updates the pserver never saw."""
         for gname, q in self._queues.items():
             while True:
-                vals = self._drain(q)
+                vals = q.drain(self._max_merge)
                 if not vals:
                     break
                 try:
@@ -175,14 +129,14 @@ class Communicator:
                     # endpoint gone at shutdown: record, stop trying
                     # this var (the remaining items would fail the same
                     # way), keep flushing the others
-                    self._errors.put(("flush", e))
+                    self._sup.report_error("flush", e)
                     break
 
     def _send_loop(self):
         while self._running:
             sent_any = False
             for gname, q in self._queues.items():
-                vals = self._drain(q)
+                vals = q.drain(self._max_merge)
                 if not vals:
                     continue
                 try:
@@ -190,10 +144,12 @@ class Communicator:
                 except Exception:
                     # requeue before dying: the supervisor restarts the
                     # loop and these updates ship late instead of never
+                    import queue as queue_mod
+
                     for v in vals:
                         try:
                             q.put_nowait(v)
-                        except queue.Full:
+                        except queue_mod.Full:
                             break
                     raise
                 sent_any = True

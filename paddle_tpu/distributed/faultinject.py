@@ -34,8 +34,14 @@ Plan grammar (``PADDLE_TPU_FAULT_PLAN`` or ``FaultPlan.parse``):
     plan  := item (';' item)*
     item  := rule | knob
     rule  := msg_type '@' index ':' action      # send_var@0:drop
-    action:= drop | close | kill | delay=SECONDS | truncate[=FRACTION]
+    action:= step ('+' step)*                   # delay=0.2+truncate
+    step  := drop | close | kill | delay=SECONDS | truncate[=FRACTION]
     knob  := seed=N | rate=P | actions=a,b,... | max=N
+
+A '+'-combined action applies every step to the SAME request in order
+(non-final steps must be ``delay``; ``close``/``kill`` stand alone):
+``delay=0.2+truncate`` runs the handler, holds the reply 0.2 s, then
+writes it truncated and closes mid-frame.
 
 ``msg_type`` may be ``*`` (any type; index counts per-type).  With
 ``seed``/``rate`` set, every call is additionally faulted with
@@ -62,13 +68,13 @@ import threading
 
 __all__ = [
     "FaultPlan", "FaultInjector", "install", "uninstall", "installed",
-    "maybe_injector",
+    "maybe_injector", "steps_of",
 ]
 
 _ACTIONS = ("drop", "close", "kill", "delay", "truncate")
 
 
-def _parse_action(text):
+def _parse_single(text):
     """'delay=0.5' -> ('delay', 0.5); validates kind + argument."""
     kind, _, arg = text.partition("=")
     kind = kind.strip()
@@ -87,6 +93,56 @@ def _parse_action(text):
     if arg:
         raise ValueError(f"action {kind!r} takes no argument")
     return (kind, None)
+
+
+def _parse_action(text):
+    """One action, or a '+'-combined chain applied to the SAME request
+    (e.g. ``delay=0.2+truncate``: handler runs, reply is held 0.2 s,
+    then written truncated — a latency spike that ends in wire
+    corruption, the failure shape a slow-then-dying peer produces).
+
+    Chain rules: every non-final step must be ``delay`` (the only
+    action with a pure-latency effect); the final step may be
+    ``delay``, ``drop`` or ``truncate``; ``close``/``kill`` stand
+    alone (the handler never runs, so a preceding delay would claim
+    latency the peer can't observe).  A single action parses exactly
+    as before: ('kind', arg).  A chain parses to ('seq', ((kind, arg),
+    ...)); transports normalize via ``steps_of``.
+    """
+    parts = [p.strip() for p in str(text).split("+")]
+    if len(parts) == 1:
+        return _parse_single(parts[0])
+    steps = tuple(_parse_single(p) for p in parts)
+    for kind, _ in steps:
+        if kind in ("close", "kill"):
+            raise ValueError(
+                f"action {kind!r} cannot be combined (handler never "
+                "runs, a chained step could not be observed)")
+    for kind, _ in steps[:-1]:
+        if kind != "delay":
+            raise ValueError(
+                "only 'delay' may precede another action in a chain "
+                f"(got {kind!r} before the final step)")
+    return ("seq", steps)
+
+
+def steps_of(action):
+    """Normalize a decide() result to its ordered step list:
+    ('drop', None) -> [('drop', None)]; ('seq', steps) -> list(steps)."""
+    kind, arg = action
+    return list(arg) if kind == "seq" else [(kind, arg)]
+
+
+def action_name(action):
+    """Loggable name: 'drop', or 'delay+truncate' for a chain."""
+    return "+".join(kind for kind, _ in steps_of(action))
+
+
+def _action_text(action):
+    """Inverse of _parse_action (single step or chain)."""
+    steps = steps_of(action)
+    return "+".join(kind if arg is None else f"{kind}={arg}"
+                    for kind, arg in steps)
 
 
 class FaultPlan:
@@ -161,9 +217,8 @@ class FaultPlan:
             items.append("actions=" + ",".join(self.random_actions))
         if self.max_faults is not None:
             items.append(f"max={self.max_faults}")
-        for (mt, idx), (kind, arg) in sorted(self.rules.items()):
-            act = kind if arg is None else f"{kind}={arg}"
-            items.append(f"{mt}@{idx}:{act}")
+        for (mt, idx), action in sorted(self.rules.items()):
+            items.append(f"{mt}@{idx}:{_action_text(action)}")
         return ";".join(items)
 
 
@@ -202,7 +257,7 @@ class FaultInjector:
                 or self.plan.rules.get(("*", idx)) \
                 or self._random_action(msg_type, idx)
             if act is not None:
-                self.log.append((msg_type, idx, act[0]))
+                self.log.append((msg_type, idx, action_name(act)))
             return act
 
     def counts(self):

@@ -71,28 +71,33 @@ class _Handler(BaseHTTPRequestHandler):
                     and len(msg) == 2 and isinstance(msg[0], str):
                 fault = inj.decide(msg[0])
             if fault is not None:
-                kind, arg = fault
-                if kind in ("close", "kill"):
+                steps = faultinject.steps_of(fault)
+                if steps[0][0] in ("close", "kill"):
                     # request-loss: the handler never runs
                     self._abort()
                     return
                 reply = rpc._dispatch(msg)  # shared with socket framing
-                if kind == "drop":
-                    self._abort()           # executed, reply discarded
-                    return
-                if kind == "truncate":
-                    out = wire_dumps(reply)
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "application/octet-stream")
-                    self.send_header("Content-Length", str(len(out)))
-                    self.end_headers()
-                    self.wfile.write(out[:max(1, int(len(out) * arg))])
-                    self.wfile.flush()
-                    self._abort()           # mid-body close
-                    return
-                if kind == "delay":
-                    time.sleep(arg)
+                # chains apply in order: delays first (after the
+                # handler), then at most one terminal step
+                for kind, arg in steps:
+                    if kind == "delay":
+                        time.sleep(arg)
+                    elif kind == "drop":
+                        self._abort()       # executed, reply discarded
+                        return
+                    elif kind == "truncate":
+                        out = wire_dumps(reply)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Content-Length",
+                                         str(len(out)))
+                        self.end_headers()
+                        self.wfile.write(
+                            out[:max(1, int(len(out) * arg))])
+                        self.wfile.flush()
+                        self._abort()       # mid-body close
+                        return
             else:
                 reply = rpc._dispatch(msg)  # shared with socket framing
         try:

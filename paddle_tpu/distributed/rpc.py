@@ -89,6 +89,14 @@ def _env_int(name, default):
     return int(v)
 
 
+def health_probe_interval(default=1.0):
+    """Seconds between liveness probes against a server's built-in
+    'health' handler, read from ``PADDLE_TPU_HEALTH_INTERVAL`` (the
+    serving replica pool and any other prober consume this one knob;
+    see docs/SERVING.md / docs/FAULT_TOLERANCE.md)."""
+    return _env_float("PADDLE_TPU_HEALTH_INTERVAL", default)
+
+
 # transport-level failures worth a transparent retry; handler ("error",
 # ...) replies are application errors and are NEVER retried
 _RETRYABLE_EXCS = (ConnectionError, TimeoutError, OSError, WireError)
@@ -491,26 +499,35 @@ class RPCServer:
                         and len(msg) == 2 and isinstance(msg[0], str):
                     fault = inj.decide(msg[0])
                 if fault is not None:
-                    kind, arg = fault
-                    if kind in ("close", "kill"):
+                    steps = faultinject.steps_of(fault)
+                    if steps[0][0] in ("close", "kill"):
                         # request-loss: handler never runs (kill = the
                         # handler thread crashed at entry)
                         return
                     reply = self._dispatch(msg)
-                    if kind == "drop":
-                        return  # reply-loss: executed, reply discarded
-                    if kind == "truncate":
-                        try:
-                            data = wire_dumps(reply)
-                            frame = _LEN.pack(len(data)) + data
-                            conn.sendall(
-                                frame[:max(1, int(len(frame) * arg))])
-                        except (WireError, OSError):
-                            pass
-                        return  # mid-frame close
-                    if kind == "delay":
-                        import time
-                        time.sleep(arg)
+                    # chains apply in order: delays run first (after
+                    # the handler), then at most one terminal step
+                    done = False
+                    for kind, arg in steps:
+                        if kind == "delay":
+                            import time
+                            time.sleep(arg)
+                        elif kind == "drop":
+                            done = True  # reply-loss: executed,
+                            break        # reply discarded
+                        elif kind == "truncate":
+                            try:
+                                data = wire_dumps(reply)
+                                frame = _LEN.pack(len(data)) + data
+                                conn.sendall(
+                                    frame[:max(1, int(len(frame)
+                                                      * arg))])
+                            except (WireError, OSError):
+                                pass
+                            done = True  # mid-frame close
+                            break
+                    if done:
+                        return
                 else:
                     reply = self._dispatch(msg)
                 try:
@@ -585,6 +602,47 @@ class RPCClient:
         self._seq = itertools.count(1)
         self._DEADLINE = None       # per-instance override of the env
         self._breaker: dict = {}    # endpoint -> [consec_fails, open_until]
+        self._stats_lock = threading.Lock()
+        self._endpoint_stats: dict = {}   # endpoint -> counter dict
+
+    def _stat(self, endpoint, **incs):
+        with self._stats_lock:
+            st = self._endpoint_stats.setdefault(
+                endpoint, {"calls": 0, "retries": 0,
+                           "deadline_misses": 0, "failures": 0})
+            for k, v in incs.items():
+                st[k] += v
+
+    def stats(self):
+        """Per-endpoint client-side failure telemetry — the breaker
+        state the fault-tolerance round left invisible, plus retry and
+        deadline-miss counts.  Shape (per endpoint):
+
+            {"calls": N, "retries": N, "deadline_misses": N,
+             "failures": N, "breaker": {"consecutive_failures": N,
+             "open": bool, "cooldown_remaining_s": float}}
+
+        ``failures`` counts TERMINAL call failures (retries exhausted /
+        deadline blown / breaker trip), not absorbed transient ones."""
+        import time
+
+        thresh = _env_int("PADDLE_TPU_RPC_CB_THRESHOLD", 8)
+        now = time.monotonic()
+        with self._stats_lock:
+            out = {ep: dict(st)
+                   for ep, st in self._endpoint_stats.items()}
+        for ep in set(out) | set(self._breaker):
+            st = self._breaker.get(ep)
+            out.setdefault(ep, {"calls": 0, "retries": 0,
+                                "deadline_misses": 0, "failures": 0})
+            out[ep]["breaker"] = {
+                "consecutive_failures": st[0] if st else 0,
+                "open": bool(st and thresh > 0 and st[0] >= thresh
+                             and now < st[1]),
+                "cooldown_remaining_s": max(0.0, st[1] - now)
+                if st else 0.0,
+            }
+        return out
 
     def _connect(self, endpoint, timeout=None):
         """Blocking connect with retry (the server may not be up yet —
@@ -719,7 +777,12 @@ class RPCClient:
                        next(self._seq), payload)
         elif msg_type not in self.IDEMPOTENT and not explicit_retries:
             retries = 0
-        self._breaker_gate(endpoint)
+        try:
+            self._breaker_gate(endpoint)
+        except CircuitOpenError:
+            self._stat(endpoint, calls=1, failures=1)
+            raise
+        self._stat(endpoint, calls=1)
         deadline_t = time.monotonic() + float(deadline)
         backoff = _env_float("PADDLE_TPU_RPC_BACKOFF", 0.05)
         attempt = 0
@@ -727,6 +790,7 @@ class RPCClient:
             budget = deadline_t - time.monotonic()
             if budget <= 0:
                 self._breaker_fail(endpoint)
+                self._stat(endpoint, deadline_misses=1, failures=1)
                 raise RPCDeadlineExceeded(
                     f"RPC '{msg_type}' to {endpoint}: deadline "
                     f"{deadline:g}s exhausted after {attempt} attempts")
@@ -737,11 +801,16 @@ class RPCClient:
                 attempt += 1
                 if attempt > retries:
                     self._breaker_fail(endpoint)
+                    self._stat(endpoint, failures=1,
+                               deadline_misses=int(
+                                   isinstance(e, socket.timeout)))
                     raise
+                self._stat(endpoint, retries=1)
                 sleep = min(backoff * (2 ** (attempt - 1)), 2.0) \
                     * (0.5 + random.random())
                 if time.monotonic() + sleep >= deadline_t:
                     self._breaker_fail(endpoint)
+                    self._stat(endpoint, deadline_misses=1, failures=1)
                     raise RPCDeadlineExceeded(
                         f"RPC '{msg_type}' to {endpoint}: deadline "
                         f"{deadline:g}s exhausted after {attempt} "

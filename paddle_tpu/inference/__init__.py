@@ -23,7 +23,16 @@ import os
 import numpy as np
 
 __all__ = ["Config", "AnalysisConfig", "Predictor", "PaddleTensor",
-           "create_predictor", "create_paddle_predictor"]
+           "FeedValidationError", "create_predictor",
+           "create_paddle_predictor"]
+
+
+class FeedValidationError(ValueError):
+    """A feed's name/shape/dtype doesn't match the program's feed
+    target.  Raised by Predictor.run BEFORE compilation with a one-line
+    message naming the offending feed — the alternative is an opaque
+    XLA trace error surfacing mid-batch (the serving tier turns this
+    into a typed per-request rejection)."""
 
 
 class Config:
@@ -166,6 +175,18 @@ class Predictor:
         self._compiled = CompiledProgram(self._program) \
             .with_inference_optimize(config)
         self._inputs = {n: PaddleTensor(n) for n in self._feed_names}
+        # feed target specs for run()-time validation: (shape, dtype)
+        # per feed name; shape dims < 0 (the batch dim) are wildcards
+        self._feed_specs = {}
+        block = self._program.global_block()
+        for n in self._feed_names:
+            try:
+                v = block.var(n)
+            except (KeyError, ValueError):
+                continue
+            if v.shape is not None and v.dtype is not None:
+                self._feed_specs[n] = (tuple(v.shape),
+                                       np.dtype(v.dtype))
 
     # -- ZeroCopy-style API ----------------------------------------------
     def get_input_names(self):
@@ -179,12 +200,57 @@ class Predictor:
     def get_output_names(self):
         return [v.name for v in self._fetch_vars]
 
+    def feed_specs(self):
+        """{feed name: (shape, dtype)} of the program's feed targets;
+        shape dims < 0 (the batch dim) accept any extent."""
+        return dict(self._feed_specs)
+
+    def validate_feed(self, name, value):
+        """Raise FeedValidationError (one line, naming the feed) when
+        `value` can't legally feed target `name`; returns the ndarray."""
+        if name not in self._feed_specs:
+            if name not in self._feed_names:
+                raise FeedValidationError(
+                    f"feed '{name}': not a feed target (expected one "
+                    f"of {sorted(self._feed_names)})")
+            return np.asarray(value)     # target without a recorded spec
+        shape, dtype = self._feed_specs[name]
+        arr = np.asarray(value)
+        if arr.dtype != dtype:
+            raise FeedValidationError(
+                f"feed '{name}': dtype {arr.dtype} does not match the "
+                f"program's feed target dtype {dtype}")
+        if len(arr.shape) != len(shape) or any(
+                d >= 0 and a != d for a, d in zip(arr.shape, shape)):
+            raise FeedValidationError(
+                f"feed '{name}': shape {tuple(arr.shape)} does not "
+                f"match the program's feed target shape {shape} "
+                "(dims < 0 are free)")
+        return arr
+
+    def validate_feeds(self, feeds):
+        """Validate a {name: array} dict: every feed target present,
+        no extras, every array shape/dtype-conformant."""
+        missing = set(self._feed_names) - set(feeds)
+        if missing:
+            raise FeedValidationError(
+                f"missing feeds {sorted(missing)} (feed targets: "
+                f"{sorted(self._feed_names)})")
+        return {n: self.validate_feed(n, v) for n, v in feeds.items()}
+
     def run(self, inputs=None):
         """inputs: list of PaddleTensor/ndarray in get_input_names() order,
         or None to use the handles filled via copy_from_cpu.  Returns list
-        of ndarrays; also retrievable via get_output_handle."""
+        of ndarrays; also retrievable via get_output_handle.  Feeds are
+        validated against the program's feed targets first — a
+        wrong-named/shaped/typed input raises FeedValidationError naming
+        the feed instead of an opaque XLA trace error mid-batch."""
         feed = {}
         if inputs is not None:
+            if len(inputs) != len(self._feed_names):
+                raise FeedValidationError(
+                    f"expected {len(self._feed_names)} inputs "
+                    f"({self._feed_names}), got {len(inputs)}")
             for name, t in zip(self._feed_names, inputs):
                 feed[name] = t.data() if isinstance(t, PaddleTensor) \
                     else np.asarray(t)
@@ -194,6 +260,7 @@ class Predictor:
                     raise RuntimeError(
                         f"input '{name}' not set; call copy_from_cpu")
                 feed[name] = t.data()
+        feed = {n: self.validate_feed(n, v) for n, v in feed.items()}
         outs = self._exe.run(self._compiled, feed=feed,
                              fetch_list=self._fetch_vars,
                              scope=self._scope)

@@ -1,0 +1,60 @@
+"""Production serving tier: a continuous-batching inference server
+over ``inference.Predictor`` whose headline property is robustness
+under overload and faults (ROADMAP "New directions" #1 — the
+"millions of users" half of the north star).
+
+    from paddle_tpu import inference, serving
+
+    factory = lambda i: inference.create_predictor(
+        inference.Config(model_dir))
+    with serving.InferenceServer(
+            factory, serving.ServingConfig(n_replicas=2)) as srv:
+        out = srv.infer({"x": batch})          # typed errors on shed
+
+Pieces (each its own module):
+  admission.py     bounded queue, typed shedding, the exactly-once
+                   Request future, request-id accounting
+  batcher.py       shape-bucketed dynamic batching, pad-to-bucket,
+                   compile-once bucket cache, max-wait timer
+  replica_pool.py  N predictor replicas, health probes, per-replica
+                   circuit breakers, failover/requeue, NamedSharding
+                   param replication helper
+  server.py        InferenceServer / ServingConfig / drain()
+
+Design + contracts: docs/SERVING.md.  Fault semantics are driven by
+distributed/faultinject.py (msg types ``serving_infer`` /
+``serving_health``) so every failure mode is seeded and replayable.
+"""
+
+from paddle_tpu.serving.admission import (
+    AdmissionController,
+    DeadlineExpiredError,
+    OverloadedError,
+    ReplicaFailedError,
+    Request,
+    ServingError,
+    ShutdownError,
+)
+from paddle_tpu.serving.batcher import (
+    Batch,
+    ShapeBucketBatcher,
+    default_buckets,
+    signature_of,
+)
+from paddle_tpu.serving.replica_pool import (
+    MSG_HEALTH,
+    MSG_INFER,
+    Replica,
+    ReplicaPool,
+    replicate_predictor_params,
+)
+from paddle_tpu.serving.server import InferenceServer, ServingConfig
+
+__all__ = [
+    "AdmissionController", "Batch", "DeadlineExpiredError",
+    "InferenceServer", "MSG_HEALTH", "MSG_INFER", "OverloadedError",
+    "Replica", "ReplicaFailedError", "ReplicaPool", "Request",
+    "ServingConfig", "ServingError", "ShapeBucketBatcher",
+    "ShutdownError", "default_buckets", "replicate_predictor_params",
+    "signature_of",
+]

@@ -1,0 +1,251 @@
+"""Admission control: bounded queue, typed load shedding, and the
+answered-exactly-once request future.
+
+Contract (docs/SERVING.md): every request the server ADMITS is answered
+exactly once — with a result or with a typed ``ServingError`` — and
+every request it does NOT admit is rejected synchronously with a typed
+error at submit().  Nothing is ever silently dropped; the counters here
+are the request-id accounting the acceptance test audits.
+
+The bounded queue + backpressure shape is the Communicator's
+(concurrency.BoundedQueue): over capacity, submit() raises
+``OverloadedError`` immediately instead of queueing work the deadline
+already condemned.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as queue_mod
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.concurrency import BoundedQueue
+
+__all__ = [
+    "ServingError", "OverloadedError", "DeadlineExpiredError",
+    "ShutdownError", "ReplicaFailedError", "Request",
+    "AdmissionController",
+]
+
+
+class ServingError(RuntimeError):
+    """Base of every typed non-success reply.  ``code`` is the stable
+    machine-readable reason (the load generator and soak key on it)."""
+
+    code = "error"
+
+
+class OverloadedError(ServingError):
+    """Rejected at admission: queue at capacity (load shed)."""
+
+    code = "overloaded"
+
+
+class DeadlineExpiredError(ServingError):
+    """The request's deadline passed — shed at admission, before batch
+    formation, or before result delivery (compute may or may not have
+    happened; the reply is typed either way)."""
+
+    code = "expired"
+
+
+class ShutdownError(ServingError):
+    """The server is draining / stopped; the request was answered with
+    this instead of being silently abandoned."""
+
+    code = "shutdown"
+
+
+class ReplicaFailedError(ServingError):
+    """No replica could run the batch (all dead / breaker-open /
+    failover attempts exhausted)."""
+
+    code = "failed"
+
+
+class Request:
+    """One admitted request: a future answered EXACTLY once.
+
+    ``complete``/``fail`` race-safely deliver the first answer and
+    ignore (but count) the rest — a failed-over batch re-computed on a
+    second replica can never double-deliver."""
+
+    __slots__ = ("id", "feeds", "rows", "deadline_t", "admitted_t",
+                 "_event", "_lock", "_result", "_error", "_on_done",
+                 "done_t")
+
+    def __init__(self, req_id, feeds, rows, deadline_t, on_done=None):
+        self.id = req_id
+        self.feeds = feeds            # {name: ndarray}, shared leading dim
+        self.rows = int(rows)         # leading-dim extent
+        self.deadline_t = float(deadline_t)
+        self.admitted_t = time.monotonic()
+        self.done_t = None
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result = None
+        self._error = None
+        self._on_done = on_done
+
+    def expired(self, now=None):
+        return (time.monotonic() if now is None else now) \
+            > self.deadline_t
+
+    def remaining(self, now=None):
+        return self.deadline_t - (time.monotonic() if now is None
+                                  else now)
+
+    def done(self):
+        return self._event.is_set()
+
+    def complete(self, result):
+        """Deliver a success reply; False if already answered."""
+        return self._finish(result, None)
+
+    def fail(self, exc):
+        """Deliver a typed error reply; False if already answered."""
+        return self._finish(None, exc)
+
+    def _finish(self, result, exc):
+        with self._lock:
+            if self._event.is_set():
+                return False          # exactly-once: first answer wins
+            self._result = result
+            self._error = exc
+            self.done_t = time.monotonic()
+            self._event.set()
+        if self._on_done is not None:
+            self._on_done(self, exc)
+        return True
+
+    def result(self, timeout=None):
+        """Block for the answer; returns the output list or raises the
+        typed ServingError the server answered with."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id}: no answer within {timeout}s "
+                "(the request is still in flight — this is a caller "
+                "wait timeout, not a server reply)")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def latency_s(self):
+        return None if self.done_t is None \
+            else self.done_t - self.admitted_t
+
+
+class AdmissionController:
+    """Bounded admission queue + typed shedding + request accounting."""
+
+    def __init__(self, capacity=64, default_deadline_s=1.0):
+        self.capacity = int(capacity)
+        self.default_deadline_s = float(default_deadline_s)
+        self._queue = BoundedQueue(maxsize=self.capacity)
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._outstanding: dict = {}     # id -> Request (admitted, unanswered)
+        self._draining = False
+        self._counters = {
+            "admitted": 0,
+            "rejected_overloaded": 0,    # never admitted (typed raise)
+            "rejected_expired": 0,
+            "rejected_shutdown": 0,
+            "answered_ok": 0,            # admitted -> success
+            "answered_expired": 0,       # admitted -> typed error, by code
+            "answered_shutdown": 0,
+            "answered_failed": 0,
+            "answered_error": 0,
+        }
+
+    # -- submit side --------------------------------------------------------
+    def submit(self, feeds, deadline_s=None, request_id=None):
+        """Admit a request or raise a typed ServingError.  feeds:
+        {name: ndarray} with a shared leading (batch) dim."""
+        if self._draining:
+            self._count("rejected_shutdown")
+            raise ShutdownError("server is draining: not admitting")
+        deadline_s = self.default_deadline_s if deadline_s is None \
+            else float(deadline_s)
+        now = time.monotonic()
+        if deadline_s <= 0:
+            self._count("rejected_expired")
+            raise DeadlineExpiredError(
+                f"deadline {deadline_s:g}s already expired at submit")
+        rows = None
+        for name, arr in feeds.items():
+            arr = np.asarray(arr)
+            n = arr.shape[0] if arr.ndim else 1
+            if rows is None:
+                rows = n
+            elif n != rows:
+                raise ValueError(
+                    f"feed '{name}' leading dim {n} != {rows} "
+                    "(all feeds of one request share the batch dim)")
+        if not rows:
+            raise ValueError("request with no feeds / zero rows")
+        req = Request(
+            request_id if request_id is not None else next(self._ids),
+            {n: np.asarray(v) for n, v in feeds.items()},
+            rows, now + deadline_s, on_done=self._on_done)
+        try:
+            self._queue.put(req, block=False)
+        except queue_mod.Full:
+            self._count("rejected_overloaded")
+            raise OverloadedError(
+                f"admission queue full (capacity {self.capacity}): "
+                "load shed") from None
+        with self._lock:
+            self._outstanding[req.id] = req
+            self._counters["admitted"] += 1
+        return req
+
+    # -- batcher side -------------------------------------------------------
+    def take(self, timeout=0.002):
+        """Pop the next admitted request (None on timeout)."""
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue_mod.Empty:
+            return None
+
+    # -- drain / accounting -------------------------------------------------
+    def start_drain(self):
+        """Stop admitting; everything already admitted will still be
+        answered (or typed-shutdown by the server's drain sweep)."""
+        self._draining = True
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def outstanding(self):
+        """Admitted-but-unanswered requests, id -> Request."""
+        with self._lock:
+            return dict(self._outstanding)
+
+    def outstanding_count(self):
+        with self._lock:
+            return len(self._outstanding)
+
+    def counters(self):
+        with self._lock:
+            return dict(self._counters)
+
+    def _count(self, key, n=1):
+        with self._lock:
+            self._counters[key] += n
+
+    def _on_done(self, req, exc):
+        with self._lock:
+            self._outstanding.pop(req.id, None)
+            if exc is None:
+                self._counters["answered_ok"] += 1
+            else:
+                code = getattr(exc, "code", "error")
+                self._counters[
+                    "answered_%s" % (code if "answered_%s" % code
+                                     in self._counters else "error")
+                ] += 1

@@ -1,0 +1,379 @@
+"""Multi-replica execution: health probes, per-replica circuit
+breakers, and automatic failover of in-flight batches.
+
+Replicas are N in-process predictors (one per supervised worker
+thread) — the off-chip shape of data-parallel serving; on a real mesh
+the same pool runs predictors whose params were placed with
+``replicate_predictor_params`` (NamedSharding replicate over the
+device mesh, the SNIPPETS [2]/[3] idiom), so every replica reads one
+shared device copy.
+
+Every failure mode is driven through ``distributed/faultinject.py``
+so it is a seeded, replayable test: replicas consult the installed
+plan under msg types ``serving_infer`` (one call per batch execution)
+and ``serving_health`` (one per probe).  Action semantics mirror the
+wire transports:
+
+  ``kill``       the replica dies mid-batch (worker thread exits); the
+                 in-flight batch is requeued to a surviving replica.
+  ``close``      transient execution failure BEFORE compute ran.
+  ``drop``       compute ran, the reply frame is lost — the batch is
+                 requeued; exactly-once delivery is the Request
+                 future's job, so the re-computed answer lands once.
+  ``delay=S``    the reply is S seconds late (deadline exercise).
+  ``truncate``   reply frame corrupt mid-write: treated like drop.
+
+Health probes run every ``PADDLE_TPU_HEALTH_INTERVAL`` seconds (the
+same knob RPC-level probers read — distributed.rpc.
+health_probe_interval); a probe failure counts against the replica's
+breaker exactly like a batch failure.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+
+from paddle_tpu.concurrency import BoundedQueue, Supervisor
+from paddle_tpu.distributed import faultinject
+from paddle_tpu.distributed.rpc import health_probe_interval
+from paddle_tpu.serving.admission import (DeadlineExpiredError,
+                                          ReplicaFailedError)
+
+__all__ = ["MSG_INFER", "MSG_HEALTH", "ReplicaKilled", "ReplyLost",
+           "Replica", "ReplicaPool", "replicate_predictor_params"]
+
+MSG_INFER = "serving_infer"
+MSG_HEALTH = "serving_health"
+
+
+class ReplicaKilled(RuntimeError):
+    """The replica process/thread died (injected ``kill``)."""
+
+
+class ReplyLost(RuntimeError):
+    """Transient execution failure; the batch is safe to requeue."""
+
+
+class Replica:
+    """One predictor + liveness/breaker state."""
+
+    def __init__(self, index, predictor, breaker_threshold=3,
+                 breaker_cooldown_s=0.5):
+        self.index = int(index)
+        self.predictor = predictor
+        self.alive = True
+        self.last_health_t = None
+        self.batches = 0
+        self.failures = 0
+        self._consec_fails = 0
+        self._open_until = 0.0
+        self._threshold = int(breaker_threshold)
+        self._cooldown = float(breaker_cooldown_s)
+        self._lock = threading.Lock()
+
+    # -- breaker (the RPCClient per-endpoint shape, per replica) ------------
+    def available(self, now=None):
+        """Live and breaker-closed (or half-open: one probe allowed)."""
+        if not self.alive:
+            return False
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._threshold <= 0 or \
+                    self._consec_fails < self._threshold:
+                return True
+            if now < self._open_until:
+                return False
+            # half-open: admit this probe, push the window
+            self._open_until = now + self._cooldown
+            return True
+
+    def breaker_open(self, now=None):
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return self._threshold > 0 and \
+                self._consec_fails >= self._threshold and \
+                now < self._open_until
+
+    def record_ok(self):
+        with self._lock:
+            self._consec_fails = 0
+
+    def record_failure(self):
+        with self._lock:
+            self._consec_fails += 1
+            self._open_until = time.monotonic() + self._cooldown
+            self.failures += 1
+
+    # -- execution ----------------------------------------------------------
+    def run(self, batch):
+        """Run one batch through the predictor, consulting the fault
+        plan first.  Returns the predictor's output list."""
+        inj = faultinject.maybe_injector()
+        steps = []
+        if inj is not None:
+            act = inj.decide(MSG_INFER)
+            if act is not None:
+                steps = faultinject.steps_of(act)
+        if steps and steps[0][0] in ("close", "kill"):
+            if steps[0][0] == "kill":
+                self.alive = False
+                raise ReplicaKilled(
+                    f"replica {self.index} killed mid-batch "
+                    "(fault injection)")
+            raise ReplyLost(
+                f"replica {self.index}: connection closed before "
+                "compute (fault injection)")
+        feeds = [batch.feeds[n]
+                 for n in self.predictor.get_input_names()]
+        outs = self.predictor.run(feeds)
+        for kind, arg in steps:
+            if kind == "delay":
+                time.sleep(arg)
+            elif kind in ("drop", "truncate"):
+                raise ReplyLost(
+                    f"replica {self.index}: reply frame "
+                    f"{'lost' if kind == 'drop' else 'corrupt'} "
+                    "(fault injection)")
+        self.batches += 1
+        return outs
+
+    def health(self):
+        """Liveness probe (fault-aware; raises on probe failure)."""
+        inj = faultinject.maybe_injector()
+        if inj is not None:
+            act = inj.decide(MSG_HEALTH)
+            if act is not None:
+                for kind, arg in faultinject.steps_of(act):
+                    if kind == "delay":
+                        time.sleep(arg)
+                    else:
+                        raise ReplyLost(
+                            f"replica {self.index}: health probe "
+                            f"{kind} (fault injection)")
+        if not self.alive:
+            raise ReplicaKilled(f"replica {self.index} is dead")
+        self.last_health_t = time.monotonic()
+        return {"status": "ok", "replica": self.index,
+                "batches": self.batches}
+
+    def stats(self, now=None):
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return {
+                "alive": self.alive,
+                "batches": self.batches,
+                "failures": self.failures,
+                "breaker": {
+                    "consecutive_failures": self._consec_fails,
+                    "open": self._threshold > 0 and
+                    self._consec_fails >= self._threshold and
+                    now < self._open_until,
+                    "cooldown_remaining_s":
+                        max(0.0, self._open_until - now),
+                },
+                "last_health_age_s":
+                    None if self.last_health_t is None
+                    else now - self.last_health_t,
+            }
+
+
+class ReplicaPool:
+    """Dispatch queue + N supervised replica workers + health monitor."""
+
+    def __init__(self, predictor_factory, n_replicas=2,
+                 dispatch_capacity=8, breaker_threshold=3,
+                 breaker_cooldown_s=0.5, health_interval_s=None,
+                 restart_dead=True, max_batch_attempts=None,
+                 restart_backoff=0.05):
+        """predictor_factory(i) -> a Predictor for replica i (each
+        replica owns its predictor: private scope + compile cache).
+        restart_dead=False leaves a killed replica down — pure
+        failover, the acceptance-test mode."""
+        self._factory = predictor_factory
+        self._restart_dead = bool(restart_dead)
+        self._max_attempts = int(max_batch_attempts) \
+            if max_batch_attempts is not None else 2 * n_replicas + 1
+        self._health_interval = health_probe_interval(1.0) \
+            if health_interval_s is None else float(health_interval_s)
+        self.dispatch = BoundedQueue(maxsize=dispatch_capacity)
+        # failover lane: UNBOUNDED on purpose — a worker must never
+        # block requeueing into a full dispatch queue that only itself
+        # consumes (single-survivor deadlock).  Total batches in the
+        # system stay bounded by the admission queue's capacity, so
+        # this lane cannot grow without bound.
+        self._retry = BoundedQueue()
+        self.replicas = [
+            Replica(i, predictor_factory(i),
+                    breaker_threshold=breaker_threshold,
+                    breaker_cooldown_s=breaker_cooldown_s)
+            for i in range(int(n_replicas))]
+        self._sup = Supervisor(restart_backoff=restart_backoff,
+                               max_backoff=1.0)
+        for rep in self.replicas:
+            self._sup.add_worker("replica-%d" % rep.index,
+                                 self._make_worker(rep),
+                                 restart=self._restart_dead)
+        self._sup.add_worker("health", self._health_loop, restart=True)
+        self._lock = threading.Lock()
+        self._in_flight = 0
+        self._counters = {"batches_ok": 0, "batches_failed": 0,
+                          "requeues": 0, "probes": 0,
+                          "probe_failures": 0, "shed_expired_batches": 0}
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._sup.start()
+        return self
+
+    def stop(self, join_timeout=5.0):
+        self._sup.stop(join_timeout=join_timeout)
+
+    def errors(self):
+        return self._sup.errors()
+
+    def restarts(self):
+        return self._sup.restarts()
+
+    # -- batch intake -------------------------------------------------------
+    def submit_batch(self, batch, block=True, timeout=None):
+        self.dispatch.put(batch, block=block, timeout=timeout)
+
+    def live_replicas(self):
+        return [r.index for r in self.replicas if r.alive]
+
+    def in_flight(self):
+        with self._lock:
+            return self._in_flight
+
+    def idle(self):
+        return self.dispatch.empty() and self._retry.empty() \
+            and self.in_flight() == 0
+
+    def counters(self):
+        with self._lock:
+            return dict(self._counters)
+
+    def stats(self):
+        now = time.monotonic()
+        st = {"replicas": {r.index: r.stats(now)
+                           for r in self.replicas},
+              "dispatch_depth": self.dispatch.qsize(),
+              "retry_depth": self._retry.qsize(),
+              "in_flight": self.in_flight(),
+              "restarts": self.restarts()}
+        st.update(self.counters())
+        return st
+
+    # -- workers ------------------------------------------------------------
+    def _make_worker(self, rep):
+        def loop():
+            # a supervisor restart of this loop IS the replica relaunch
+            # (restart_dead=True); with restart_dead=False the
+            # supervisor never respawns it and the replica stays down
+            if not rep.alive and self._restart_dead:
+                rep.alive = True
+                rep.record_ok()
+            while self._sup.running:
+                if not rep.alive:
+                    return
+                try:                      # failover lane first
+                    batch = self._retry.get_nowait()
+                except queue_mod.Empty:
+                    try:
+                        batch = self.dispatch.get(timeout=0.01)
+                    except queue_mod.Empty:
+                        continue
+                if not rep.available():
+                    # breaker open: hand the batch to a healthier
+                    # replica; brief sleep avoids a requeue spin when
+                    # every breaker is open
+                    self._retry.put(batch)
+                    time.sleep(0.005)
+                    continue
+                if batch.all_expired():
+                    # every rider's deadline passed while queued: shed
+                    # without compute, typed replies
+                    self._count(shed_expired_batches=1)
+                    batch.fail_all(DeadlineExpiredError(
+                        "batch expired before execution"))
+                    continue
+                with self._lock:
+                    self._in_flight += 1
+                try:
+                    outs = rep.run(batch)
+                except ReplicaKilled:
+                    rep.record_failure()
+                    self._requeue_or_fail(batch)
+                    raise      # worker dies; supervisor may relaunch
+                except Exception:
+                    rep.record_failure()
+                    self._requeue_or_fail(batch)
+                else:
+                    rep.record_ok()
+                    batch.deliver(outs)
+                    self._count(batches_ok=1)
+                finally:
+                    with self._lock:
+                        self._in_flight -= 1
+
+        return loop
+
+    def _requeue_or_fail(self, batch):
+        """Failover: push the batch back for another replica, or answer
+        every rider with the typed failure when there is nowhere left
+        to go (never a silent drop)."""
+        batch.attempts += 1
+        live = [r for r in self.replicas if r.alive]
+        if batch.attempts >= self._max_attempts or not live:
+            self._count(batches_failed=1)
+            batch.fail_all(ReplicaFailedError(
+                f"batch failed after {batch.attempts} attempts; "
+                f"{len(live)} live replicas"))
+            return
+        self._count(requeues=1)
+        self._retry.put(batch)         # unbounded lane: never blocks
+
+    def _health_loop(self):
+        while self._sup.running:
+            for rep in self.replicas:
+                if not self._sup.running:
+                    return
+                if not rep.alive:
+                    continue
+                self._count(probes=1)
+                try:
+                    rep.health()
+                except Exception:
+                    rep.record_failure()
+                    self._count(probe_failures=1)
+            t = time.monotonic() + self._health_interval
+            while self._sup.running and time.monotonic() < t:
+                time.sleep(min(0.02, self._health_interval))
+
+    def _count(self, **incs):
+        with self._lock:
+            for k, v in incs.items():
+                self._counters[k] += v
+
+
+def replicate_predictor_params(predictor, mesh=None):
+    """Place every initialized var of the predictor's scope replicated
+    over the device mesh (NamedSharding(mesh, P()) — the SNIPPETS
+    [2]/[3] ``replicate`` idiom): N data-parallel serving replicas then
+    read ONE shared device copy of the weights instead of N host
+    copies.  Returns the mesh used."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel import env as penv
+
+    if mesh is None:
+        mesh = penv.get_mesh() or penv.make_mesh()
+    sharding = NamedSharding(mesh, P())
+    for name, var in predictor._scope.vars.items():
+        val = var.get()
+        if val is not None:
+            var.set(jax.device_put(val, sharding))
+    return mesh

@@ -1,0 +1,190 @@
+"""The continuous-batching inference server.
+
+Pipeline: submit() -> AdmissionController (bounded queue, typed
+shedding) -> ShapeBucketBatcher (pad-to-bucket, max-wait timer) ->
+ReplicaPool dispatch (health/breakers/failover) -> Request future
+answered exactly once.
+
+Robustness contract (asserted by tests/test_serving.py and the
+acceptance soak):
+
+  - every ADMITTED request is answered exactly once — a result, or a
+    typed ServingError (expired / failed / shutdown); never a silent
+    drop (request-id accounting in AdmissionController);
+  - over capacity or past deadline, requests are REJECTED with a typed
+    error at submit() — overload degrades into typed shedding while
+    admitted-request latency stays within the deadline;
+  - a replica dying mid-batch requeues the batch onto survivors
+    transparently (ReplicaPool failover);
+  - drain() completes every admitted request (or answers it with the
+    typed ShutdownError) before the server exits.
+"""
+
+from __future__ import annotations
+
+import time
+
+from paddle_tpu.concurrency import Supervisor
+from paddle_tpu.serving.admission import (AdmissionController,
+                                          ReplicaFailedError,
+                                          ShutdownError)
+from paddle_tpu.serving.batcher import ShapeBucketBatcher, \
+    default_buckets
+from paddle_tpu.serving.replica_pool import ReplicaPool
+
+__all__ = ["ServingConfig", "InferenceServer"]
+
+
+class ServingConfig:
+    """Server knobs (mirrors the env-knob table in docs/SERVING.md)."""
+
+    def __init__(self, max_batch=8, buckets=None, max_wait_s=0.005,
+                 queue_capacity=None, default_deadline_s=1.0,
+                 n_replicas=2, dispatch_capacity=None,
+                 breaker_threshold=3, breaker_cooldown_s=0.5,
+                 health_interval_s=None, restart_dead=True,
+                 max_batch_attempts=None, drain_timeout_s=30.0):
+        self.max_batch = int(max_batch)
+        self.buckets = tuple(buckets) if buckets is not None \
+            else default_buckets(self.max_batch)
+        self.max_wait_s = float(max_wait_s)
+        # capacity defaults scale with the batch so a full pipeline is
+        # ~2 batches deep per stage — bounded work-in-progress is what
+        # keeps admitted-request latency under the deadline
+        self.queue_capacity = int(queue_capacity) \
+            if queue_capacity is not None else 4 * self.max_batch
+        self.default_deadline_s = float(default_deadline_s)
+        self.n_replicas = int(n_replicas)
+        self.dispatch_capacity = int(dispatch_capacity) \
+            if dispatch_capacity is not None else 2 * self.n_replicas
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.health_interval_s = health_interval_s
+        self.restart_dead = bool(restart_dead)
+        self.max_batch_attempts = max_batch_attempts
+        self.drain_timeout_s = float(drain_timeout_s)
+
+
+class InferenceServer:
+    """Continuous-batching server over N predictor replicas.
+
+    predictor_factory(i) -> inference.Predictor for replica i (e.g.
+    ``lambda i: inference.create_predictor(inference.Config(d))``).
+    """
+
+    def __init__(self, predictor_factory, config=None):
+        self.config = cfg = config or ServingConfig()
+        self.admission = AdmissionController(
+            capacity=cfg.queue_capacity,
+            default_deadline_s=cfg.default_deadline_s)
+        self.pool = ReplicaPool(
+            predictor_factory, n_replicas=cfg.n_replicas,
+            dispatch_capacity=cfg.dispatch_capacity,
+            breaker_threshold=cfg.breaker_threshold,
+            breaker_cooldown_s=cfg.breaker_cooldown_s,
+            health_interval_s=cfg.health_interval_s,
+            restart_dead=cfg.restart_dead,
+            max_batch_attempts=cfg.max_batch_attempts)
+        self.batcher = ShapeBucketBatcher(
+            self.admission, self.pool.dispatch, buckets=cfg.buckets,
+            max_wait_s=cfg.max_wait_s)
+        self._sup = Supervisor(restart_backoff=0.02, max_backoff=0.5)
+        self._sup.add_worker(
+            "batcher",
+            lambda: self.batcher.run_loop(lambda: self._sup.running),
+            restart=True)
+        self._validator = self.pool.replicas[0].predictor \
+            if self.pool.replicas else None
+        self._started = False
+        self._stopped = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._started:
+            return self
+        self._started = True
+        self.pool.start()
+        self._sup.start()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- request path -------------------------------------------------------
+    def submit(self, feeds, deadline_s=None, request_id=None):
+        """Admit a request; returns a Request future.  Raises a typed
+        ServingError synchronously when the request is NOT admitted
+        (overloaded / expired / shutdown / no live replicas) and
+        FeedValidationError when the feeds don't match the program's
+        feed targets (a malformed request must never poison a batch)."""
+        if not self._started or self._stopped:
+            self.admission._count("rejected_shutdown")
+            raise ShutdownError("server not running")
+        if not self.pool.live_replicas():
+            # graceful degradation: with every replica down, reject
+            # typed-and-fast instead of admitting work nobody can run
+            self.admission._count("rejected_overloaded")
+            raise ReplicaFailedError("no live replicas")
+        if self._validator is not None:
+            feeds = self._validator.validate_feeds(feeds)
+        return self.admission.submit(feeds, deadline_s=deadline_s,
+                                     request_id=request_id)
+
+    def infer(self, feeds, deadline_s=None, timeout=None):
+        """Synchronous convenience: submit + result."""
+        req = self.submit(feeds, deadline_s=deadline_s)
+        return req.result(timeout=timeout)
+
+    # -- shutdown -----------------------------------------------------------
+    def drain(self, timeout=None):
+        """Graceful shutdown of the request path: stop admitting, then
+        wait for every admitted request to be answered; whatever is
+        still unanswered at the timeout is answered with the typed
+        ShutdownError.  Returns the number of requests that had to be
+        shutdown-failed (0 = fully clean drain)."""
+        timeout = self.config.drain_timeout_s if timeout is None \
+            else float(timeout)
+        self.admission.start_drain()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.admission.outstanding_count() == 0 and \
+                    self.pool.idle():
+                break
+            time.sleep(0.005)
+        leftovers = self.admission.outstanding()
+        for req in leftovers.values():
+            req.fail(ShutdownError(
+                f"request {req.id}: server drained before completion"))
+        return len(leftovers)
+
+    def stop(self, drain_timeout=None):
+        """drain() then tear the workers down."""
+        if self._stopped:
+            return 0
+        leftovers = self.drain(timeout=drain_timeout)
+        self._stopped = True
+        self._sup.stop(join_timeout=2.0)
+        self.pool.stop(join_timeout=2.0)
+        return leftovers
+
+    # -- observability ------------------------------------------------------
+    def stats(self):
+        """One dict the load generator / soak serializes: admission
+        counters + batcher + pool state."""
+        c = self.admission.counters()
+        answered = sum(v for k, v in c.items()
+                       if k.startswith("answered_"))
+        return {
+            "admission": c,
+            "outstanding": self.admission.outstanding_count(),
+            "answered": answered,
+            "accounted": answered + self.admission.outstanding_count()
+            == c["admitted"],
+            "batcher": self.batcher.stats(),
+            "pool": self.pool.stats(),
+            "draining": self.admission.draining,
+        }

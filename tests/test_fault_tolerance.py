@@ -129,6 +129,81 @@ def test_max_faults_bounds_injection():
     assert len(inj.log) == 1
 
 
+def test_combined_action_grammar_roundtrip():
+    """ISSUE 6 satellite: '+'-combined actions (delay THEN truncate on
+    the same (msg_type, call_index)) parse, round-trip, and log under
+    a joined name."""
+    plan = FaultPlan.parse("echo@0:delay=0.2+truncate=0.25")
+    assert plan.rules[("echo", 0)] == \
+        ("seq", (("delay", 0.2), ("truncate", 0.25)))
+    assert FaultPlan.parse(plan.to_text()).rules == plan.rules
+    # builder form + multi-delay chain
+    p2 = FaultPlan().on("e", 1, "delay=0.1+delay=0.1+drop")
+    assert p2.rules[("e", 1)] == \
+        ("seq", (("delay", 0.1), ("delay", 0.1), ("drop", None)))
+    inj = FaultInjector(p2)
+    inj.decide("e")
+    assert inj.decide("e") == p2.rules[("e", 1)]
+    assert inj.log == [("e", 1, "delay+delay+drop")]
+    # steps_of normalizes both shapes
+    assert faultinject.steps_of(("drop", None)) == [("drop", None)]
+    assert faultinject.steps_of(p2.rules[("e", 1)])[0] == ("delay", 0.1)
+
+
+@pytest.mark.parametrize("bad", [
+    "e@0:close+delay=1",      # close/kill stand alone
+    "e@0:delay=1+kill",
+    "e@0:drop+truncate",      # terminal step must be final
+    "e@0:truncate+delay=1",
+    "e@0:drop+drop",
+])
+def test_combined_action_rejects_invalid_chains(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_combined_delay_then_truncate_on_wire(transport):
+    """The combined action applies to ONE request on the wire: the
+    handler runs, the reply is held, then written truncated — the
+    client sees a late broken frame, evicts, retries, and the stream
+    stays in sync afterwards.  Runs on both framings."""
+    server, client = transport
+    server.register_handler("echo", lambda p: p)
+    plan = FaultPlan().on("echo", 0, "delay=0.25+truncate")
+    with faultinject.installed(plan) as inj:
+        t0 = time.monotonic()
+        out = client.call(server.endpoint, "echo",
+                          {"k": np.arange(6.0)}, retries=3)
+        assert time.monotonic() - t0 >= 0.25    # the delay really ran
+    assert out["k"][5] == 5.0
+    assert inj.log == [("echo", 0, "delay+truncate")]
+    for i in range(3):                  # no desync after the mid-frame
+        assert client.call(server.endpoint, "echo", i) == i
+
+
+def test_rpc_client_stats_expose_breaker_retries_deadline(transport):
+    """ISSUE 6 satellite: stats() makes the PR 3 breaker state visible
+    per endpoint, plus transparent-retry and deadline-miss counts."""
+    server, client = transport
+    server.register_handler("echo", lambda p: p)
+    with faultinject.installed(FaultPlan().on("echo", 0, "drop")):
+        assert client.call(server.endpoint, "echo", 1, retries=3) == 1
+    st = client.stats()[server.endpoint]
+    assert st["calls"] >= 1 and st["retries"] >= 1
+    assert st["failures"] == 0
+    assert st["breaker"] == {"consecutive_failures": 0, "open": False,
+                             "cooldown_remaining_s": 0.0}
+    # a reply delayed past the deadline counts as a deadline miss and
+    # a terminal failure, and the breaker state surfaces
+    with faultinject.installed(FaultPlan().on("echo", 0, "delay=1.0")):
+        with pytest.raises(OSError):
+            client.call(server.endpoint, "echo", "x", deadline=0.25,
+                        retries=0)
+    st = client.stats()[server.endpoint]
+    assert st["deadline_misses"] >= 1 and st["failures"] >= 1
+    assert st["breaker"]["consecutive_failures"] >= 1
+
+
 # ---------------------------------------------------------------------------
 # transports under injected faults
 # ---------------------------------------------------------------------------

@@ -1,18 +1,29 @@
-"""Randomized-seed chaos soak of a loopback PS cluster.
+"""Randomized-seed chaos soak: loopback PS cluster, or serving tier.
 
-Runs N minutes (or --iterations runs) of a 2-trainer/2-pserver sync
-training job with a seeded random fault plan injected at the pservers
-(PADDLE_TPU_FAULT_PLAN: drop/close/delay/truncate at rate --rate,
-bounded by --max-faults), asserting every iteration that the cluster
-completes and converges despite the faults.  Each iteration's plan is
-fully determined by its seed, so any failure replays exactly:
+--mode cluster (default): N minutes (or --iterations runs) of a
+2-trainer/2-pserver sync training job with a seeded random fault plan
+injected at the pservers (PADDLE_TPU_FAULT_PLAN: drop/close/delay/
+truncate at rate --rate, bounded by --max-faults), asserting every
+iteration that the cluster completes and converges despite the faults.
+
+--mode serving: each iteration drives an in-process InferenceServer
+(2 replicas) under a seeded random plan over the serving fault points
+(``serving_infer``: kill/close/drop/delay, ``serving_health``) and
+asserts the ISSUE 6 robustness contract — every admitted request
+answered exactly once (typed success or typed rejection, request-id
+accounting exact), the pool keeps serving through replica kills, and
+drain() leaves nothing silently dropped.
+
+Each iteration's plan is fully determined by its seed, so any failure
+replays exactly:
 
     python tools/chaos_soak.py --seed 1234 --iterations 1   # CI leg
+    python tools/chaos_soak.py --mode serving --iterations 2
     python tools/chaos_soak.py --minutes 10                 # soak
 
 Prints one line of JSON to stdout as the verdict:
-    {"ok": true, "iterations": 7, "failures": [], "seeds": [...],
-     "transport": "socket", "wall_s": 123.4}
+    {"ok": true, "mode": "cluster", "iterations": 7, "failures": [],
+     "seeds": [...], "transport": "socket", "wall_s": 123.4}
 Exit code 0 iff every iteration passed.
 """
 
@@ -155,6 +166,104 @@ def run_iteration(seed, rate, max_faults, transport, timeout):
                 p.kill()
 
 
+_serving_model_dir = None
+
+
+def run_serving_iteration(seed, rate, max_faults, timeout,
+                          n_requests=60):
+    """One faulted serving run (in-process); (ok, detail, n_faults).
+
+    The fault plan is seeded rate-based over the serving fault points;
+    the contract checked is the ISSUE 6 acceptance shape: exact
+    request-id accounting (typed success or typed rejection for every
+    admitted request — zero silent drops), service survives replica
+    kills (restart_dead=True: the supervisor relaunches), and drain
+    leaves outstanding == 0."""
+    global _serving_model_dir
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import numpy as np
+
+    from paddle_tpu import serving
+    from paddle_tpu.distributed import faultinject
+    from paddle_tpu.distributed.faultinject import FaultPlan
+
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serving_load",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "serving_load.py"))
+    sl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sl)
+
+    if _serving_model_dir is None:
+        _serving_model_dir = sl.build_model(tempfile.mkdtemp())
+    plan = FaultPlan(seed=seed, rate=rate,
+                     actions=("kill", "close", "drop", "delay=0.05",
+                              "delay=0.02+drop"),
+                     max_faults=max_faults)
+    rng = np.random.RandomState(seed)
+    deadline = time.monotonic() + timeout
+    try:
+        with faultinject.installed(plan) as inj:
+            srv = sl.make_server(_serving_model_dir, replicas=2,
+                                 max_batch=8, deadline_ms=5000.0,
+                                 max_wait_ms=2.0, warmup=True,
+                                 health_interval_s=0.05,
+                                 restart_dead=True)
+            try:
+                futures, rejected = [], 0
+                for i in range(n_requests):
+                    x = rng.rand(1, 8).astype(np.float32)
+                    try:
+                        futures.append(srv.submit({"x": x}))
+                    except serving.ServingError:
+                        rejected += 1
+                    time.sleep(0.002)
+                answered = 0
+                for f in futures:
+                    if time.monotonic() > deadline:
+                        return (False, f"seed={seed}: request {f.id} "
+                                "unanswered at soak timeout (silent "
+                                "drop?)", len(inj.log))
+                    try:
+                        f.result(timeout=max(
+                            0.1, deadline - time.monotonic()))
+                    except serving.ServingError:
+                        pass     # typed rejection: answered, counted
+                    except TimeoutError:
+                        return (False, f"seed={seed}: request {f.id} "
+                                "unanswered (silent drop?)",
+                                len(inj.log))
+                    answered += 1
+                leftovers = srv.stop()
+                st = srv.stats()
+                c = st["admission"]
+                if answered != len(futures):
+                    return (False, f"seed={seed}: answered {answered}"
+                            f"/{len(futures)}", len(inj.log))
+                if not st["accounted"] or st["outstanding"]:
+                    return (False, f"seed={seed}: accounting broken "
+                            f"{c} outstanding={st['outstanding']}",
+                            len(inj.log))
+                if c["answered_ok"] == 0:
+                    return (False, f"seed={seed}: no request ever "
+                            "succeeded", len(inj.log))
+                if rejected + c["admitted"] != n_requests:
+                    return (False, f"seed={seed}: submit accounting "
+                            f"{rejected}+{c['admitted']} != "
+                            f"{n_requests}", len(inj.log))
+                _ = leftovers  # typed shutdown answers, already counted
+                return True, "", len(inj.log)
+            finally:
+                srv.stop()
+    except Exception as e:   # noqa: BLE001 — verdict, not crash
+        return False, f"seed={seed}: {type(e).__name__}: {e}", 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="randomized chaos soak of a loopback PS cluster")
@@ -174,7 +283,15 @@ def main(argv=None):
                     default="socket")
     ap.add_argument("--timeout", type=float, default=240.0,
                     help="per-iteration trainer timeout (s)")
+    ap.add_argument("--mode", choices=["cluster", "serving"],
+                    default="cluster")
     args = ap.parse_args(argv)
+    if args.mode == "serving":
+        # in-process serving soak: pin the platform before jax loads
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     base_seed = args.seed if args.seed is not None \
         else int(time.time()) % 1_000_000
@@ -190,18 +307,25 @@ def main(argv=None):
         seed = base_seed + i
         transport = args.transport if args.transport != "both" else \
             ("socket", "http")[i % 2]
-        ok, detail, n_faults = run_iteration(
-            seed, args.rate, args.max_faults, transport, args.timeout)
+        if args.mode == "serving":
+            ok, detail, n_faults = run_serving_iteration(
+                seed, args.rate, args.max_faults, args.timeout)
+        else:
+            ok, detail, n_faults = run_iteration(
+                seed, args.rate, args.max_faults, transport,
+                args.timeout)
         seeds.append(seed)
         total_faults += n_faults
         if not ok:
             failures.append(detail)
-        print(f"# iter {i} seed={seed} transport={transport} "
-              f"faults={n_faults} {'ok' if ok else 'FAIL: ' + detail}",
+        print(f"# iter {i} seed={seed} mode={args.mode} "
+              f"transport={transport} faults={n_faults} "
+              f"{'ok' if ok else 'FAIL: ' + detail}",
               file=sys.stderr)
         i += 1
     verdict = {
         "ok": not failures and bool(seeds),
+        "mode": args.mode,
         "iterations": len(seeds),
         "failures": failures,
         "seeds": seeds,

@@ -55,6 +55,30 @@ assert isinstance(rec["value"], (int, float)), rec["value"]
 print("bench stdout contract OK: 1 line, %d headline fields" % len(rec))
 PY
 
+echo "== 5b/8 serving load generator (one-JSON-line contract) =="
+# same stdout contract as bench.py: the driver/soak parse this as ONE
+# JSON line; a short fixed-rate leg proves the generator + server
+# round-trip and the headline fields (docs/SERVING.md)
+JAX_PLATFORMS=cpu python tools/serving_load.py --seconds 1.5 \
+  --qps 150 --seed 7 > /tmp/_serving_load.json
+cat /tmp/_serving_load.json
+python - <<'PY'
+import json
+lines = [ln for ln in open("/tmp/_serving_load.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, (
+    "serving_load.py stdout must be exactly ONE JSON line — got %d"
+    % len(lines))
+rec = json.loads(lines[0])
+missing = {"metric", "value", "unit", "offered_qps", "goodput_qps",
+           "p50_ms", "p99_ms", "admitted", "ok", "shed", "expired",
+           "failed_over", "accounted", "seed", "mode"} - set(rec)
+assert not missing, "serving_load JSON missing fields: %s" % (
+    sorted(missing),)
+assert rec["accounted"] is True, "request accounting broken: %r" % rec
+print("serving_load stdout contract OK: 1 line, %d fields" % len(rec))
+PY
+
 echo "== 6/8 per-op regression gate (hot ops vs committed CPU baseline) =="
 # 3x tolerance absorbs machine load; catches order-of-magnitude
 # per-op regressions (reference op_tester role) before they surface
@@ -91,5 +115,10 @@ echo "== 8/8 chaos soak (deterministic seed; both transports) =="
 # runs (docs/FAULT_TOLERANCE.md).
 JAX_PLATFORMS=cpu python tools/chaos_soak.py \
   --iterations 2 --seed 1234 --transport both
+# serving-tier leg of the same soak: seeded faults over the replica
+# pool (kill/close/drop/delay at serving_infer/serving_health) with
+# exact request-id accounting asserted each iteration
+JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+  --mode serving --iterations 2 --seed 4321 --rate 0.08
 
 echo "ALL CHECKS PASSED"

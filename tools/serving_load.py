@@ -1,0 +1,261 @@
+"""Seeded open-loop load generator for the serving tier.
+
+Drives an in-process InferenceServer (CPU, tiny fc model) with a
+seeded Poisson arrival stream and reports goodput vs offered load and
+the latency distribution of admitted requests — the
+"millions of users" counterpart of bench.py's throughput rows.
+
+stdout contract (gated in tools/ci.sh like bench stdout): EXACTLY ONE
+JSON line; progress goes to stderr.  Headline fields:
+
+    {"metric": "serving_goodput", "value": <goodput_qps>, "unit":
+     "req/s", "offered_qps": ..., "capacity_qps": ..., "p50_ms": ...,
+     "p99_ms": ..., "deadline_ms": ..., "admitted": N, "ok": N,
+     "shed": N, "expired": N, "failed_over": N, "seed": N, ...}
+
+Modes:
+    --mode fixed       open loop at --qps
+    --mode overload2x  measure single-replica capacity closed-loop,
+                       then drive 2x that: the ISSUE 6 acceptance
+                       shape (shedding keeps admitted p99 within the
+                       deadline while goodput stays >= 80% of
+                       capacity)
+
+Replayable: the arrival schedule is fully determined by --seed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_model(dirname, in_dim=8, hidden=16, depth=1):
+    """Save a tiny fc inference model; returns the model dir.  Larger
+    in_dim/hidden/depth make each batch compute-bound — the overload
+    acceptance leg uses that so the (single-thread) generator is never
+    the bottleneck being measured."""
+    import numpy as np  # noqa: F401
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("x", shape=[in_dim], dtype="float32")
+    h = x
+    for _ in range(int(depth)):
+        h = layers.fc(h, size=hidden, act="relu")
+    pred = layers.fc(h, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mdir = os.path.join(dirname, "model")
+    fluid.io.save_inference_model(mdir, ["x"], [pred], exe)
+    return mdir
+
+
+def make_server(model_dir, replicas=1, max_batch=8, deadline_ms=250.0,
+                capacity=None, max_wait_ms=2.0, warmup=True, **cfg_kw):
+    """Build + start an InferenceServer over `model_dir`; pre-warms
+    every (replica, bucket) compile-cache entry so the measured run
+    never pays a compile."""
+    import numpy as np
+
+    from paddle_tpu import inference, serving
+
+    def factory(i):
+        return inference.create_predictor(inference.Config(model_dir))
+
+    cfg = serving.ServingConfig(
+        n_replicas=replicas, max_batch=max_batch,
+        max_wait_s=max_wait_ms / 1000.0,
+        default_deadline_s=deadline_ms / 1000.0,
+        queue_capacity=capacity, **cfg_kw)
+    srv = serving.InferenceServer(factory, cfg).start()
+    if warmup:
+        specs = srv.pool.replicas[0].predictor.feed_specs()
+        for rep in srv.pool.replicas:
+            for b in cfg.buckets:
+                feeds = [np.zeros((b,) + tuple(d for d in shape[1:]),
+                                  dtype=dtype)
+                         for shape, dtype in specs.values()]
+                rep.predictor.run(feeds)
+    return srv
+
+
+def _in_dim(srv):
+    (shape, _), = srv.pool.replicas[0].predictor.feed_specs().values()
+    return int(shape[-1])
+
+
+def measure_capacity(srv, seconds=1.0, concurrency=None):
+    """Closed-loop saturation throughput (req/s): `concurrency`
+    threads looping submit+result as fast as replies come back."""
+    import numpy as np
+
+    from paddle_tpu import serving
+
+    concurrency = concurrency or srv.config.max_batch
+    stop_t = time.monotonic() + float(seconds)
+    counts = [0] * concurrency
+    in_dim = _in_dim(srv)
+
+    def worker(k):
+        rng = np.random.RandomState(1000 + k)
+        x = rng.rand(1, in_dim).astype(np.float32)
+        while time.monotonic() < stop_t:
+            try:
+                srv.infer({"x": x}, timeout=10.0)
+                counts[k] += 1
+            except serving.ServingError:
+                pass
+
+    t0 = time.monotonic()
+    ths = [threading.Thread(target=worker, args=(k,))
+           for k in range(concurrency)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    wall = time.monotonic() - t0
+    return sum(counts) / wall if wall > 0 else 0.0
+
+
+def run_open_loop(srv, qps, seconds, seed=0, deadline_s=None):
+    """Seeded Poisson arrivals at `qps` for `seconds`; returns the
+    outcome/latency record (dict).  Every submitted request ends in
+    exactly one bucket: ok / a typed rejection code / (never) silent."""
+    import numpy as np
+
+    from paddle_tpu import serving
+
+    rng = np.random.RandomState(int(seed))
+    x = rng.rand(1, _in_dim(srv)).astype(np.float32)
+    inflight = []          # Request futures (admitted)
+    outcomes = {"ok": 0}   # code -> count (submit-time rejections too)
+    t0 = time.monotonic()
+    next_t = t0
+    n_submitted = 0
+    while True:
+        now = time.monotonic()
+        if now - t0 >= seconds:
+            break
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.002))
+            continue
+        next_t += rng.exponential(1.0 / qps)
+        n_submitted += 1
+        try:
+            inflight.append(srv.submit({"x": x},
+                                       deadline_s=deadline_s))
+        except serving.ServingError as e:
+            outcomes[e.code] = outcomes.get(e.code, 0) + 1
+    wall = time.monotonic() - t0
+    latencies = []
+    for req in inflight:
+        try:
+            req.result(timeout=(deadline_s or
+                                srv.config.default_deadline_s) + 5.0)
+            outcomes["ok"] += 1
+            latencies.append(req.latency_s())
+        except serving.ServingError as e:
+            outcomes[e.code] = outcomes.get(e.code, 0) + 1
+            if req.latency_s() is not None:
+                latencies.append(req.latency_s())
+    lat_ms = sorted(1000.0 * v for v in latencies if v is not None)
+
+    def pct(p):
+        if not lat_ms:
+            return None
+        return lat_ms[min(len(lat_ms) - 1,
+                          int(p / 100.0 * len(lat_ms)))]
+
+    st = srv.stats()
+    return {
+        "offered_qps": round(n_submitted / wall, 1) if wall else 0.0,
+        "goodput_qps": round(outcomes["ok"] / wall, 1) if wall else 0.0,
+        "submitted": n_submitted,
+        "admitted": len(inflight),
+        "ok": outcomes["ok"],
+        "shed": outcomes.get("overloaded", 0),
+        "expired": outcomes.get("expired", 0),
+        "failed": outcomes.get("failed", 0),
+        "shutdown": outcomes.get("shutdown", 0),
+        "p50_ms": round(pct(50), 2) if lat_ms else None,
+        "p99_ms": round(pct(99), 2) if lat_ms else None,
+        "failed_over": st["pool"]["requeues"],
+        "accounted": st["accounted"],
+        "wall_s": round(wall, 2),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="seeded open-loop serving load generator")
+    ap.add_argument("--qps", type=float, default=200.0)
+    ap.add_argument("--seconds", type=float, default=2.0)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--deadline-ms", type=float, default=250.0)
+    ap.add_argument("--capacity", type=int, default=None,
+                    help="admission queue capacity (default 4x batch)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--mode", choices=["fixed", "overload2x"],
+                    default="fixed")
+    ap.add_argument("--capacity-seconds", type=float, default=1.0,
+                    help="closed-loop capacity probe length "
+                         "(overload2x)")
+    ap.add_argument("--in-dim", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=16)
+    ap.add_argument("--depth", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    with tempfile.TemporaryDirectory() as d:
+        mdir = build_model(d, in_dim=args.in_dim, hidden=args.hidden,
+                           depth=args.depth)
+        srv = make_server(mdir, replicas=args.replicas,
+                          max_batch=args.max_batch,
+                          deadline_ms=args.deadline_ms,
+                          capacity=args.capacity)
+        try:
+            cap_qps = None
+            qps = args.qps
+            if args.mode == "overload2x":
+                cap_qps = measure_capacity(
+                    srv, seconds=args.capacity_seconds)
+                qps = 2.0 * cap_qps
+                print(f"# capacity {cap_qps:.1f} req/s -> offering "
+                      f"{qps:.1f}", file=sys.stderr)
+            rec = run_open_loop(srv, qps, args.seconds,
+                                seed=args.seed,
+                                deadline_s=args.deadline_ms / 1000.0)
+        finally:
+            srv.stop()
+    rec.update({
+        "metric": "serving_goodput",
+        "value": rec["goodput_qps"],
+        "unit": "req/s",
+        "capacity_qps": round(cap_qps, 1) if cap_qps else None,
+        "deadline_ms": args.deadline_ms,
+        "replicas": args.replicas,
+        "max_batch": args.max_batch,
+        "seed": args.seed,
+        "mode": args.mode,
+    })
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
