@@ -1005,6 +1005,181 @@ def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
     return res
 
 
+def _build_llm_decode(streams=8, prefill_len=128, gen_tokens=64,
+                      heads=8, head_dim=128, page_size=128,
+                      vocab=32000, kv_int8=False, head_pack=False,
+                      dtype=None, seed=0, impl=None):
+    """Build ONE jitted continuous-decode step (ISSUE 7): token embed +
+    qkv projections + the paged KV append scatter + flash_decode over
+    the block-table page pool + the output projection + greedy argmax —
+    the device half of what serving/decode_engine.py runs per
+    iteration.  Returns (fn, state, feed, aux): fn(state, feed) ->
+    (new_state, next_tokens); state carries the page pools, feed the
+    per-step indices.  Shared with tools/tpu_lowering_check.py so the
+    gate cross-lowers exactly the graph the bench times.
+
+    Streams own static contiguous page ranges (stream s -> pages
+    [s*mp, (s+1)*mp)) with seeded RAGGED prefill lengths in
+    [prefill_len/2, prefill_len] — the kernel still walks the block
+    table page-by-page, but the timed loop pays zero allocator churn
+    (allocation/retire dynamics are tools/serving_load.py --mode
+    decode's job)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.paged_kv import kv_scales_of, quantize_kv
+    from paddle_tpu.ops.pallas_kernels import flash_decode
+    from paddle_tpu.serving.decode_engine import TinyDecodeLM
+
+    dtype = dtype or jnp.float32
+    model = TinyDecodeLM(vocab=vocab, d_model=heads * head_dim,
+                         num_heads=heads, head_dim=head_dim,
+                         seed=seed, dtype=dtype)
+    rng = np.random.RandomState(seed)
+    max_len = prefill_len + gen_tokens + 4       # +warmup margin
+    mp = -(-max_len // page_size)                # pages per stream
+    num_pages = streams * mp
+    tables_np = np.arange(num_pages,
+                          dtype=np.int32).reshape(streams, mp)
+    lens0 = rng.randint(max(1, prefill_len // 2), prefill_len + 1,
+                        size=streams).astype(np.int32)
+    store = jnp.int8 if kv_int8 else dtype
+    k_pages = jnp.zeros((num_pages, heads, page_size, head_dim), store)
+    v_pages = jnp.zeros((num_pages, heads, page_size, head_dim), store)
+    kv_scales = None
+    for s in range(streams):
+        prompt = rng.randint(2, vocab, size=int(lens0[s]))
+        _, k, v = model.qkv(prompt.astype(np.int32))
+        if kv_int8:
+            if kv_scales is None:
+                kv_scales = (kv_scales_of(k), kv_scales_of(v))
+            k = quantize_kv(k, kv_scales[0])
+            v = quantize_kv(v, kv_scales[1])
+        else:
+            k, v = k.astype(store), v.astype(store)
+        for i in range(-(-int(lens0[s]) // page_size)):
+            chunk_k = k[i * page_size:(i + 1) * page_size]
+            chunk_v = v[i * page_size:(i + 1) * page_size]
+            n = chunk_k.shape[0]
+            pid = int(tables_np[s, i])
+            k_pages = k_pages.at[pid, :, :n, :].set(
+                jnp.transpose(chunk_k, (1, 0, 2)))
+            v_pages = v_pages.at[pid, :, :n, :].set(
+                jnp.transpose(chunk_v, (1, 0, 2)))
+
+    def step(state, feed):
+        q, k, v = model.qkv_fn(feed["tokens"])
+        if kv_int8:
+            k = quantize_kv(k, kv_scales[0])
+            v = quantize_kv(v, kv_scales[1])
+        else:
+            k, v = k.astype(store), v.astype(store)
+        kp = state["k_pages"].at[feed["page_ids"], :,
+                                 feed["offsets"], :].set(k)
+        vp = state["v_pages"].at[feed["page_ids"], :,
+                                 feed["offsets"], :].set(v)
+        out = flash_decode(q, kp, vp, feed["tables"], feed["lens"],
+                           impl=impl, head_pack=head_pack,
+                           kv_scales=kv_scales)
+        logits = model.logits_fn(out)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return {"k_pages": kp, "v_pages": vp}, nxt
+
+    state = {"k_pages": k_pages, "v_pages": v_pages}
+    feed = {
+        "tokens": jnp.asarray(rng.randint(2, vocab, size=streams)
+                              .astype(np.int32)),
+        "page_ids": jnp.asarray(
+            tables_np[np.arange(streams), lens0 // page_size]),
+        "offsets": jnp.asarray(lens0 % page_size),
+        "tables": jnp.asarray(tables_np),
+        "lens": jnp.asarray(lens0 + 1),
+    }
+    aux = {"lens0": lens0, "tables_np": tables_np, "model": model,
+           "kv_scales": kv_scales, "page_size": page_size,
+           "kv_itemsize": jnp.dtype(store).itemsize}
+    return jax.jit(step), state, feed, aux
+
+
+def bench_llm_decode(streams=64, prefill_len=128, gen_tokens=32,
+                     heads=8, head_dim=128, page_size=128,
+                     vocab=32000, kv_int8=False, head_pack=False,
+                     warmup=2, chain=None):
+    """LLM continuous-decode leg (ISSUE 7): tokens/s/chip and
+    inter-token p50/p99 at `streams` concurrent ragged sequences,
+    decoding through the paged KV-cache + flash_decode step.  Every
+    step blocks on its next-token output (the engine needs the token
+    host-side to detect eos — the sync IS part of real inter-token
+    latency).  Decode is K/V-streaming bound, so the row carries the
+    analytic KV-traffic roofline (kv_gb_per_step, kv_bw_pct) next to
+    the rate, the DeepFM-roofline convention.  `chain` is accepted for
+    ladder uniformity and maps onto gen_tokens."""
+    import jax.numpy as jnp
+
+    if chain:
+        gen_tokens = int(chain)
+    fn, state, feed, aux = _build_llm_decode(
+        streams=streams, prefill_len=prefill_len,
+        gen_tokens=gen_tokens + warmup, heads=heads,
+        head_dim=head_dim, page_size=page_size, vocab=vocab,
+        kv_int8=kv_int8, head_pack=head_pack)
+    lens = aux["lens0"].copy()
+    tables_np = aux["tables_np"]
+    tables_dev = feed["tables"]
+    tokens = np.asarray(feed["tokens"])
+    times = []
+    kv_bytes = 0.0
+    for i in range(gen_tokens + warmup):
+        idx = np.arange(streams)
+        feed_i = {
+            "tokens": jnp.asarray(tokens),
+            "page_ids": jnp.asarray(
+                tables_np[idx, lens // page_size]),
+            "offsets": jnp.asarray(lens % page_size),
+            "tables": tables_dev,
+            "lens": jnp.asarray(lens + 1),
+        }
+        t0 = time.perf_counter()
+        state, nxt = fn(state, feed_i)
+        tokens = np.asarray(nxt)          # sync: the inter-token beat
+        dt = time.perf_counter() - t0
+        lens += 1
+        if i >= warmup:
+            times.append(dt)
+            # the kernel streams every LIVE page of K and V per step
+            pages_live = np.sum(-(-lens // page_size))
+            kv_bytes += (2.0 * pages_live * page_size * heads *
+                         head_dim * aux["kv_itemsize"])
+    total = sum(times)
+    lat_ms = sorted(t * 1e3 for t in times)
+
+    def pct(p):
+        return lat_ms[min(len(lat_ms) - 1, int(p / 100 * len(lat_ms)))]
+
+    peak_bw, kind = _chip_peak_bw()
+    res = {
+        "tokens_per_sec": round(streams * len(times) / total, 1),
+        "inter_token_p50_ms": round(pct(50), 3),
+        "inter_token_p99_ms": round(pct(99), 3),
+        "streams": streams,
+        "prefill_len": prefill_len,
+        "gen_tokens": len(times),
+        "heads": heads,
+        "head_dim": head_dim,
+        "page_size": page_size,
+        "paged": True,
+        "kv_gb_per_step": round(kv_bytes / max(len(times), 1) / 1e9,
+                                4),
+        "kv_bw_pct": round(100 * kv_bytes / total / peak_bw, 2),
+        "device": kind,
+    }
+    if kv_int8:
+        res["kv_int8"] = True
+    if head_pack:
+        res["head_pack"] = True
+    return res
+
+
 # ---------------------------------------------------------------------------
 # Main: one subprocess per leg so a tunnel wedge mid-ladder loses that
 # LEG, not the whole run (on 2026-07-31 the tunnel was alive for
@@ -1030,6 +1205,11 @@ _LEG_FUNCS = {
     "vgg_infer": "bench_vgg16_infer",
     "longctx": "bench_longctx_train",
     "longctx_d128": "bench_longctx_train_d128",
+    # ISSUE 7: LLM continuous decode through the paged KV-cache +
+    # flash_decode step — tokens/s/chip + inter-token p50/p99 vs
+    # concurrent streams; rides after the longctx legs (same kernel
+    # family, no int8-style wedge history)
+    "llm_decode": "bench_llm_decode",
     # the reference's cifar10 fp16 table rows (float16_benchmark.md
     # :56-74) — cheap bf16 legs, so they ride ahead of int8
     "vgg_cifar": "bench_vgg16_cifar_infer",
@@ -1074,6 +1254,11 @@ _TINY = {
     "longctx": dict(batch=1, heads=2, seq=512, chain=1),
     "longctx_d128": dict(batch=1, heads=2, seq=512, head_dim=32,
                          chain=1),
+    # degraded decode runs the gather+reference path (flash_decode
+    # impl auto picks "xla" off-TPU): checks the step graph + paging
+    # liveness, not the kernel
+    "llm_decode": dict(streams=2, prefill_len=8, gen_tokens=4,
+                       heads=2, head_dim=32, page_size=8, vocab=256),
 }
 
 # generous per-leg wall budgets: first compile over the tunnel takes
@@ -1132,9 +1317,10 @@ def _workload_sig(key, row):
     import re
 
     fam = re.sub(r"_DEGRADED.*$", "", key)
-    fam = re.sub(r"_(?:mb|seq|h|d|blk)\d+", "", fam)
+    fam = re.sub(r"_(?:mb|seq|h|d|blk|str)\d+", "", fam)
     fam = re.sub(r"_(?:s2d|convep|convbnstats|cmp_pool|bn1p|fastpath|"
-                 r"packed|hp2|fusedadam|interlayer)(?=_|$)", "", fam)
+                 r"packed|hp2|fusedadam|interlayer|int8kv)(?=_|$)",
+                 "", fam)
     return (fam, row.get("batch"), row.get("seq"), row.get("heads"),
             row.get("head_dim"), bool(row.get("s2d_stem")),
             bool(row.get("conv_epilogue")),
@@ -1143,7 +1329,9 @@ def _workload_sig(key, row):
             bool(row.get("conv_bn_folded")),
             bool(row.get("packed_stats")), bool(row.get("head_pack")),
             bool(row.get("fused_adam")),
-            bool(row.get("int8_interlayer")))
+            bool(row.get("int8_interlayer")),
+            row.get("streams"), bool(row.get("kv_int8")),
+            bool(row.get("paged")))
 
 
 def main():
@@ -1276,6 +1464,13 @@ def main():
             else "longctx_attention_train_seq32768_d128",
             "longctx_d128", mb="batch", seq="seq", h="heads",
             d="head_dim"): row("longctx_d128"),
+        # same honesty rule as longctx: the degraded CPU leg times the
+        # gather+reference path, so its key must not claim "flash"
+        key("llm_decode_flash_str64"
+            if not (results["llm_decode"] or {}).get("degraded")
+            else "llm_decode_paged_ref",
+            "llm_decode", str="streams", h="heads", d="head_dim"):
+            row("llm_decode"),
     }
     metric = key("resnet50_bf16_train_mfu_pct_mb128" + rn_s2d,
                  "rn_train", mb="batch")

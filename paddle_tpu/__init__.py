@@ -63,4 +63,41 @@ from paddle_tpu import debugger
 from paddle_tpu.flags import get_flag, set_flags
 from paddle_tpu.data_feeder import DataFeeder
 
+
+def enable_compile_cache(cache_dir):
+    """Point jax's persistent on-disk compilation cache at ``cache_dir``
+    (ROADMAP item 5: cold-start as a product metric).  Every XLA/Mosaic
+    compile is keyed on (graph, flags, shapes) and reused across
+    processes and restarts, so a serving replica fleet warms its bucket
+    set from disk instead of paying a per-replica compile storm.
+    Called automatically at import when ``PADDLE_TPU_COMPILE_CACHE_DIR``
+    is set; returns True when the cache was enabled."""
+    import os as _os
+
+    import jax as _jax
+
+    try:
+        _os.makedirs(cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        # serving buckets are tiny, fast compiles — cache everything,
+        # not just the >1s entries jax defaults to keeping
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           0.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                           0)
+        return True
+    except Exception:  # noqa: BLE001 — a cache is an optimization, never a crash
+        return False
+
+
+def _init_compile_cache():
+    import os as _os
+
+    cache_dir = _os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR")
+    if cache_dir:
+        enable_compile_cache(cache_dir)
+
+
+_init_compile_cache()
+
 __version__ = "0.1.0"

@@ -10,6 +10,20 @@ compile; here the whole decode is ONE lax.scan with dense [B, K] state
 (scores, finished flags, parent pointers) and a gather_tree finalization
 (ops/control_flow.py gather_tree op) — the entire beam search runs on
 device as a single XLA while loop.
+
+Paged decode (ISSUE 7, flag ``paged_decode``): the scan form forbids
+host-side state, so a paged KV-cache (ops/paged_kv.PagedKVCache —
+block-table page allocation is host work) cannot ride in it.  With
+``kv_cache="paged"`` the SAME step math runs as a host-stepped loop
+(one device step per token) so the step fn may carry a paged cache and
+attend via ops.pallas_kernels.flash_decode, plus an early exit the
+moment every sequence is finished — the remaining steps are provably
+eos-padding no-ops, reproduced exactly (tokens pad with eos, beam
+parents with the identity), so the output is bit-identical in shape
+and content to the full scan.  ``on_step(t, token[, parent])`` fires
+after each step for cache bookkeeping (appends; beam block-table
+reorder by parent).  Flag-off (``kv_cache="dense"``) is the untouched
+scan path — bit-parity asserted in tests/test_decode.py.
 """
 
 from __future__ import annotations
@@ -19,6 +33,18 @@ import jax.numpy as jnp
 from jax import lax
 
 _NEG_INF = -1e9
+
+
+def _resolve_kv_cache(kv_cache):
+    """None -> the typed ``paged_decode`` flag; explicit str wins."""
+    if kv_cache is None:
+        from paddle_tpu.flags import get_flag
+
+        return "paged" if get_flag("paged_decode") else "dense"
+    if kv_cache not in ("dense", "paged"):
+        raise ValueError("kv_cache must be 'dense' or 'paged', got %r"
+                         % (kv_cache,))
+    return kv_cache
 
 
 def _gather_beams(x, parent, batch, beam):
@@ -32,12 +58,19 @@ def _gather_beams(x, parent, batch, beam):
 
 def beam_search(symbols_to_logits_fn, init_state, batch_size, beam_size,
                 vocab_size, max_len, bos_id=0, eos_id=1,
-                length_penalty=0.0):
+                length_penalty=0.0, kv_cache=None, on_step=None):
     """Returns (sequences [B, K, T], scores [B, K]), best beam first.
 
     symbols_to_logits_fn(ids, state, t) -> (logits [B*K, V], new_state);
     ``ids`` is [B*K, 1] (tokens chosen at the previous step).  All state
     leaves must carry leading dim B*K.
+
+    kv_cache: None -> the ``paged_decode`` flag; "dense" = the one-scan
+    path (default); "paged" = host-stepped loop with early all-finished
+    exit (module docstring) — the step fn may then carry a paged
+    KV-cache, and ``on_step(t, token [B, K], parent [B, K])`` fires
+    after each live step (e.g. to reorder cache block tables by
+    parent).
     """
     b, k, v = batch_size, beam_size, vocab_size
     eos_row = jnp.full((v,), _NEG_INF).at[eos_id].set(0.0)
@@ -68,9 +101,34 @@ def beam_search(symbols_to_logits_fn, init_state, batch_size, beam_size,
         jnp.asarray([0.0] + [_NEG_INF] * (k - 1), jnp.float32)[None, :],
         (b, 1))
     init_fin = jnp.zeros((b, k), bool)
-    carry, (tokens, parents) = lax.scan(
-        step, (init_ids, init_lp, init_fin, init_state),
-        jnp.arange(max_len))
+    if _resolve_kv_cache(kv_cache) == "paged":
+        carry = (init_ids, init_lp, init_fin, init_state)
+        tok_steps, par_steps = [], []
+        for t in range(max_len):
+            carry, (token, parent) = step(carry, jnp.int32(t))
+            tok_steps.append(token)
+            par_steps.append(parent)
+            if on_step is not None:
+                on_step(t, token, parent)
+            if bool(jnp.all(carry[2])):
+                break
+        # the skipped steps are provably no-ops: with every beam
+        # finished, each next step emits token=eos at zero added cost
+        # and parent=identity (top_k over the already-sorted scores is
+        # stable) — pad exactly that
+        n_pad = max_len - len(tok_steps)
+        if n_pad:
+            pad_tok = jnp.full((b, k), eos_id, jnp.int32)
+            pad_par = jnp.broadcast_to(
+                jnp.arange(k, dtype=jnp.int32)[None, :], (b, k))
+            tok_steps.extend([pad_tok] * n_pad)
+            par_steps.extend([pad_par] * n_pad)
+        tokens = jnp.stack(tok_steps)
+        parents = jnp.stack(par_steps)
+    else:
+        carry, (tokens, parents) = lax.scan(
+            step, (init_ids, init_lp, init_fin, init_state),
+            jnp.arange(max_len))
     _, scores, _, _ = carry
     from paddle_tpu.core.registry import get_op_def
 
@@ -87,9 +145,15 @@ def beam_search(symbols_to_logits_fn, init_state, batch_size, beam_size,
 
 
 def greedy_search(symbols_to_logits_fn, init_state, batch_size, max_len,
-                  bos_id=0, eos_id=1):
+                  bos_id=0, eos_id=1, kv_cache=None, on_step=None):
     """Argmax decode as one lax.scan; returns (sequences [B, T],
-    scores [B])."""
+    scores [B]).
+
+    kv_cache: None -> the ``paged_decode`` flag; "dense" = the one-scan
+    path (default); "paged" = host-stepped loop with early
+    all-finished exit (module docstring) — the step fn may then carry
+    a paged KV-cache and attend via flash_decode.  ``on_step(t,
+    token [B])`` fires after each live step (cache appends)."""
 
     def step(carry, t):
         ids, score, finished, state = carry
@@ -106,5 +170,23 @@ def greedy_search(symbols_to_logits_fn, init_state, batch_size, max_len,
     init = (jnp.full((batch_size, 1), bos_id, jnp.int32),
             jnp.zeros((batch_size,), jnp.float32),
             jnp.zeros((batch_size,), bool), init_state)
+    if _resolve_kv_cache(kv_cache) == "paged":
+        carry = init
+        toks = []
+        for t in range(max_len):
+            carry, token = step(carry, jnp.int32(t))
+            toks.append(token)
+            if on_step is not None:
+                on_step(t, token)
+            if bool(jnp.all(carry[2])):
+                break
+        # skipped steps are eos no-ops (token=eos, zero added score) —
+        # pad exactly that so the output matches the full scan
+        if len(toks) < max_len:
+            pad = jnp.full((batch_size,), eos_id,
+                           toks[0].dtype if toks else jnp.int32)
+            toks.extend([pad] * (max_len - len(toks)))
+        tokens = jnp.stack(toks)
+        return jnp.transpose(tokens, (1, 0)), carry[1]
     carry, tokens = lax.scan(step, init, jnp.arange(max_len))
     return jnp.transpose(tokens, (1, 0)), carry[1]

@@ -146,6 +146,28 @@ define_flag("int8_interlayer", False,
             "are bit-identical to the calibrated int8 path (asserted "
             "in tests/test_quantization.py); flip per-call via "
             "convert_to_int8_execution(int8_activations=True)")
+define_flag("paged_decode", False,
+            "LLM decode KV-cache strategy (ISSUE 7): False = the "
+            "validated dense lax.scan decode loop (decode.py "
+            "beam_search/greedy_search; default, zero behavior "
+            "change — flag-off decode is bit-identical to the "
+            "pre-paged scan loop, asserted in tests/test_decode.py); "
+            "True = the host-stepped paged path: decode runs one "
+            "device step per token with an early all-finished exit, "
+            "so the step fn may carry a paged KV-cache "
+            "(ops/paged_kv.PagedKVCache) and attend via flash_decode "
+            "— thousands of ragged concurrent sequences share ONE "
+            "preallocated HBM page pool instead of re-running "
+            "full-prefix attention per step")
+define_flag("kv_int8", False,
+            "paged KV-cache storage dtype: False = the model dtype "
+            "(f32/bf16; default), True = int8 pages with per-channel "
+            "(head, dim) scales riding the PR-5 requantize contract "
+            "(q = clip(round(x/s*127)), dequant-in-kernel x_hat = "
+            "q*s/127) — 2-4x less HBM per cached token and 2-4x less "
+            "decode-step K/V streaming traffic.  Accuracy asserted "
+            "against the f32 KV path (top-1 agreement, "
+            "tests/test_decode.py; docs/DECODE.md accuracy bar)")
 define_flag("int8_conv_algo", "conv",
             "conv2d_int8 lowering: 'conv' = integer "
             "conv_general_dilated; 'im2col' = pad/slice/concat + one "

@@ -1,0 +1,352 @@
+"""Paged KV-cache: block-table paging over one preallocated HBM pool.
+
+Capability anchor (ROADMAP New-directions #2, ISSUE 7): autoregressive
+LLM decode over many concurrent sequences of ragged length.  A dense
+per-sequence KV-cache must reserve ``max_len`` tokens per sequence, so
+a serving batch of S ragged streams wastes (max_len - len_i) slots per
+stream — at 4k context and 90% raggedness that is ~10x the HBM the
+live tokens need.  Here the cache is the vLLM PagedAttention shape:
+
+  * ONE preallocated page pool per replica —
+    ``[num_pages, H, page_size, d]`` for K and V.  The page is the
+    allocation unit; the head axis rides AHEAD of the token axis so a
+    flash-decode kernel block slices ``(1, hpb, page_size, d)`` with
+    Mosaic-legal trailing dims (page_size, d) — a token-major
+    ``[num_pages, page_size, H, d]`` layout would put a size-1 head
+    slice in the block's sublane position, the exact construct class
+    Mosaic rejected in PR 1/PR 2 (the [1, bq] lse lesson).
+  * per-sequence BLOCK TABLES (host int32 [max_seqs, max_pages_per_seq])
+    mapping logical page i of a sequence to its physical pool page, so
+    thousands of sequences share the pool with zero copy on
+    alloc/retire and external fragmentation bounded by one page per
+    live sequence.
+
+The allocator is host-side (free-list + tables); the pools are device
+arrays updated functionally (one fused scatter per decode step for the
+whole running batch).  ``ops.pallas_kernels.flash_decode`` consumes
+(pools, tables, lens) directly — K/V stream page-by-page through the
+block table, never gathered into a dense [B, T, H, d] copy.
+
+int8 KV storage (flag ``kv_int8``) rides the PR-5 per-channel
+requantize contract: pages hold ``q = clip(round(x / s * 127), -127,
+127)`` int8 with per-(head, dim) abs-max scales, and the kernel
+dequantizes in VMEM (``x_hat = q * s / 127``) — the tensor that
+streams from HBM per decode step is int8.  Scales are calibrated on
+the first prefill (or given explicitly), the same static-scale story
+as the PR-5 activation path.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["OutOfPagesError", "PagedKVCache", "quantize_kv",
+           "dequantize_kv", "kv_scales_of"]
+
+_INT8_BOUND = 127.0  # mirrors ops/quant.py _quantize bit_length=8
+
+
+class OutOfPagesError(RuntimeError):
+    """The pool has no free page (admission backpressure signal: the
+    serving tier defers the sequence instead of corrupting the pool)."""
+
+
+def kv_scales_of(x, floor=1e-8):
+    """Per-channel (head, dim) abs-max scale of ``x`` [T, H, d] — the
+    PR-5 calibration shape (observed-all-zero channels floor at 1e-8 so
+    a zero scale can never read as 'uncalibrated' downstream)."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=0)
+    return jnp.maximum(s, floor)
+
+
+def quantize_kv(x, scale):
+    """f32/bf16 [..., H, d] -> int8 under per-channel ``scale`` [H, d]
+    (q = clip(round(x/s*127), -127, 127) — ops/quant.py contract)."""
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale * _INT8_BOUND),
+                 -_INT8_BOUND, _INT8_BOUND)
+    return q.astype(jnp.int8)
+
+
+def dequantize_kv(q, scale):
+    """int8 [..., H, d] -> f32 (x_hat = q * s / 127)."""
+    return q.astype(jnp.float32) * (scale / _INT8_BOUND)
+
+
+def _scatter_token(pool, page_ids, offsets, vals):
+    """Write one token's K or V per sequence into the pool:
+    pool [P, H, ps, d]; page_ids/offsets [N]; vals [N, H, d]."""
+    return pool.at[page_ids, :, offsets, :].set(vals)
+
+
+_scatter_token_jit = jax.jit(_scatter_token)
+
+
+class PagedKVCache:
+    """Block-table paged K/V pool for one decode replica.
+
+    Host side: free-list page allocator + per-sequence block tables +
+    lengths.  Device side: the two pools (functionally updated).  The
+    accounting invariant the chaos soak asserts: at every moment
+    ``free_pages + in_use_pages == num_pages`` and after drain
+    ``in_use_pages == 0`` (zero leaks).
+    """
+
+    def __init__(self, num_pages, page_size, num_heads, head_dim,
+                 dtype=jnp.float32, max_seqs=None,
+                 max_pages_per_seq=None, kv_int8=None, kv_scales=None):
+        from paddle_tpu.flags import get_flag
+
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.kv_int8 = bool(get_flag("kv_int8")) if kv_int8 is None \
+            else bool(kv_int8)
+        self.dtype = jnp.dtype(dtype)
+        store = jnp.int8 if self.kv_int8 else self.dtype
+        # one extra SINK page rides past the allocatable pool: batch
+        # writes padded to a fixed size (the decode engine's
+        # compile-once shape discipline) scatter their dummy rows
+        # there — never a free-list page, never in the accounting
+        self.sink_page = self.num_pages
+        shape = (self.num_pages + 1, self.num_heads, self.page_size,
+                 self.head_dim)
+        self.k_pages = jnp.zeros(shape, store)
+        self.v_pages = jnp.zeros(shape, store)
+        # per-channel dequant scales (kv_int8 only): calibrated on the
+        # first prefill unless given — the PR-5 static-scale story
+        self.k_scale = None
+        self.v_scale = None
+        if kv_scales is not None:
+            self.k_scale = jnp.asarray(kv_scales[0], jnp.float32)
+            self.v_scale = jnp.asarray(kv_scales[1], jnp.float32)
+        self.max_seqs = int(max_seqs) if max_seqs is not None \
+            else self.num_pages
+        self.max_pages_per_seq = int(max_pages_per_seq) \
+            if max_pages_per_seq is not None else self.num_pages
+        # host-side allocator state.  Padded/free table entries point
+        # at physical page 0 (always a VALID index): the kernel masks
+        # their contribution by seq_len, so a gather through a padded
+        # entry reads garbage it then multiplies by zero — never OOB.
+        self._tables = np.zeros((self.max_seqs,
+                                 self.max_pages_per_seq), np.int32)
+        self._lens = np.zeros((self.max_seqs,), np.int32)
+        self._free_pages = list(range(self.num_pages - 1, -1, -1))
+        self._free_slots = list(range(self.max_seqs - 1, -1, -1))
+        self._live = set()          # live slot ids
+        self._pages_of = {}         # slot -> [page ids] (alloc order)
+        self._peak_in_use = 0
+
+    # -- geometry -----------------------------------------------------------
+    def pages_for(self, n_tokens):
+        return max(1, math.ceil(n_tokens / self.page_size))
+
+    # -- allocation ---------------------------------------------------------
+    def _take_page(self, slot):
+        if not self._free_pages:
+            raise OutOfPagesError(
+                "page pool exhausted (%d pages, %d live seqs)"
+                % (self.num_pages, len(self._live)))
+        pages = self._pages_of[slot]
+        if len(pages) >= self.max_pages_per_seq:
+            raise OutOfPagesError(
+                "sequence at max_pages_per_seq=%d"
+                % self.max_pages_per_seq)
+        pid = self._free_pages.pop()
+        self._tables[slot, len(pages)] = pid
+        pages.append(pid)
+        self._peak_in_use = max(self._peak_in_use, self.in_use_pages())
+        return pid
+
+    def alloc(self, n_tokens):
+        """Reserve a sequence slot with page capacity for ``n_tokens``;
+        returns the slot id.  Raises OutOfPagesError (nothing partially
+        allocated) when the pool can't hold it."""
+        need = self.pages_for(n_tokens)
+        if len(self._free_pages) < need:
+            raise OutOfPagesError(
+                "need %d pages, %d free (of %d)"
+                % (need, len(self._free_pages), self.num_pages))
+        if not self._free_slots:
+            raise OutOfPagesError("no free sequence slot (max_seqs=%d)"
+                                  % self.max_seqs)
+        slot = self._free_slots.pop()
+        self._live.add(slot)
+        self._pages_of[slot] = []
+        self._lens[slot] = 0
+        for _ in range(need):
+            self._take_page(slot)
+        return slot
+
+    def free(self, slot):
+        """Retire a sequence: every page back on the free list."""
+        if slot not in self._live:
+            raise KeyError("slot %r is not live" % (slot,))
+        self._live.discard(slot)
+        for pid in self._pages_of.pop(slot):
+            self._free_pages.append(pid)
+        self._tables[slot, :] = 0
+        self._lens[slot] = 0
+        self._free_slots.append(slot)
+
+    def reset(self):
+        """Drop every sequence (replica relaunch path)."""
+        for slot in list(self._live):
+            self.free(slot)
+
+    # -- writes -------------------------------------------------------------
+    def _maybe_calibrate(self, k, v):
+        if self.kv_int8 and self.k_scale is None:
+            self.k_scale = kv_scales_of(k)
+            self.v_scale = kv_scales_of(v)
+
+    def _store(self, x, scale):
+        return quantize_kv(x, scale) if self.kv_int8 \
+            else jnp.asarray(x, self.dtype)
+
+    def prefill(self, k, v):
+        """Admit a sequence whose prompt K/V is already computed:
+        k/v [T, H, d].  Allocates slot + pages, writes page-by-page,
+        sets the length.  Returns the slot id."""
+        k = jnp.asarray(k)
+        t = int(k.shape[0])
+        slot = self.alloc(t)
+        self._maybe_calibrate(k, v)
+        ks = self._store(k, self.k_scale)
+        vs = self._store(jnp.asarray(v), self.v_scale)
+        ps = self.page_size
+        for i, pid in enumerate(self._pages_of[slot]):
+            chunk_k = ks[i * ps:(i + 1) * ps]
+            chunk_v = vs[i * ps:(i + 1) * ps]
+            n = int(chunk_k.shape[0])
+            # [n, H, d] -> [H, n, d] (head-major pages)
+            self.k_pages = self.k_pages.at[pid, :, :n, :].set(
+                jnp.transpose(chunk_k, (1, 0, 2)))
+            self.v_pages = self.v_pages.at[pid, :, :n, :].set(
+                jnp.transpose(chunk_v, (1, 0, 2)))
+        self._lens[slot] = t
+        return slot
+
+    def append(self, slots, k, v):
+        """Append ONE token per sequence for the whole running batch:
+        slots [N] ints, k/v [N_pad, H, d] with N_pad >= N — rows past
+        len(slots) are batch padding and scatter into the sink page
+        (fixed-shape calls = one compile).  One fused device scatter;
+        new pages are taken from the free list as sequences cross a
+        page boundary (OutOfPagesError leaves lengths untouched)."""
+        slots = list(slots)
+        self._maybe_calibrate(jnp.asarray(k), jnp.asarray(v))
+        page_ids, offsets = [], []
+        taken = []          # rollback on mid-batch exhaustion
+        try:
+            for s in slots:
+                ln = int(self._lens[s])
+                if ln % self.page_size == 0 and \
+                        ln // self.page_size >= \
+                        len(self._pages_of[s]):
+                    taken.append((s, self._take_page(s)))
+                page_ids.append(self._tables[s, ln // self.page_size])
+                offsets.append(ln % self.page_size)
+        except OutOfPagesError:
+            for s, pid in taken:
+                self._pages_of[s].remove(pid)
+                self._tables[s, len(self._pages_of[s])] = 0
+                self._free_pages.append(pid)
+            raise
+        ks = self._store(jnp.asarray(k), self.k_scale)
+        vs = self._store(jnp.asarray(v), self.v_scale)
+        n_pad = int(ks.shape[0]) - len(slots)
+        if n_pad:
+            page_ids = page_ids + [self.sink_page] * n_pad
+            offsets = offsets + [0] * n_pad
+        pid_a = jnp.asarray(np.asarray(page_ids, np.int32))
+        off_a = jnp.asarray(np.asarray(offsets, np.int32))
+        self.k_pages = _scatter_token_jit(self.k_pages, pid_a, off_a,
+                                          ks)
+        self.v_pages = _scatter_token_jit(self.v_pages, pid_a, off_a,
+                                          vs)
+        for s in slots:
+            self._lens[s] += 1
+
+    # -- reads --------------------------------------------------------------
+    def seq_len(self, slot):
+        return int(self._lens[slot])
+
+    def tables_for(self, slots, max_pages=None, pad_to=None):
+        """Device block-table view [N(_pad), max_pages] int32 for a
+        batch of slots (padded COLUMNS point at valid page 0 — the
+        kernel masks by length; ``pad_to`` adds dummy ROWS of zeros
+        for fixed-batch-shape callers, masked the same way by their
+        zero length)."""
+        n = max_pages if max_pages is not None else max(
+            1, max(self.pages_for(int(self._lens[s])) for s in slots))
+        t = self._tables[np.asarray(slots), :n]
+        if pad_to is not None and pad_to > t.shape[0]:
+            t = np.concatenate(
+                [t, np.zeros((pad_to - t.shape[0], n), np.int32)])
+        return jnp.asarray(t)
+
+    def lens_for(self, slots, pad_to=None):
+        """Device lengths [N(_pad)] int32 (dummy rows length 0 — the
+        kernel emits zeros for them)."""
+        ln = self._lens[np.asarray(slots)]
+        if pad_to is not None and pad_to > ln.shape[0]:
+            ln = np.concatenate(
+                [ln, np.zeros((pad_to - ln.shape[0],), np.int32)])
+        return jnp.asarray(ln)
+
+    def kv_scales(self):
+        """(k_scale, v_scale) per-channel [H, d] dequant scales (int8
+        mode; None otherwise)."""
+        return self.k_scale, self.v_scale
+
+    # -- accounting ---------------------------------------------------------
+    def in_use_pages(self):
+        return sum(len(p) for p in self._pages_of.values())
+
+    def free_pages(self):
+        return len(self._free_pages)
+
+    def stats(self):
+        """Allocator + fragmentation stats (the chaos soak's audit
+        surface).  ``accounted`` is the leak invariant: every pool page
+        is either free or owned by exactly one live sequence."""
+        in_use = self.in_use_pages()
+        owned = [p for pages in self._pages_of.values() for p in pages]
+        live_tokens = int(sum(self._lens[s] for s in self._live))
+        capacity = in_use * self.page_size
+        return {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "free_pages": self.free_pages(),
+            "in_use_pages": in_use,
+            "peak_in_use_pages": self._peak_in_use,
+            "live_seqs": len(self._live),
+            "accounted": (self.free_pages() + in_use == self.num_pages
+                          and len(owned) == len(set(owned))),
+            # internal fragmentation: tail slack of the last page of
+            # each live sequence (the only waste paging permits)
+            "internal_frag_pct": round(
+                100.0 * (capacity - live_tokens) / capacity, 2)
+            if capacity else 0.0,
+            "kv_int8": self.kv_int8,
+        }
+
+    def check_accounting(self):
+        """(ok, detail) — free + in_use == num_pages, no page owned
+        twice, no freed page still owned."""
+        st = self.stats()
+        if not st["accounted"]:
+            return False, ("page accounting broken: free=%d in_use=%d "
+                           "pool=%d" % (st["free_pages"],
+                                        st["in_use_pages"],
+                                        st["num_pages"]))
+        owned = {p for pages in self._pages_of.values() for p in pages}
+        both = owned & set(self._free_pages)
+        if both:
+            return False, "pages both free and owned: %s" % sorted(both)
+        return True, ""

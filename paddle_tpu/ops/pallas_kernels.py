@@ -770,6 +770,357 @@ def _on_tpu():
 
 
 # ---------------------------------------------------------------------------
+# flash decode: q_len=1 attention over a paged KV-cache (ISSUE 7)
+# ---------------------------------------------------------------------------
+# Decode-step attention for autoregressive serving: ONE query token per
+# sequence attends over that sequence's whole cached prefix, with K/V
+# streamed page-by-page from the ops/paged_kv.py pool through the
+# per-sequence block table (vLLM PagedAttention shape).  The grid is
+# (B, H/hpb, max_pages) — a split-K sweep over pages with the KV
+# dimension innermost so the (acc, m, l) scratch carries across pages;
+# each page's partial (out, lse) merges into the carry by EXACTLY the
+# PR-2 mergeable-summary contract (m = max(m1, m2); a_i = exp(m_i - m);
+# out = sum out_i*a_i / sum l_i*a_i) — the same formula ring attention
+# uses across chunks, here applied page-by-page inside one kernel.
+#
+# Geometry notes (the Mosaic lessons from PR 1/2 applied):
+#   * pages are [P, H, page_size, d] (head-major) so the per-step block
+#     is (1, hpb, page_size, d) with legal trailing dims; a token-major
+#     pool would put a size-1 head slice in the sublane position (the
+#     rejected [1, bq] construct class).
+#   * the single query row is sublane-replicated to 8 rows (16 for
+#     bf16 — the (16, 128) bf16 tile rule) host-side; every row
+#     computes the identical result and the caller takes row 0.  The
+#     replication is ~B*H*16*d*4 bytes — noise next to the page
+#     streaming this kernel exists to bound.
+#   * the block table and sequence lengths ride in as SCALAR PREFETCH
+#     (SMEM) so the K/V BlockSpec index maps can address physical pages
+#     (blk[b, p]) before the body runs — the standard paged-attention
+#     Pallas shape.
+#   * head packing (flag `flash_head_pack`, same gate spirit as the
+#     fwd kernel): at d <= 64 two heads of the SAME sequence ride per
+#     grid step (block (1, 2, ...)), needing H even — the pairing must
+#     not cross a batch boundary because both heads share one block
+#     table entry.
+#
+# int8 KV (`kv_int8`): pages hold the PR-5 per-channel contract
+# (q = clip(round(x/s*127))); the kernel dequantizes IN VMEM with the
+# precomputed per-(head, dim) multiplier s/127, so what streams from
+# HBM is int8 — the decode step's traffic is K/V-dominated, so this is
+# the same structural cut int8-interlayer made for conv activations.
+#
+# Not differentiable (decode is inference); no custom_vjp.
+
+_DECODE_VMEM_BUDGET = 12 * 2 ** 20  # conservative per-core VMEM cap
+_SUBLANES_BY_DTYPE = {jnp.dtype(jnp.float32): 8,
+                      jnp.dtype(jnp.bfloat16): 16,
+                      jnp.dtype(jnp.int8): 32}
+
+
+def _decode_qrows(dtype):
+    """Sublane replication of the single query row: min sublane tile
+    of the q/output dtype (f32 8, bf16 16)."""
+    return _SUBLANES_BY_DTYPE.get(jnp.dtype(dtype), 8)
+
+
+def _decode_hpb(head_pack, n_heads, d):
+    """Heads per grid step: 2 when packing is on, profitable (d <= 64,
+    the half-idle-MXU regime) and legal (H even — both packed heads
+    share one block-table entry, so the pair must not straddle a
+    sequence boundary)."""
+    return 2 if (head_pack and d <= 64 and n_heads % 2 == 0) else 1
+
+
+def _decode_geom_ok(q, k_pages, hpb, vmem_budget_bytes=None):
+    """True when the Pallas path is legal + fits VMEM; False routes to
+    the gather+reference fallback (documented, silent — same shape as
+    the packed-stats bq gate)."""
+    b, h, d = q.shape
+    ps = k_pages.shape[2]
+    store = jnp.dtype(k_pages.dtype)
+    if ps % _SUBLANES_BY_DTYPE.get(store, 8) != 0:
+        return False
+    qrows = _decode_qrows(jnp.float32 if store == jnp.int8
+                          else q.dtype)
+    budget = vmem_budget_bytes or _DECODE_VMEM_BUDGET
+    # double-buffered K+V page blocks + q/o/acc + the two row-stat
+    # scratches
+    page_bytes = 2 * 2 * hpb * ps * d * store.itemsize
+    row_bytes = hpb * qrows * (3 * d + 2 * _MIN_LANES) * 4
+    return page_bytes + row_bytes <= budget
+
+
+def _decode_kernel(blk_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, page_size, hpb,
+                   qrows, int8kv):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_p = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    kv_len = len_ref[b]
+    # pages at or past the sequence length contribute nothing — skip
+    # them outright (their block-table entries point at valid page 0,
+    # so the prefetch window stays in bounds either way)
+    live = (p * page_size) < kv_len
+
+    @pl.when(live)
+    def _step():
+        kpos = p * page_size + lax.broadcasted_iota(
+            jnp.int32, (qrows, page_size), 1)
+        mask = kpos < kv_len
+        for h in range(hpb):
+            q = q_ref[0, h]                      # [qrows, d]
+            k = k_ref[0, h]                      # [page_size, d]
+            v = v_ref[0, h]
+            if int8kv:
+                # int8 pages convert in VMEM; the per-channel dequant
+                # scales were algebraically relocated OFF the page by
+                # the wrapper (sum_d q_d*(k_td*s_d) == sum_d
+                # (q_d*s_d)*k_td, so the K scale pre-multiplied q
+                # host-side; the per-output-channel V scale applies to
+                # the final acc/l outside the kernel).  What streams
+                # from HBM is the raw int8 page — and the kernel body
+                # carries zero scale-multiply VPU work per page.
+                k = k.astype(jnp.float32)
+                v = v.astype(jnp.float32)
+            s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) \
+                * scale
+            s = jnp.where(mask, s, _NEG_INF)
+            m_prev = m_ref[h, :, 0]
+            m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p_ = jnp.exp(s - m_next[:, None])
+            # explicit zero for masked entries (a fully-masked row
+            # would otherwise see exp(-1e30 - (-1e30)) = 1)
+            p_ = jnp.where(mask, p_, 0.0)
+            alpha = jnp.exp(m_prev - m_next)
+            l_next = l_ref[h, :, 0] * alpha + jnp.sum(p_, axis=-1)
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + lax.dot_general(
+                p_ if int8kv else p_.astype(v.dtype), v,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[h] = jnp.broadcast_to(m_next[:, None],
+                                        m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_next[:, None],
+                                        l_ref.shape[1:])
+
+    @pl.when(p == n_p - 1)
+    def _finalize():
+        for h in range(hpb):
+            l = l_ref[h, :, 0]
+            l = jnp.where(l == 0.0, 1.0, l)  # zero-length seq -> 0 out
+            o_ref[0, h] = (acc_ref[h] / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_decode_pallas(q, k_pages, v_pages, block_tables, seq_lens,
+                         scale, hpb, interpret=False):
+    """q: [B, H, d] (K-scale pre-applied in int8 mode); pools
+    [P, H, ps, d]; block_tables [B, MP] int32; seq_lens [B] int32 ->
+    out [B, H, d] (f32 in int8 mode — the V scale applies outside)."""
+    b, h, d = q.shape
+    ps = k_pages.shape[2]
+    max_pages = block_tables.shape[1]
+    qrows = _decode_qrows(q.dtype)
+    int8kv = jnp.dtype(k_pages.dtype) == jnp.int8
+    q8 = jnp.broadcast_to(q[:, :, None, :], (b, h, qrows, d))
+    kernel = functools.partial(_decode_kernel, scale=scale,
+                               page_size=ps, hpb=hpb, qrows=qrows,
+                               int8kv=int8kv)
+    in_specs = [
+        pl.BlockSpec((1, hpb, qrows, d),
+                     lambda bi, hi, pi, blk, ln: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, hpb, ps, d),
+                     lambda bi, hi, pi, blk, ln: (blk[bi, pi], hi, 0,
+                                                  0)),
+        pl.BlockSpec((1, hpb, ps, d),
+                     lambda bi, hi, pi, blk, ln: (blk[bi, pi], hi, 0,
+                                                  0)),
+    ]
+    args = [q8, k_pages, v_pages]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h // hpb, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, hpb, qrows, d),
+            lambda bi, hi, pi, blk, ln: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hpb, qrows, d), jnp.float32),
+            pltpu.VMEM((hpb, qrows, _MIN_LANES), jnp.float32),
+            pltpu.VMEM((hpb, qrows, _MIN_LANES), jnp.float32),
+        ])
+    params = {}
+    if not interpret:
+        params["compiler_params"] = _CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (b, h, qrows, d),
+            jnp.float32 if int8kv else q.dtype),
+        interpret=interpret,
+        **params,
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(seq_lens, jnp.int32), *args)
+    return out[:, :, 0, :]
+
+
+def flash_decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
+                           scale=None, kv_scales=None):
+    """Gather + reference attention replay: the flash_decode fallback
+    path (VMEM budget / geometry gate / off-TPU impl) AND the parity
+    oracle.  It gathers the pages dense through the block table and
+    replays the kernel's page-ordered online-softmax merge with the
+    SAME op order, shapes and rounding points (q sublane-replicated,
+    per-page dot/max/exp/fma in f32, post-exp masking), so
+    flash_decode output is array_equal to this path in every mode —
+    the bit-parity contract PR 4 established for fused-vs-unfused.
+    Mathematically it equals plain softmax(QK^T)V over the first
+    seq_len cached tokens (allclose; asserted in tests).
+
+    Runs as ONE jitted computation on purpose: the interpret/pallas
+    kernel executes its whole grid inside one XLA computation, where
+    the compiler contracts ``acc*alpha + dot(...)`` into an FMA; an
+    eager op-by-op replay rounds the multiply and add separately and
+    drifts 1 ulp per page (measured) — jitting the replay restores
+    the identical fusion, and the production fallback runs under the
+    caller's jit anyway.  The int8-KV dequant multiplies stay EAGER
+    and outside the jitted region in BOTH paths (pre-scaled q, V scale
+    on the final output) for the same reason — inside, the compiler
+    folds them into the dots differently per path."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    bt = jnp.asarray(block_tables, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+    if jnp.dtype(k_pages.dtype) == jnp.int8:
+        q_eff, vdq = _int8_pre(q, kv_scales)
+        raw = _decode_reference_jit(q_eff, k_pages, v_pages, bt, sl,
+                                    jnp.float32(scale))
+        return _int8_post(raw, vdq, q.dtype)
+    return _decode_reference_jit(q, k_pages, v_pages, bt, sl,
+                                 jnp.float32(scale))
+
+
+def _int8_pre(q, kv_scales):
+    """Eager int8-KV dequant prologue shared by kernel + reference:
+    the per-channel K scale rides the contraction dim, so
+    sum_d q_d*(k_td*s_d) == sum_d (q_d*s_d)*k_td — pre-scale q once
+    ([B, H, d]) instead of dequantizing every page ([ps, d] per
+    step)."""
+    if kv_scales is None:
+        raise ValueError("int8 k_pages/v_pages need kv_scales "
+                         "(per-channel [H, d] — paged_kv.kv_scales())")
+    kdq = kv_scales[0].astype(jnp.float32) / 127.0
+    vdq = kv_scales[1].astype(jnp.float32) / 127.0
+    return q.astype(jnp.float32) * kdq[None, :, :], vdq
+
+
+def _int8_post(raw, vdq, out_dtype):
+    """Eager int8-KV epilogue: the V scale is per OUTPUT channel, so
+    it moves out of the page accumulation onto the final [B, H, d]."""
+    return (raw * vdq[None, :, :]).astype(out_dtype)
+
+
+def _decode_reference_impl(q, k_pages, v_pages, block_tables, seq_lens,
+                           scale):
+    b, h, d = q.shape
+    ps = k_pages.shape[2]
+    max_pages = block_tables.shape[1]
+    qrows = _decode_qrows(q.dtype)
+    int8kv = jnp.dtype(k_pages.dtype) == jnp.int8
+    q8 = jnp.broadcast_to(q[:, :, None, :], (b, h, qrows, d))
+    # gather [B, MP, H, ps, d] (the dense copy the kernel avoids)
+    kg = jnp.take(k_pages, jnp.asarray(block_tables, jnp.int32),
+                  axis=0)
+    vg = jnp.take(v_pages, jnp.asarray(block_tables, jnp.int32),
+                  axis=0)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    m = jnp.full((b, h, qrows), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, qrows), jnp.float32)
+    acc = jnp.zeros((b, h, qrows, d), jnp.float32)
+    for p in range(max_pages):
+        k = kg[:, p]                                # [B, H, ps, d]
+        v = vg[:, p]
+        if int8kv:
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
+        kpos = p * ps + lax.broadcasted_iota(
+            jnp.int32, (qrows, ps), 1)
+        mask = kpos[None, None] < lens[:, None, None, None]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q8, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(mask, s, _NEG_INF)
+        m_next = jnp.maximum(m, jnp.max(s, axis=-1))
+        p_ = jnp.exp(s - m_next[..., None])
+        p_ = jnp.where(mask, p_, 0.0)
+        alpha = jnp.exp(m - m_next)
+        l = l * alpha + jnp.sum(p_, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p_ if int8kv else p_.astype(v.dtype),
+            v, preferred_element_type=jnp.float32)
+        m = m_next
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l[..., None]).astype(q.dtype)
+    return out[:, :, 0, :]
+
+
+_decode_reference_jit = jax.jit(_decode_reference_impl)
+
+
+def flash_decode(q, k_pages, v_pages, block_tables, seq_lens, *,
+                 scale=None, impl=None, head_pack=None,
+                 kv_scales=None, vmem_budget_bytes=None):
+    """Paged-KV decode-step attention.  q: [B, H, d] (ONE query token
+    per sequence); k_pages/v_pages: [num_pages, H, page_size, d] pool
+    (ops/paged_kv.PagedKVCache layout; int8 pools need kv_scales =
+    (k_scale, v_scale) per-channel [H, d]); block_tables: [B,
+    max_pages] int32; seq_lens: [B] int32.  Returns [B, H, d].
+
+    impl: None (auto: pallas on TPU, reference replay elsewhere),
+    "pallas", "interpret", or "xla" (the gather+reference path).
+    head_pack: None defers to the `flash_head_pack` flag; needs
+    d <= 64 and an even H.  Every mode is bit-identical (array_equal)
+    to flash_decode_reference — the parity contract tests pin across
+    page boundaries, ragged lengths, d in {64, 128}, f32/bf16/int8-KV,
+    head-packed and not."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    scale = float(scale)
+    if head_pack is None:
+        head_pack = _resolve_variants(None, None)[1]
+    if impl is None:
+        impl = "pallas" if _on_tpu() else "xla"
+    int8kv = jnp.dtype(k_pages.dtype) == jnp.int8
+    if int8kv and kv_scales is None:
+        raise ValueError("int8 k_pages/v_pages need kv_scales "
+                         "(per-channel [H, d] — paged_kv.kv_scales())")
+    hpb = _decode_hpb(head_pack, q.shape[1], q.shape[2])
+    if impl in ("pallas", "interpret") and not _decode_geom_ok(
+            q, k_pages, hpb, vmem_budget_bytes):
+        impl = "xla"   # documented fallback: gather + reference replay
+    if impl in ("pallas", "interpret"):
+        if int8kv:
+            q_eff, vdq = _int8_pre(q, kv_scales)
+            raw = _flash_decode_pallas(
+                q_eff, k_pages, v_pages, block_tables, seq_lens,
+                scale, hpb, interpret=impl == "interpret")
+            return _int8_post(raw, vdq, q.dtype)
+        return _flash_decode_pallas(
+            q, k_pages, v_pages, block_tables, seq_lens, scale, hpb,
+            interpret=impl == "interpret")
+    return flash_decode_reference(q, k_pages, v_pages, block_tables,
+                                  seq_lens, scale=scale,
+                                  kv_scales=kv_scales)
+
+
+# ---------------------------------------------------------------------------
 # IR op registration
 # ---------------------------------------------------------------------------
 
@@ -786,3 +1137,20 @@ def _flash_attention_op(ins, attrs):
                                    scale=scale,
                                    block_q=attrs.get("block_q") or None,
                                    block_k=attrs.get("block_k") or None)}
+
+
+@register_op("flash_decode",
+             inputs=("Q", "KPages", "VPages", "BlockTables", "SeqLens",
+                     "KScale", "VScale"),
+             outputs=("Out",), optional=("KScale", "VScale"),
+             attrs={"scale": 0.0})
+def _flash_decode_op(ins, attrs):
+    """IR surface of the paged decode-step attention (module section
+    above); KScale/VScale are the int8-KV per-channel dequant scales."""
+    kv_scales = None
+    if "KScale" in ins:
+        kv_scales = (ins["KScale"], ins["VScale"])
+    return {"Out": flash_decode(ins["Q"], ins["KPages"], ins["VPages"],
+                                ins["BlockTables"], ins["SeqLens"],
+                                scale=attrs.get("scale") or None,
+                                kv_scales=kv_scales)}

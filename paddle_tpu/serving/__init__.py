@@ -20,10 +20,15 @@ Pieces (each its own module):
                    circuit breakers, failover/requeue, NamedSharding
                    param replication helper
   server.py        InferenceServer / ServingConfig / drain()
+  decode_engine.py continuous decode batching (ISSUE 7): DecodeServer
+                   — iteration-level batching of LLM decode over paged
+                   KV-caches + flash_decode, reusing the admission /
+                   deadline / drain contracts above (docs/DECODE.md)
 
 Design + contracts: docs/SERVING.md.  Fault semantics are driven by
 distributed/faultinject.py (msg types ``serving_infer`` /
-``serving_health``) so every failure mode is seeded and replayable.
+``serving_health`` / ``serving_decode``) so every failure mode is
+seeded and replayable.
 """
 
 from paddle_tpu.serving.admission import (
@@ -48,13 +53,20 @@ from paddle_tpu.serving.replica_pool import (
     ReplicaPool,
     replicate_predictor_params,
 )
+from paddle_tpu.serving.decode_engine import (
+    MSG_DECODE,
+    DecodeConfig,
+    DecodeServer,
+    TinyDecodeLM,
+)
 from paddle_tpu.serving.server import InferenceServer, ServingConfig
 
 __all__ = [
     "AdmissionController", "Batch", "DeadlineExpiredError",
-    "InferenceServer", "MSG_HEALTH", "MSG_INFER", "OverloadedError",
+    "DecodeConfig", "DecodeServer", "InferenceServer", "MSG_DECODE",
+    "MSG_HEALTH", "MSG_INFER", "OverloadedError",
     "Replica", "ReplicaFailedError", "ReplicaPool", "Request",
     "ServingConfig", "ServingError", "ShapeBucketBatcher",
-    "ShutdownError", "default_buckets", "replicate_predictor_params",
-    "signature_of",
+    "ShutdownError", "TinyDecodeLM", "default_buckets",
+    "replicate_predictor_params", "signature_of",
 ]

@@ -102,7 +102,15 @@ class ShapeBucketBatcher:
         self._first_t: dict = {}           # signature -> oldest arrival
         self._lock = threading.Lock()
         self._stats = {"batches": 0, "padded_rows": 0, "real_rows": 0,
-                       "shed_expired": 0}
+                       "shed_expired": 0,
+                       # bucket-cache temperature: a batch whose
+                       # (signature, bucket) was never formed before
+                       # is COLD (the replica pays a compile unless a
+                       # persistent compilation cache pre-warmed it —
+                       # PADDLE_TPU_COMPILE_CACHE_DIR); the rest are
+                       # WARM.  tools/serving_load.py banks both next
+                       # to time_to_first_batch_s (ROADMAP item 5).
+                       "bucket_cold": 0, "bucket_warm": 0}
         self._shapes: set = set()          # (signature, bucket) formed
 
     # -- stats --------------------------------------------------------------
@@ -206,10 +214,13 @@ class ShapeBucketBatcher:
                     [np.asarray(p) for p in parts], axis=0) \
                     if len(parts) > 1 else np.asarray(parts[0])
             batch = Batch(chunk, feeds, rows, bucket, sig)
+            cold = (sig, bucket) not in self._shapes
             with self._lock:
                 self._stats["batches"] += 1
                 self._stats["real_rows"] += rows
                 self._stats["padded_rows"] += bucket
+                self._stats["bucket_cold" if cold
+                            else "bucket_warm"] += 1
             self._shapes.add((sig, bucket))
             # blocking put: dispatch backpressure stalls the batcher,
             # which stalls admission takes, which sheds at submit —

@@ -1,0 +1,623 @@
+"""Continuous decode batching: the LLM-serving request type (ISSUE 7).
+
+Iteration-level (Orca-style) scheduling over N decode replicas: each
+replica owns a model adapter plus ONE paged KV-cache
+(ops/paged_kv.PagedKVCache) and runs a supervised iteration loop —
+every iteration, NEW sequences join the running batch (prompt KV
+prefilled into fresh pages), ONE decode step runs for the whole batch
+(ops.pallas_kernels.flash_decode over the shared page pool), and
+FINISHED sequences retire (pages freed, Request future answered) —
+the batch composition changes every token, not every request.
+
+The request path reuses the PR-6 serving discipline verbatim:
+
+  - admission: the same ``AdmissionController`` — bounded queue, typed
+    shedding (OverloadedError / DeadlineExpiredError / ShutdownError /
+    ReplicaFailedError), every ADMITTED sequence answered EXACTLY once
+    (request-id accounting);
+  - deadlines: shed at submit, before joining the batch, and checked
+    every iteration mid-generation (a typed expiry carries whatever
+    compute was already spent — the reply is typed either way);
+  - drain: stop admitting, let running sequences finish, answer
+    leftovers with the typed ShutdownError; after drain every replica
+    cache must satisfy ``free + in_use == num_pages`` with
+    ``in_use == 0`` — ZERO page leaks (the chaos soak asserts it);
+  - failover: a replica killed mid-step (faultinject msg type
+    ``serving_decode``) pushes its live sequences — full token history
+    — onto an unbounded retry lane; a survivor re-prefills them from
+    history and generation continues.  The dead replica's cache is
+    reset (all pages back to free), so a kill can corrupt nothing and
+    leak nothing.
+  - pool pressure: a batch that cannot take one more page PREEMPTS its
+    youngest sequence back to the retry lane (tokens-so-far preserved)
+    instead of corrupting the pool — vLLM-style preemption as the
+    backpressure of paging.
+
+Model adapter protocol (duck-typed; ``TinyDecodeLM`` is the built-in
+used by tests, the load generator and the bench):
+
+    model.vocab / num_heads / head_dim      (ints)
+    model.qkv(tokens [N] int32) -> (q, k, v) each [N, H, d]
+    model.logits(attn_out [N, H, d]) -> [N, vocab]
+
+The engine is greedy (argmax) per step; eos or max_new_tokens retires
+a sequence.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.concurrency import BoundedQueue, Supervisor
+from paddle_tpu.distributed import faultinject
+from paddle_tpu.ops.paged_kv import OutOfPagesError, PagedKVCache
+from paddle_tpu.serving.admission import (AdmissionController,
+                                          DeadlineExpiredError,
+                                          ReplicaFailedError,
+                                          ShutdownError)
+from paddle_tpu.serving.replica_pool import ReplicaKilled, ReplyLost
+
+__all__ = ["MSG_DECODE", "TinyDecodeLM", "DecodeConfig",
+           "DecodeServer"]
+
+MSG_DECODE = "serving_decode"
+
+
+class TinyDecodeLM:
+    """Deterministic seeded single-layer attention LM — the built-in
+    model adapter (tests / tools/serving_load.py --mode decode / the
+    bench decode leg).  Positionless on purpose: logits depend on the
+    full cached prefix through attention only, so correct paged
+    attention (and ONLY correct paged attention) reproduces the dense
+    decode exactly."""
+
+    def __init__(self, vocab=128, d_model=64, num_heads=4, head_dim=16,
+                 seed=0, dtype=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.vocab = int(vocab)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        dtype = dtype or jnp.float32
+        rng = np.random.RandomState(seed)
+        hd = self.num_heads * self.head_dim
+
+        def w(*shape):
+            return jnp.asarray(
+                (rng.randn(*shape) * 0.3).astype(np.float32), dtype)
+
+        self.embed = w(self.vocab, d_model)
+        self.wq = w(d_model, hd)
+        self.wk = w(d_model, hd)
+        self.wv = w(d_model, hd)
+        self.wo = w(hd, self.vocab)
+
+        def _qkv(tokens):
+            e = self.embed[tokens]
+            shp = (tokens.shape[0], self.num_heads, self.head_dim)
+            return ((e @ self.wq).reshape(shp),
+                    (e @ self.wk).reshape(shp),
+                    (e @ self.wv).reshape(shp))
+
+        def _logits(attn_out):
+            flat = attn_out.reshape(attn_out.shape[0], hd)
+            return flat.astype(self.wo.dtype) @ self.wo
+
+        # the pure functions are public so a caller building its own
+        # jitted decode step (bench.py _build_llm_decode, the lowering
+        # gate) can inline them under one jit
+        self.qkv_fn = _qkv
+        self.logits_fn = _logits
+        self._qkv_jit = jax.jit(_qkv)
+        self._logits_jit = jax.jit(_logits)
+
+    def qkv(self, tokens):
+        import jax.numpy as jnp
+
+        return self._qkv_jit(jnp.asarray(np.asarray(tokens, np.int32)))
+
+    def logits(self, attn_out):
+        return self._logits_jit(attn_out)
+
+
+class DecodeConfig:
+    """Decode-server knobs (docs/DECODE.md env-knob table)."""
+
+    def __init__(self, max_batch=8, max_new_tokens=32, num_pages=None,
+                 page_size=16, queue_capacity=None,
+                 default_deadline_s=30.0, n_replicas=1,
+                 restart_dead=True, max_attempts=None, eos_id=1,
+                 kv_int8=None, head_pack=None, drain_timeout_s=30.0,
+                 impl=None):
+        self.max_batch = int(max_batch)
+        self.max_new_tokens = int(max_new_tokens)
+        self.page_size = int(page_size)
+        # default pool: room for max_batch sequences of ~4 pages plus
+        # one page of growth each — tight enough that the preemption
+        # path is reachable, roomy enough that steady state never
+        # preempts
+        self.num_pages = int(num_pages) if num_pages is not None \
+            else 5 * self.max_batch
+        self.queue_capacity = int(queue_capacity) \
+            if queue_capacity is not None else 4 * self.max_batch
+        self.default_deadline_s = float(default_deadline_s)
+        self.n_replicas = int(n_replicas)
+        self.restart_dead = bool(restart_dead)
+        self.max_attempts = int(max_attempts) \
+            if max_attempts is not None else 2 * self.n_replicas + 1
+        self.eos_id = int(eos_id)
+        self.kv_int8 = kv_int8      # None -> the typed flag
+        self.head_pack = head_pack  # None -> the typed flag
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.impl = impl            # flash_decode impl (None = auto)
+
+
+class _Seq:
+    """One admitted sequence: request + full token history (the
+    failover unit — a survivor re-prefills from ``history``)."""
+
+    __slots__ = ("req", "prompt", "generated", "max_new", "attempts",
+                 "slot", "last_token", "last_emit_t")
+
+    def __init__(self, req, prompt, max_new):
+        self.req = req
+        self.prompt = list(int(t) for t in prompt)
+        self.generated = []
+        self.max_new = int(max_new)
+        self.attempts = 0
+        self.slot = None
+        self.last_token = None
+        self.last_emit_t = None
+
+    def history(self):
+        return self.prompt + self.generated
+
+
+class _DecodeReplica:
+    """Model + paged cache + the sequences currently riding it."""
+
+    def __init__(self, index, model, cfg):
+        self.index = index
+        self.model = model
+        self.cfg = cfg
+        self.alive = True
+        self.cache = PagedKVCache(
+            num_pages=cfg.num_pages, page_size=cfg.page_size,
+            num_heads=model.num_heads, head_dim=model.head_dim,
+            kv_int8=cfg.kv_int8)
+        self.active = []            # [_Seq], admission order
+        self.iterations = 0
+        self.tokens_out = 0
+
+
+class DecodeServer:
+    """Continuous-batching decode server over N model replicas.
+
+    model_factory(i) -> a model adapter for replica i (default:
+    ``TinyDecodeLM`` per replica, same seed — replicas must agree so a
+    failed-over sequence continues the same distribution)."""
+
+    def __init__(self, model_factory=None, config=None):
+        import jax.numpy as jnp  # noqa: F401 — decode runs on device
+
+        self.config = cfg = config or DecodeConfig()
+        factory = model_factory or (lambda i: TinyDecodeLM())
+        self.admission = AdmissionController(
+            capacity=cfg.queue_capacity,
+            default_deadline_s=cfg.default_deadline_s)
+        # failover/preemption lane: unbounded on purpose — the PR-6
+        # single-survivor-deadlock lesson (total sequences stay bounded
+        # by admission capacity + max_batch * n_replicas)
+        self._retry = BoundedQueue()
+        self.replicas = [_DecodeReplica(i, factory(i), cfg)
+                         for i in range(cfg.n_replicas)]
+        self._sup = Supervisor(restart_backoff=0.02, max_backoff=0.5)
+        for rep in self.replicas:
+            self._sup.add_worker("decode-%d" % rep.index,
+                                 self._make_worker(rep),
+                                 restart=cfg.restart_dead)
+        self._meta = {}             # req.id -> max_new
+        self._lock = threading.Lock()
+        self._counters = {"iterations": 0, "tokens_out": 0,
+                          "prefills": 0, "kills": 0, "step_faults": 0,
+                          "failovers": 0, "preemptions": 0}
+        self._step_ms = []          # bounded rolling inter-token record
+        self._started = False
+        self._stopped = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if not self._started:
+            self._started = True
+            self._sup.start()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- request path -------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=None, deadline_s=None,
+               request_id=None):
+        """Admit a decode request (prompt token ids, 1-D int array) or
+        raise a typed ServingError.  The Request future resolves to
+        ``[generated_tokens]`` (np.int32, <= max_new_tokens, eos
+        included when emitted)."""
+        if not self._started or self._stopped:
+            self.admission._count("rejected_shutdown")
+            raise ShutdownError("decode server not running")
+        if not any(r.alive for r in self.replicas):
+            self.admission._count("rejected_overloaded")
+            raise ReplicaFailedError("no live decode replicas")
+        ids = np.asarray(prompt_ids)
+        if ids.ndim != 1 or ids.size == 0 or \
+                not np.issubdtype(ids.dtype, np.integer):
+            raise ValueError(
+                "prompt_ids must be a non-empty 1-D integer array, "
+                "got shape %s dtype %s" % (ids.shape, ids.dtype))
+        vocab = self.replicas[0].model.vocab
+        if ids.min() < 0 or ids.max() >= vocab:
+            raise ValueError("prompt token out of range [0, %d)"
+                             % vocab)
+        max_new = int(max_new_tokens) if max_new_tokens is not None \
+            else self.config.max_new_tokens
+        cache0 = self.replicas[0].cache
+        if cache0.pages_for(ids.size + max_new) > cache0.num_pages:
+            raise ValueError(
+                "prompt+max_new needs %d pages; the pool only has %d"
+                % (cache0.pages_for(ids.size + max_new),
+                   cache0.num_pages))
+        req = self.admission.submit({"ids": ids.astype(np.int32)},
+                                    deadline_s=deadline_s,
+                                    request_id=request_id)
+        with self._lock:
+            self._meta[req.id] = max_new
+        return req
+
+    def decode(self, prompt_ids, max_new_tokens=None, deadline_s=None,
+               timeout=None):
+        """Synchronous convenience: submit + result -> np token array."""
+        req = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                          deadline_s=deadline_s)
+        return req.result(timeout=timeout)[0]
+
+    # -- the iteration loop -------------------------------------------------
+    def _make_worker(self, rep):
+        def loop():
+            # a supervisor relaunch IS the replica restart
+            # (restart_dead=True); the cache was reset at kill time
+            if not rep.alive and self.config.restart_dead:
+                rep.alive = True
+            while self._sup.running:
+                if not rep.alive:
+                    return
+                self._admit(rep)
+                if not rep.active:
+                    if self.admission.draining and \
+                            self._retry.empty():
+                        time.sleep(0.002)
+                    time.sleep(0.001)
+                    continue
+                try:
+                    self._iterate(rep)
+                except ReplicaKilled:
+                    raise     # worker dies; supervisor may relaunch
+                except Exception:
+                    # a step that failed for any other reason fails
+                    # over its sequences rather than dying silently
+                    self._fail_over(rep)
+                    raise
+
+        return loop
+
+    def _admit(self, rep):
+        """Join new + failed-over sequences into this replica's batch
+        (iteration-level batching: called every step)."""
+        cfg = self.config
+        while len(rep.active) < cfg.max_batch:
+            seq = None
+            try:
+                seq = self._retry.get_nowait()
+            except queue_mod.Empty:
+                req = self.admission.take(timeout=0.0005)
+                if req is not None:
+                    with self._lock:
+                        max_new = self._meta.get(
+                            req.id, cfg.max_new_tokens)
+                    seq = _Seq(req, np.asarray(req.feeds["ids"]),
+                               max_new)
+            if seq is None:
+                return
+            now = time.monotonic()
+            if seq.req.done():
+                continue            # answered elsewhere (drain sweep)
+            if seq.req.expired(now):
+                seq.req.fail(DeadlineExpiredError(
+                    "request %s: deadline passed before joining the "
+                    "decode batch" % seq.req.id))
+                continue
+            if seq.attempts >= cfg.max_attempts:
+                seq.req.fail(ReplicaFailedError(
+                    "sequence failed after %d attempts"
+                    % seq.attempts))
+                continue
+            try:
+                self._prefill(rep, seq)
+            except OutOfPagesError:
+                # no room: back on the lane for later / for a less
+                # loaded replica (not an attempt — nothing failed)
+                self._retry.put(seq)
+                return
+            rep.active.append(seq)
+
+    def _prefill(self, rep, seq):
+        """Write KV for history[:-1] into fresh pages; the last history
+        token becomes the pending input of the next iteration."""
+        hist = seq.history()
+        prefix = hist[:-1]
+        if prefix:
+            # pow2-pad the prompt through the projections (ragged
+            # lengths would retrace the jitted qkv per length), then
+            # slice the real rows for the page writes
+            plen = len(prefix)
+            pp = 1
+            while pp < plen:
+                pp *= 2
+            padded = np.zeros((pp,), np.int32)
+            padded[:plen] = prefix
+            _, k, v = rep.model.qkv(padded)
+            seq.slot = rep.cache.prefill(k[:plen], v[:plen])
+        else:
+            seq.slot = rep.cache.alloc(1)
+        seq.last_token = int(hist[-1])
+        seq.last_emit_t = time.monotonic()
+        self._count(prefills=1)
+
+    def _iterate(self, rep):
+        """ONE decode step for the whole running batch."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas_kernels import flash_decode
+
+        cfg = self.config
+        # seeded fault point — consulted BEFORE any cache mutation so
+        # kill/close/drop can never half-apply a step
+        inj = faultinject.maybe_injector()
+        if inj is not None:
+            act = inj.decide(MSG_DECODE)
+            if act is not None:
+                for kind, arg in faultinject.steps_of(act):
+                    if kind == "delay":
+                        time.sleep(arg)
+                    elif kind == "kill":
+                        self._count(kills=1)
+                        self._fail_over(rep)
+                        raise ReplicaKilled(
+                            "decode replica %d killed mid-step "
+                            "(fault injection)" % rep.index)
+                    else:   # close / drop / truncate: lost step —
+                        # transient, nothing mutated yet, no token
+                        # emitted this iteration; the next one retries
+                        self._count(step_faults=1)
+                        return
+        now = time.monotonic()
+        # deadline / externally-answered sweep before spending compute
+        keep = []
+        for s in rep.active:
+            if s.req.done():
+                rep.cache.free(s.slot)
+            elif s.req.expired(now):
+                rep.cache.free(s.slot)
+                s.req.fail(DeadlineExpiredError(
+                    "request %s: deadline passed mid-generation "
+                    "(%d/%d tokens emitted)"
+                    % (s.req.id, len(s.generated), s.max_new)))
+            else:
+                keep.append(s)
+        rep.active = keep
+        if not rep.active:
+            return
+        # compile-once shape discipline (the PR-6 bucket-cache story
+        # applied to decode): the device step always runs at the FIXED
+        # batch shape max_batch (dummy rows: sink-page writes, length
+        # 0 -> zero attention output) and at a pow2-bucketed block
+        # table width — iteration-level batching changes the batch
+        # every token, and unpadded shapes would retrace the jitted
+        # step per composition (measured: ~300 ms/step of pure
+        # recompile on the CPU harness)
+        n_pad = cfg.max_batch
+        tokens = np.zeros((n_pad,), np.int32)
+        tokens[:len(rep.active)] = [s.last_token for s in rep.active]
+        q, k, v = rep.model.qkv(tokens)
+        slots = [s.slot for s in rep.active]
+        while True:
+            try:
+                rep.cache.append(slots, k, v)
+                break
+            except OutOfPagesError:
+                # paging backpressure: preempt the youngest sequence
+                # (full history preserved) and retry the step
+                if len(rep.active) == 1:
+                    s = rep.active.pop()
+                    rep.cache.free(s.slot)
+                    s.slot = None
+                    s.req.fail(ReplicaFailedError(
+                        "request %s: page pool too small even for a "
+                        "lone sequence" % s.req.id))
+                    return
+                s = rep.active.pop()
+                rep.cache.free(s.slot)
+                s.slot = None
+                self._count(preemptions=1)
+                self._retry.put(s)
+                slots = slots[:-1]
+        # pow2 bucket of the table width: at most log2(max) distinct
+        # (batch, table) shapes ever reach the compiler
+        mp_need = max(rep.cache.pages_for(rep.cache.seq_len(s_) or 1)
+                      for s_ in slots)
+        mp = 1
+        while mp < mp_need:
+            mp *= 2
+        tables = rep.cache.tables_for(slots, max_pages=mp,
+                                      pad_to=n_pad)
+        lens = rep.cache.lens_for(slots, pad_to=n_pad)
+        out = flash_decode(
+            q, rep.cache.k_pages, rep.cache.v_pages, tables, lens,
+            impl=cfg.impl, head_pack=cfg.head_pack,
+            kv_scales=rep.cache.kv_scales() if rep.cache.kv_int8
+            else None)
+        logits = rep.model.logits(out)
+        next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
+        t_emit = time.monotonic()
+        rep.iterations += 1
+        still = []
+        for s, tok in zip(rep.active, next_tokens):
+            tok = int(tok)
+            s.generated.append(tok)
+            s.last_token = tok
+            if s.last_emit_t is not None:
+                self._record_step_ms(
+                    (t_emit - s.last_emit_t) * 1000.0)
+            s.last_emit_t = t_emit
+            rep.tokens_out += 1
+            if tok == cfg.eos_id or len(s.generated) >= s.max_new:
+                rep.cache.free(s.slot)
+                s.slot = None
+                s.req.complete(
+                    [np.asarray(s.generated, np.int32)])
+            else:
+                still.append(s)
+        rep.active = still
+        self._count(iterations=1, tokens_out=len(next_tokens))
+
+    def _fail_over(self, rep):
+        """Kill path: every live sequence — full token history — onto
+        the retry lane; the cache resets (all pages freed, accounting
+        intact)."""
+        rep.alive = False
+        moved = rep.active
+        rep.active = []
+        rep.cache.reset()
+        survivors = [r for r in self.replicas
+                     if r.alive and r is not rep] \
+            or ([rep] if self.config.restart_dead else [])
+        for s in moved:
+            s.slot = None
+            s.attempts += 1
+            if s.req.done():
+                continue
+            if not survivors and s.attempts >= \
+                    self.config.max_attempts:
+                s.req.fail(ReplicaFailedError(
+                    "replica died; no survivors after %d attempts"
+                    % s.attempts))
+            else:
+                self._count(failovers=1)
+                self._retry.put(s)
+
+    # -- shutdown -----------------------------------------------------------
+    def drain(self, timeout=None):
+        """Stop admitting; run every admitted sequence to completion
+        (or typed expiry); answer whatever remains at the timeout with
+        the typed ShutdownError.  Returns the shutdown-failed count."""
+        timeout = self.config.drain_timeout_s if timeout is None \
+            else float(timeout)
+        self.admission.start_drain()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            busy = any(r.active for r in self.replicas) \
+                or not self._retry.empty() \
+                or self.admission.outstanding_count() > 0
+            if not busy:
+                break
+            time.sleep(0.005)
+        leftovers = self.admission.outstanding()
+        for req in leftovers.values():
+            req.fail(ShutdownError(
+                "request %s: decode server drained before completion"
+                % req.id))
+        return len(leftovers)
+
+    def stop(self, drain_timeout=None):
+        if self._stopped:
+            return 0
+        leftovers = self.drain(timeout=drain_timeout)
+        self._stopped = True
+        self._sup.stop(join_timeout=2.0)
+        # post-drain page sweep: sequences answered by the drain fail
+        # above still hold pages until their worker notices — workers
+        # are stopped now, so release here; the accounting check runs
+        # AFTER this (a real leak — a page owned by no sequence — is
+        # not maskable by it)
+        for rep in self.replicas:
+            for s in rep.active:
+                if s.slot is not None:
+                    rep.cache.free(s.slot)
+            rep.active = []
+        return leftovers
+
+    # -- observability ------------------------------------------------------
+    def _count(self, **incs):
+        with self._lock:
+            for k_, v_ in incs.items():
+                self._counters[k_] += v_
+
+    def _record_step_ms(self, ms):
+        with self._lock:
+            self._step_ms.append(ms)
+            if len(self._step_ms) > 10000:
+                del self._step_ms[:5000]
+
+    def inter_token_ms(self):
+        """(p50, p99) inter-token latency over the rolling record."""
+        with self._lock:
+            lat = sorted(self._step_ms)
+        if not lat:
+            return None, None
+        return (lat[min(len(lat) - 1, int(0.50 * len(lat)))],
+                lat[min(len(lat) - 1, int(0.99 * len(lat)))])
+
+    def page_accounting(self):
+        """(ok, detail) over every replica cache — the zero-leak
+        invariant (`allocated == in_use + free`, and in_use == 0 after
+        drain)."""
+        for rep in self.replicas:
+            ok, detail = rep.cache.check_accounting()
+            if not ok:
+                return False, "replica %d: %s" % (rep.index, detail)
+        return True, ""
+
+    def stats(self):
+        c = self.admission.counters()
+        answered = sum(v for k_, v in c.items()
+                       if k_.startswith("answered_"))
+        with self._lock:
+            counters = dict(self._counters)
+        p50, p99 = self.inter_token_ms()
+        return {
+            "admission": c,
+            "outstanding": self.admission.outstanding_count(),
+            "answered": answered,
+            "accounted": answered + self.admission.outstanding_count()
+            == c["admitted"],
+            "decode": counters,
+            "inter_token_p50_ms": p50,
+            "inter_token_p99_ms": p99,
+            "retry_depth": self._retry.qsize(),
+            "replicas": {
+                rep.index: {"alive": rep.alive,
+                            "active_seqs": len(rep.active),
+                            "iterations": rep.iterations,
+                            "tokens_out": rep.tokens_out,
+                            "cache": rep.cache.stats()}
+                for rep in self.replicas},
+            "draining": self.admission.draining,
+        }

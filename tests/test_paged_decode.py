@@ -1,0 +1,461 @@
+"""ISSUE 7 tentpole coverage: paged KV-cache allocator, the
+flash_decode kernel (bit-parity with the gather+reference replay
+across page boundaries, ragged lengths, d in {64, 128}, f32/bf16,
+int8-KV, head-packed and not), the int8-KV accuracy bar, and the
+continuous-decode serving tier (exactly-once under seeded chaos, zero
+KV-page leaks after drain, preemption under pool pressure).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import pallas_kernels as pk
+from paddle_tpu.ops.paged_kv import (OutOfPagesError, PagedKVCache,
+                                     dequantize_kv, kv_scales_of,
+                                     quantize_kv)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+def test_alloc_append_free_accounting():
+    c = PagedKVCache(num_pages=8, page_size=4, num_heads=2, head_dim=8)
+    rng = np.random.RandomState(0)
+    s0 = c.prefill(rng.randn(3, 2, 8), rng.randn(3, 2, 8))   # 1 page
+    s1 = c.prefill(rng.randn(6, 2, 8), rng.randn(6, 2, 8))   # 2 pages
+    assert c.in_use_pages() == 3 and c.free_pages() == 5
+    assert c.seq_len(s0) == 3 and c.seq_len(s1) == 6
+    # append crosses a page boundary for s0 at len 4
+    c.append([s0], rng.randn(1, 2, 8), rng.randn(1, 2, 8))
+    assert c.in_use_pages() == 3          # 3 -> 4 fits page 0
+    c.append([s0], rng.randn(1, 2, 8), rng.randn(1, 2, 8))
+    assert c.in_use_pages() == 4          # 4 -> 5 takes a new page
+    ok, detail = c.check_accounting()
+    assert ok, detail
+    c.free(s0)
+    assert c.in_use_pages() == 2 and c.free_pages() == 6
+    c.free(s1)
+    assert c.in_use_pages() == 0 and c.free_pages() == 8
+    assert c.stats()["accounted"]
+    with pytest.raises(KeyError):
+        c.free(s0)                        # double free is loud
+
+
+def test_out_of_pages_is_typed_and_atomic():
+    c = PagedKVCache(num_pages=2, page_size=4, num_heads=1, head_dim=8)
+    rng = np.random.RandomState(0)
+    with pytest.raises(OutOfPagesError):
+        c.prefill(rng.randn(12, 1, 8), rng.randn(12, 1, 8))  # 3 pages
+    assert c.free_pages() == 2            # nothing partially allocated
+    s = c.prefill(rng.randn(8, 1, 8), rng.randn(8, 1, 8))    # full pool
+    with pytest.raises(OutOfPagesError):
+        c.append([s], rng.randn(1, 1, 8), rng.randn(1, 1, 8))
+    assert c.seq_len(s) == 8              # length untouched on failure
+    ok, detail = c.check_accounting()
+    assert ok, detail
+
+
+def test_prefill_roundtrip_and_gather():
+    c = PagedKVCache(num_pages=6, page_size=4, num_heads=2, head_dim=8)
+    rng = np.random.RandomState(1)
+    k = rng.randn(7, 2, 8).astype(np.float32)
+    v = rng.randn(7, 2, 8).astype(np.float32)
+    s = c.prefill(k, v)
+    tab = np.asarray(c.tables_for([s]))
+    got = np.asarray(c.k_pages)[tab[0]]          # [2 pages, H, ps, d]
+    flat = got.transpose(0, 2, 1, 3).reshape(-1, 2, 8)[:7]
+    assert np.array_equal(flat, k)
+
+
+def test_padded_append_hits_sink_page():
+    c = PagedKVCache(num_pages=4, page_size=4, num_heads=1, head_dim=8)
+    rng = np.random.RandomState(2)
+    s = c.prefill(rng.randn(2, 1, 8), rng.randn(2, 1, 8))
+    k = rng.randn(3, 1, 8).astype(np.float32)     # 1 real + 2 padding
+    c.append([s], k, k)
+    assert c.seq_len(s) == 3
+    ok, detail = c.check_accounting()
+    assert ok, detail
+    # the sink page took the padding rows; real pages untouched by them
+    assert np.array_equal(
+        np.asarray(c.k_pages)[c.sink_page, 0, 0], k[1, 0]) or \
+        np.array_equal(np.asarray(c.k_pages)[c.sink_page, 0, 0],
+                       k[2, 0])
+
+
+def test_tables_lens_padding():
+    c = PagedKVCache(num_pages=6, page_size=4, num_heads=1, head_dim=8)
+    rng = np.random.RandomState(3)
+    s = c.prefill(rng.randn(5, 1, 8), rng.randn(5, 1, 8))
+    t = c.tables_for([s], max_pages=4, pad_to=3)
+    ln = c.lens_for([s], pad_to=3)
+    assert t.shape == (3, 4) and ln.shape == (3,)
+    assert int(ln[0]) == 5 and int(ln[1]) == 0 and int(ln[2]) == 0
+
+
+def test_int8_storage_rides_quant_contract():
+    c = PagedKVCache(num_pages=4, page_size=4, num_heads=2, head_dim=8,
+                     kv_int8=True)
+    rng = np.random.RandomState(4)
+    k = rng.randn(4, 2, 8).astype(np.float32)
+    v = rng.randn(4, 2, 8).astype(np.float32)
+    s = c.prefill(k, v)
+    ks, vs = c.kv_scales()
+    assert ks.shape == (2, 8)
+    tab = np.asarray(c.tables_for([s]))
+    stored = np.asarray(c.k_pages)[tab[0, 0]]     # [H, ps, d] int8
+    assert stored.dtype == np.int8
+    deq = np.asarray(dequantize_kv(
+        jnp.asarray(stored.transpose(1, 0, 2)), ks))[:4]
+    assert np.allclose(deq, k, atol=float(np.abs(k).max()) / 100.0)
+    # the contract is ops/quant.py's: q = clip(round(x/s*127))
+    expect = np.asarray(quantize_kv(jnp.asarray(k), ks))
+    assert np.array_equal(stored.transpose(1, 0, 2)[:4], expect)
+
+
+# ---------------------------------------------------------------------------
+# flash_decode kernel parity
+# ---------------------------------------------------------------------------
+
+def _setup(lens, H=4, d=64, ps=16, dtype=jnp.float32, int8=False,
+           seed=1):
+    rng = np.random.RandomState(seed)
+    c = PagedKVCache(num_pages=64, page_size=ps, num_heads=H,
+                     head_dim=d, dtype=dtype, kv_int8=int8)
+    for t in lens:
+        c.prefill(rng.randn(t, H, d).astype(np.float32),
+                  rng.randn(t, H, d).astype(np.float32))
+    slots = list(range(len(lens)))
+    q = jnp.asarray(rng.randn(len(lens), H, d).astype(np.float32)) \
+        .astype(dtype)
+    return (c, q, c.tables_for(slots), c.lens_for(slots),
+            c.kv_scales() if int8 else None)
+
+
+@pytest.mark.parametrize("d", [64, 128])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("hp", [False, True])
+def test_kernel_bit_parity_ragged_page_boundaries(d, dtype, hp):
+    """interpret kernel == gather+reference replay, array_equal, on
+    ragged lengths spanning none/exact/multiple page boundaries."""
+    c, q, tab, ln, _ = _setup([5, 33, 16, 1], d=d, dtype=dtype)
+    ref = pk.flash_decode_reference(q, c.k_pages, c.v_pages, tab, ln)
+    out = pk.flash_decode(q, c.k_pages, c.v_pages, tab, ln,
+                          impl="interpret", head_pack=hp)
+    assert jnp.array_equal(ref, out)
+
+
+@pytest.mark.parametrize("hp", [False, True])
+def test_kernel_bit_parity_int8kv(hp):
+    c, q, tab, ln, scales = _setup([5, 33, 16, 64], d=64, ps=32,
+                                   int8=True)
+    ref = pk.flash_decode_reference(q, c.k_pages, c.v_pages, tab, ln,
+                                    kv_scales=scales)
+    out = pk.flash_decode(q, c.k_pages, c.v_pages, tab, ln,
+                          impl="interpret", head_pack=hp,
+                          kv_scales=scales)
+    assert jnp.array_equal(ref, out)
+
+
+def test_reference_matches_plain_softmax():
+    """The replay path is page-ordered online softmax; numerically it
+    must equal plain softmax(QK^T)V over the live prefix."""
+    c, q, tab, ln, _ = _setup([5, 33, 16], d=64)
+    ref = np.asarray(pk.flash_decode_reference(
+        q, c.k_pages, c.v_pages, tab, ln))
+    rng = np.random.RandomState(1)
+    for i, t in enumerate([5, 33, 16]):
+        k = rng.randn(t, 4, 64).astype(np.float32)
+        v = rng.randn(t, 4, 64).astype(np.float32)
+        qq = np.asarray(q)[i]
+        s = np.einsum("hd,thd->ht", qq, k) / np.sqrt(64)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        o = np.einsum("ht,thd->hd", p, v)
+        assert np.allclose(ref[i], o, atol=1e-5)
+
+
+def test_zero_length_rows_emit_zero():
+    c, q, tab, ln, _ = _setup([5], d=64)
+    tab = c.tables_for([0], pad_to=3)
+    ln = c.lens_for([0], pad_to=3)
+    q3 = jnp.concatenate([q, q[:1], q[:1]], axis=0)
+    out = pk.flash_decode(q3, c.k_pages, c.v_pages, tab, ln,
+                          impl="interpret")
+    assert jnp.array_equal(out[1], jnp.zeros_like(out[1]))
+    ref = pk.flash_decode_reference(q3, c.k_pages, c.v_pages, tab, ln)
+    assert jnp.array_equal(ref, out)
+
+
+def test_geometry_and_budget_fallback():
+    """Illegal page geometry or a too-small VMEM budget routes to the
+    reference path silently — outputs identical by construction."""
+    # page_size 6: not a legal f32 sublane multiple -> fallback
+    c, q, tab, ln, _ = _setup([5, 9], d=64, ps=6)
+    assert not pk._decode_geom_ok(q, c.k_pages, 1)
+    out = pk.flash_decode(q, c.k_pages, c.v_pages, tab, ln,
+                          impl="pallas")   # silently degrades
+    ref = pk.flash_decode_reference(q, c.k_pages, c.v_pages, tab, ln)
+    assert jnp.array_equal(ref, out)
+    # legal geometry but a 1 KB budget -> fallback
+    c2, q2, tab2, ln2, _ = _setup([5, 9], d=64, ps=16)
+    assert pk._decode_geom_ok(q2, c2.k_pages, 1)
+    assert not pk._decode_geom_ok(q2, c2.k_pages, 1,
+                                  vmem_budget_bytes=1024)
+    out2 = pk.flash_decode(q2, c2.k_pages, c2.v_pages, tab2, ln2,
+                           impl="pallas", vmem_budget_bytes=1024)
+    ref2 = pk.flash_decode_reference(q2, c2.k_pages, c2.v_pages, tab2,
+                                     ln2)
+    assert jnp.array_equal(ref2, out2)
+
+
+def test_head_pack_gate():
+    assert pk._decode_hpb(True, 8, 64) == 2
+    assert pk._decode_hpb(True, 7, 64) == 1    # odd H
+    assert pk._decode_hpb(True, 8, 128) == 1   # d > 64
+    assert pk._decode_hpb(False, 8, 64) == 1
+
+
+def test_int8_requires_scales():
+    c, q, tab, ln, _ = _setup([5], int8=True, ps=32)
+    with pytest.raises(ValueError):
+        pk.flash_decode(q, c.k_pages, c.v_pages, tab, ln,
+                        kv_scales=None)
+
+
+def test_int8_kv_top1_agreement():
+    """The ISSUE accuracy bar (rn32-harness pattern): greedy next-token
+    top-1 agreement between f32-KV and int8-KV decode over seeded
+    ragged prompts must hold >= 0.95 (measured 0.984 at N=64)."""
+    from paddle_tpu.serving.decode_engine import TinyDecodeLM
+
+    model = TinyDecodeLM(vocab=128, d_model=64, num_heads=4,
+                         head_dim=16, seed=0)
+    rng = np.random.RandomState(42)
+    n, agree = 64, 0
+    for _ in range(n):
+        prompt = rng.randint(2, 128,
+                             size=int(rng.randint(2, 24))) \
+            .astype(np.int32)
+        _, k, v = model.qkv(prompt)
+        tok = {}
+        for int8 in (False, True):
+            c = PagedKVCache(num_pages=8, page_size=16, num_heads=4,
+                             head_dim=16, kv_int8=int8)
+            s = c.prefill(k, v)
+            q, _, _ = model.qkv(prompt[-1:])
+            o = pk.flash_decode_reference(
+                q, c.k_pages, c.v_pages, c.tables_for([s]),
+                c.lens_for([s]),
+                kv_scales=c.kv_scales() if int8 else None)
+            tok[int8] = int(jnp.argmax(model.logits(o)))
+        agree += tok[False] == tok[True]
+    assert agree / n >= 0.95, "int8-KV top-1 agreement %d/%d" \
+        % (agree, n)
+
+
+# ---------------------------------------------------------------------------
+# continuous decode batching through the serving tier
+# ---------------------------------------------------------------------------
+
+def _decode_server(**kw):
+    from paddle_tpu import serving
+
+    cfg = dict(max_batch=4, max_new_tokens=10, page_size=16,
+               num_pages=40, n_replicas=2, eos_id=1,
+               default_deadline_s=60.0)
+    cfg.update(kw)
+    return serving.DecodeServer(config=serving.DecodeConfig(**cfg))
+
+
+def test_decode_server_matches_dense_oracle():
+    """Sequences decoded through continuous batching + paged
+    flash_decode must reproduce the dense full-prefix greedy decode
+    token-for-token (the TinyDecodeLM is positionless, so only correct
+    paged attention can do this)."""
+    srv = _decode_server().start()
+    try:
+        rng = np.random.RandomState(0)
+        pairs = []
+        for _ in range(8):
+            p = rng.randint(2, 128, size=int(rng.randint(1, 8)))
+            pairs.append((p, srv.submit(p)))
+        outs = [r.result(timeout=60.0)[0] for _, r in pairs]
+        model = srv.replicas[0].model
+
+        def dense(prompt, max_new=10, eos=1):
+            hist, gen = list(prompt), []
+            for _ in range(max_new):
+                q, k, v = model.qkv(np.asarray(hist, np.int32))
+                s = jnp.einsum("hd,thd->ht", q[-1], k) \
+                    / np.sqrt(model.head_dim)
+                o = jnp.einsum("ht,thd->hd",
+                               jax.nn.softmax(s, axis=-1), v)
+                tok = int(jnp.argmax(model.logits(o[None])[0]))
+                gen.append(tok)
+                hist.append(tok)
+                if tok == eos:
+                    break
+            return gen
+
+        for (p, _), out in zip(pairs, outs):
+            assert list(out) == dense(p)
+    finally:
+        srv.stop()
+    assert srv.stats()["accounted"]
+    ok, detail = srv.page_accounting()
+    assert ok, detail
+
+
+def test_decode_chaos_exactly_once_zero_page_leaks():
+    """THE acceptance leg: seeded kill+drop plan over serving_decode —
+    every admitted sequence answered exactly once (typed success or
+    typed rejection), replica kill fails its batch over to the
+    survivor, and after drain no KV page is leaked."""
+    from paddle_tpu import serving
+    from paddle_tpu.distributed import faultinject
+    from paddle_tpu.distributed.faultinject import FaultPlan
+
+    plan = FaultPlan()
+    plan.on("serving_decode", 2, "kill")
+    plan.on("serving_decode", 5, "drop")
+    plan.on("serving_decode", 9, "delay=0.01+drop")
+    rng = np.random.RandomState(3)
+    with faultinject.installed(plan):
+        srv = _decode_server(num_pages=60,
+                             restart_dead=False).start()
+        futures = [srv.submit(rng.randint(2, 128,
+                                          size=int(rng.randint(1, 6))))
+                   for _ in range(12)]
+        answered = 0
+        for f in futures:
+            try:
+                f.result(timeout=60.0)
+            except serving.ServingError:
+                pass
+            answered += 1
+        leftovers = srv.stop()
+        st = srv.stats()
+    assert answered == len(futures)
+    assert leftovers == 0
+    assert st["accounted"] and st["outstanding"] == 0
+    assert st["decode"]["kills"] == 1
+    assert st["decode"]["failovers"] >= 1
+    ok, detail = srv.page_accounting()
+    assert ok, detail
+    for rep_st in st["replicas"].values():
+        assert rep_st["cache"]["in_use_pages"] == 0
+
+
+def test_decode_deadline_expires_typed_mid_generation():
+    from paddle_tpu import serving
+    from paddle_tpu.distributed import faultinject
+    from paddle_tpu.distributed.faultinject import FaultPlan
+
+    # slow every step so a short deadline trips mid-generation
+    plan = FaultPlan(seed=1, rate=1.0, actions=("delay=0.05",),
+                     max_faults=1000)
+    with faultinject.installed(plan):
+        srv = _decode_server(n_replicas=1, max_new_tokens=64).start()
+        try:
+            req = srv.submit(np.asarray([2, 3, 4]), deadline_s=0.15)
+            with pytest.raises(serving.DeadlineExpiredError):
+                req.result(timeout=30.0)
+        finally:
+            srv.stop()
+    ok, detail = srv.page_accounting()
+    assert ok, detail
+
+
+def test_decode_drain_answers_typed_shutdown():
+    from paddle_tpu import serving
+
+    srv = _decode_server(n_replicas=1).start()
+    req = srv.submit(np.asarray([2, 3, 4]), max_new_tokens=5)
+    req.result(timeout=60.0)
+    srv.admission.start_drain()
+    with pytest.raises(serving.ShutdownError):
+        srv.submit(np.asarray([5, 6]))
+    left = srv.stop()
+    assert left == 0
+    assert srv.stats()["accounted"]
+
+
+def test_decode_preemption_under_pool_pressure():
+    """A pool too small for the whole batch preempts its youngest
+    sequence (tokens preserved) instead of corrupting pages — every
+    request still answers, accounting exact."""
+    srv = _decode_server(n_replicas=1, max_batch=4, page_size=4,
+                         num_pages=8, max_new_tokens=12).start()
+    try:
+        rng = np.random.RandomState(5)
+        futures = [srv.submit(rng.randint(2, 128, size=3))
+                   for _ in range(6)]
+        for f in futures:
+            f.result(timeout=60.0)
+    finally:
+        srv.stop()
+    st = srv.stats()
+    assert st["accounted"]
+    ok, detail = srv.page_accounting()
+    assert ok, detail
+
+
+def test_decode_submit_validation():
+    from paddle_tpu import serving  # noqa: F401
+
+    srv = _decode_server(n_replicas=1).start()
+    try:
+        with pytest.raises(ValueError):
+            srv.submit(np.zeros((2, 2), np.int32))      # not 1-D
+        with pytest.raises(ValueError):
+            srv.submit(np.asarray([1.5, 2.5]))          # not ints
+        with pytest.raises(ValueError):
+            srv.submit(np.asarray([99999]))             # out of vocab
+        with pytest.raises(ValueError):
+            srv.submit(np.asarray([2] * 10000))         # can't ever fit
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# bench leg + load generator plumbing
+# ---------------------------------------------------------------------------
+
+def test_bench_llm_decode_row_contract():
+    import bench
+
+    res = bench.bench_llm_decode(streams=2, prefill_len=8,
+                                 gen_tokens=3, heads=2, head_dim=32,
+                                 page_size=8, vocab=64, warmup=1)
+    for field in ("tokens_per_sec", "inter_token_p50_ms",
+                  "inter_token_p99_ms", "streams", "paged",
+                  "kv_gb_per_step", "kv_bw_pct", "page_size"):
+        assert field in res, field
+    assert res["paged"] is True and res["streams"] == 2
+    res8 = bench.bench_llm_decode(streams=2, prefill_len=8,
+                                  gen_tokens=2, heads=2, head_dim=32,
+                                  page_size=8, vocab=64, warmup=1,
+                                  kv_int8=True)
+    assert res8["kv_int8"] is True
+
+
+def test_workload_sig_keys_decode_variants_apart():
+    import bench
+
+    base = {"streams": 64, "heads": 8, "head_dim": 128, "paged": True}
+    a = bench._workload_sig("llm_decode_flash_str64", base)
+    b = bench._workload_sig("llm_decode_flash_str64_int8kv",
+                            dict(base, kv_int8=True))
+    c = bench._workload_sig("llm_decode_flash_str256",
+                            dict(base, streams=256))
+    assert a != b and a != c and b != c
+    # same workload under a differently-spelled key collapses
+    d = bench._workload_sig("llm_decode_flash", base)
+    assert a == d

@@ -134,6 +134,18 @@ TASK_KEYS = {
         "longctx_flash_train_mb1_seq1048576_packed", None),
     "longctx_seq1048576_packed_hp2": (
         "longctx_flash_train_mb1_seq1048576_packed_hp2", None),
+    # ISSUE 7: LLM continuous-decode rows (paged KV + flash_decode) —
+    # variant markers (kv_int8/head_pack/streams) ride in the rows so
+    # bench._workload_sig keys them apart; the int8-KV and hp2 rows
+    # land under their own keys next to the f32 rows (the re-key
+    # rule: a storage/layout flip must never read as a same-graph
+    # perf change)
+    "llm_decode_str64": ("llm_decode_flash_str64", None),
+    "llm_decode_str256": ("llm_decode_flash_str256", None),
+    "llm_decode_str64_int8kv": ("llm_decode_flash_str64_int8kv",
+                                None),
+    "llm_decode_str64_d64_hp2": ("llm_decode_flash_str64_d64_hp2",
+                                 None),
 }
 
 # primary key <- best (by LOWEST ms_per_batch) among these variant

@@ -12,7 +12,12 @@ iteration that the cluster completes and converges despite the faults.
 asserts the ISSUE 6 robustness contract — every admitted request
 answered exactly once (typed success or typed rejection, request-id
 accounting exact), the pool keeps serving through replica kills, and
-drain() leaves nothing silently dropped.
+drain() leaves nothing silently dropped.  Each serving iteration ALSO
+runs a DECODE iteration (ISSUE 7): ragged LLM decode streams through
+serving.DecodeServer under a seeded plan at the ``serving_decode``
+fault point — kill-mid-step replica failover must answer every
+admitted sequence exactly once AND leak zero KV pages (page
+accounting asserted after drain: free + in_use == pool, in_use == 0).
 
 Each iteration's plan is fully determined by its seed, so any failure
 replays exactly:
@@ -264,6 +269,90 @@ def run_serving_iteration(seed, rate, max_faults, timeout,
         return False, f"seed={seed}: {type(e).__name__}: {e}", 0
 
 
+def run_decode_iteration(seed, rate, max_faults, timeout,
+                         n_requests=24):
+    """One faulted continuous-decode run (ISSUE 7 acceptance shape):
+    seeded kill/drop/close/delay plan at ``serving_decode``, ragged
+    seeded prompts, every admitted sequence answered exactly once
+    (typed success or typed rejection), and ZERO KV-page leaks after
+    drain.  Returns (ok, detail, n_faults)."""
+    import numpy as np
+
+    from paddle_tpu import serving
+    from paddle_tpu.distributed import faultinject
+    from paddle_tpu.distributed.faultinject import FaultPlan
+
+    plan = FaultPlan(seed=seed, rate=rate,
+                     actions=("kill", "close", "drop", "delay=0.02",
+                              "delay=0.01+drop"),
+                     max_faults=max_faults)
+    rng = np.random.RandomState(seed)
+    deadline = time.monotonic() + timeout
+    try:
+        with faultinject.installed(plan) as inj:
+            srv = serving.DecodeServer(
+                config=serving.DecodeConfig(
+                    max_batch=4, max_new_tokens=8, page_size=16,
+                    num_pages=48, n_replicas=2,
+                    default_deadline_s=60.0,
+                    restart_dead=True)).start()
+            try:
+                futures, rejected = [], 0
+                for _ in range(n_requests):
+                    prompt = rng.randint(
+                        2, 128, size=int(rng.randint(1, 12)))
+                    try:
+                        futures.append(srv.submit(prompt))
+                    except serving.ServingError:
+                        rejected += 1
+                    time.sleep(0.002)
+                answered = 0
+                for f in futures:
+                    try:
+                        f.result(timeout=max(
+                            0.1, deadline - time.monotonic()))
+                    except serving.ServingError:
+                        pass    # typed rejection: answered, counted
+                    except TimeoutError:
+                        return (False, f"seed={seed}: decode request "
+                                f"{f.id} unanswered (silent drop?)",
+                                len(inj.log))
+                    answered += 1
+                leftovers = srv.stop()
+                st = srv.stats()
+                c = st["admission"]
+                pages_ok, pages_detail = srv.page_accounting()
+                if answered != len(futures):
+                    return (False, f"seed={seed}: decode answered "
+                            f"{answered}/{len(futures)}",
+                            len(inj.log))
+                if not st["accounted"] or st["outstanding"]:
+                    return (False, f"seed={seed}: decode accounting "
+                            f"broken {c} outstanding="
+                            f"{st['outstanding']}", len(inj.log))
+                if not pages_ok:
+                    return (False, f"seed={seed}: KV-PAGE LEAK: "
+                            f"{pages_detail}", len(inj.log))
+                for rep_st in st["replicas"].values():
+                    if rep_st["cache"]["in_use_pages"]:
+                        return (False, f"seed={seed}: pages still in "
+                                "use after drain: %r"
+                                % rep_st["cache"], len(inj.log))
+                if c["answered_ok"] == 0:
+                    return (False, f"seed={seed}: no decode request "
+                            "ever succeeded", len(inj.log))
+                if rejected + c["admitted"] != n_requests:
+                    return (False, f"seed={seed}: decode submit "
+                            f"accounting {rejected}+{c['admitted']} "
+                            f"!= {n_requests}", len(inj.log))
+                _ = leftovers
+                return True, "", len(inj.log)
+            finally:
+                srv.stop()
+    except Exception as e:   # noqa: BLE001 — verdict, not crash
+        return False, f"seed={seed}: {type(e).__name__}: {e}", 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="randomized chaos soak of a loopback PS cluster")
@@ -310,6 +399,15 @@ def main(argv=None):
         if args.mode == "serving":
             ok, detail, n_faults = run_serving_iteration(
                 seed, args.rate, args.max_faults, args.timeout)
+            # the decode half of the serving contract (ISSUE 7):
+            # same seed, its own plan over serving_decode
+            ok2, detail2, n_faults2 = run_decode_iteration(
+                seed, args.rate, args.max_faults, args.timeout)
+            n_faults += n_faults2
+            if not ok2:
+                ok = False
+                detail = (detail + "; " if detail else "") + \
+                    "decode: " + detail2
         else:
             ok, detail, n_faults = run_iteration(
                 seed, args.rate, args.max_faults, transport,

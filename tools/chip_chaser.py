@@ -33,6 +33,23 @@ BENCH = os.path.join(REPO, "bench.py")
 # sweep and anchors sit at the tail for fresh-results-file runs; int8
 # (the known 2026-07-31 tunnel-wedger) stays last.
 TASKS = [
+    # ---- PR-7 HEAD: LLM continuous decode (ISSUE 7) — the paged
+    # KV-cache + flash_decode step, tokens/s/chip + inter-token
+    # p50/p99 vs concurrent streams.  Decode is K/V-streaming bound:
+    # the rows carry kv_gb_per_step/kv_bw_pct, so the verdict is the
+    # achieved fraction of HBM BW (expect the int8-KV row ~2-4x the
+    # f32 tokens/s at the same stream count IF the row is BW-bound as
+    # modeled; head-pack targets the d64 half-idle-MXU regime like
+    # the hp2 flash legs).  Cross-lowered for Mosaic in CI before any
+    # window is spent (tools/tpu_lowering_check.py llm_decode*).
+    ("llm_decode_str64", "llm_decode", {"streams": 64, "chain": 32}),
+    ("llm_decode_str256", "llm_decode",
+     {"streams": 256, "chain": 32}, 3000),
+    ("llm_decode_str64_int8kv", "llm_decode",
+     {"streams": 64, "chain": 32, "kv_int8": True}),
+    ("llm_decode_str64_d64_hp2", "llm_decode",
+     {"streams": 64, "chain": 32, "head_dim": 64,
+      "head_pack": True}),
     # ---- PR-2 HEAD: flash memory-overhaul A/B legs (VERDICT r5
     # next-round #2/#3; ISSUE 2 acceptance).  All behind default-off
     # flags validated bit-parity in interpret mode + Mosaic

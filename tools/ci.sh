@@ -105,7 +105,8 @@ echo "== 7/8 TPU cross-lowering gate (Mosaic legality without a chip) =="
 # tests/test_tpu_lowering_gate.py, so only the rest run here.
 python tools/tpu_lowering_check.py \
   resnet50_train resnet50_train_convbnstats bert_train resnet50_infer \
-  resnet50_infer_int8_interlayer vgg16_infer longctx_train
+  resnet50_infer_int8_interlayer vgg16_infer longctx_train \
+  llm_decode llm_decode_d64_hp2 llm_decode_int8kv llm_decode_bf16
 
 echo "== 8/8 chaos soak (deterministic seed; both transports) =="
 # short fault-injection leg of the distributed stack: a seeded random

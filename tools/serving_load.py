@@ -20,6 +20,20 @@ Modes:
                        shape (shedding keeps admitted p99 within the
                        deadline while goodput stays >= 80% of
                        capacity)
+    --mode decode      ISSUE 7: open-loop RAGGED-length LLM decode
+                       streams (seeded geometric prompt-length
+                       distribution) through serving.DecodeServer —
+                       continuous decode batching over the paged
+                       KV-cache; reports tokens/s goodput and
+                       inter-token p99 NEXT TO the request-level rows,
+                       plus the zero-page-leak accounting verdict.
+
+Cold-start metrics (ROADMAP item 5): every mode's JSON line carries
+``time_to_first_batch_s`` (server start -> first completed request,
+measured on a cold probe BEFORE any warmup) and the batcher's
+bucket-cache ``bucket_cold``/``bucket_warm`` hit counts — run with
+PADDLE_TPU_COMPILE_CACHE_DIR set to see the persistent compilation
+cache turn the cold number warm across process restarts.
 
 Replayable: the arrival schedule is fully determined by --seed.
 """
@@ -81,14 +95,36 @@ def make_server(model_dir, replicas=1, max_batch=8, deadline_ms=250.0,
         queue_capacity=capacity, **cfg_kw)
     srv = serving.InferenceServer(factory, cfg).start()
     if warmup:
-        specs = srv.pool.replicas[0].predictor.feed_specs()
-        for rep in srv.pool.replicas:
-            for b in cfg.buckets:
-                feeds = [np.zeros((b,) + tuple(d for d in shape[1:]),
-                                  dtype=dtype)
-                         for shape, dtype in specs.values()]
-                rep.predictor.run(feeds)
+        warm_server(srv)
     return srv
+
+
+def warm_server(srv):
+    """Compile every (replica, bucket) entry (the pre-measurement
+    warmup make_server(warmup=True) runs)."""
+    import numpy as np
+
+    specs = srv.pool.replicas[0].predictor.feed_specs()
+    for rep in srv.pool.replicas:
+        for b in srv.config.buckets:
+            feeds = [np.zeros((b,) + tuple(d for d in shape[1:]),
+                              dtype=dtype)
+                     for shape, dtype in specs.values()]
+            rep.predictor.run(feeds)
+
+
+def probe_first_batch(srv, deadline_s=60.0):
+    """Cold-start metric (ROADMAP item 5): wall seconds from now (the
+    server is up, NOTHING compiled yet) to the first completed
+    request — dominated by the first bucket compile unless the
+    persistent compilation cache (PADDLE_TPU_COMPILE_CACHE_DIR) served
+    it from disk."""
+    import numpy as np
+
+    t0 = time.monotonic()
+    srv.infer({"x": np.zeros((1, _in_dim(srv)), np.float32)},
+              deadline_s=deadline_s, timeout=deadline_s)
+    return time.monotonic() - t0
 
 
 def _in_dim(srv):
@@ -197,6 +233,92 @@ def run_open_loop(srv, qps, seconds, seed=0, deadline_s=None):
     }
 
 
+def run_decode_open_loop(srv, qps, seconds, seed=0, deadline_s=None,
+                         mean_prompt=12, max_new=16):
+    """Seeded Poisson arrivals of RAGGED decode requests (geometric
+    prompt-length distribution, mean ``mean_prompt``) for ``seconds``;
+    returns the outcome/latency/token-goodput record."""
+    import numpy as np
+
+    from paddle_tpu import serving
+
+    rng = np.random.RandomState(int(seed))
+    vocab = srv.replicas[0].model.vocab
+    max_prompt = max(1, srv.config.page_size *
+                     (srv.config.num_pages // 2) - max_new)
+    inflight, outcomes = [], {"ok": 0}
+    tokens_ok = 0
+    t0 = time.monotonic()
+    next_t = t0
+    n_submitted = 0
+    while True:
+        now = time.monotonic()
+        if now - t0 >= seconds:
+            break
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.002))
+            continue
+        next_t += rng.exponential(1.0 / qps)
+        n_submitted += 1
+        plen = min(int(rng.geometric(1.0 / mean_prompt)), max_prompt)
+        prompt = rng.randint(2, vocab, size=max(1, plen))
+        try:
+            inflight.append(srv.submit(prompt, max_new_tokens=max_new,
+                                       deadline_s=deadline_s))
+        except (serving.ServingError, ValueError) as e:
+            code = getattr(e, "code", "invalid")
+            outcomes[code] = outcomes.get(code, 0) + 1
+    wall = time.monotonic() - t0
+    latencies = []
+    wait = (deadline_s or srv.config.default_deadline_s) + 10.0
+    for req in inflight:
+        try:
+            out, = req.result(timeout=wait)
+            outcomes["ok"] += 1
+            tokens_ok += len(out)
+            latencies.append(req.latency_s())
+        except serving.ServingError as e:
+            outcomes[e.code] = outcomes.get(e.code, 0) + 1
+            if req.latency_s() is not None:
+                latencies.append(req.latency_s())
+    lat_ms = sorted(1000.0 * v for v in latencies if v is not None)
+
+    def pct(p):
+        if not lat_ms:
+            return None
+        return lat_ms[min(len(lat_ms) - 1,
+                          int(p / 100.0 * len(lat_ms)))]
+
+    st = srv.stats()
+    it_p50, it_p99 = st["inter_token_p50_ms"], st["inter_token_p99_ms"]
+    pages_ok, pages_detail = srv.page_accounting()
+    return {
+        "offered_qps": round(n_submitted / wall, 1) if wall else 0.0,
+        "goodput_qps": round(outcomes["ok"] / wall, 1) if wall
+        else 0.0,
+        "tokens_per_sec": round(tokens_ok / wall, 1) if wall else 0.0,
+        "tokens_ok": tokens_ok,
+        "inter_token_p50_ms": round(it_p50, 3) if it_p50 else None,
+        "inter_token_p99_ms": round(it_p99, 3) if it_p99 else None,
+        "submitted": n_submitted,
+        "admitted": len(inflight),
+        "ok": outcomes["ok"],
+        "shed": outcomes.get("overloaded", 0),
+        "expired": outcomes.get("expired", 0),
+        "failed": outcomes.get("failed", 0),
+        "shutdown": outcomes.get("shutdown", 0),
+        "p50_ms": round(pct(50), 2) if lat_ms else None,
+        "p99_ms": round(pct(99), 2) if lat_ms else None,
+        "failed_over": st["decode"]["failovers"],
+        "preemptions": st["decode"]["preemptions"],
+        "accounted": st["accounted"],
+        "pages_accounted": pages_ok and not pages_detail,
+        "mean_prompt": mean_prompt,
+        "max_new": max_new,
+        "wall_s": round(wall, 2),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="seeded open-loop serving load generator")
@@ -208,7 +330,8 @@ def main(argv=None):
     ap.add_argument("--capacity", type=int, default=None,
                     help="admission queue capacity (default 4x batch)")
     ap.add_argument("--seed", type=int, default=7)
-    ap.add_argument("--mode", choices=["fixed", "overload2x"],
+    ap.add_argument("--mode",
+                    choices=["fixed", "overload2x", "decode"],
                     default="fixed")
     ap.add_argument("--capacity-seconds", type=float, default=1.0,
                     help="closed-loop capacity probe length "
@@ -216,19 +339,69 @@ def main(argv=None):
     ap.add_argument("--in-dim", type=int, default=8)
     ap.add_argument("--hidden", type=int, default=16)
     ap.add_argument("--depth", type=int, default=1)
+    ap.add_argument("--mean-prompt", type=int, default=12,
+                    help="decode mode: mean of the seeded geometric "
+                         "prompt-length distribution")
+    ap.add_argument("--max-new", type=int, default=16,
+                    help="decode mode: max generated tokens per "
+                         "request")
     args = ap.parse_args(argv)
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+    if args.mode == "decode":
+        from paddle_tpu import serving
+
+        srv = serving.DecodeServer(config=serving.DecodeConfig(
+            max_batch=args.max_batch, n_replicas=args.replicas,
+            max_new_tokens=args.max_new, page_size=16,
+            num_pages=16 * args.max_batch,
+            default_deadline_s=args.deadline_ms / 1000.0,
+            queue_capacity=args.capacity)).start()
+        try:
+            # cold first-token probe (1-token request, nothing
+            # compiled yet): the decode-side time_to_first_batch_s
+            t0 = time.monotonic()
+            srv.decode([2, 3, 4], max_new_tokens=1,
+                       deadline_s=60.0, timeout=60.0)
+            ttfb = time.monotonic() - t0
+            rec = run_decode_open_loop(
+                srv, args.qps, args.seconds, seed=args.seed,
+                deadline_s=args.deadline_ms / 1000.0,
+                mean_prompt=args.mean_prompt, max_new=args.max_new)
+        finally:
+            srv.stop()
+        rec.update({
+            "metric": "decode_tokens_per_sec",
+            "value": rec["tokens_per_sec"],
+            "unit": "tok/s",
+            "time_to_first_batch_s": round(ttfb, 3),
+            "bucket_cold": None, "bucket_warm": None,
+            "deadline_ms": args.deadline_ms,
+            "replicas": args.replicas,
+            "max_batch": args.max_batch,
+            "seed": args.seed,
+            "mode": args.mode,
+        })
+        print(json.dumps(rec))
+        return 0
+
     with tempfile.TemporaryDirectory() as d:
         mdir = build_model(d, in_dim=args.in_dim, hidden=args.hidden,
                            depth=args.depth)
         srv = make_server(mdir, replicas=args.replicas,
                           max_batch=args.max_batch,
                           deadline_ms=args.deadline_ms,
-                          capacity=args.capacity)
+                          capacity=args.capacity, warmup=False)
         try:
+            # cold-start metric FIRST (nothing compiled yet), then the
+            # usual full warmup so the measured run never pays a
+            # compile — with PADDLE_TPU_COMPILE_CACHE_DIR set, this
+            # number is the warm-disk replay of the bucket compile
+            ttfb = probe_first_batch(srv)
+            warm_server(srv)
             cap_qps = None
             qps = args.qps
             if args.mode == "overload2x":
@@ -240,6 +413,7 @@ def main(argv=None):
             rec = run_open_loop(srv, qps, args.seconds,
                                 seed=args.seed,
                                 deadline_s=args.deadline_ms / 1000.0)
+            bstats = srv.stats()["batcher"]
         finally:
             srv.stop()
     rec.update({
@@ -247,6 +421,9 @@ def main(argv=None):
         "value": rec["goodput_qps"],
         "unit": "req/s",
         "capacity_qps": round(cap_qps, 1) if cap_qps else None,
+        "time_to_first_batch_s": round(ttfb, 3),
+        "bucket_cold": bstats.get("bucket_cold"),
+        "bucket_warm": bstats.get("bucket_warm"),
         "deadline_ms": args.deadline_ms,
         "replicas": args.replicas,
         "max_batch": args.max_batch,

@@ -98,6 +98,22 @@ def _workloads():
         "resnet50_infer_int8_interlayer": lambda:
             bench._build_resnet50_infer_int8(
                 128, int8_activations=True)[:3],
+        # ISSUE 7: the paged-KV flash-decode step — scalar-prefetch
+        # block-table index maps, the (1, hpb, page_size, d) page
+        # blocks, the int8-page convert and the head-packed pairing
+        # are exactly the construct class Mosaic may reject while the
+        # interpret suite stays green; every variant flag cross-lowers
+        # here BEFORE the chaser spends a window on the decode legs
+        "llm_decode": lambda: bench._build_llm_decode(
+            streams=8, prefill_len=64, heads=8, head_dim=128,
+            page_size=128)[:3],
+        "llm_decode_d64_hp2": lambda: bench._build_llm_decode(
+            streams=8, prefill_len=64, heads=8, head_dim=64,
+            page_size=128, head_pack=True)[:3],
+        "llm_decode_int8kv": lambda: bench._build_llm_decode(
+            streams=8, prefill_len=64, heads=8, head_dim=128,
+            page_size=128, kv_int8=True)[:3],
+        "llm_decode_bf16": lambda: _llm_decode_bf16(bench),
         "resnet50_infer": lambda: _infer(bench, "resnet", 128),
         "vgg16_infer": lambda: _infer(bench, "vgg", 64),
         "vgg16_cifar_infer": lambda: _infer(bench, "vgg_cifar", 512),
@@ -105,6 +121,14 @@ def _workloads():
                                                512),
         "longctx_train": lambda: bench._build_longctx_train()[:3],
     }
+
+
+def _llm_decode_bf16(bench):
+    import jax.numpy as jnp
+
+    return bench._build_llm_decode(
+        streams=8, prefill_len=64, heads=8, head_dim=64,
+        page_size=128, dtype=jnp.bfloat16)[:3]
 
 
 def _infer(bench, which, batch, conv_epilogue=False):
