@@ -120,6 +120,13 @@ def _program_fingerprint(program):
 
     h = 0
     for b in program.blocks:
+        # sharding annotations change the jitted step's in/out
+        # NamedShardings (sharding_transpiler): an annotation edit must
+        # produce a different fingerprint exactly like an op edit
+        # (set_sharding bumps the mutation counter for the memo token)
+        for v in b.vars.values():
+            if v.sharding is not None:
+                h = hash((h, "__sharding__", v.name, v.sharding))
         for op in b.ops:
             h = hash((
                 h, op.type, op.stage,
@@ -577,6 +584,51 @@ class CompiledProgram:
         return self
 
     # -- execution --------------------------------------------------------------
+    def _state_named_sharding(self, name, shape):
+        """NamedSharding for one persistable var under the installed
+        sharding rule (replicated without one).  Shared by _build_fn's
+        declared in/out state shardings and _globalize's multi-process
+        state commit so the two can never disagree."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh
+        repl = NamedSharding(mesh, P())
+        if self._param_sharding_fn is None:
+            return repl
+        ps = self._param_sharding_fn(name, tuple(shape))
+        if ps is None:
+            # optimizer accumulators inherit the param's rule when
+            # their shape matches (longest param-name prefix wins)
+            for pn in sorted((v.name
+                              for v in self._program.all_parameters()),
+                             key=len, reverse=True):
+                if name != pn and name.startswith(pn + "_"):
+                    ps = self._param_sharding_fn(pn, tuple(shape))
+                    break
+        if ps is None:
+            return repl
+        spec_axes = tuple(ps)
+        if len(spec_axes) > len(shape):
+            raise ValueError(
+                f"sharding rule for '{name}': spec {ps} has more"
+                f" dims than shape {tuple(shape)}")
+        # refuse specs that don't divide the dims evenly
+        for dim, axes in zip(shape, spec_axes):
+            if axes is None:
+                continue
+            ax_list = axes if isinstance(axes, tuple) else (axes,)
+            n = 1
+            for a in ax_list:
+                if a not in mesh.shape:
+                    raise ValueError(
+                        f"sharding rule for '{name}': unknown mesh"
+                        f" axis '{a}' (mesh axes:"
+                        f" {tuple(mesh.axis_names)})")
+                n *= mesh.shape[a]
+            if dim % n != 0:
+                return repl
+        return NamedSharding(mesh, ps)
+
     @property
     def _persistable_names(self):
         return [v.name for v in self._program.persistables()
@@ -613,48 +665,9 @@ class CompiledProgram:
                                 *([None] * (len(spec.shape) - 1))))
                 return repl
 
-            param_names = sorted(
-                (v.name for v in program.all_parameters()),
-                key=len, reverse=True)
-
-            def state_shard(name, spec):
-                if self._param_sharding_fn is None:
-                    return repl
-                ps = self._param_sharding_fn(name, tuple(spec.shape))
-                if ps is None:
-                    # optimizer accumulators inherit the param's rule when
-                    # their shape matches (longest param-name prefix wins)
-                    for pn in param_names:
-                        if name != pn and name.startswith(pn + "_"):
-                            ps = self._param_sharding_fn(
-                                pn, tuple(spec.shape))
-                            break
-                if ps is None:
-                    return repl
-                spec_axes = tuple(ps)
-                if len(spec_axes) > len(spec.shape):
-                    raise ValueError(
-                        f"sharding rule for '{name}': spec {ps} has more"
-                        f" dims than shape {tuple(spec.shape)}")
-                # refuse specs that don't divide the dims evenly
-                for dim, axes in zip(spec.shape, spec_axes):
-                    if axes is None:
-                        continue
-                    ax_list = axes if isinstance(axes, tuple) else (axes,)
-                    n = 1
-                    for a in ax_list:
-                        if a not in mesh.shape:
-                            raise ValueError(
-                                f"sharding rule for '{name}': unknown mesh"
-                                f" axis '{a}' (mesh axes:"
-                                f" {tuple(mesh.axis_names)})")
-                        n *= mesh.shape[a]
-                    if dim % n != 0:
-                        return repl
-                return NamedSharding(mesh, ps)
-
-            state_sh = {k: state_shard(k, state_specs[k])
-                        for k in state_names}
+            state_sh = {k: self._state_named_sharding(
+                k, tuple(state_specs[k].shape))
+                for k in state_names}
             # multi-process: the committed arrays' ACTUAL shardings are
             # authoritative (one policy, decided in _globalize); the
             # shape-derived feed_shard is the single-process path
@@ -679,15 +692,15 @@ class CompiledProgram:
         each process holds its LOCAL shard of every feed; assemble
         global jax Arrays over the multi-host mesh via
         make_array_from_process_local_data.  State is process-local
-        full copies (identical across processes — same startup seed),
-        committed as replicated global arrays."""
+        full copies (identical across processes — same startup seed):
+        replicated state commits as replicated global arrays, and
+        under a sharding rule (ZeRO/TP/gspmd annotations) each process
+        carves its addressable shards out of its full copy via
+        make_array_from_callback — the multi-host half of the GSPMD
+        front-end (ROADMAP item 3)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        if self._param_sharding_fn is not None:
-            raise NotImplementedError(
-                "multi-process training with per-param sharding rules "
-                "is not wired yet; use replicated state (dp)")
         mesh = self._mesh
         pcount = jax.process_count()
         repl = NamedSharding(mesh, P())
@@ -722,8 +735,18 @@ class CompiledProgram:
             if isinstance(v, jax.Array) and not v.is_fully_addressable:
                 out_state[k] = v
                 continue
-            out_state[k] = jax.make_array_from_process_local_data(
-                repl, np.asarray(v))
+            arr = np.asarray(v)
+            sh = self._state_named_sharding(k, arr.shape) \
+                if self._param_sharding_fn is not None else repl
+            if sh.is_fully_replicated:
+                out_state[k] = jax.make_array_from_process_local_data(
+                    repl, arr)
+            else:
+                # sharded persistable: every process holds the full
+                # copy (identical startup seed / restored checkpoint);
+                # each commits only its addressable shards
+                out_state[k] = jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, a=arr: a[idx])
         return out_feeds, out_state
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
@@ -790,6 +813,28 @@ class CompiledProgram:
                                 state_specs,
                                 feed_shardings=feed_shardings)
             self._cache[key] = fn
+        if self._mesh is not None and not multiproc:
+            # conform COMMITTED state arrays to the declared
+            # in_shardings: jit auto-places uncommitted arrays but
+            # refuses a committed mismatch — e.g. a checkpoint
+            # restored right after the startup program lands whole on
+            # device 0 (the relaunched-trainer resume path), or the
+            # sharding rules changed between runs.  Expected
+            # shardings are cached per jit key; steady-state arrays
+            # (outputs of the previous step) already match and skip
+            # the device_put.
+            skey = ("__state_sh__",) + key
+            expect = self._cache.get(skey)
+            if expect is None:
+                expect = {k: self._state_named_sharding(
+                    k, np.shape(v)) for k, v in state.items()}
+                self._cache[skey] = expect
+            for k, sh in expect.items():
+                v = state[k]
+                if isinstance(v, jax.Array) and \
+                        getattr(v, "committed", False) and \
+                        not sh.is_equivalent_to(v.sharding, v.ndim):
+                    state[k] = jax.device_put(v, sh)
         new_state, fetches = fn(state, feeds)
         for k, v in new_state.items():
             scope.var(k).set(v)

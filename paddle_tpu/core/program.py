@@ -85,6 +85,36 @@ class BlockRef:
         return isinstance(other, BlockRef) and other.idx == self.idx
 
 
+def _normalize_sharding(spec):
+    """Canonical annotation form: a tuple over dims whose entries are
+    None, a str axis name, or a tuple of str axis names — so a
+    to_dict/from_dict round-trip (JSON turns tuples into lists) and a
+    live annotation compare equal."""
+    if spec is None:
+        return None
+    try:
+        from jax.sharding import PartitionSpec as _P
+
+        if isinstance(spec, _P):
+            spec = tuple(spec)
+    except ImportError:
+        pass
+    out = []
+    for entry in spec:
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        elif isinstance(entry, (list, tuple)):
+            if not all(isinstance(a, str) for a in entry):
+                raise ValueError(
+                    f"sharding entry {entry!r}: axis names must be str")
+            out.append(tuple(entry))
+        else:
+            raise ValueError(
+                f"sharding entry {entry!r}: expected None, an axis "
+                "name, or a tuple of axis names")
+    return tuple(out)
+
+
 class VarDesc:
     """A named variable in a block; doubles as the Python front-end handle
     (reference keeps VarDesc and python Variable separate; we fuse them)."""
@@ -110,11 +140,26 @@ class VarDesc:
         self.stop_gradient = stop_gradient
         self.trainable = trainable
         self.is_data = is_data
-        # optional sharding annotation: PartitionSpec-like tuple of axis names
+        # optional sharding annotation: PartitionSpec-like tuple, one
+        # entry per dim — None (replicated), an axis name, or a tuple
+        # of axis names (a dim sharded over several mesh axes, e.g.
+        # ZeRO-3 dp on top of a tp row split).  Set via set_sharding so
+        # compiled-program fingerprints see the edit; consumed by
+        # transpiler.sharding_transpiler (docs/GSPMD.md).
         self.sharding = None
         # error-clip attr: clips this var's upstream error gradient the
         # moment append_backward produces it (reference clip.py:42)
         self.error_clip = None
+
+    def set_sharding(self, spec):
+        """Annotate this var with a PartitionSpec-like tuple (one entry
+        per dim: None | axis name | tuple of axis names), or None to
+        clear.  Goes through the IR mutation counter so an annotation
+        edit after a compile invalidates the jit cache the same way an
+        op edit does (compiler._program_fingerprint hashes both)."""
+        self.sharding = _normalize_sharding(spec)
+        _bump_ir_mutation()
+        return self
 
     def _set_error_clip(self, clip):
         """Reference framework.py Variable._set_error_clip."""
@@ -194,7 +239,9 @@ class VarDesc:
             "stop_gradient": self.stop_gradient,
             "trainable": self.trainable,
             "is_data": self.is_data,
-            "sharding": list(self.sharding) if self.sharding else None,
+            "sharding": [list(e) if isinstance(e, tuple) else e
+                         for e in self.sharding]
+            if self.sharding else None,
         }
 
     @staticmethod
@@ -211,7 +258,7 @@ class VarDesc:
             is_data=d.get("is_data", False),
         )
         if d.get("sharding"):
-            v.sharding = tuple(d["sharding"])
+            v.sharding = _normalize_sharding(d["sharding"])
         return v
 
 

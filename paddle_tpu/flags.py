@@ -168,6 +168,22 @@ define_flag("kv_int8", False,
             "decode-step K/V streaming traffic.  Accuracy asserted "
             "against the f32 KV path (top-1 agreement, "
             "tests/test_decode.py; docs/DECODE.md accuracy bar)")
+define_flag("gspmd", False,
+            "GSPMD pod-scale front-end (ISSUE 8): False = the "
+            "validated per-module parallelism paths (default, zero "
+            "behavior change — shard_program() is a no-op and the "
+            "compiled step is bit-identical to never calling it, "
+            "asserted in tests/test_gspmd.py); True = "
+            "transpiler.shard_program(plan) maps per-var "
+            "PartitionSpec annotations on the Program IR to "
+            "NamedShardings over a dp/tp/pp MeshPlan and emits ONE "
+            "jitted train step (jax.jit with in/out shardings — the "
+            "modern pjit) covering fwd+bwd+optimizer: ZeRO-3 is a "
+            "parameter/optimizer-state sharding spec (params sharded "
+            "on dp, gathered by the XLA SPMD partitioner), tensor "
+            "parallelism is tp PartitionSpecs on the existing layers, "
+            "and flash attention runs under shard_map on the same "
+            "mesh (docs/GSPMD.md)")
 define_flag("int8_conv_algo", "conv",
             "conv2d_int8 lowering: 'conv' = integer "
             "conv_general_dilated; 'im2col' = pad/slice/concat + one "

@@ -1127,16 +1127,64 @@ def flash_decode(q, k_pages, v_pages, block_tables, seq_lens, *,
 from paddle_tpu.core.registry import register_op  # noqa: E402
 
 
+def _gspmd_flash_shard_map(attrs, q, k, v, call):
+    """GSPMD front-end hook (parallel/gspmd.py tag_attention_ops):
+    when the typed `gspmd` flag is on and the op carries
+    gspmd_batch_axis / gspmd_head_axis attrs, run the kernel under
+    shard_map on the current mesh — Mosaic kernels can't ride XLA's
+    automatic partitioner, and attention is independent per
+    (batch, head) row so the dp x tp split is exact.  Any gate failing
+    (flag off, no mesh, axis missing, dim not divisible, axis size 1)
+    returns None and the caller runs the plain single-program path —
+    the same geometric-fallback spirit as the packed-stats gate."""
+    from paddle_tpu.flags import get_flag
+
+    if not get_flag("gspmd"):
+        return None
+    ba = attrs.get("gspmd_batch_axis") or None
+    ha = attrs.get("gspmd_head_axis") or None
+    if not (ba or ha):
+        return None
+    from paddle_tpu.parallel import env as penv
+
+    mesh = penv.get_mesh()
+    if mesh is None:
+        return None
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    bsz, hsz = q.shape[0], q.shape[1]
+    if ba and (sizes.get(ba, 1) <= 1 or bsz % sizes.get(ba, 1) != 0):
+        ba = None
+    if ha and (sizes.get(ha, 1) <= 1 or hsz % sizes.get(ha, 1) != 0):
+        ha = None
+    if not (ba or ha):
+        return None
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(ba, ha, None, None)
+    f = penv.shard_map(call, mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return f(q, k, v)
+
+
 @register_op("flash_attention", inputs=("Q", "K", "V"), outputs=("Out",),
              attrs={"causal": False, "scale": 0.0, "block_q": 0,
-                    "block_k": 0})
+                    "block_k": 0, "gspmd_batch_axis": "",
+                    "gspmd_head_axis": ""})
 def _flash_attention_op(ins, attrs):
     scale = attrs.get("scale") or None
-    return {"Out": flash_attention(ins["Q"], ins["K"], ins["V"],
-                                   causal=bool(attrs.get("causal")),
-                                   scale=scale,
-                                   block_q=attrs.get("block_q") or None,
-                                   block_k=attrs.get("block_k") or None)}
+
+    def call(q, k, v):
+        return flash_attention(q, k, v,
+                               causal=bool(attrs.get("causal")),
+                               scale=scale,
+                               block_q=attrs.get("block_q") or None,
+                               block_k=attrs.get("block_k") or None)
+
+    out = _gspmd_flash_shard_map(attrs, ins["Q"], ins["K"], ins["V"],
+                                 call)
+    if out is None:
+        out = call(ins["Q"], ins["K"], ins["V"])
+    return {"Out": out}
 
 
 @register_op("flash_decode",

@@ -56,6 +56,14 @@ class Optimizer:
         block = param.block.program.global_block()
         v = block.create_var(name=var_name, shape=shape, dtype=dtype,
                              persistable=True, stop_gradient=True)
+        # GSPMD annotations (parallel/gspmd.py): a same-shaped
+        # accumulator shards exactly like its parameter — ZeRO's
+        # "optimizer state lives with the param shard" falls out of
+        # copying the spec (beta-pow style [1] accumulators keep their
+        # own shape and stay replicated)
+        if getattr(param, "sharding", None) is not None and \
+                list(shape) == list(param.shape or ()):
+            v.set_sharding(param.sharding)
         sb = default_startup_program().global_block()
         sv = sb.create_var(name=var_name, shape=shape, dtype=dtype,
                            persistable=True)

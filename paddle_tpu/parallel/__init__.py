@@ -29,3 +29,11 @@ from paddle_tpu.parallel.zero import (
     is_optimizer_accumulator,
     zero_sharding_rules,
 )
+from paddle_tpu.parallel.gspmd import (
+    MeshPlan,
+    annotate_tp_transformer,
+    annotate_var,
+    annotate_zero3,
+    partition_spec_of,
+    tag_attention_ops,
+)

@@ -43,7 +43,8 @@ class ServingConfig:
                  n_replicas=2, dispatch_capacity=None,
                  breaker_threshold=3, breaker_cooldown_s=0.5,
                  health_interval_s=None, restart_dead=True,
-                 max_batch_attempts=None, drain_timeout_s=30.0):
+                 max_batch_attempts=None, drain_timeout_s=30.0,
+                 prewarm=None):
         self.max_batch = int(max_batch)
         self.buckets = tuple(buckets) if buckets is not None \
             else default_buckets(self.max_batch)
@@ -63,6 +64,25 @@ class ServingConfig:
         self.restart_dead = bool(restart_dead)
         self.max_batch_attempts = max_batch_attempts
         self.drain_timeout_s = float(drain_timeout_s)
+        # cold-start follow-through (ROADMAP item 5): compile every
+        # (replica, bucket) entry at start() so the first real request
+        # never pays a bucket compile.  With the persistent
+        # compilation cache (PADDLE_TPU_COMPILE_CACHE_DIR) the prewarm
+        # replays compiles from disk — seconds instead of the
+        # first-compile minutes — which is why the default is
+        # "prewarm iff the cache dir is set": without it, prewarm
+        # still helps p99 but moves the full compile cost to startup.
+        # PADDLE_TPU_SERVING_PREWARM=0/1 overrides.
+        if prewarm is None:
+            import os
+
+            env = os.environ.get("PADDLE_TPU_SERVING_PREWARM")
+            if env is not None:
+                prewarm = env.lower() in ("1", "true", "yes", "on")
+            else:
+                prewarm = bool(
+                    os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR"))
+        self.prewarm = bool(prewarm)
 
 
 class InferenceServer:
@@ -104,8 +124,31 @@ class InferenceServer:
             return self
         self._started = True
         self.pool.start()
+        if self.config.prewarm:
+            self.prewarm_buckets()
         self._sup.start()
         return self
+
+    def prewarm_buckets(self):
+        """Run a zeros batch of every bucket size through every
+        replica's predictor, so the full serving bucket set is
+        compiled (or replayed from PADDLE_TPU_COMPILE_CACHE_DIR)
+        BEFORE the first request arrives — the replica-start half of
+        the cold-start story (docs/SERVING.md; tools/serving_load.py
+        banks the resulting warm-vs-cold time_to_first_batch_s pair).
+        Returns the number of (replica, bucket) entries warmed."""
+        import numpy as np
+
+        n = 0
+        for rep in self.pool.replicas:
+            specs = rep.predictor.feed_specs()
+            for b in self.config.buckets:
+                feeds = [np.zeros((int(b),) + tuple(
+                    int(d) for d in shape[1:]), dtype=dtype)
+                    for shape, dtype in specs.values()]
+                rep.predictor.run(feeds)
+                n += 1
+        return n
 
     def __enter__(self):
         return self.start()

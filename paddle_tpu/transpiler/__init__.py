@@ -18,3 +18,5 @@ from paddle_tpu.transpiler.memory_optimization_transpiler import (  # noqa: F401
     memory_optimize, release_memory)
 from paddle_tpu.transpiler.ps_dispatcher import (HashName,  # noqa: F401
                                                  PSDispatcher, RoundRobin)
+from paddle_tpu.transpiler.sharding_transpiler import (  # noqa: F401
+    ShardingTranspiler, shard_program)

@@ -1,0 +1,117 @@
+"""Sharding transpiler: annotated Program IR -> ONE pjit train step.
+
+The execution half of the GSPMD front-end (parallel/gspmd.py holds the
+MeshPlan + annotation passes; docs/GSPMD.md the contract):
+
+  ``shard_program(compiled, plan, loss_name=...)`` maps the program's
+  per-var PartitionSpec annotations to ``NamedSharding`` over the
+  plan's mesh and installs them on the CompiledProgram, whose
+  ``_build_fn`` then emits ONE ``jax.jit`` step with in/out shardings
+  (the modern pjit) covering fwd+bwd+optimizer: feeds batch-shard over
+  dp, ZeRO-3 params/optimizer state shard per annotation and the XLA
+  SPMD partitioner inserts every gather/reduce-scatter, tensor-parallel
+  weights split per their tp specs, flash_attention runs under
+  shard_map via the attrs ``tag_attention_ops`` stamped.
+
+Gated by the typed ``gspmd`` flag (default off): flag-off,
+``shard_program`` returns the CompiledProgram UNTOUCHED — no mesh, no
+annotations, no attrs — so the compiled step is bit-identical to never
+calling it (asserted in tests/test_gspmd.py).
+
+Reference analog: DistributeTranspiler rewrites the program into
+PS/collective graphs; this transpiler instead leaves the op graph
+alone and attaches a mesh plan the compiler consumes — the
+"sharding-annotation path on the Program IR" of ROADMAP item 3.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.parallel.gspmd import (MeshPlan, annotate_tp_transformer,
+                                       annotate_zero3, partition_spec_of,
+                                       tag_attention_ops)
+
+__all__ = ["ShardingTranspiler", "shard_program"]
+
+
+class ShardingTranspiler:
+    """Two-phase pass: ``transpile(program)`` writes the annotations
+    (ZeRO-3 + transformer tp + attention shard_map tags), ``apply``
+    installs mesh + rules on a CompiledProgram.  Pre-annotated
+    programs (hand specs, deserialized programs) can skip transpile
+    and go straight to apply."""
+
+    def __init__(self, plan: MeshPlan):
+        if not isinstance(plan, MeshPlan):
+            raise TypeError(f"plan must be a MeshPlan, got {plan!r}")
+        self.plan = plan
+        self.summary = {}
+
+    def transpile(self, program, zero3=True, tp=True,
+                  tag_attention=True, min_size=2 ** 12):
+        """Annotate ``program`` per the plan; returns a summary dict
+        ({"zero3": [...], "tp": {...}, "attention_ops": N}).  Honors
+        the gspmd flag: off -> no-op (the flag-off program must stay
+        byte-identical)."""
+        from paddle_tpu.flags import get_flag
+
+        if not get_flag("gspmd"):
+            self.summary = {"enabled": False}
+            return self.summary
+        summary = {"enabled": True, "zero3": [], "tp": {},
+                   "attention_ops": 0}
+        if tp:
+            summary["tp"] = annotate_tp_transformer(program, self.plan)
+        if zero3:
+            # after tp so ZeRO composes onto the tp layout's free dims
+            summary["zero3"] = annotate_zero3(
+                program, self.plan, min_size=min_size,
+                axis=self.plan.data_axis)
+        if tag_attention:
+            summary["attention_ops"] = tag_attention_ops(
+                program, self.plan)
+        self.summary = summary
+        return summary
+
+    def sharding_rules(self, program):
+        """var-name -> PartitionSpec rule (CompiledProgram
+        .with_sharding_rules shape) backed by the IR annotations —
+        zero.py's rule CLOSURE becomes data on the program."""
+        plan = self.plan
+
+        def rule(name, shape):
+            for block in program.blocks:
+                var = block.vars.get(name)
+                if var is not None:
+                    return partition_spec_of(var, plan, shape=shape)
+            return None
+
+        return rule
+
+    def apply(self, compiled, loss_name=None, devices=None):
+        """Install the plan's mesh + the annotation-backed rules on a
+        CompiledProgram; its next run jits the one sharded step."""
+        mesh = self.plan.build_mesh(devices=devices)
+        compiled.with_data_parallel(loss_name=loss_name, mesh=mesh)
+        compiled._data_axis = self.plan.data_axis
+        compiled.with_sharding_rules(
+            self.sharding_rules(compiled._program), mesh=mesh)
+        return compiled
+
+
+def shard_program(compiled, plan, loss_name=None, zero3=True, tp=True,
+                  tag_attention=True, min_size=2 ** 12, devices=None,
+                  annotate=True):
+    """The one-call form: annotate ``compiled``'s program per ``plan``
+    and install mesh + shardings.  Behind the typed ``gspmd`` flag —
+    flag-off this returns ``compiled`` untouched (bit-parity
+    contract).  ``annotate=False`` applies a pre-annotated program
+    as-is (e.g. specs carried through serialization)."""
+    from paddle_tpu.flags import get_flag
+
+    if not get_flag("gspmd"):
+        return compiled
+    t = ShardingTranspiler(plan)
+    if annotate:
+        t.transpile(compiled._program, zero3=zero3, tp=tp,
+                    tag_attention=tag_attention, min_size=min_size)
+    return t.apply(compiled, loss_name=loss_name, devices=devices)

@@ -34,3 +34,32 @@ def test_two_host_bench_reports_per_host_throughput():
     # global rate is 2x its local rate; rounding gives +-0.3 slack)
     expect = min(2 * h["host_examples_per_sec"] for h in per_host)
     assert abs(summary["examples_per_sec"] - expect) <= 0.3
+
+
+def test_gspmd_simulated_hosts_smoke():
+    """--mode gspmd --simulate-hosts (ISSUE 8): the sharded pjit step
+    over the virtual mesh partitioned into 2 device groups emits ONE
+    JSON line with per-host + global MFU (the ci.sh step 4b
+    contract).  The spawn path needs cross-process collectives this
+    container's CPU backend lacks — same env gate as the dp test."""
+    import math
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "bench_multihost.py"),
+         "--mode", "gspmd", "--simulate-hosts", "2",
+         "--devices-per-host", "4", "--batch-per-host", "8",
+         "--steps", "2", "--warmup", "1"],
+        capture_output=True, text=True, timeout=540, cwd=ROOT)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, "must be exactly ONE JSON line"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "multihost_gspmd_train"
+    assert rec["simulated_hosts"] is True
+    assert rec["hosts"] == 2 and rec["global_devices"] == 8
+    assert rec["dp"] == 4 and rec["tp"] == 2
+    assert rec["mfu_pct"] > 0 and rec["tokens_per_sec"] > 0
+    assert math.isfinite(rec["loss"])
+    assert len(rec["per_host"]) == 2
+    assert all(h["host_mfu_pct"] > 0 for h in rec["per_host"])

@@ -72,6 +72,17 @@ TASK_KEYS = {
         "transformer_base_train_mb128_fusedadam", None),
     "tf_train_mb32_fusedadam": (
         "transformer_base_train_mb32_fusedadam", None),
+    # ISSUE 8: the gspmd pjit-sharded transformer step (flag `gspmd`,
+    # transpiler.shard_program).  Rows carry gspmd/dp/tp/devices
+    # markers for bench._workload_sig — a mesh-plan flip must never
+    # read as a same-graph perf change.  On the 1-chip tunnel these
+    # price the gspmd compile path vs the plain tf_train rows
+    # (expect ~parity); a multi-chip window banks the real dp x tp
+    # fleet-MFU row.  Flip no default before banking.
+    "tf_train_gspmd_mb32": (
+        "transformer_base_train_gspmd_mb32", None),
+    "tf_train_gspmd_mb64": (
+        "transformer_base_train_gspmd_mb64", None),
     # DeepFM roofline re-key (VERDICT r5 #7): same primary key — the
     # re-banked row carries mfu_pct/hbm_bw_pct so the CTR leg is
     # judged like the others

@@ -33,6 +33,23 @@ BENCH = os.path.join(REPO, "bench.py")
 # sweep and anchors sit at the tail for fresh-results-file runs; int8
 # (the known 2026-07-31 tunnel-wedger) stays last.
 TASKS = [
+    # ---- PR-8 HEAD: GSPMD pjit train step (ISSUE 8) — the whole
+    # transformer fwd+bwd+Adam as ONE jit with in/out NamedShardings
+    # over a dp x tp mesh (ZeRO-3 + Megatron tp as PartitionSpecs,
+    # flash under shard_map; transpiler.shard_program, flag `gspmd`).
+    # On the single-chip tunnel the mesh degrades to 1 device — the
+    # row then prices the gspmd COMPILE PATH (annotation rules +
+    # shard_map wrapping) against the banked tf_train rows at the
+    # same shape: expectation ~parity at mb32 (the A/B that clears
+    # the flag for multi-chip windows); a multi-chip window banks the
+    # real dp x tp MFU row.  Off-chip evidence is already banked
+    # (CPU-mesh allclose parity + Mosaic cross-lowering of the
+    # sharded step + simulated-hosts smoke in CI).  Flip no default
+    # before banking.
+    ("tf_train_gspmd_mb32", "tf_train_gspmd",
+     {"batch": 32, "chain": 15}),
+    ("tf_train_gspmd_mb64", "tf_train_gspmd",
+     {"batch": 64, "chain": 10}),
     # ---- PR-7 HEAD: LLM continuous decode (ISSUE 7) — the paged
     # KV-cache + flash_decode step, tokens/s/chip + inter-token
     # p50/p99 vs concurrent streams.  Decode is K/V-streaming bound:

@@ -29,6 +29,38 @@ import jax; jax.config.update('jax_platforms','cpu')
 import __graft_entry__ as ge; ge.dryrun_multichip(8)
 print('dryrun_multichip(8) OK')"
 
+echo "== 4b/8 gspmd simulated-hosts smoke (one pjit step, dp x tp mesh) =="
+# ISSUE 8: the sharded train step over the virtual mesh partitioned
+# into 2 simulated hosts (dryrun_multichip style — this container's
+# CPU backend cannot execute true multi-process computations, same
+# reason the multihost dp test is environment-gated).  Gates the
+# one-JSON-line contract with per-host + global MFU; the same worker
+# path runs real jax.distributed fleets on pods.
+JAX_PLATFORMS=cpu python tools/bench_multihost.py --mode gspmd \
+  --simulate-hosts 2 --devices-per-host 4 --batch-per-host 8 \
+  --steps 3 --warmup 1 > /tmp/_gspmd_smoke.json
+cat /tmp/_gspmd_smoke.json
+python - <<'PY'
+import json
+lines = [ln for ln in open("/tmp/_gspmd_smoke.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, (
+    "gspmd smoke stdout must be exactly ONE JSON line — got %d"
+    % len(lines))
+rec = json.loads(lines[0])
+missing = {"metric", "value", "unit", "mfu_pct", "tokens_per_sec",
+           "hosts", "dp", "tp", "per_host", "loss"} - set(rec)
+assert not missing, "gspmd smoke JSON missing fields: %s" % (
+    sorted(missing),)
+assert rec["metric"] == "multihost_gspmd_train"
+assert len(rec["per_host"]) == rec["hosts"] == 2
+assert all("host_mfu_pct" in h for h in rec["per_host"])
+import math
+assert math.isfinite(rec["loss"]), rec["loss"]
+print("gspmd smoke OK: dp=%s tp=%s mfu=%s%%"
+      % (rec["dp"], rec["tp"], rec["mfu_pct"]))
+PY
+
 echo "== 5/8 benchmark (real chip if attached; tiny CPU run otherwise) =="
 # CI keeps the TPU probe short; the 15-min retry budget is for real
 # bench rounds (driver invocation), not the validation matrix.
@@ -106,7 +138,8 @@ echo "== 7/8 TPU cross-lowering gate (Mosaic legality without a chip) =="
 python tools/tpu_lowering_check.py \
   resnet50_train resnet50_train_convbnstats bert_train resnet50_infer \
   resnet50_infer_int8_interlayer vgg16_infer longctx_train \
-  llm_decode llm_decode_d64_hp2 llm_decode_int8kv llm_decode_bf16
+  llm_decode llm_decode_d64_hp2 llm_decode_int8kv llm_decode_bf16 \
+  transformer_train_gspmd
 
 echo "== 8/8 chaos soak (deterministic seed; both transports) =="
 # short fault-injection leg of the distributed stack: a seeded random

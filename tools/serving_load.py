@@ -33,7 +33,12 @@ Cold-start metrics (ROADMAP item 5): every mode's JSON line carries
 measured on a cold probe BEFORE any warmup) and the batcher's
 bucket-cache ``bucket_cold``/``bucket_warm`` hit counts — run with
 PADDLE_TPU_COMPILE_CACHE_DIR set to see the persistent compilation
-cache turn the cold number warm across process restarts.
+cache turn the cold number warm across process restarts.  The
+fixed/overload modes additionally bank the warm-vs-cold PAIR:
+``time_to_first_batch_cold_s`` (no prewarm) next to
+``time_to_first_batch_warm_s`` (a second server with
+ServingConfig(prewarm=True) — the full bucket set compiled/replayed
+at replica start before the probe).
 
 Replayable: the arrival schedule is fully determined by --seed.
 """
@@ -378,6 +383,8 @@ def main(argv=None):
             "value": rec["tokens_per_sec"],
             "unit": "tok/s",
             "time_to_first_batch_s": round(ttfb, 3),
+            "time_to_first_batch_cold_s": round(ttfb, 3),
+            "time_to_first_batch_warm_s": None,
             "bucket_cold": None, "bucket_warm": None,
             "deadline_ms": args.deadline_ms,
             "replicas": args.replicas,
@@ -394,12 +401,15 @@ def main(argv=None):
         srv = make_server(mdir, replicas=args.replicas,
                           max_batch=args.max_batch,
                           deadline_ms=args.deadline_ms,
-                          capacity=args.capacity, warmup=False)
+                          capacity=args.capacity, warmup=False,
+                          prewarm=False)
         try:
-            # cold-start metric FIRST (nothing compiled yet), then the
-            # usual full warmup so the measured run never pays a
-            # compile — with PADDLE_TPU_COMPILE_CACHE_DIR set, this
-            # number is the warm-disk replay of the bucket compile
+            # cold-start metric FIRST (nothing compiled yet,
+            # prewarm=False so the env can't warm it behind our
+            # back), then the usual full warmup so the measured run
+            # never pays a compile — with PADDLE_TPU_COMPILE_CACHE_DIR
+            # set, this number is the warm-disk replay of the bucket
+            # compile
             ttfb = probe_first_batch(srv)
             warm_server(srv)
             cap_qps = None
@@ -416,12 +426,29 @@ def main(argv=None):
             bstats = srv.stats()["batcher"]
         finally:
             srv.stop()
+        # the WARM half of the cold-start pair (ROADMAP item 5): a
+        # SECOND server over the same model with prewarm=True — every
+        # (replica, bucket) entry compiled (or replayed from
+        # PADDLE_TPU_COMPILE_CACHE_DIR) at replica start — then the
+        # same first-request probe.  warm << cold is the banked
+        # evidence that replica start absorbs the bucket compiles.
+        srv2 = make_server(mdir, replicas=args.replicas,
+                           max_batch=args.max_batch,
+                           deadline_ms=args.deadline_ms,
+                           capacity=args.capacity, warmup=False,
+                           prewarm=True)
+        try:
+            ttfb_warm = probe_first_batch(srv2)
+        finally:
+            srv2.stop()
     rec.update({
         "metric": "serving_goodput",
         "value": rec["goodput_qps"],
         "unit": "req/s",
         "capacity_qps": round(cap_qps, 1) if cap_qps else None,
         "time_to_first_batch_s": round(ttfb, 3),
+        "time_to_first_batch_cold_s": round(ttfb, 3),
+        "time_to_first_batch_warm_s": round(ttfb_warm, 3),
         "bucket_cold": bstats.get("bucket_cold"),
         "bucket_warm": bstats.get("bucket_warm"),
         "deadline_ms": args.deadline_ms,
