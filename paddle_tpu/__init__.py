@@ -44,6 +44,7 @@ from paddle_tpu import metrics
 from paddle_tpu import nets
 from paddle_tpu import unique_name
 from paddle_tpu import parallel
+from paddle_tpu import observability
 from paddle_tpu import profiler
 from paddle_tpu import dygraph
 from paddle_tpu import contrib

@@ -41,6 +41,12 @@ import jax.numpy as jnp
 
 from paddle_tpu.concurrency import BoundedQueue, Supervisor
 from paddle_tpu.distributed.rpc import global_rpc_client
+from paddle_tpu.observability import metrics as _obs_metrics
+
+_M_EVENTS = _obs_metrics.counter(
+    "paddle_tpu_communicator_events_total",
+    "async-communicator transitions (grads_sent / recv_rounds / "
+    "flush_errors), by event")
 
 
 class Communicator:
@@ -113,6 +119,7 @@ class Communicator:
             client.send_var(self._t.endpoints[i], gsec,
                             np.ascontiguousarray(part),
                             trainer_idx=int(self._t.trainer_id))
+        _M_EVENTS.inc(event="grads_sent")
 
     def _flush(self):
         """Drain EVERY queued grad (not just one merge window per var):
@@ -130,6 +137,7 @@ class Communicator:
                     # this var (the remaining items would fail the same
                     # way), keep flushing the others
                     self._sup.report_error("flush", e)
+                    _M_EVENTS.inc(event="flush_errors")
                     break
 
     def _send_loop(self):
@@ -170,4 +178,5 @@ class Communicator:
                 val = parts[0] if len(parts) == 1 else \
                     np.concatenate(parts, axis=0)
                 self._scope.var(pname).set(jnp.asarray(val))
+            _M_EVENTS.inc(event="recv_rounds")
             time.sleep(self._recv_interval)

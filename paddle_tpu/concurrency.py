@@ -28,7 +28,17 @@ import queue
 import threading
 import time
 
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _obs_metrics
+
 __all__ = ["BoundedQueue", "Supervisor"]
+
+_M_RESTARTS = _obs_metrics.counter(
+    "paddle_tpu_supervisor_restarts_total",
+    "supervised worker restarts, by worker name", max_series=128)
+_M_WORKER_ERRORS = _obs_metrics.counter(
+    "paddle_tpu_supervisor_worker_errors_total",
+    "exceptions escaped from supervised worker loops", max_series=128)
 
 
 class BoundedQueue:
@@ -153,6 +163,9 @@ class Supervisor:
                 fn()
             except Exception as e:   # report, never die silently
                 self._errors.put((name, e))
+                _M_WORKER_ERRORS.inc(worker=name)
+                _flight.record("supervisor", "worker_error",
+                               worker=name, error=repr(e)[:200])
 
         th = threading.Thread(target=guarded, daemon=True)
         th.start()
@@ -171,5 +184,8 @@ class Supervisor:
                     if not self._running:
                         return
                     self._restarts[name] = n + 1
+                    _M_RESTARTS.inc(worker=name)
+                    _flight.record("supervisor", "restart",
+                                   worker=name, n=n + 1)
                     self._spawn(name, fn)
             time.sleep(self._poll)

@@ -30,6 +30,18 @@ import numpy as np
 from paddle_tpu.core.program import BlockRef, Program
 from paddle_tpu.core.registry import get_op_def, has_op_def
 from paddle_tpu.core.scope import Scope
+from paddle_tpu.observability import flight_recorder as _obs_flight
+from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability import tracing as _obs_trace
+
+# executor observability (ISSUE 9): per-step wall time + compile
+# events ride the process registry next to the serving/rpc instruments
+_M_STEP_SECONDS = _obs_metrics.histogram(
+    "paddle_tpu_executor_step_seconds",
+    "compiled-program step wall time (dispatch, not device-sync)")
+_M_COMPILES = _obs_metrics.counter(
+    "paddle_tpu_executor_compiles_total",
+    "CompiledProgram jit-cache misses (trace+compile entries built)")
 
 # host-only op types silently skipped when tracing (IO/readers run outside
 # the compiled step, like the reference's feed/fetch special handling)
@@ -809,9 +821,22 @@ class CompiledProgram:
                           for k, v in feeds.items()}
             state_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                           for k, v in state.items()}
-            fn = self._build_fn(list(feeds), feed_specs, fetch_names,
-                                state_specs,
-                                feed_shardings=feed_shardings)
+            # compile event (ISSUE 9): jit-cache miss = a new (shapes,
+            # program) entry — the cold-start cost the serving bucket
+            # cache and PADDLE_TPU_COMPILE_CACHE_DIR exist to bound
+            _M_COMPILES.inc()
+            _obs_flight.record(
+                "executor", "compile",
+                n_feeds=len(feed_specs), n_fetch=len(fetch_names))
+            if _obs_trace._tracer is not None:
+                with _obs_trace._tracer.span("executor.compile"):
+                    fn = self._build_fn(
+                        list(feeds), feed_specs, fetch_names,
+                        state_specs, feed_shardings=feed_shardings)
+            else:
+                fn = self._build_fn(list(feeds), feed_specs,
+                                    fetch_names, state_specs,
+                                    feed_shardings=feed_shardings)
             self._cache[key] = fn
         if self._mesh is not None and not multiproc:
             # conform COMMITTED state arrays to the declared
@@ -835,7 +860,15 @@ class CompiledProgram:
                         getattr(v, "committed", False) and \
                         not sh.is_equivalent_to(v.sharding, v.ndim):
                     state[k] = jax.device_put(v, sh)
-        new_state, fetches = fn(state, feeds)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        if _obs_trace._tracer is not None:
+            with _obs_trace._tracer.span("executor.step"):
+                new_state, fetches = fn(state, feeds)
+        else:
+            new_state, fetches = fn(state, feeds)
+        _M_STEP_SECONDS.observe(_time.perf_counter() - t0)
         for k, v in new_state.items():
             scope.var(k).set(v)
         if return_numpy:

@@ -46,7 +46,18 @@ from __future__ import annotations
 
 import numpy as np
 
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _obs_metrics
+
 __all__ = ["ElasticTrainer"]
+
+_M_CKPT_SECONDS = _obs_metrics.histogram(
+    "paddle_tpu_elastic_checkpoint_seconds",
+    "wall time of the synchronous part of each elastic checkpoint "
+    "cut (async submit + pserver snapshot notify)")
+_M_EVENTS = _obs_metrics.counter(
+    "paddle_tpu_elastic_events_total",
+    "elastic-trainer transitions (checkpoints / resumes), by event")
 
 
 class ElasticTrainer:
@@ -104,6 +115,10 @@ class ElasticTrainer:
             if not self._restore_ps_state(int(step)) and \
                     self._t is not None:
                 self._push_restored_params()
+        _M_EVENTS.inc(event="resumes")
+        _flight.record("elastic", "resume",
+                       step=0 if step is None else int(step),
+                       peer=self._peer_id)
         return 0 if step is None else int(step)
 
     def _restore_ps_state(self, step):
@@ -185,11 +200,18 @@ class ElasticTrainer:
         """Call after completing step index `step`; checkpoints
         (asynchronously) every save_every steps."""
         if self._save_every > 0 and (int(step) + 1) % self._save_every == 0:
+            import time
+
+            t0 = time.perf_counter()
             self._ck.save(int(step) + 1, program=self._program,
                           scope=self._scope)
             self._notify_ps_snapshot(int(step) + 1)
             if self._wait_each_save:
                 self._ck.wait()
+            _M_CKPT_SECONDS.observe(time.perf_counter() - t0)
+            _M_EVENTS.inc(event="checkpoints")
+            _flight.record("elastic", "checkpoint",
+                           step=int(step) + 1, peer=self._peer_id)
 
     def run(self, n_steps, step_fn, start_step=None):
         """Convenience loop: resume, then step_fn(step) for each

@@ -258,6 +258,14 @@ class FaultInjector:
                 or self._random_action(msg_type, idx)
             if act is not None:
                 self.log.append((msg_type, idx, action_name(act)))
+                # chaos actions join the flight-recorder narrative so a
+                # post-mortem dump shows WHAT was injected right before
+                # the failure it caused (ISSUE 9)
+                from paddle_tpu.observability import flight_recorder
+
+                flight_recorder.record(
+                    "chaos", action_name(act), msg_type=msg_type,
+                    call_index=idx)
             return act
 
     def counts(self):

@@ -32,6 +32,9 @@ from collections import OrderedDict
 import numpy as np
 
 from paddle_tpu.distributed import faultinject
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability import tracing as _trace
 
 _LEN = struct.Struct("!Q")
 _I64 = struct.Struct("!q")
@@ -103,6 +106,39 @@ _RETRYABLE_EXCS = (ConnectionError, TimeoutError, OSError, WireError)
 
 _DEDUP_CACHE_SIZE = 4096
 _DEDUP_TAG = "__seq1__"
+# trace-context envelope: ("__trace1__", trace_id, span_id, inner) —
+# wrapped OUTSIDE the dedup envelope by RPCClient.call when tracing is
+# on, unwrapped first by RPCServer._dispatch so the server-side handler
+# span joins the caller's trace (docs/OBSERVABILITY.md)
+_TRACE_TAG = "__trace1__"
+
+# -- observability instruments (ISSUE 9): the registry is the ONE
+# source of truth; RPCClient.stats() is a view over these (the
+# breaker/registry split fix — tests assert the two never drift)
+_M_CLIENT = {
+    "calls": _obs_metrics.counter(
+        "paddle_tpu_rpc_client_calls_total",
+        "RPC calls started, by client/endpoint", max_series=4096),
+    "retries": _obs_metrics.counter(
+        "paddle_tpu_rpc_client_retries_total",
+        "transparent transport retries", max_series=4096),
+    "deadline_misses": _obs_metrics.counter(
+        "paddle_tpu_rpc_client_deadline_misses_total",
+        "calls that blew their deadline budget", max_series=4096),
+    "failures": _obs_metrics.counter(
+        "paddle_tpu_rpc_client_failures_total",
+        "TERMINAL call failures (retries exhausted / deadline blown "
+        "/ breaker trip)", max_series=4096),
+}
+_M_BREAKER_OPENS = _obs_metrics.counter(
+    "paddle_tpu_rpc_breaker_opens_total",
+    "circuit-breaker open transitions, by endpoint", max_series=1024)
+_M_SRV_REQS = _obs_metrics.counter(
+    "paddle_tpu_rpc_server_requests_total",
+    "server-side dispatches, by msg_type/status", max_series=256)
+_M_SRV_SECONDS = _obs_metrics.histogram(
+    "paddle_tpu_rpc_server_handler_seconds",
+    "server-side handler latency, by msg_type", max_series=128)
 
 
 _MAX_DEPTH = 32
@@ -380,6 +416,9 @@ class RPCServer:
                     "arrived": [], "gen": 0, "leader_taken": False}
         c = b["cond"]
         token = object() if peer is None else str(peer)
+        _flight.record("barrier", "arrive", name=name,
+                       endpoint=self.endpoint,
+                       peer=None if peer is None else str(peer))
         with c:
             gen = b["gen"]
             if not (isinstance(token, str) and token in b["arrived"]):
@@ -405,6 +444,17 @@ class RPCServer:
                     except ValueError:
                         pass
                     c.notify_all()
+                    # flight-recorder trigger: a stalled barrier is a
+                    # post-mortem moment — dump the causal event chain
+                    # next to the one-line diagnostic
+                    _flight.record(
+                        "barrier", "timeout", name=name,
+                        endpoint=self.endpoint,
+                        arrived=len(err.arrived), needed=err.needed,
+                        waiters=",".join(
+                            p for p in err.arrived
+                            if isinstance(p, str)))
+                    _flight.dump(reason="barrier_timeout")
                     raise err
                 c.wait(poll)
             me_alive = alive_fn is None or not isinstance(token, str) \
@@ -415,6 +465,8 @@ class RPCServer:
                 b["gen"] += 1
                 b["arrived"] = []
                 b["leader_taken"] = False
+                _flight.record("barrier", "release", name=name,
+                               endpoint=self.endpoint, gen=gen)
                 c.notify_all()
             if me_alive and not b["leader_taken"] and \
                     b["gen"] == gen + 1:
@@ -452,7 +504,14 @@ class RPCServer:
         (client_id, seq) already executed, the cached ok-reply is
         returned WITHOUT re-running the handler — a retried send_var
         whose reply was lost lands once, not twice.  Handlers only ever
-        see the inner payload."""
+        see the inner payload.
+
+        Trace envelope: (_TRACE_TAG, trace_id, span_id, inner) is
+        unwrapped FIRST (it wraps the dedup envelope); when this
+        process traces, the handler runs under a span parented on the
+        caller's ids — the pserver side of one distributed trace."""
+        import time
+
         if not (isinstance(msg, tuple) and len(msg) == 2
                 and isinstance(msg[0], str)):
             return ("error", "message must be (msg_type, payload)")
@@ -460,6 +519,11 @@ class RPCServer:
         fn = self._handlers.get(msg_type)
         if fn is None:
             return ("error", f"no handler for '{msg_type}'")
+        tctx = None
+        if (isinstance(payload, tuple) and len(payload) == 4
+                and payload[0] == _TRACE_TAG):
+            tctx = (payload[1], payload[2])
+            payload = payload[3]
         dedup_key = None
         if (isinstance(payload, tuple) and len(payload) == 4
                 and payload[0] == _DEDUP_TAG):
@@ -470,10 +534,21 @@ class RPCServer:
                 if cached is not None:
                     self._dedup.move_to_end(dedup_key)
                     return cached
+        t0 = time.perf_counter()
         try:
-            reply = ("ok", fn(payload))
+            if _trace._tracer is not None:
+                with _trace._tracer.span("rpc.server:" + msg_type,
+                                         parent=tctx,
+                                         endpoint=self.endpoint):
+                    reply = ("ok", fn(payload))
+            else:
+                reply = ("ok", fn(payload))
         except Exception as e:  # surface to client
+            _M_SRV_REQS.inc(msg_type=msg_type, status="error")
             return ("error", repr(e))
+        _M_SRV_REQS.inc(msg_type=msg_type, status="ok")
+        _M_SRV_SECONDS.observe(time.perf_counter() - t0,
+                               msg_type=msg_type)
         if dedup_key is not None:
             with self._dedup_lock:
                 self._dedup[dedup_key] = reply
@@ -602,16 +677,14 @@ class RPCClient:
         self._seq = itertools.count(1)
         self._DEADLINE = None       # per-instance override of the env
         self._breaker: dict = {}    # endpoint -> [consec_fails, open_until]
-        self._stats_lock = threading.Lock()
-        self._endpoint_stats: dict = {}   # endpoint -> counter dict
 
     def _stat(self, endpoint, **incs):
-        with self._stats_lock:
-            st = self._endpoint_stats.setdefault(
-                endpoint, {"calls": 0, "retries": 0,
-                           "deadline_misses": 0, "failures": 0})
-            for k, v in incs.items():
-                st[k] += v
+        """Counters live in the observability registry (labels
+        client/endpoint); stats() is a VIEW over them — there is no
+        second private copy to drift (ISSUE 9 satellite)."""
+        for k, v in incs.items():
+            _M_CLIENT[k].inc(v, client=self._client_id,
+                             endpoint=endpoint)
 
     def stats(self):
         """Per-endpoint client-side failure telemetry — the breaker
@@ -623,14 +696,24 @@ class RPCClient:
              "open": bool, "cooldown_remaining_s": float}}
 
         ``failures`` counts TERMINAL call failures (retries exhausted /
-        deadline blown / breaker trip), not absorbed transient ones."""
+        deadline blown / breaker trip), not absorbed transient ones.
+
+        This is a read-through VIEW over the process metrics registry
+        (paddle_tpu_rpc_client_*_total filtered to this client's
+        label), so it can never drift from /metrics."""
         import time
 
         thresh = _env_int("PADDLE_TPU_RPC_CB_THRESHOLD", 8)
         now = time.monotonic()
-        with self._stats_lock:
-            out = {ep: dict(st)
-                   for ep, st in self._endpoint_stats.items()}
+        out: dict = {}
+        for key, metric in _M_CLIENT.items():
+            for labels, value in metric.items():
+                if labels.get("client") != self._client_id:
+                    continue
+                ep = labels.get("endpoint")
+                out.setdefault(ep, {"calls": 0, "retries": 0,
+                                    "deadline_misses": 0,
+                                    "failures": 0})[key] = int(value)
         for ep in set(out) | set(self._breaker):
             st = self._breaker.get(ep)
             out.setdefault(ep, {"calls": 0, "retries": 0,
@@ -750,6 +833,13 @@ class RPCClient:
         st[0] += 1
         st[1] = time.monotonic() + \
             _env_float("PADDLE_TPU_RPC_CB_COOLDOWN", 1.0)
+        thresh = _env_int("PADDLE_TPU_RPC_CB_THRESHOLD", 8)
+        if thresh > 0 and st[0] == thresh:
+            # open TRANSITION (not every failure beyond it): a metric
+            # + a flight-recorder event — the "breaker invisible" gap
+            _M_BREAKER_OPENS.inc(endpoint=endpoint)
+            _flight.record("rpc", "breaker_open", endpoint=endpoint,
+                           consecutive_failures=st[0])
 
     def call(self, endpoint: str, msg_type: str, payload=None,
              deadline=None, retries=None):
@@ -777,45 +867,78 @@ class RPCClient:
                        next(self._seq), payload)
         elif msg_type not in self.IDEMPOTENT and not explicit_retries:
             retries = 0
+        span = None
+        if _trace._tracer is not None:
+            # the distributed-trace envelope: the server-side handler
+            # span joins THIS trace id (one conditional when off)
+            span = _trace._tracer.start_span(
+                "rpc.client:" + msg_type, endpoint=endpoint)
+            payload = (_TRACE_TAG, span.trace_id, span.span_id,
+                       payload)
         try:
-            self._breaker_gate(endpoint)
-        except CircuitOpenError:
-            self._stat(endpoint, calls=1, failures=1)
-            raise
-        self._stat(endpoint, calls=1)
-        deadline_t = time.monotonic() + float(deadline)
-        backoff = _env_float("PADDLE_TPU_RPC_BACKOFF", 0.05)
-        attempt = 0
-        while True:
-            budget = deadline_t - time.monotonic()
-            if budget <= 0:
-                self._breaker_fail(endpoint)
-                self._stat(endpoint, deadline_misses=1, failures=1)
-                raise RPCDeadlineExceeded(
-                    f"RPC '{msg_type}' to {endpoint}: deadline "
-                    f"{deadline:g}s exhausted after {attempt} attempts")
             try:
-                return self._call_once(endpoint, msg_type, payload,
-                                       min(budget, self._TIMEOUT))
-            except self._RETRYABLE as e:
-                attempt += 1
-                if attempt > retries:
-                    self._breaker_fail(endpoint)
-                    self._stat(endpoint, failures=1,
-                               deadline_misses=int(
-                                   isinstance(e, socket.timeout)))
-                    raise
-                self._stat(endpoint, retries=1)
-                sleep = min(backoff * (2 ** (attempt - 1)), 2.0) \
-                    * (0.5 + random.random())
-                if time.monotonic() + sleep >= deadline_t:
+                self._breaker_gate(endpoint)
+            except CircuitOpenError:
+                self._stat(endpoint, calls=1, failures=1)
+                raise
+            self._stat(endpoint, calls=1)
+            deadline_t = time.monotonic() + float(deadline)
+            backoff = _env_float("PADDLE_TPU_RPC_BACKOFF", 0.05)
+            attempt = 0
+            while True:
+                budget = deadline_t - time.monotonic()
+                if budget <= 0:
                     self._breaker_fail(endpoint)
                     self._stat(endpoint, deadline_misses=1, failures=1)
+                    _flight.record("rpc", "deadline_exceeded",
+                                   msg_type=msg_type,
+                                   endpoint=endpoint, attempts=attempt)
                     raise RPCDeadlineExceeded(
                         f"RPC '{msg_type}' to {endpoint}: deadline "
                         f"{deadline:g}s exhausted after {attempt} "
-                        f"attempts (last: {e!r})") from e
-                time.sleep(sleep)
+                        "attempts")
+                try:
+                    return self._call_once(endpoint, msg_type, payload,
+                                           min(budget, self._TIMEOUT))
+                except self._RETRYABLE as e:
+                    attempt += 1
+                    if attempt > retries:
+                        self._breaker_fail(endpoint)
+                        self._stat(endpoint, failures=1,
+                                   deadline_misses=int(
+                                       isinstance(e, socket.timeout)))
+                        _flight.record("rpc", "call_failed",
+                                       msg_type=msg_type,
+                                       endpoint=endpoint,
+                                       attempts=attempt,
+                                       error=type(e).__name__)
+                        raise
+                    self._stat(endpoint, retries=1)
+                    _flight.record("rpc", "retry", msg_type=msg_type,
+                                   endpoint=endpoint, attempt=attempt,
+                                   error=type(e).__name__)
+                    sleep = min(backoff * (2 ** (attempt - 1)), 2.0) \
+                        * (0.5 + random.random())
+                    if time.monotonic() + sleep >= deadline_t:
+                        self._breaker_fail(endpoint)
+                        self._stat(endpoint, deadline_misses=1,
+                                   failures=1)
+                        _flight.record("rpc", "deadline_exceeded",
+                                       msg_type=msg_type,
+                                       endpoint=endpoint,
+                                       attempts=attempt)
+                        raise RPCDeadlineExceeded(
+                            f"RPC '{msg_type}' to {endpoint}: deadline "
+                            f"{deadline:g}s exhausted after {attempt} "
+                            f"attempts (last: {e!r})") from e
+                    time.sleep(sleep)
+        except Exception as e:
+            if span is not None:
+                span.set_attr("error", type(e).__name__)
+            raise
+        finally:
+            if span is not None:
+                span.end()
 
     def health(self, endpoint, deadline=2.0):
         """Probe the server's built-in 'health' handler: short deadline,

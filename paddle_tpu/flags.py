@@ -184,6 +184,20 @@ define_flag("gspmd", False,
             "parallelism is tp PartitionSpecs on the existing layers, "
             "and flash attention runs under shard_map on the same "
             "mesh (docs/GSPMD.md)")
+define_flag("tracing", False,
+            "request-scoped structured tracing (ISSUE 9, "
+            "observability/tracing.py): False = off (default; every "
+            "span site reduces to ONE module-global None check — the "
+            "disabled-cost contract asserted in "
+            "tests/test_observability.py); True = spans with "
+            "trace-id/span-id propagation are recorded into a bounded "
+            "ring: a serving request carries one trace id submit -> "
+            "admission -> batch -> replica -> Predictor.run -> "
+            "delivery, decode sequences span join -> step -> retire, "
+            "and the id rides the RPC envelope so pserver handler "
+            "spans join the caller's trace.  Export: chrome-trace "
+            "JSON merged by tools/timeline.py "
+            "(docs/OBSERVABILITY.md)")
 define_flag("int8_conv_algo", "conv",
             "conv2d_int8 lowering: 'conv' = integer "
             "conv_general_dilated; 'im2col' = pad/slice/concat + one "

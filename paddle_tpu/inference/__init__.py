@@ -261,9 +261,19 @@ class Predictor:
                         f"input '{name}' not set; call copy_from_cpu")
                 feed[name] = t.data()
         feed = {n: self.validate_feed(n, v) for n, v in feed.items()}
-        outs = self._exe.run(self._compiled, feed=feed,
-                             fetch_list=self._fetch_vars,
-                             scope=self._scope)
+        from paddle_tpu.observability import tracing as _trace
+
+        if _trace._tracer is not None:
+            # joins the serving.replica span via the thread-local
+            # stack when called from the pool worker (ISSUE 9)
+            with _trace._tracer.span("predictor.run"):
+                outs = self._exe.run(self._compiled, feed=feed,
+                                     fetch_list=self._fetch_vars,
+                                     scope=self._scope)
+        else:
+            outs = self._exe.run(self._compiled, feed=feed,
+                                 fetch_list=self._fetch_vars,
+                                 scope=self._scope)
         self._outputs = {v.name: PaddleTensor(v.name, o)
                          for v, o in zip(self._fetch_vars, outs)}
         return outs

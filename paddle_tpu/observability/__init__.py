@@ -1,0 +1,55 @@
+"""Unified observability subsystem (ISSUE 9): ONE metrics surface,
+request-scoped tracing, and a crash flight recorder across the
+serving, decode, and distributed stacks.
+
+Reference contrast: the reference framework ships a first-class
+profiler layer (platform/profiler.h RecordEvent + CUPTI DeviceTracer
++ tools/timeline.py chrome-trace merge) but no metrics registry or
+post-mortem recorder; production operation of a "millions of users"
+stack needs all three (docs/OBSERVABILITY.md).
+
+  metrics.py          process-wide registry of typed labeled
+                      instruments (Counter/Gauge/Histogram, bounded
+                      label cardinality, prometheus text + one-JSON-
+                      line snapshot)
+  tracing.py          structured spans with trace-id propagation
+                      (serving request -> admission -> batch ->
+                      replica -> delivery; RPC envelope carries the id
+                      to pserver handler spans), chrome-trace export
+                      merged by tools/timeline.py; default-off typed
+                      flag ``tracing`` with a one-conditional disabled
+                      cost
+  flight_recorder.py  bounded lock-free ring of recent structured
+                      events dumped to a file on crash /
+                      BarrierTimeoutError / replica death / request
+  export.py           /metrics + /varz HTTP endpoint mountable on
+                      listen_and_serv, InferenceServer, DecodeServer;
+                      in-tree prometheus grammar checker
+
+``paddle_tpu/profiler.py`` (the Fluid-shaped start_profiler/
+stop_profiler/RecordEvent surface) is a thin shim over tracing.py.
+"""
+
+from paddle_tpu.observability import flight_recorder
+from paddle_tpu.observability import metrics
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.export import (MetricsHTTPServer,
+                                             metrics_port_from_env,
+                                             parse_prometheus_text)
+from paddle_tpu.observability.flight_recorder import FlightRecorder
+from paddle_tpu.observability.metrics import (Counter, Gauge,
+                                              Histogram,
+                                              MetricsRegistry,
+                                              registry)
+from paddle_tpu.observability.tracing import (Span, Tracer,
+                                              maybe_tracer,
+                                              start_tracing,
+                                              stop_tracing)
+
+__all__ = [
+    "Counter", "FlightRecorder", "Gauge", "Histogram",
+    "MetricsHTTPServer", "MetricsRegistry", "Span", "Tracer",
+    "flight_recorder", "maybe_tracer", "metrics",
+    "metrics_port_from_env", "parse_prometheus_text", "registry",
+    "start_tracing", "stop_tracing", "tracing",
+]

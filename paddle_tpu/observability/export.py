@@ -1,0 +1,232 @@
+"""HTTP exposition of the observability surface + the in-tree
+prometheus text-format grammar checker.
+
+``MetricsHTTPServer`` serves, on a daemon thread:
+
+    /metrics    prometheus text exposition of the process registry
+    /varz       the registry snapshot as one JSON document
+    /flightz    recent flight-recorder events (JSON)
+    /tracez     finished-span summary when tracing is on (JSON)
+    /healthz    {"status": "ok"}
+
+It is mountable on every long-running process of the stack:
+``listen_and_serv`` (attr ``metrics_port`` / env
+``PADDLE_TPU_METRICS_PORT``), ``InferenceServer`` and ``DecodeServer``
+(``ServingConfig(metrics_port=...)`` / ``DecodeConfig(metrics_port=
+...)``).  Port 0 binds an ephemeral port (read ``server.port``).
+
+``parse_prometheus_text`` is the grammar check the CI smoke runs — a
+strict-enough parser of exposition format 0.0.4 (names, label pairs,
+escapes, values, HELP/TYPE comments, histogram ``le``/+Inf shape)
+with no external dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import tracing as _tracing
+
+__all__ = ["MetricsHTTPServer", "parse_prometheus_text",
+           "metrics_port_from_env"]
+
+
+def metrics_port_from_env(default=None):
+    """PADDLE_TPU_METRICS_PORT -> int port (0 = ephemeral), or
+    ``default`` when unset/empty."""
+    import os
+
+    v = os.environ.get("PADDLE_TPU_METRICS_PORT")
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+class MetricsHTTPServer:
+    """Tiny threading HTTP server for the /metrics + /varz surface."""
+
+    def __init__(self, port=0, host="127.0.0.1", registry=None):
+        self._host = host
+        self._want_port = int(port)
+        self._registry = registry or _metrics.registry()
+        self._httpd = None
+        self._thread = None
+        self.port = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._httpd is not None:
+            return self
+        import http.server
+
+        reg = self._registry
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):   # silence per-request stderr
+                pass
+
+            def _send(self, body, ctype):
+                data = body.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(reg.prometheus_text(),
+                               "text/plain; version=0.0.4")
+                elif path == "/varz":
+                    self._send(json.dumps(reg.snapshot(),
+                                          sort_keys=True),
+                               "application/json")
+                elif path == "/flightz":
+                    self._send(json.dumps(
+                        {"events":
+                         _flight.recorder().events()[-256:],
+                         "dumps": _flight.dump_paths()}),
+                        "application/json")
+                elif path == "/tracez":
+                    t = _tracing.maybe_tracer()
+                    spans = [] if t is None else [
+                        {"name": s.name, "trace_id": s.trace_id,
+                         "span_id": s.span_id,
+                         "parent_id": s.parent_id,
+                         "dur_us": ((s.t1_ns or s.t0_ns) - s.t0_ns)
+                         / 1e3}
+                        for s in t.spans()[-256:]]
+                    self._send(json.dumps(
+                        {"enabled": t is not None, "spans": spans}),
+                        "application/json")
+                elif path == "/healthz":
+                    self._send('{"status": "ok"}', "application/json")
+                else:
+                    self.send_error(404)
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self._host, self._want_port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread is not None:
+                self._thread.join(timeout=2.0)
+                self._thread = None
+
+    @property
+    def url(self):
+        return None if self.port is None else \
+            "http://%s:%d" % (self._host, self.port)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+# -- prometheus text grammar (exposition format 0.0.4) ----------------------
+
+_PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(r"# HELP (%s) (.*)\Z" % _PROM_NAME)
+_TYPE_RE = re.compile(
+    r"# TYPE (%s) (counter|gauge|histogram|summary|untyped)\Z"
+    % _PROM_NAME)
+_SAMPLE_RE = re.compile(
+    r"(?P<name>%s)(?:\{(?P<labels>[^}]*)\})?\s+"
+    r"(?P<value>[+-]?(?:\d+(?:\.\d*)?(?:[eE][+-]?\d+)?|\.\d+"
+    r"(?:[eE][+-]?\d+)?|Inf|NaN))(?:\s+(?P<ts>-?\d+))?\Z"
+    % _PROM_NAME)
+_LABEL_PAIR_RE = re.compile(
+    r'\s*(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*'
+    r'"(?P<v>(?:[^"\\]|\\.)*)"\s*(?P<sep>,|\Z)')
+
+
+def _parse_labels(text):
+    labels = {}
+    pos = 0
+    while pos < len(text):
+        m = _LABEL_PAIR_RE.match(text, pos)
+        if m is None:
+            raise ValueError(f"bad label pair at {text[pos:]!r}")
+        v = m.group("v").replace('\\"', '"').replace("\\n", "\n") \
+            .replace("\\\\", "\\")
+        labels[m.group("k")] = v
+        pos = m.end()
+    return labels
+
+
+def parse_prometheus_text(text):
+    """Validate + parse exposition text.  Returns
+    ``[(name, labels_dict, value)]`` samples; raises ValueError on any
+    grammar violation.  Extra structural checks: a TYPE may be
+    announced at most once per name; histogram samples only use the
+    ``_bucket``/``_sum``/``_count`` suffixes of an announced histogram
+    and every bucket run ends with ``le="+Inf"``."""
+    samples = []
+    types = {}
+    hist_bucket_le: dict = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if _HELP_RE.match(line):
+                continue
+            m = _TYPE_RE.match(line)
+            if m:
+                name, kind = m.group(1), m.group(2)
+                if name in types:
+                    raise ValueError(
+                        f"line {lineno}: duplicate TYPE for {name}")
+                types[name] = kind
+                continue
+            if line.startswith("# HELP") or line.startswith("# TYPE"):
+                raise ValueError(
+                    f"line {lineno}: malformed comment: {line!r}")
+            continue     # free-form comments are legal
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: bad sample: {line!r}")
+        name = m.group("name")
+        labels = _parse_labels(m.group("labels") or "")
+        raw = m.group("value")
+        value = float(raw.replace("Inf", "inf").replace("NaN", "nan"))
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    types.get(name[: -len(suffix)]) in ("histogram",
+                                                        "summary"):
+                base = name[: -len(suffix)]
+                break
+        if types and base not in types and name not in types:
+            raise ValueError(
+                f"line {lineno}: sample {name!r} has no TYPE")
+        if name.endswith("_bucket") and \
+                types.get(base) == "histogram":
+            if "le" not in labels:
+                raise ValueError(
+                    f"line {lineno}: histogram bucket without le")
+            key = (base, tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le")))
+            hist_bucket_le.setdefault(key, []).append(labels["le"])
+        samples.append((name, labels, value))
+    for (base, _), les in hist_bucket_le.items():
+        if "+Inf" not in les:
+            raise ValueError(
+                f"histogram {base} bucket run missing le=\"+Inf\"")
+    return samples

@@ -1,0 +1,302 @@
+"""Request-scoped structured tracing: spans with trace-id/span-id
+propagation, chrome-trace export, default-off behind the typed flag
+``tracing``.
+
+Propagation contract (docs/OBSERVABILITY.md):
+
+  - a serving request carries ONE trace id from
+    ``InferenceServer.submit`` -> admission -> batch formation ->
+    replica -> ``Predictor.run`` -> delivery (span ctx rides on the
+    ``Request``/``Batch`` objects across the worker threads);
+  - the decode path spans join -> step -> retire per sequence;
+  - the id rides the RPC envelope (``rpc.py`` wraps the payload as
+    ``("__trace__", trace_id, span_id, payload)``) so a pserver-side
+    handler span joins the CLIENT's trace.
+
+Disabled-cost contract (the faultinject discipline): every span site
+is ONE conditional —
+
+    from paddle_tpu.observability import tracing as _trace
+    ...
+    if _trace._tracer is not None:
+        with _trace._tracer.span("stage", parent=ctx):
+            ...work...
+    else:
+        ...work...
+
+``_tracer`` is a plain module global (None unless tracing is on), so a
+flag-off site costs one attribute load + ``is not None``; the bench
+test in tests/test_observability.py asserts no measurable per-call
+regression vs a build with the sites compiled out.
+
+Export is chrome-trace JSON (``ph: "X"`` duration events, ts/dur in
+microseconds) compatible with the existing ``tools/timeline.py``
+multi-worker merge; ``paddle_tpu/profiler.py`` is a Fluid-shaped shim
+over this module.
+
+Env knobs: ``PADDLE_TPU_TRACING=1`` turns the flag on at import;
+``PADDLE_TPU_TRACE_CAPACITY`` bounds the finished-span ring (default
+65536 spans — tracing memory is bounded no matter how long the
+process runs).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import uuid
+
+__all__ = [
+    "Span", "Tracer", "start_tracing", "stop_tracing", "maybe_tracer",
+    "enabled", "current", "span", "export_chrome_trace",
+]
+
+# THE module global every span site checks (one load + None test).
+_tracer = None
+_tls = threading.local()
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    return default if not v else int(v)
+
+
+class Span:
+    """One timed span.  Use as a context manager (activates on the
+    thread-local stack so nested sites pick it up as parent) or call
+    ``end()`` manually (cross-thread stages that can't nest)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "t0_ns",
+                 "t1_ns", "attrs", "thread", "_tracer", "_active")
+
+    def __init__(self, tracer, name, trace_id, span_id, parent_id,
+                 attrs):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.thread = threading.get_ident()
+        self.t0_ns = time.perf_counter_ns()
+        self.t1_ns = None
+        self._tracer = tracer
+        self._active = False
+
+    @property
+    def ctx(self):
+        """The (trace_id, span_id) pair children parent on — also what
+        rides the RPC envelope and the serving Request objects."""
+        return (self.trace_id, self.span_id)
+
+    def set_attr(self, key, value):
+        self.attrs[key] = value
+        return self
+
+    def end(self):
+        if self.t1_ns is None:
+            self.t1_ns = time.perf_counter_ns()
+            self._tracer._record(self)
+        return self
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self.ctx)
+        self._active = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._active:
+            self._active = False
+            stack = getattr(_tls, "stack", None)
+            if stack and stack[-1] == self.ctx:
+                stack.pop()
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        self.end()
+        return False
+
+
+class Tracer:
+    """Span factory + bounded ring of finished spans."""
+
+    def __init__(self, capacity=None):
+        self.capacity = capacity if capacity is not None else \
+            _env_int("PADDLE_TPU_TRACE_CAPACITY", 65536)
+        self._ring = [None] * int(self.capacity)
+        self._idx = itertools.count()
+        self._count = 0          # highest slot written + 1 (read path)
+        self._sid = itertools.count(1)
+        self.dropped = 0
+
+    # -- creation -----------------------------------------------------------
+    def _ids(self, parent):
+        if parent is None:
+            parent = current()
+        if isinstance(parent, Span):
+            parent = parent.ctx
+        if parent is not None:
+            trace_id, parent_id = parent
+        else:
+            trace_id, parent_id = uuid.uuid4().hex[:16], None
+        return trace_id, "%x" % next(self._sid), parent_id
+
+    def start_span(self, name, parent=None, **attrs):
+        """A running span; caller must ``end()`` it (or use ``span``)."""
+        trace_id, span_id, parent_id = self._ids(parent)
+        return Span(self, name, trace_id, span_id, parent_id, attrs)
+
+    def span(self, name, parent=None, **attrs):
+        """Context-manager form: activates on the thread-local stack so
+        nested sites parent onto it automatically."""
+        return self.start_span(name, parent=parent, **attrs)
+
+    def instant(self, name, parent=None, **attrs):
+        """Zero-ish-duration span recorded immediately (stage markers
+        like batch formation / token retire)."""
+        return self.start_span(name, parent=parent, **attrs).end()
+
+    # -- collection ---------------------------------------------------------
+    def _record(self, span):
+        i = next(self._idx)
+        if i >= self.capacity:
+            self.dropped += 1
+        self._ring[i % self.capacity] = span
+        if i + 1 > self._count:
+            self._count = i + 1
+
+    def spans(self):
+        """Finished spans, oldest first (bounded by capacity)."""
+        n = self._count
+        out = []
+        if n > self.capacity:
+            for j in range(n % self.capacity, self.capacity):
+                s = self._ring[j]
+                if s is not None:
+                    out.append(s)
+        for j in range(n % self.capacity):
+            s = self._ring[j]
+            if s is not None:
+                out.append(s)
+        return out
+
+    def spans_for(self, trace_id):
+        return [s for s in self.spans() if s.trace_id == trace_id]
+
+    def trace_ids(self):
+        return sorted({s.trace_id for s in self.spans()})
+
+    def clear(self):
+        self._ring = [None] * int(self.capacity)
+        self._idx = itertools.count()
+        self._count = 0
+        self.dropped = 0
+
+    # -- export -------------------------------------------------------------
+    def chrome_events(self):
+        """Chrome-trace duration events (the tools/timeline.py input
+        shape: name/ph/ts/dur/pid/tid + args carrying the trace ids)."""
+        pid = os.getpid()
+        events = []
+        for s in self.spans():
+            args = {"trace_id": s.trace_id, "span_id": s.span_id}
+            if s.parent_id is not None:
+                args["parent_id"] = s.parent_id
+            args.update(s.attrs)
+            events.append({
+                "name": s.name, "ph": "X",
+                "ts": s.t0_ns / 1e3,
+                "dur": ((s.t1_ns or s.t0_ns) - s.t0_ns) / 1e3,
+                "pid": pid, "tid": s.thread, "args": args,
+            })
+        return events
+
+    def export_chrome_trace(self, path):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.chrome_events()}, f)
+        return path
+
+
+# -- module-level switch ----------------------------------------------------
+
+def start_tracing(capacity=None):
+    """Install the process tracer (idempotent); returns it."""
+    global _tracer
+    if _tracer is None:
+        _tracer = Tracer(capacity=capacity)
+    return _tracer
+
+
+def stop_tracing():
+    """Uninstall; returns the (now inert, still readable) tracer."""
+    global _tracer
+    t = _tracer
+    _tracer = None
+    return t
+
+
+def maybe_tracer():
+    """None unless tracing is on — the same shape as
+    faultinject.maybe_injector().  Hot sites read the ``_tracer``
+    module global directly (one conditional, the disabled-cost
+    contract)."""
+    return _tracer
+
+
+def enabled():
+    return _tracer is not None
+
+
+def current():
+    """The active (trace_id, span_id) on this thread, or None."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def span(name, parent=None, **attrs):
+    """Null-safe convenience for NON-hot sites: a real span when
+    tracing is on, a no-op context manager when off.  Hot sites use the
+    ``_tracer is not None`` guard instead (see the module docstring)."""
+    t = _tracer
+    return _NULL_SPAN if t is None else t.span(name, parent=parent,
+                                               **attrs)
+
+
+def export_chrome_trace(path):
+    t = _tracer
+    if t is None:
+        raise RuntimeError("tracing is not enabled")
+    return t.export_chrome_trace(path)
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _init_from_flag():
+    """PADDLE_TPU_TRACING=1 (the typed flag ``tracing``) switches the
+    tracer on at import — the always-on-in-this-process mode the CI
+    smoke uses."""
+    try:
+        from paddle_tpu import flags
+
+        if flags.get_flag("tracing"):
+            start_tracing()
+    except Exception:   # flags not importable yet (bootstrap order)
+        pass
+
+
+_init_from_flag()

@@ -44,8 +44,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _obs_metrics
+
 __all__ = ["OutOfPagesError", "PagedKVCache", "quantize_kv",
            "dequantize_kv", "kv_scales_of"]
+
+_M_PAGES = _obs_metrics.counter(
+    "paddle_tpu_paged_kv_pages_total",
+    "page-pool transitions (alloc / free) summed over every cache in "
+    "the process, by event")
+_M_OOP = _obs_metrics.counter(
+    "paddle_tpu_paged_kv_out_of_pages_total",
+    "OutOfPagesError raises (the paging backpressure signal)")
 
 _INT8_BOUND = 127.0  # mirrors ops/quant.py _quantize bit_length=8
 
@@ -148,17 +159,20 @@ class PagedKVCache:
     # -- allocation ---------------------------------------------------------
     def _take_page(self, slot):
         if not self._free_pages:
+            _M_OOP.inc()
             raise OutOfPagesError(
                 "page pool exhausted (%d pages, %d live seqs)"
                 % (self.num_pages, len(self._live)))
         pages = self._pages_of[slot]
         if len(pages) >= self.max_pages_per_seq:
+            _M_OOP.inc()
             raise OutOfPagesError(
                 "sequence at max_pages_per_seq=%d"
                 % self.max_pages_per_seq)
         pid = self._free_pages.pop()
         self._tables[slot, len(pages)] = pid
         pages.append(pid)
+        _M_PAGES.inc(event="alloc")
         self._peak_in_use = max(self._peak_in_use, self.in_use_pages())
         return pid
 
@@ -168,10 +182,12 @@ class PagedKVCache:
         allocated) when the pool can't hold it."""
         need = self.pages_for(n_tokens)
         if len(self._free_pages) < need:
+            _M_OOP.inc()
             raise OutOfPagesError(
                 "need %d pages, %d free (of %d)"
                 % (need, len(self._free_pages), self.num_pages))
         if not self._free_slots:
+            _M_OOP.inc()
             raise OutOfPagesError("no free sequence slot (max_seqs=%d)"
                                   % self.max_seqs)
         slot = self._free_slots.pop()
@@ -180,6 +196,8 @@ class PagedKVCache:
         self._lens[slot] = 0
         for _ in range(need):
             self._take_page(slot)
+        _flight.record("paged_kv", "alloc", slot=int(slot),
+                       pages=need)
         return slot
 
     def free(self, slot):
@@ -187,8 +205,12 @@ class PagedKVCache:
         if slot not in self._live:
             raise KeyError("slot %r is not live" % (slot,))
         self._live.discard(slot)
-        for pid in self._pages_of.pop(slot):
+        pages = self._pages_of.pop(slot)
+        for pid in pages:
             self._free_pages.append(pid)
+        _M_PAGES.inc(len(pages), event="free")
+        _flight.record("paged_kv", "free", slot=int(slot),
+                       pages=len(pages))
         self._tables[slot, :] = 0
         self._lens[slot] = 0
         self._free_slots.append(slot)

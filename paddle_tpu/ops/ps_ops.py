@@ -596,6 +596,27 @@ def listen_and_serv_op(op, block, scope, ctx):
     server.register_handler("checkpoint_notify", on_checkpoint)
     server.register_handler("checkpoint_restore", on_checkpoint_restore)
     server.register_handler("profile", on_profile)
+
+    # observability surface (ISSUE 9): a 'varz' RPC returning the
+    # process metrics snapshot (wire-encodable dict), and — when
+    # metrics_port attr / PADDLE_TPU_METRICS_PORT is set — the
+    # /metrics + /varz HTTP endpoint mounted for scrapers
+    from paddle_tpu.observability import metrics as _obs_metrics
+    from paddle_tpu.observability.export import (MetricsHTTPServer,
+                                                 metrics_port_from_env)
+
+    server.register_handler(
+        "varz", lambda _=None: _obs_metrics.registry().snapshot())
+    mport = int(attrs.get("metrics_port", -1))
+    if mport < 0:
+        mport = metrics_port_from_env(-1)
+    metrics_http = None
+    if mport is not None and mport >= 0:
+        try:
+            metrics_http = MetricsHTTPServer(port=mport).start()
+        except OSError:
+            metrics_http = None   # port taken: a scrape endpoint is
+            #                       an optimization, never a crash
     server.start()
     try:
         while not stop.wait(timeout=0.25):
@@ -606,6 +627,8 @@ def listen_and_serv_op(op, block, scope, ctx):
                 if ncomplete[0] >= outstanding_completions():
                     stop.set()
     finally:
+        if metrics_http is not None:
+            metrics_http.stop()
         server.stop()
 
 

@@ -1,55 +1,77 @@
 """Profiler (reference: python/paddle/fluid/profiler.py:225 profiler guard;
 platform/profiler.h RecordEvent; CUPTI DeviceTracer -> here jax.profiler
-which captures XLA:TPU device traces viewable in xprof/tensorboard,
-plus a host op-span recorder with a chrome-trace exporter like
-tools/timeline.py)."""
+which captures XLA:TPU device traces viewable in xprof/tensorboard).
+
+Since ISSUE 9 this module is a thin Fluid-shaped SHIM over
+``observability/tracing.py``: ``RecordEvent`` spans land in a
+profiler-owned ``Tracer`` between ``start_profiler``/``stop_profiler``
+(and ALSO join the process tracer when the ``tracing`` flag is on, so
+op spans appear inside request traces), and ``export_chrome_tracing``
+writes the tracer's chrome-trace JSON — same signatures, same file
+shape, still merged across workers by ``tools/timeline.py``."""
 
 from __future__ import annotations
 
 import contextlib
-import json
-import time
 
-_events = []
-_enabled = False
+from paddle_tpu.observability import tracing as _trace
+
+# profiler-owned tracer: enabled between start/stop_profiler,
+# independent of the process ``tracing`` flag (the legacy
+# profile_ops/profiler() contract must work with tracing off)
+_prof_tracer = None
 
 
 class RecordEvent:
-    """Host event span (reference platform/profiler.h:81)."""
+    """Host event span (reference platform/profiler.h:81).  Exact
+    legacy signature; now a tracing span site: records into the
+    profiler tracer when profiling is on AND into the process tracer
+    when the ``tracing`` flag is on (joining the active trace)."""
+
+    __slots__ = ("name", "_spans")
 
     def __init__(self, name):
         self.name = name
 
     def __enter__(self):
-        self.start = time.perf_counter_ns()
+        self._spans = []
+        if _prof_tracer is not None:
+            self._spans.append(
+                _prof_tracer.span(self.name).__enter__())
+        if _trace._tracer is not None:
+            self._spans.append(
+                _trace._tracer.span(self.name).__enter__())
         return self
 
     def __exit__(self, *exc):
-        if _enabled:
-            _events.append(
-                (self.name, self.start, time.perf_counter_ns()))
+        for sp in reversed(self._spans):
+            sp.__exit__(*(exc or (None, None, None)))
+        return False
 
 
 def start_profiler(state="All"):
-    global _enabled
-    _enabled = True
-    _events.clear()
+    global _prof_tracer
+    _prof_tracer = _trace.Tracer()
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
-    global _enabled
-    _enabled = False
+    global _prof_tracer
+    t = _prof_tracer
+    _prof_tracer = None
+    if t is None:
+        return
     if profile_path:
-        export_chrome_tracing(profile_path)
+        t.export_chrome_trace(profile_path)
     if sorted_key:
-        _print_summary(sorted_key)
+        _print_summary(t, sorted_key)
 
 
-def _print_summary(sorted_key="total"):
+def _print_summary(tracer, sorted_key="total"):
     agg = {}
-    for name, s, e in _events:
-        tot, cnt, mx = agg.get(name, (0, 0, 0))
-        agg[name] = (tot + (e - s), cnt + 1, max(mx, e - s))
+    for s in tracer.spans():
+        dur = (s.t1_ns or s.t0_ns) - s.t0_ns
+        tot, cnt, mx = agg.get(s.name, (0, 0, 0))
+        agg[s.name] = (tot + dur, cnt + 1, max(mx, dur))
     keyfn = {"total": lambda kv: kv[1][0],
              "max": lambda kv: kv[1][2],
              "calls": lambda kv: kv[1][1],
@@ -64,14 +86,14 @@ def _print_summary(sorted_key="total"):
 
 
 def export_chrome_tracing(path):
-    """Chrome trace like the reference's tools/timeline.py."""
-    trace = {"traceEvents": [
-        {"name": name, "ph": "X", "ts": s / 1e3,
-         "dur": (e - s) / 1e3, "pid": 0, "tid": 0}
-        for name, s, e in _events
-    ]}
-    with open(path, "w") as f:
-        json.dump(trace, f)
+    """Chrome trace like the reference's tools/timeline.py (exports the
+    CURRENT profiler session's spans; call before stop_profiler, or
+    pass profile_path to stop_profiler)."""
+    t = _prof_tracer
+    if t is None:
+        # legacy tolerance: an export after stop writes an empty trace
+        t = _trace.Tracer(capacity=1)
+    return t.export_chrome_trace(path)
 
 
 @contextlib.contextmanager
@@ -97,7 +119,8 @@ def device_trace(logdir="/tmp/paddle_tpu_trace"):
 
 
 def reset_profiler():
-    _events.clear()
+    if _prof_tracer is not None:
+        _prof_tracer.clear()
 
 
 def start_remote_profiler(endpoints):

@@ -23,6 +23,24 @@ import time
 import numpy as np
 
 from paddle_tpu.concurrency import BoundedQueue
+from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability import tracing as _trace
+
+# process-wide admission instruments (ISSUE 9).  The per-controller
+# counters() dict keeps its exact public shape; these aggregate across
+# controllers in the process under the typed ``outcome`` label so one
+# /metrics scrape sees every shed.
+_M_REQS = _obs_metrics.counter(
+    "paddle_tpu_admission_requests_total",
+    "admission outcomes by typed code (admitted / rejected_* / "
+    "answered_*)")
+_M_DEPTH = _obs_metrics.gauge(
+    "paddle_tpu_admission_queue_depth",
+    "admitted-but-untaken requests (last controller written wins in "
+    "multi-server processes)")
+_M_OUTSTANDING = _obs_metrics.gauge(
+    "paddle_tpu_admission_outstanding",
+    "admitted-but-unanswered requests")
 
 __all__ = [
     "ServingError", "OverloadedError", "DeadlineExpiredError",
@@ -75,7 +93,7 @@ class Request:
 
     __slots__ = ("id", "feeds", "rows", "deadline_t", "admitted_t",
                  "_event", "_lock", "_result", "_error", "_on_done",
-                 "done_t")
+                 "done_t", "trace")
 
     def __init__(self, req_id, feeds, rows, deadline_t, on_done=None):
         self.id = req_id
@@ -84,6 +102,7 @@ class Request:
         self.deadline_t = float(deadline_t)
         self.admitted_t = time.monotonic()
         self.done_t = None
+        self.trace = None             # (trace_id, span_id) when tracing
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result = None
@@ -164,7 +183,22 @@ class AdmissionController:
     # -- submit side --------------------------------------------------------
     def submit(self, feeds, deadline_s=None, request_id=None):
         """Admit a request or raise a typed ServingError.  feeds:
-        {name: ndarray} with a shared leading (batch) dim."""
+        {name: ndarray} with a shared leading (batch) dim.
+
+        When tracing is on, admission runs under a
+        ``serving.admission`` span (child of the caller's
+        ``serving.submit`` span) and the admitted Request carries the
+        span ctx — the batcher/replica/delivery stages chain onto it
+        so ONE trace id covers the request end to end."""
+        if _trace._tracer is not None:
+            with _trace._tracer.span("serving.admission") as sp:
+                req = self._submit_inner(feeds, deadline_s, request_id)
+                sp.set_attr("request_id", req.id)
+                req.trace = sp.ctx
+                return req
+        return self._submit_inner(feeds, deadline_s, request_id)
+
+    def _submit_inner(self, feeds, deadline_s, request_id):
         if self._draining:
             self._count("rejected_shutdown")
             raise ShutdownError("server is draining: not admitting")
@@ -201,15 +235,20 @@ class AdmissionController:
         with self._lock:
             self._outstanding[req.id] = req
             self._counters["admitted"] += 1
+            _M_OUTSTANDING.set(len(self._outstanding))
+        _M_REQS.inc(outcome="admitted")
+        _M_DEPTH.set(self._queue.qsize())
         return req
 
     # -- batcher side -------------------------------------------------------
     def take(self, timeout=0.002):
         """Pop the next admitted request (None on timeout)."""
         try:
-            return self._queue.get(timeout=timeout)
+            req = self._queue.get(timeout=timeout)
         except queue_mod.Empty:
             return None
+        _M_DEPTH.set(self._queue.qsize())
+        return req
 
     # -- drain / accounting -------------------------------------------------
     def start_drain(self):
@@ -237,15 +276,24 @@ class AdmissionController:
     def _count(self, key, n=1):
         with self._lock:
             self._counters[key] += n
+        _M_REQS.inc(n, outcome=key)
 
     def _on_done(self, req, exc):
         with self._lock:
             self._outstanding.pop(req.id, None)
+            _M_OUTSTANDING.set(len(self._outstanding))
             if exc is None:
-                self._counters["answered_ok"] += 1
+                key = "answered_ok"
             else:
                 code = getattr(exc, "code", "error")
-                self._counters[
-                    "answered_%s" % (code if "answered_%s" % code
-                                     in self._counters else "error")
-                ] += 1
+                key = "answered_%s" % (
+                    code if "answered_%s" % code in self._counters
+                    else "error")
+            self._counters[key] += 1
+        _M_REQS.inc(outcome=key)
+        if _trace._tracer is not None and req.trace is not None:
+            _trace._tracer.instant(
+                "serving.deliver", parent=req.trace,
+                request_id=req.id,
+                outcome="ok" if exc is None
+                else getattr(exc, "code", "error"))

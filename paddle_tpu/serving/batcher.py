@@ -21,10 +21,28 @@ import time
 
 import numpy as np
 
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability import tracing as _trace
 from paddle_tpu.serving.admission import DeadlineExpiredError
 
 __all__ = ["default_buckets", "signature_of", "Batch",
            "ShapeBucketBatcher"]
+
+_M_BATCHES = _obs_metrics.counter(
+    "paddle_tpu_batcher_batches_total",
+    "formed batches by bucket-cache temperature (cold = first time "
+    "this (signature, bucket) was formed)")
+_M_ROWS = _obs_metrics.counter(
+    "paddle_tpu_batcher_rows_total",
+    "rows through the batcher (real vs pad)")
+_M_OCCUPANCY = _obs_metrics.histogram(
+    "paddle_tpu_batcher_occupancy_ratio",
+    "real_rows / bucket per formed batch",
+    buckets=tuple(i / 8.0 for i in range(1, 9)))
+_M_SHED = _obs_metrics.counter(
+    "paddle_tpu_batcher_shed_expired_total",
+    "requests shed before batch formation (deadline passed)")
 
 
 def default_buckets(max_batch):
@@ -51,7 +69,7 @@ class Batch:
     """A formed (padded) batch plus the requests riding in it."""
 
     __slots__ = ("requests", "feeds", "rows", "bucket", "signature",
-                 "attempts")
+                 "attempts", "trace")
 
     def __init__(self, requests, feeds, rows, bucket, signature):
         self.requests = list(requests)
@@ -60,6 +78,7 @@ class Batch:
         self.bucket = int(bucket)
         self.signature = signature
         self.attempts = 0             # failover hops so far
+        self.trace = None             # oldest rider's span ctx
 
     def all_expired(self, now=None):
         now = time.monotonic() if now is None else now
@@ -186,6 +205,7 @@ class ShapeBucketBatcher:
         for r in reqs:
             if r.expired(now):
                 self._stats["shed_expired"] += 1
+                _M_SHED.inc()
                 r.fail(DeadlineExpiredError(
                     f"request {r.id}: deadline passed before batch "
                     "formation"))
@@ -222,6 +242,24 @@ class ShapeBucketBatcher:
                 self._stats["bucket_cold" if cold
                             else "bucket_warm"] += 1
             self._shapes.add((sig, bucket))
+            _M_BATCHES.inc(temperature="cold" if cold else "warm")
+            _M_ROWS.inc(rows, kind="real")
+            _M_ROWS.inc(bucket - rows, kind="pad")
+            _M_OCCUPANCY.observe(rows / float(bucket))
+            _flight.record("serving", "batch_formed", rows=rows,
+                           bucket=bucket, riders=len(chunk),
+                           cold=cold)
+            if _trace._tracer is not None:
+                # per-rider formation marker chained onto the request
+                # trace; the batch itself carries the OLDEST rider's
+                # ctx so the replica-stage span joins that trace
+                for r in chunk:
+                    sp = _trace._tracer.instant(
+                        "serving.batch", parent=r.trace,
+                        bucket=bucket, rows=rows, request_id=r.id)
+                    if r.trace is not None:
+                        r.trace = sp.ctx
+                batch.trace = chunk[0].trace
             # blocking put: dispatch backpressure stalls the batcher,
             # which stalls admission takes, which sheds at submit —
             # overload degrades with typed rejections, not queues

@@ -54,6 +54,11 @@ import numpy as np
 
 from paddle_tpu.concurrency import BoundedQueue, Supervisor
 from paddle_tpu.distributed import faultinject
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability import tracing as _trace
+from paddle_tpu.observability.export import (MetricsHTTPServer,
+                                             metrics_port_from_env)
 from paddle_tpu.ops.paged_kv import OutOfPagesError, PagedKVCache
 from paddle_tpu.serving.admission import (AdmissionController,
                                           DeadlineExpiredError,
@@ -65,6 +70,23 @@ __all__ = ["MSG_DECODE", "TinyDecodeLM", "DecodeConfig",
            "DecodeServer"]
 
 MSG_DECODE = "serving_decode"
+
+_M_DECODE = _obs_metrics.counter(
+    "paddle_tpu_decode_events_total",
+    "decode-server transitions (iterations / tokens_out / prefills / "
+    "kills / step_faults / failovers / preemptions / retires), by "
+    "event")
+_M_STEP_MS = _obs_metrics.histogram(
+    "paddle_tpu_decode_inter_token_seconds",
+    "per-sequence inter-token latency")
+_M_PAGE_UTIL = _obs_metrics.gauge(
+    "paddle_tpu_decode_page_utilization",
+    "in_use / num_pages of each replica's page pool, by replica "
+    "index", max_series=64)
+_M_ACTIVE = _obs_metrics.gauge(
+    "paddle_tpu_decode_active_seqs",
+    "sequences in the running batch, by replica index",
+    max_series=64)
 
 
 class TinyDecodeLM:
@@ -133,7 +155,7 @@ class DecodeConfig:
                  default_deadline_s=30.0, n_replicas=1,
                  restart_dead=True, max_attempts=None, eos_id=1,
                  kv_int8=None, head_pack=None, drain_timeout_s=30.0,
-                 impl=None):
+                 impl=None, metrics_port=None):
         self.max_batch = int(max_batch)
         self.max_new_tokens = int(max_new_tokens)
         self.page_size = int(page_size)
@@ -155,6 +177,12 @@ class DecodeConfig:
         self.head_pack = head_pack  # None -> the typed flag
         self.drain_timeout_s = float(drain_timeout_s)
         self.impl = impl            # flash_decode impl (None = auto)
+        # observability (ISSUE 9): /metrics + /varz on this server
+        # (None -> PADDLE_TPU_METRICS_PORT -> off; 0 = ephemeral)
+        if metrics_port is None:
+            metrics_port = metrics_port_from_env(None)
+        self.metrics_port = None if metrics_port is None \
+            else int(metrics_port)
 
 
 class _Seq:
@@ -162,7 +190,7 @@ class _Seq:
     failover unit — a survivor re-prefills from ``history``)."""
 
     __slots__ = ("req", "prompt", "generated", "max_new", "attempts",
-                 "slot", "last_token", "last_emit_t")
+                 "slot", "last_token", "last_emit_t", "trace")
 
     def __init__(self, req, prompt, max_new):
         self.req = req
@@ -173,6 +201,7 @@ class _Seq:
         self.slot = None
         self.last_token = None
         self.last_emit_t = None
+        self.trace = req.trace       # join/step/retire chain onto it
 
     def history(self):
         return self.prompt + self.generated
@@ -227,6 +256,7 @@ class DecodeServer:
                           "prefills": 0, "kills": 0, "step_faults": 0,
                           "failovers": 0, "preemptions": 0}
         self._step_ms = []          # bounded rolling inter-token record
+        self.metrics_server = None
         self._started = False
         self._stopped = False
 
@@ -234,6 +264,12 @@ class DecodeServer:
     def start(self):
         if not self._started:
             self._started = True
+            if self.config.metrics_port is not None:
+                try:
+                    self.metrics_server = MetricsHTTPServer(
+                        port=self.config.metrics_port).start()
+                except OSError:
+                    self.metrics_server = None
             self._sup.start()
         return self
 
@@ -250,7 +286,21 @@ class DecodeServer:
         """Admit a decode request (prompt token ids, 1-D int array) or
         raise a typed ServingError.  The Request future resolves to
         ``[generated_tokens]`` (np.int32, <= max_new_tokens, eos
-        included when emitted)."""
+        included when emitted).
+
+        When tracing is on, this is the ROOT span of the sequence's
+        trace (``decode.submit``); join -> step -> retire spans carry
+        its trace id."""
+        if _trace._tracer is not None:
+            with _trace._tracer.span("decode.submit",
+                                     request_id=request_id):
+                return self._submit_inner(prompt_ids, max_new_tokens,
+                                          deadline_s, request_id)
+        return self._submit_inner(prompt_ids, max_new_tokens,
+                                  deadline_s, request_id)
+
+    def _submit_inner(self, prompt_ids, max_new_tokens, deadline_s,
+                      request_id):
         if not self._started or self._stopped:
             self.admission._count("rejected_shutdown")
             raise ShutdownError("decode server not running")
@@ -356,6 +406,17 @@ class DecodeServer:
                 # loaded replica (not an attempt — nothing failed)
                 self._retry.put(seq)
                 return
+            if _trace._tracer is not None:
+                sp = _trace._tracer.instant(
+                    "decode.join", parent=seq.trace,
+                    request_id=seq.req.id, replica=rep.index,
+                    prompt_len=len(seq.prompt),
+                    attempt=seq.attempts)
+                if seq.trace is not None:
+                    seq.trace = sp.ctx
+            _flight.record("decode", "join", request_id=seq.req.id,
+                           replica=rep.index,
+                           prompt_len=len(seq.prompt))
             rep.active.append(seq)
 
     def _prefill(self, rep, seq):
@@ -457,6 +518,10 @@ class DecodeServer:
                 rep.cache.free(s.slot)
                 s.slot = None
                 self._count(preemptions=1)
+                _flight.record("decode", "preempt",
+                               request_id=s.req.id,
+                               replica=rep.index,
+                               tokens_so_far=len(s.generated))
                 self._retry.put(s)
                 slots = slots[:-1]
         # pow2 bucket of the table width: at most log2(max) distinct
@@ -478,6 +543,7 @@ class DecodeServer:
         next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
         t_emit = time.monotonic()
         rep.iterations += 1
+        tr = _trace._tracer
         still = []
         for s, tok in zip(rep.active, next_tokens):
             tok = int(tok)
@@ -488,14 +554,33 @@ class DecodeServer:
                     (t_emit - s.last_emit_t) * 1000.0)
             s.last_emit_t = t_emit
             rep.tokens_out += 1
+            if tr is not None:
+                tr.instant("decode.step", parent=s.trace,
+                           request_id=s.req.id, replica=rep.index,
+                           token=tok, n=len(s.generated))
             if tok == cfg.eos_id or len(s.generated) >= s.max_new:
                 rep.cache.free(s.slot)
                 s.slot = None
+                if tr is not None:
+                    tr.instant("decode.retire", parent=s.trace,
+                               request_id=s.req.id,
+                               replica=rep.index,
+                               tokens=len(s.generated))
+                _flight.record("decode", "retire",
+                               request_id=s.req.id,
+                               replica=rep.index,
+                               tokens=len(s.generated))
+                self._count(retires=1)
                 s.req.complete(
                     [np.asarray(s.generated, np.int32)])
             else:
                 still.append(s)
         rep.active = still
+        st = rep.cache.stats()
+        _M_PAGE_UTIL.set(
+            st["in_use_pages"] / float(max(1, st["num_pages"])),
+            replica=rep.index)
+        _M_ACTIVE.set(len(rep.active), replica=rep.index)
         self._count(iterations=1, tokens_out=len(next_tokens))
 
     def _fail_over(self, rep):
@@ -506,6 +591,11 @@ class DecodeServer:
         moved = rep.active
         rep.active = []
         rep.cache.reset()
+        _flight.record("decode", "replica_killed", replica=rep.index,
+                       live_seqs=len(moved))
+        # post-mortem: the ring holds the chaos action + the kill +
+        # every join/preempt that led here — dump the narrative
+        _flight.dump(reason="decode_replica_death")
         survivors = [r for r in self.replicas
                      if r.alive and r is not rep] \
             or ([rep] if self.config.restart_dead else [])
@@ -562,19 +652,28 @@ class DecodeServer:
                 if s.slot is not None:
                     rep.cache.free(s.slot)
             rep.active = []
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
         return leftovers
 
     # -- observability ------------------------------------------------------
     def _count(self, **incs):
         with self._lock:
             for k_, v_ in incs.items():
-                self._counters[k_] += v_
+                # registry-only events (retires) keep the public
+                # counters dict shape frozen (docs/DECODE.md)
+                if k_ in self._counters:
+                    self._counters[k_] += v_
+        for k_, v_ in incs.items():
+            _M_DECODE.inc(v_, event=k_)
 
     def _record_step_ms(self, ms):
         with self._lock:
             self._step_ms.append(ms)
             if len(self._step_ms) > 10000:
                 del self._step_ms[:5000]
+        _M_STEP_MS.observe(ms / 1000.0)
 
     def inter_token_ms(self):
         """(p50, p99) inter-token latency over the rolling record."""

@@ -38,8 +38,23 @@ import time
 from paddle_tpu.concurrency import BoundedQueue, Supervisor
 from paddle_tpu.distributed import faultinject
 from paddle_tpu.distributed.rpc import health_probe_interval
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability import tracing as _trace
 from paddle_tpu.serving.admission import (DeadlineExpiredError,
                                           ReplicaFailedError)
+
+_M_POOL = _obs_metrics.counter(
+    "paddle_tpu_replica_pool_events_total",
+    "replica-pool transitions (batches_ok / batches_failed / "
+    "requeues / probes / probe_failures / shed_expired_batches / "
+    "kills), by event")
+_M_LIVE = _obs_metrics.gauge(
+    "paddle_tpu_replica_pool_live_replicas",
+    "replicas currently alive (last pool written wins)")
+_M_BATCH_SECONDS = _obs_metrics.histogram(
+    "paddle_tpu_replica_batch_seconds",
+    "per-batch replica execution wall time")
 
 __all__ = ["MSG_INFER", "MSG_HEALTH", "ReplicaKilled", "ReplyLost",
            "Replica", "ReplicaPool", "replicate_predictor_params"]
@@ -109,7 +124,22 @@ class Replica:
     # -- execution ----------------------------------------------------------
     def run(self, batch):
         """Run one batch through the predictor, consulting the fault
-        plan first.  Returns the predictor's output list."""
+        plan first.  Returns the predictor's output list.
+
+        When tracing is on, execution runs under a ``serving.replica``
+        span joined to the batch's (oldest rider's) trace; the nested
+        ``predictor.run`` span picks it up from the thread-local
+        stack."""
+        if _trace._tracer is not None:
+            with _trace._tracer.span("serving.replica",
+                                     parent=batch.trace,
+                                     replica=self.index,
+                                     rows=batch.rows,
+                                     bucket=batch.bucket):
+                return self._run_inner(batch)
+        return self._run_inner(batch)
+
+    def _run_inner(self, batch):
         inj = faultinject.maybe_injector()
         steps = []
         if inj is not None:
@@ -119,6 +149,9 @@ class Replica:
         if steps and steps[0][0] in ("close", "kill"):
             if steps[0][0] == "kill":
                 self.alive = False
+                _flight.record("serving", "replica_killed",
+                               replica=self.index,
+                               batch_rows=batch.rows)
                 raise ReplicaKilled(
                     f"replica {self.index} killed mid-batch "
                     "(fault injection)")
@@ -301,17 +334,24 @@ class ReplicaPool:
                     continue
                 with self._lock:
                     self._in_flight += 1
+                t0 = time.perf_counter()
                 try:
                     outs = rep.run(batch)
                 except ReplicaKilled:
                     rep.record_failure()
                     self._requeue_or_fail(batch)
+                    self._count(kills=1)
+                    _M_LIVE.set(len(self.live_replicas()))
+                    # post-mortem: the ring now holds the chaos action
+                    # + the kill + the requeue — dump the narrative
+                    _flight.dump(reason="replica_death")
                     raise      # worker dies; supervisor may relaunch
                 except Exception:
                     rep.record_failure()
                     self._requeue_or_fail(batch)
                 else:
                     rep.record_ok()
+                    _M_BATCH_SECONDS.observe(time.perf_counter() - t0)
                     batch.deliver(outs)
                     self._count(batches_ok=1)
                 finally:
@@ -355,7 +395,12 @@ class ReplicaPool:
     def _count(self, **incs):
         with self._lock:
             for k, v in incs.items():
-                self._counters[k] += v
+                # 'kills' rides only the registry (the public
+                # counters() shape is frozen — docs/SERVING.md)
+                if k in self._counters:
+                    self._counters[k] += v
+        for k, v in incs.items():
+            _M_POOL.inc(v, event=k)
 
 
 def replicate_predictor_params(predictor, mesh=None):

@@ -25,6 +25,9 @@ from __future__ import annotations
 import time
 
 from paddle_tpu.concurrency import Supervisor
+from paddle_tpu.observability import tracing as _trace
+from paddle_tpu.observability.export import (MetricsHTTPServer,
+                                             metrics_port_from_env)
 from paddle_tpu.serving.admission import (AdmissionController,
                                           ReplicaFailedError,
                                           ShutdownError)
@@ -44,7 +47,7 @@ class ServingConfig:
                  breaker_threshold=3, breaker_cooldown_s=0.5,
                  health_interval_s=None, restart_dead=True,
                  max_batch_attempts=None, drain_timeout_s=30.0,
-                 prewarm=None):
+                 prewarm=None, metrics_port=None):
         self.max_batch = int(max_batch)
         self.buckets = tuple(buckets) if buckets is not None \
             else default_buckets(self.max_batch)
@@ -83,6 +86,13 @@ class ServingConfig:
                 prewarm = bool(
                     os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR"))
         self.prewarm = bool(prewarm)
+        # observability (ISSUE 9): mount /metrics + /varz on this
+        # server.  None -> PADDLE_TPU_METRICS_PORT -> off; 0 binds an
+        # ephemeral port (read server.metrics_server.port)
+        if metrics_port is None:
+            metrics_port = metrics_port_from_env(None)
+        self.metrics_port = None if metrics_port is None \
+            else int(metrics_port)
 
 
 class InferenceServer:
@@ -115,6 +125,7 @@ class InferenceServer:
             restart=True)
         self._validator = self.pool.replicas[0].predictor \
             if self.pool.replicas else None
+        self.metrics_server = None
         self._started = False
         self._stopped = False
 
@@ -123,6 +134,13 @@ class InferenceServer:
         if self._started:
             return self
         self._started = True
+        if self.config.metrics_port is not None:
+            try:
+                self.metrics_server = MetricsHTTPServer(
+                    port=self.config.metrics_port).start()
+            except OSError:
+                self.metrics_server = None   # scrape endpoint is an
+                #                              optimization, not a crash
         self.pool.start()
         if self.config.prewarm:
             self.prewarm_buckets()
@@ -163,7 +181,19 @@ class InferenceServer:
         ServingError synchronously when the request is NOT admitted
         (overloaded / expired / shutdown / no live replicas) and
         FeedValidationError when the feeds don't match the program's
-        feed targets (a malformed request must never poison a batch)."""
+        feed targets (a malformed request must never poison a batch).
+
+        When tracing is on, this is the ROOT span of the request's
+        trace (``serving.submit``): admission / batch / replica /
+        predictor / delivery spans all carry its trace id."""
+        if _trace._tracer is not None:
+            with _trace._tracer.span("serving.submit",
+                                     request_id=request_id):
+                return self._submit_inner(feeds, deadline_s,
+                                          request_id)
+        return self._submit_inner(feeds, deadline_s, request_id)
+
+    def _submit_inner(self, feeds, deadline_s, request_id):
         if not self._started or self._stopped:
             self.admission._count("rejected_shutdown")
             raise ShutdownError("server not running")
@@ -212,6 +242,9 @@ class InferenceServer:
         self._stopped = True
         self._sup.stop(join_timeout=2.0)
         self.pool.stop(join_timeout=2.0)
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
         return leftovers
 
     # -- observability ------------------------------------------------------

@@ -28,8 +28,17 @@ replays exactly:
 
 Prints one line of JSON to stdout as the verdict:
     {"ok": true, "mode": "cluster", "iterations": 7, "failures": [],
-     "seeds": [...], "transport": "socket", "wall_s": 123.4}
+     "seeds": [...], "transport": "socket", "wall_s": 123.4,
+     "flight_dumps": [...], "metrics": {...}}
 Exit code 0 iff every iteration passed.
+
+Observability (ISSUE 9): ``flight_dumps`` lists the crash
+flight-recorder dump files produced during the soak — in-process ones
+(serving mode: every injected replica kill dumps the causal event
+chain) plus any a pserver subprocess announced on stderr (the
+``FLIGHT RECORDER DUMP: <path>`` contract) — so a failing seed comes
+with its post-mortem narrative attached.  ``metrics`` embeds the
+process registry snapshot (same shape as tools/serving_load.py).
 """
 
 from __future__ import annotations
@@ -100,6 +109,20 @@ _RUNNER = textwrap.dedent("""
 """)
 
 
+_FLIGHT_RE = None
+
+
+def _scan_flight_dumps(stderr_text):
+    """Subprocess stderr -> dump paths (the flight-recorder announce
+    contract: 'FLIGHT RECORDER DUMP: <path> (reason=..., events=N)')."""
+    global _FLIGHT_RE
+    if _FLIGHT_RE is None:
+        import re
+
+        _FLIGHT_RE = re.compile(r"FLIGHT RECORDER DUMP: (\S+) ")
+    return _FLIGHT_RE.findall(stderr_text or "")
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -142,6 +165,8 @@ def run_iteration(seed, rate, max_faults, transport, timeout):
                 out, err = p.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
                 return False, f"trainer{tid} timed out (plan={plan})", 0
+            _subproc_flight_dumps.extend(
+                _scan_flight_dumps(err.decode(errors="replace")))
             if p.returncode != 0:
                 return (False, f"trainer{tid} rc={p.returncode}: "
                         f"{err.decode()[-500:]} (plan={plan})", 0)
@@ -158,6 +183,8 @@ def run_iteration(seed, rate, max_faults, transport, timeout):
                 out, err = p.communicate(timeout=60)
             except subprocess.TimeoutExpired:
                 return False, f"pserver hung at shutdown (plan={plan})", 0
+            _subproc_flight_dumps.extend(
+                _scan_flight_dumps(err.decode(errors="replace")))
             if p.returncode != 0:
                 return (False, f"pserver rc={p.returncode}: "
                         f"{err.decode()[-500:]} (plan={plan})", 0)
@@ -172,6 +199,7 @@ def run_iteration(seed, rate, max_faults, transport, timeout):
 
 
 _serving_model_dir = None
+_subproc_flight_dumps: list = []
 
 
 def run_serving_iteration(seed, rate, max_faults, timeout,
@@ -421,6 +449,19 @@ def main(argv=None):
               f"{'ok' if ok else 'FAIL: ' + detail}",
               file=sys.stderr)
         i += 1
+    # observability verdict surface (ISSUE 9): the post-mortem dump
+    # paths (in-process recorder + subprocess stderr announcements)
+    # and the process metrics snapshot ride the one-line verdict
+    flight_dumps = list(_subproc_flight_dumps)
+    metrics_snapshot = {}
+    try:
+        from paddle_tpu.observability import flight_recorder
+        from paddle_tpu.observability import metrics as obs_metrics
+
+        flight_dumps.extend(flight_recorder.dump_paths())
+        metrics_snapshot = obs_metrics.registry().snapshot()
+    except Exception:   # cluster mode may never import paddle_tpu
+        pass
     verdict = {
         "ok": not failures and bool(seeds),
         "mode": args.mode,
@@ -430,6 +471,8 @@ def main(argv=None):
         "faults_injected": total_faults,
         "transport": args.transport,
         "wall_s": round(time.monotonic() - t0, 1),
+        "flight_dumps": flight_dumps,
+        "metrics": metrics_snapshot,
     }
     print(json.dumps(verdict))
     return 0 if verdict["ok"] else 1

@@ -13,10 +13,20 @@ diagnostic naming the stalled barrier, the serving endpoint, and the
 waiters seen; this tool surfaces those lines next to the hang suspects
 so a wedged cluster test reports WHICH barrier/endpoint stalled rather
 than a bare timeout.
+
+Flight-recorder dumps (ISSUE 9): when a barrier times out or a
+replica dies, observability/flight_recorder.py writes the recent
+structured event ring to a file and announces it on stderr
+('FLIGHT RECORDER DUMP: <path> (reason=..., events=N)').  This tool
+finds those announcements in the log, and for each dump file that
+still exists renders the TAIL of the causal event chain next to the
+"Stalled barriers" section — the post-mortem narrative, inline.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import re
 import sys
 
@@ -33,6 +43,11 @@ _BARRIER = re.compile(
     r"barrier '(?P<name>[^']+)' @ (?P<endpoint>\S+) timed out after "
     r"(?P<timeout>[0-9.]+)s: (?P<arrived>\d+)/(?P<needed>\d+) "
     r"arrivals, waiters=\[(?P<waiters>[^\]]*)\]")
+# the flight-recorder announce contract (observability/flight_recorder
+# .py dump): FLIGHT RECORDER DUMP: <path> (reason=R, events=N)
+_FLIGHT = re.compile(
+    r"FLIGHT RECORDER DUMP: (?P<path>\S+) "
+    r"\(reason=(?P<reason>[\w.\-]+), events=(?P<events>\d+)\)")
 
 
 def scan(lines):
@@ -79,6 +94,47 @@ def scan_barriers(lines):
     return out
 
 
+def scan_flight_dumps(lines):
+    """Flight-recorder dump announcements found in the log:
+    [{path, reason, events}], deduplicated in first-seen order."""
+    out, seen = [], set()
+    for line in lines:
+        m = _FLIGHT.search(line)
+        if not m or m.group("path") in seen:
+            continue
+        seen.add(m.group("path"))
+        out.append({"path": m.group("path"),
+                    "reason": m.group("reason"),
+                    "events": int(m.group("events"))})
+    return out
+
+
+def render_flight_dump(rec, tail=8):
+    """Human lines for one dump record: header + the last `tail`
+    events of the causal chain (file may be gone — still report the
+    announcement)."""
+    lines = [f"  {rec['path']} (reason={rec['reason']}, "
+             f"events={rec['events']})"]
+    if not os.path.exists(rec["path"]):
+        lines.append("    (dump file no longer exists)")
+        return lines
+    try:
+        with open(rec["path"]) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        lines.append(f"    (unreadable: {e})")
+        return lines
+    for ev in doc.get("events", [])[-tail:]:
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("wall_time", "monotonic", "category",
+                              "event")}
+        lines.append(
+            "    %-10s %-18s %s"
+            % (ev.get("category", "?"), ev.get("event", "?"),
+               " ".join(f"{k}={v}" for k, v in sorted(extra.items()))))
+    return lines
+
+
 def main():
     if len(sys.argv) < 2:
         print(__doc__)
@@ -87,18 +143,24 @@ def main():
         lines = f.readlines()
     hung = scan(lines)
     barriers = scan_barriers(lines)
+    dumps = scan_flight_dumps(lines)
     if barriers:
         print("Stalled barriers (deadline diagnostics):")
         for b in barriers:
             print(f"  barrier '{b['name']}' @ {b['endpoint']}: "
                   f"{b['arrived']}/{b['needed']} arrivals after "
                   f"{b['timeout_s']:g}s, waiters={b['waiters']}")
+    if dumps:
+        print("Flight-recorder dumps (causal event chains):")
+        for rec in dumps:
+            for ln in render_flight_dump(rec):
+                print(ln)
     if hung:
         print("Hung (started, no outcome):")
         for t in sorted(hung):
             print(" ", t)
         return 1
-    if not barriers:
+    if not barriers and not dumps:
         print("No hung tests found.")
     return 0
 

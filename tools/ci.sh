@@ -104,11 +104,50 @@ assert len(lines) == 1, (
 rec = json.loads(lines[0])
 missing = {"metric", "value", "unit", "offered_qps", "goodput_qps",
            "p50_ms", "p99_ms", "admitted", "ok", "shed", "expired",
-           "failed_over", "accounted", "seed", "mode"} - set(rec)
+           "failed_over", "accounted", "seed", "mode",
+           "metrics"} - set(rec)
 assert not missing, "serving_load JSON missing fields: %s" % (
     sorted(missing),)
 assert rec["accounted"] is True, "request accounting broken: %r" % rec
-print("serving_load stdout contract OK: 1 line, %d fields" % len(rec))
+# ISSUE 9: the embedded metrics-registry snapshot must parse and
+# carry the admission instrument with a nonzero admitted series
+m = rec["metrics"]
+assert isinstance(m, dict) and \
+    "paddle_tpu_admission_requests_total" in m, sorted(m)[:10]
+adm = m["paddle_tpu_admission_requests_total"]["series"]
+admitted = sum(s["value"] for s in adm
+               if s["labels"].get("outcome") == "admitted")
+assert admitted > 0, adm
+print("serving_load stdout contract OK: 1 line, %d fields, "
+      "%d instruments in metrics snapshot" % (len(rec), len(m)))
+PY
+
+echo "== 5c/8 observability smoke (tracing on: one trace id end-to-end) =="
+# ISSUE 9 acceptance gate: with the tracing flag on, a seeded serving
+# round-trip and a decode sequence each carry ONE trace id across
+# every stage (submit->admission->batch->replica->Predictor.run->
+# delivery; join->step->retire), the pserver-side handler span joins
+# the client's trace via the RPC envelope, and the /metrics exposition
+# parses under the in-tree prometheus grammar check (no external dep).
+JAX_PLATFORMS=cpu python tools/observability_smoke.py \
+  > /tmp/_obs_smoke.json
+cat /tmp/_obs_smoke.json
+python - <<'PY'
+import json
+lines = [ln for ln in open("/tmp/_obs_smoke.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, (
+    "observability smoke stdout must be exactly ONE JSON line — got "
+    "%d" % len(lines))
+rec = json.loads(lines[0])
+for k in ("serving_trace_ok", "decode_trace_ok", "rpc_trace_joined",
+          "prometheus_ok", "flight_ok"):
+    assert rec.get(k) is True, (k, rec)
+assert rec["serving_trace_id"] and rec["decode_trace_id"]
+print("observability smoke OK: serving trace %s, decode trace %s, "
+      "%d prom samples" % (rec["serving_trace_id"],
+                           rec["decode_trace_id"],
+                           rec["prom_samples"]))
 PY
 
 echo "== 6/8 per-op regression gate (hot ops vs committed CPU baseline) =="

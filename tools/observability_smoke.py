@@ -1,0 +1,162 @@
+"""Seeded serving + decode observability smoke (ISSUE 9, ci.sh gate).
+
+With the ``tracing`` flag ON, runs one request through the
+InferenceServer and one sequence through the DecodeServer, then
+asserts the end-to-end trace contract:
+
+  - serving: ONE trace id covers submit -> admission -> batch ->
+    replica -> Predictor.run -> delivery;
+  - decode:  ONE trace id covers submit -> join -> step -> retire ->
+    delivery;
+  - rpc: a pserver-side handler span joins the CLIENT's trace via the
+    RPC envelope (socket transport, in-process server);
+  - /metrics on the serving server parses under the in-tree prometheus
+    grammar check (observability.export.parse_prometheus_text — no
+    external dep) and carries the core instruments;
+  - an explicit flight-recorder dump round-trips through its JSON file.
+
+stdout contract: EXACTLY ONE JSON line (the same driver/gate shape as
+bench.py / serving_load.py); progress goes to stderr.  Exit 0 iff every
+assertion held.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PADDLE_TPU_TRACING"] = "1"
+
+
+def _log(msg):
+    print("# " + msg, file=sys.stderr)
+
+
+def trace_names(tracer, root_name):
+    """(trace_id, {span names}) for the trace rooted at `root_name`."""
+    roots = [s for s in tracer.spans() if s.name == root_name]
+    if not roots:
+        return None, set()
+    tid = roots[0].trace_id
+    return tid, {s.name for s in tracer.spans() if s.trace_id == tid}
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import inference, layers, serving
+    from paddle_tpu.observability import flight_recorder, tracing
+    from paddle_tpu.observability.export import parse_prometheus_text
+
+    tracer = tracing.start_tracing()
+    verdict = {"metric": "observability_smoke", "value": 1,
+               "unit": "ok", "ok": False}
+    checks = {}
+
+    # -- serving leg --------------------------------------------------------
+    _log("building tiny fc model")
+    x = layers.data("x", shape=[8], dtype="float32")
+    pred = layers.fc(x, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mdir = os.path.join(tempfile.mkdtemp(), "model")
+    fluid.io.save_inference_model(mdir, ["x"], [pred], exe)
+
+    srv = serving.InferenceServer(
+        lambda i: inference.create_predictor(inference.Config(mdir)),
+        serving.ServingConfig(n_replicas=1, max_batch=4,
+                              metrics_port=0)).start()
+    try:
+        srv.infer({"x": np.zeros((1, 8), np.float32)},
+                  deadline_s=30.0, timeout=30.0)
+        tid, names = trace_names(tracer, "serving.submit")
+        need = {"serving.submit", "serving.admission", "serving.batch",
+                "serving.replica", "predictor.run", "serving.deliver"}
+        checks["serving_trace_ok"] = bool(tid) and need <= names
+        verdict["serving_trace_id"] = tid
+        verdict["serving_trace_spans"] = sorted(names)
+        _log("serving trace %s: %s" % (tid, sorted(names)))
+
+        # /metrics exposition parses under the in-tree grammar
+        import urllib.request
+
+        body = urllib.request.urlopen(
+            srv.metrics_server.url + "/metrics", timeout=10).read()
+        samples = parse_prometheus_text(body.decode("utf-8"))
+        sample_names = {n for n, _, _ in samples}
+        core = {"paddle_tpu_admission_requests_total",
+                "paddle_tpu_batcher_batches_total",
+                "paddle_tpu_executor_step_seconds_count"}
+        checks["prometheus_ok"] = core <= sample_names
+        verdict["prom_samples"] = len(samples)
+        _log("prometheus: %d samples, core present=%s"
+             % (len(samples), core <= sample_names))
+    finally:
+        srv.stop()
+
+    # -- decode leg ---------------------------------------------------------
+    dsrv = serving.DecodeServer(config=serving.DecodeConfig(
+        max_batch=2, max_new_tokens=4, page_size=16, num_pages=16,
+        n_replicas=1)).start()
+    try:
+        dsrv.decode([2, 3, 4], deadline_s=30.0, timeout=30.0)
+        dtid, dnames = trace_names(tracer, "decode.submit")
+        dneed = {"decode.submit", "decode.join", "decode.step",
+                 "decode.retire", "serving.deliver"}
+        checks["decode_trace_ok"] = bool(dtid) and dneed <= dnames
+        verdict["decode_trace_id"] = dtid
+        verdict["decode_trace_spans"] = sorted(dnames)
+        _log("decode trace %s: %s" % (dtid, sorted(dnames)))
+    finally:
+        dsrv.stop()
+
+    # -- rpc envelope leg ---------------------------------------------------
+    from paddle_tpu.distributed.rpc import RPCClient, RPCServer
+
+    rsrv = RPCServer("127.0.0.1:0").start()
+    rsrv.register_handler("ping", lambda p: p)
+    client = RPCClient()
+    try:
+        client.call(rsrv.endpoint, "ping", "x", retries=0)
+        cspans = [s for s in tracer.spans()
+                  if s.name == "rpc.client:ping"]
+        sspans = [s for s in tracer.spans()
+                  if s.name == "rpc.server:ping"]
+        checks["rpc_trace_joined"] = bool(
+            cspans and sspans
+            and sspans[-1].trace_id == cspans[-1].trace_id
+            and sspans[-1].parent_id == cspans[-1].span_id)
+        _log("rpc envelope joined=%s" % checks["rpc_trace_joined"])
+    finally:
+        client.close()
+        rsrv.stop()
+
+    # -- flight recorder round-trip ----------------------------------------
+    flight_recorder.record("smoke", "probe", n=1)
+    path = flight_recorder.dump(reason="smoke", announce=False)
+    doc = flight_recorder.load_dump(path) if path else {}
+    checks["flight_ok"] = bool(path) and any(
+        ev.get("category") == "smoke" for ev in doc.get("events", []))
+    verdict["flight_dump"] = path
+
+    tracing.stop_tracing()
+    verdict.update(checks)
+    verdict["ok"] = all(checks.values())
+    verdict["value"] = int(verdict["ok"])
+    print(json.dumps(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

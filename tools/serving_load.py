@@ -40,6 +40,14 @@ fixed/overload modes additionally bank the warm-vs-cold PAIR:
 ServingConfig(prewarm=True) — the full bucket set compiled/replayed
 at replica start before the probe).
 
+Observability (ISSUE 9): every mode's JSON line embeds a ``metrics``
+object — the process metrics-registry snapshot
+(``observability.metrics.registry().snapshot()``: admission outcomes,
+batcher occupancy, replica pool, decode, executor step/compile
+instruments; histograms summarized to count/sum/p50/p95/p99 so the
+single-line contract stays bounded).  ci.sh step 5b gates that the
+field parses and carries the admission instrument.
+
 Replayable: the arrival schedule is fully determined by --seed.
 """
 
@@ -378,10 +386,13 @@ def main(argv=None):
                 mean_prompt=args.mean_prompt, max_new=args.max_new)
         finally:
             srv.stop()
+        from paddle_tpu.observability import metrics as obs_metrics
+
         rec.update({
             "metric": "decode_tokens_per_sec",
             "value": rec["tokens_per_sec"],
             "unit": "tok/s",
+            "metrics": obs_metrics.registry().snapshot(),
             "time_to_first_batch_s": round(ttfb, 3),
             "time_to_first_batch_cold_s": round(ttfb, 3),
             "time_to_first_batch_warm_s": None,
@@ -441,10 +452,13 @@ def main(argv=None):
             ttfb_warm = probe_first_batch(srv2)
         finally:
             srv2.stop()
+    from paddle_tpu.observability import metrics as obs_metrics
+
     rec.update({
         "metric": "serving_goodput",
         "value": rec["goodput_qps"],
         "unit": "req/s",
+        "metrics": obs_metrics.registry().snapshot(),
         "capacity_qps": round(cap_qps, 1) if cap_qps else None,
         "time_to_first_batch_s": round(ttfb, 3),
         "time_to_first_batch_cold_s": round(ttfb, 3),
