@@ -30,6 +30,7 @@ import numpy as np
 from paddle_tpu.core.program import BlockRef, Program
 from paddle_tpu.core.registry import get_op_def, has_op_def
 from paddle_tpu.core.scope import Scope
+from paddle_tpu.observability import device_trace as _obs_device
 from paddle_tpu.observability import flight_recorder as _obs_flight
 from paddle_tpu.observability import metrics as _obs_metrics
 from paddle_tpu.observability import tracing as _obs_trace
@@ -829,7 +830,12 @@ class CompiledProgram:
                 "executor", "compile",
                 n_feeds=len(feed_specs), n_fetch=len(fetch_names))
             if _obs_trace._tracer is not None:
-                with _obs_trace._tracer.span("executor.compile"):
+                # the device-trace annotation carries the active trace
+                # id into the jax.profiler timeline (ISSUE 10) — the
+                # span puts the ctx on the thread-local stack first,
+                # so annotate() picks it up
+                with _obs_trace._tracer.span("executor.compile"), \
+                        _obs_device.annotate("executor.compile"):
                     fn = self._build_fn(
                         list(feeds), feed_specs, fetch_names,
                         state_specs, feed_shardings=feed_shardings)
@@ -864,7 +870,8 @@ class CompiledProgram:
 
         t0 = _time.perf_counter()
         if _obs_trace._tracer is not None:
-            with _obs_trace._tracer.span("executor.step"):
+            with _obs_trace._tracer.span("executor.step"), \
+                    _obs_device.annotate("executor.step"):
                 new_state, fetches = fn(state, feeds)
         else:
             new_state, fetches = fn(state, feeds)

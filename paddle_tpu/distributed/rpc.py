@@ -870,11 +870,15 @@ class RPCClient:
         span = None
         if _trace._tracer is not None:
             # the distributed-trace envelope: the server-side handler
-            # span joins THIS trace id (one conditional when off)
+            # span joins THIS trace id (one conditional when off).
+            # Head sampling (ISSUE 10): a dropped trace sends NO
+            # envelope — the wire is byte-identical to flag-off, and
+            # the server never sees a partial trace
             span = _trace._tracer.start_span(
                 "rpc.client:" + msg_type, endpoint=endpoint)
-            payload = (_TRACE_TAG, span.trace_id, span.span_id,
-                       payload)
+            if span.sampled:
+                payload = (_TRACE_TAG, span.trace_id, span.span_id,
+                           payload)
         try:
             try:
                 self._breaker_gate(endpoint)

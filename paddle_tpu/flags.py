@@ -196,8 +196,15 @@ define_flag("tracing", False,
             "delivery, decode sequences span join -> step -> retire, "
             "and the id rides the RPC envelope so pserver handler "
             "spans join the caller's trace.  Export: chrome-trace "
-            "JSON merged by tools/timeline.py "
-            "(docs/OBSERVABILITY.md)")
+            "JSON merged by tools/timeline.py.  Head sampling "
+            "(ISSUE 10): PADDLE_TPU_TRACE_SAMPLE / "
+            "ServingConfig.trace_sample in [0.0, 1.0] decides ONCE "
+            "per trace id (deterministic hash, inherited by children "
+            "and the RPC envelope — no partial traces); 0.0 is wire- "
+            "and cost-identical to flag-off; with the flag on, Pallas "
+            "kernel entries and executor steps also emit "
+            "jax.profiler annotations carrying the trace id "
+            "(observability/device_trace.py, docs/OBSERVABILITY.md)")
 define_flag("int8_conv_algo", "conv",
             "conv2d_int8 lowering: 'conv' = integer "
             "conv_general_dilated; 'im2col' = pad/slice/concat + one "

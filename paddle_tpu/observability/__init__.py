@@ -30,9 +30,12 @@ stack needs all three (docs/OBSERVABILITY.md).
 stop_profiler/RecordEvent surface) is a thin shim over tracing.py.
 """
 
+from paddle_tpu.observability import device_trace
 from paddle_tpu.observability import flight_recorder
 from paddle_tpu.observability import metrics
+from paddle_tpu.observability import slo
 from paddle_tpu.observability import tracing
+from paddle_tpu.observability.device_trace import DeviceTraceSession
 from paddle_tpu.observability.export import (MetricsHTTPServer,
                                              metrics_port_from_env,
                                              parse_prometheus_text)
@@ -41,15 +44,17 @@ from paddle_tpu.observability.metrics import (Counter, Gauge,
                                               Histogram,
                                               MetricsRegistry,
                                               registry)
+from paddle_tpu.observability.slo import SLO, SLOMonitor
 from paddle_tpu.observability.tracing import (Span, Tracer,
                                               maybe_tracer,
                                               start_tracing,
                                               stop_tracing)
 
 __all__ = [
-    "Counter", "FlightRecorder", "Gauge", "Histogram",
-    "MetricsHTTPServer", "MetricsRegistry", "Span", "Tracer",
-    "flight_recorder", "maybe_tracer", "metrics",
-    "metrics_port_from_env", "parse_prometheus_text", "registry",
-    "start_tracing", "stop_tracing", "tracing",
+    "Counter", "DeviceTraceSession", "FlightRecorder", "Gauge",
+    "Histogram", "MetricsHTTPServer", "MetricsRegistry", "SLO",
+    "SLOMonitor", "Span", "Tracer", "device_trace", "flight_recorder",
+    "maybe_tracer", "metrics", "metrics_port_from_env",
+    "parse_prometheus_text", "registry", "slo", "start_tracing",
+    "stop_tracing", "tracing",
 ]

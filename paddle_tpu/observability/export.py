@@ -7,7 +7,12 @@ prometheus text-format grammar checker.
     /varz       the registry snapshot as one JSON document
     /flightz    recent flight-recorder events (JSON)
     /tracez     finished-span summary when tracing is on (JSON)
-    /healthz    {"status": "ok"}
+    /sloz       the SLO engine's evaluation (objectives, attainment,
+                fast/slow burn rates, firing alerts — observability/
+                slo.py; evaluates on request)
+    /healthz    {"status": "ok"} — DEGRADED to {"status": "degraded",
+                "alerts": [...]} while any SLO burn-rate alert fires
+                (ISSUE 10: the load balancer's view of the SLO engine)
 
 It is mountable on every long-running process of the stack:
 ``listen_and_serv`` (attr ``metrics_port`` / env
@@ -33,6 +38,21 @@ from paddle_tpu.observability import tracing as _tracing
 
 __all__ = ["MetricsHTTPServer", "parse_prometheus_text",
            "metrics_port_from_env"]
+
+
+def _slo_firing():
+    """Firing SLO alerts — re-evaluated live when a monitor exists,
+    [] (never a crash, never a forced monitor) otherwise."""
+    try:
+        from paddle_tpu.observability import slo as _slo
+
+        m = _slo._monitor
+        if m is None:
+            return []
+        m.observe()
+        return m.firing()
+    except Exception:
+        return []
 
 
 def metrics_port_from_env(default=None):
@@ -104,8 +124,21 @@ class MetricsHTTPServer:
                     self._send(json.dumps(
                         {"enabled": t is not None, "spans": spans}),
                         "application/json")
+                elif path == "/sloz":
+                    from paddle_tpu.observability import slo as _slo
+
+                    self._send(json.dumps(_slo.monitor().sloz(),
+                                          sort_keys=True),
+                               "application/json")
                 elif path == "/healthz":
-                    self._send('{"status": "ok"}', "application/json")
+                    firing = _slo_firing()
+                    if firing:
+                        self._send(json.dumps(
+                            {"status": "degraded", "alerts": firing}),
+                            "application/json")
+                    else:
+                        self._send('{"status": "ok"}',
+                                   "application/json")
                 else:
                     self.send_error(404)
 

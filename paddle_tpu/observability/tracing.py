@@ -34,25 +34,56 @@ microseconds) compatible with the existing ``tools/timeline.py``
 multi-worker merge; ``paddle_tpu/profiler.py`` is a Fluid-shaped shim
 over this module.
 
+Head-based sampling (ISSUE 10, the Dapper shape): the sampling
+decision is made ONCE, at trace-id creation, as a deterministic hash
+of the id — ``sha256(trace_id) / 2^64 < rate`` — so every span of a
+trace (children, cross-thread stages, the RPC-enveloped server side)
+recomputes the SAME verdict from the id it inherited: a trace is
+never half-sampled, and two processes at the same rate agree without
+carrying the verdict on the wire.  Unsampled spans still propagate
+ctx (parenting stays correct) but record nothing and send NO RPC
+envelope; per-path sampled/dropped root counters land in the metrics
+registry (``paddle_tpu_trace_traces_total``).  Rate 0.0 does not
+install the tracer at all — cost- and wire-identical to flag-off
+(the disabled-cost contract extends to it).  Rate 1.0 is bit-identical
+to unsampled tracing.  ``PADDLE_TPU_TRACE_SEED`` makes trace-id
+generation itself deterministic, so two runs with the same seed sample
+the same ids (replayable production sampling).
+
 Env knobs: ``PADDLE_TPU_TRACING=1`` turns the flag on at import;
 ``PADDLE_TPU_TRACE_CAPACITY`` bounds the finished-span ring (default
 65536 spans — tracing memory is bounded no matter how long the
-process runs).
+process runs); ``PADDLE_TPU_TRACE_SAMPLE`` in [0.0, 1.0] (default
+1.0) is the head-sampling rate; ``PADDLE_TPU_TRACE_SEED`` seeds the
+trace-id stream.
 """
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import os
+import random
 import threading
 import time
 import uuid
 
+from paddle_tpu.observability import metrics as _metrics
+
 __all__ = [
     "Span", "Tracer", "start_tracing", "stop_tracing", "maybe_tracer",
     "enabled", "current", "span", "export_chrome_trace",
+    "sample_rate", "set_sample_rate", "sampled",
 ]
+
+# per-path (root span name) sampled/dropped counters — the ISSUE 10
+# observability of the sampler itself.  sampled + dropped == offered
+# root creations at any rate (asserted by the 5c smoke).
+_M_TRACES = _metrics.counter(
+    "paddle_tpu_trace_traces_total",
+    "trace roots by path (root span name) and head-sampling verdict",
+    max_series=256)
 
 # THE module global every span site checks (one load + None test).
 _tracer = None
@@ -64,16 +95,42 @@ def _env_int(name, default):
     return default if not v else int(v)
 
 
+def _env_float(name, default):
+    v = os.environ.get(name)
+    return default if not v else float(v)
+
+
+def _resolve_sample(sample):
+    """Explicit arg wins; else PADDLE_TPU_TRACE_SAMPLE; else 1.0."""
+    if sample is None:
+        sample = _env_float("PADDLE_TPU_TRACE_SAMPLE", 1.0)
+    sample = float(sample)
+    if not 0.0 <= sample <= 1.0:
+        raise ValueError(
+            "trace sample rate must be in [0.0, 1.0], got %r" % sample)
+    return sample
+
+
+def _hash01(trace_id):
+    """Deterministic [0, 1) hash of a trace id — THE sampling verdict
+    function (docs/OBSERVABILITY.md sampling determinism contract):
+    any holder of the id recomputes the same verdict, in any process,
+    in any run."""
+    h = hashlib.sha256(trace_id.encode("ascii", "replace")).digest()
+    return int.from_bytes(h[:8], "big") / 2.0 ** 64
+
+
 class Span:
     """One timed span.  Use as a context manager (activates on the
     thread-local stack so nested sites pick it up as parent) or call
     ``end()`` manually (cross-thread stages that can't nest)."""
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "t0_ns",
-                 "t1_ns", "attrs", "thread", "_tracer", "_active")
+                 "t1_ns", "attrs", "thread", "sampled", "_tracer",
+                 "_active")
 
     def __init__(self, tracer, name, trace_id, span_id, parent_id,
-                 attrs):
+                 attrs, sampled=True):
         self.name = name
         self.trace_id = trace_id
         self.span_id = span_id
@@ -82,6 +139,7 @@ class Span:
         self.thread = threading.get_ident()
         self.t0_ns = time.perf_counter_ns()
         self.t1_ns = None
+        self.sampled = sampled
         self._tracer = tracer
         self._active = False
 
@@ -98,7 +156,9 @@ class Span:
     def end(self):
         if self.t1_ns is None:
             self.t1_ns = time.perf_counter_ns()
-            self._tracer._record(self)
+            if self.sampled:   # dropped traces record NOTHING: no
+                #                partial traces exist at any rate
+                self._tracer._record(self)
         return self
 
     def __enter__(self):
@@ -122,9 +182,14 @@ class Span:
 
 
 class Tracer:
-    """Span factory + bounded ring of finished spans."""
+    """Span factory + bounded ring of finished spans.
 
-    def __init__(self, capacity=None):
+    ``sample`` in [0.0, 1.0] is the head-sampling rate (default: the
+    ``PADDLE_TPU_TRACE_SAMPLE`` env knob, else 1.0).  ``seed`` (default
+    ``PADDLE_TPU_TRACE_SEED``) makes the trace-id stream deterministic
+    so two runs with the same seed sample the same ids."""
+
+    def __init__(self, capacity=None, sample=None, seed=None):
         self.capacity = capacity if capacity is not None else \
             _env_int("PADDLE_TPU_TRACE_CAPACITY", 65536)
         self._ring = [None] * int(self.capacity)
@@ -132,8 +197,30 @@ class Tracer:
         self._count = 0          # highest slot written + 1 (read path)
         self._sid = itertools.count(1)
         self.dropped = 0
+        self.sample_rate = _resolve_sample(sample)
+        if seed is None:
+            env_seed = os.environ.get("PADDLE_TPU_TRACE_SEED")
+            seed = int(env_seed) if env_seed else None
+        self._rng = random.Random(seed) if seed is not None else None
+        self.sampled_roots = 0
+        self.dropped_roots = 0
 
     # -- creation -----------------------------------------------------------
+    def _new_trace_id(self):
+        if self._rng is not None:
+            return "%016x" % self._rng.getrandbits(64)
+        return uuid.uuid4().hex[:16]
+
+    def _verdict(self, trace_id):
+        """The head-sampling verdict for a trace id — deterministic,
+        so children/servers holding only the id reach the same answer
+        (the inheritance contract)."""
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        return _hash01(trace_id) < self.sample_rate
+
     def _ids(self, parent):
         if parent is None:
             parent = current()
@@ -142,13 +229,26 @@ class Tracer:
         if parent is not None:
             trace_id, parent_id = parent
         else:
-            trace_id, parent_id = uuid.uuid4().hex[:16], None
+            trace_id, parent_id = self._new_trace_id(), None
         return trace_id, "%x" % next(self._sid), parent_id
 
     def start_span(self, name, parent=None, **attrs):
         """A running span; caller must ``end()`` it (or use ``span``)."""
         trace_id, span_id, parent_id = self._ids(parent)
-        return Span(self, name, trace_id, span_id, parent_id, attrs)
+        sampled = self._verdict(trace_id)
+        if parent_id is None:
+            # per-path sampled/dropped accounting at ROOT creation —
+            # the decision point (head-based: decided once per trace)
+            if sampled:
+                self.sampled_roots += 1
+            else:
+                self.dropped_roots += 1
+            if self.sample_rate < 1.0:
+                _M_TRACES.inc(path=name,
+                              verdict="sampled" if sampled
+                              else "dropped")
+        return Span(self, name, trace_id, span_id, parent_id, attrs,
+                    sampled=sampled)
 
     def span(self, name, parent=None, **attrs):
         """Context-manager form: activates on the thread-local stack so
@@ -223,11 +323,24 @@ class Tracer:
 
 # -- module-level switch ----------------------------------------------------
 
-def start_tracing(capacity=None):
-    """Install the process tracer (idempotent); returns it."""
+def start_tracing(capacity=None, sample=None, seed=None):
+    """Install the process tracer (idempotent); returns it.
+
+    ``sample`` is the head-sampling rate (default: the
+    ``PADDLE_TPU_TRACE_SAMPLE`` env knob, else 1.0).  Rate 0.0 installs
+    NOTHING and returns None — every span site stays at the
+    one-conditional disabled cost and the RPC wire carries no trace
+    envelope, identical to the flag being off (the ISSUE 10
+    sample=0.0 contract)."""
     global _tracer
+    rate = _resolve_sample(sample)
+    if rate <= 0.0:
+        _tracer = None
+        return None
     if _tracer is None:
-        _tracer = Tracer(capacity=capacity)
+        _tracer = Tracer(capacity=capacity, sample=rate, seed=seed)
+    else:
+        _tracer.sample_rate = rate
     return _tracer
 
 
@@ -249,6 +362,37 @@ def maybe_tracer():
 
 def enabled():
     return _tracer is not None
+
+
+def sample_rate():
+    """The installed tracer's head-sampling rate (0.0 when tracing is
+    off — rate 0.0 and flag-off are the same state by construction)."""
+    t = _tracer
+    return 0.0 if t is None else t.sample_rate
+
+
+def set_sample_rate(rate):
+    """Change the head-sampling rate of the running tracer
+    (``ServingConfig.trace_sample`` lands here at server start).  Rate
+    0.0 uninstalls the tracer — back to the one-conditional disabled
+    cost; raising it from 0.0 re-installs only if the ``tracing`` flag
+    ever started one (a no-op otherwise: the flag owns on/off, the
+    rate owns how much).  Returns the tracer or None."""
+    global _tracer
+    rate = _resolve_sample(float(rate))
+    if rate <= 0.0:
+        _tracer = None
+        return None
+    if _tracer is not None:
+        _tracer.sample_rate = rate
+    return _tracer
+
+
+def sampled(trace_id):
+    """The deterministic verdict for ``trace_id`` under the current
+    tracer (False when tracing is off)."""
+    t = _tracer
+    return False if t is None else t._verdict(trace_id)
 
 
 def current():

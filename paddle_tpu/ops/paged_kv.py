@@ -44,8 +44,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.observability import device_trace as _obs_device
 from paddle_tpu.observability import flight_recorder as _flight
 from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability import tracing as _obs_trace
 
 __all__ = ["OutOfPagesError", "PagedKVCache", "quantize_kv",
            "dequantize_kv", "kv_scales_of"]
@@ -260,6 +262,14 @@ class PagedKVCache:
         (fixed-shape calls = one compile).  One fused device scatter;
         new pages are taken from the free list as sequences cross a
         page boundary (OutOfPagesError leaves lengths untouched)."""
+        if _obs_trace._tracer is not None:
+            # device-time attribution (ISSUE 10): the batched append
+            # scatter is a decode-step hot spot worth its own lane
+            with _obs_device.annotate("paged_kv_append"):
+                return self._append_inner(slots, k, v)
+        return self._append_inner(slots, k, v)
+
+    def _append_inner(self, slots, k, v):
         slots = list(slots)
         self._maybe_calibrate(jnp.asarray(k), jnp.asarray(v))
         page_ids, offsets = [], []

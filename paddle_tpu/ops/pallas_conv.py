@@ -47,6 +47,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.observability import device_trace as _obs_device
+from paddle_tpu.observability import tracing as _obs_trace
+
 # jax renamed TPUCompilerParams -> CompilerParams around 0.5; support
 # both so the kernel lowers under the CI jax as well as the chip
 # host's (the seed's TPU cross-lowering tests failed on exactly this
@@ -596,6 +599,12 @@ def conv2d_epilogue(x, w, bias=None, residual=None, *, strides=(1, 1),
         impl = "pallas" if _on_tpu() else "xla"
     strides = tuple(int(s) for s in strides)
     padding = _norm_padding(paddings)
+    if _obs_trace._tracer is not None:
+        # device-time attribution (ISSUE 10): annotation at runtime,
+        # named_scope inside a jit trace — one module-global check off
+        with _obs_device.annotate("conv2d_epilogue"):
+            return _conv_ep(x, w, bias, residual, strides, padding,
+                            act or "", impl)
     return _conv_ep(x, w, bias, residual, strides, padding,
                     act or "", impl)
 
@@ -731,6 +740,11 @@ def conv2d_bn_act(x, w, scale, shift, bias=None, residual=None, *,
         impl = "pallas" if _on_tpu() else "xla"
     strides = tuple(int(s) for s in strides)
     padding = _norm_padding(paddings)
+    if _obs_trace._tracer is not None:
+        with _obs_device.annotate("conv2d_bn_act"):
+            return _conv_bn_act(x, w, bias, scale, shift, residual,
+                                strides, padding, act or "",
+                                float(epsilon), impl)
     return _conv_bn_act(x, w, bias, scale, shift, residual, strides,
                         padding, act or "", float(epsilon), impl)
 

@@ -61,6 +61,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.observability import device_trace as _obs_device
+from paddle_tpu.observability import tracing as _obs_trace
+
 _NEG_INF = -1e30
 _MIN_LANES = 128  # TPU vector lane count; m/l scratch padded to this
 _F32_SUBLANES = 8  # f32 min sublane tile — gates the packed-stats block
@@ -750,6 +753,13 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=None,
     block_q = block_q or _default_block(q.shape[-2])
     block_k = block_k or _default_block(k.shape[-2])
     packed_stats, head_pack = _resolve_variants(packed_stats, head_pack)
+    if _obs_trace._tracer is not None:
+        # device-time attribution (ISSUE 10): annotate the entry with
+        # the active trace id (runtime) or a named_scope (inside a jit
+        # trace) — one module-global check when tracing is off
+        with _obs_device.annotate("flash_attention"):
+            return _flash(q, k, v, causal, float(scale), block_q,
+                          block_k, impl, packed_stats, head_pack)
     return _flash(q, k, v, causal, float(scale), block_q, block_k, impl,
                   packed_stats, head_pack)
 
@@ -1105,6 +1115,18 @@ def flash_decode(q, k_pages, v_pages, block_tables, seq_lens, *,
     if impl in ("pallas", "interpret") and not _decode_geom_ok(
             q, k_pages, hpb, vmem_budget_bytes):
         impl = "xla"   # documented fallback: gather + reference replay
+    if _obs_trace._tracer is not None:
+        with _obs_device.annotate("flash_decode"):
+            return _flash_decode_entry(q, k_pages, v_pages,
+                                       block_tables, seq_lens, scale,
+                                       impl, hpb, int8kv, kv_scales)
+    return _flash_decode_entry(q, k_pages, v_pages, block_tables,
+                               seq_lens, scale, impl, hpb, int8kv,
+                               kv_scales)
+
+
+def _flash_decode_entry(q, k_pages, v_pages, block_tables, seq_lens,
+                        scale, impl, hpb, int8kv, kv_scales):
     if impl in ("pallas", "interpret"):
         if int8kv:
             q_eff, vdq = _int8_pre(q, kv_scales)

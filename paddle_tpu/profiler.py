@@ -20,6 +20,12 @@ from paddle_tpu.observability import tracing as _trace
 # independent of the process ``tracing`` flag (the legacy
 # profile_ops/profiler() contract must work with tracing off)
 _prof_tracer = None
+# device half (ISSUE 10): a DeviceTraceSession opened by
+# start_profiler(tracer_option=...) plus the session-wide annotation
+# that binds the ACTIVE span context into the jax.profiler timeline —
+# the Fluid shim and the device trace are no longer disjoint
+_device_session = None
+_session_annot = None
 
 
 class RecordEvent:
@@ -49,21 +55,57 @@ class RecordEvent:
         return False
 
 
-def start_profiler(state="All"):
-    global _prof_tracer
+def start_profiler(state="All", tracer_option=None):
+    """Open a profiling session.  ``state`` keeps the legacy CPU/GPU/
+    All signature (host spans always record); ``tracer_option``
+    (reference: Default / OpDetail / AllOpDetail) is the DEVICE path
+    (ISSUE 10): any non-None value also opens an
+    ``observability.device_trace.DeviceTraceSession`` (jax.profiler
+    capture) and binds the PR-9 span context into it — a session-wide
+    annotation carries the ACTIVE trace id (when the ``tracing`` flag
+    is on) so device slices captured here join the request's trace,
+    and ``stop_profiler`` routes through the session's parse/join, so
+    the Fluid API gets per-kernel device-seconds attribution for
+    free."""
+    global _prof_tracer, _device_session, _session_annot
     _prof_tracer = _trace.Tracer()
+    if tracer_option is not None:
+        from paddle_tpu.observability import device_trace as _device
+
+        try:
+            _device_session = _device.DeviceTraceSession().start()
+        except Exception:
+            _device_session = None   # a second concurrent jax capture
+            #                          is a no-op, not a crash
+        if _device_session is not None:
+            ctx = _trace.current()
+            _session_annot = _device.session_annotation(
+                "profiler", ctx[0] if ctx is not None else None)
+            _session_annot.__enter__()
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
-    global _prof_tracer
+    global _prof_tracer, _device_session, _session_annot
     t = _prof_tracer
     _prof_tracer = None
+    session, annot = _device_session, _session_annot
+    _device_session = _session_annot = None
+    if annot is not None:
+        annot.__exit__(None, None, None)
+    if session is not None:
+        session.stop()    # parse + join + registry attribution
     if t is None:
         return
     if profile_path:
-        t.export_chrome_trace(profile_path)
+        if session is not None:
+            # chrome export with the device tracks merged in (same
+            # traceEvents shape; tools/timeline.py merges it as-is)
+            session.export_merged(profile_path, tracer=t)
+        else:
+            t.export_chrome_trace(profile_path)
     if sorted_key:
         _print_summary(t, sorted_key)
+    return session
 
 
 def _print_summary(tracer, sorted_key="total"):
@@ -97,9 +139,11 @@ def export_chrome_tracing(path):
 
 
 @contextlib.contextmanager
-def profiler(state="All", sorted_key="total", profile_path=None):
-    """reference profiler.py:225 profiler guard."""
-    start_profiler(state)
+def profiler(state="All", sorted_key="total", profile_path=None,
+             tracer_option=None):
+    """reference profiler.py:225 profiler guard (tracer_option opens
+    the device half — see start_profiler)."""
+    start_profiler(state, tracer_option=tracer_option)
     try:
         yield
     finally:

@@ -41,6 +41,11 @@ _M_DEPTH = _obs_metrics.gauge(
 _M_OUTSTANDING = _obs_metrics.gauge(
     "paddle_tpu_admission_outstanding",
     "admitted-but-unanswered requests")
+_M_REQ_SECONDS = _obs_metrics.histogram(
+    "paddle_tpu_serving_request_seconds",
+    "admitted-request latency (admission -> answered), by typed "
+    "outcome — the p99-vs-deadline SLO reads this (observability/"
+    "slo.py serving_latency)", max_series=16)
 
 __all__ = [
     "ServingError", "OverloadedError", "DeadlineExpiredError",
@@ -291,6 +296,11 @@ class AdmissionController:
                     else "error")
             self._counters[key] += 1
         _M_REQS.inc(outcome=key)
+        lat = req.latency_s()
+        if lat is not None:
+            _M_REQ_SECONDS.observe(
+                lat, outcome="ok" if exc is None
+                else getattr(exc, "code", "error"))
         if _trace._tracer is not None and req.trace is not None:
             _trace._tracer.instant(
                 "serving.deliver", parent=req.trace,

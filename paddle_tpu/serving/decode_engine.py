@@ -155,7 +155,7 @@ class DecodeConfig:
                  default_deadline_s=30.0, n_replicas=1,
                  restart_dead=True, max_attempts=None, eos_id=1,
                  kv_int8=None, head_pack=None, drain_timeout_s=30.0,
-                 impl=None, metrics_port=None):
+                 impl=None, metrics_port=None, trace_sample=None):
         self.max_batch = int(max_batch)
         self.max_new_tokens = int(max_new_tokens)
         self.page_size = int(page_size)
@@ -183,6 +183,13 @@ class DecodeConfig:
             metrics_port = metrics_port_from_env(None)
         self.metrics_port = None if metrics_port is None \
             else int(metrics_port)
+        # head-based trace sampling (ISSUE 10; same contract as
+        # ServingConfig.trace_sample)
+        if trace_sample is not None:
+            trace_sample = float(trace_sample)
+            if not 0.0 <= trace_sample <= 1.0:
+                raise ValueError("trace_sample must be in [0.0, 1.0]")
+        self.trace_sample = trace_sample
 
 
 class _Seq:
@@ -264,6 +271,8 @@ class DecodeServer:
     def start(self):
         if not self._started:
             self._started = True
+            if self.config.trace_sample is not None:
+                _trace.set_sample_rate(self.config.trace_sample)
             if self.config.metrics_port is not None:
                 try:
                     self.metrics_server = MetricsHTTPServer(

@@ -47,7 +47,7 @@ class ServingConfig:
                  breaker_threshold=3, breaker_cooldown_s=0.5,
                  health_interval_s=None, restart_dead=True,
                  max_batch_attempts=None, drain_timeout_s=30.0,
-                 prewarm=None, metrics_port=None):
+                 prewarm=None, metrics_port=None, trace_sample=None):
         self.max_batch = int(max_batch)
         self.buckets = tuple(buckets) if buckets is not None \
             else default_buckets(self.max_batch)
@@ -93,6 +93,15 @@ class ServingConfig:
             metrics_port = metrics_port_from_env(None)
         self.metrics_port = None if metrics_port is None \
             else int(metrics_port)
+        # head-based trace sampling (ISSUE 10): None defers to the
+        # tracer's own rate (PADDLE_TPU_TRACE_SAMPLE); a float in
+        # [0.0, 1.0] is applied at start() — 0.0 uninstalls the tracer
+        # (cost- and wire-identical to the flag being off)
+        if trace_sample is not None:
+            trace_sample = float(trace_sample)
+            if not 0.0 <= trace_sample <= 1.0:
+                raise ValueError("trace_sample must be in [0.0, 1.0]")
+        self.trace_sample = trace_sample
 
 
 class InferenceServer:
@@ -134,6 +143,8 @@ class InferenceServer:
         if self._started:
             return self
         self._started = True
+        if self.config.trace_sample is not None:
+            _trace.set_sample_rate(self.config.trace_sample)
         if self.config.metrics_port is not None:
             try:
                 self.metrics_server = MetricsHTTPServer(

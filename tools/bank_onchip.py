@@ -159,6 +159,13 @@ TASK_KEYS = {
                                  None),
 }
 
+# "script:" tasks whose stdout is ONE JSON line to bank verbatim
+# under the given artifact key (ISSUE 10: the serving QPS-vs-p99-vs-
+# SLO dashboard row from tools/slo_report.py)
+SCRIPT_JSON_KEYS = {
+    "serving_qps_slo": "serving_qps_slo",
+}
+
 # primary key <- best (by LOWEST ms_per_batch) among these variant
 # keys — the int8 inference promotion (ISSUE 5): train rows promote on
 # mfu_pct (PRIMARY below), latency rows on measured ms; the primary
@@ -232,6 +239,23 @@ def main(argv=None):
         print("no results file at %s" % args.results, file=sys.stderr)
         return 1
     for rec in recs:
+        if rec.get("ok") and rec.get("task") in SCRIPT_JSON_KEYS:
+            # script task with a one-JSON-line stdout contract: bank
+            # the line itself (chaser stores it in stdout_tail)
+            tail = rec.get("stdout_tail") or ""
+            row = None
+            for ln in reversed(tail.splitlines()):
+                if ln.strip().startswith("{"):
+                    try:
+                        row = json.loads(ln)
+                    except ValueError:
+                        row = None
+                    break
+            if isinstance(row, dict) and row.get("ok"):
+                row["degraded"] = False
+                art["extras"][SCRIPT_JSON_KEYS[rec["task"]]] = row
+                banked += 1
+            continue
         if not rec.get("ok") or not isinstance(rec.get("result"), dict):
             continue
         res = dict(rec["result"])

@@ -413,6 +413,20 @@ def main(argv=None):
     base_seed = args.seed if args.seed is not None \
         else int(time.time()) % 1_000_000
     t0 = time.monotonic()
+    # ISSUE 10: baseline SLO sample at soak start so the end-of-soak
+    # verdict windows over the WHOLE run (burn rates need a delta)
+    soak_monitor = None
+    if args.mode == "serving":
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            from paddle_tpu.observability import slo as obs_slo
+
+            soak_monitor = obs_slo.SLOMonitor(
+                slos=obs_slo.default_slos(window_s=24 * 3600.0))
+            soak_monitor.observe()
+        except Exception:
+            soak_monitor = None
     seeds, failures, total_faults = [], [], 0
     i = 0
     while True:
@@ -454,12 +468,18 @@ def main(argv=None):
     # and the process metrics snapshot ride the one-line verdict
     flight_dumps = list(_subproc_flight_dumps)
     metrics_snapshot = {}
+    slo_verdict = {}
     try:
         from paddle_tpu.observability import flight_recorder
         from paddle_tpu.observability import metrics as obs_metrics
 
         flight_dumps.extend(flight_recorder.dump_paths())
         metrics_snapshot = obs_metrics.registry().snapshot()
+        # ISSUE 10: the soak's SLO verdict next to the metrics embed —
+        # the monitor sampled a baseline at soak start, so the burn
+        # rates window over the whole chaos run
+        if soak_monitor is not None:
+            slo_verdict = soak_monitor.verdict()
     except Exception:   # cluster mode may never import paddle_tpu
         pass
     verdict = {
@@ -473,6 +493,7 @@ def main(argv=None):
         "wall_s": round(time.monotonic() - t0, 1),
         "flight_dumps": flight_dumps,
         "metrics": metrics_snapshot,
+        "slo": slo_verdict,
     }
     print(json.dumps(verdict))
     return 0 if verdict["ok"] else 1

@@ -67,6 +67,17 @@ TASKS = [
     ("llm_decode_str64_d64_hp2", "llm_decode",
      {"streams": 64, "chain": 32, "head_dim": 64,
       "head_pack": True}),
+    # ---- ISSUE 10: the QPS-vs-p99-vs-SLO dashboard row (ROADMAP
+    # observability item (a)).  tools/slo_report.py drives
+    # serving_load --mode overload2x on whatever backend the child
+    # pins (the chip when the tunnel is up) and emits the one-line
+    # row with per-objective attained/target/burn_rate — the first
+    # banked row where the verdict is an SLO, not a throughput.
+    # bank_onchip parses the script's JSON line (SCRIPT_JSON_KEYS).
+    ("serving_qps_slo",
+     "script:tools/slo_report.py --run --mode overload2x "
+     "--seconds 6 --deadline-ms 250 --seed 7 --in-dim 64 "
+     "--hidden 128 --depth 2", {}, 1200),
     # ---- PR-2 HEAD: flash memory-overhaul A/B legs (VERDICT r5
     # next-round #2/#3; ISSUE 2 acceptance).  All behind default-off
     # flags validated bit-parity in interpret mode + Mosaic

@@ -105,7 +105,7 @@ rec = json.loads(lines[0])
 missing = {"metric", "value", "unit", "offered_qps", "goodput_qps",
            "p50_ms", "p99_ms", "admitted", "ok", "shed", "expired",
            "failed_over", "accounted", "seed", "mode",
-           "metrics"} - set(rec)
+           "metrics", "slo"} - set(rec)
 assert not missing, "serving_load JSON missing fields: %s" % (
     sorted(missing),)
 assert rec["accounted"] is True, "request accounting broken: %r" % rec
@@ -118,8 +118,18 @@ adm = m["paddle_tpu_admission_requests_total"]["series"]
 admitted = sum(s["value"] for s in adm
                if s["labels"].get("outcome") == "admitted")
 assert admitted > 0, adm
+# ISSUE 10: the slo embed must carry the availability objective with
+# the per-objective {attained, target, burn_rate} shape
+slo = rec["slo"]
+assert isinstance(slo, dict) and "serving_availability" in slo, \
+    sorted(slo)
+avail = slo["serving_availability"]
+assert {"attained", "target", "burn_rate", "firing"} <= set(avail), \
+    avail
+assert avail["target"] == 0.99, avail
 print("serving_load stdout contract OK: 1 line, %d fields, "
-      "%d instruments in metrics snapshot" % (len(rec), len(m)))
+      "%d instruments in metrics snapshot, %d slo objectives"
+      % (len(rec), len(m), len(slo)))
 PY
 
 echo "== 5c/8 observability smoke (tracing on: one trace id end-to-end) =="
@@ -141,13 +151,19 @@ assert len(lines) == 1, (
     "%d" % len(lines))
 rec = json.loads(lines[0])
 for k in ("serving_trace_ok", "decode_trace_ok", "rpc_trace_joined",
-          "prometheus_ok", "flight_ok"):
+          "prometheus_ok", "flight_ok",
+          # ISSUE 10: device-time attribution (CPU DeviceTraceSession
+          # join), head-based sampling accounting, /sloz
+          "device_trace_ok", "sampling_ok", "sloz_ok"):
     assert rec.get(k) is True, (k, rec)
 assert rec["serving_trace_id"] and rec["decode_trace_id"]
+s = rec["sampling"]
+assert s["sampled"] + s["dropped"] == s["offered"], s
 print("observability smoke OK: serving trace %s, decode trace %s, "
-      "%d prom samples" % (rec["serving_trace_id"],
-                           rec["decode_trace_id"],
-                           rec["prom_samples"]))
+      "%d prom samples, %d device slices joined, sampling %d/%d"
+      % (rec["serving_trace_id"], rec["decode_trace_id"],
+         rec["prom_samples"], rec["device_joined_slices"],
+         s["sampled"], s["offered"]))
 PY
 
 echo "== 6/8 per-op regression gate (hot ops vs committed CPU baseline) =="

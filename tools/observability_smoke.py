@@ -1,4 +1,5 @@
-"""Seeded serving + decode observability smoke (ISSUE 9, ci.sh gate).
+"""Seeded serving + decode observability smoke (ISSUE 9 + 10, ci.sh
+gate).
 
 With the ``tracing`` flag ON, runs one request through the
 InferenceServer and one sequence through the DecodeServer, then
@@ -14,6 +15,21 @@ asserts the end-to-end trace contract:
     grammar check (observability.export.parse_prometheus_text — no
     external dep) and carries the core instruments;
   - an explicit flight-recorder dump round-trips through its JSON file.
+
+ISSUE 10 legs:
+
+  - DEVICE TRACE (CPU-backend DeviceTraceSession smoke — jax.profiler
+    works on CPU): a tracing-on serving request inside a capture
+    window must yield >= 1 annotated device slice whose embedded trace
+    id JOINS the host ``predictor.run`` span's trace, per-kernel
+    device-seconds must land in the registry, and the merged chrome
+    trace must carry a device slice under that id — host AND device
+    under ONE trace id, chip-free;
+  - SAMPLED TRACING at rate 0.5: sampled + dropped root counters must
+    sum to the offered roots, every sampled trace must be COMPLETE
+    (client + envelope-joined server span), and no dropped trace may
+    leave any span in the ring;
+  - /sloz parses and carries the declarative objectives.
 
 stdout contract: EXACTLY ONE JSON line (the same driver/gate shape as
 bench.py / serving_load.py); progress goes to stderr.  Exit 0 iff every
@@ -149,6 +165,106 @@ def main():
     checks["flight_ok"] = bool(path) and any(
         ev.get("category") == "smoke" for ev in doc.get("events", []))
     verdict["flight_dump"] = path
+
+    # -- ISSUE 10: device-trace leg (CPU-backend DeviceTraceSession) --------
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.observability.device_trace import \
+        DeviceTraceSession
+
+    _log("device-trace leg: serving request inside a capture window")
+    tracer.clear()
+    dsess = DeviceTraceSession(
+        os.path.join(tempfile.mkdtemp(), "devtrace"))
+    srv = serving.InferenceServer(
+        lambda i: inference.create_predictor(inference.Config(mdir)),
+        serving.ServingConfig(n_replicas=1, max_batch=4,
+                              metrics_port=0)).start()
+    try:
+        dsess.start()
+        srv.infer({"x": np.zeros((1, 8), np.float32)},
+                  deadline_s=30.0, timeout=30.0)
+        dsess.stop()
+
+        pruns = [s for s in tracer.spans()
+                 if s.name == "predictor.run"]
+        ptid = pruns[-1].trace_id if pruns else None
+        joined_tids = {j["trace_id"] for j in dsess.joined}
+        ksec = dsess.kernel_seconds()
+        reg = obs_metrics.registry().get(
+            "paddle_tpu_device_kernel_seconds_total")
+        merged = dsess.merged_chrome_trace(tracer)
+        merged_dev = [
+            e for e in merged["traceEvents"]
+            if e.get("pid", 0) >= DeviceTraceSession._PID_OFFSET
+            and e.get("args", {}).get("trace_id") == ptid]
+        checks["device_trace_ok"] = bool(
+            ptid and ptid in joined_tids and ksec
+            and reg is not None and reg.total() > 0 and merged_dev)
+        verdict["device_joined_slices"] = len(dsess.joined)
+        verdict["device_kernel_seconds"] = {
+            k: round(v, 6) for k, v in ksec.items()}
+        verdict["device_step_breakdown"] = {
+            k: round(v, 6) for k, v in dsess.step_breakdown().items()}
+        _log("device trace: %d joined slices, kernels %s"
+             % (len(dsess.joined), sorted(ksec)))
+
+        # /sloz parses and carries the declarative objectives
+        import urllib.request
+
+        sloz = json.loads(urllib.request.urlopen(
+            srv.metrics_server.url + "/sloz", timeout=10).read())
+        names = {s.get("name") for s in sloz.get("slos", [])}
+        checks["sloz_ok"] = "serving_availability" in names and \
+            "firing" in sloz
+        _log("sloz objectives: %s" % sorted(names))
+    finally:
+        srv.stop()
+
+    # -- ISSUE 10: sampled-tracing leg (rate 0.5) ---------------------------
+    _log("sampled-tracing leg: 40 rpc roots at rate 0.5")
+    tracing.stop_tracing()
+    t2 = tracing.start_tracing(sample=0.5)
+    reg_traces = obs_metrics.registry().get(
+        "paddle_tpu_trace_traces_total")
+
+    def _counts():
+        if reg_traces is None:
+            return 0.0, 0.0
+        return (reg_traces.value(path="rpc.client:ping",
+                                 verdict="sampled"),
+                reg_traces.value(path="rpc.client:ping",
+                                 verdict="dropped"))
+
+    s0, d0 = _counts()
+    rsrv2 = RPCServer("127.0.0.1:0").start()
+    rsrv2.register_handler("ping", lambda p: p)
+    client2 = RPCClient()
+    offered = 40
+    try:
+        for _ in range(offered):
+            client2.call(rsrv2.endpoint, "ping", "x", retries=0)
+    finally:
+        client2.close()
+        rsrv2.stop()
+    reg_traces = obs_metrics.registry().get(
+        "paddle_tpu_trace_traces_total")
+    s1, d1 = _counts()
+    n_sampled, n_dropped = int(s1 - s0), int(d1 - d0)
+    roots = [s for s in t2.spans() if s.name == "rpc.client:ping"]
+    complete = all(
+        any(sv.name == "rpc.server:ping" and sv.trace_id == r.trace_id
+            for sv in t2.spans())
+        for r in roots)
+    checks["sampling_ok"] = (
+        n_sampled + n_dropped == offered
+        and len(roots) == n_sampled
+        and 0 < n_sampled < offered      # both verdicts exercised
+        and complete)
+    verdict["sampling"] = {"offered": offered, "sampled": n_sampled,
+                           "dropped": n_dropped,
+                           "complete_traces": complete}
+    _log("sampling: %d sampled + %d dropped of %d, complete=%s"
+         % (n_sampled, n_dropped, offered, complete))
 
     tracing.stop_tracing()
     verdict.update(checks)

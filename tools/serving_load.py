@@ -48,6 +48,16 @@ instruments; histograms summarized to count/sum/p50/p95/p99 so the
 single-line contract stays bounded).  ci.sh step 5b gates that the
 field parses and carries the admission instrument.
 
+SLO verdicts (ISSUE 10): every mode's JSON line also embeds ``slo`` —
+per-objective ``{attained, target, burn_rate, firing}`` from an
+``observability.slo.SLOMonitor`` evaluated over the run (availability
++ p99-vs-deadline for the request modes, + decode inter-token for
+--mode decode; window = the run length so a short run's burn rates
+are meaningful).  Under --mode overload2x the availability objective
+burns hard (sheds count against the budget) — the alert the SLO
+engine exists to fire.  ci.sh 5b gates that the availability
+objective is present.
+
 Replayable: the arrival schedule is fully determined by --seed.
 """
 
@@ -364,9 +374,31 @@ def main(argv=None):
 
     jax.config.update("jax_platforms", "cpu")
 
+    from paddle_tpu.observability import slo as obs_slo
+
+    def make_monitor(decode=False):
+        """The run's SLO set, windowed to the run length (module
+        docstring); installed process-wide so /sloz shows the same
+        verdicts the JSON line embeds."""
+        window = max(2.0, float(args.seconds))
+        slos = [obs_slo.serving_availability(objective=0.99,
+                                             window_s=window,
+                                             fast_fraction=0.25),
+                obs_slo.serving_latency(
+                    deadline_s=args.deadline_ms / 1000.0,
+                    objective=0.99, window_s=window,
+                    fast_fraction=0.25)]
+        if decode:
+            slos.append(obs_slo.decode_inter_token(
+                threshold_s=max(0.05, args.deadline_ms / 1000.0),
+                objective=0.99, window_s=window, fast_fraction=0.25))
+        return obs_slo.install(
+            obs_slo.SLOMonitor(slos=slos)).start(interval_s=0.05)
+
     if args.mode == "decode":
         from paddle_tpu import serving
 
+        monitor = make_monitor(decode=True)
         srv = serving.DecodeServer(config=serving.DecodeConfig(
             max_batch=args.max_batch, n_replicas=args.replicas,
             max_new_tokens=args.max_new, page_size=16,
@@ -388,11 +420,14 @@ def main(argv=None):
             srv.stop()
         from paddle_tpu.observability import metrics as obs_metrics
 
+        slo_verdict = monitor.verdict()
+        monitor.stop()
         rec.update({
             "metric": "decode_tokens_per_sec",
             "value": rec["tokens_per_sec"],
             "unit": "tok/s",
             "metrics": obs_metrics.registry().snapshot(),
+            "slo": slo_verdict,
             "time_to_first_batch_s": round(ttfb, 3),
             "time_to_first_batch_cold_s": round(ttfb, 3),
             "time_to_first_batch_warm_s": None,
@@ -409,6 +444,7 @@ def main(argv=None):
     with tempfile.TemporaryDirectory() as d:
         mdir = build_model(d, in_dim=args.in_dim, hidden=args.hidden,
                            depth=args.depth)
+        monitor = make_monitor()
         srv = make_server(mdir, replicas=args.replicas,
                           max_batch=args.max_batch,
                           deadline_ms=args.deadline_ms,
@@ -434,6 +470,10 @@ def main(argv=None):
             rec = run_open_loop(srv, qps, args.seconds,
                                 seed=args.seed,
                                 deadline_s=args.deadline_ms / 1000.0)
+            # SLO verdict AT RUN END — the warm-probe server below
+            # must not dilute the windows the run just burned
+            slo_verdict = monitor.verdict()
+            monitor.stop()
             bstats = srv.stats()["batcher"]
         finally:
             srv.stop()
@@ -459,6 +499,7 @@ def main(argv=None):
         "value": rec["goodput_qps"],
         "unit": "req/s",
         "metrics": obs_metrics.registry().snapshot(),
+        "slo": slo_verdict,
         "capacity_qps": round(cap_qps, 1) if cap_qps else None,
         "time_to_first_batch_s": round(ttfb, 3),
         "time_to_first_batch_cold_s": round(ttfb, 3),
