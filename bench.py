@@ -1082,7 +1082,8 @@ def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
 def _build_llm_decode(streams=8, prefill_len=128, gen_tokens=64,
                       heads=8, head_dim=128, page_size=128,
                       vocab=32000, kv_int8=False, head_pack=False,
-                      dtype=None, seed=0, impl=None):
+                      dtype=None, seed=0, impl=None, spec_k=0,
+                      prefix_share=0):
     """Build ONE jitted continuous-decode step (ISSUE 7): token embed +
     qkv projections + the paged KV append scatter + flash_decode over
     the block-table page pool + the output projection + greedy argmax —
@@ -1097,7 +1098,20 @@ def _build_llm_decode(streams=8, prefill_len=128, gen_tokens=64,
     [prefill_len/2, prefill_len] — the kernel still walks the block
     table page-by-page, but the timed loop pays zero allocator churn
     (allocation/retire dynamics are tools/serving_load.py --mode
-    decode's job)."""
+    decode's job).
+
+    spec_k > 0 builds the SPECULATIVE VERIFY step instead (ISSUE
+    11c): feed carries the k+1-token window per stream (tokens /
+    page_ids / offsets all [streams, k+1]) and the step appends the
+    whole window then scores every row in ONE q-len-(k+1)
+    flash_decode — fn returns next-token picks [streams, k+1].
+
+    prefix_share > 0 makes every stream's first prefix_share prompt
+    tokens IDENTICAL and their pages PHYSICALLY SHARED (ISSUE 11b:
+    one page set, written once, in every block table — the
+    serving-side radix-tree outcome expressed as static tables), so
+    the pool holds shared + per-stream-tail pages instead of
+    streams x full-length (rounded down to full pages)."""
     import jax
     import jax.numpy as jnp
 
@@ -1110,75 +1124,132 @@ def _build_llm_decode(streams=8, prefill_len=128, gen_tokens=64,
                          num_heads=heads, head_dim=head_dim,
                          seed=seed, dtype=dtype)
     rng = np.random.RandomState(seed)
-    max_len = prefill_len + gen_tokens + 4       # +warmup margin
-    mp = -(-max_len // page_size)                # pages per stream
-    num_pages = streams * mp
-    tables_np = np.arange(num_pages,
-                          dtype=np.int32).reshape(streams, mp)
-    lens0 = rng.randint(max(1, prefill_len // 2), prefill_len + 1,
-                        size=streams).astype(np.int32)
+    shared_tokens = (prefix_share // page_size) * page_size
+    n_sp = shared_tokens // page_size            # shared pages
+    spec_margin = (spec_k + 1) * (gen_tokens + 1) if spec_k else 0
+    max_len = prefill_len + gen_tokens + spec_margin + 4
+    mp = -(-max_len // page_size)                # private pages/stream
+    num_pages = n_sp + streams * mp
+    tables_np = np.zeros((streams, n_sp + mp), np.int32)
+    tables_np[:, :n_sp] = np.arange(n_sp, dtype=np.int32)[None, :]
+    tables_np[:, n_sp:] = n_sp + np.arange(
+        streams * mp, dtype=np.int32).reshape(streams, mp)
+    lens0 = (shared_tokens + rng.randint(
+        max(1, prefill_len // 2), prefill_len + 1,
+        size=streams)).astype(np.int32)
     store = jnp.int8 if kv_int8 else dtype
     k_pages = jnp.zeros((num_pages, heads, page_size, head_dim), store)
     v_pages = jnp.zeros((num_pages, heads, page_size, head_dim), store)
     kv_scales = None
-    for s in range(streams):
-        prompt = rng.randint(2, vocab, size=int(lens0[s]))
-        _, k, v = model.qkv(prompt.astype(np.int32))
+    shared_prompt = rng.randint(2, vocab, size=shared_tokens) \
+        if shared_tokens else None
+
+    def write_pages(kp, vp, k, v, pids, first_off=0):
+        # page-by-page pool writes of [T, H, d] rows along pids
+        w = 0
+        off = first_off
+        for pid in pids:
+            n = min(page_size - off, k.shape[0] - w)
+            if n <= 0:
+                break
+            kp = kp.at[int(pid), :, off:off + n, :].set(
+                jnp.transpose(k[w:w + n], (1, 0, 2)))
+            vp = vp.at[int(pid), :, off:off + n, :].set(
+                jnp.transpose(v[w:w + n], (1, 0, 2)))
+            w += n
+            off = 0
+        return kp, vp
+
+    def store_kv(k, v):
+        nonlocal kv_scales
         if kv_int8:
             if kv_scales is None:
                 kv_scales = (kv_scales_of(k), kv_scales_of(v))
-            k = quantize_kv(k, kv_scales[0])
-            v = quantize_kv(v, kv_scales[1])
-        else:
-            k, v = k.astype(store), v.astype(store)
-        for i in range(-(-int(lens0[s]) // page_size)):
-            chunk_k = k[i * page_size:(i + 1) * page_size]
-            chunk_v = v[i * page_size:(i + 1) * page_size]
-            n = chunk_k.shape[0]
-            pid = int(tables_np[s, i])
-            k_pages = k_pages.at[pid, :, :n, :].set(
-                jnp.transpose(chunk_k, (1, 0, 2)))
-            v_pages = v_pages.at[pid, :, :n, :].set(
-                jnp.transpose(chunk_v, (1, 0, 2)))
+            return (quantize_kv(k, kv_scales[0]),
+                    quantize_kv(v, kv_scales[1]))
+        return k.astype(store), v.astype(store)
+
+    if shared_tokens:
+        # the shared prefix is computed + written ONCE — the
+        # amortized-to-zero prefill the sharing leg measures
+        _, k, v = model.qkv(shared_prompt.astype(np.int32))
+        k, v = store_kv(k, v)
+        k_pages, v_pages = write_pages(k_pages, v_pages, k, v,
+                                       tables_np[0, :n_sp])
+    for s in range(streams):
+        tail = int(lens0[s]) - shared_tokens
+        prompt = rng.randint(2, vocab, size=tail)
+        _, k, v = model.qkv(prompt.astype(np.int32))
+        k, v = store_kv(k, v)
+        k_pages, v_pages = write_pages(k_pages, v_pages, k, v,
+                                       tables_np[s, n_sp:])
+
+    r = spec_k + 1
 
     def step(state, feed):
-        q, k, v = model.qkv_fn(feed["tokens"])
+        q, k, v = model.qkv_fn(feed["tokens"].reshape(-1))
         if kv_int8:
             k = quantize_kv(k, kv_scales[0])
             v = quantize_kv(v, kv_scales[1])
         else:
             k, v = k.astype(store), v.astype(store)
-        kp = state["k_pages"].at[feed["page_ids"], :,
-                                 feed["offsets"], :].set(k)
-        vp = state["v_pages"].at[feed["page_ids"], :,
-                                 feed["offsets"], :].set(v)
+        kp = state["k_pages"].at[feed["page_ids"].reshape(-1), :,
+                                 feed["offsets"].reshape(-1), :] \
+            .set(k)
+        vp = state["v_pages"].at[feed["page_ids"].reshape(-1), :,
+                                 feed["offsets"].reshape(-1), :] \
+            .set(v)
+        if spec_k:
+            q = jnp.reshape(q, (streams, r, heads, head_dim))
         out = flash_decode(q, kp, vp, feed["tables"], feed["lens"],
                            impl=impl, head_pack=head_pack,
                            kv_scales=kv_scales)
+        if spec_k:
+            out = jnp.reshape(out, (streams * r, heads, head_dim))
         logits = model.logits_fn(out)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if spec_k:
+            nxt = jnp.reshape(nxt, (streams, r))
         return {"k_pages": kp, "v_pages": vp}, nxt
 
     state = {"k_pages": k_pages, "v_pages": v_pages}
-    feed = {
-        "tokens": jnp.asarray(rng.randint(2, vocab, size=streams)
-                              .astype(np.int32)),
-        "page_ids": jnp.asarray(
-            tables_np[np.arange(streams), lens0 // page_size]),
-        "offsets": jnp.asarray(lens0 % page_size),
-        "tables": jnp.asarray(tables_np),
-        "lens": jnp.asarray(lens0 + 1),
-    }
+    if spec_k:
+        pos = lens0[:, None] + np.arange(r, dtype=np.int32)[None, :]
+        feed = {
+            "tokens": jnp.asarray(
+                rng.randint(2, vocab, size=(streams, r))
+                .astype(np.int32)),
+            "page_ids": jnp.asarray(
+                tables_np[np.arange(streams)[:, None],
+                          pos // page_size]),
+            "offsets": jnp.asarray(pos % page_size),
+            "tables": jnp.asarray(tables_np),
+            "lens": jnp.asarray(lens0 + r),
+        }
+    else:
+        feed = {
+            "tokens": jnp.asarray(rng.randint(2, vocab, size=streams)
+                                  .astype(np.int32)),
+            "page_ids": jnp.asarray(
+                tables_np[np.arange(streams), lens0 // page_size]),
+            "offsets": jnp.asarray(lens0 % page_size),
+            "tables": jnp.asarray(tables_np),
+            "lens": jnp.asarray(lens0 + 1),
+        }
     aux = {"lens0": lens0, "tables_np": tables_np, "model": model,
            "kv_scales": kv_scales, "page_size": page_size,
-           "kv_itemsize": jnp.dtype(store).itemsize}
+           "kv_itemsize": jnp.dtype(store).itemsize,
+           "num_pages": num_pages, "shared_tokens": shared_tokens,
+           # what the pool would need with every stream owning its
+           # own copy of the shared prefix
+           "unshared_pages": streams * (n_sp + mp)}
     return jax.jit(step), state, feed, aux
 
 
 def bench_llm_decode(streams=64, prefill_len=128, gen_tokens=32,
                      heads=8, head_dim=128, page_size=128,
                      vocab=32000, kv_int8=False, head_pack=False,
-                     warmup=2, chain=None):
+                     warmup=2, chain=None, prefix_share=0):
     """LLM continuous-decode leg (ISSUE 7): tokens/s/chip and
     inter-token p50/p99 at `streams` concurrent ragged sequences,
     decoding through the paged KV-cache + flash_decode step.  Every
@@ -1196,7 +1267,8 @@ def bench_llm_decode(streams=64, prefill_len=128, gen_tokens=32,
         streams=streams, prefill_len=prefill_len,
         gen_tokens=gen_tokens + warmup, heads=heads,
         head_dim=head_dim, page_size=page_size, vocab=vocab,
-        kv_int8=kv_int8, head_pack=head_pack)
+        kv_int8=kv_int8, head_pack=head_pack,
+        prefix_share=prefix_share)
     lens = aux["lens0"].copy()
     tables_np = aux["tables_np"]
     tables_dev = feed["tables"]
@@ -1251,7 +1323,272 @@ def bench_llm_decode(streams=64, prefill_len=128, gen_tokens=32,
         res["kv_int8"] = True
     if head_pack:
         res["head_pack"] = True
+    if prefix_share:
+        # the capacity win of prefix sharing (ISSUE 11b): one shared
+        # page set in every table instead of per-stream copies —
+        # tokens/s is expected ~flat (the kernel still streams shared
+        # pages per stream), the pool shrinks
+        res["prefix_shared"] = aux["shared_tokens"]
+        res["pool_pages"] = aux["num_pages"]
+        res["pool_pages_unshared_equiv"] = aux["unshared_pages"]
     return res
+
+
+def bench_llm_decode_spec(streams=64, spec_k=4, prefill_len=128,
+                          gen_tokens=32, heads=8, head_dim=128,
+                          page_size=128, vocab=32000, draft_heads=2,
+                          draft_head_dim=16, warmup=2, chain=None):
+    """Lossless speculative decoding leg (ISSUE 11c): a small draft
+    model (its own paged pool) proposes ``spec_k`` tokens per
+    iteration, the target model scores the k+1-token window in ONE
+    q-len-(k+1) flash_decode verify sweep, greedy acceptance
+    (decode.spec_accept_length) takes the longest agreeing prefix and
+    the rejected tail is a pure length rewind (static page ranges —
+    the engine-side truncate expressed as arithmetic).  Headline:
+    EMITTED tokens/s x the measured acceptance rate, reported
+    together — the verdict is their product, not either alone.
+    `chain` maps onto gen_tokens (verify iterations) for ladder
+    uniformity."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.decode import spec_accept_length
+
+    if chain:
+        gen_tokens = int(chain)
+    iters = gen_tokens + warmup
+    r = spec_k + 1
+    vfn, vstate, vfeed, vaux = _build_llm_decode(
+        streams=streams, prefill_len=prefill_len, gen_tokens=iters,
+        heads=heads, head_dim=head_dim, page_size=page_size,
+        vocab=vocab, spec_k=spec_k)
+    # the draft decodes the SAME prompts (same seed -> same token
+    # stream) through its own small model + pool; q-len-1 step
+    dfn, dstate, dfeed, daux = _build_llm_decode(
+        streams=streams, prefill_len=prefill_len,
+        gen_tokens=(iters + 1) * r, heads=draft_heads,
+        head_dim=draft_head_dim, page_size=page_size, vocab=vocab)
+    tables_v = vfeed["tables"]
+    tables_d = dfeed["tables"]
+    tv_np, td_np = vaux["tables_np"], daux["tables_np"]
+    lens_v = vaux["lens0"].copy()
+    lens_d = daux["lens0"].copy()
+    assert np.array_equal(lens_v, lens_d)  # same seeded prompts
+    pending = np.asarray(dfeed["tokens"]).copy()
+    idx = np.arange(streams)
+    rpos = np.arange(r, dtype=np.int32)
+    times, emitted_total, agreed_total, proposed_total = [], 0, 0, 0
+    for i in range(iters):
+        t0 = time.perf_counter()
+        # draft phase: k sequential q-len-1 proposals
+        proposals = np.zeros((streams, spec_k), np.int32)
+        cur = pending.copy()
+        dl = lens_d.copy()
+        for j in range(spec_k):
+            dfeed_i = {
+                "tokens": jnp.asarray(cur),
+                "page_ids": jnp.asarray(td_np[idx, dl // page_size]),
+                "offsets": jnp.asarray(dl % page_size),
+                "tables": tables_d,
+                "lens": jnp.asarray(dl + 1),
+            }
+            dstate, nxt = dfn(dstate, dfeed_i)
+            cur = np.asarray(nxt)
+            proposals[:, j] = cur
+            dl += 1
+        # verify phase: ONE q-len-(k+1) sweep over [pending, d_1..d_k]
+        window = np.concatenate([pending[:, None], proposals], axis=1)
+        pos = lens_v[:, None] + rpos[None, :]
+        vfeed_i = {
+            "tokens": jnp.asarray(window.astype(np.int32)),
+            "page_ids": jnp.asarray(
+                tv_np[idx[:, None], pos // page_size]),
+            "offsets": jnp.asarray(pos % page_size),
+            "tables": tables_v,
+            "lens": jnp.asarray(lens_v + r),
+        }
+        vstate, tgt = vfn(vstate, vfeed_i)
+        targets = np.asarray(tgt)              # sync: the verify beat
+        dt = time.perf_counter() - t0
+        # acceptance + length rewind (host arithmetic on the static
+        # page ranges; overwrites at the same offsets next round)
+        n_emits = np.zeros((streams,), np.int32)
+        for s in range(streams):
+            m = spec_accept_length(proposals[s], targets[s])
+            n_emits[s] = m + 1
+            agreed_total += m
+            pending[s] = targets[s, m]
+        proposed_total += spec_k * streams
+        lens_v += n_emits
+        # draft catch-up: one append step realigns the draft cache —
+        # a full-acceptance stream is owed the d_k row (at its
+        # base + k slot); any other stream's write lands one PAST its
+        # new end, exactly where the next round's pending overwrites
+        # it
+        pos_c = lens_d + np.where(n_emits == r, spec_k, n_emits)
+        lens_d = lens_d + n_emits
+        dfeed_c = {
+            "tokens": jnp.asarray(proposals[:, -1]),
+            "page_ids": jnp.asarray(
+                td_np[idx, pos_c // page_size]),
+            "offsets": jnp.asarray(pos_c % page_size),
+            "tables": tables_d,
+            "lens": jnp.asarray(lens_d),
+        }
+        dstate, _ = dfn(dstate, dfeed_c)
+        if i >= warmup:
+            times.append(dt)
+            emitted_total += int(n_emits.sum())
+    total = sum(times)
+    lat_ms = sorted(t * 1e3 for t in times)
+
+    def pct(p):
+        return lat_ms[min(len(lat_ms) - 1, int(p / 100 * len(lat_ms)))]
+
+    acceptance = agreed_total / max(1, proposed_total)
+    peak_bw, kind = _chip_peak_bw()
+    return {
+        "tokens_per_sec": round(emitted_total / total, 1)
+        if total else 0.0,
+        "acceptance_rate": round(acceptance, 4),
+        "emitted_per_iter": round(
+            emitted_total / max(1, len(times)) / streams, 3),
+        "iter_p50_ms": round(pct(50), 3),
+        "iter_p99_ms": round(pct(99), 3),
+        "streams": streams,
+        "spec_k": spec_k,
+        "prefill_len": prefill_len,
+        "verify_iters": len(times),
+        "heads": heads,
+        "head_dim": head_dim,
+        "draft_heads": draft_heads,
+        "draft_head_dim": draft_head_dim,
+        "page_size": page_size,
+        "paged": True,
+        "device": kind,
+    }
+
+
+def bench_llm_decode_chunked_join(streams=16, join_prompt=32768,
+                                  chunk=512, prefill_len=128,
+                                  gen_tokens=64, heads=8,
+                                  head_dim=128, page_size=128,
+                                  vocab=32000, warmup=2, chain=None):
+    """Chunked-prefill join leg (ISSUE 11a): ``streams`` sequences
+    decode steadily while ONE ``join_prompt``-token prompt prefills in
+    fixed ``chunk``-token slices INTERLEAVED with their decode steps —
+    the row's verdict is the running streams' inter-token p99 DURING
+    the join vs after it (the 32k-join-never-stretches-p99 claim,
+    measured; the serving-side SLO assertion lives in
+    tests/test_decode_act2.py).  chunk must be a page_size multiple
+    (aligned page writes).  `chain` maps onto gen_tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    if chain:
+        gen_tokens = int(chain)
+    if chunk % page_size:
+        raise ValueError("chunk must be a multiple of page_size")
+    fn, state, feed, aux = _build_llm_decode(
+        streams=streams, prefill_len=prefill_len,
+        gen_tokens=gen_tokens + warmup, heads=heads,
+        head_dim=head_dim, page_size=page_size, vocab=vocab)
+    model = aux["model"]
+    tables_np = aux["tables_np"]
+    lens = aux["lens0"].copy()
+    # the joiner owns its own page range appended past the pool — the
+    # running streams' tables never see it until the join completes
+    join_pages = -(-(join_prompt + gen_tokens + 4) // page_size)
+    base_pages = aux["num_pages"]
+    store = state["k_pages"].dtype
+    state = {
+        "k_pages": jnp.concatenate(
+            [state["k_pages"],
+             jnp.zeros((join_pages,) + state["k_pages"].shape[1:],
+                       store)]),
+        "v_pages": jnp.concatenate(
+            [state["v_pages"],
+             jnp.zeros((join_pages,) + state["v_pages"].shape[1:],
+                       store)]),
+    }
+    rng = np.random.RandomState(7)
+    join_tokens = rng.randint(2, vocab, size=join_prompt) \
+        .astype(np.int32)
+    cpp = chunk // page_size                  # pages per chunk
+
+    def chunk_fn(st, ctokens, cpages):
+        _, k, v = model.qkv_fn(ctokens)       # [chunk, H, d]
+        kc = jnp.transpose(
+            k.astype(store).reshape(cpp, page_size, heads, head_dim),
+            (0, 2, 1, 3))
+        vc = jnp.transpose(
+            v.astype(store).reshape(cpp, page_size, heads, head_dim),
+            (0, 2, 1, 3))
+        return {"k_pages": st["k_pages"].at[cpages].set(kc),
+                "v_pages": st["v_pages"].at[cpages].set(vc)}
+
+    chunk_jit = jax.jit(chunk_fn)
+    tokens = np.asarray(feed["tokens"])
+    tables_dev = feed["tables"]
+    idx = np.arange(streams)
+    n_chunks = -(-join_prompt // chunk)
+    during, after = [], []
+    prefilled = 0
+    for i in range(gen_tokens + warmup):
+        joining = prefilled < join_prompt
+        if joining:
+            # ONE chunk of the long prompt between decode steps — the
+            # interleave that bounds what the join adds per token
+            c0 = prefilled
+            span = join_tokens[c0:c0 + chunk]
+            padded = np.zeros((chunk,), np.int32)
+            padded[:len(span)] = span
+            pids = base_pages + c0 // page_size + np.arange(cpp)
+            state = chunk_jit(state, jnp.asarray(padded),
+                              jnp.asarray(pids.astype(np.int32)))
+            prefilled += len(span)
+        feed_i = {
+            "tokens": jnp.asarray(tokens),
+            "page_ids": jnp.asarray(
+                tables_np[idx, lens // page_size]),
+            "offsets": jnp.asarray(lens % page_size),
+            "tables": tables_dev,
+            "lens": jnp.asarray(lens + 1),
+        }
+        t0 = time.perf_counter()
+        state, nxt = fn(state, feed_i)
+        tokens = np.asarray(nxt)              # sync: inter-token beat
+        dt = time.perf_counter() - t0
+        lens += 1
+        if i >= warmup:
+            (during if joining else after).append(dt)
+
+    def pct(vals, p):
+        vs = sorted(v * 1e3 for v in vals)
+        return round(vs[min(len(vs) - 1, int(p / 100 * len(vs)))], 3) \
+            if vs else None
+
+    peak_bw, kind = _chip_peak_bw()
+    total = sum(during) + sum(after)
+    n_steps = len(during) + len(after)
+    return {
+        "tokens_per_sec": round(streams * n_steps / total, 1)
+        if total else 0.0,
+        "inter_token_p50_ms": pct(during + after, 50),
+        "inter_token_p99_ms": pct(during + after, 99),
+        "inter_token_p99_during_join_ms": pct(during, 99),
+        "inter_token_p99_after_join_ms": pct(after, 99),
+        "join_steps": len(during),
+        "chunks_prefilled": min(n_chunks, len(during) + warmup),
+        "chunked_join": True,
+        "join_prompt_len": join_prompt,
+        "chunk": chunk,
+        "streams": streams,
+        "heads": heads,
+        "head_dim": head_dim,
+        "page_size": page_size,
+        "paged": True,
+        "device": kind,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -1290,6 +1627,12 @@ _LEG_FUNCS = {
     # concurrent streams; rides after the longctx legs (same kernel
     # family, no int8-style wedge history)
     "llm_decode": "bench_llm_decode",
+    # ISSUE 11: decode act II — the speculative verify loop
+    # (acceptance-rate x tokens/s) and the chunked-prefill join
+    # (inter-token p99 while a 32k prompt joins); the prefix-shared
+    # row rides the plain llm_decode leg via its prefix_share kwarg
+    "llm_decode_spec": "bench_llm_decode_spec",
+    "llm_decode_chunked_join": "bench_llm_decode_chunked_join",
     # the reference's cifar10 fp16 table rows (float16_benchmark.md
     # :56-74) — cheap bf16 legs, so they ride ahead of int8
     "vgg_cifar": "bench_vgg16_cifar_infer",
@@ -1342,6 +1685,17 @@ _TINY = {
     # liveness, not the kernel
     "llm_decode": dict(streams=2, prefill_len=8, gen_tokens=4,
                        heads=2, head_dim=32, page_size=8, vocab=256),
+    # degraded act-II legs run the gather+reference kernel path like
+    # llm_decode: they check the spec/chunk plumbing, not the kernel
+    "llm_decode_spec": dict(streams=2, spec_k=2, prefill_len=8,
+                            gen_tokens=3, heads=2, head_dim=32,
+                            page_size=8, vocab=64, draft_heads=2,
+                            draft_head_dim=8),
+    "llm_decode_chunked_join": dict(streams=2, join_prompt=64,
+                                    chunk=16, prefill_len=8,
+                                    gen_tokens=6, heads=2,
+                                    head_dim=32, page_size=8,
+                                    vocab=64),
 }
 
 # generous per-leg wall budgets: first compile over the tunnel takes
@@ -1400,10 +1754,10 @@ def _workload_sig(key, row):
     import re
 
     fam = re.sub(r"_DEGRADED.*$", "", key)
-    fam = re.sub(r"_(?:mb|seq|h|d|blk|str)\d+", "", fam)
+    fam = re.sub(r"_(?:mb|seq|h|d|blk|str|spec_k)\d+", "", fam)
     fam = re.sub(r"_(?:s2d|convep|convbnstats|cmp_pool|bn1p|fastpath|"
                  r"packed|hp2|fusedadam|interlayer|int8kv|gspmd|"
-                 r"tp\d+)(?=_|$)",
+                 r"prefix_shared|chunked_join|tp\d+)(?=_|$)",
                  "", fam)
     return (fam, row.get("batch"), row.get("seq"), row.get("heads"),
             row.get("head_dim"), bool(row.get("s2d_stem")),
@@ -1416,6 +1770,8 @@ def _workload_sig(key, row):
             bool(row.get("int8_interlayer")),
             row.get("streams"), bool(row.get("kv_int8")),
             bool(row.get("paged")),
+            row.get("spec_k"), row.get("prefix_shared"),
+            bool(row.get("chunked_join")),
             bool(row.get("gspmd")), row.get("dp"), row.get("tp"),
             row.get("devices"))
 
@@ -1560,6 +1916,18 @@ def main():
             else "llm_decode_paged_ref",
             "llm_decode", str="streams", h="heads", d="head_dim"):
             row("llm_decode"),
+        # act-II decode rows (ISSUE 11): same flash-vs-ref key honesty
+        key("llm_decode_spec_k4_flash_str64"
+            if not (results["llm_decode_spec"] or {}).get("degraded")
+            else "llm_decode_spec_ref",
+            "llm_decode_spec", str="streams", h="heads",
+            d="head_dim"): row("llm_decode_spec"),
+        key("llm_decode_chunked_join_flash"
+            if not (results["llm_decode_chunked_join"] or {})
+            .get("degraded")
+            else "llm_decode_chunked_join_ref",
+            "llm_decode_chunked_join", str="streams", h="heads",
+            d="head_dim"): row("llm_decode_chunked_join"),
     }
     metric = key("resnet50_bf16_train_mfu_pct_mb128" + rn_s2d,
                  "rn_train", mb="batch")

@@ -35,6 +35,30 @@ from jax import lax
 _NEG_INF = -1e9
 
 
+def spec_accept_length(draft_tokens, target_tokens):
+    """The LOSSLESS greedy acceptance rule of speculative decoding
+    (ISSUE 11c), shared by serving/decode_engine.py and the bench leg.
+
+    ``draft_tokens`` d_1..d_k are the draft model's proposals;
+    ``target_tokens`` t_0..t_k are the target model's greedy picks at
+    the k+1 verify positions (t_0 follows the pending token, t_i
+    follows d_i).  Returns m — the largest count such that
+    d_j == t_{j-1} for every j <= m — so the caller emits t_0..t_m:
+    m+1 tokens, each EXACTLY what sequential greedy decoding would
+    have produced (t_0 needs no agreement: its context is fully
+    confirmed; t_i's context includes d_i, valid only while the draft
+    kept agreeing).  m == k is full acceptance (k+1 tokens per verify
+    sweep); m == 0 still emits one token — speculation never loses
+    throughput to rejection, only the drafted work."""
+    draft_tokens = [int(t) for t in draft_tokens]
+    target_tokens = [int(t) for t in target_tokens]
+    m = 0
+    while m < len(draft_tokens) and \
+            draft_tokens[m] == target_tokens[m]:
+        m += 1
+    return m
+
+
 def _resolve_kv_cache(kv_cache):
     """None -> the typed ``paged_decode`` flag; explicit str wins."""
     if kv_cache is None:

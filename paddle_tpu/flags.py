@@ -168,6 +168,46 @@ define_flag("kv_int8", False,
             "decode-step K/V streaming traffic.  Accuracy asserted "
             "against the f32 KV path (top-1 agreement, "
             "tests/test_decode.py; docs/DECODE.md accuracy bar)")
+define_flag("prefill_chunk", 0,
+            "chunked prefill for the continuous-decode engine "
+            "(ISSUE 11a): 0 = whole-prompt prefill (default; the "
+            "validated PR-7 path — a long prompt's projections run as "
+            "one pow2-padded call before the sequence joins), N > 0 = "
+            "prompts longer than N tokens prefill in fixed N-token "
+            "chunks INTERLEAVED with decode iterations (one chunk per "
+            "iteration, chunk shape always padded to exactly N — one "
+            "compile), so a 32k-token join never stretches running "
+            "streams' inter-token p99 (the PR-10 decode_inter_token "
+            "SLO is the acceptance instrument).  Chunked-prefill "
+            "output is bit-identical to whole-prefill (asserted in "
+            "tests/test_decode_act2.py)")
+define_flag("kv_share", False,
+            "copy-on-write prefix sharing in the paged KV-cache "
+            "(ISSUE 11b): False = every sequence owns its pages "
+            "(default; the validated PR-7 allocator, zero behavior "
+            "change), True = per-page refcounts plus a radix tree "
+            "over block tables so beams (PagedKVCache.fork) AND "
+            "requests with a common token prefix share physical "
+            "full pages — a shared system prompt amortizes its "
+            "prefill to zero.  Appends into a shared page copy-on-"
+            "write through the atomic alloc path; the zero-leak "
+            "invariant generalizes to free + unique(in_use) == "
+            "num_pages; shared-decode output is bit-identical "
+            "(array_equal) to unshared since the kernel reads the "
+            "same physical bytes (docs/DECODE.md)")
+define_flag("spec_k", 0,
+            "lossless speculative decoding for the continuous-decode "
+            "engine (ISSUE 11c): 0 = one token per decode iteration "
+            "(default; the validated PR-7 step), k > 0 = a small "
+            "draft model proposes k tokens per iteration, ONE "
+            "batched flash_decode verify step (q-len-(k+1) "
+            "generalization of the split-K-over-pages kernel) scores "
+            "them, greedy acceptance takes the longest agreeing "
+            "prefix (decode.spec_accept_length), and rejection is a "
+            "page-pointer rewind through PagedKVCache.truncate — so "
+            "speculative greedy output is token-for-token identical "
+            "to non-speculative greedy (asserted), with "
+            "acceptance-rate x tokens/s reported per bench row")
 define_flag("gspmd", False,
             "GSPMD pod-scale front-end (ISSUE 8): False = the "
             "validated per-module parallelism paths (default, zero "

@@ -34,11 +34,38 @@ dequantizes in VMEM (``x_hat = q * s / 127``) — the tensor that
 streams from HBM per decode step is int8.  Scales are calibrated on
 the first prefill (or given explicitly), the same static-scale story
 as the PR-5 activation path.
+
+Copy-on-write prefix sharing (flag ``kv_share``, ISSUE 11b): every
+page carries a REFCOUNT and the cache keeps a radix tree (page-granular
+token trie) over the FULL pages it has written, so
+
+  * two requests whose prompts share a token prefix share the physical
+    pages of that prefix (``prefill(..., tokens=...)`` looks the
+    prefix up; ``shared_prefix_tokens`` lets the caller skip the
+    projections for the shared span entirely — a common system prompt
+    amortizes its prefill to zero);
+  * beams share everything at ``fork`` time (all pages refcounted up,
+    block table copied);
+  * a write landing in a page with refcount > 1 COPIES-ON-WRITE
+    through the same atomic take-a-free-page path (the page bytes are
+    duplicated device-side, the writer's table repoints, the shared
+    original is untouched).
+
+Only FULL pages enter the radix tree — a full page is immutable (later
+appends go to later pages; a COW replaces the writer's pointer, never
+the bytes), which is what makes sharing sound.  The zero-leak
+invariant generalizes to ``free + unique(in_use) == num_pages`` with
+``ref[p] == number of sequences holding p`` — ``check_accounting``
+verifies both, and the chaos soak asserts them after every drain.
+Shared-decode output is bit-identical (array_equal) to unshared: the
+kernel reads the same physical bytes through a different table.
 """
 
 from __future__ import annotations
 
+import itertools
 import math
+from collections import Counter
 
 import jax
 import jax.numpy as jnp
@@ -54,13 +81,33 @@ __all__ = ["OutOfPagesError", "PagedKVCache", "quantize_kv",
 
 _M_PAGES = _obs_metrics.counter(
     "paddle_tpu_paged_kv_pages_total",
-    "page-pool transitions (alloc / free) summed over every cache in "
-    "the process, by event")
+    "page-pool transitions (alloc / share / cow / free) summed over "
+    "every cache in the process, by event")
 _M_OOP = _obs_metrics.counter(
     "paddle_tpu_paged_kv_out_of_pages_total",
     "OutOfPagesError raises (the paging backpressure signal)")
+# page-pressure gauges (ISSUE 11 satellite): /metrics and the
+# serving_load / chaos_soak JSON embeds show pool state next to
+# tokens/s, by per-process cache index
+_G_FREE = _obs_metrics.gauge(
+    "paddle_tpu_paged_kv_pages_free",
+    "free pages of each cache's pool, by cache index", max_series=64)
+_G_IN_USE = _obs_metrics.gauge(
+    "paddle_tpu_paged_kv_pages_in_use",
+    "unique owned pages of each cache's pool, by cache index",
+    max_series=64)
+_G_SHARED = _obs_metrics.gauge(
+    "paddle_tpu_paged_kv_pages_shared",
+    "pages with refcount > 1 (prefix-shared / forked), by cache index",
+    max_series=64)
+_G_FRAG = _obs_metrics.gauge(
+    "paddle_tpu_paged_kv_internal_frag_pct",
+    "tail slack of live sequences' last pages as % of owned capacity, "
+    "by cache index", max_series=64)
 
 _INT8_BOUND = 127.0  # mirrors ops/quant.py _quantize bit_length=8
+
+_CACHE_INDEX = itertools.count()
 
 
 class OutOfPagesError(RuntimeError):
@@ -98,19 +145,31 @@ def _scatter_token(pool, page_ids, offsets, vals):
 _scatter_token_jit = jax.jit(_scatter_token)
 
 
+def _copy_pages(pool, old_ids, new_ids):
+    """Duplicate whole pages device-side (the COW byte copy):
+    pool [P, H, ps, d]; old_ids/new_ids [N] int32."""
+    return pool.at[new_ids].set(pool[old_ids])
+
+
+_copy_pages_jit = jax.jit(_copy_pages)
+
+
 class PagedKVCache:
     """Block-table paged K/V pool for one decode replica.
 
-    Host side: free-list page allocator + per-sequence block tables +
-    lengths.  Device side: the two pools (functionally updated).  The
-    accounting invariant the chaos soak asserts: at every moment
-    ``free_pages + in_use_pages == num_pages`` and after drain
-    ``in_use_pages == 0`` (zero leaks).
+    Host side: free-list page allocator + per-page refcounts +
+    per-sequence block tables + lengths (+ the full-page radix tree
+    under ``kv_share``).  Device side: the two pools (functionally
+    updated).  The accounting invariant the chaos soak asserts: at
+    every moment ``free_pages + unique in_use_pages == num_pages``
+    with every page's refcount equal to the number of sequences
+    holding it, and after drain ``in_use_pages == 0`` (zero leaks).
     """
 
     def __init__(self, num_pages, page_size, num_heads, head_dim,
                  dtype=jnp.float32, max_seqs=None,
-                 max_pages_per_seq=None, kv_int8=None, kv_scales=None):
+                 max_pages_per_seq=None, kv_int8=None, kv_scales=None,
+                 kv_share=None):
         from paddle_tpu.flags import get_flag
 
         self.num_pages = int(num_pages)
@@ -119,6 +178,8 @@ class PagedKVCache:
         self.head_dim = int(head_dim)
         self.kv_int8 = bool(get_flag("kv_int8")) if kv_int8 is None \
             else bool(kv_int8)
+        self.kv_share = bool(get_flag("kv_share")) if kv_share is None \
+            else bool(kv_share)
         self.dtype = jnp.dtype(dtype)
         store = jnp.int8 if self.kv_int8 else self.dtype
         # one extra SINK page rides past the allocatable pool: batch
@@ -151,8 +212,23 @@ class PagedKVCache:
         self._free_pages = list(range(self.num_pages - 1, -1, -1))
         self._free_slots = list(range(self.max_seqs - 1, -1, -1))
         self._live = set()          # live slot ids
-        self._pages_of = {}         # slot -> [page ids] (alloc order)
+        self._pages_of = {}         # slot -> [page ids] (logical order)
+        # per-page refcount (ISSUE 11b): number of sequences whose
+        # block table holds the page.  1 everywhere unless kv_share.
+        self._ref = np.zeros((self.num_pages,), np.int32)
+        self._n_shared = 0          # pages with ref > 1
+        # radix tree over FULL pages: root children keyed by the
+        # page_size-token tuple; node = {"page": pid, "children": {}}.
+        # _radix_of_page maps pid -> (parent_children_dict, key) for
+        # O(1) detach when the page's refcount reaches zero.
+        self._radix_root = {"children": {}}
+        self._radix_of_page = {}
+        # radix insertion cursor per slot (chunked prefill registers
+        # full pages incrementally as extend() completes them)
+        self._radix_cursor = {}
         self._peak_in_use = 0
+        self._peak_shared = 0
+        self._label = str(next(_CACHE_INDEX))
 
     # -- geometry -----------------------------------------------------------
     def pages_for(self, n_tokens):
@@ -172,11 +248,51 @@ class PagedKVCache:
                 "sequence at max_pages_per_seq=%d"
                 % self.max_pages_per_seq)
         pid = self._free_pages.pop()
+        self._ref[pid] = 1
         self._tables[slot, len(pages)] = pid
         pages.append(pid)
         _M_PAGES.inc(event="alloc")
-        self._peak_in_use = max(self._peak_in_use, self.in_use_pages())
+        self._peak_in_use = max(self._peak_in_use, self._owned_count())
         return pid
+
+    def _untake_page(self, slot, pid):
+        """Inverse of _take_page (the atomic rollback path): pid must
+        be the slot's LAST page."""
+        pages = self._pages_of[slot]
+        assert pages and pages[-1] == pid
+        pages.pop()
+        self._tables[slot, len(pages)] = 0
+        self._ref[pid] = 0
+        self._free_pages.append(pid)
+
+    def _share_page(self, slot, pid):
+        """Point ``slot``'s next logical page at an already-owned
+        physical page (prefix sharing / fork)."""
+        pages = self._pages_of[slot]
+        if len(pages) >= self.max_pages_per_seq:
+            _M_OOP.inc()
+            raise OutOfPagesError(
+                "sequence at max_pages_per_seq=%d"
+                % self.max_pages_per_seq)
+        self._ref[pid] += 1
+        if self._ref[pid] == 2:
+            self._n_shared += 1
+            self._peak_shared = max(self._peak_shared, self._n_shared)
+        self._tables[slot, len(pages)] = pid
+        pages.append(pid)
+        _M_PAGES.inc(event="share")
+
+    def _deref_page(self, pid):
+        """Drop one reference; returns True when the page went back to
+        the free list (refcount hit zero)."""
+        self._ref[pid] -= 1
+        if self._ref[pid] == 1:
+            self._n_shared -= 1
+        if self._ref[pid] == 0:
+            self._free_pages.append(pid)
+            self._radix_detach(pid)
+            return True
+        return False
 
     def alloc(self, n_tokens):
         """Reserve a sequence slot with page capacity for ``n_tokens``;
@@ -188,6 +304,15 @@ class PagedKVCache:
             raise OutOfPagesError(
                 "need %d pages, %d free (of %d)"
                 % (need, len(self._free_pages), self.num_pages))
+        slot = self._take_slot()
+        for _ in range(need):
+            self._take_page(slot)
+        _flight.record("paged_kv", "alloc", slot=int(slot),
+                       pages=need)
+        self._export_gauges()
+        return slot
+
+    def _take_slot(self):
         if not self._free_slots:
             _M_OOP.inc()
             raise OutOfPagesError("no free sequence slot (max_seqs=%d)"
@@ -196,31 +321,157 @@ class PagedKVCache:
         self._live.add(slot)
         self._pages_of[slot] = []
         self._lens[slot] = 0
-        for _ in range(need):
-            self._take_page(slot)
-        _flight.record("paged_kv", "alloc", slot=int(slot),
-                       pages=need)
         return slot
 
     def free(self, slot):
-        """Retire a sequence: every page back on the free list."""
+        """Retire a sequence: every reference dropped; pages whose
+        refcount reaches zero go back on the free list."""
         if slot not in self._live:
             raise KeyError("slot %r is not live" % (slot,))
         self._live.discard(slot)
         pages = self._pages_of.pop(slot)
+        n_freed = 0
         for pid in pages:
-            self._free_pages.append(pid)
-        _M_PAGES.inc(len(pages), event="free")
+            if self._deref_page(pid):
+                n_freed += 1
+        _M_PAGES.inc(n_freed, event="free")
         _flight.record("paged_kv", "free", slot=int(slot),
                        pages=len(pages))
         self._tables[slot, :] = 0
         self._lens[slot] = 0
+        self._radix_cursor.pop(slot, None)
         self._free_slots.append(slot)
+        self._export_gauges()
 
     def reset(self):
         """Drop every sequence (replica relaunch path)."""
         for slot in list(self._live):
             self.free(slot)
+
+    def fork(self, slot):
+        """Beam fork (ISSUE 11b): a NEW slot sharing every page of
+        ``slot`` (refcounts up, block table copied, same length) —
+        zero bytes copied now; the first divergent append to a shared
+        page copies-on-write.  Needs ``kv_share``."""
+        if not self.kv_share:
+            raise RuntimeError("fork() needs kv_share=True (copy-on-"
+                               "write is what makes aliased pages "
+                               "sound)")
+        if slot not in self._live:
+            raise KeyError("slot %r is not live" % (slot,))
+        new = self._take_slot()
+        try:
+            for pid in self._pages_of[slot]:
+                self._share_page(new, pid)
+        except OutOfPagesError:
+            for pid in list(self._pages_of[new]):
+                self._deref_page(pid)
+            self._pages_of.pop(new)
+            self._tables[new, :] = 0
+            self._live.discard(new)
+            self._free_slots.append(new)
+            raise
+        self._lens[new] = self._lens[slot]
+        _flight.record("paged_kv", "fork", slot=int(slot),
+                       child=int(new),
+                       pages=len(self._pages_of[new]))
+        self._export_gauges()
+        return new
+
+    def truncate(self, slot, new_len):
+        """Rewind a sequence to ``new_len`` tokens (the speculative-
+        decoding rejection path, ISSUE 11c): pages wholly past the new
+        length are dereferenced through the same atomic free path —
+        rejection is a page-pointer rewind, never a byte rewrite."""
+        if slot not in self._live:
+            raise KeyError("slot %r is not live" % (slot,))
+        new_len = int(new_len)
+        cur = int(self._lens[slot])
+        if not 0 <= new_len <= cur:
+            raise ValueError("truncate to %d outside [0, %d]"
+                             % (new_len, cur))
+        keep = self.pages_for(new_len)   # >= 1: alloc's one-page floor
+        pages = self._pages_of[slot]
+        dropped = pages[keep:]
+        del pages[keep:]
+        for pid in dropped:
+            self._deref_page(pid)
+        self._tables[slot, keep:keep + len(dropped)] = 0
+        self._lens[slot] = new_len
+        if dropped:
+            _M_PAGES.inc(len(dropped), event="rewind")
+        self._export_gauges()
+
+    # -- prefix sharing (radix tree over full pages) ------------------------
+    @staticmethod
+    def _page_key(tokens, i, ps):
+        return tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+
+    def _radix_walk(self, tokens, max_pages=None):
+        """Longest chain of radix nodes matching ``tokens``' full
+        pages; returns the node list (possibly empty)."""
+        ps = self.page_size
+        n_full = len(tokens) // ps
+        if max_pages is not None:
+            n_full = min(n_full, max_pages)
+        cur, chain = self._radix_root, []
+        for i in range(n_full):
+            node = cur["children"].get(self._page_key(tokens, i, ps))
+            if node is None:
+                break
+            chain.append(node)
+            cur = node
+        return chain
+
+    def shared_prefix_tokens(self, tokens):
+        """Number of leading tokens of ``tokens`` whose pages the pool
+        already holds (a multiple of page_size; 0 unless kv_share).
+        The caller may skip computing K/V for that span entirely —
+        this is where a shared system prompt's prefill amortizes to
+        zero."""
+        if not self.kv_share or tokens is None:
+            return 0
+        return len(self._radix_walk(tokens)) * self.page_size
+
+    def _radix_register(self, slot, tokens, first_page, pages):
+        """Insert newly WRITTEN full pages into the tree.  ``tokens``
+        is the slot's full token history; pages[i] backs logical page
+        first_page + i and every one of them is full.  A key conflict
+        (another sequence registered the same content concurrently)
+        keeps the existing node — our copy stays private."""
+        ps = self.page_size
+        cur = self._radix_cursor.get(slot)
+        if cur is None:
+            chain = self._radix_walk(tokens, max_pages=first_page)
+            if len(chain) < first_page:
+                # ancestors unregistered (e.g. COW'd writer): the tree
+                # only holds chains rooted at page 0, so stop here
+                return
+            cur = chain[-1] if chain else self._radix_root
+        for i, pid in enumerate(pages):
+            key = self._page_key(tokens, first_page + i, ps)
+            node = cur["children"].get(key)
+            if node is None:
+                node = {"page": int(pid), "children": {}}
+                cur["children"][key] = node
+                self._radix_of_page[int(pid)] = (cur["children"], key)
+            cur = node
+        self._radix_cursor[slot] = cur
+
+    def _radix_detach(self, pid):
+        """Remove a dead page's node (and its — necessarily dead —
+        descendants) from the tree."""
+        ent = self._radix_of_page.pop(pid, None)
+        if ent is None:
+            return
+        parent_children, key = ent
+        node = parent_children.pop(key, None)
+        stack = [node] if node is not None else []
+        while stack:
+            n = stack.pop()
+            self._radix_of_page.pop(n["page"], None)
+            stack.extend(n["children"].values())
+            n["children"] = {}
 
     # -- writes -------------------------------------------------------------
     def _maybe_calibrate(self, k, v):
@@ -232,36 +483,198 @@ class PagedKVCache:
         return quantize_kv(x, scale) if self.kv_int8 \
             else jnp.asarray(x, self.dtype)
 
-    def prefill(self, k, v):
+    def prefill(self, k, v, tokens=None):
         """Admit a sequence whose prompt K/V is already computed:
         k/v [T, H, d].  Allocates slot + pages, writes page-by-page,
-        sets the length.  Returns the slot id."""
+        sets the length.  Returns the slot id.
+
+        With ``kv_share`` and ``tokens`` (the prompt token ids): the
+        longest already-cached full-page prefix is SHARED instead of
+        written (refcounts up, zero device writes, zero projection
+        work needed for it), and k/v may cover either the full prompt
+        or only the unshared tail ``tokens[shared_prefix_tokens():]``.
+        Newly written full pages register in the radix tree so later
+        prompts can share them."""
+        share = self.kv_share and tokens is not None
+        if share:
+            t = len(tokens)
+            shared_nodes = self._radix_walk(tokens)
+            m = len(shared_nodes) * self.page_size
+        else:
+            t = int(jnp.asarray(k).shape[0])
+            shared_nodes, m = [], 0
+        need_new = self.pages_for(t) - len(shared_nodes) if t else 1
+        if len(self._free_pages) < max(0, need_new):
+            _M_OOP.inc()
+            raise OutOfPagesError(
+                "need %d pages, %d free (of %d)"
+                % (need_new, len(self._free_pages), self.num_pages))
         k = jnp.asarray(k)
-        t = int(k.shape[0])
-        slot = self.alloc(t)
-        self._maybe_calibrate(k, v)
-        ks = self._store(k, self.k_scale)
-        vs = self._store(jnp.asarray(v), self.v_scale)
-        ps = self.page_size
-        for i, pid in enumerate(self._pages_of[slot]):
-            chunk_k = ks[i * ps:(i + 1) * ps]
-            chunk_v = vs[i * ps:(i + 1) * ps]
-            n = int(chunk_k.shape[0])
-            # [n, H, d] -> [H, n, d] (head-major pages)
-            self.k_pages = self.k_pages.at[pid, :, :n, :].set(
-                jnp.transpose(chunk_k, (1, 0, 2)))
-            self.v_pages = self.v_pages.at[pid, :, :n, :].set(
-                jnp.transpose(chunk_v, (1, 0, 2)))
-        self._lens[slot] = t
+        v = jnp.asarray(v)
+        if share:
+            if int(k.shape[0]) == t:
+                k, v = k[m:], v[m:]
+            elif int(k.shape[0]) != t - m:
+                raise ValueError(
+                    "k/v must cover the full prompt (%d tokens) or "
+                    "the unshared tail (%d); got %d"
+                    % (t, t - m, int(k.shape[0])))
+        slot = self._take_slot()
+        try:
+            for node in shared_nodes:
+                self._share_page(slot, node["page"])
+            self._lens[slot] = m
+            if shared_nodes:
+                self._radix_cursor[slot] = shared_nodes[-1]
+            if t - m:
+                self._write_tokens(slot, k, v,
+                                   tokens=tokens if share else None)
+            elif not self._pages_of[slot]:
+                self._take_page(slot)   # alloc's >= 1 page floor
+            self._lens[slot] = t
+        except OutOfPagesError:
+            # atomic: nothing partially allocated survives a failure
+            for pid in list(self._pages_of[slot]):
+                self._deref_page(pid)
+            self._pages_of.pop(slot)
+            self._tables[slot, :] = 0
+            self._lens[slot] = 0
+            self._live.discard(slot)
+            self._radix_cursor.pop(slot, None)
+            self._free_slots.append(slot)
+            raise
+        _flight.record("paged_kv", "alloc", slot=int(slot),
+                       pages=len(self._pages_of[slot]),
+                       shared=len(shared_nodes))
+        self._export_gauges()
         return slot
 
+    def extend(self, slot, k, v, tokens=None):
+        """Append T tokens' K/V to one sequence (the chunked-prefill
+        write path, ISSUE 11a): k/v [T, H, d] land at the slot's
+        current length, taking pages as needed — atomic (nothing
+        written, no page kept on OutOfPagesError).  ``tokens`` (the
+        slot's FULL token history including these T) lets newly
+        completed full pages register for prefix sharing."""
+        if slot not in self._live:
+            raise KeyError("slot %r is not live" % (slot,))
+        self._maybe_calibrate(jnp.asarray(k), jnp.asarray(v))
+        self._write_tokens(slot, jnp.asarray(k), jnp.asarray(v),
+                           tokens=tokens)
+        self._export_gauges()
+
+    def _write_tokens(self, slot, k, v, tokens=None):
+        """Shared write engine for prefill tails and extend: plan the
+        page takes/COWs for T tokens at the current length (undo
+        journal => atomic), then one device write per touched page."""
+        t = int(k.shape[0])
+        if t == 0:
+            return
+        self._maybe_calibrate(k, v)
+        ps = self.page_size
+        start = int(self._lens[slot])
+        journal = []
+        cow_pairs = []
+        try:
+            for pos in range(start, start + t):
+                idx = pos // ps
+                pages = self._pages_of[slot]
+                if idx >= len(pages):
+                    pid = self._take_page(slot)
+                    journal.append(("take", pid))
+                elif self.kv_share and self._ref[pages[idx]] > 1:
+                    old = pages[idx]
+                    pid = self._cow_page(slot, idx)
+                    journal.append(("cow", idx, old, pid))
+                    cow_pairs.append((old, pid))
+        except OutOfPagesError:
+            self._undo(slot, journal)
+            raise
+        self._apply_cow(cow_pairs)
+        ks = self._store(k, self.k_scale)
+        vs = self._store(v, self.v_scale)
+        first_new_full = []
+        pages = self._pages_of[slot]
+        off0 = start % ps
+        w = 0
+        idx = start // ps
+        while w < t:
+            n = min(ps - off0, t - w)
+            pid = pages[idx]
+            self.k_pages = self.k_pages.at[
+                pid, :, off0:off0 + n, :].set(
+                jnp.transpose(ks[w:w + n], (1, 0, 2)))
+            self.v_pages = self.v_pages.at[
+                pid, :, off0:off0 + n, :].set(
+                jnp.transpose(vs[w:w + n], (1, 0, 2)))
+            if off0 + n == ps:
+                first_new_full.append((idx, pid))
+            w += n
+            off0 = 0
+            idx += 1
+        self._lens[slot] = start + t
+        if self.kv_share and tokens is not None and first_new_full:
+            # register the completed full pages (contiguous by
+            # construction) for prefix sharing
+            i0 = first_new_full[0][0]
+            self._radix_register(
+                slot, tokens, i0, [p for _, p in first_new_full])
+
+    def _cow_page(self, slot, idx):
+        """Copy-on-write: repoint logical page ``idx`` of ``slot`` at
+        a fresh physical page (bytes duplicated by _apply_cow); the
+        shared original keeps its other holders."""
+        if not self._free_pages:
+            _M_OOP.inc()
+            raise OutOfPagesError(
+                "page pool exhausted during copy-on-write (%d pages, "
+                "%d live seqs)" % (self.num_pages, len(self._live)))
+        old = self._pages_of[slot][idx]
+        new = self._free_pages.pop()
+        self._ref[new] = 1
+        self._ref[old] -= 1
+        if self._ref[old] == 1:
+            self._n_shared -= 1
+        self._pages_of[slot][idx] = new
+        self._tables[slot, idx] = new
+        _M_PAGES.inc(event="cow")
+        self._peak_in_use = max(self._peak_in_use, self._owned_count())
+        return new
+
+    def _undo(self, slot, journal):
+        for step in reversed(journal):
+            if step[0] == "take":
+                self._untake_page(slot, step[1])
+            else:
+                _, idx, old, new = step
+                self._pages_of[slot][idx] = old
+                self._tables[slot, idx] = old
+                self._ref[old] += 1
+                if self._ref[old] == 2:
+                    self._n_shared += 1
+                self._ref[new] = 0
+                self._free_pages.append(new)
+
+    def _apply_cow(self, cow_pairs):
+        if not cow_pairs:
+            return
+        olds = jnp.asarray(np.asarray([o for o, _ in cow_pairs],
+                                      np.int32))
+        news = jnp.asarray(np.asarray([n for _, n in cow_pairs],
+                                      np.int32))
+        self.k_pages = _copy_pages_jit(self.k_pages, olds, news)
+        self.v_pages = _copy_pages_jit(self.v_pages, olds, news)
+
     def append(self, slots, k, v):
-        """Append ONE token per sequence for the whole running batch:
-        slots [N] ints, k/v [N_pad, H, d] with N_pad >= N — rows past
-        len(slots) are batch padding and scatter into the sink page
-        (fixed-shape calls = one compile).  One fused device scatter;
-        new pages are taken from the free list as sequences cross a
-        page boundary (OutOfPagesError leaves lengths untouched)."""
+        """Append tokens for the whole running batch: slots [N] ints;
+        k/v [N_pad, H, d] (ONE token per sequence) or
+        [N_pad, R, H, d] (R tokens per sequence — the speculative
+        verify write, ISSUE 11c).  Rows past len(slots) are batch
+        padding and scatter into the sink page (fixed-shape calls =
+        one compile).  One fused device scatter; new pages come off
+        the free list as sequences cross page boundaries, shared
+        pages copy-on-write first, and OutOfPagesError leaves
+        lengths, tables and refcounts untouched (atomic)."""
         if _obs_trace._tracer is not None:
             # device-time attribution (ISSUE 10): the batched append
             # scatter is a decode-step hot spot worth its own lane
@@ -271,27 +684,42 @@ class PagedKVCache:
 
     def _append_inner(self, slots, k, v):
         slots = list(slots)
-        self._maybe_calibrate(jnp.asarray(k), jnp.asarray(v))
+        k = jnp.asarray(k)
+        v = jnp.asarray(v)
+        r = 1 if k.ndim == 3 else int(k.shape[1])
+        self._maybe_calibrate(k.reshape((-1,) + k.shape[-2:]),
+                              v.reshape((-1,) + v.shape[-2:]))
         page_ids, offsets = [], []
-        taken = []          # rollback on mid-batch exhaustion
+        journal = {}            # slot -> undo journal
+        cow_pairs = []
         try:
             for s in slots:
                 ln = int(self._lens[s])
-                if ln % self.page_size == 0 and \
-                        ln // self.page_size >= \
-                        len(self._pages_of[s]):
-                    taken.append((s, self._take_page(s)))
-                page_ids.append(self._tables[s, ln // self.page_size])
-                offsets.append(ln % self.page_size)
+                jr = journal.setdefault(s, [])
+                for j in range(r):
+                    pos = ln + j
+                    idx = pos // self.page_size
+                    pages = self._pages_of[s]
+                    if idx >= len(pages):
+                        pid = self._take_page(s)
+                        jr.append(("take", pid))
+                    else:
+                        pid = pages[idx]
+                        if self.kv_share and self._ref[pid] > 1:
+                            new = self._cow_page(s, idx)
+                            jr.append(("cow", idx, pid, new))
+                            cow_pairs.append((pid, new))
+                            pid = new
+                    page_ids.append(pid)
+                    offsets.append(pos % self.page_size)
         except OutOfPagesError:
-            for s, pid in taken:
-                self._pages_of[s].remove(pid)
-                self._tables[s, len(self._pages_of[s])] = 0
-                self._free_pages.append(pid)
+            for s, jr in journal.items():
+                self._undo(s, jr)
             raise
-        ks = self._store(jnp.asarray(k), self.k_scale)
-        vs = self._store(jnp.asarray(v), self.v_scale)
-        n_pad = int(ks.shape[0]) - len(slots)
+        self._apply_cow(cow_pairs)
+        ks = self._store(k.reshape((-1,) + k.shape[-2:]), self.k_scale)
+        vs = self._store(v.reshape((-1,) + v.shape[-2:]), self.v_scale)
+        n_pad = int(ks.shape[0]) - len(slots) * r
         if n_pad:
             page_ids = page_ids + [self.sink_page] * n_pad
             offsets = offsets + [0] * n_pad
@@ -302,7 +730,8 @@ class PagedKVCache:
         self.v_pages = _scatter_token_jit(self.v_pages, pid_a, off_a,
                                           vs)
         for s in slots:
-            self._lens[s] += 1
+            self._lens[s] += r
+        self._export_gauges()
 
     # -- reads --------------------------------------------------------------
     def seq_len(self, slot):
@@ -317,6 +746,13 @@ class PagedKVCache:
         n = max_pages if max_pages is not None else max(
             1, max(self.pages_for(int(self._lens[s])) for s in slots))
         t = self._tables[np.asarray(slots), :n]
+        if t.shape[1] < n:
+            # a requested width past the stored table (a pow2 bucket
+            # rounding above max_pages_per_seq) pads COLUMNS with
+            # page 0 — masked by seq_len like every padded entry
+            t = np.concatenate(
+                [t, np.zeros((t.shape[0], n - t.shape[1]), np.int32)],
+                axis=1)
         if pad_to is not None and pad_to > t.shape[0]:
             t = np.concatenate(
                 [t, np.zeros((pad_to - t.shape[0], n), np.int32)])
@@ -337,48 +773,91 @@ class PagedKVCache:
         return self.k_scale, self.v_scale
 
     # -- accounting ---------------------------------------------------------
+    def _owned_count(self):
+        """Cheap unique-owned count (free-list complement); the audit
+        surface (in_use_pages / check_accounting) recomputes it
+        independently from the tables."""
+        return self.num_pages - len(self._free_pages)
+
     def in_use_pages(self):
-        return sum(len(p) for p in self._pages_of.values())
+        """UNIQUE pages owned by live sequences (the generalized
+        invariant counts each shared page once)."""
+        return len({p for pages in self._pages_of.values()
+                    for p in pages})
+
+    def shared_pages(self):
+        """Pages held by more than one sequence (refcount > 1)."""
+        return self._n_shared
 
     def free_pages(self):
         return len(self._free_pages)
 
+    def _export_gauges(self):
+        free = len(self._free_pages)
+        _G_FREE.set(free, cache=self._label)
+        _G_IN_USE.set(self.num_pages - free, cache=self._label)
+        _G_SHARED.set(self._n_shared, cache=self._label)
+        owned = self.num_pages - free
+        live_tokens = int(sum(self._lens[s] for s in self._live))
+        logical = sum(len(p) for p in self._pages_of.values())
+        cap = logical * self.page_size
+        _G_FRAG.set(
+            round(100.0 * (cap - live_tokens) / cap, 2) if cap
+            else 0.0, cache=self._label)
+        del owned
+
     def stats(self):
         """Allocator + fragmentation stats (the chaos soak's audit
-        surface).  ``accounted`` is the leak invariant: every pool page
-        is either free or owned by exactly one live sequence."""
-        in_use = self.in_use_pages()
+        surface).  ``accounted`` is the generalized leak invariant:
+        every pool page is either free or held by >= 1 live sequence,
+        each shared page counted ONCE, and every page's refcount
+        equals the number of holding sequences."""
         owned = [p for pages in self._pages_of.values() for p in pages]
+        cnt = Counter(owned)
+        in_use = len(cnt)
         live_tokens = int(sum(self._lens[s] for s in self._live))
-        capacity = in_use * self.page_size
+        capacity = len(owned) * self.page_size
+        ref_ok = all(int(self._ref[p]) == c for p, c in cnt.items()) \
+            and int((self._ref > 0).sum()) == in_use
         return {
             "num_pages": self.num_pages,
             "page_size": self.page_size,
             "free_pages": self.free_pages(),
             "in_use_pages": in_use,
+            "shared_pages": sum(1 for c in cnt.values() if c > 1),
+            "logical_pages": len(owned),
             "peak_in_use_pages": self._peak_in_use,
+            "peak_shared_pages": self._peak_shared,
             "live_seqs": len(self._live),
             "accounted": (self.free_pages() + in_use == self.num_pages
-                          and len(owned) == len(set(owned))),
+                          and ref_ok),
             # internal fragmentation: tail slack of the last page of
             # each live sequence (the only waste paging permits)
             "internal_frag_pct": round(
                 100.0 * (capacity - live_tokens) / capacity, 2)
             if capacity else 0.0,
             "kv_int8": self.kv_int8,
+            "kv_share": self.kv_share,
         }
 
     def check_accounting(self):
-        """(ok, detail) — free + in_use == num_pages, no page owned
-        twice, no freed page still owned."""
+        """(ok, detail) — the generalized zero-leak invariant:
+        free + unique(in_use) == num_pages, refcounts equal holder
+        counts, no freed page still held, every radix page owned."""
         st = self.stats()
         if not st["accounted"]:
             return False, ("page accounting broken: free=%d in_use=%d "
-                           "pool=%d" % (st["free_pages"],
-                                        st["in_use_pages"],
-                                        st["num_pages"]))
+                           "pool=%d refcounts_consistent=%s"
+                           % (st["free_pages"], st["in_use_pages"],
+                              st["num_pages"],
+                              st["free_pages"] + st["in_use_pages"]
+                              == st["num_pages"]))
         owned = {p for pages in self._pages_of.values() for p in pages}
         both = owned & set(self._free_pages)
         if both:
             return False, "pages both free and owned: %s" % sorted(both)
+        dead_radix = set(self._radix_of_page) - owned
+        if dead_radix:
+            return False, ("radix tree holds dead pages: %s"
+                           % sorted(dead_radix))
         return True, ""

@@ -827,10 +827,14 @@ _SUBLANES_BY_DTYPE = {jnp.dtype(jnp.float32): 8,
                       jnp.dtype(jnp.int8): 32}
 
 
-def _decode_qrows(dtype):
-    """Sublane replication of the single query row: min sublane tile
-    of the q/output dtype (f32 8, bf16 16)."""
-    return _SUBLANES_BY_DTYPE.get(jnp.dtype(dtype), 8)
+def _decode_qrows(dtype, q_len=1):
+    """Sublane rows of the query block: the min sublane tile of the
+    q/output dtype (f32 8, bf16 16) rounded up to hold q_len rows —
+    q_len = 1 is the decode step (row 0 replicated), q_len = k+1 is
+    the speculative verify step (ISSUE 11c: the last k+1 positions of
+    each sequence ride as distinct rows, per-row causal masks)."""
+    t = _SUBLANES_BY_DTYPE.get(jnp.dtype(dtype), 8)
+    return -(-int(q_len) // t) * t
 
 
 def _decode_hpb(head_pack, n_heads, d):
@@ -841,17 +845,18 @@ def _decode_hpb(head_pack, n_heads, d):
     return 2 if (head_pack and d <= 64 and n_heads % 2 == 0) else 1
 
 
-def _decode_geom_ok(q, k_pages, hpb, vmem_budget_bytes=None):
+def _decode_geom_ok(q, k_pages, hpb, vmem_budget_bytes=None,
+                    q_len=1):
     """True when the Pallas path is legal + fits VMEM; False routes to
     the gather+reference fallback (documented, silent — same shape as
     the packed-stats bq gate)."""
-    b, h, d = q.shape
+    d = q.shape[-1]
     ps = k_pages.shape[2]
     store = jnp.dtype(k_pages.dtype)
     if ps % _SUBLANES_BY_DTYPE.get(store, 8) != 0:
         return False
     qrows = _decode_qrows(jnp.float32 if store == jnp.int8
-                          else q.dtype)
+                          else q.dtype, q_len)
     budget = vmem_budget_bytes or _DECODE_VMEM_BUDGET
     # double-buffered K+V page blocks + q/o/acc + the two row-stat
     # scratches
@@ -862,7 +867,7 @@ def _decode_geom_ok(q, k_pages, hpb, vmem_budget_bytes=None):
 
 def _decode_kernel(blk_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_ref, m_ref, l_ref, *, scale, page_size, hpb,
-                   qrows, int8kv):
+                   qrows, int8kv, q_len=1):
     b = pl.program_id(0)
     p = pl.program_id(2)
     n_p = pl.num_programs(2)
@@ -883,7 +888,21 @@ def _decode_kernel(blk_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     def _step():
         kpos = p * page_size + lax.broadcasted_iota(
             jnp.int32, (qrows, page_size), 1)
-        mask = kpos < kv_len
+        if q_len == 1:
+            # the decode step: every sublane row replicates the ONE
+            # query, one shared mask (the validated PR-7 lowering —
+            # this branch is byte-identical to it)
+            mask = kpos < kv_len
+        else:
+            # speculative verify (ISSUE 11c): row r is the query at
+            # position kv_len - q_len + r, causal WITHIN the window —
+            # row r sees keys < kv_len - q_len + 1 + r.  Padding rows
+            # (r >= q_len) clamp to kv_len; the caller discards them.
+            row = lax.broadcasted_iota(
+                jnp.int32, (qrows, page_size), 0)
+            limit = jnp.minimum(kv_len,
+                                kv_len - q_len + 1 + row)
+            mask = kpos < limit
         for h in range(hpb):
             q = q_ref[0, h]                      # [qrows, d]
             k = k_ref[0, h]                      # [page_size, d]
@@ -929,19 +948,31 @@ def _decode_kernel(blk_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _flash_decode_pallas(q, k_pages, v_pages, block_tables, seq_lens,
-                         scale, hpb, interpret=False):
-    """q: [B, H, d] (K-scale pre-applied in int8 mode); pools
-    [P, H, ps, d]; block_tables [B, MP] int32; seq_lens [B] int32 ->
-    out [B, H, d] (f32 in int8 mode — the V scale applies outside)."""
-    b, h, d = q.shape
+                         scale, hpb, interpret=False, q_len=1):
+    """q: [B, H, d] (q_len 1) or [B, R, H, d] (q_len R — the verify
+    step; K-scale pre-applied in int8 mode either way); pools
+    [P, H, ps, d]; block_tables [B, MP] int32; seq_lens [B] int32
+    (INCLUDING the R window tokens) -> out [B, H, d] / [B, R, H, d]
+    (f32 in int8 mode — the V scale applies outside)."""
     ps = k_pages.shape[2]
     max_pages = block_tables.shape[1]
-    qrows = _decode_qrows(q.dtype)
+    qrows = _decode_qrows(q.dtype, q_len)
     int8kv = jnp.dtype(k_pages.dtype) == jnp.int8
-    q8 = jnp.broadcast_to(q[:, :, None, :], (b, h, qrows, d))
+    if q_len == 1:
+        b, h, d = q.shape
+        q8 = jnp.broadcast_to(q[:, :, None, :], (b, h, qrows, d))
+    else:
+        b, _, h, d = q.shape
+        # rows 0..R-1 are the R real queries; padding rows repeat the
+        # last one (masked identically to it, discarded by the caller)
+        qr = jnp.transpose(q, (0, 2, 1, 3))          # [B, H, R, d]
+        pad = jnp.broadcast_to(qr[:, :, -1:, :],
+                               (b, h, qrows - q_len, d))
+        q8 = jnp.concatenate([qr, pad], axis=2) if qrows > q_len \
+            else qr
     kernel = functools.partial(_decode_kernel, scale=scale,
                                page_size=ps, hpb=hpb, qrows=qrows,
-                               int8kv=int8kv)
+                               int8kv=int8kv, q_len=q_len)
     in_specs = [
         pl.BlockSpec((1, hpb, qrows, d),
                      lambda bi, hi, pi, blk, ln: (bi, hi, 0, 0)),
@@ -979,7 +1010,9 @@ def _flash_decode_pallas(q, k_pages, v_pages, block_tables, seq_lens,
         **params,
     )(jnp.asarray(block_tables, jnp.int32),
       jnp.asarray(seq_lens, jnp.int32), *args)
-    return out[:, :, 0, :]
+    if q_len == 1:
+        return out[:, :, 0, :]
+    return jnp.transpose(out[:, :, :q_len, :], (0, 2, 1, 3))
 
 
 def flash_decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
@@ -1009,33 +1042,45 @@ def flash_decode_reference(q, k_pages, v_pages, block_tables, seq_lens,
         scale = 1.0 / math.sqrt(q.shape[-1])
     bt = jnp.asarray(block_tables, jnp.int32)
     sl = jnp.asarray(seq_lens, jnp.int32)
+    q_len = 1 if q.ndim == 3 else int(q.shape[1])
     if jnp.dtype(k_pages.dtype) == jnp.int8:
         q_eff, vdq = _int8_pre(q, kv_scales)
-        raw = _decode_reference_jit(q_eff, k_pages, v_pages, bt, sl,
-                                    jnp.float32(scale))
+        if q_len == 1:
+            raw = _decode_reference_jit(q_eff, k_pages, v_pages, bt,
+                                        sl, jnp.float32(scale))
+        else:
+            raw = _decode_reference_multi_jit(
+                q_eff, k_pages, v_pages, bt, sl, jnp.float32(scale),
+                q_len)
         return _int8_post(raw, vdq, q.dtype)
-    return _decode_reference_jit(q, k_pages, v_pages, bt, sl,
-                                 jnp.float32(scale))
+    if q_len == 1:
+        return _decode_reference_jit(q, k_pages, v_pages, bt, sl,
+                                     jnp.float32(scale))
+    return _decode_reference_multi_jit(q, k_pages, v_pages, bt, sl,
+                                       jnp.float32(scale), q_len)
 
 
 def _int8_pre(q, kv_scales):
     """Eager int8-KV dequant prologue shared by kernel + reference:
     the per-channel K scale rides the contraction dim, so
     sum_d q_d*(k_td*s_d) == sum_d (q_d*s_d)*k_td — pre-scale q once
-    ([B, H, d]) instead of dequantizing every page ([ps, d] per
-    step)."""
+    ([B, H, d] or [B, R, H, d]) instead of dequantizing every page
+    ([ps, d] per step)."""
     if kv_scales is None:
         raise ValueError("int8 k_pages/v_pages need kv_scales "
                          "(per-channel [H, d] — paged_kv.kv_scales())")
     kdq = kv_scales[0].astype(jnp.float32) / 127.0
     vdq = kv_scales[1].astype(jnp.float32) / 127.0
-    return q.astype(jnp.float32) * kdq[None, :, :], vdq
+    kdq = kdq[None, :, :] if q.ndim == 3 else kdq[None, None, :, :]
+    return q.astype(jnp.float32) * kdq, vdq
 
 
 def _int8_post(raw, vdq, out_dtype):
     """Eager int8-KV epilogue: the V scale is per OUTPUT channel, so
-    it moves out of the page accumulation onto the final [B, H, d]."""
-    return (raw * vdq[None, :, :]).astype(out_dtype)
+    it moves out of the page accumulation onto the final
+    [B, H, d] / [B, R, H, d]."""
+    vdq = vdq[None, :, :] if raw.ndim == 3 else vdq[None, None, :, :]
+    return (raw * vdq).astype(out_dtype)
 
 
 def _decode_reference_impl(q, k_pages, v_pages, block_tables, seq_lens,
@@ -1055,9 +1100,16 @@ def _decode_reference_impl(q, k_pages, v_pages, block_tables, seq_lens,
     m = jnp.full((b, h, qrows), _NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, qrows), jnp.float32)
     acc = jnp.zeros((b, h, qrows, d), jnp.float32)
-    for p in range(max_pages):
-        k = kg[:, p]                                # [B, H, ps, d]
-        v = vg[:, p]
+
+    # ONE lax.scan over pages, not an unrolled python loop: the body
+    # compiles once however wide the block table is (a 32k-token
+    # sequence is a 512-wide table — unrolled, XLA's compile time
+    # exploded on exactly that width, found by the chunked-join SLO
+    # leg).  The per-page op order is unchanged, so kernel parity
+    # holds bit-for-bit.
+    def page_step(carry, inputs):
+        m, l, acc = carry
+        p, k, v = inputs                            # [B, H, ps, d]
         if int8kv:
             k = k.astype(jnp.float32)
             v = v.astype(jnp.float32)
@@ -1075,7 +1127,12 @@ def _decode_reference_impl(q, k_pages, v_pages, block_tables, seq_lens,
         acc = acc * alpha[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p_ if int8kv else p_.astype(v.dtype),
             v, preferred_element_type=jnp.float32)
-        m = m_next
+        return (m_next, l, acc), None
+
+    (m, l, acc), _ = lax.scan(
+        page_step, (m, l, acc),
+        (jnp.arange(max_pages, dtype=jnp.int32),
+         jnp.moveaxis(kg, 1, 0), jnp.moveaxis(vg, 1, 0)))
     l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l[..., None]).astype(q.dtype)
     return out[:, :, 0, :]
@@ -1084,14 +1141,88 @@ def _decode_reference_impl(q, k_pages, v_pages, block_tables, seq_lens,
 _decode_reference_jit = jax.jit(_decode_reference_impl)
 
 
+def _decode_reference_multi_impl(q, k_pages, v_pages, block_tables,
+                                 seq_lens, scale, q_len):
+    """q-len-R twin of _decode_reference_impl (the verify-step oracle,
+    ISSUE 11c): q [B, R, H, d], per-row causal masks mirroring the
+    kernel's minimum(kv_len, kv_len - R + 1 + row) rule with the SAME
+    op order / shapes / rounding points, so flash_decode at q_len > 1
+    is array_equal to this in every mode."""
+    b, rr, h, d = q.shape
+    ps = k_pages.shape[2]
+    max_pages = block_tables.shape[1]
+    qrows = _decode_qrows(q.dtype, q_len)
+    int8kv = jnp.dtype(k_pages.dtype) == jnp.int8
+    qr = jnp.transpose(q, (0, 2, 1, 3))              # [B, H, R, d]
+    if qrows > rr:
+        pad = jnp.broadcast_to(qr[:, :, -1:, :],
+                               (b, h, qrows - rr, d))
+        q8 = jnp.concatenate([qr, pad], axis=2)
+    else:
+        q8 = qr
+    kg = jnp.take(k_pages, jnp.asarray(block_tables, jnp.int32),
+                  axis=0)
+    vg = jnp.take(v_pages, jnp.asarray(block_tables, jnp.int32),
+                  axis=0)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    m = jnp.full((b, h, qrows), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, qrows), jnp.float32)
+    acc = jnp.zeros((b, h, qrows, d), jnp.float32)
+    row = lax.broadcasted_iota(jnp.int32, (qrows, ps), 0)
+    limit = jnp.minimum(
+        lens[:, None, None, None],
+        lens[:, None, None, None] - q_len + 1 + row[None, None])
+
+    # same compile-scaling rule as the q-len-1 replay: ONE lax.scan
+    # over pages, body compiled once however wide the table is
+    def page_step(carry, inputs):
+        m, l, acc = carry
+        p, k, v = inputs                            # [B, H, ps, d]
+        if int8kv:
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
+        kpos = p * ps + lax.broadcasted_iota(
+            jnp.int32, (qrows, ps), 1)
+        mask = kpos[None, None] < limit
+        s = jnp.einsum("bhqd,bhkd->bhqk", q8, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(mask, s, _NEG_INF)
+        m_next = jnp.maximum(m, jnp.max(s, axis=-1))
+        p_ = jnp.exp(s - m_next[..., None])
+        p_ = jnp.where(mask, p_, 0.0)
+        alpha = jnp.exp(m - m_next)
+        l = l * alpha + jnp.sum(p_, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p_ if int8kv else p_.astype(v.dtype),
+            v, preferred_element_type=jnp.float32)
+        return (m_next, l, acc), None
+
+    (m, l, acc), _ = lax.scan(
+        page_step, (m, l, acc),
+        (jnp.arange(max_pages, dtype=jnp.int32),
+         jnp.moveaxis(kg, 1, 0), jnp.moveaxis(vg, 1, 0)))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l[..., None]).astype(q.dtype)
+    return jnp.transpose(out[:, :, :rr, :], (0, 2, 1, 3))
+
+
+_decode_reference_multi_jit = jax.jit(_decode_reference_multi_impl,
+                                      static_argnums=(6,))
+
+
 def flash_decode(q, k_pages, v_pages, block_tables, seq_lens, *,
                  scale=None, impl=None, head_pack=None,
                  kv_scales=None, vmem_budget_bytes=None):
     """Paged-KV decode-step attention.  q: [B, H, d] (ONE query token
-    per sequence); k_pages/v_pages: [num_pages, H, page_size, d] pool
+    per sequence) or [B, R, H, d] (the SPECULATIVE VERIFY step, ISSUE
+    11c: the R = k+1 newest tokens of each sequence as distinct query
+    rows, row r causally seeing keys < seq_len - R + 1 + r);
+    k_pages/v_pages: [num_pages, H, page_size, d] pool
     (ops/paged_kv.PagedKVCache layout; int8 pools need kv_scales =
     (k_scale, v_scale) per-channel [H, d]); block_tables: [B,
-    max_pages] int32; seq_lens: [B] int32.  Returns [B, H, d].
+    max_pages] int32; seq_lens: [B] int32 — the FULL cached length,
+    including the R window tokens in verify mode.  Returns [B, H, d]
+    or [B, R, H, d].
 
     impl: None (auto: pallas on TPU, reference replay elsewhere),
     "pallas", "interpret", or "xla" (the gather+reference path).
@@ -1099,7 +1230,10 @@ def flash_decode(q, k_pages, v_pages, block_tables, seq_lens, *,
     d <= 64 and an even H.  Every mode is bit-identical (array_equal)
     to flash_decode_reference — the parity contract tests pin across
     page boundaries, ragged lengths, d in {64, 128}, f32/bf16/int8-KV,
-    head-packed and not."""
+    head-packed and not, q_len 1 and k+1.  Verify row r is ALSO
+    bit-identical to a q-len-1 call at seq_len - R + 1 + r (masked
+    pages are exact no-ops in the online-softmax merge) — the
+    numerical half of the lossless-speculation contract."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     scale = float(scale)
@@ -1107,36 +1241,39 @@ def flash_decode(q, k_pages, v_pages, block_tables, seq_lens, *,
         head_pack = _resolve_variants(None, None)[1]
     if impl is None:
         impl = "pallas" if _on_tpu() else "xla"
+    q_len = 1 if q.ndim == 3 else int(q.shape[1])
     int8kv = jnp.dtype(k_pages.dtype) == jnp.int8
     if int8kv and kv_scales is None:
         raise ValueError("int8 k_pages/v_pages need kv_scales "
                          "(per-channel [H, d] — paged_kv.kv_scales())")
-    hpb = _decode_hpb(head_pack, q.shape[1], q.shape[2])
+    hpb = _decode_hpb(head_pack, q.shape[-2], q.shape[-1])
     if impl in ("pallas", "interpret") and not _decode_geom_ok(
-            q, k_pages, hpb, vmem_budget_bytes):
+            q, k_pages, hpb, vmem_budget_bytes, q_len):
         impl = "xla"   # documented fallback: gather + reference replay
     if _obs_trace._tracer is not None:
         with _obs_device.annotate("flash_decode"):
             return _flash_decode_entry(q, k_pages, v_pages,
                                        block_tables, seq_lens, scale,
-                                       impl, hpb, int8kv, kv_scales)
+                                       impl, hpb, int8kv, kv_scales,
+                                       q_len)
     return _flash_decode_entry(q, k_pages, v_pages, block_tables,
                                seq_lens, scale, impl, hpb, int8kv,
-                               kv_scales)
+                               kv_scales, q_len)
 
 
 def _flash_decode_entry(q, k_pages, v_pages, block_tables, seq_lens,
-                        scale, impl, hpb, int8kv, kv_scales):
+                        scale, impl, hpb, int8kv, kv_scales, q_len=1):
     if impl in ("pallas", "interpret"):
         if int8kv:
             q_eff, vdq = _int8_pre(q, kv_scales)
             raw = _flash_decode_pallas(
                 q_eff, k_pages, v_pages, block_tables, seq_lens,
-                scale, hpb, interpret=impl == "interpret")
+                scale, hpb, interpret=impl == "interpret",
+                q_len=q_len)
             return _int8_post(raw, vdq, q.dtype)
         return _flash_decode_pallas(
             q, k_pages, v_pages, block_tables, seq_lens, scale, hpb,
-            interpret=impl == "interpret")
+            interpret=impl == "interpret", q_len=q_len)
     return flash_decode_reference(q, k_pages, v_pages, block_tables,
                                   seq_lens, scale=scale,
                                   kv_scales=kv_scales)

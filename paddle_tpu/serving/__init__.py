@@ -23,7 +23,12 @@ Pieces (each its own module):
   decode_engine.py continuous decode batching (ISSUE 7): DecodeServer
                    — iteration-level batching of LLM decode over paged
                    KV-caches + flash_decode, reusing the admission /
-                   deadline / drain contracts above (docs/DECODE.md)
+                   deadline / drain contracts above; decode speed act
+                   II (ISSUE 11) rides it behind default-off typed
+                   flags — chunked prefill (prefill_chunk), COW
+                   prefix sharing (kv_share), lossless speculative
+                   decoding (spec_k) — with deadline-aware preemption
+                   (docs/DECODE.md)
 
 Design + contracts: docs/SERVING.md.  Fault semantics are driven by
 distributed/faultinject.py (msg types ``serving_infer`` /

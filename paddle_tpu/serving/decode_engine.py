@@ -28,10 +28,38 @@ The request path reuses the PR-6 serving discipline verbatim:
     history and generation continues.  The dead replica's cache is
     reset (all pages back to free), so a kill can corrupt nothing and
     leak nothing.
-  - pool pressure: a batch that cannot take one more page PREEMPTS its
-    youngest sequence back to the retry lane (tokens-so-far preserved)
-    instead of corrupting the pool — vLLM-style preemption as the
-    backpressure of paging.
+  - pool pressure: a batch that cannot take one more page PREEMPTS a
+    sequence back to the retry lane (tokens-so-far preserved) instead
+    of corrupting the pool — vLLM-style preemption as the
+    backpressure of paging.  The victim policy is DEADLINE-AWARE
+    (ISSUE 11 satellite): scanning youngest -> oldest, the first
+    sequence whose deadline could afford a re-prefill is evicted; a
+    sequence that would miss its deadline if re-prefilled is spared
+    while a less constrained one exists, and when every candidate is
+    at risk the youngest goes (the pinned legacy tie-break).
+
+Decode speed act II (ISSUE 11), three legs, each behind its own
+default-off typed flag with the repo's bit-parity discipline:
+
+  - CHUNKED PREFILL (flag ``prefill_chunk`` / DecodeConfig knob): a
+    prompt longer than the chunk joins incrementally — ONE fixed-size
+    chunk of projections + page writes per iteration (chunk shape
+    padded to exactly the chunk size: one compile), interleaved with
+    the running batch's decode steps, so a 32k-token join never
+    stretches running streams' inter-token p99 (the PR-10
+    ``decode_inter_token`` SLO is the acceptance instrument).
+    Chunked output is bit-identical to whole-prefill.
+  - PREFIX SHARING (flag ``kv_share``): prompt prefill consults the
+    cache's radix tree first — the longest already-cached full-page
+    prefix is SHARED (refcounted, zero projections, zero writes), so
+    N requests behind one system prompt pay its prefill once.
+  - LOSSLESS SPECULATIVE DECODING (flag ``spec_k``): a small draft
+    model (its own paged cache per replica) proposes k tokens, ONE
+    batched q-len-(k+1) flash_decode verify step scores them,
+    ``decode.spec_accept_length`` takes the longest agreeing prefix,
+    and rejection is a page-pointer rewind (PagedKVCache.truncate)
+    through the atomic free path — speculative greedy output is
+    token-for-token identical to non-speculative greedy (asserted).
 
 Model adapter protocol (duck-typed; ``TinyDecodeLM`` is the built-in
 used by tests, the load generator and the bench):
@@ -74,8 +102,8 @@ MSG_DECODE = "serving_decode"
 _M_DECODE = _obs_metrics.counter(
     "paddle_tpu_decode_events_total",
     "decode-server transitions (iterations / tokens_out / prefills / "
-    "kills / step_faults / failovers / preemptions / retires), by "
-    "event")
+    "prefill_chunks / kills / step_faults / failovers / preemptions / "
+    "retires / spec_proposed / spec_accepted), by event")
 _M_STEP_MS = _obs_metrics.histogram(
     "paddle_tpu_decode_inter_token_seconds",
     "per-sequence inter-token latency")
@@ -155,7 +183,11 @@ class DecodeConfig:
                  default_deadline_s=30.0, n_replicas=1,
                  restart_dead=True, max_attempts=None, eos_id=1,
                  kv_int8=None, head_pack=None, drain_timeout_s=30.0,
-                 impl=None, metrics_port=None, trace_sample=None):
+                 impl=None, metrics_port=None, trace_sample=None,
+                 prefill_chunk=None, kv_share=None, spec_k=None,
+                 draft_factory=None, preempt_slack_s=0.25):
+        from paddle_tpu.flags import get_flag
+
         self.max_batch = int(max_batch)
         self.max_new_tokens = int(max_new_tokens)
         self.page_size = int(page_size)
@@ -190,6 +222,25 @@ class DecodeConfig:
             if not 0.0 <= trace_sample <= 1.0:
                 raise ValueError("trace_sample must be in [0.0, 1.0]")
         self.trace_sample = trace_sample
+        # decode speed act II (ISSUE 11): None defers to the typed
+        # flags, resolved once here (0 / False = the validated PR-7
+        # paths, zero behavior change)
+        self.prefill_chunk = int(get_flag("prefill_chunk")) \
+            if prefill_chunk is None else int(prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0")
+        self.kv_share = kv_share    # None -> the typed flag (cache)
+        self.spec_k = int(get_flag("spec_k")) if spec_k is None \
+            else int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        # draft_factory(i) -> draft model adapter (spec_k > 0 only);
+        # None = a small TinyDecodeLM over the target's vocab
+        self.draft_factory = draft_factory
+        # deadline-aware preemption: a victim needs at least this much
+        # deadline slack (plus a per-history-token allowance) to be
+        # considered re-prefillable
+        self.preempt_slack_s = float(preempt_slack_s)
 
 
 class _Seq:
@@ -197,7 +248,8 @@ class _Seq:
     failover unit — a survivor re-prefills from ``history``)."""
 
     __slots__ = ("req", "prompt", "generated", "max_new", "attempts",
-                 "slot", "last_token", "last_emit_t", "trace")
+                 "slot", "draft_slot", "chunk_pos", "last_token",
+                 "last_emit_t", "trace")
 
     def __init__(self, req, prompt, max_new):
         self.req = req
@@ -206,6 +258,9 @@ class _Seq:
         self.max_new = int(max_new)
         self.attempts = 0
         self.slot = None
+        self.draft_slot = None       # spec decode: the draft cache's
+        self.chunk_pos = 0           # chunked prefill: prefix tokens
+        #                              already written to the caches
         self.last_token = None
         self.last_emit_t = None
         self.trace = req.trace       # join/step/retire chain onto it
@@ -215,9 +270,10 @@ class _Seq:
 
 
 class _DecodeReplica:
-    """Model + paged cache + the sequences currently riding it."""
+    """Model + paged cache (+ draft model and ITS paged cache under
+    spec_k) + the sequences currently riding it."""
 
-    def __init__(self, index, model, cfg):
+    def __init__(self, index, model, cfg, draft_model=None):
         self.index = index
         self.model = model
         self.cfg = cfg
@@ -225,8 +281,17 @@ class _DecodeReplica:
         self.cache = PagedKVCache(
             num_pages=cfg.num_pages, page_size=cfg.page_size,
             num_heads=model.num_heads, head_dim=model.head_dim,
-            kv_int8=cfg.kv_int8)
+            kv_int8=cfg.kv_int8, kv_share=cfg.kv_share)
+        self.draft_model = draft_model
+        self.draft_cache = None
+        if draft_model is not None:
+            self.draft_cache = PagedKVCache(
+                num_pages=cfg.num_pages, page_size=cfg.page_size,
+                num_heads=draft_model.num_heads,
+                head_dim=draft_model.head_dim,
+                kv_int8=cfg.kv_int8, kv_share=cfg.kv_share)
         self.active = []            # [_Seq], admission order
+        self.prefilling = []        # [_Seq] mid-chunked-prefill
         self.iterations = 0
         self.tokens_out = 0
 
@@ -250,8 +315,18 @@ class DecodeServer:
         # single-survivor-deadlock lesson (total sequences stay bounded
         # by admission capacity + max_batch * n_replicas)
         self._retry = BoundedQueue()
-        self.replicas = [_DecodeReplica(i, factory(i), cfg)
-                         for i in range(cfg.n_replicas)]
+        self.replicas = []
+        for i in range(cfg.n_replicas):
+            model = factory(i)
+            draft = None
+            if cfg.spec_k > 0:
+                # replicas must agree on the draft too: a failed-over
+                # sequence continues the same proposal distribution
+                draft = cfg.draft_factory(i) if cfg.draft_factory \
+                    else TinyDecodeLM(vocab=model.vocab, d_model=32,
+                                      num_heads=2, head_dim=16,
+                                      seed=0)
+            self.replicas.append(_DecodeReplica(i, model, cfg, draft))
         self._sup = Supervisor(restart_backoff=0.02, max_backoff=0.5)
         for rep in self.replicas:
             self._sup.add_worker("decode-%d" % rep.index,
@@ -260,8 +335,10 @@ class DecodeServer:
         self._meta = {}             # req.id -> max_new
         self._lock = threading.Lock()
         self._counters = {"iterations": 0, "tokens_out": 0,
-                          "prefills": 0, "kills": 0, "step_faults": 0,
-                          "failovers": 0, "preemptions": 0}
+                          "prefills": 0, "prefill_chunks": 0,
+                          "kills": 0, "step_faults": 0,
+                          "failovers": 0, "preemptions": 0,
+                          "spec_proposed": 0, "spec_accepted": 0}
         self._step_ms = []          # bounded rolling inter-token record
         self.metrics_server = None
         self._started = False
@@ -329,10 +406,14 @@ class DecodeServer:
         max_new = int(max_new_tokens) if max_new_tokens is not None \
             else self.config.max_new_tokens
         cache0 = self.replicas[0].cache
-        if cache0.pages_for(ids.size + max_new) > cache0.num_pages:
+        # spec decoding transiently appends k+1 tokens before the
+        # rejection rewind — the capacity check carries that margin
+        margin = self.config.spec_k + 1 if self.config.spec_k else 0
+        if cache0.pages_for(ids.size + max_new + margin) > \
+                cache0.num_pages:
             raise ValueError(
                 "prompt+max_new needs %d pages; the pool only has %d"
-                % (cache0.pages_for(ids.size + max_new),
+                % (cache0.pages_for(ids.size + max_new + margin),
                    cache0.num_pages))
         req = self.admission.submit({"ids": ids.astype(np.int32)},
                                     deadline_s=deadline_s,
@@ -359,7 +440,7 @@ class DecodeServer:
                 if not rep.alive:
                     return
                 self._admit(rep)
-                if not rep.active:
+                if not rep.active and not rep.prefilling:
                     if self.admission.draining and \
                             self._retry.empty():
                         time.sleep(0.002)
@@ -381,7 +462,7 @@ class DecodeServer:
         """Join new + failed-over sequences into this replica's batch
         (iteration-level batching: called every step)."""
         cfg = self.config
-        while len(rep.active) < cfg.max_batch:
+        while len(rep.active) + len(rep.prefilling) < cfg.max_batch:
             seq = None
             try:
                 seq = self._retry.get_nowait()
@@ -409,7 +490,7 @@ class DecodeServer:
                     % seq.attempts))
                 continue
             try:
-                self._prefill(rep, seq)
+                ready = self._prefill(rep, seq)
             except OutOfPagesError:
                 # no room: back on the lane for later / for a less
                 # loaded replica (not an attempt — nothing failed)
@@ -420,43 +501,160 @@ class DecodeServer:
                     "decode.join", parent=seq.trace,
                     request_id=seq.req.id, replica=rep.index,
                     prompt_len=len(seq.prompt),
-                    attempt=seq.attempts)
+                    attempt=seq.attempts,
+                    chunked=not ready)
                 if seq.trace is not None:
                     seq.trace = sp.ctx
             _flight.record("decode", "join", request_id=seq.req.id,
                            replica=rep.index,
-                           prompt_len=len(seq.prompt))
-            rep.active.append(seq)
+                           prompt_len=len(seq.prompt),
+                           chunked=not ready)
+            (rep.active if ready else rep.prefilling).append(seq)
+
+    @staticmethod
+    def _proj_pow2(model, toks):
+        """Whole-prefill projections: pow2-pad the span (ragged
+        lengths would retrace the jitted qkv per length), slice the
+        real rows — the validated PR-7 path, byte-for-byte."""
+        plen = len(toks)
+        pp = 1
+        while pp < plen:
+            pp *= 2
+        padded = np.zeros((pp,), np.int32)
+        padded[:plen] = toks
+        _, k, v = model.qkv(padded)
+        return k[:plen], v[:plen]
+
+    @staticmethod
+    def _proj_chunk(model, toks, chunk):
+        """Chunked-prefill projections: every chunk call runs at
+        EXACTLY the chunk shape (the compile-once discipline — the
+        final partial chunk pads up to it)."""
+        plen = len(toks)
+        padded = np.zeros((chunk,), np.int32)
+        padded[:plen] = toks
+        _, k, v = model.qkv(padded)
+        return k[:plen], v[:plen]
+
+    def _release_seq(self, rep, seq):
+        """Free whatever cache state the sequence holds on this
+        replica (both caches under spec_k); resets the chunk cursor so
+        a re-prefill starts clean."""
+        if seq.slot is not None:
+            rep.cache.free(seq.slot)
+            seq.slot = None
+        if seq.draft_slot is not None and rep.draft_cache is not None:
+            rep.draft_cache.free(seq.draft_slot)
+        seq.draft_slot = None
+        seq.chunk_pos = 0
 
     def _prefill(self, rep, seq):
-        """Write KV for history[:-1] into fresh pages; the last history
-        token becomes the pending input of the next iteration."""
+        """Write KV for history[:-1] into fresh pages (BOTH caches
+        under spec_k); the last history token becomes the pending
+        input of the next iteration.  Returns True when the sequence
+        is decode-ready, False when its prompt continues chunk-by-
+        chunk in _advance_prefill (ISSUE 11a).  Under kv_share the
+        already-cached full-page prefix is shared instead of projected
+        or written (ISSUE 11b)."""
+        cfg = self.config
         hist = seq.history()
         prefix = hist[:-1]
-        if prefix:
-            # pow2-pad the prompt through the projections (ragged
-            # lengths would retrace the jitted qkv per length), then
-            # slice the real rows for the page writes
-            plen = len(prefix)
-            pp = 1
-            while pp < plen:
-                pp *= 2
-            padded = np.zeros((pp,), np.int32)
-            padded[:plen] = prefix
-            _, k, v = rep.model.qkv(padded)
-            seq.slot = rep.cache.prefill(k[:plen], v[:plen])
-        else:
-            seq.slot = rep.cache.alloc(1)
+        try:
+            if not prefix:
+                seq.slot = rep.cache.alloc(1)
+                if rep.draft_cache is not None:
+                    seq.draft_slot = rep.draft_cache.alloc(1)
+            else:
+                shared = rep.cache.shared_prefix_tokens(prefix)
+                chunk = cfg.prefill_chunk
+                if chunk and len(prefix) - shared > chunk:
+                    span = prefix[:shared + chunk]
+                else:
+                    span = prefix
+                tail = span[shared:]
+                if not tail:
+                    # fully shared: zero projections, zero writes —
+                    # the amortized-to-zero prefill of a cached prompt
+                    k = v = np.zeros((0, rep.model.num_heads,
+                                      rep.model.head_dim), np.float32)
+                elif chunk:
+                    # every chunked projection runs at the one fixed
+                    # chunk shape (tail <= chunk by the span cap)
+                    k, v = self._proj_chunk(rep.model, tail, chunk)
+                else:
+                    k, v = self._proj_pow2(rep.model, tail)
+                seq.slot = rep.cache.prefill(
+                    k, v, tokens=span if rep.cache.kv_share else None)
+                if rep.draft_cache is not None:
+                    dm = rep.draft_cache.shared_prefix_tokens(span)
+                    kd, vd = self._proj_pow2(rep.draft_model,
+                                             span[dm:]) \
+                        if len(span) > dm else \
+                        (np.zeros((0, rep.draft_model.num_heads,
+                                   rep.draft_model.head_dim),
+                                  np.float32),) * 2
+                    seq.draft_slot = rep.draft_cache.prefill(
+                        kd, vd,
+                        tokens=span if rep.draft_cache.kv_share
+                        else None)
+                if len(span) < len(prefix):
+                    seq.chunk_pos = len(span)
+                    self._count(prefill_chunks=1)
+                    return False
+        except OutOfPagesError:
+            self._release_seq(rep, seq)
+            raise
+        seq.chunk_pos = 0
         seq.last_token = int(hist[-1])
         seq.last_emit_t = time.monotonic()
         self._count(prefills=1)
+        return True
+
+    def _advance_prefill(self, rep):
+        """One fixed-size prefill chunk per iteration for the OLDEST
+        joining sequence (ISSUE 11a): the cost a long prompt adds to
+        every running stream's inter-token time is bounded by one
+        chunk, whatever the prompt length."""
+        if not rep.prefilling:
+            return
+        cfg = self.config
+        seq = rep.prefilling[0]
+        prefix = seq.history()[:-1]
+        span = prefix[seq.chunk_pos:seq.chunk_pos + cfg.prefill_chunk]
+        try:
+            k, v = self._proj_chunk(rep.model, span, cfg.prefill_chunk)
+            rep.cache.extend(
+                seq.slot, k, v,
+                tokens=prefix[:seq.chunk_pos + len(span)]
+                if rep.cache.kv_share else None)
+            if rep.draft_cache is not None:
+                kd, vd = self._proj_chunk(rep.draft_model, span,
+                                          cfg.prefill_chunk)
+                rep.draft_cache.extend(
+                    seq.draft_slot, kd, vd,
+                    tokens=prefix[:seq.chunk_pos + len(span)]
+                    if rep.draft_cache.kv_share else None)
+        except OutOfPagesError:
+            # pool pressure mid-prefill: whole sequence back on the
+            # lane (pages freed — nothing half-joined)
+            rep.prefilling.pop(0)
+            self._release_seq(rep, seq)
+            self._retry.put(seq)
+            return
+        seq.chunk_pos += len(span)
+        self._count(prefill_chunks=1)
+        if seq.chunk_pos >= len(prefix):
+            rep.prefilling.pop(0)
+            seq.chunk_pos = 0
+            seq.last_token = int(seq.history()[-1])
+            seq.last_emit_t = time.monotonic()
+            self._count(prefills=1)
+            rep.active.append(seq)
 
     def _iterate(self, rep):
-        """ONE decode step for the whole running batch."""
-        import jax.numpy as jnp
-
-        from paddle_tpu.ops.pallas_kernels import flash_decode
-
+        """ONE iteration: advance at most one prefill chunk, then one
+        decode step (plain or speculative) for the whole running
+        batch."""
         cfg = self.config
         # seeded fault point — consulted BEFORE any cache mutation so
         # kill/close/drop can never half-apply a step
@@ -480,21 +678,95 @@ class DecodeServer:
                         return
         now = time.monotonic()
         # deadline / externally-answered sweep before spending compute
-        keep = []
-        for s in rep.active:
-            if s.req.done():
-                rep.cache.free(s.slot)
-            elif s.req.expired(now):
-                rep.cache.free(s.slot)
-                s.req.fail(DeadlineExpiredError(
-                    "request %s: deadline passed mid-generation "
-                    "(%d/%d tokens emitted)"
-                    % (s.req.id, len(s.generated), s.max_new)))
-            else:
-                keep.append(s)
-        rep.active = keep
+        # (joining chunked sequences expire mid-prefill the same way)
+        for lane_name in ("active", "prefilling"):
+            lane = getattr(rep, lane_name)
+            keep = []
+            for s in lane:
+                if s.req.done():
+                    self._release_seq(rep, s)
+                elif s.req.expired(now):
+                    self._release_seq(rep, s)
+                    s.req.fail(DeadlineExpiredError(
+                        "request %s: deadline passed mid-generation "
+                        "(%d/%d tokens emitted)"
+                        % (s.req.id, len(s.generated), s.max_new)))
+                else:
+                    keep.append(s)
+            setattr(rep, lane_name, keep)
+        self._advance_prefill(rep)
         if not rep.active:
             return
+        if cfg.spec_k > 0:
+            self._step_spec(rep)
+        else:
+            self._step(rep)
+        st = rep.cache.stats()
+        _M_PAGE_UTIL.set(
+            st["in_use_pages"] / float(max(1, st["num_pages"])),
+            replica=rep.index)
+        _M_ACTIVE.set(len(rep.active), replica=rep.index)
+
+    def _preempt_victim(self, rep, now):
+        """Deadline-aware victim index (ISSUE 11 satellite): youngest
+        -> oldest, the first sequence whose deadline can absorb a
+        re-prefill (slack > preempt_slack_s + 1 ms/history-token); a
+        sequence that would miss its deadline if evicted is spared
+        while a less constrained — possibly older — one exists.  Every
+        candidate at risk -> the youngest (the pinned legacy
+        tie-break)."""
+        slack = self.config.preempt_slack_s
+        for idx in range(len(rep.active) - 1, -1, -1):
+            s = rep.active[idx]
+            if s.req.remaining(now) > slack + \
+                    0.001 * len(s.history()):
+                return idx
+        return len(rep.active) - 1
+
+    def _preempt_one(self, rep):
+        """Evict one sequence under pool pressure (full history
+        preserved on the retry lane); returns False when the batch is
+        down to a lone unservable sequence (typed failure, step
+        abandoned)."""
+        if len(rep.active) == 1:
+            s = rep.active.pop()
+            self._release_seq(rep, s)
+            s.req.fail(ReplicaFailedError(
+                "request %s: page pool too small even for a "
+                "lone sequence" % s.req.id))
+            return False
+        s = rep.active.pop(self._preempt_victim(rep,
+                                                time.monotonic()))
+        self._release_seq(rep, s)
+        self._count(preemptions=1)
+        _flight.record("decode", "preempt",
+                       request_id=s.req.id,
+                       replica=rep.index,
+                       tokens_so_far=len(s.generated))
+        self._retry.put(s)
+        return True
+
+    def _table_bucket(self, cache, slots):
+        """pow2 bucket of the table width: at most log2(max) distinct
+        (batch, table) shapes ever reach the compiler."""
+        mp_need = max(cache.pages_for(cache.seq_len(s_) or 1)
+                      for s_ in slots)
+        mp = 1
+        while mp < mp_need:
+            mp *= 2
+        # a long sequence's pow2 rounding can overshoot the table
+        # itself; clamping keeps the kernel's page sweep bounded (a
+        # sequence can never hold more than max_pages_per_seq pages,
+        # so the clamp is always >= mp_need)
+        return min(mp, cache.max_pages_per_seq)
+
+    def _step(self, rep):
+        """ONE decode step for the whole running batch."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas_kernels import flash_decode
+
+        cfg = self.config
         # compile-once shape discipline (the PR-6 bucket-cache story
         # applied to decode): the device step always runs at the FIXED
         # batch shape max_batch (dummy rows: sink-page writes, length
@@ -504,42 +776,21 @@ class DecodeServer:
         # step per composition (measured: ~300 ms/step of pure
         # recompile on the CPU harness)
         n_pad = cfg.max_batch
-        tokens = np.zeros((n_pad,), np.int32)
-        tokens[:len(rep.active)] = [s.last_token for s in rep.active]
-        q, k, v = rep.model.qkv(tokens)
-        slots = [s.slot for s in rep.active]
         while True:
+            tokens = np.zeros((n_pad,), np.int32)
+            tokens[:len(rep.active)] = [s.last_token
+                                        for s in rep.active]
+            q, k, v = rep.model.qkv(tokens)
+            slots = [s.slot for s in rep.active]
             try:
                 rep.cache.append(slots, k, v)
                 break
             except OutOfPagesError:
-                # paging backpressure: preempt the youngest sequence
-                # (full history preserved) and retry the step
-                if len(rep.active) == 1:
-                    s = rep.active.pop()
-                    rep.cache.free(s.slot)
-                    s.slot = None
-                    s.req.fail(ReplicaFailedError(
-                        "request %s: page pool too small even for a "
-                        "lone sequence" % s.req.id))
+                # paging backpressure: preempt (deadline-aware) and
+                # retry the step
+                if not self._preempt_one(rep):
                     return
-                s = rep.active.pop()
-                rep.cache.free(s.slot)
-                s.slot = None
-                self._count(preemptions=1)
-                _flight.record("decode", "preempt",
-                               request_id=s.req.id,
-                               replica=rep.index,
-                               tokens_so_far=len(s.generated))
-                self._retry.put(s)
-                slots = slots[:-1]
-        # pow2 bucket of the table width: at most log2(max) distinct
-        # (batch, table) shapes ever reach the compiler
-        mp_need = max(rep.cache.pages_for(rep.cache.seq_len(s_) or 1)
-                      for s_ in slots)
-        mp = 1
-        while mp < mp_need:
-            mp *= 2
+        mp = self._table_bucket(rep.cache, slots)
         tables = rep.cache.tables_for(slots, max_pages=mp,
                                       pad_to=n_pad)
         lens = rep.cache.lens_for(slots, pad_to=n_pad)
@@ -552,54 +803,202 @@ class DecodeServer:
         next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
         t_emit = time.monotonic()
         rep.iterations += 1
-        tr = _trace._tracer
         still = []
         for s, tok in zip(rep.active, next_tokens):
-            tok = int(tok)
+            retired = self._commit_tokens(rep, s, [int(tok)], t_emit)
+            if not retired:
+                still.append(s)
+        rep.active = still
+        self._count(iterations=1, tokens_out=len(next_tokens))
+
+    def _commit_tokens(self, rep, s, toks, t_emit):
+        """Append emitted tokens to a sequence's bookkeeping (never
+        touches the caches); returns True when the sequence retired
+        (pages freed, future answered)."""
+        cfg = self.config
+        tr = _trace._tracer
+        per_tok_ms = None
+        if s.last_emit_t is not None:
+            per_tok_ms = (t_emit - s.last_emit_t) * 1000.0 / len(toks)
+        done = False
+        for tok in toks:
             s.generated.append(tok)
             s.last_token = tok
-            if s.last_emit_t is not None:
-                self._record_step_ms(
-                    (t_emit - s.last_emit_t) * 1000.0)
-            s.last_emit_t = t_emit
+            if per_tok_ms is not None:
+                self._record_step_ms(per_tok_ms)
             rep.tokens_out += 1
             if tr is not None:
                 tr.instant("decode.step", parent=s.trace,
                            request_id=s.req.id, replica=rep.index,
                            token=tok, n=len(s.generated))
             if tok == cfg.eos_id or len(s.generated) >= s.max_new:
-                rep.cache.free(s.slot)
-                s.slot = None
-                if tr is not None:
-                    tr.instant("decode.retire", parent=s.trace,
-                               request_id=s.req.id,
-                               replica=rep.index,
-                               tokens=len(s.generated))
-                _flight.record("decode", "retire",
-                               request_id=s.req.id,
-                               replica=rep.index,
-                               tokens=len(s.generated))
-                self._count(retires=1)
-                s.req.complete(
-                    [np.asarray(s.generated, np.int32)])
-            else:
+                done = True
+        s.last_emit_t = t_emit
+        if done:
+            self._release_seq(rep, s)
+            if tr is not None:
+                tr.instant("decode.retire", parent=s.trace,
+                           request_id=s.req.id,
+                           replica=rep.index,
+                           tokens=len(s.generated))
+            _flight.record("decode", "retire",
+                           request_id=s.req.id,
+                           replica=rep.index,
+                           tokens=len(s.generated))
+            self._count(retires=1)
+            s.req.complete([np.asarray(s.generated, np.int32)])
+        return done
+
+    def _step_spec(self, rep):
+        """ONE speculative iteration (ISSUE 11c): k draft proposals,
+        one q-len-(k+1) verify sweep, longest-agreeing-prefix
+        acceptance, page-pointer rewind of the rejected tail.  Any
+        OutOfPagesError mid-round rewinds BOTH caches to the
+        iteration's start state (truncate through the atomic free
+        path), preempts one sequence, and retries — the same
+        backpressure contract as the plain step."""
+        while True:
+            if not rep.active:
+                return
+            base = [(s, rep.cache.seq_len(s.slot),
+                     rep.draft_cache.seq_len(s.draft_slot))
+                    for s in rep.active]
+            try:
+                self._spec_round(rep)
+                return
+            except OutOfPagesError:
+                for s, main_len, draft_len in base:
+                    if s.slot is not None and \
+                            rep.cache.seq_len(s.slot) > main_len:
+                        rep.cache.truncate(s.slot, main_len)
+                    if s.draft_slot is not None and \
+                            rep.draft_cache.seq_len(s.draft_slot) > \
+                            draft_len:
+                        rep.draft_cache.truncate(s.draft_slot,
+                                                 draft_len)
+                if not self._preempt_one(rep):
+                    return
+
+    def _spec_round(self, rep):
+        import jax.numpy as jnp
+
+        from paddle_tpu.decode import spec_accept_length
+        from paddle_tpu.ops.pallas_kernels import flash_decode
+
+        cfg = self.config
+        kk = cfg.spec_k
+        n_pad = cfg.max_batch
+        live = rep.active
+        n = len(live)
+        draft = rep.draft_model
+        dcache = rep.draft_cache
+        # --- draft phase: k sequential q-len-1 proposals on the
+        # draft replica's own paged cache (fixed shapes throughout)
+        pending = np.zeros((n_pad,), np.int32)
+        pending[:n] = [s.last_token for s in live]
+        dslots = [s.draft_slot for s in live]
+        proposals = np.zeros((n_pad, kk), np.int32)
+        cur = pending.copy()
+        for j in range(kk):
+            q, dk, dv = draft.qkv(cur)
+            dcache.append(dslots, dk, dv)
+            mp = self._table_bucket(dcache, dslots)
+            tables = dcache.tables_for(dslots, max_pages=mp,
+                                       pad_to=n_pad)
+            lens = dcache.lens_for(dslots, pad_to=n_pad)
+            out = flash_decode(
+                q, dcache.k_pages, dcache.v_pages, tables, lens,
+                impl=cfg.impl, head_pack=cfg.head_pack,
+                kv_scales=dcache.kv_scales() if dcache.kv_int8
+                else None)
+            cur = np.asarray(jnp.argmax(draft.logits(out), axis=-1)) \
+                .astype(np.int32)
+            proposals[:, j] = cur
+        # --- verify phase: ONE batched q-len-(k+1) target sweep over
+        # [pending, d_1..d_k] — the whole window appends first (the
+        # speculative pages), then every row scores in one kernel pass
+        r = kk + 1
+        window = np.zeros((n_pad, r), np.int32)
+        window[:n, 0] = pending[:n]
+        window[:n, 1:] = proposals[:n]
+        h, d = rep.model.num_heads, rep.model.head_dim
+        q, mk, mv = rep.model.qkv(window.reshape(-1))
+        q = jnp.reshape(q, (n_pad, r, h, d))
+        mk = jnp.reshape(mk, (n_pad, r, h, d))
+        mv = jnp.reshape(mv, (n_pad, r, h, d))
+        slots = [s.slot for s in live]
+        rep.cache.append(slots, mk, mv)
+        mp = self._table_bucket(rep.cache, slots)
+        tables = rep.cache.tables_for(slots, max_pages=mp,
+                                      pad_to=n_pad)
+        lens = rep.cache.lens_for(slots, pad_to=n_pad)
+        out = flash_decode(
+            q, rep.cache.k_pages, rep.cache.v_pages, tables, lens,
+            impl=cfg.impl, head_pack=cfg.head_pack,
+            kv_scales=rep.cache.kv_scales() if rep.cache.kv_int8
+            else None)
+        logits = rep.model.logits(jnp.reshape(out, (n_pad * r, h, d)))
+        targets = np.asarray(jnp.argmax(logits, axis=-1)) \
+            .reshape(n_pad, r)
+        # --- acceptance + cache rewind (still abortable: seq
+        # bookkeeping is untouched until the commit loop below)
+        plan = []
+        catch_up = []
+        for i, s in enumerate(live):
+            m = spec_accept_length(proposals[i], targets[i])
+            emitted = [int(t) for t in targets[i, :m + 1]]
+            room = s.max_new - len(s.generated)
+            if len(emitted) > room:
+                emitted = emitted[:room]
+            if cfg.eos_id in emitted:
+                emitted = emitted[:emitted.index(cfg.eos_id) + 1]
+            n_emit = len(emitted)
+            plan.append((s, emitted, m))
+            base_main = rep.cache.seq_len(s.slot) - r
+            rep.cache.truncate(s.slot, base_main + n_emit)
+            base_draft = dcache.seq_len(s.draft_slot) - kk
+            dcache.truncate(s.draft_slot,
+                            min(base_draft + kk, base_draft + n_emit))
+            if n_emit == kk + 1:
+                # full acceptance: the draft cache is one row short
+                # (d_k was proposed but never appended draft-side)
+                catch_up.append((s, int(proposals[i, kk - 1])))
+        if catch_up:
+            toks = np.zeros((n_pad,), np.int32)
+            toks[:len(catch_up)] = [t for _, t in catch_up]
+            _, dk, dv = draft.qkv(toks)
+            dcache.append([s.draft_slot for s, _ in catch_up], dk, dv)
+        # --- commit (never raises): emitted tokens, timers, retires
+        t_emit = time.monotonic()
+        rep.iterations += 1
+        total = 0
+        accepted = 0
+        still = []
+        for s, emitted, m in plan:
+            total += len(emitted)
+            # acceptance counts draft AGREEMENT (the draft-quality /
+            # speedup signal), not emission — eos and max_new caps
+            # discard agreed tokens without saying anything about the
+            # draft
+            accepted += m
+            retired = self._commit_tokens(rep, s, emitted, t_emit)
+            if not retired:
                 still.append(s)
         rep.active = still
-        st = rep.cache.stats()
-        _M_PAGE_UTIL.set(
-            st["in_use_pages"] / float(max(1, st["num_pages"])),
-            replica=rep.index)
-        _M_ACTIVE.set(len(rep.active), replica=rep.index)
-        self._count(iterations=1, tokens_out=len(next_tokens))
+        self._count(iterations=1, tokens_out=total,
+                    spec_proposed=kk * n, spec_accepted=accepted)
 
     def _fail_over(self, rep):
         """Kill path: every live sequence — full token history — onto
         the retry lane; the cache resets (all pages freed, accounting
         intact)."""
         rep.alive = False
-        moved = rep.active
+        moved = rep.active + rep.prefilling
         rep.active = []
+        rep.prefilling = []
         rep.cache.reset()
+        if rep.draft_cache is not None:
+            rep.draft_cache.reset()
         _flight.record("decode", "replica_killed", replica=rep.index,
                        live_seqs=len(moved))
         # post-mortem: the ring holds the chaos action + the kill +
@@ -610,6 +1009,8 @@ class DecodeServer:
             or ([rep] if self.config.restart_dead else [])
         for s in moved:
             s.slot = None
+            s.draft_slot = None
+            s.chunk_pos = 0
             s.attempts += 1
             if s.req.done():
                 continue
@@ -632,7 +1033,8 @@ class DecodeServer:
         self.admission.start_drain()
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            busy = any(r.active for r in self.replicas) \
+            busy = any(r.active or r.prefilling
+                       for r in self.replicas) \
                 or not self._retry.empty() \
                 or self.admission.outstanding_count() > 0
             if not busy:
@@ -657,10 +1059,10 @@ class DecodeServer:
         # AFTER this (a real leak — a page owned by no sequence — is
         # not maskable by it)
         for rep in self.replicas:
-            for s in rep.active:
-                if s.slot is not None:
-                    rep.cache.free(s.slot)
+            for s in rep.active + rep.prefilling:
+                self._release_seq(rep, s)
             rep.active = []
+            rep.prefilling = []
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
@@ -701,6 +1103,11 @@ class DecodeServer:
             ok, detail = rep.cache.check_accounting()
             if not ok:
                 return False, "replica %d: %s" % (rep.index, detail)
+            if rep.draft_cache is not None:
+                ok, detail = rep.draft_cache.check_accounting()
+                if not ok:
+                    return False, ("replica %d draft cache: %s"
+                                   % (rep.index, detail))
         return True, ""
 
     def stats(self):
@@ -710,7 +1117,12 @@ class DecodeServer:
         with self._lock:
             counters = dict(self._counters)
         p50, p99 = self.inter_token_ms()
+        acceptance = None
+        if counters.get("spec_proposed"):
+            acceptance = round(counters["spec_accepted"]
+                               / counters["spec_proposed"], 4)
         return {
+            "spec_acceptance_rate": acceptance,
             "admission": c,
             "outstanding": self.admission.outstanding_count(),
             "answered": answered,
@@ -723,9 +1135,14 @@ class DecodeServer:
             "replicas": {
                 rep.index: {"alive": rep.alive,
                             "active_seqs": len(rep.active),
+                            "prefilling_seqs": len(rep.prefilling),
                             "iterations": rep.iterations,
                             "tokens_out": rep.tokens_out,
-                            "cache": rep.cache.stats()}
+                            "cache": rep.cache.stats(),
+                            **({"draft_cache":
+                                rep.draft_cache.stats()}
+                               if rep.draft_cache is not None
+                               else {})}
                 for rep in self.replicas},
             "draining": self.admission.draining,
         }

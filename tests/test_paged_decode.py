@@ -4,6 +4,11 @@ across page boundaries, ragged lengths, d in {64, 128}, f32/bf16,
 int8-KV, head-packed and not), the int8-KV accuracy bar, and the
 continuous-decode serving tier (exactly-once under seeded chaos, zero
 KV-page leaks after drain, preemption under pool pressure).
+
+The ISSUE 11 act-II surface (refcounts/COW/radix sharing, chunked
+prefill, q-len-k verify, speculative decoding) is covered by
+tests/test_decode_act2.py; these tests pin the act-I behavior those
+features must leave untouched under the default-off flags.
 """
 
 import time
@@ -42,7 +47,10 @@ def test_alloc_append_free_accounting():
     assert c.in_use_pages() == 2 and c.free_pages() == 6
     c.free(s1)
     assert c.in_use_pages() == 0 and c.free_pages() == 8
-    assert c.stats()["accounted"]
+    st = c.stats()
+    assert st["accounted"]
+    # act-II fields exist and stay inert with kv_share off
+    assert st["shared_pages"] == 0 and st["kv_share"] is False
     with pytest.raises(KeyError):
         c.free(s0)                        # double free is loud
 

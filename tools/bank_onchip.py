@@ -157,6 +157,15 @@ TASK_KEYS = {
                                 None),
     "llm_decode_str64_d64_hp2": ("llm_decode_flash_str64_d64_hp2",
                                  None),
+    # ISSUE 11: decode act II — spec_k/prefix_shared/chunked_join
+    # markers ride in the rows so bench._workload_sig keys them apart
+    # from the plain decode rows (the re-key rule once more)
+    "llm_decode_spec_k4": ("llm_decode_spec_k4_flash_str64", None),
+    "llm_decode_spec_k8": ("llm_decode_spec_k8_flash_str64", None),
+    "llm_decode_prefix_shared": (
+        "llm_decode_flash_str64_prefix_shared", None),
+    "llm_decode_chunked_join": ("llm_decode_chunked_join_flash",
+                                None),
 }
 
 # "script:" tasks whose stdout is ONE JSON line to bank verbatim

@@ -299,11 +299,18 @@ def run_serving_iteration(seed, rate, max_faults, timeout,
 
 def run_decode_iteration(seed, rate, max_faults, timeout,
                          n_requests=24):
-    """One faulted continuous-decode run (ISSUE 7 acceptance shape):
-    seeded kill/drop/close/delay plan at ``serving_decode``, ragged
-    seeded prompts, every admitted sequence answered exactly once
-    (typed success or typed rejection), and ZERO KV-page leaks after
-    drain.  Returns (ok, detail, n_faults)."""
+    """One faulted continuous-decode run (ISSUE 7 acceptance shape,
+    generalized by ISSUE 11): seeded kill/drop/close/delay plan at
+    ``serving_decode``, ragged seeded prompts (HALF sharing a common
+    system-prompt prefix), the act-II flags ON (kv_share + spec_k +
+    prefill_chunk) so every iteration exercises refcounted shared
+    pages, chunked joins, speculative verify appends AND their
+    rejection rewinds under faults — every admitted sequence answered
+    exactly once (typed success or typed rejection) and ZERO KV-page
+    leaks after drain under the GENERALIZED invariant
+    (free + unique(in_use) == num_pages, refcounts consistent,
+    checked for the draft cache too).  Returns (ok, detail,
+    n_faults)."""
     import numpy as np
 
     from paddle_tpu import serving
@@ -315,20 +322,26 @@ def run_decode_iteration(seed, rate, max_faults, timeout,
                               "delay=0.01+drop"),
                      max_faults=max_faults)
     rng = np.random.RandomState(seed)
+    shared_prefix = rng.randint(2, 128, size=18)
     deadline = time.monotonic() + timeout
     try:
         with faultinject.installed(plan) as inj:
             srv = serving.DecodeServer(
                 config=serving.DecodeConfig(
                     max_batch=4, max_new_tokens=8, page_size=16,
-                    num_pages=48, n_replicas=2,
+                    num_pages=64, n_replicas=2,
                     default_deadline_s=60.0,
-                    restart_dead=True)).start()
+                    restart_dead=True,
+                    kv_share=True, spec_k=2,
+                    prefill_chunk=6)).start()
             try:
                 futures, rejected = [], 0
                 for _ in range(n_requests):
                     prompt = rng.randint(
                         2, 128, size=int(rng.randint(1, 12)))
+                    if rng.rand() < 0.5:
+                        prompt = np.concatenate([shared_prefix,
+                                                 prompt])
                     try:
                         futures.append(srv.submit(prompt))
                     except serving.ServingError:

@@ -67,6 +67,24 @@ TASKS = [
     ("llm_decode_str64_d64_hp2", "llm_decode",
      {"streams": 64, "chain": 32, "head_dim": 64,
       "head_pack": True}),
+    # ---- ISSUE 11 HEAD: decode act II.  (1) speculative decoding —
+    # the verdict is acceptance_rate x tokens/s per row (the q-len-k
+    # verify kernel amortizes one HBM sweep over k+1 scored tokens;
+    # cross-lowered in CI as llm_decode_spec_k4 before any window is
+    # spent); (2) prefix sharing — tokens/s expected ~flat, the row
+    # banks the pool-capacity win (pool_pages vs unshared equiv);
+    # (3) chunked join — the row's verdict is inter-token p99 DURING
+    # a 32k-token join vs after it.  Flip no act-II flag before these
+    # bank.
+    ("llm_decode_spec_k4", "llm_decode_spec",
+     {"streams": 64, "spec_k": 4, "chain": 32}),
+    ("llm_decode_spec_k8", "llm_decode_spec",
+     {"streams": 64, "spec_k": 8, "chain": 32}),
+    ("llm_decode_prefix_shared", "llm_decode",
+     {"streams": 64, "chain": 32, "prefix_share": 2048}),
+    ("llm_decode_chunked_join", "llm_decode_chunked_join",
+     {"streams": 16, "join_prompt": 32768, "chunk": 512,
+      "chain": 64}, 3000),
     # ---- ISSUE 10: the QPS-vs-p99-vs-SLO dashboard row (ROADMAP
     # observability item (a)).  tools/slo_report.py drives
     # serving_load --mode overload2x on whatever backend the child

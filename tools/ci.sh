@@ -132,6 +132,51 @@ print("serving_load stdout contract OK: 1 line, %d fields, "
       % (len(rec), len(m), len(slo)))
 PY
 
+# decode act II leg (ISSUE 11): one short decode-mode run with all
+# three flags on — the one-JSON-line contract grows acceptance_rate /
+# prefix-sharing / chunked-prefill evidence and the generalized
+# zero-leak verdict; a generous deadline keeps the CPU run honest
+# (the spec path compiles several extra shapes in its first second)
+JAX_PLATFORMS=cpu python tools/serving_load.py --mode decode \
+  --seconds 2 --qps 30 --seed 7 --deadline-ms 5000 \
+  --spec-k 2 --prefix-shared 32 --prefill-chunk 8 \
+  > /tmp/_serving_load_decode.json
+cat /tmp/_serving_load_decode.json
+python - <<'PY'
+import json
+lines = [ln for ln in
+         open("/tmp/_serving_load_decode.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, (
+    "serving_load --mode decode stdout must be exactly ONE JSON line "
+    "— got %d" % len(lines))
+rec = json.loads(lines[0])
+missing = {"metric", "value", "unit", "tokens_per_sec",
+           "inter_token_p99_ms", "acceptance_rate", "spec_k",
+           "prefix_shared", "peak_shared_pages", "prefill_chunk",
+           "prefill_chunks", "pages_accounted", "accounted",
+           "metrics", "slo"} - set(rec)
+assert not missing, "decode JSON missing fields: %s" % (
+    sorted(missing),)
+assert rec["metric"] == "decode_tokens_per_sec", rec["metric"]
+assert rec["accounted"] is True, rec
+assert rec["pages_accounted"] is True, (
+    "generalized zero-leak invariant broken: %r" % rec)
+assert rec["spec_k"] == 2 and rec["prefix_shared"] == 32
+assert rec["ok"] > 0, "no decode request ever succeeded: %r" % rec
+assert rec["prefill_chunks"] > 0, "chunked prefill never ran"
+# the paged-KV page-pressure gauges ride the metrics embed
+m = rec["metrics"]
+for g in ("paddle_tpu_paged_kv_pages_free",
+          "paddle_tpu_paged_kv_pages_in_use",
+          "paddle_tpu_paged_kv_pages_shared"):
+    assert g in m, (g, sorted(m)[:12])
+print("decode act-II contract OK: %.1f tok/s, acceptance %.4f, "
+      "%d peak shared pages, %d chunks"
+      % (rec["tokens_per_sec"], rec["acceptance_rate"],
+         rec["peak_shared_pages"], rec["prefill_chunks"]))
+PY
+
 echo "== 5c/8 observability smoke (tracing on: one trace id end-to-end) =="
 # ISSUE 9 acceptance gate: with the tracing flag on, a seeded serving
 # round-trip and a decode sequence each carry ONE trace id across
@@ -194,6 +239,7 @@ python tools/tpu_lowering_check.py \
   resnet50_train resnet50_train_convbnstats bert_train resnet50_infer \
   resnet50_infer_int8_interlayer vgg16_infer longctx_train \
   llm_decode llm_decode_d64_hp2 llm_decode_int8kv llm_decode_bf16 \
+  llm_decode_spec_k4 llm_decode_spec_k8 \
   transformer_train_gspmd
 
 echo "== 8/8 chaos soak (deterministic seed; both transports) =="

@@ -257,16 +257,25 @@ def run_open_loop(srv, qps, seconds, seed=0, deadline_s=None):
 
 
 def run_decode_open_loop(srv, qps, seconds, seed=0, deadline_s=None,
-                         mean_prompt=12, max_new=16):
+                         mean_prompt=12, max_new=16,
+                         prefix_shared=0):
     """Seeded Poisson arrivals of RAGGED decode requests (geometric
     prompt-length distribution, mean ``mean_prompt``) for ``seconds``;
-    returns the outcome/latency/token-goodput record."""
+    returns the outcome/latency/token-goodput record.
+
+    prefix_shared > 0 (ISSUE 11b): every prompt carries the SAME
+    seeded ``prefix_shared``-token system prompt ahead of its ragged
+    tail — with the server's kv_share on, N streams amortize that
+    prefill to one page set (the row banks peak shared pages next to
+    tokens/s)."""
     import numpy as np
 
     from paddle_tpu import serving
 
     rng = np.random.RandomState(int(seed))
     vocab = srv.replicas[0].model.vocab
+    shared = rng.randint(2, vocab, size=int(prefix_shared)) \
+        if prefix_shared else None
     max_prompt = max(1, srv.config.page_size *
                      (srv.config.num_pages // 2) - max_new)
     inflight, outcomes = [], {"ok": 0}
@@ -285,6 +294,8 @@ def run_decode_open_loop(srv, qps, seconds, seed=0, deadline_s=None,
         n_submitted += 1
         plen = min(int(rng.geometric(1.0 / mean_prompt)), max_prompt)
         prompt = rng.randint(2, vocab, size=max(1, plen))
+        if shared is not None:
+            prompt = np.concatenate([shared, prompt])[:max_prompt]
         try:
             inflight.append(srv.submit(prompt, max_new_tokens=max_new,
                                        deadline_s=deadline_s))
@@ -315,7 +326,17 @@ def run_decode_open_loop(srv, qps, seconds, seed=0, deadline_s=None,
     st = srv.stats()
     it_p50, it_p99 = st["inter_token_p50_ms"], st["inter_token_p99_ms"]
     pages_ok, pages_detail = srv.page_accounting()
+    peak_shared = max(rep_st["cache"].get("peak_shared_pages", 0)
+                      for rep_st in st["replicas"].values())
     return {
+        # decode act II (ISSUE 11): the one-JSON-line contract grows
+        # acceptance-rate / sharing / chunking evidence (5b-gated)
+        "spec_k": srv.config.spec_k,
+        "acceptance_rate": st["spec_acceptance_rate"],
+        "prefix_shared": int(prefix_shared),
+        "peak_shared_pages": int(peak_shared),
+        "prefill_chunk": srv.config.prefill_chunk,
+        "prefill_chunks": st["decode"]["prefill_chunks"],
         "offered_qps": round(n_submitted / wall, 1) if wall else 0.0,
         "goodput_qps": round(outcomes["ok"] / wall, 1) if wall
         else 0.0,
@@ -368,6 +389,21 @@ def main(argv=None):
     ap.add_argument("--max-new", type=int, default=16,
                     help="decode mode: max generated tokens per "
                          "request")
+    ap.add_argument("--prefix-shared", type=int, default=0,
+                    help="decode mode (ISSUE 11b): every prompt "
+                         "carries this seeded common system-prompt "
+                         "prefix and the server runs kv_share — the "
+                         "row banks peak shared pages next to "
+                         "tokens/s")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="decode mode (ISSUE 11c): lossless "
+                         "speculative decoding with k draft proposals "
+                         "per iteration — the row banks "
+                         "acceptance_rate next to tokens/s")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="decode mode (ISSUE 11a): prompts longer "
+                         "than this prefill in fixed chunks "
+                         "interleaved with decode iterations")
     args = ap.parse_args(argv)
 
     import jax
@@ -399,12 +435,18 @@ def main(argv=None):
         from paddle_tpu import serving
 
         monitor = make_monitor(decode=True)
+        # pool sized up for the shared prefix + the spec-window margin
+        extra_pages = -(-(args.prefix_shared + args.spec_k + 1) // 16)
         srv = serving.DecodeServer(config=serving.DecodeConfig(
             max_batch=args.max_batch, n_replicas=args.replicas,
             max_new_tokens=args.max_new, page_size=16,
-            num_pages=16 * args.max_batch,
+            num_pages=16 * args.max_batch +
+            args.max_batch * extra_pages,
             default_deadline_s=args.deadline_ms / 1000.0,
-            queue_capacity=args.capacity)).start()
+            queue_capacity=args.capacity,
+            kv_share=bool(args.prefix_shared) or None,
+            spec_k=args.spec_k,
+            prefill_chunk=args.prefill_chunk)).start()
         try:
             # cold first-token probe (1-token request, nothing
             # compiled yet): the decode-side time_to_first_batch_s
@@ -415,7 +457,8 @@ def main(argv=None):
             rec = run_decode_open_loop(
                 srv, args.qps, args.seconds, seed=args.seed,
                 deadline_s=args.deadline_ms / 1000.0,
-                mean_prompt=args.mean_prompt, max_new=args.max_new)
+                mean_prompt=args.mean_prompt, max_new=args.max_new,
+                prefix_shared=args.prefix_shared)
         finally:
             srv.stop()
         from paddle_tpu.observability import metrics as obs_metrics

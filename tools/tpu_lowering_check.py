@@ -125,6 +125,17 @@ def _workloads():
             streams=8, prefill_len=64, heads=8, head_dim=128,
             page_size=128, kv_int8=True)[:3],
         "llm_decode_bf16": lambda: _llm_decode_bf16(bench),
+        # ISSUE 11c: the q-len-(k+1) speculative VERIFY step — the
+        # per-row causal mask (min(kv_len, kv_len-R+1+row) over a row
+        # iota) and the 16-sublane query block at R > 8 are new
+        # Mosaic surface the q-len-1 gate never sees; cross-lower
+        # BEFORE the chaser spends a window on the spec rows
+        "llm_decode_spec_k4": lambda: bench._build_llm_decode(
+            streams=8, prefill_len=64, heads=8, head_dim=128,
+            page_size=128, spec_k=4)[:3],
+        "llm_decode_spec_k8": lambda: bench._build_llm_decode(
+            streams=8, prefill_len=64, heads=8, head_dim=128,
+            page_size=128, spec_k=8)[:3],
         "resnet50_infer": lambda: _infer(bench, "resnet", 128),
         "vgg16_infer": lambda: _infer(bench, "vgg", 64),
         "vgg16_cifar_infer": lambda: _infer(bench, "vgg_cifar", 512),
