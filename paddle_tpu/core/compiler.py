@@ -30,6 +30,7 @@ import numpy as np
 from paddle_tpu.core.program import BlockRef, Program
 from paddle_tpu.core.registry import get_op_def, has_op_def
 from paddle_tpu.core.scope import Scope
+from paddle_tpu.observability import collector as _obs_collector
 from paddle_tpu.observability import device_trace as _obs_device
 from paddle_tpu.observability import flight_recorder as _obs_flight
 from paddle_tpu.observability import metrics as _obs_metrics
@@ -876,6 +877,10 @@ class CompiledProgram:
         else:
             new_state, fetches = fn(state, feeds)
         _M_STEP_SECONDS.observe(_time.perf_counter() - t0)
+        # trainer fleet push (ISSUE 12): a step boundary is the
+        # trainer's natural push moment — rate-limited inside, runs on
+        # the pusher thread, one None/memo check when off
+        _obs_collector.maybe_step_push()
         for k, v in new_state.items():
             scope.var(k).set(v)
         if return_numpy:

@@ -116,7 +116,15 @@ class Executor:
         ctx = RuntimeCtx(self, program, scope, self.place, feed,
                          fetch_results)
         self._run_block(program.global_block(), scope, ctx)
-        return self._fetch(fetch_list, scope, return_numpy)
+        out = self._fetch(fetch_list, scope, return_numpy)
+        # trainer fleet push (ISSUE 12): an Executor.run IS the
+        # trainer's step boundary on the op-at-a-time path (the
+        # compiled path hooks inside CompiledProgram.step); cost when
+        # off is one None check + one memo check
+        from paddle_tpu.observability import collector as _collector
+
+        _collector.maybe_step_push()
+        return out
 
     def _feed_data(self, program, feed, scope):
         import jax

@@ -111,6 +111,13 @@ _DEDUP_TAG = "__seq1__"
 # on, unwrapped first by RPCServer._dispatch so the server-side handler
 # span joins the caller's trace (docs/OBSERVABILITY.md)
 _TRACE_TAG = "__trace1__"
+# msg types exempt from the trace envelope AND the server-side handler
+# span (ISSUE 12): the fleet collector's own push RPC must never open
+# trace roots — a traced push would be exported by the NEXT push, and
+# the observability plane would observe itself without bound.  The
+# exemption also keeps push payload bytes independent of whether the
+# pushing process happens to trace.
+_UNTRACED_MSG_TYPES = frozenset({"collector_push"})
 
 # -- observability instruments (ISSUE 9): the registry is the ONE
 # source of truth; RPCClient.stats() is a view over these (the
@@ -536,7 +543,8 @@ class RPCServer:
                     return cached
         t0 = time.perf_counter()
         try:
-            if _trace._tracer is not None:
+            if _trace._tracer is not None and \
+                    msg_type not in _UNTRACED_MSG_TYPES:
                 with _trace._tracer.span("rpc.server:" + msg_type,
                                          parent=tctx,
                                          endpoint=self.endpoint):
@@ -868,7 +876,8 @@ class RPCClient:
         elif msg_type not in self.IDEMPOTENT and not explicit_retries:
             retries = 0
         span = None
-        if _trace._tracer is not None:
+        if _trace._tracer is not None and \
+                msg_type not in _UNTRACED_MSG_TYPES:
             # the distributed-trace envelope: the server-side handler
             # span joins THIS trace id (one conditional when off).
             # Head sampling (ISSUE 10): a dropped trace sends NO

@@ -22,19 +22,28 @@ stack needs all three (docs/OBSERVABILITY.md).
   flight_recorder.py  bounded lock-free ring of recent structured
                       events dumped to a file on crash /
                       BarrierTimeoutError / replica death / request
-  export.py           /metrics + /varz HTTP endpoint mountable on
-                      listen_and_serv, InferenceServer, DecodeServer;
-                      in-tree prometheus grammar checker
+  export.py           /metrics + /varz (+ /fleetz) HTTP endpoint
+                      mountable on listen_and_serv, InferenceServer,
+                      DecodeServer; in-tree prometheus grammar checker
+                      (incl. OpenMetrics exemplar syntax)
+  collector.py        fleet collector (ISSUE 12): cross-process
+                      aggregation of snapshots/spans/dump refs with
+                      chaos-tested exactly-once push loss handling,
+                      one-store trace assembly, staleness marking,
+                      and the fleet SLO roll-up
 
 ``paddle_tpu/profiler.py`` (the Fluid-shaped start_profiler/
 stop_profiler/RecordEvent surface) is a thin shim over tracing.py.
 """
 
+from paddle_tpu.observability import collector
 from paddle_tpu.observability import device_trace
 from paddle_tpu.observability import flight_recorder
 from paddle_tpu.observability import metrics
 from paddle_tpu.observability import slo
 from paddle_tpu.observability import tracing
+from paddle_tpu.observability.collector import (CollectorPusher,
+                                                CollectorServer)
 from paddle_tpu.observability.device_trace import DeviceTraceSession
 from paddle_tpu.observability.export import (MetricsHTTPServer,
                                              metrics_port_from_env,
@@ -51,10 +60,11 @@ from paddle_tpu.observability.tracing import (Span, Tracer,
                                               stop_tracing)
 
 __all__ = [
-    "Counter", "DeviceTraceSession", "FlightRecorder", "Gauge",
+    "CollectorPusher", "CollectorServer", "Counter",
+    "DeviceTraceSession", "FlightRecorder", "Gauge",
     "Histogram", "MetricsHTTPServer", "MetricsRegistry", "SLO",
-    "SLOMonitor", "Span", "Tracer", "device_trace", "flight_recorder",
-    "maybe_tracer", "metrics", "metrics_port_from_env",
-    "parse_prometheus_text", "registry", "slo", "start_tracing",
-    "stop_tracing", "tracing",
+    "SLOMonitor", "Span", "Tracer", "collector", "device_trace",
+    "flight_recorder", "maybe_tracer", "metrics",
+    "metrics_port_from_env", "parse_prometheus_text", "registry",
+    "slo", "start_tracing", "stop_tracing", "tracing",
 ]

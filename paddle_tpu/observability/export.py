@@ -124,6 +124,19 @@ class MetricsHTTPServer:
                     self._send(json.dumps(
                         {"enabled": t is not None, "spans": spans}),
                         "application/json")
+                elif path == "/fleetz":
+                    # fleet view (ISSUE 12): the installed collector's
+                    # snapshot, or an explicit disabled marker — the
+                    # route exists on every server so a scraper can
+                    # probe without knowing which process collects
+                    from paddle_tpu.observability import collector \
+                        as _collector
+
+                    c = _collector.maybe_collector()
+                    self._send(
+                        json.dumps(c.snapshot(), sort_keys=True)
+                        if c is not None else '{"enabled": false}',
+                        "application/json")
                 elif path == "/sloz":
                     from paddle_tpu.observability import slo as _slo
 
@@ -180,11 +193,21 @@ _HELP_RE = re.compile(r"# HELP (%s) (.*)\Z" % _PROM_NAME)
 _TYPE_RE = re.compile(
     r"# TYPE (%s) (counter|gauge|histogram|summary|untyped)\Z"
     % _PROM_NAME)
+_VALUE_PAT = (r"[+-]?(?:\d+(?:\.\d*)?(?:[eE][+-]?\d+)?|\.\d+"
+              r"(?:[eE][+-]?\d+)?|Inf|NaN)")
 _SAMPLE_RE = re.compile(
     r"(?P<name>%s)(?:\{(?P<labels>[^}]*)\})?\s+"
-    r"(?P<value>[+-]?(?:\d+(?:\.\d*)?(?:[eE][+-]?\d+)?|\.\d+"
-    r"(?:[eE][+-]?\d+)?|Inf|NaN))(?:\s+(?P<ts>-?\d+))?\Z"
-    % _PROM_NAME)
+    r"(?P<value>%s)(?:\s+(?P<ts>-?\d+))?\Z"
+    % (_PROM_NAME, _VALUE_PAT))
+# OpenMetrics exemplar suffix (ISSUE 12): appended to a histogram
+# bucket (or counter) sample as `# {trace_id="..."} value [unix_ts]`.
+# The strict form: exactly one space-separated comment marker, a
+# braced label set, a value, and an optional float timestamp at EOL.
+_EXEMPLAR_RE = re.compile(
+    r"\s#\s\{(?P<elabels>(?:[^\"{}]|\"(?:[^\"\\]|\\.)*\")*)\}"
+    r"\s(?P<evalue>%s)"
+    r"(?:\s(?P<ets>[+-]?\d+(?:\.\d*)?(?:[eE][+-]?\d+)?))?\Z"
+    % _VALUE_PAT)
 _LABEL_PAIR_RE = re.compile(
     r'\s*(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*'
     r'"(?P<v>(?:[^"\\]|\\.)*)"\s*(?P<sep>,|\Z)')
@@ -204,14 +227,24 @@ def _parse_labels(text):
     return labels
 
 
-def parse_prometheus_text(text):
+def parse_prometheus_text(text, with_exemplars=False):
     """Validate + parse exposition text.  Returns
     ``[(name, labels_dict, value)]`` samples; raises ValueError on any
     grammar violation.  Extra structural checks: a TYPE may be
     announced at most once per name; histogram samples only use the
     ``_bucket``/``_sum``/``_count`` suffixes of an announced histogram
-    and every bucket run ends with ``le="+Inf"``."""
+    and every bucket run ends with ``le="+Inf"``.
+
+    OpenMetrics exemplars (ISSUE 12): a sample line may end with
+    ``# {trace_id="..."} <value> [<unix_ts>]`` — accepted ONLY on
+    histogram ``_bucket`` samples and counter samples (the OpenMetrics
+    rule); the label set must parse, the value must be a number, and a
+    ``#`` that does not open a well-formed exemplar is a grammar
+    violation.  With ``with_exemplars=True`` returns
+    ``(samples, exemplars)`` where exemplars is
+    ``[{name, labels, exemplar_labels, value, ts}]``."""
     samples = []
+    exemplars = []
     types = {}
     hist_bucket_le: dict = {}
     for lineno, line in enumerate(text.splitlines(), 1):
@@ -232,7 +265,17 @@ def parse_prometheus_text(text):
                 raise ValueError(
                     f"line {lineno}: malformed comment: {line!r}")
             continue     # free-form comments are legal
-        m = _SAMPLE_RE.match(line)
+        m_ex = _EXEMPLAR_RE.search(line)
+        if m_ex is not None:
+            base_line = line[:m_ex.start()]
+        else:
+            base_line = line
+            if " # " in line:
+                raise ValueError(
+                    f"line {lineno}: malformed exemplar (a '#' on a "
+                    f"sample line must open "
+                    f"'# {{label=\"v\"}} value [ts]'): {line!r}")
+        m = _SAMPLE_RE.match(base_line)
         if m is None:
             raise ValueError(f"line {lineno}: bad sample: {line!r}")
         name = m.group("name")
@@ -257,9 +300,30 @@ def parse_prometheus_text(text):
             key = (base, tuple(sorted(
                 (k, v) for k, v in labels.items() if k != "le")))
             hist_bucket_le.setdefault(key, []).append(labels["le"])
+        if m_ex is not None:
+            is_bucket = name.endswith("_bucket") and \
+                types.get(base) == "histogram"
+            is_counter = types.get(name) == "counter"
+            if not (is_bucket or is_counter):
+                raise ValueError(
+                    f"line {lineno}: exemplar on a non-bucket/"
+                    f"non-counter sample {name!r} (OpenMetrics allows "
+                    "exemplars on histogram buckets and counters "
+                    "only)")
+            elabels = _parse_labels(m_ex.group("elabels") or "")
+            eraw = m_ex.group("evalue")
+            evalue = float(eraw.replace("Inf", "inf")
+                           .replace("NaN", "nan"))
+            ets = m_ex.group("ets")
+            exemplars.append({
+                "name": name, "labels": labels,
+                "exemplar_labels": elabels, "value": evalue,
+                "ts": float(ets) if ets is not None else None})
         samples.append((name, labels, value))
     for (base, _), les in hist_bucket_le.items():
         if "+Inf" not in les:
             raise ValueError(
                 f"histogram {base} bucket run missing le=\"+Inf\"")
+    if with_exemplars:
+        return samples, exemplars
     return samples

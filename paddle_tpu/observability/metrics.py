@@ -38,11 +38,20 @@ import bisect
 import json
 import re
 import threading
+import time
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_BUCKETS", "registry", "counter", "gauge", "histogram",
 ]
+
+# Exemplar context hook (ISSUE 12): installed by observability.tracing
+# at import — () -> trace_id of the ACTIVE *sampled* trace, else None.
+# Histograms record a bounded per-bucket exemplar reservoir only when
+# this returns an id, so exemplar presence is exactly head-sampling
+# presence (deterministic under PADDLE_TPU_TRACE_SEED) and a run with
+# tracing off (or sample 0.0) produces byte-identical exposition.
+_exemplar_provider = None
 
 _NAME_RE = re.compile(r"[a-z][a-z0-9_]*\Z")
 _LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
@@ -109,9 +118,10 @@ class _GaugeSeries(_Series):
 
 
 class _HistogramSeries(_Series):
-    __slots__ = ("bounds", "counts", "sum", "count", "min", "max")
+    __slots__ = ("bounds", "counts", "sum", "count", "min", "max",
+                 "exemplars", "_exemplar_cap")
 
-    def __init__(self, labels, bounds):
+    def __init__(self, labels, bounds, exemplar_capacity=1):
         super().__init__(labels)
         self.bounds = bounds
         self.counts = [0] * (len(bounds) + 1)   # last = +Inf overflow
@@ -119,8 +129,14 @@ class _HistogramSeries(_Series):
         self.count = 0
         self.min = None
         self.max = None
+        # per-bucket exemplar reservoir (ISSUE 12): bucket index ->
+        # [(trace_id, value, unix_ts)], newest-wins ring bounded at
+        # exemplar_capacity — total exemplar memory is
+        # O(buckets * capacity), never O(observations)
+        self.exemplars: dict = {}
+        self._exemplar_cap = int(exemplar_capacity)
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         v = float(v)
         i = bisect.bisect_left(self.bounds, v)
         with self._lock:
@@ -131,6 +147,29 @@ class _HistogramSeries(_Series):
                 self.min = v
             if self.max is None or v > self.max:
                 self.max = v
+            if exemplar is not None and self._exemplar_cap > 0:
+                ring = self.exemplars.setdefault(i, [])
+                ring.append((str(exemplar), v, time.time()))
+                if len(ring) > self._exemplar_cap:
+                    ring.pop(0)
+
+    def _bucket_le(self, i):
+        """The exposition `le` of bucket index i (+Inf past bounds)."""
+        return self.bounds[i] if i < len(self.bounds) else \
+            float("inf")
+
+    def exemplar_list(self):
+        """[{le, trace_id, value, ts}] snapshot, bucket order."""
+        with self._lock:
+            items = sorted(self.exemplars.items())
+            out = []
+            for i, ring in items:
+                le = self._bucket_le(i)
+                for tid, v, ts in ring:
+                    out.append({"le": "+Inf" if le == float("inf")
+                                else le, "trace_id": tid,
+                                "value": v, "ts": ts})
+        return out
 
     def percentile(self, p):
         """Upper bound of the bucket holding the p-th percentile (the
@@ -161,6 +200,10 @@ class _HistogramSeries(_Series):
         out["p50"] = self.percentile(50)
         out["p95"] = self.percentile(95)
         out["p99"] = self.percentile(99)
+        ex = self.exemplar_list()
+        if ex:      # only-when-present: an exemplar-free run's
+            #         snapshot stays byte-identical to PR 10
+            out["exemplars"] = ex
         return out
 
 
@@ -261,24 +304,39 @@ class Gauge(_Instrument):
 class Histogram(_Instrument):
     kind = "histogram"
 
-    def __init__(self, name, help="", buckets=None, max_series=64):
+    def __init__(self, name, help="", buckets=None, max_series=64,
+                 exemplar_capacity=1):
         super().__init__(name, help=help, max_series=max_series)
         b = tuple(float(x) for x in (buckets or DEFAULT_BUCKETS))
         if list(b) != sorted(b) or len(set(b)) != len(b):
             raise ValueError("histogram buckets must strictly increase")
         self.buckets = b
+        self.exemplar_capacity = int(exemplar_capacity)
 
     def _new_series(self, labels):
-        return _HistogramSeries(labels, self.buckets)
+        return _HistogramSeries(labels, self.buckets,
+                                exemplar_capacity=self.exemplar_capacity)
 
-    def observe(self, v, **labels):
-        self.labels(**labels).observe(v)
+    def observe(self, v, exemplar=None, **labels):
+        """Record one observation.  ``exemplar`` (a trace id) pins a
+        per-bucket exemplar; when omitted, the ambient SAMPLED trace id
+        (observability.tracing's provider hook) is used — exemplar
+        presence is exactly head-sampling presence."""
+        if exemplar is None and _exemplar_provider is not None:
+            exemplar = _exemplar_provider()
+        self.labels(**labels).observe(v, exemplar=exemplar)
 
     def summary(self, **labels):
         key = _label_key(labels)
         s = self._series.get(key)
         return _HistogramSeries(dict(key), self.buckets).summary() \
             if s is None else s.summary()
+
+    def exemplars(self, **labels):
+        """[{le, trace_id, value, ts}] of one series ([] if absent)."""
+        key = _label_key(labels)
+        s = self._series.get(key)
+        return [] if s is None else s.exemplar_list()
 
     def items(self):
         return [(lbl, s.summary()) for lbl, s in self.series()]
@@ -314,10 +372,12 @@ class MetricsRegistry:
         return self._get_or_create(Gauge, name, help,
                                    max_series=max_series)
 
-    def histogram(self, name, help="", buckets=None, max_series=64):
+    def histogram(self, name, help="", buckets=None, max_series=64,
+                  exemplar_capacity=1):
         return self._get_or_create(Histogram, name, help,
                                    buckets=buckets,
-                                   max_series=max_series)
+                                   max_series=max_series,
+                                   exemplar_capacity=exemplar_capacity)
 
     def get(self, name):
         return self._instruments.get(name)
@@ -372,14 +432,18 @@ class MetricsRegistry:
                     with s._lock:
                         counts = list(s.counts)
                         total, ssum = s.count, s.sum
-                    for bound, c in zip(s.bounds, counts):
+                        exm = {i: ring[-1] for i, ring
+                               in s.exemplars.items() if ring}
+                    for i, (bound, c) in enumerate(zip(s.bounds,
+                                                       counts)):
                         acc += c
-                        lines.append("%s_bucket%s %d" % (
+                        lines.append("%s_bucket%s %d%s" % (
                             name,
                             _fmt_labels(lbl, le=_fmt_float(bound)),
-                            acc))
-                    lines.append("%s_bucket%s %d" % (
-                        name, _fmt_labels(lbl, le="+Inf"), total))
+                            acc, _fmt_exemplar(exm.get(i))))
+                    lines.append("%s_bucket%s %d%s" % (
+                        name, _fmt_labels(lbl, le="+Inf"), total,
+                        _fmt_exemplar(exm.get(len(s.bounds)))))
                     lines.append("%s_sum%s %s" % (
                         name, _fmt_labels(lbl), _fmt_float(ssum)))
                     lines.append("%s_count%s %d" % (
@@ -404,6 +468,21 @@ def _fmt_float(v):
         return "+Inf" if v > 0 else "-Inf"
     f = float(v)
     return repr(int(f)) if f == int(f) and abs(f) < 2 ** 53 else repr(f)
+
+
+def _fmt_exemplar(ex):
+    """OpenMetrics exemplar suffix for a bucket line, or "".
+
+    Grammar (docs/OBSERVABILITY.md; parsed by export.
+    parse_prometheus_text):  ``# {trace_id="<id>"} <value> <unix_ts>``
+    appended after the bucket's cumulative count.  Absent exemplars
+    append nothing, so an exemplar-free exposition is byte-identical
+    to PR 10."""
+    if ex is None:
+        return ""
+    tid, v, ts = ex
+    return ' # {trace_id="%s"} %s %s' % (
+        _escape_label_value(tid), _fmt_float(v), _fmt_float(ts))
 
 
 def _escape_label_value(v):
@@ -435,6 +514,8 @@ def gauge(name, help="", max_series=64):
     return _registry.gauge(name, help=help, max_series=max_series)
 
 
-def histogram(name, help="", buckets=None, max_series=64):
+def histogram(name, help="", buckets=None, max_series=64,
+              exemplar_capacity=1):
     return _registry.histogram(name, help=help, buckets=buckets,
-                               max_series=max_series)
+                               max_series=max_series,
+                               exemplar_capacity=exemplar_capacity)

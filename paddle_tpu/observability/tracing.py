@@ -74,7 +74,7 @@ from paddle_tpu.observability import metrics as _metrics
 __all__ = [
     "Span", "Tracer", "start_tracing", "stop_tracing", "maybe_tracer",
     "enabled", "current", "span", "export_chrome_trace",
-    "sample_rate", "set_sample_rate", "sampled",
+    "sample_rate", "set_sample_rate", "sampled", "span_to_dict",
 ]
 
 # per-path (root span name) sampled/dropped counters — the ISSUE 10
@@ -287,6 +287,22 @@ class Tracer:
     def spans_for(self, trace_id):
         return [s for s in self.spans() if s.trace_id == trace_id]
 
+    def spans_since(self, cursor):
+        """(finished spans with index >= cursor, new cursor) — the
+        collector pusher's incremental read (ISSUE 12).  Spans that
+        fell off the bounded ring before being read are simply gone
+        (the ring is the memory bound; the collector marks the process
+        stale rather than blocking it)."""
+        n = self._count
+        if cursor >= n:
+            return [], cursor
+        out = []
+        for i in range(max(cursor, n - self.capacity), n):
+            s = self._ring[i % self.capacity]
+            if s is not None and s.t1_ns is not None:
+                out.append(s)
+        return out, n
+
     def trace_ids(self):
         return sorted({s.trace_id for s in self.spans()})
 
@@ -415,6 +431,42 @@ def export_chrome_trace(path):
     if t is None:
         raise RuntimeError("tracing is not enabled")
     return t.export_chrome_trace(path)
+
+
+def span_to_dict(s):
+    """Wire/JSON-able form of a finished span — the shape the fleet
+    collector stores and tools/tail_forensics.py decomposes
+    (docs/OBSERVABILITY.md).  Times are microseconds on this process's
+    perf_counter clock (comparable WITHIN a process only)."""
+    return {
+        "name": s.name, "trace_id": s.trace_id, "span_id": s.span_id,
+        "parent_id": s.parent_id,
+        "t0_us": s.t0_ns / 1e3,
+        "t1_us": (s.t1_ns if s.t1_ns is not None else s.t0_ns) / 1e3,
+        "thread": s.thread,
+        "attrs": {k: v for k, v in s.attrs.items()
+                  if isinstance(v, (str, int, float, bool))
+                  or v is None},
+    }
+
+
+def _exemplar_trace():
+    """The metrics exemplar hook (ISSUE 12): the ACTIVE trace id iff a
+    tracer is installed, a span is active on this thread, and the
+    trace is SAMPLED — so Histogram exemplars exist exactly when the
+    trace's spans do (deterministic under PADDLE_TPU_TRACE_SEED), and
+    a dropped trace leaves no exemplar just as it leaves no span."""
+    t = _tracer
+    if t is None:
+        return None
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return None
+    tid = stack[-1][0]
+    return tid if t._verdict(tid) else None
+
+
+_metrics._exemplar_provider = _exemplar_trace
 
 
 class _NullSpan:

@@ -298,8 +298,17 @@ class AdmissionController:
         _M_REQS.inc(outcome=key)
         lat = req.latency_s()
         if lat is not None:
+            # exemplar (ISSUE 12): the delivery thread has no span
+            # ctx of its own, so the request's trace id is passed
+            # explicitly — recorded only when the trace is SAMPLED,
+            # so the p99 bucket names a trace that actually has spans
+            exemplar = None
+            if _trace._tracer is not None and req.trace is not None \
+                    and _trace._tracer._verdict(req.trace[0]):
+                exemplar = req.trace[0]
             _M_REQ_SECONDS.observe(
-                lat, outcome="ok" if exc is None
+                lat, exemplar=exemplar,
+                outcome="ok" if exc is None
                 else getattr(exc, "code", "error"))
         if _trace._tracer is not None and req.trace is not None:
             _trace._tracer.instant(

@@ -197,10 +197,16 @@ class ShapeBucketBatcher:
 
     def _form(self, sig):
         reqs = self._pending.pop(sig, [])
-        self._first_t.pop(sig, None)
+        first_t = self._first_t.pop(sig, None)
         if not reqs:
             return
         now = time.monotonic()
+        # the group's formation window (first rider taken -> batch
+        # formed): tools/tail_forensics.py splits a request's
+        # admission->batch gap into queue wait vs batch formation
+        # with this attribute
+        formation_us = int((now - first_t) * 1e6) \
+            if first_t is not None else 0
         live = []
         for r in reqs:
             if r.expired(now):
@@ -256,7 +262,8 @@ class ShapeBucketBatcher:
                 for r in chunk:
                     sp = _trace._tracer.instant(
                         "serving.batch", parent=r.trace,
-                        bucket=bucket, rows=rows, request_id=r.id)
+                        bucket=bucket, rows=rows, request_id=r.id,
+                        formation_us=formation_us)
                     if r.trace is not None:
                         r.trace = sp.ctx
                 batch.trace = chunk[0].trace

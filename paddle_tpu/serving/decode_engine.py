@@ -185,7 +185,8 @@ class DecodeConfig:
                  kv_int8=None, head_pack=None, drain_timeout_s=30.0,
                  impl=None, metrics_port=None, trace_sample=None,
                  prefill_chunk=None, kv_share=None, spec_k=None,
-                 draft_factory=None, preempt_slack_s=0.25):
+                 draft_factory=None, preempt_slack_s=0.25,
+                 collector=None):
         from paddle_tpu.flags import get_flag
 
         self.max_batch = int(max_batch)
@@ -241,6 +242,14 @@ class DecodeConfig:
         # deadline slack (plus a per-history-token allowance) to be
         # considered re-prefillable
         self.preempt_slack_s = float(preempt_slack_s)
+        # fleet collector (ISSUE 12; same contract as
+        # ServingConfig.collector): None -> PADDLE_TPU_COLLECTOR -> off
+        if collector is None:
+            from paddle_tpu.observability.collector import \
+                collector_endpoint
+
+            collector = collector_endpoint()
+        self.collector = collector
 
 
 class _Seq:
@@ -341,6 +350,7 @@ class DecodeServer:
                           "spec_proposed": 0, "spec_accepted": 0}
         self._step_ms = []          # bounded rolling inter-token record
         self.metrics_server = None
+        self.collector_pusher = None
         self._started = False
         self._stopped = False
 
@@ -356,6 +366,12 @@ class DecodeServer:
                         port=self.config.metrics_port).start()
                 except OSError:
                     self.metrics_server = None
+            if self.config.collector:
+                from paddle_tpu.observability.collector import \
+                    CollectorPusher
+
+                self.collector_pusher = CollectorPusher(
+                    self.config.collector, role="decode").start()
             self._sup.start()
         return self
 
@@ -1063,6 +1079,9 @@ class DecodeServer:
                 self._release_seq(rep, s)
             rep.active = []
             rep.prefilling = []
+        if self.collector_pusher is not None:
+            self.collector_pusher.stop(final_push=True)
+            self.collector_pusher = None
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None

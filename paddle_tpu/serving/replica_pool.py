@@ -129,14 +129,30 @@ class Replica:
         When tracing is on, execution runs under a ``serving.replica``
         span joined to the batch's (oldest rider's) trace; the nested
         ``predictor.run`` span picks it up from the thread-local
-        stack."""
+        stack.  Every OTHER rider gets its own sibling
+        ``serving.replica`` span covering the same execution window
+        (ISSUE 12: tools/tail_forensics.py decomposes each request's
+        trace individually — without the sibling spans only one rider
+        per batch would carry a replica stage)."""
         if _trace._tracer is not None:
-            with _trace._tracer.span("serving.replica",
-                                     parent=batch.trace,
-                                     replica=self.index,
-                                     rows=batch.rows,
-                                     bucket=batch.bucket):
-                return self._run_inner(batch)
+            tr = _trace._tracer
+            extra = [tr.start_span("serving.replica", parent=r.trace,
+                                   replica=self.index,
+                                   rows=batch.rows,
+                                   bucket=batch.bucket,
+                                   request_id=r.id)
+                     for r in batch.requests
+                     if r.trace is not None and r.trace != batch.trace]
+            try:
+                with tr.span("serving.replica",
+                             parent=batch.trace,
+                             replica=self.index,
+                             rows=batch.rows,
+                             bucket=batch.bucket):
+                    return self._run_inner(batch)
+            finally:
+                for sp in extra:
+                    sp.end()
         return self._run_inner(batch)
 
     def _run_inner(self, batch):

@@ -47,7 +47,8 @@ class ServingConfig:
                  breaker_threshold=3, breaker_cooldown_s=0.5,
                  health_interval_s=None, restart_dead=True,
                  max_batch_attempts=None, drain_timeout_s=30.0,
-                 prewarm=None, metrics_port=None, trace_sample=None):
+                 prewarm=None, metrics_port=None, trace_sample=None,
+                 collector=None):
         self.max_batch = int(max_batch)
         self.buckets = tuple(buckets) if buckets is not None \
             else default_buckets(self.max_batch)
@@ -102,6 +103,15 @@ class ServingConfig:
             if not 0.0 <= trace_sample <= 1.0:
                 raise ValueError("trace_sample must be in [0.0, 1.0]")
         self.trace_sample = trace_sample
+        # fleet collector (ISSUE 12): endpoint the server's
+        # CollectorPusher targets.  None -> PADDLE_TPU_COLLECTOR ->
+        # off; off means no pusher thread and ZERO new wire bytes.
+        if collector is None:
+            from paddle_tpu.observability.collector import \
+                collector_endpoint
+
+            collector = collector_endpoint()
+        self.collector = collector
 
 
 class InferenceServer:
@@ -135,6 +145,7 @@ class InferenceServer:
         self._validator = self.pool.replicas[0].predictor \
             if self.pool.replicas else None
         self.metrics_server = None
+        self.collector_pusher = None
         self._started = False
         self._stopped = False
 
@@ -152,6 +163,15 @@ class InferenceServer:
             except OSError:
                 self.metrics_server = None   # scrape endpoint is an
                 #                              optimization, not a crash
+        if self.config.collector:
+            # fleet collector push loop (ISSUE 12): snapshot + span
+            # batches + dump refs on a timer; a dead collector costs
+            # one short-deadline failure per tick, never the server
+            from paddle_tpu.observability.collector import \
+                CollectorPusher
+
+            self.collector_pusher = CollectorPusher(
+                self.config.collector, role="serving").start()
         self.pool.start()
         if self.config.prewarm:
             self.prewarm_buckets()
@@ -253,6 +273,10 @@ class InferenceServer:
         self._stopped = True
         self._sup.stop(join_timeout=2.0)
         self.pool.stop(join_timeout=2.0)
+        if self.collector_pusher is not None:
+            # final push so the drain's last spans/counters land
+            self.collector_pusher.stop(final_push=True)
+            self.collector_pusher = None
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None

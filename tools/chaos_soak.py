@@ -39,6 +39,14 @@ chain) plus any a pserver subprocess announced on stderr (the
 ``FLIGHT RECORDER DUMP: <path>`` contract) — so a failing seed comes
 with its post-mortem narrative attached.  ``metrics`` embeds the
 process registry snapshot (same shape as tools/serving_load.py).
+
+Fleet collector (ISSUE 12): serving-mode soaks run an in-process
+``CollectorServer``; every iteration's servers push snapshots + span
+batches to it (PADDLE_TPU_COLLECTOR is set for the soak), so the
+verdict line embeds ``fleet`` — the fleet snapshot with per-process
+staleness and the rolled-up fleet SLO row — and ``fleet_snapshot``
+names the dumped fleet file (the ``COLLECTOR FLEET SNAPSHOT`` announce
+contract tools/check_test_hung.py renders).
 """
 
 from __future__ import annotations
@@ -429,6 +437,7 @@ def main(argv=None):
     # ISSUE 10: baseline SLO sample at soak start so the end-of-soak
     # verdict windows over the WHOLE run (burn rates need a delta)
     soak_monitor = None
+    collector_srv = None
     if args.mode == "serving":
         try:
             sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -438,8 +447,26 @@ def main(argv=None):
             soak_monitor = obs_slo.SLOMonitor(
                 slos=obs_slo.default_slos(window_s=24 * 3600.0))
             soak_monitor.observe()
+            # installed process-wide so the collector pushers embed
+            # the per-process SLO evaluation -> the fleet roll-up row
+            obs_slo.install(soak_monitor)
         except Exception:
             soak_monitor = None
+        try:
+            # fleet collector (ISSUE 12): the soak's servers push to
+            # an in-process collector via the env knob; its snapshot
+            # rides the verdict and dumps for the post-mortem
+            from paddle_tpu.observability import (
+                collector as obs_collector)
+
+            collector_srv = obs_collector.CollectorServer(
+                "127.0.0.1:0").start()
+            os.environ["PADDLE_TPU_COLLECTOR"] = \
+                collector_srv.endpoint
+            os.environ.setdefault(
+                "PADDLE_TPU_COLLECTOR_PUSH_INTERVAL", "0.25")
+        except Exception:
+            collector_srv = None
     seeds, failures, total_faults = [], [], 0
     i = 0
     while True:
@@ -495,6 +522,25 @@ def main(argv=None):
             slo_verdict = soak_monitor.verdict()
     except Exception:   # cluster mode may never import paddle_tpu
         pass
+    fleet_snapshot, fleet_path = {}, None
+    if soak_monitor is not None:
+        try:
+            from paddle_tpu.observability import slo as obs_slo
+
+            obs_slo.install(None)
+        except Exception:
+            pass
+    if collector_srv is not None:
+        try:
+            fleet_snapshot = collector_srv.snapshot()
+            # the full per-process series live in the dump file; the
+            # one-line embed keeps processes/staleness/SLO roll-up so
+            # the verdict line stays bounded
+            fleet_snapshot.pop("metrics", None)
+            fleet_path = collector_srv.dump(reason="chaos_soak")
+        finally:
+            os.environ.pop("PADDLE_TPU_COLLECTOR", None)
+            collector_srv.stop()
     verdict = {
         "ok": not failures and bool(seeds),
         "mode": args.mode,
@@ -507,6 +553,8 @@ def main(argv=None):
         "flight_dumps": flight_dumps,
         "metrics": metrics_snapshot,
         "slo": slo_verdict,
+        "fleet": fleet_snapshot,
+        "fleet_snapshot": fleet_path,
     }
     print(json.dumps(verdict))
     return 0 if verdict["ok"] else 1

@@ -48,6 +48,13 @@ _BARRIER = re.compile(
 _FLIGHT = re.compile(
     r"FLIGHT RECORDER DUMP: (?P<path>\S+) "
     r"\(reason=(?P<reason>[\w.\-]+), events=(?P<events>\d+)\)")
+# the fleet-collector announce contract (observability/collector.py
+# dump): COLLECTOR FLEET SNAPSHOT: <path> (reason=R, processes=N,
+# traces=M)
+_FLEET = re.compile(
+    r"COLLECTOR FLEET SNAPSHOT: (?P<path>\S+) "
+    r"\(reason=(?P<reason>[\w.\-]+), processes=(?P<procs>\d+), "
+    r"traces=(?P<traces>\d+)\)")
 
 
 def scan(lines):
@@ -109,6 +116,59 @@ def scan_flight_dumps(lines):
     return out
 
 
+def scan_fleet_snapshots(lines):
+    """Collector fleet-snapshot announcements found in the log:
+    [{path, reason, processes, traces}], deduplicated in first-seen
+    order."""
+    out, seen = [], set()
+    for line in lines:
+        m = _FLEET.search(line)
+        if not m or m.group("path") in seen:
+            continue
+        seen.add(m.group("path"))
+        out.append({"path": m.group("path"),
+                    "reason": m.group("reason"),
+                    "processes": int(m.group("procs")),
+                    "traces": int(m.group("traces"))})
+    return out
+
+
+def render_fleet_snapshot(rec):
+    """Human lines for one fleet snapshot: per-process role/staleness
+    and the fleet SLO roll-up (file may be gone — still report the
+    announcement)."""
+    lines = [f"  {rec['path']} (reason={rec['reason']}, "
+             f"processes={rec['processes']}, traces={rec['traces']})"]
+    if not os.path.exists(rec["path"]):
+        lines.append("    (snapshot file no longer exists)")
+        return lines
+    try:
+        with open(rec["path"]) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        lines.append(f"    (unreadable: {e})")
+        return lines
+    for name, p in sorted((doc.get("processes") or {}).items()):
+        age = p.get("last_push_age_s")
+        lines.append(
+            "    %-28s role=%-8s %s  pushes=%s spans=%s"
+            % (name, p.get("role", "?"),
+               "STALE" if p.get("stale")
+               else "fresh(%.1fs)" % age if age is not None
+               else "fresh", p.get("pushes"), p.get("span_count")))
+    for obj, e in sorted((doc.get("slo_fleet") or {}).items()):
+        att = e.get("attained")
+        lines.append(
+            "    slo %-24s attained=%s target=%s burn=%s%s"
+            % (obj,
+               "%.4f" % att if att is not None else "-",
+               e.get("target"),
+               "%.1f" % e["burn_rate"]
+               if e.get("burn_rate") is not None else "-",
+               " FIRING" if e.get("firing") else ""))
+    return lines
+
+
 def render_flight_dump(rec, tail=8):
     """Human lines for one dump record: header + the last `tail`
     events of the causal chain (file may be gone — still report the
@@ -144,6 +204,7 @@ def main():
     hung = scan(lines)
     barriers = scan_barriers(lines)
     dumps = scan_flight_dumps(lines)
+    fleets = scan_fleet_snapshots(lines)
     if barriers:
         print("Stalled barriers (deadline diagnostics):")
         for b in barriers:
@@ -155,12 +216,17 @@ def main():
         for rec in dumps:
             for ln in render_flight_dump(rec):
                 print(ln)
+    if fleets:
+        print("Fleet snapshot (collector dumps):")
+        for rec in fleets:
+            for ln in render_fleet_snapshot(rec):
+                print(ln)
     if hung:
         print("Hung (started, no outcome):")
         for t in sorted(hung):
             print(" ", t)
         return 1
-    if not barriers and not dumps:
+    if not barriers and not dumps and not fleets:
         print("No hung tests found.")
     return 0
 

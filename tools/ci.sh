@@ -199,16 +199,83 @@ for k in ("serving_trace_ok", "decode_trace_ok", "rpc_trace_joined",
           "prometheus_ok", "flight_ok",
           # ISSUE 10: device-time attribution (CPU DeviceTraceSession
           # join), head-based sampling accounting, /sloz
-          "device_trace_ok", "sampling_ok", "sloz_ok"):
+          "device_trace_ok", "sampling_ok", "sloz_ok",
+          # ISSUE 12: exemplar-bearing exposition validates end to
+          # end; two processes assemble one trace in the collector
+          # and /fleetz parses
+          "exemplar_ok", "collector_ok"):
     assert rec.get(k) is True, (k, rec)
 assert rec["serving_trace_id"] and rec["decode_trace_id"]
+assert rec["exemplars"] >= 1 and rec["fleet_trace_id"]
 s = rec["sampling"]
 assert s["sampled"] + s["dropped"] == s["offered"], s
 print("observability smoke OK: serving trace %s, decode trace %s, "
-      "%d prom samples, %d device slices joined, sampling %d/%d"
+      "%d prom samples, %d device slices joined, sampling %d/%d, "
+      "%d exemplars, fleet trace %s"
       % (rec["serving_trace_id"], rec["decode_trace_id"],
          rec["prom_samples"], rec["device_joined_slices"],
-         s["sampled"], s["offered"]))
+         s["sampled"], s["offered"], rec["exemplars"],
+         rec["fleet_trace_id"]))
+PY
+
+echo "== 5d/8 tail-latency forensics gate (seeded overload attribution) =="
+# ISSUE 12: a seeded 2x-overload run with tracing head-sampled at 0.5
+# must decompose its slowest traces into the stage taxonomy with
+# segment sums closing over each span's wall time, and the aggregate
+# attribution must provably name admission-queue wait — the automated
+# answer to "where does the p99 go?"
+JAX_PLATFORMS=cpu python tools/tail_forensics.py --run \
+  --seconds 2 --seed 7 --sample 0.5 --slowest 5 \
+  > /tmp/_forensics.json
+cat /tmp/_forensics.json
+python - <<'PY'
+import json
+lines = [ln for ln in open("/tmp/_forensics.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, (
+    "tail_forensics stdout must be exactly ONE JSON line — got %d"
+    % len(lines))
+rec = json.loads(lines[0])
+missing = {"metric", "value", "unit", "dominant", "n_traces",
+           "aggregate_us", "per_trace", "closure_ok"} - set(rec)
+assert not missing, "forensics JSON missing fields: %s" % (
+    sorted(missing),)
+assert rec["metric"] == "tail_forensics"
+assert rec["n_traces"] >= 3, rec["n_traces"]
+assert rec["closure_ok"] is True, (
+    "segment sums must close over the span wall time: %r"
+    % rec["per_trace"])
+assert rec["dominant"] == "admission_wait", (
+    "overload p99 must be attributed to admission-queue wait, got "
+    "%r (%r)" % (rec["dominant"], rec["aggregate_us"]))
+print("forensics gate OK: %s dominates at %.1f%% over %d traces"
+      % (rec["dominant"], rec["value"], rec["n_traces"]))
+PY
+
+echo "== 5e/8 perf-regression sentinel (CPU-harness rows vs banked baseline) =="
+# ISSUE 12: the 5b rows (inter-token p50, time_to_first_batch
+# warm/cold, p50/goodput) are diffed against the committed CPU
+# baseline keyed by workload signature — the bench trajectory is
+# machine-gated, not eyeballed.  The 4x band absorbs CI-machine
+# variance and still catches order-of-magnitude breakage.
+JAX_PLATFORMS=cpu python tools/perf_sentinel.py --mode serving \
+  --fresh /tmp/_serving_load.json,/tmp/_serving_load_decode.json \
+  --baseline docs/perf_baseline_cpu.json > /tmp/_sentinel.json
+cat /tmp/_sentinel.json
+python - <<'PY'
+import json
+lines = [ln for ln in open("/tmp/_sentinel.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, "perf_sentinel stdout must be ONE JSON line"
+rec = json.loads(lines[0])
+assert rec["metric"] == "perf_sentinel"
+assert rec["checked"] >= 6, (
+    "sentinel must actually compare the CPU-harness rows: %r" % rec)
+assert rec["ok"] is True, (
+    "PERF REGRESSION flagged vs docs/perf_baseline_cpu.json: %r"
+    % rec["flagged"])
+print("perf sentinel OK: %d metrics checked, 0 regressions"
+      % rec["checked"])
 PY
 
 echo "== 6/8 per-op regression gate (hot ops vs committed CPU baseline) =="

@@ -31,6 +31,18 @@ ISSUE 10 legs:
     leave any span in the ring;
   - /sloz parses and carries the declarative objectives.
 
+ISSUE 12 legs:
+
+  - EXEMPLARS: the serving request-latency histogram's exposition
+    carries an OpenMetrics exemplar (`# {trace_id="..."} v ts`) whose
+    trace id IS the request's trace, and the strict grammar checker
+    accepts it;
+  - COLLECTOR: a second PROCESS (subprocess RPC server + pusher) and
+    this process both push span batches to an in-process
+    CollectorServer; one trace id (client span here, envelope-joined
+    server span there) must assemble COMPLETE in the collector's one
+    store, and /fleetz must parse with both processes present.
+
 stdout contract: EXACTLY ONE JSON line (the same driver/gate shape as
 bench.py / serving_load.py); progress goes to stderr.  Exit 0 iff every
 assertion held.
@@ -109,7 +121,9 @@ def main():
 
         body = urllib.request.urlopen(
             srv.metrics_server.url + "/metrics", timeout=10).read()
-        samples = parse_prometheus_text(body.decode("utf-8"))
+        text = body.decode("utf-8")
+        samples, exemplars = parse_prometheus_text(
+            text, with_exemplars=True)
         sample_names = {n for n, _, _ in samples}
         core = {"paddle_tpu_admission_requests_total",
                 "paddle_tpu_batcher_batches_total",
@@ -118,6 +132,22 @@ def main():
         verdict["prom_samples"] = len(samples)
         _log("prometheus: %d samples, core present=%s"
              % (len(samples), core <= sample_names))
+        # ISSUE 12: the request-latency histogram carries an
+        # OpenMetrics exemplar naming the request's REAL trace id —
+        # the strict grammar checker validates exemplar-bearing
+        # exposition end to end
+        req_ex = [e for e in exemplars
+                  if e["name"] ==
+                  "paddle_tpu_serving_request_seconds_bucket"]
+        checks["exemplar_ok"] = bool(
+            req_ex
+            and any(e["exemplar_labels"].get("trace_id") == tid
+                    for e in req_ex)
+            and ' # {trace_id="' in text)
+        verdict["exemplars"] = len(exemplars)
+        _log("exemplars: %d total, serving-request exemplar joins "
+             "trace %s: %s" % (len(exemplars), tid,
+                               checks["exemplar_ok"]))
     finally:
         srv.stop()
 
@@ -267,6 +297,89 @@ def main():
          % (n_sampled, n_dropped, offered, complete))
 
     tracing.stop_tracing()
+
+    # -- ISSUE 12: fleet-collector leg (two processes, one trace) -----------
+    _log("collector leg: cross-process trace assembly + /fleetz")
+    import subprocess
+    import time as _time
+
+    from paddle_tpu.observability import collector as obs_collector
+
+    t3 = tracing.start_tracing(sample=1.0)
+    t3.clear()
+    coll = obs_collector.CollectorServer("127.0.0.1:0",
+                                         http_port=0).start()
+    child_src = (
+        "import os, sys, time\n"
+        "os.environ['PADDLE_TPU_TRACING'] = '1'\n"
+        "from paddle_tpu.observability import collector, tracing\n"
+        "from paddle_tpu.distributed.rpc import RPCServer\n"
+        "tracing.start_tracing(sample=1.0)\n"
+        "srv = RPCServer('127.0.0.1:0').start()\n"
+        "srv.register_handler('echo', lambda p: p)\n"
+        "p = collector.CollectorPusher(%r, role='pserver',\n"
+        "                              interval_s=0.1).start()\n"
+        "print('EP ' + srv.endpoint, flush=True)\n"
+        "sys.stdin.read()\n"          # EOF = shut down
+        "p.stop(final_push=True)\n"
+        "srv.stop()\n" % coll.endpoint)
+    child = subprocess.Popen(
+        [sys.executable, "-c", child_src],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        ep_line = child.stdout.readline().decode().strip()
+        assert ep_line.startswith("EP "), ep_line
+        child_ep = ep_line[3:]
+        from paddle_tpu.distributed.rpc import RPCClient
+
+        client3 = RPCClient()
+        try:
+            with t3.span("fleet.probe") as root:
+                client3.call(child_ep, "echo", "x", retries=0)
+            ftid = root.trace_id
+        finally:
+            client3.close()
+        child.stdin.close()         # child: final push + exit
+        child.wait(timeout=30)
+        pusher = obs_collector.CollectorPusher(
+            coll.endpoint, role="serving", interval_s=0.1)
+        pusher.start()
+        deadline = _time.monotonic() + 10.0
+        assembled = False
+        while _time.monotonic() < deadline and not assembled:
+            pusher.push_now()
+            spans = coll.trace(ftid)
+            names = {s["name"] for s in spans}
+            procs = {s["process"] for s in spans}
+            assembled = ({"fleet.probe", "rpc.client:echo",
+                          "rpc.server:echo"} <= names
+                         and len(procs) >= 2
+                         and coll.trace_complete(ftid))
+            _time.sleep(0.05)
+        pusher.stop(final_push=False)
+        # /fleetz parses and names both processes
+        import urllib.request
+
+        fleetz = json.loads(urllib.request.urlopen(
+            coll.http_server.url + "/fleetz", timeout=10).read())
+        roles = {p.get("role")
+                 for p in fleetz.get("processes", {}).values()}
+        checks["collector_ok"] = bool(
+            assembled and {"pserver", "serving"} <= roles
+            and fleetz.get("n_traces", 0) >= 1)
+        verdict["fleet_trace_id"] = ftid
+        verdict["fleet_processes"] = sorted(
+            fleetz.get("processes", {}))
+        _log("collector: trace %s assembled=%s from %s"
+             % (ftid, assembled, sorted(procs) if spans else []))
+    finally:
+        if child.poll() is None:
+            child.kill()
+        coll.stop()
+        tracing.stop_tracing()
+
     verdict.update(checks)
     verdict["ok"] = all(checks.values())
     verdict["value"] = int(verdict["ok"])

@@ -13,6 +13,13 @@ Input: one or more serving_load one-JSON-line outputs —
 shape (`serving_qps_slo` in tools/chip_chaser.py; keyed by
 tools/bank_onchip.py).
 
+``--fleet <path>`` (ISSUE 12) additionally ingests a collector fleet
+snapshot (observability/collector.py ``snapshot()`` / ``dump()``
+output): the per-process burn rates roll up to ONE fleet SLO row
+(mode "fleet") appended after the per-run rows — sum of per-process
+(good, total) per objective, burn weighted by each process's total,
+firing iff any process fires.
+
 stdout contract (gated like every tool here): EXACTLY ONE JSON line —
 
     {"metric": "serving_qps_slo", "value": <goodput_qps of the
@@ -67,6 +74,32 @@ def _records_from_stdin():
     return [json.loads(line) for line in sys.stdin if line.strip()]
 
 
+def _fleet_row(path):
+    """The fleet SLO roll-up row from a collector snapshot/dump file.
+    The snapshot already carries ``slo_fleet`` (observability/
+    collector.py fleet_slo()); this just reshapes it to the dashboard
+    row contract."""
+    with open(path) as f:
+        doc = json.load(f)
+    slo_fleet = doc.get("slo_fleet") or {}
+    procs = doc.get("processes") or {}
+    return {
+        "mode": "fleet",
+        "offered_qps": None, "goodput_qps": None,
+        "capacity_qps": None, "tokens_per_sec": None,
+        "p50_ms": None, "p99_ms": None, "deadline_ms": None,
+        "seed": None,
+        "slo": {name: {"attained": e.get("attained"),
+                       "target": e.get("target"),
+                       "burn_rate": e.get("burn_rate"),
+                       "firing": e.get("firing")}
+                for name, e in slo_fleet.items()},
+        "processes": len(procs),
+        "stale_processes": sorted(
+            n for n, p in procs.items() if p.get("stale")),
+    }
+
+
 def _record_from_run(passthrough):
     cmd = [sys.executable,
            os.path.join(REPO, "tools", "serving_load.py")] \
@@ -94,6 +127,10 @@ def main(argv=None):
     ap.add_argument("--run", action="store_true",
                     help="invoke tools/serving_load.py with the "
                          "remaining args and report on its line")
+    ap.add_argument("--fleet", default=None,
+                    help="collector fleet snapshot/dump file: roll "
+                         "per-process burn rates up to one fleet SLO "
+                         "row")
     args, passthrough = ap.parse_known_args(argv)
 
     if args.run:
@@ -103,7 +140,7 @@ def main(argv=None):
             p for p in args.inputs.split(",") if p)
     else:
         recs = _records_from_stdin()
-    if not recs:
+    if not recs and not args.fleet:
         print("no serving_load records given", file=sys.stderr)
         return 1
 
@@ -114,7 +151,12 @@ def main(argv=None):
              and {"attained", "target", "burn_rate"} <= set(
                  r["slo"]["serving_availability"])
              for r in rows)
-    headline = rows[-1]
+    if args.fleet:
+        # the fleet roll-up rides AFTER the per-run rows (it is a
+        # different aggregation level, not a heavier load point)
+        rows.append(_fleet_row(args.fleet))
+    headline = next((r for r in reversed(rows)
+                     if r.get("goodput_qps") is not None), rows[-1])
     report = {
         "metric": "serving_qps_slo",
         "value": headline.get("goodput_qps"),

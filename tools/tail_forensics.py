@@ -1,0 +1,425 @@
+"""Tail-latency forensics: decompose traced serving requests into
+stage segments and name the dominant contributor (ISSUE 12).
+
+Answers "where does the p99 actually go?" from span timings alone:
+given a trace id (or ``--slowest P`` over a run), each request trace
+is decomposed into
+
+    admission_wait      admission enqueue -> batch formation start
+                        (time spent waiting in the bounded queue)
+    batch_formation     the batcher group window (the
+                        ``formation_us`` attribute the serving.batch
+                        span carries)
+    replica_queue       batch formed -> replica execution start
+                        (time in the dispatch queue)
+    device_compute      the replica execution window, split by the
+    device_transfer     PR-10 device breakdown joined BY TRACE ID
+    device_host_gap     when available (DeviceTraceSession); without
+                        device data, compute ~= the predictor.run
+                        span and the remainder is host_gap
+    delivery            replica done -> the exactly-once answer
+
+Segment sums close over the span's wall time (admission end ->
+delivery) by construction; ``closure_ok`` flags any trace where clock
+weirdness broke that.  The aggregate attribution sums segments over
+the selected traces — under a 2x-overload run the dominant
+contributor is provably ``admission_wait`` (the ci.sh forensics gate
+asserts exactly that).
+
+Inputs (one of):
+    --run               drive a seeded in-process overload serving run
+                        (tracing head-sampled; --sample/--seed) and
+                        analyze its tracer ring — the CI gate shape
+    --input FILE        offline: a collector fleet dump (its
+                        ``traces`` store), a ``{"spans": [...]}``
+                        file, or a chrome-trace export
+    lines on stdin      span dicts, one JSON object per line
+
+Selection: --trace TRACE_ID (repeatable) or --slowest P (default 5).
+
+stdout contract: EXACTLY ONE JSON line —
+
+    {"metric": "tail_forensics", "value": <dominant share pct>,
+     "unit": "pct", "dominant": "admission_wait", "n_traces": N,
+     "aggregate_us": {...}, "per_trace": [...], "closure_ok": true}
+
+progress goes to stderr.  Exit 0 iff >= 1 trace decomposed and every
+decomposed trace closed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SEGMENTS = ("admission_wait", "batch_formation", "replica_queue",
+            "device_compute", "device_transfer", "device_host_gap",
+            "delivery")
+
+_CLOSURE_ABS_US = 500.0
+_CLOSURE_REL = 0.05
+
+
+def _log(msg):
+    print("# " + msg, file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# span-store loading
+# ---------------------------------------------------------------------------
+
+def traces_from_spans(spans):
+    """Group span dicts by trace id -> {tid: [span, ...]}."""
+    out: dict = {}
+    for s in spans:
+        tid = s.get("trace_id")
+        if tid:
+            out.setdefault(str(tid), []).append(s)
+    return out
+
+
+def _span_from_chrome_event(ev):
+    args = ev.get("args") or {}
+    if "trace_id" not in args:
+        return None
+    return {"name": ev.get("name"), "trace_id": args["trace_id"],
+            "span_id": args.get("span_id"),
+            "parent_id": args.get("parent_id"),
+            "t0_us": float(ev.get("ts", 0.0)),
+            "t1_us": float(ev.get("ts", 0.0))
+            + float(ev.get("dur", 0.0)),
+            "attrs": {k: v for k, v in args.items()
+                      if k not in ("trace_id", "span_id",
+                                   "parent_id")}}
+
+
+def load_traces(path):
+    """{tid: [span dicts]} from a collector fleet dump (``traces``),
+    a ``{"spans": [...]}`` file, or a chrome-trace export."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("traces"), dict):
+        return {tid: list(spans)
+                for tid, spans in doc["traces"].items()}
+    if isinstance(doc, dict) and isinstance(doc.get("spans"), list):
+        return traces_from_spans(doc["spans"])
+    if isinstance(doc, dict) and \
+            isinstance(doc.get("traceEvents"), list):
+        spans = [s for s in (
+            _span_from_chrome_event(ev)
+            for ev in doc["traceEvents"] if ev.get("ph") == "X")
+            if s is not None]
+        return traces_from_spans(spans)
+    raise ValueError(
+        f"{path}: not a collector dump, spans file, or chrome trace")
+
+
+# ---------------------------------------------------------------------------
+# decomposition
+# ---------------------------------------------------------------------------
+
+def _attr(span, key, default=None):
+    a = span.get("attrs") or {}
+    return a.get(key, default)
+
+
+def decompose_trace(spans, device_index=None):
+    """One trace's segment decomposition, or None when the trace does
+    not carry the full serving stage chain (shed/failed requests stop
+    early; they are counted by the caller as skipped, not guessed
+    at).  ``device_index``: {trace_id: {"compute_us", "transfer_us"}}
+    from a DeviceTraceSession join (optional)."""
+    by: dict = {}
+    for s in spans:
+        by.setdefault(s.get("name"), []).append(s)
+    adm = by.get("serving.admission")
+    batch = by.get("serving.batch")
+    rep = by.get("serving.replica")
+    deliver = by.get("serving.deliver")
+    if not (adm and batch and rep and deliver):
+        return None
+    tid = spans[0].get("trace_id")
+    adm_end = max(s["t1_us"] for s in adm)
+    batch_ts = min(s["t0_us"] for s in batch)
+    formation = float(_attr(
+        sorted(batch, key=lambda s: s["t0_us"])[0],
+        "formation_us", 0.0) or 0.0)
+    reps = sorted(rep, key=lambda s: s["t0_us"])
+    rep0 = reps[0]["t0_us"]
+    rep1 = max(s["t1_us"] for s in reps)
+    deliver_ts = max(s["t0_us"] for s in deliver)
+    wall = deliver_ts - adm_end
+    if wall <= 0:
+        return None
+    gap = max(0.0, batch_ts - adm_end)
+    formation = min(formation, gap)
+    window = max(0.0, rep1 - rep0)
+    dev = (device_index or {}).get(tid)
+    if dev is not None:
+        compute = min(window, float(dev.get("compute_us", 0.0)))
+        transfer = min(window - compute,
+                       float(dev.get("transfer_us", 0.0)))
+        device_joined = True
+    else:
+        pred = by.get("predictor.run") or []
+        # without device data or a nested predictor span (only the
+        # batch's oldest rider carries one), the replica window IS
+        # compute from this request's point of view
+        compute = min(window, sum(s["t1_us"] - s["t0_us"]
+                                  for s in pred)) if pred else window
+        transfer = 0.0
+        device_joined = False
+    seg = {
+        "admission_wait": gap - formation,
+        "batch_formation": formation,
+        "replica_queue": max(0.0, rep0 - batch_ts),
+        "device_compute": compute,
+        "device_transfer": transfer,
+        "device_host_gap": window - compute - transfer,
+        "delivery": max(0.0, deliver_ts - rep1),
+    }
+    total = sum(seg.values())
+    closure_ok = abs(total - wall) <= max(_CLOSURE_ABS_US,
+                                          _CLOSURE_REL * wall)
+    dominant = max(seg, key=lambda k: seg[k])
+    return {
+        "trace_id": tid,
+        "wall_us": round(wall, 1),
+        "segments_us": {k: round(v, 1) for k, v in seg.items()},
+        "dominant": dominant,
+        "dominant_share_pct": round(100.0 * seg[dominant] / wall, 1),
+        "outcome": _attr(deliver[-1], "outcome"),
+        "device_joined": device_joined,
+        "closure_ok": closure_ok,
+    }
+
+
+def aggregate(decomps):
+    """Fleet-level attribution over decomposed traces: summed
+    segments, the dominant contributor, and per-trace dominant
+    counts."""
+    agg = {k: 0.0 for k in SEGMENTS}
+    wall = 0.0
+    dom_counts: dict = {}
+    for d in decomps:
+        for k, v in d["segments_us"].items():
+            agg[k] += v
+        wall += d["wall_us"]
+        dom_counts[d["dominant"]] = dom_counts.get(d["dominant"],
+                                                   0) + 1
+    dominant = max(agg, key=lambda k: agg[k]) if decomps else None
+    return {
+        "segments_us": {k: round(v, 1) for k, v in agg.items()},
+        "wall_us": round(wall, 1),
+        "dominant": dominant,
+        "dominant_share_pct": round(
+            100.0 * agg[dominant] / wall, 1) if wall else None,
+        "per_trace_dominant": dom_counts,
+    }
+
+
+def device_index_from_session(sess):
+    """{trace_id: {compute_us, transfer_us}} from a stopped
+    DeviceTraceSession — the PR-10 device breakdown keyed by the
+    trace id each joined slice carries."""
+    out: dict = {}
+    for j in sess.joined:
+        tid = j.get("trace_id")
+        if not tid:
+            continue
+        d = out.setdefault(tid, {"compute_us": 0.0,
+                                 "transfer_us": 0.0})
+        d["transfer_us" if j.get("transfer")
+          else "compute_us"] += float(j.get("dur", 0.0))
+    return out
+
+
+def slowest(traces, p, device_index=None):
+    """Decompose every trace, return the P slowest by wall time (plus
+    the skipped count)."""
+    decomps = []
+    skipped = 0
+    for spans in traces.values():
+        d = decompose_trace(spans, device_index=device_index)
+        if d is None:
+            skipped += 1
+        else:
+            decomps.append(d)
+    decomps.sort(key=lambda d: -d["wall_us"])
+    return decomps[:int(p)], skipped
+
+
+# ---------------------------------------------------------------------------
+# --run mode: seeded in-process overload serving run
+# ---------------------------------------------------------------------------
+
+def run_overload(seconds=2.0, seed=7, sample=0.5, replicas=1,
+                 max_batch=4, device_trace=False):
+    """Drive a seeded closed-loop OVERLOAD run (every round fills the
+    admission queue before waiting) with tracing head-sampled at
+    ``sample``, and return (traces, device_index, extras).  The deep
+    bounded queue makes admission wait the dominant segment — the
+    acceptance shape."""
+    import tempfile
+    import time
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import inference, layers, serving
+    from paddle_tpu.observability import tracing
+
+    x = layers.data("x", shape=[8], dtype="float32")
+    pred = layers.fc(x, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mdir = os.path.join(tempfile.mkdtemp(), "model")
+    fluid.io.save_inference_model(mdir, ["x"], [pred], exe)
+
+    tracing.stop_tracing()
+    os.environ["PADDLE_TPU_TRACE_SEED"] = str(seed)
+    tracer = tracing.start_tracing(sample=sample, seed=seed)
+    capacity = 12 * max_batch
+    srv = serving.InferenceServer(
+        lambda i: inference.create_predictor(inference.Config(mdir)),
+        serving.ServingConfig(
+            n_replicas=replicas, max_batch=max_batch,
+            queue_capacity=capacity,
+            default_deadline_s=60.0, max_wait_s=0.002)).start()
+    dsess = None
+    if device_trace:
+        from paddle_tpu.observability.device_trace import \
+            DeviceTraceSession
+
+        dsess = DeviceTraceSession(
+            os.path.join(tempfile.mkdtemp(), "devtrace")).start()
+    n_submitted = n_ok = 0
+    rng = np.random.RandomState(seed)
+    feeds = {"x": rng.rand(1, 8).astype(np.float32)}
+    t_end = time.monotonic() + float(seconds)
+    try:
+        # warm the bucket compiles OUT of the measured traces
+        srv.infer(feeds, deadline_s=60.0, timeout=60.0)
+        tracer.clear()
+        while time.monotonic() < t_end:
+            futures = []
+            for _ in range(capacity):   # fill the queue: overload
+                try:
+                    futures.append(srv.submit(feeds))
+                except serving.ServingError:
+                    break
+            n_submitted += len(futures)
+            for f in futures:
+                try:
+                    f.result(timeout=120.0)
+                    n_ok += 1
+                except serving.ServingError:
+                    pass
+    finally:
+        srv.stop()
+        if dsess is not None:
+            try:
+                dsess.stop()
+            except Exception:
+                dsess = None
+    spans = [tracing.span_to_dict(s) for s in tracer.spans()]
+    tracing.stop_tracing()
+    device_index = device_index_from_session(dsess) \
+        if dsess is not None else None
+    extras = {"submitted": n_submitted, "ok": n_ok,
+              "sample": sample, "seed": seed,
+              "spans": len(spans)}
+    return traces_from_spans(spans), device_index, extras
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="tail-latency forensics over traced serving runs")
+    ap.add_argument("--input", default=None,
+                    help="collector fleet dump / spans file / chrome "
+                         "trace (default without --run: span dicts "
+                         "as JSON lines on stdin)")
+    ap.add_argument("--trace", action="append", default=None,
+                    help="decompose this trace id (repeatable)")
+    ap.add_argument("--slowest", type=int, default=5,
+                    help="decompose the P slowest traces (default 5)")
+    ap.add_argument("--run", action="store_true",
+                    help="drive a seeded in-process overload serving "
+                         "run and analyze it (the CI gate shape)")
+    ap.add_argument("--seconds", type=float, default=2.0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--sample", type=float, default=0.5,
+                    help="--run: head-sampling rate")
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--device-trace", action="store_true",
+                    help="--run: wrap the run in a DeviceTraceSession "
+                         "and join the device breakdown by trace id")
+    args = ap.parse_args(argv)
+
+    device_index = None
+    extras = {}
+    if args.run:
+        traces, device_index, extras = run_overload(
+            seconds=args.seconds, seed=args.seed, sample=args.sample,
+            replicas=args.replicas, max_batch=args.max_batch,
+            device_trace=args.device_trace)
+        _log("run: %(submitted)d submitted, %(ok)d ok, %(spans)d "
+             "spans" % extras)
+    elif args.input:
+        traces = load_traces(args.input)
+    else:
+        traces = traces_from_spans(
+            [json.loads(ln) for ln in sys.stdin if ln.strip()])
+    _log("%d traces in store" % len(traces))
+
+    if args.trace:
+        decomps, skipped = [], 0
+        for tid in args.trace:
+            spans = traces.get(tid)
+            d = decompose_trace(spans, device_index=device_index) \
+                if spans else None
+            if d is None:
+                skipped += 1
+                _log("trace %s: absent or incomplete stage chain"
+                     % tid)
+            else:
+                decomps.append(d)
+    else:
+        decomps, skipped = slowest(traces, args.slowest,
+                                   device_index=device_index)
+
+    agg = aggregate(decomps)
+    closure_ok = bool(decomps) and all(d["closure_ok"]
+                                       for d in decomps)
+    report = {
+        "metric": "tail_forensics",
+        "value": agg["dominant_share_pct"],
+        "unit": "pct",
+        "dominant": agg["dominant"],
+        "n_traces": len(decomps),
+        "skipped": skipped,
+        "aggregate_us": agg["segments_us"],
+        "wall_us": agg["wall_us"],
+        "per_trace_dominant": agg["per_trace_dominant"],
+        "per_trace": decomps,
+        "device_joined": bool(device_index),
+        "closure_ok": closure_ok,
+        "ok": closure_ok,
+    }
+    report.update(extras)
+    print(json.dumps(report))
+    return 0 if closure_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
