@@ -117,6 +117,13 @@ class Supervisor:
             self._spawn(name, fn)
         return self
 
+    def remove_worker(self, name):
+        """Deregister a worker loop: the supervisor stops restarting
+        it (the retirement half of elastic pools — a live thread
+        finishes its current pass and is joined by stop()).  Returns
+        True when the name was registered."""
+        return self._loops.pop(name, None) is not None
+
     def start(self):
         if self._running:
             return self

@@ -154,6 +154,17 @@ def _program_fingerprint(program):
     return h
 
 
+def program_fingerprint(program):
+    """Public structural content hash of a program's IR — the same
+    value the jit cache keys on (``_program_fingerprint``), reused by
+    the serving model registry (serving/registry.py) to dedupe
+    registered versions and by the rollout controller to verify a
+    rollback restored the exact old program.  Two programs with
+    identical ops/attrs/shardings hash equal; any op, attr, or
+    sharding-annotation edit changes the value."""
+    return _program_fingerprint(program)
+
+
 def _mesh_fingerprint(mesh):
     if mesh is None:
         return None

@@ -281,6 +281,49 @@ class Predictor:
     # ZeroCopyRun: outputs pulled via handles after run()
     zero_copy_run = run
 
+    # -- live program swap (serving fleet rollout) -----------------------
+    _SWAP_ATTRS = ("_program", "_feed_names", "_fetch_vars",
+                   "_compiled", "_scope", "_inputs", "_feed_specs")
+
+    def program_fingerprint(self):
+        """Structural content hash of the loaded program (the jit-cache
+        key — core.compiler.program_fingerprint).  The model registry
+        dedupes versions by it; the rollout controller asserts a
+        rollback restored the exact old value."""
+        from paddle_tpu.core.compiler import program_fingerprint
+
+        return program_fingerprint(self._program)
+
+    def program_state(self):
+        """Snapshot of the swappable program surface (program, feed
+        names, fetch vars, compiled graph, scope, handles, feed specs)
+        — the token ``swap_program`` accepts to restore this exact
+        program later (rollout rollback)."""
+        return {a: getattr(self, a) for a in self._SWAP_ATTRS}
+
+    def swap_program(self, source):
+        """Hot-swap this predictor onto another program IN PLACE —
+        the serving rollout path.  ``source`` is another Predictor
+        (typically one prewarm-compiled from the model registry) or a
+        ``program_state()`` snapshot (rollback).  The predictor OBJECT
+        survives, so references held elsewhere (the server's feed
+        validator, the replica) see the new program without re-wiring;
+        the old state is returned for rollback.
+
+        Concurrency contract: the caller must guarantee no ``run()``
+        is in flight (the serving tier swaps only replicas quiesced
+        through the per-replica drain — ReplicaPool.swap_predictor)."""
+        state = source if isinstance(source, dict) \
+            else source.program_state()
+        missing = [a for a in self._SWAP_ATTRS if a not in state]
+        if missing:
+            raise ValueError(
+                "swap_program: source state missing %s" % missing)
+        prior = self.program_state()
+        for a in self._SWAP_ATTRS:
+            setattr(self, a, state[a])
+        return prior
+
     def get_output_handle(self, name):
         return self._outputs[name]
 

@@ -57,7 +57,7 @@ from paddle_tpu.observability import metrics as _metrics
 
 __all__ = ["SLO", "SLOMonitor", "monitor", "install",
            "default_slos", "serving_availability", "serving_latency",
-           "decode_inter_token", "peek_firing"]
+           "decode_inter_token", "fleet_availability", "peek_firing"]
 
 _G_ATTAIN = _metrics.gauge(
     "paddle_tpu_slo_attainment",
@@ -177,6 +177,23 @@ def serving_latency(deadline_s=1.0, objective=0.99, window_s=None,
         "kind": "histogram_under",
         "metric": "paddle_tpu_serving_request_seconds",
         "threshold_s": float(deadline_s)}, **kw)
+
+
+def fleet_availability(objective=0.99, window_s=None, **kw):
+    """The multi-tenant fleet objective (ISSUE 13, docs/FLEET.md):
+    like ``serving_availability`` but QUOTA sheds also count against
+    the budget — a tenant shed for being over its own quota is policy
+    working as intended, yet it is still unavailability from that
+    caller's side, and a fleet drowning in quota sheds is
+    under-provisioned.  The SLOAutoscaler watching this objective
+    therefore scales on quota pressure too."""
+    return SLO("fleet_availability", objective, window_s, source={
+        "kind": "counter_ratio",
+        "metric": "paddle_tpu_admission_requests_total",
+        "good": [{"outcome": "answered_ok"}],
+        "total": [{"outcome": "admitted"},
+                  {"outcome": "rejected_overloaded"},
+                  {"outcome": "rejected_quota"}]}, **kw)
 
 
 def decode_inter_token(threshold_s=0.1, objective=0.99, window_s=None,

@@ -20,6 +20,15 @@ Pieces (each its own module):
                    circuit breakers, failover/requeue, NamedSharding
                    param replication helper
   server.py        InferenceServer / ServingConfig / drain()
+  registry.py      ModelRegistry (ISSUE 13): named, versioned
+                   programs riding the ProgramDesc serialization,
+                   deduped by program fingerprint, prewarm-compiled
+                   through the persistent compile cache
+  fleet.py         RolloutController — zero-downtime rolling version
+                   swaps through the per-replica drain contract with
+                   burn-triggered rollback — and SLOAutoscaler, which
+                   actuates ReplicaPool size from the PR-10 burn-rate
+                   signal (hysteresis + cooldown; docs/FLEET.md)
   decode_engine.py continuous decode batching (ISSUE 7): DecodeServer
                    — iteration-level batching of LLM decode over paged
                    KV-caches + flash_decode, reusing the admission /
@@ -40,10 +49,12 @@ from paddle_tpu.serving.admission import (
     AdmissionController,
     DeadlineExpiredError,
     OverloadedError,
+    QuotaExceededError,
     ReplicaFailedError,
     Request,
     ServingError,
     ShutdownError,
+    TenantQuota,
 )
 from paddle_tpu.serving.batcher import (
     Batch,
@@ -65,13 +76,31 @@ from paddle_tpu.serving.decode_engine import (
     TinyDecodeLM,
 )
 from paddle_tpu.serving.server import InferenceServer, ServingConfig
+from paddle_tpu.serving.registry import (
+    ModelNotFoundError,
+    ModelRegistry,
+    ModelVersion,
+    PrewarmFailedError,
+    RegistryError,
+    VersionNotFoundError,
+)
+from paddle_tpu.serving.fleet import (
+    RolloutController,
+    RolloutError,
+    RolloutResult,
+    SLOAutoscaler,
+)
 
 __all__ = [
     "AdmissionController", "Batch", "DeadlineExpiredError",
     "DecodeConfig", "DecodeServer", "InferenceServer", "MSG_DECODE",
-    "MSG_HEALTH", "MSG_INFER", "OverloadedError",
-    "Replica", "ReplicaFailedError", "ReplicaPool", "Request",
-    "ServingConfig", "ServingError", "ShapeBucketBatcher",
-    "ShutdownError", "TinyDecodeLM", "default_buckets",
+    "MSG_HEALTH", "MSG_INFER", "ModelNotFoundError", "ModelRegistry",
+    "ModelVersion", "OverloadedError", "PrewarmFailedError",
+    "QuotaExceededError", "RegistryError", "Replica",
+    "ReplicaFailedError", "ReplicaPool", "Request",
+    "RolloutController", "RolloutError", "RolloutResult",
+    "SLOAutoscaler", "ServingConfig", "ServingError",
+    "ShapeBucketBatcher", "ShutdownError", "TenantQuota",
+    "TinyDecodeLM", "VersionNotFoundError", "default_buckets",
     "replicate_predictor_params", "signature_of",
 ]

@@ -1,5 +1,6 @@
-"""Admission control: bounded queue, typed load shedding, and the
-answered-exactly-once request future.
+"""Admission control: bounded queue, typed load shedding, per-tenant
+quotas with weighted-fair dequeue, and the answered-exactly-once
+request future.
 
 Contract (docs/SERVING.md): every request the server ADMITS is answered
 exactly once — with a result or with a typed ``ServingError`` — and
@@ -7,22 +8,32 @@ every request it does NOT admit is rejected synchronously with a typed
 error at submit().  Nothing is ever silently dropped; the counters here
 are the request-id accounting the acceptance test audits.
 
-The bounded queue + backpressure shape is the Communicator's
-(concurrency.BoundedQueue): over capacity, submit() raises
-``OverloadedError`` immediately instead of queueing work the deadline
-already condemned.
+Over capacity, submit() raises ``OverloadedError`` immediately instead
+of queueing work the deadline already condemned (the Communicator's
+backpressure shape).
+
+Multi-tenancy (ISSUE 13, docs/FLEET.md): requests may carry a
+``tenant`` key.  A tenant with a ``TenantQuota`` is admission-limited
+two ways — ``max_outstanding`` (admitted-but-unanswered cap) and a
+``qps`` token bucket (rate cap with ``burst`` depth) — and over-quota
+submits raise the typed ``QuotaExceededError`` (code ``quota``)
+WITHOUT consuming shared queue capacity.  Dequeue is weighted-fair
+(virtual-time WFQ over per-tenant lanes, ``TenantQuota.weight``), so
+one hot tenant saturating its lane cannot starve the others: under
+backlog every tenant drains in proportion to its weight.  Per-tenant
+outcomes ride ``paddle_tpu_serving_tenant_requests_total``
+{tenant, outcome} (bounded cardinality like every PR-9 instrument).
 """
 
 from __future__ import annotations
 
 import itertools
-import queue as queue_mod
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
-from paddle_tpu.concurrency import BoundedQueue
 from paddle_tpu.observability import metrics as _obs_metrics
 from paddle_tpu.observability import tracing as _trace
 
@@ -46,11 +57,18 @@ _M_REQ_SECONDS = _obs_metrics.histogram(
     "admitted-request latency (admission -> answered), by typed "
     "outcome — the p99-vs-deadline SLO reads this (observability/"
     "slo.py serving_latency)", max_series=16)
+_M_TENANT = _obs_metrics.counter(
+    "paddle_tpu_serving_tenant_requests_total",
+    "per-tenant admission outcomes (submitted / admitted / "
+    "rejected_quota / rejected_overloaded / answered_*) — recorded "
+    "only for requests that carry a tenant key; cardinality bounded "
+    "at max_series like every registry instrument (docs/FLEET.md)",
+    max_series=128)
 
 __all__ = [
     "ServingError", "OverloadedError", "DeadlineExpiredError",
-    "ShutdownError", "ReplicaFailedError", "Request",
-    "AdmissionController",
+    "ShutdownError", "ReplicaFailedError", "QuotaExceededError",
+    "TenantQuota", "Request", "AdmissionController",
 ]
 
 
@@ -89,6 +107,69 @@ class ReplicaFailedError(ServingError):
     code = "failed"
 
 
+class QuotaExceededError(ServingError):
+    """Rejected at admission: the request's TENANT is over its quota
+    (max outstanding, or the QPS token bucket is empty).  A quota shed
+    is policy, not failure — the caller's remedy is backoff, not
+    retry-elsewhere — which is why it gets its own typed code instead
+    of riding ``overloaded``."""
+
+    code = "quota"
+
+
+class TenantQuota:
+    """Per-tenant admission limits + fair-share weight.
+
+    ``max_outstanding``  cap on admitted-but-unanswered requests
+                         (None = unlimited)
+    ``qps``              sustained admission rate via a token bucket
+                         (None = unlimited); ``burst`` is the bucket
+                         depth (default: one second's worth, >= 1)
+    ``weight``           weighted-fair dequeue share under backlog
+                         (relative; default 1.0)
+    """
+
+    __slots__ = ("max_outstanding", "qps", "burst", "weight",
+                 "_tokens", "_refill_t", "_lock")
+
+    def __init__(self, max_outstanding=None, qps=None, burst=None,
+                 weight=1.0):
+        self.max_outstanding = None if max_outstanding is None \
+            else int(max_outstanding)
+        self.qps = None if qps is None else float(qps)
+        if self.qps is not None and self.qps <= 0:
+            raise ValueError("qps quota must be > 0")
+        self.burst = float(burst) if burst is not None \
+            else (max(1.0, self.qps) if self.qps is not None else 1.0)
+        self.weight = float(weight)
+        if self.weight <= 0:
+            raise ValueError("weight must be > 0")
+        self._tokens = self.burst
+        self._refill_t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_take_token(self, now=None):
+        """Consume one admission token; False when the bucket is empty
+        (the QPS shed).  No-op True when no qps quota is set."""
+        if self.qps is None:
+            return True
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._tokens = min(
+                self.burst,
+                self._tokens + (now - self._refill_t) * self.qps)
+            self._refill_t = now
+            if self._tokens < 1.0:
+                return False
+            self._tokens -= 1.0
+            return True
+
+    def to_dict(self):
+        return {"max_outstanding": self.max_outstanding,
+                "qps": self.qps, "burst": self.burst,
+                "weight": self.weight}
+
+
 class Request:
     """One admitted request: a future answered EXACTLY once.
 
@@ -98,12 +179,14 @@ class Request:
 
     __slots__ = ("id", "feeds", "rows", "deadline_t", "admitted_t",
                  "_event", "_lock", "_result", "_error", "_on_done",
-                 "done_t", "trace")
+                 "done_t", "trace", "tenant")
 
-    def __init__(self, req_id, feeds, rows, deadline_t, on_done=None):
+    def __init__(self, req_id, feeds, rows, deadline_t, on_done=None,
+                 tenant=None):
         self.id = req_id
         self.feeds = feeds            # {name: ndarray}, shared leading dim
         self.rows = int(rows)         # leading-dim extent
+        self.tenant = tenant          # quota/fairness key (None = default)
         self.deadline_t = float(deadline_t)
         self.admitted_t = time.monotonic()
         self.done_t = None
@@ -163,21 +246,40 @@ class Request:
 
 
 class AdmissionController:
-    """Bounded admission queue + typed shedding + request accounting."""
+    """Bounded admission queue + typed shedding + per-tenant quotas +
+    weighted-fair dequeue + request accounting.
 
-    def __init__(self, capacity=64, default_deadline_s=1.0):
+    The queue is per-tenant lanes drained by virtual-time weighted
+    fair queuing: each lane carries a virtual finish time advanced by
+    1/weight per dequeued request, ``take()`` serves the non-empty
+    lane with the smallest virtual time, and a lane going from empty
+    to non-empty joins at the scheduler's current virtual clock (no
+    banked credit for idle tenants).  With a single (default) lane
+    this degenerates to exact FIFO — the pre-fleet behavior."""
+
+    def __init__(self, capacity=64, default_deadline_s=1.0,
+                 quotas=None):
         self.capacity = int(capacity)
         self.default_deadline_s = float(default_deadline_s)
-        self._queue = BoundedQueue(maxsize=self.capacity)
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._outstanding: dict = {}     # id -> Request (admitted, unanswered)
         self._draining = False
+        # WFQ lanes (tenant key None = the default lane "")
+        self._lanes: dict = {}           # lane -> deque[Request]
+        self._vtime: dict = {}           # lane -> virtual finish time
+        self._vclock = 0.0               # virtual time of last service
+        self._depth = 0                  # total queued across lanes
+        self._not_empty = threading.Condition(self._lock)
+        self._quotas: dict = dict(quotas or {})   # tenant -> TenantQuota
+        self._tenant_outstanding: dict = {}       # tenant -> count
+        self._tenant_counters: dict = {}          # tenant -> {k: n}
         self._counters = {
             "admitted": 0,
             "rejected_overloaded": 0,    # never admitted (typed raise)
             "rejected_expired": 0,
             "rejected_shutdown": 0,
+            "rejected_quota": 0,         # tenant over its quota
             "answered_ok": 0,            # admitted -> success
             "answered_expired": 0,       # admitted -> typed error, by code
             "answered_shutdown": 0,
@@ -185,10 +287,42 @@ class AdmissionController:
             "answered_error": 0,
         }
 
+    # -- tenant quotas ------------------------------------------------------
+    def set_quota(self, tenant, quota):
+        """Install/replace (or with None, remove) a tenant's quota —
+        takes effect on the next submit."""
+        with self._lock:
+            if quota is None:
+                self._quotas.pop(tenant, None)
+            else:
+                self._quotas[tenant] = quota
+
+    def quotas(self):
+        with self._lock:
+            return dict(self._quotas)
+
+    def _tenant_count(self, tenant, key, n=1):
+        if tenant is None:
+            return
+        with self._lock:
+            c = self._tenant_counters.setdefault(tenant, {})
+            c[key] = c.get(key, 0) + n
+        _M_TENANT.inc(n, tenant=str(tenant), outcome=key)
+
+    def tenant_counters(self):
+        """Per-tenant outcome counts, {tenant: {outcome: n}} — the
+        load generator's per-tenant rows read this."""
+        with self._lock:
+            return {t: dict(c) for t, c in
+                    self._tenant_counters.items()}
+
     # -- submit side --------------------------------------------------------
-    def submit(self, feeds, deadline_s=None, request_id=None):
+    def submit(self, feeds, deadline_s=None, request_id=None,
+               tenant=None):
         """Admit a request or raise a typed ServingError.  feeds:
-        {name: ndarray} with a shared leading (batch) dim.
+        {name: ndarray} with a shared leading (batch) dim; ``tenant``
+        keys quota enforcement and fair dequeue (None = default lane,
+        never quota-limited).
 
         When tracing is on, admission runs under a
         ``serving.admission`` span (child of the caller's
@@ -197,13 +331,16 @@ class AdmissionController:
         so ONE trace id covers the request end to end."""
         if _trace._tracer is not None:
             with _trace._tracer.span("serving.admission") as sp:
-                req = self._submit_inner(feeds, deadline_s, request_id)
+                req = self._submit_inner(feeds, deadline_s, request_id,
+                                         tenant)
                 sp.set_attr("request_id", req.id)
                 req.trace = sp.ctx
                 return req
-        return self._submit_inner(feeds, deadline_s, request_id)
+        return self._submit_inner(feeds, deadline_s, request_id,
+                                  tenant)
 
-    def _submit_inner(self, feeds, deadline_s, request_id):
+    def _submit_inner(self, feeds, deadline_s, request_id, tenant):
+        self._tenant_count(tenant, "submitted")
         if self._draining:
             self._count("rejected_shutdown")
             raise ShutdownError("server is draining: not admitting")
@@ -214,6 +351,27 @@ class AdmissionController:
             self._count("rejected_expired")
             raise DeadlineExpiredError(
                 f"deadline {deadline_s:g}s already expired at submit")
+        quota = self._quotas.get(tenant) if tenant is not None \
+            else None
+        if quota is not None:
+            # quota sheds happen BEFORE capacity is consumed: an
+            # over-quota tenant cannot displace in-quota traffic
+            if quota.max_outstanding is not None:
+                with self._lock:
+                    over = self._tenant_outstanding.get(tenant, 0) \
+                        >= quota.max_outstanding
+                if over:
+                    self._count("rejected_quota")
+                    self._tenant_count(tenant, "rejected_quota")
+                    raise QuotaExceededError(
+                        f"tenant '{tenant}' at max_outstanding "
+                        f"{quota.max_outstanding}: quota shed")
+            if not quota.try_take_token(now):
+                self._count("rejected_quota")
+                self._tenant_count(tenant, "rejected_quota")
+                raise QuotaExceededError(
+                    f"tenant '{tenant}' QPS token bucket empty "
+                    f"(qps {quota.qps:g}): quota shed")
         rows = None
         for name, arr in feeds.items():
             arr = np.asarray(arr)
@@ -229,31 +387,82 @@ class AdmissionController:
         req = Request(
             request_id if request_id is not None else next(self._ids),
             {n: np.asarray(v) for n, v in feeds.items()},
-            rows, now + deadline_s, on_done=self._on_done)
-        try:
-            self._queue.put(req, block=False)
-        except queue_mod.Full:
-            self._count("rejected_overloaded")
+            rows, now + deadline_s, on_done=self._on_done,
+            tenant=tenant)
+        lane = "" if tenant is None else tenant
+        with self._lock:
+            if self._depth >= self.capacity:
+                self._counters["rejected_overloaded"] += 1
+                full = True
+            else:
+                full = False
+                dq = self._lanes.get(lane)
+                if dq is None:
+                    dq = self._lanes[lane] = deque()
+                if not dq:
+                    # joining lane starts at the current virtual
+                    # clock: idle tenants bank no credit
+                    self._vtime[lane] = max(
+                        self._vtime.get(lane, 0.0), self._vclock)
+                dq.append(req)
+                self._depth += 1
+                self._outstanding[req.id] = req
+                self._counters["admitted"] += 1
+                if tenant is not None:
+                    self._tenant_outstanding[tenant] = \
+                        self._tenant_outstanding.get(tenant, 0) + 1
+                _M_OUTSTANDING.set(len(self._outstanding))
+                self._not_empty.notify()
+        if full:
+            _M_REQS.inc(outcome="rejected_overloaded")
+            self._tenant_count(tenant, "rejected_overloaded")
             raise OverloadedError(
                 f"admission queue full (capacity {self.capacity}): "
                 "load shed") from None
-        with self._lock:
-            self._outstanding[req.id] = req
-            self._counters["admitted"] += 1
-            _M_OUTSTANDING.set(len(self._outstanding))
         _M_REQS.inc(outcome="admitted")
-        _M_DEPTH.set(self._queue.qsize())
+        self._tenant_count(tenant, "admitted")
+        _M_DEPTH.set(self._depth)
+        return req
+
+    def _lane_weight(self, lane):
+        q = self._quotas.get(lane if lane != "" else None)
+        return q.weight if q is not None else 1.0
+
+    def _pop_locked(self):
+        """WFQ pop under self._lock; None when every lane is empty."""
+        best = None
+        for lane, dq in self._lanes.items():
+            if dq and (best is None
+                       or self._vtime[lane] < self._vtime[best]):
+                best = lane
+        if best is None:
+            return None
+        req = self._lanes[best].popleft()
+        self._depth -= 1
+        self._vclock = self._vtime[best]
+        self._vtime[best] += 1.0 / self._lane_weight(best)
         return req
 
     # -- batcher side -------------------------------------------------------
     def take(self, timeout=0.002):
-        """Pop the next admitted request (None on timeout)."""
-        try:
-            req = self._queue.get(timeout=timeout)
-        except queue_mod.Empty:
-            return None
-        _M_DEPTH.set(self._queue.qsize())
+        """Pop the next admitted request — weighted-fair across tenant
+        lanes (None on timeout)."""
+        deadline = time.monotonic() + float(timeout)
+        with self._not_empty:
+            while True:
+                req = self._pop_locked()
+                if req is not None:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._not_empty.wait(remaining)
+        _M_DEPTH.set(self._depth)
         return req
+
+    def qsize(self):
+        with self._lock:
+            return self._depth
 
     # -- drain / accounting -------------------------------------------------
     def start_drain(self):
@@ -286,6 +495,12 @@ class AdmissionController:
     def _on_done(self, req, exc):
         with self._lock:
             self._outstanding.pop(req.id, None)
+            if req.tenant is not None:
+                n = self._tenant_outstanding.get(req.tenant, 1) - 1
+                if n <= 0:
+                    self._tenant_outstanding.pop(req.tenant, None)
+                else:
+                    self._tenant_outstanding[req.tenant] = n
             _M_OUTSTANDING.set(len(self._outstanding))
             if exc is None:
                 key = "answered_ok"
@@ -296,6 +511,7 @@ class AdmissionController:
                     else "error")
             self._counters[key] += 1
         _M_REQS.inc(outcome=key)
+        self._tenant_count(req.tenant, key)
         lat = req.latency_s()
         if lat is not None:
             # exemplar (ISSUE 12): the delivery thread has no span

@@ -82,6 +82,17 @@ class Replica:
         self.last_health_t = None
         self.batches = 0
         self.failures = 0
+        # fleet state (ISSUE 13): ``paused`` makes the worker stop
+        # taking NEW batches (the per-replica drain the rollout and
+        # scale-down ride); ``busy`` is set around batch execution so
+        # a quiesce can wait for the in-flight batch; ``retired``
+        # permanently ends the worker (scale-down) — never resurrected
+        # by restart_dead; ``version`` is the registry tag the rollout
+        # controller maintains (None outside fleet serving)
+        self.paused = False
+        self.busy = False
+        self.retired = False
+        self.version = None
         self._consec_fails = 0
         self._open_until = 0.0
         self._threshold = int(breaker_threshold)
@@ -212,6 +223,9 @@ class Replica:
         with self._lock:
             return {
                 "alive": self.alive,
+                "paused": self.paused,
+                "version": None if self.version is None
+                else str(self.version),
                 "batches": self.batches,
                 "failures": self.failures,
                 "breaker": {
@@ -235,17 +249,29 @@ class ReplicaPool:
                  dispatch_capacity=8, breaker_threshold=3,
                  breaker_cooldown_s=0.5, health_interval_s=None,
                  restart_dead=True, max_batch_attempts=None,
-                 restart_backoff=0.05):
+                 restart_backoff=0.05, health_failures=None):
         """predictor_factory(i) -> a Predictor for replica i (each
         replica owns its predictor: private scope + compile cache).
         restart_dead=False leaves a killed replica down — pure
-        failover, the acceptance-test mode."""
+        failover, the acceptance-test mode.  ``health_failures`` is
+        the probe-flake tolerance: a replica's breaker only sees a
+        probe failure after this many CONSECUTIVE probe failures
+        (default PADDLE_TPU_HEALTH_FAILURES or 2 — one seeded delayed
+        probe must not kill a healthy replica)."""
+        import os
+
         self._factory = predictor_factory
         self._restart_dead = bool(restart_dead)
         self._max_attempts = int(max_batch_attempts) \
             if max_batch_attempts is not None else 2 * n_replicas + 1
         self._health_interval = health_probe_interval(1.0) \
             if health_interval_s is None else float(health_interval_s)
+        if health_failures is None:
+            health_failures = int(os.environ.get(
+                "PADDLE_TPU_HEALTH_FAILURES", "2"))
+        self._health_failures = max(1, int(health_failures))
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown = float(breaker_cooldown_s)
         self.dispatch = BoundedQueue(maxsize=dispatch_capacity)
         # failover lane: UNBOUNDED on purpose — a worker must never
         # block requeueing into a full dispatch queue that only itself
@@ -258,6 +284,7 @@ class ReplicaPool:
                     breaker_threshold=breaker_threshold,
                     breaker_cooldown_s=breaker_cooldown_s)
             for i in range(int(n_replicas))]
+        self._next_index = int(n_replicas)
         self._sup = Supervisor(restart_backoff=restart_backoff,
                                max_backoff=1.0)
         for rep in self.replicas:
@@ -267,6 +294,7 @@ class ReplicaPool:
         self._sup.add_worker("health", self._health_loop, restart=True)
         self._lock = threading.Lock()
         self._in_flight = 0
+        self._probe_fails: dict = {}   # replica index -> consecutive
         self._counters = {"batches_ok": 0, "batches_failed": 0,
                           "requeues": 0, "probes": 0,
                           "probe_failures": 0, "shed_expired_batches": 0}
@@ -304,6 +332,104 @@ class ReplicaPool:
         with self._lock:
             return dict(self._counters)
 
+    # -- fleet operations (ISSUE 13) ----------------------------------------
+    def replica(self, index):
+        for r in self.replicas:
+            if r.index == index:
+                return r
+        raise KeyError(f"no replica with index {index}")
+
+    def quiesce_replica(self, index, timeout=10.0):
+        """Per-replica drain: stop the replica taking NEW batches and
+        wait for its in-flight batch to finish.  Returns the quiesced
+        Replica; on timeout the pause is reverted and TimeoutError
+        raised (the replica keeps serving — a failed quiesce must not
+        half-drain the fleet)."""
+        rep = self.replica(index)
+        rep.paused = True
+        deadline = time.monotonic() + float(timeout)
+        while rep.busy:
+            if time.monotonic() > deadline:
+                rep.paused = False
+                raise TimeoutError(
+                    f"replica {index}: batch still in flight after "
+                    f"{timeout:g}s quiesce")
+            time.sleep(0.002)
+        return rep
+
+    def resume_replica(self, index):
+        self.replica(index).paused = False
+
+    def swap_predictor(self, index, source, version=None,
+                       timeout=10.0):
+        """The rollout primitive: quiesce replica ``index`` through
+        the per-replica drain, hot-swap its predictor onto ``source``
+        (a prewarm-compiled Predictor or a ``program_state()``
+        snapshot — inference.Predictor.swap_program), tag it with
+        ``version``, resume.  Returns (prior_state, prior_version)
+        for rollback.  Zero requests are dropped: new batches flow to
+        the other replicas while this one drains (or wait in dispatch
+        when it is the only one)."""
+        rep = self.quiesce_replica(index, timeout=timeout)
+        try:
+            prior = rep.predictor.swap_program(source)
+            prior_version, rep.version = rep.version, version
+            self._count(swaps=1)
+            _flight.record("fleet", "replica_swapped", replica=index,
+                           version=str(version),
+                           prior=str(prior_version))
+            return prior, prior_version
+        finally:
+            rep.paused = False
+
+    def add_replica(self, version=None):
+        """Scale up: build a new replica from the predictor factory
+        (fresh index, never reused) and start its worker.  Returns the
+        new replica's index."""
+        with self._lock:
+            idx = self._next_index
+            self._next_index += 1
+        rep = Replica(idx, self._factory(idx),
+                      breaker_threshold=self._breaker_threshold,
+                      breaker_cooldown_s=self._breaker_cooldown)
+        rep.version = version
+        self.replicas.append(rep)
+        self._sup.add_worker("replica-%d" % idx,
+                             self._make_worker(rep),
+                             restart=self._restart_dead)
+        self._count(scale_ups=1)
+        _M_LIVE.set(len(self.live_replicas()))
+        _flight.record("fleet", "replica_added", replica=idx,
+                       live=len(self.live_replicas()))
+        return idx
+
+    def remove_replica(self, index=None, timeout=10.0, force=False):
+        """Scale down THROUGH GRACEFUL DRAIN: quiesce the replica
+        (its in-flight batch finishes and is delivered), then retire
+        it permanently (never resurrected by restart_dead).  Default
+        victim: the newest live replica.  Refuses to remove the last
+        live replica unless ``force`` — a fleet of zero answers
+        nobody."""
+        live = [r for r in self.replicas if r.alive and not r.retired]
+        if index is None:
+            if not live:
+                raise RuntimeError("no live replica to remove")
+            index = live[-1].index
+        if len(live) <= 1 and not force:
+            raise RuntimeError(
+                "refusing to remove the last live replica "
+                "(force=True overrides)")
+        rep = self.quiesce_replica(index, timeout=timeout)
+        rep.retired = True
+        rep.alive = False
+        self._sup.remove_worker("replica-%d" % index)
+        self.replicas.remove(rep)
+        self._count(scale_downs=1)
+        _M_LIVE.set(len(self.live_replicas()))
+        _flight.record("fleet", "replica_removed", replica=index,
+                       live=len(self.live_replicas()))
+        return index
+
     def stats(self):
         now = time.monotonic()
         st = {"replicas": {r.index: r.stats(now)
@@ -320,13 +446,22 @@ class ReplicaPool:
         def loop():
             # a supervisor restart of this loop IS the replica relaunch
             # (restart_dead=True); with restart_dead=False the
-            # supervisor never respawns it and the replica stays down
+            # supervisor never respawns it and the replica stays down.
+            # A RETIRED replica (scale-down) is never resurrected.
+            if rep.retired:
+                return
             if not rep.alive and self._restart_dead:
                 rep.alive = True
                 rep.record_ok()
             while self._sup.running:
-                if not rep.alive:
+                if not rep.alive or rep.retired:
                     return
+                if rep.paused:
+                    # per-replica drain (rollout swap / scale-down):
+                    # stop taking NEW batches; in-flight work was
+                    # already counted via rep.busy
+                    time.sleep(0.002)
+                    continue
                 try:                      # failover lane first
                     batch = self._retry.get_nowait()
                 except queue_mod.Empty:
@@ -334,6 +469,12 @@ class ReplicaPool:
                         batch = self.dispatch.get(timeout=0.01)
                     except queue_mod.Empty:
                         continue
+                if rep.paused or rep.retired:
+                    # pause raced the take: hand the batch on rather
+                    # than run it — the quiesce contract is "no NEW
+                    # batch starts after pause"
+                    self._retry.put(batch)
+                    continue
                 if not rep.available():
                     # breaker open: hand the batch to a healthier
                     # replica; brief sleep avoids a requeue spin when
@@ -350,6 +491,7 @@ class ReplicaPool:
                     continue
                 with self._lock:
                     self._in_flight += 1
+                rep.busy = True
                 t0 = time.perf_counter()
                 try:
                     outs = rep.run(batch)
@@ -371,6 +513,7 @@ class ReplicaPool:
                     batch.deliver(outs)
                     self._count(batches_ok=1)
                 finally:
+                    rep.busy = False
                     with self._lock:
                         self._in_flight -= 1
 
@@ -393,17 +536,28 @@ class ReplicaPool:
 
     def _health_loop(self):
         while self._sup.running:
-            for rep in self.replicas:
+            for rep in list(self.replicas):
                 if not self._sup.running:
                     return
-                if not rep.alive:
+                if not rep.alive or rep.retired:
                     continue
                 self._count(probes=1)
                 try:
                     rep.health()
                 except Exception:
-                    rep.record_failure()
+                    # probe-flake tolerance (ISSUE 13 satellite): only
+                    # K CONSECUTIVE probe failures reach the breaker —
+                    # one seeded delayed/dropped probe must not kill a
+                    # healthy replica (PADDLE_TPU_HEALTH_FAILURES)
+                    n = self._probe_fails.get(rep.index, 0) + 1
+                    self._probe_fails[rep.index] = n
                     self._count(probe_failures=1)
+                    if n >= self._health_failures:
+                        rep.record_failure()
+                    else:
+                        self._count(probe_flakes_tolerated=1)
+                else:
+                    self._probe_fails[rep.index] = 0
             t = time.monotonic() + self._health_interval
             while self._sup.running and time.monotonic() < t:
                 time.sleep(min(0.02, self._health_interval))

@@ -48,7 +48,7 @@ class ServingConfig:
                  health_interval_s=None, restart_dead=True,
                  max_batch_attempts=None, drain_timeout_s=30.0,
                  prewarm=None, metrics_port=None, trace_sample=None,
-                 collector=None):
+                 collector=None, quotas=None, health_failures=None):
         self.max_batch = int(max_batch)
         self.buckets = tuple(buckets) if buckets is not None \
             else default_buckets(self.max_batch)
@@ -112,6 +112,17 @@ class ServingConfig:
 
             collector = collector_endpoint()
         self.collector = collector
+        # multi-tenant fleet (ISSUE 13): per-tenant admission quotas
+        # ({tenant: TenantQuota | {max_outstanding/qps/burst/weight}
+        # dict}) and the probe-flake tolerance K (docs/FLEET.md)
+        if quotas:
+            from paddle_tpu.serving.admission import TenantQuota
+
+            quotas = {t: (q if isinstance(q, TenantQuota)
+                          else TenantQuota(**q))
+                      for t, q in quotas.items()}
+        self.quotas = quotas or None
+        self.health_failures = health_failures
 
 
 class InferenceServer:
@@ -125,7 +136,8 @@ class InferenceServer:
         self.config = cfg = config or ServingConfig()
         self.admission = AdmissionController(
             capacity=cfg.queue_capacity,
-            default_deadline_s=cfg.default_deadline_s)
+            default_deadline_s=cfg.default_deadline_s,
+            quotas=cfg.quotas)
         self.pool = ReplicaPool(
             predictor_factory, n_replicas=cfg.n_replicas,
             dispatch_capacity=cfg.dispatch_capacity,
@@ -133,7 +145,11 @@ class InferenceServer:
             breaker_cooldown_s=cfg.breaker_cooldown_s,
             health_interval_s=cfg.health_interval_s,
             restart_dead=cfg.restart_dead,
-            max_batch_attempts=cfg.max_batch_attempts)
+            max_batch_attempts=cfg.max_batch_attempts,
+            health_failures=cfg.health_failures)
+        # the registry version currently serving (set by the fleet
+        # RolloutController; None for a single anonymous model)
+        self.model_version = None
         self.batcher = ShapeBucketBatcher(
             self.admission, self.pool.dispatch, buckets=cfg.buckets,
             max_wait_s=cfg.max_wait_s)
@@ -207,12 +223,15 @@ class InferenceServer:
         return False
 
     # -- request path -------------------------------------------------------
-    def submit(self, feeds, deadline_s=None, request_id=None):
+    def submit(self, feeds, deadline_s=None, request_id=None,
+               tenant=None):
         """Admit a request; returns a Request future.  Raises a typed
         ServingError synchronously when the request is NOT admitted
-        (overloaded / expired / shutdown / no live replicas) and
-        FeedValidationError when the feeds don't match the program's
-        feed targets (a malformed request must never poison a batch).
+        (overloaded / expired / shutdown / over tenant quota / no live
+        replicas) and FeedValidationError when the feeds don't match
+        the program's feed targets (a malformed request must never
+        poison a batch).  ``tenant`` keys quota enforcement and
+        weighted-fair dequeue (docs/FLEET.md).
 
         When tracing is on, this is the ROOT span of the request's
         trace (``serving.submit``): admission / batch / replica /
@@ -221,10 +240,11 @@ class InferenceServer:
             with _trace._tracer.span("serving.submit",
                                      request_id=request_id):
                 return self._submit_inner(feeds, deadline_s,
-                                          request_id)
-        return self._submit_inner(feeds, deadline_s, request_id)
+                                          request_id, tenant)
+        return self._submit_inner(feeds, deadline_s, request_id,
+                                  tenant)
 
-    def _submit_inner(self, feeds, deadline_s, request_id):
+    def _submit_inner(self, feeds, deadline_s, request_id, tenant):
         if not self._started or self._stopped:
             self.admission._count("rejected_shutdown")
             raise ShutdownError("server not running")
@@ -236,12 +256,18 @@ class InferenceServer:
         if self._validator is not None:
             feeds = self._validator.validate_feeds(feeds)
         return self.admission.submit(feeds, deadline_s=deadline_s,
-                                     request_id=request_id)
+                                     request_id=request_id,
+                                     tenant=tenant)
 
-    def infer(self, feeds, deadline_s=None, timeout=None):
+    def infer(self, feeds, deadline_s=None, timeout=None,
+              tenant=None):
         """Synchronous convenience: submit + result."""
-        req = self.submit(feeds, deadline_s=deadline_s)
+        req = self.submit(feeds, deadline_s=deadline_s, tenant=tenant)
         return req.result(timeout=timeout)
+
+    def set_quota(self, tenant, quota):
+        """Install/replace/remove (None) a tenant quota at runtime."""
+        self.admission.set_quota(tenant, quota)
 
     # -- shutdown -----------------------------------------------------------
     def drain(self, timeout=None):
@@ -297,5 +323,8 @@ class InferenceServer:
             == c["admitted"],
             "batcher": self.batcher.stats(),
             "pool": self.pool.stats(),
+            "tenants": self.admission.tenant_counters(),
+            "model_version": None if self.model_version is None
+            else str(self.model_version),
             "draining": self.admission.draining,
         }

@@ -1,0 +1,729 @@
+"""Multi-tenant model fleet suite (ISSUE 13): versioned registry,
+zero-downtime rolling rollout, per-tenant quotas with weighted-fair
+dequeue, and the SLO-actuated autoscaler.
+
+Covers: registry versioning + fingerprint dedupe + typed errors, the
+Predictor program-swap primitive, per-tenant max-outstanding and QPS
+token-bucket quotas with typed QuotaExceededError + bounded per-tenant
+metric labels, virtual-time weighted-fair dequeue (exact share ratios,
+no starvation), rollout under live traffic (zero drops, converged
+fingerprint), prewarm-failure leaving the old version serving,
+burn-triggered rollback restoring the EXACT old program fingerprint
+(under a chaos plan too), autoscaler scale-up on sustained burn /
+hysteresis on a seeded oscillating load / scale-down through graceful
+drain / min-max clamps + cooldown, the health-probe flake-tolerance
+satellite (K consecutive failures before the breaker; faultinject
+delay regression), the per-tenant serving_load contract, and (slow
+lane) THE acceptance legs — seeded kill-a-replica-mid-rollout chaos
+with exactly-once accounting + the overload leg actuating the
+autoscaler, and tenant isolation under overload (quota-respecting
+tenant keeps >= 90% goodput).
+"""
+
+import importlib.util
+import os
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import inference, layers, serving
+from paddle_tpu.distributed import faultinject
+from paddle_tpu.distributed.faultinject import FaultPlan
+from paddle_tpu.observability import metrics as obs_metrics
+
+
+def _tools_mod(name):
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _build_model(dirname, hidden=16, in_dim=8):
+    """Save a tiny fc inference model (fresh program each call so two
+    builds in one test don't share graphs); returns the model dir."""
+    fluid.framework.switch_main_program(fluid.Program())
+    fluid.framework.switch_startup_program(fluid.Program())
+    x = layers.data("x", shape=[in_dim], dtype="float32")
+    h = layers.fc(x, size=hidden, act="relu")
+    pred = layers.fc(h, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = os.path.join(str(dirname), "model_h%d" % hidden)
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    return d
+
+
+def _factory(model_dir):
+    return lambda i: inference.create_predictor(
+        inference.Config(model_dir))
+
+
+class _StubPredictor:
+    """Predictor stand-in for pool-only tests (health probes never
+    touch the predictor)."""
+
+    def run(self, feeds):
+        return feeds
+
+    def feed_specs(self):
+        return {}
+
+    def get_input_names(self):
+        return []
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_versioning_dedupe_and_typed_errors(tmp_path):
+    """Versions are monotonic per name, deduped by program
+    fingerprint (same dir twice -> the SAME ModelVersion object), and
+    lookups fail with typed RegistryError subclasses carrying stable
+    codes."""
+    d1 = _build_model(tmp_path, hidden=16)
+    d2 = _build_model(tmp_path, hidden=24)
+    reg = serving.ModelRegistry()
+    v1 = reg.register("m", d1)
+    v2 = reg.register("m", d2)
+    assert (v1.version, v2.version) == (1, 2)
+    assert v1.fingerprint != v2.fingerprint
+    assert reg.register("m", d1) is v1          # fingerprint dedupe
+    assert len(reg.versions("m")) == 2
+    assert reg.get("m") is v2                   # latest
+    assert reg.get("m", 1) is v1
+    assert reg.models() == ["m"]
+
+    with pytest.raises(serving.ModelNotFoundError) as ei:
+        reg.get("nope")
+    assert ei.value.code == "model_not_found"
+    assert isinstance(ei.value, serving.ServingError)
+    with pytest.raises(serving.VersionNotFoundError) as ei:
+        reg.get("m", 9)
+    assert ei.value.code == "version_not_found"
+    # a dir that is not a saved model is a typed registry error
+    with pytest.raises(serving.RegistryError):
+        reg.register("bad", str(tmp_path))
+    # prewarm compiles + records the serving fingerprint
+    p = v1.prewarm(buckets=(1, 2))
+    assert v1.prewarmed and v1.serving_fingerprint is not None
+    assert p.program_fingerprint() == v1.serving_fingerprint
+
+
+def test_registry_register_program_serializes(tmp_path):
+    """register_program rides io.save_inference_model into the
+    registry root and the result round-trips through a predictor."""
+    fluid.framework.switch_main_program(fluid.Program())
+    fluid.framework.switch_startup_program(fluid.Program())
+    x = layers.data("x", shape=[4], dtype="float32")
+    pred = layers.fc(x, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reg = serving.ModelRegistry(root=str(tmp_path / "reg"))
+    v = reg.register_program("prog", ["x"], [pred], exe)
+    assert v.version == 1 and os.path.isdir(v.model_dir)
+    out, = v.make_predictor().run(
+        [np.ones((2, 4), np.float32)])
+    assert np.asarray(out).shape == (2, 1)
+    # a root-less registry refuses program registration, typed
+    with pytest.raises(serving.RegistryError):
+        serving.ModelRegistry().register_program(
+            "p", ["x"], [pred], exe)
+
+
+def test_predictor_swap_program_and_fingerprint(tmp_path):
+    """The rollout primitive: swap_program replaces the loaded
+    program IN PLACE (object identity preserved) and the returned
+    prior state restores the exact old fingerprint."""
+    d1 = _build_model(tmp_path, hidden=16)
+    d2 = _build_model(tmp_path, hidden=24)
+    p1 = inference.create_predictor(inference.Config(d1))
+    p2 = inference.create_predictor(inference.Config(d2))
+    fp1, fp2 = p1.program_fingerprint(), p2.program_fingerprint()
+    assert fp1 != fp2
+    x = np.ones((2, 8), np.float32)
+    out2_direct, = p2.run([x])
+    prior = p1.swap_program(p2)
+    assert p1.program_fingerprint() == fp2
+    out_swapped, = p1.run([x])
+    np.testing.assert_array_equal(np.asarray(out_swapped),
+                                  np.asarray(out2_direct))
+    p1.swap_program(prior)                      # rollback
+    assert p1.program_fingerprint() == fp1
+    with pytest.raises(ValueError):
+        p1.swap_program({"_program": None})     # malformed state
+
+
+# ---------------------------------------------------------------------------
+# per-tenant quotas + weighted-fair dequeue
+# ---------------------------------------------------------------------------
+
+def test_quota_max_outstanding_typed_shed_and_metrics():
+    """A tenant at max_outstanding sheds with the typed
+    QuotaExceededError (code 'quota'), other tenants are untouched,
+    and the per-tenant instrument carries bounded tenant labels."""
+    ac = serving.AdmissionController(
+        capacity=32, default_deadline_s=10.0,
+        quotas={"a": serving.TenantQuota(max_outstanding=2)})
+    feeds = {"x": np.zeros((1, 2), np.float32)}
+    r1 = ac.submit(feeds, tenant="a")
+    r2 = ac.submit(feeds, tenant="a")
+    with pytest.raises(serving.QuotaExceededError) as ei:
+        ac.submit(feeds, tenant="a")
+    assert ei.value.code == "quota"
+    assert isinstance(ei.value, serving.ServingError)
+    # unlimited tenants and the default lane are unaffected
+    ac.submit(feeds, tenant="b")
+    ac.submit(feeds)
+    assert ac.counters()["rejected_quota"] == 1
+    # answering frees the slot
+    r1.complete([np.zeros((1, 1))])
+    r3 = ac.submit(feeds, tenant="a")
+    assert r3.tenant == "a"
+    tc = ac.tenant_counters()
+    assert tc["a"]["rejected_quota"] == 1
+    assert tc["a"]["admitted"] == 3 and tc["b"]["admitted"] == 1
+    inst = obs_metrics.registry().get(
+        "paddle_tpu_serving_tenant_requests_total")
+    labels = {(ls.get("tenant"), ls.get("outcome"))
+              for ls, _ in inst.items()}
+    assert ("a", "rejected_quota") in labels
+    assert ("a", "admitted") in labels
+    _ = r2
+
+
+def test_quota_qps_token_bucket():
+    """The QPS quota is a token bucket: a burst drains it (typed
+    shed), elapsed time refills it."""
+    q = serving.TenantQuota(qps=100.0, burst=2)
+    ac = serving.AdmissionController(
+        capacity=64, default_deadline_s=10.0, quotas={"t": q})
+    feeds = {"x": np.zeros((1, 2), np.float32)}
+    ac.submit(feeds, tenant="t")
+    ac.submit(feeds, tenant="t")
+    with pytest.raises(serving.QuotaExceededError):
+        ac.submit(feeds, tenant="t")
+    time.sleep(0.03)                 # ~3 tokens at 100/s
+    ac.submit(feeds, tenant="t")
+    assert ac.counters()["rejected_quota"] == 1
+    with pytest.raises(ValueError):
+        serving.TenantQuota(qps=0.0)
+    with pytest.raises(ValueError):
+        serving.TenantQuota(weight=0.0)
+
+
+def test_weighted_fair_dequeue_shares_and_no_starvation():
+    """Under backlog the WFQ dequeue serves tenants in proportion to
+    their weights (exact with deterministic virtual time) and a light
+    tenant is served immediately despite a hot tenant's deep lane."""
+    ac = serving.AdmissionController(
+        capacity=256, default_deadline_s=30.0,
+        quotas={"hot": serving.TenantQuota(weight=3.0),
+                "light": serving.TenantQuota(weight=1.0)})
+    feeds = {"x": np.zeros((1, 2), np.float32)}
+    hot = [ac.submit(feeds, tenant="hot") for _ in range(60)]
+    light = [ac.submit(feeds, tenant="light") for _ in range(20)]
+    first40 = [ac.take(timeout=0.1) for _ in range(40)]
+    counts = {"hot": 0, "light": 0}
+    for req in first40:
+        counts[req.tenant] += 1
+    # weight 3:1 -> 30/10 in the first 40 pops (virtual time exact)
+    assert counts == {"hot": 30, "light": 10}, counts
+    # no starvation: a light request appears within the first pops
+    assert any(r.tenant == "light" for r in first40[:4])
+    rest = [ac.take(timeout=0.1) for _ in range(40)]
+    for req in first40 + rest:
+        req.complete([np.zeros((1, 1))])
+    _ = hot, light
+
+
+def test_default_lane_fifo_unchanged():
+    """Without tenants the controller is exact FIFO — the pre-fleet
+    contract."""
+    ac = serving.AdmissionController(capacity=16,
+                                     default_deadline_s=10.0)
+    feeds = {"x": np.zeros((1, 2), np.float32)}
+    ids = [ac.submit(feeds).id for _ in range(8)]
+    popped = [ac.take(timeout=0.1).id for _ in range(8)]
+    assert popped == ids
+    assert ac.take(timeout=0.01) is None
+
+
+# ---------------------------------------------------------------------------
+# rolling rollout
+# ---------------------------------------------------------------------------
+
+def test_rollout_zero_drop_under_live_traffic(tmp_path):
+    """A rolling v1 -> v2 swap with traffic in flight: every request
+    answered (exactly-once accounting holds), the fleet converges on
+    v2's serving fingerprint, and outputs after the swap come from
+    the NEW model."""
+    d1 = _build_model(tmp_path, hidden=16)
+    d2 = _build_model(tmp_path, hidden=24)
+    reg = serving.ModelRegistry()
+    reg.register("m", d1)
+    v2 = reg.register("m", d2)
+    cfg = serving.ServingConfig(n_replicas=2, max_batch=4,
+                                default_deadline_s=10.0)
+    with serving.InferenceServer(_factory(d1), cfg) as srv:
+        probe = np.ones((1, 8), np.float32)
+        before, = srv.infer({"x": probe})
+        oracle2, = v2.prewarm(buckets=(1,)).run([probe])
+        stop = threading.Event()
+        futures = []
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    futures.append(srv.submit({"x": probe}))
+                except serving.ServingError:
+                    pass
+                time.sleep(0.002)
+
+        th = threading.Thread(target=pump, daemon=True)
+        th.start()
+        time.sleep(0.03)
+        res = serving.RolloutController(srv, reg).rollout("m")
+        stop.set()
+        th.join(timeout=5.0)
+        assert res.converged and res.swapped == 2
+        for f in futures:
+            f.result(timeout=10.0)     # every admitted answered ok
+        st = srv.stats()
+        assert st["accounted"] and st["outstanding"] == 0
+        for r in srv.pool.replicas:
+            assert r.predictor.program_fingerprint() == \
+                v2.serving_fingerprint
+            assert r.version is v2
+        after, = srv.infer({"x": probe})
+        np.testing.assert_array_equal(np.asarray(after),
+                                      np.asarray(oracle2))
+        assert not np.array_equal(np.asarray(after),
+                                  np.asarray(before))
+        assert srv.stats()["model_version"] == "m@v2"
+
+
+def test_rollout_prewarm_failure_leaves_old_serving(tmp_path):
+    """A version whose model cannot load surfaces the typed
+    PrewarmFailedError with ZERO replicas touched — no partial
+    fleet."""
+    d1 = _build_model(tmp_path, hidden=16)
+    d2 = _build_model(tmp_path, hidden=24)
+    reg = serving.ModelRegistry()
+    reg.register("m", d1)
+    v2 = reg.register("m", d2)
+    # corrupt v2 AFTER registration (fingerprint already recorded;
+    # the predictor load fails)
+    os.remove(os.path.join(d2, "__model__"))
+    cfg = serving.ServingConfig(n_replicas=2, max_batch=4,
+                                default_deadline_s=10.0)
+    with serving.InferenceServer(_factory(d1), cfg) as srv:
+        fps = [r.predictor.program_fingerprint()
+               for r in srv.pool.replicas]
+        rc = serving.RolloutController(srv, reg)
+        with pytest.raises(serving.PrewarmFailedError) as ei:
+            rc.rollout("m", 2)
+        assert ei.value.code == "prewarm_failed"
+        assert "v2" in str(ei.value)
+        # zero replicas touched; still serving v1
+        assert [r.predictor.program_fingerprint()
+                for r in srv.pool.replicas] == fps
+        srv.infer({"x": np.ones((1, 8), np.float32)})
+        assert rc.state == "idle"
+        _ = v2
+
+
+def test_rollout_burn_rollback_restores_exact_fingerprint(tmp_path):
+    """The burn signal firing mid-rollout rolls every swapped replica
+    back to its EXACT prior program fingerprint, and serving
+    continues on the old version."""
+    d1 = _build_model(tmp_path, hidden=16)
+    d2 = _build_model(tmp_path, hidden=24)
+    reg = serving.ModelRegistry()
+    reg.register("m", d1)
+    reg.register("m", d2)
+    cfg = serving.ServingConfig(n_replicas=3, max_batch=4,
+                                default_deadline_s=10.0)
+
+    class FireAfterFirstSwap:
+        def __init__(self):
+            self.polls = 0
+
+        def observe(self):
+            self.polls += 1
+            return {}
+
+        def firing(self):
+            return ["serving_availability"] if self.polls >= 1 else []
+
+    with serving.InferenceServer(_factory(d1), cfg) as srv:
+        old_fps = {r.index: r.predictor.program_fingerprint()
+                   for r in srv.pool.replicas}
+        rc = serving.RolloutController(srv, reg,
+                                       monitor=FireAfterFirstSwap())
+        res = rc.rollout("m", 2)
+        assert res.status == "rolled_back"
+        assert res.swapped == 1 and res.rolled_back == 1
+        assert "burn firing" in res.reason
+        now_fps = {r.index: r.predictor.program_fingerprint()
+                   for r in srv.pool.replicas}
+        assert now_fps == old_fps        # exact restoration
+        srv.infer({"x": np.ones((1, 8), np.float32)})
+        assert rc.state == "rolled_back"
+
+
+def test_rollout_rollback_under_chaos_plan(tmp_path):
+    """The burn-firing rollback holds under a seeded fault plan
+    (delayed + dropped batches mid-rollout): typed answers for every
+    admitted request and the exact old fingerprints restored."""
+    d1 = _build_model(tmp_path, hidden=16)
+    d2 = _build_model(tmp_path, hidden=24)
+    reg = serving.ModelRegistry()
+    reg.register("m", d1)
+    reg.register("m", d2)
+
+    class FireAfterFirstSwap:
+        def __init__(self):
+            self.polls = 0
+
+        def observe(self):
+            self.polls += 1
+
+        def firing(self):
+            return ["serving_availability"] if self.polls >= 1 else []
+
+    plan = FaultPlan(seed=99, rate=0.1,
+                     actions=("drop", "delay=0.01", "close"),
+                     max_faults=6)
+    cfg = serving.ServingConfig(n_replicas=2, max_batch=4,
+                                default_deadline_s=10.0)
+    with faultinject.installed(plan):
+        with serving.InferenceServer(_factory(d1), cfg) as srv:
+            old_fps = {r.index: r.predictor.program_fingerprint()
+                       for r in srv.pool.replicas}
+            futures = [srv.submit(
+                {"x": np.ones((1, 8), np.float32)})
+                for _ in range(8)]
+            res = serving.RolloutController(
+                srv, reg, monitor=FireAfterFirstSwap()).rollout("m")
+            assert res.status == "rolled_back"
+            for f in futures:
+                try:
+                    f.result(timeout=20.0)
+                except serving.ServingError:
+                    pass                 # typed answer: accounted
+            st = srv.stats()
+            assert st["accounted"] and st["outstanding"] == 0
+            assert {r.index: r.predictor.program_fingerprint()
+                    for r in srv.pool.replicas} == old_fps
+
+
+# ---------------------------------------------------------------------------
+# SLO-actuated autoscaler
+# ---------------------------------------------------------------------------
+
+class _EvalMonitor:
+    """Scriptable monitor: feeds a fixed or per-tick evaluation."""
+
+    def __init__(self, evals):
+        self.evals = list(evals)
+        self.i = 0
+
+    def observe(self):
+        e = self.evals[min(self.i, len(self.evals) - 1)]
+        self.i += 1
+        return {"serving_availability": e}
+
+    def firing(self):
+        return []
+
+
+def _hot(f=5.0, s=5.0):
+    return {"burn_rate_fast": f, "burn_rate_slow": s, "firing": True}
+
+
+def _cold(f=0.0, s=0.0):
+    return {"burn_rate_fast": f, "burn_rate_slow": s, "firing": False}
+
+
+def _stub_server(n=1):
+    pool = serving.ReplicaPool(lambda i: _StubPredictor(),
+                               n_replicas=n, health_interval_s=10.0)
+    return types.SimpleNamespace(pool=pool, model_version=None)
+
+
+def test_autoscaler_scale_up_on_sustained_burn_and_clamps():
+    """Sustained burn scales up step by step to max_replicas and
+    never past the clamp."""
+    srv = _stub_server(1)
+    mon = _EvalMonitor([_hot()])
+    sc = serving.SLOAutoscaler(srv, mon, min_replicas=1,
+                               max_replicas=3, up_consecutive=2,
+                               down_consecutive=4, cooldown_s=0.0)
+    assert sc.evaluate() is None          # streak 1 < 2
+    assert sc.evaluate() == "up"
+    assert sc.evaluate() is None and sc.evaluate() == "up"
+    assert len(srv.pool.replicas) == 3
+    # clamped at max: burns keep arriving, no further action
+    assert sc.evaluate() is None and sc.evaluate() is None
+    assert len(srv.pool.replicas) == 3
+    assert [d for _, d, _ in sc.scale_events()] == ["up", "up"]
+    with pytest.raises(ValueError):
+        serving.SLOAutoscaler(srv, mon, min_replicas=3,
+                              max_replicas=1)
+    with pytest.raises(ValueError):
+        serving.SLOAutoscaler(srv, mon, burn_up=1.0, burn_clear=2.0)
+
+
+def test_autoscaler_cooldown_blocks_consecutive_actions():
+    srv = _stub_server(1)
+    sc = serving.SLOAutoscaler(srv, _EvalMonitor([_hot()]),
+                               min_replicas=1, max_replicas=4,
+                               up_consecutive=1, down_consecutive=4,
+                               cooldown_s=60.0)
+    assert sc.evaluate() == "up"
+    # burn still firing, but the cooldown window holds
+    assert sc.evaluate() is None and sc.evaluate() is None
+    assert len(srv.pool.replicas) == 2
+
+
+def test_autoscaler_hysteresis_never_flaps_on_oscillating_load():
+    """A seeded oscillating burn (strict hot/cold alternation — the
+    worst-case flap schedule) and a mid-band burn (between burn_clear
+    and burn_up: the dead zone) produce ZERO scale actions: neither
+    consecutive-streak bar is ever cleared."""
+    evals = [_hot() if i % 2 == 0 else _cold() for i in range(40)]
+    evals += [{"burn_rate_fast": 1.0, "burn_rate_slow": 1.0,
+               "firing": False}] * 20          # mid-band: dead zone
+    srv = _stub_server(2)
+    sc = serving.SLOAutoscaler(srv, _EvalMonitor(evals),
+                               min_replicas=1, max_replicas=4,
+                               up_consecutive=2, down_consecutive=2,
+                               burn_up=2.0, burn_clear=0.5,
+                               cooldown_s=0.0)
+    actions = [sc.evaluate() for _ in range(len(evals))]
+    assert all(a is None for a in actions), actions
+    assert len(srv.pool.replicas) == 2
+    assert sc.scale_events() == []
+
+
+def test_autoscaler_scale_down_graceful_drain_answers_inflight(
+        tmp_path):
+    """Scale-down retires a replica THROUGH the quiesce: its in-flight
+    batch is delivered, every request answered, and the retired
+    replica is never resurrected by restart_dead."""
+    d1 = _build_model(tmp_path, hidden=16)
+    cfg = serving.ServingConfig(n_replicas=2, max_batch=2,
+                                default_deadline_s=10.0,
+                                queue_capacity=32,
+                                restart_dead=True)
+    with serving.InferenceServer(_factory(d1), cfg) as srv:
+        futures = [srv.submit({"x": np.ones((1, 8), np.float32)})
+                   for _ in range(12)]
+        sc = serving.SLOAutoscaler(
+            srv, _EvalMonitor([_cold()]), min_replicas=1,
+            max_replicas=3, up_consecutive=2, down_consecutive=1,
+            cooldown_s=0.0)
+        assert sc.evaluate() == "down"
+        for f in futures:
+            f.result(timeout=10.0)       # all answered, none dropped
+        st = srv.stats()
+        assert st["accounted"] and st["outstanding"] == 0
+        assert len(srv.pool.replicas) == 1
+        time.sleep(0.15)                 # restart_dead must NOT
+        assert len(srv.pool.replicas) == 1   # resurrect the retiree
+        # min clamp: the last replica is never removed
+        assert sc.evaluate() is None
+        assert len(srv.pool.replicas) == 1
+        srv.infer({"x": np.ones((1, 8), np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# satellite: health-probe flake tolerance
+# ---------------------------------------------------------------------------
+
+def test_health_probe_flake_tolerance_faultinject_delay():
+    """One seeded delayed+dropped probe no longer kills a healthy
+    replica (K=2 default): the breaker stays closed.  K consecutive
+    probe failures DO open it (breaker_threshold=1 isolates the probe
+    path)."""
+    plan = FaultPlan()
+    plan.on("serving_health", 0, "delay=0.01+drop")
+    with faultinject.installed(plan):
+        pool = serving.ReplicaPool(lambda i: _StubPredictor(),
+                                   n_replicas=1,
+                                   breaker_threshold=1,
+                                   breaker_cooldown_s=5.0,
+                                   health_interval_s=0.02,
+                                   health_failures=2)
+        pool.start()
+        try:
+            t_end = time.monotonic() + 2.0
+            while pool.counters()["probes"] < 4 and \
+                    time.monotonic() < t_end:
+                time.sleep(0.01)
+            rep = pool.replicas[0]
+            assert pool.counters()["probe_failures"] == 1
+            assert not rep.breaker_open()       # flake tolerated
+            assert rep.available()
+        finally:
+            pool.stop()
+
+    # K consecutive failures reach the breaker
+    plan2 = FaultPlan()
+    plan2.on("serving_health", 0, "drop")
+    plan2.on("serving_health", 1, "drop")
+    with faultinject.installed(plan2):
+        pool = serving.ReplicaPool(lambda i: _StubPredictor(),
+                                   n_replicas=1,
+                                   breaker_threshold=1,
+                                   breaker_cooldown_s=5.0,
+                                   health_interval_s=0.02,
+                                   health_failures=2)
+        pool.start()
+        try:
+            t_end = time.monotonic() + 2.0
+            while pool.counters()["probe_failures"] < 2 and \
+                    time.monotonic() < t_end:
+                time.sleep(0.01)
+            assert pool.replicas[0].breaker_open()
+        finally:
+            pool.stop()
+
+
+def test_health_failures_env_knob(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_HEALTH_FAILURES", "5")
+    pool = serving.ReplicaPool(lambda i: _StubPredictor(),
+                               n_replicas=1, health_interval_s=10.0)
+    assert pool._health_failures == 5
+    pool2 = serving.ReplicaPool(lambda i: _StubPredictor(),
+                                n_replicas=1, health_interval_s=10.0,
+                                health_failures=1)
+    assert pool2._health_failures == 1
+
+
+# ---------------------------------------------------------------------------
+# serving_load per-tenant contract
+# ---------------------------------------------------------------------------
+
+def test_serving_load_tenant_rows_contract(tmp_path):
+    """The per-tenant traffic mix grows tenants rows in the record
+    (goodput/shed/p99 per tenant) and the quota parser round-trips
+    both quota kinds."""
+    sl = _tools_mod("serving_load")
+    assert sl.parse_tenants("a:0.7,b:0.3") == {"a": 0.7, "b": 0.3}
+    q = sl.parse_quotas("b=8,a=20qps")
+    assert q["b"].max_outstanding == 8 and q["b"].qps is None
+    assert q["a"].qps == 20.0 and q["a"].max_outstanding is None
+    with pytest.raises(ValueError):
+        sl.parse_tenants("a0.7")
+    with pytest.raises(ValueError):
+        sl.parse_quotas("a")
+
+    d = _build_model(tmp_path, hidden=16)
+    srv = sl.make_server(d, replicas=1, max_batch=4,
+                         deadline_ms=5000.0, warmup=True,
+                         quotas={"a": serving.TenantQuota(
+                             max_outstanding=2)})
+    try:
+        rec = sl.run_open_loop(srv, qps=120.0, seconds=0.6, seed=3,
+                               deadline_s=5.0,
+                               tenants={"a": 0.7, "b": 0.3})
+    finally:
+        srv.stop()
+    assert set(rec["tenants"]) == {"a", "b"}
+    for row in rec["tenants"].values():
+        assert {"submitted", "ok", "quota_shed", "shed", "p50_ms",
+                "p99_ms", "goodput_qps", "share"} <= set(row)
+    assert rec["accounted"] is True
+    assert rec["tenants"]["a"]["submitted"] > \
+        rec["tenants"]["b"]["submitted"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance legs (slow lane)
+# ---------------------------------------------------------------------------
+
+def test_tenant_isolation_under_overload(tmp_path):
+    """THE quota-isolation leg: tenant 'a' floods (its submits exceed
+    its quota many times over), tenant 'b' stays within quota — b
+    keeps >= 90% goodput while a is shed with the typed
+    QuotaExceededError, and weighted-fair dequeue keeps b's requests
+    flowing."""
+    d = _build_model(tmp_path, hidden=16)
+    cfg = serving.ServingConfig(
+        n_replicas=1, max_batch=4, default_deadline_s=10.0,
+        queue_capacity=16,
+        quotas={"a": serving.TenantQuota(max_outstanding=4,
+                                         weight=1.0),
+                "b": serving.TenantQuota(weight=1.0)})
+    with serving.InferenceServer(_factory(d), cfg) as srv:
+        x = np.ones((1, 8), np.float32)
+        a_futs, b_futs = [], []
+        a_shed = {"quota": 0, "other": 0}
+        t_end = time.monotonic() + 2.0
+        while time.monotonic() < t_end:
+            # hot tenant: a burst of 8 submits per tick (2x its
+            # outstanding quota per tick); protected tenant: 1/tick
+            for _ in range(8):
+                try:
+                    a_futs.append(srv.submit({"x": x}, tenant="a"))
+                except serving.QuotaExceededError:
+                    a_shed["quota"] += 1
+                except serving.ServingError:
+                    a_shed["other"] += 1
+            try:
+                b_futs.append(srv.submit({"x": x}, tenant="b"))
+            except serving.ServingError:
+                pass
+            time.sleep(0.01)
+        b_ok = 0
+        for f in b_futs:
+            try:
+                f.result(timeout=15.0)
+                b_ok += 1
+            except serving.ServingError:
+                pass
+        for f in a_futs:
+            try:
+                f.result(timeout=15.0)
+            except serving.ServingError:
+                pass
+        st = srv.stats()
+        assert st["accounted"] and st["outstanding"] == 0
+        # the hot tenant was shed with the TYPED quota error
+        assert a_shed["quota"] > 10, a_shed
+        # the quota-respecting tenant keeps >= 90% goodput
+        assert b_futs and b_ok / len(b_futs) >= 0.90, \
+            (b_ok, len(b_futs))
+        tc = st["tenants"]
+        assert tc["a"]["rejected_quota"] == a_shed["quota"]
+
+
+def test_fleet_acceptance_rollout_chaos_and_autoscale():
+    """THE rollout leg (acceptance criteria): seeded chaos (kill a
+    replica mid-rollout + dropped health replies + delays) over a
+    2-version rolling swap answers every admitted request exactly
+    once with zero drops and converges the fleet to one version (or
+    cleanly rolls back), and the seeded overload leg shows the
+    SLOAutoscaler actuating replica count from the burn-rate signal
+    with no hysteresis flap — all replayable from the seed."""
+    cs = _tools_mod("chaos_soak")
+    ok, detail, n_faults, info = cs.run_rollout_iteration(
+        seed=2718, rate=0.05, max_faults=12, timeout=120.0)
+    assert ok, detail
+    assert info["zero_dropped"] is True
+    assert info["converged"] or info["rolled_back"]
+    assert info["final_version"] in (1, 2)
+    assert n_faults >= 1              # the plan actually fired
+    ok2, detail2, sinfo = cs.run_autoscale_leg(seed=2718)
+    assert ok2, detail2
+    assert sinfo["autoscaler_actuated"] and sinfo["scale_events"] >= 1
+    assert sinfo["flapped"] is False

@@ -402,6 +402,222 @@ def run_decode_iteration(seed, rate, max_faults, timeout,
         return False, f"seed={seed}: {type(e).__name__}: {e}", 0
 
 
+_rollout_model_dirs = None
+
+
+def _serving_load_mod():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serving_load",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "serving_load.py"))
+    sl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sl)
+    return sl
+
+
+def run_rollout_iteration(seed, rate, max_faults, timeout):
+    """One faulted ROLLING-ROLLOUT run (ISSUE 13 acceptance shape):
+    a 3-replica server serving live traffic starts a rolling version
+    swap v1 -> v2 (registry + RolloutController) under a seeded plan
+    that kills a replica mid-rollout, drops health replies, and
+    delays batches — every admitted request must be answered exactly
+    once by id (zero drops), and the fleet must finish CONVERGED on
+    exactly one version (v2, or v1 after a clean burn-triggered
+    rollback).  Returns (ok, detail, n_faults, info) where info feeds
+    the verdict's ``rollout`` block."""
+    global _rollout_model_dirs
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import numpy as np
+
+    from paddle_tpu import serving
+    from paddle_tpu.distributed import faultinject
+    from paddle_tpu.distributed.faultinject import FaultPlan
+
+    sl = _serving_load_mod()
+    if _rollout_model_dirs is None:
+        _rollout_model_dirs = (
+            sl.build_model(tempfile.mkdtemp(), hidden=16),
+            sl.build_model(tempfile.mkdtemp(), hidden=24))
+    info = {"zero_dropped": False, "converged": False,
+            "rolled_back": False, "final_version": None}
+    plan = FaultPlan(seed=seed, rate=rate,
+                     actions=("kill", "drop", "close", "delay=0.02",
+                              "delay=0.01+drop"),
+                     max_faults=max_faults)
+    rng = np.random.RandomState(seed)
+    deadline = time.monotonic() + timeout
+    try:
+        registry = serving.ModelRegistry()
+        v1 = registry.register("m", _rollout_model_dirs[0])
+        v2 = registry.register("m", _rollout_model_dirs[1])
+        with faultinject.installed(plan) as inj:
+            srv = sl.make_server(_rollout_model_dirs[0], replicas=3,
+                                 max_batch=8, deadline_ms=8000.0,
+                                 max_wait_ms=2.0, warmup=True,
+                                 health_interval_s=0.05,
+                                 restart_dead=True)
+            try:
+                futures, rejected = [], [0]
+                stop = threading.Event()
+
+                def pump():
+                    # live traffic THROUGH the whole rollout window
+                    while not stop.is_set():
+                        x = rng.rand(1, 8).astype(np.float32)
+                        try:
+                            futures.append(srv.submit({"x": x}))
+                        except serving.ServingError:
+                            rejected[0] += 1
+                        time.sleep(0.003)
+
+                th = threading.Thread(target=pump, daemon=True)
+                th.start()
+                time.sleep(0.05)
+                rc = serving.RolloutController(
+                    srv, registry, swap_timeout_s=timeout / 2.0)
+                res = rc.rollout("m", 2)
+                time.sleep(0.1)
+                stop.set()
+                th.join(timeout=5.0)
+                answered = 0
+                for f in futures:
+                    try:
+                        f.result(timeout=max(
+                            0.1, deadline - time.monotonic()))
+                    except serving.ServingError:
+                        pass    # typed rejection: answered, counted
+                    except TimeoutError:
+                        return (False, f"seed={seed}: request {f.id} "
+                                "unanswered during rollout (silent "
+                                "drop?)", len(inj.log), info)
+                    answered += 1
+                leftovers = srv.stop()
+                _ = leftovers
+                st = srv.stats()
+                if answered != len(futures) or not st["accounted"] \
+                        or st["outstanding"]:
+                    return (False, f"seed={seed}: rollout accounting "
+                            f"broken answered={answered}/"
+                            f"{len(futures)} {st['admission']}",
+                            len(inj.log), info)
+                info["zero_dropped"] = True
+                # convergence: every live replica on ONE fingerprint,
+                # and it is the expected side of the swap
+                fps = {r.predictor.program_fingerprint()
+                       for r in srv.pool.replicas if r.alive}
+                if len(fps) != 1:
+                    return (False, f"seed={seed}: fleet split across "
+                            f"{len(fps)} fingerprints after rollout",
+                            len(inj.log), info)
+                target = v2 if res.converged else v1
+                info["converged"] = res.converged
+                info["rolled_back"] = res.status == "rolled_back"
+                info["final_version"] = target.version
+                if target.serving_fingerprint is not None and \
+                        fps != {target.serving_fingerprint}:
+                    return (False, f"seed={seed}: fleet on the wrong "
+                            f"version after {res.status}",
+                            len(inj.log), info)
+                if st["admission"]["answered_ok"] == 0:
+                    return (False, f"seed={seed}: no request ever "
+                            "succeeded during rollout",
+                            len(inj.log), info)
+                return True, "", len(inj.log), info
+            finally:
+                srv.stop()
+    except Exception as e:   # noqa: BLE001 — verdict, not crash
+        return (False, f"seed={seed}: {type(e).__name__}: {e}", 0,
+                info)
+
+
+def run_autoscale_leg(seed, seconds=3.0):
+    """The SLO-actuated autoscaler half of the rollout verdict
+    (ISSUE 13): a seeded overload against a 1-replica fleet with an
+    SLOAutoscaler watching the fleet-availability burn rate — the
+    burn must ACTUATE at least one scale-up (and the hysteresis must
+    produce no down-flap while the overload holds).  Returns
+    (ok, detail, info)."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu import serving
+    from paddle_tpu.observability import slo as obs_slo
+
+    sl = _serving_load_mod()
+    global _rollout_model_dirs
+    if _rollout_model_dirs is None:
+        _rollout_model_dirs = (
+            sl.build_model(tempfile.mkdtemp(), hidden=16),
+            sl.build_model(tempfile.mkdtemp(), hidden=24))
+    info = {"scale_events": 0, "autoscaler_actuated": False,
+            "flapped": False}
+    rng = np.random.RandomState(seed)
+    srv = sl.make_server(_rollout_model_dirs[0], replicas=1,
+                         max_batch=4, deadline_ms=300.0, capacity=8,
+                         max_wait_ms=1.0, warmup=True)
+    monitor = obs_slo.SLOMonitor(slos=[obs_slo.fleet_availability(
+        objective=0.99, window_s=2.0, fast_fraction=0.5)])
+    monitor.observe()
+    scaler = serving.SLOAutoscaler(
+        srv, monitor, slo="fleet_availability", min_replicas=1,
+        max_replicas=3, up_consecutive=2, down_consecutive=1000,
+        cooldown_s=0.4)
+    futures = []
+    try:
+        t_end = time.monotonic() + seconds
+        next_eval = 0.0
+        while time.monotonic() < t_end:
+            # 2x-overload: bursts beyond the single replica's
+            # capacity, shed typed at admission -> the burn signal
+            for _ in range(6):
+                x = rng.rand(1, 8).astype(np.float32)
+                try:
+                    futures.append(srv.submit({"x": x},
+                                              deadline_s=5.0))
+                except serving.ServingError:
+                    pass
+            now = time.monotonic()
+            if now >= next_eval:
+                scaler.evaluate()
+                next_eval = now + 0.05
+            time.sleep(0.01)
+        for f in futures:
+            try:
+                f.result(timeout=10.0)
+            except serving.ServingError:
+                pass
+            except TimeoutError:
+                return (False, f"seed={seed}: request {f.id} "
+                        "unanswered under autoscale", info)
+        events = scaler.scale_events()
+        info["scale_events"] = len(events)
+        info["autoscaler_actuated"] = any(
+            d == "up" for _, d, _ in events)
+        info["flapped"] = any(d == "down" for _, d, _ in events)
+        st = srv.stats()
+        if not st["accounted"]:
+            return (False, f"seed={seed}: autoscale accounting "
+                    "broken", info)
+        if not info["autoscaler_actuated"]:
+            return (False, f"seed={seed}: overload never actuated a "
+                    "scale-up (burn stayed under threshold?)", info)
+        if info["flapped"]:
+            return (False, f"seed={seed}: autoscaler flapped (scaled "
+                    "DOWN during sustained overload)", info)
+        return True, "", info
+    finally:
+        scaler.stop()
+        srv.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="randomized chaos soak of a loopback PS cluster")
@@ -421,10 +637,11 @@ def main(argv=None):
                     default="socket")
     ap.add_argument("--timeout", type=float, default=240.0,
                     help="per-iteration trainer timeout (s)")
-    ap.add_argument("--mode", choices=["cluster", "serving"],
+    ap.add_argument("--mode",
+                    choices=["cluster", "serving", "rollout"],
                     default="cluster")
     args = ap.parse_args(argv)
-    if args.mode == "serving":
+    if args.mode in ("serving", "rollout"):
         # in-process serving soak: pin the platform before jax loads
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         import jax
@@ -468,6 +685,9 @@ def main(argv=None):
         except Exception:
             collector_srv = None
     seeds, failures, total_faults = [], [], 0
+    rollout_info = {"zero_dropped": True, "converged": 0,
+                    "rolled_back": 0, "final_version": None,
+                    "scale_events": 0, "autoscaler_actuated": False}
     i = 0
     while True:
         if args.iterations and i >= args.iterations:
@@ -490,6 +710,24 @@ def main(argv=None):
                 ok = False
                 detail = (detail + "; " if detail else "") + \
                     "decode: " + detail2
+        elif args.mode == "rollout":
+            # ISSUE 13: rolling version swap under kill-a-replica-
+            # mid-rollout chaos, then the SLO-autoscaler overload leg
+            ok, detail, n_faults, info = run_rollout_iteration(
+                seed, args.rate, args.max_faults, args.timeout)
+            rollout_info["zero_dropped"] &= info["zero_dropped"]
+            rollout_info["converged"] += int(info["converged"])
+            rollout_info["rolled_back"] += int(info["rolled_back"])
+            if info["final_version"] is not None:
+                rollout_info["final_version"] = info["final_version"]
+            ok2, detail2, sinfo = run_autoscale_leg(seed)
+            rollout_info["scale_events"] += sinfo["scale_events"]
+            rollout_info["autoscaler_actuated"] |= \
+                sinfo["autoscaler_actuated"]
+            if not ok2:
+                ok = False
+                detail = (detail + "; " if detail else "") + \
+                    "autoscale: " + detail2
         else:
             ok, detail, n_faults = run_iteration(
                 seed, args.rate, args.max_faults, transport,
@@ -556,6 +794,11 @@ def main(argv=None):
         "fleet": fleet_snapshot,
         "fleet_snapshot": fleet_path,
     }
+    if args.mode == "rollout":
+        # ISSUE 13 verdict block the ci.sh 5f gate parses: zero
+        # dropped requests, fleet converged (or provably rolled
+        # back), and the autoscaler actuated under the overload leg
+        verdict["rollout"] = rollout_info
     print(json.dumps(verdict))
     return 0 if verdict["ok"] else 1
 

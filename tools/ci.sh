@@ -278,6 +278,39 @@ print("perf sentinel OK: %d metrics checked, 0 regressions"
       % rec["checked"])
 PY
 
+echo "== 5f/8 fleet rollout smoke (zero-drop rolling swap + SLO autoscaler) =="
+# ISSUE 13: one seeded rollout iteration — a 3-replica fleet serving
+# live traffic swaps v1 -> v2 replica-by-replica under a chaos plan
+# (kill mid-rollout / dropped health / delays); the one-JSON-line
+# verdict must show zero dropped requests and a fleet converged on
+# exactly one version (or cleanly rolled back), and the overload leg
+# must show the SLO burn-rate signal ACTUATING at least one scale-up
+# with no hysteresis flap.  Replayable from the printed seed.
+JAX_PLATFORMS=cpu python tools/chaos_soak.py --mode rollout \
+  --iterations 1 --seed 2718 --rate 0.05 > /tmp/_rollout_smoke.json
+cat /tmp/_rollout_smoke.json
+python - <<'PY'
+import json
+lines = [ln for ln in open("/tmp/_rollout_smoke.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, (
+    "rollout smoke stdout must be exactly ONE JSON line — got %d"
+    % len(lines))
+rec = json.loads(lines[0])
+assert rec["ok"] is True, "rollout smoke failed: %r" % rec["failures"]
+r = rec["rollout"]
+assert r["zero_dropped"] is True, (
+    "requests dropped during rollout: %r" % r)
+assert r["converged"] + r["rolled_back"] == rec["iterations"], (
+    "fleet neither converged nor rolled back every iteration: %r" % r)
+assert r["scale_events"] >= 1 and r["autoscaler_actuated"] is True, (
+    "SLO burn never actuated the autoscaler: %r" % r)
+print("rollout smoke OK: %d converged / %d rolled back, "
+      "%d scale events, final v%s"
+      % (r["converged"], r["rolled_back"], r["scale_events"],
+         r["final_version"]))
+PY
+
 echo "== 6/8 per-op regression gate (hot ops vs committed CPU baseline) =="
 # 3x tolerance absorbs machine load; catches order-of-magnitude
 # per-op regressions (reference op_tester role) before they surface
@@ -322,5 +355,10 @@ JAX_PLATFORMS=cpu python tools/chaos_soak.py \
 # exact request-id accounting asserted each iteration
 JAX_PLATFORMS=cpu python tools/chaos_soak.py \
   --mode serving --iterations 2 --seed 4321 --rate 0.08
+# fleet rollout leg (ISSUE 13): rolling version swap + replica kill
+# mid-rollout + autoscaler overload, a different seed than the 5f
+# smoke so the soak explores a second chaos schedule
+JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+  --mode rollout --iterations 1 --seed 3141 --rate 0.06
 
 echo "ALL CHECKS PASSED"

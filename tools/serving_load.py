@@ -188,18 +188,35 @@ def measure_capacity(srv, seconds=1.0, concurrency=None):
     return sum(counts) / wall if wall > 0 else 0.0
 
 
-def run_open_loop(srv, qps, seconds, seed=0, deadline_s=None):
+def run_open_loop(srv, qps, seconds, seed=0, deadline_s=None,
+                  tenants=None):
     """Seeded Poisson arrivals at `qps` for `seconds`; returns the
     outcome/latency record (dict).  Every submitted request ends in
-    exactly one bucket: ok / a typed rejection code / (never) silent."""
+    exactly one bucket: ok / a typed rejection code / (never) silent.
+
+    tenants (ISSUE 13): {name: fraction} traffic mix — each arrival
+    draws its tenant from the seeded stream and the record grows a
+    per-tenant ``tenants`` block (submitted / ok / quota_shed / shed /
+    p50/p99 / goodput) next to the aggregate row, so one JSON line
+    shows which tenant the admission quotas protected and which one
+    they shed."""
     import numpy as np
 
     from paddle_tpu import serving
 
     rng = np.random.RandomState(int(seed))
     x = rng.rand(1, _in_dim(srv)).astype(np.float32)
-    inflight = []          # Request futures (admitted)
+    names, probs = None, None
+    if tenants:
+        names = sorted(tenants)
+        total = sum(float(tenants[n]) for n in names)
+        probs = [float(tenants[n]) / total for n in names]
+    inflight = []          # (Request, tenant) futures (admitted)
     outcomes = {"ok": 0}   # code -> count (submit-time rejections too)
+    per_tenant: dict = {n: {"submitted": 0, "ok": 0, "quota_shed": 0,
+                            "shed": 0, "expired": 0, "other": 0,
+                            "lat_ms": []}
+                        for n in (names or ())}
     t0 = time.monotonic()
     next_t = t0
     n_submitted = 0
@@ -212,31 +229,66 @@ def run_open_loop(srv, qps, seconds, seed=0, deadline_s=None):
             continue
         next_t += rng.exponential(1.0 / qps)
         n_submitted += 1
+        tenant = None
+        if names:
+            tenant = names[int(rng.choice(len(names), p=probs))]
+            per_tenant[tenant]["submitted"] += 1
         try:
-            inflight.append(srv.submit({"x": x},
-                                       deadline_s=deadline_s))
+            inflight.append((srv.submit({"x": x},
+                                        deadline_s=deadline_s,
+                                        tenant=tenant), tenant))
         except serving.ServingError as e:
             outcomes[e.code] = outcomes.get(e.code, 0) + 1
+            if tenant is not None:
+                key = {"quota": "quota_shed",
+                       "overloaded": "shed",
+                       "expired": "expired"}.get(e.code, "other")
+                per_tenant[tenant][key] += 1
     wall = time.monotonic() - t0
     latencies = []
-    for req in inflight:
+    for req, tenant in inflight:
         try:
             req.result(timeout=(deadline_s or
                                 srv.config.default_deadline_s) + 5.0)
             outcomes["ok"] += 1
             latencies.append(req.latency_s())
+            if tenant is not None:
+                per_tenant[tenant]["ok"] += 1
+                if req.latency_s() is not None:
+                    per_tenant[tenant]["lat_ms"].append(
+                        1000.0 * req.latency_s())
         except serving.ServingError as e:
             outcomes[e.code] = outcomes.get(e.code, 0) + 1
+            if tenant is not None:
+                key = {"quota": "quota_shed",
+                       "overloaded": "shed",
+                       "expired": "expired"}.get(e.code, "other")
+                per_tenant[tenant][key] += 1
             if req.latency_s() is not None:
                 latencies.append(req.latency_s())
     lat_ms = sorted(1000.0 * v for v in latencies if v is not None)
 
-    def pct(p):
-        if not lat_ms:
+    def pct(p, arr=None):
+        arr = lat_ms if arr is None else arr
+        if not arr:
             return None
-        return lat_ms[min(len(lat_ms) - 1,
-                          int(p / 100.0 * len(lat_ms)))]
+        return arr[min(len(arr) - 1, int(p / 100.0 * len(arr)))]
 
+    tenant_rows = None
+    if names:
+        tenant_rows = {}
+        for n in names:
+            row = per_tenant[n]
+            tl = sorted(row.pop("lat_ms"))
+            row["share"] = float(tenants[n])
+            row["goodput_qps"] = round(row["ok"] / wall, 1) \
+                if wall else 0.0
+            row["goodput_frac"] = round(
+                row["ok"] / row["submitted"], 4) \
+                if row["submitted"] else None
+            row["p50_ms"] = round(pct(50, tl), 2) if tl else None
+            row["p99_ms"] = round(pct(99, tl), 2) if tl else None
+            tenant_rows[n] = row
     st = srv.stats()
     return {
         "offered_qps": round(n_submitted / wall, 1) if wall else 0.0,
@@ -245,6 +297,7 @@ def run_open_loop(srv, qps, seconds, seed=0, deadline_s=None):
         "admitted": len(inflight),
         "ok": outcomes["ok"],
         "shed": outcomes.get("overloaded", 0),
+        "quota_shed": outcomes.get("quota", 0),
         "expired": outcomes.get("expired", 0),
         "failed": outcomes.get("failed", 0),
         "shutdown": outcomes.get("shutdown", 0),
@@ -252,6 +305,7 @@ def run_open_loop(srv, qps, seconds, seed=0, deadline_s=None):
         "p99_ms": round(pct(99), 2) if lat_ms else None,
         "failed_over": st["pool"]["requeues"],
         "accounted": st["accounted"],
+        "tenants": tenant_rows,
         "wall_s": round(wall, 2),
     }
 
@@ -363,6 +417,43 @@ def run_decode_open_loop(srv, qps, seconds, seed=0, deadline_s=None,
     }
 
 
+def parse_tenants(text):
+    """'a:0.7,b:0.3' -> {'a': 0.7, 'b': 0.3} (fractions renormalized
+    downstream)."""
+    if not text:
+        return None
+    out = {}
+    for part in text.split(","):
+        name, _, frac = part.partition(":")
+        if not name or not frac:
+            raise ValueError(
+                f"--tenants entry {part!r} is not name:fraction")
+        out[name.strip()] = float(frac)
+    return out
+
+
+def parse_quotas(text):
+    """'b=8,a=20qps' -> {'b': TenantQuota(max_outstanding=8),
+    'a': TenantQuota(qps=20)}.  A bare integer caps outstanding; an
+    ``Nqps`` suffix caps sustained admission rate (token bucket)."""
+    if not text:
+        return None
+    from paddle_tpu.serving import TenantQuota
+
+    out = {}
+    for part in text.split(","):
+        name, _, val = part.partition("=")
+        if not name or not val:
+            raise ValueError(f"--quota entry {part!r} is not name=N")
+        val = val.strip().lower()
+        if val.endswith("qps"):
+            out[name.strip()] = TenantQuota(qps=float(val[:-3]))
+        else:
+            out[name.strip()] = TenantQuota(
+                max_outstanding=int(val))
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="seeded open-loop serving load generator")
@@ -404,7 +495,18 @@ def main(argv=None):
                     help="decode mode (ISSUE 11a): prompts longer "
                          "than this prefill in fixed chunks "
                          "interleaved with decode iterations")
+    ap.add_argument("--tenants", type=str, default=None,
+                    help="ISSUE 13: per-tenant traffic mix "
+                         "'a:0.7,b:0.3' — the JSON line grows "
+                         "per-tenant goodput/shed/p99 rows")
+    ap.add_argument("--quota", type=str, default=None,
+                    help="ISSUE 13: per-tenant admission quotas "
+                         "'b=8' (max outstanding) or 'a=20qps' "
+                         "(token-bucket rate); over-quota submits "
+                         "shed with typed QuotaExceededError")
     args = ap.parse_args(argv)
+    tenants = parse_tenants(args.tenants)
+    quotas = parse_quotas(args.quota)
 
     import jax
 
@@ -492,7 +594,7 @@ def main(argv=None):
                           max_batch=args.max_batch,
                           deadline_ms=args.deadline_ms,
                           capacity=args.capacity, warmup=False,
-                          prewarm=False)
+                          prewarm=False, quotas=quotas)
         try:
             # cold-start metric FIRST (nothing compiled yet,
             # prewarm=False so the env can't warm it behind our
@@ -512,7 +614,8 @@ def main(argv=None):
                       f"{qps:.1f}", file=sys.stderr)
             rec = run_open_loop(srv, qps, args.seconds,
                                 seed=args.seed,
-                                deadline_s=args.deadline_ms / 1000.0)
+                                deadline_s=args.deadline_ms / 1000.0,
+                                tenants=tenants)
             # SLO verdict AT RUN END — the warm-probe server below
             # must not dilute the windows the run just burned
             slo_verdict = monitor.verdict()
@@ -552,6 +655,7 @@ def main(argv=None):
         "deadline_ms": args.deadline_ms,
         "replicas": args.replicas,
         "max_batch": args.max_batch,
+        "quota": args.quota,
         "seed": args.seed,
         "mode": args.mode,
     })
