@@ -301,11 +301,24 @@ class AdmissionController:
         with self._lock:
             return dict(self._quotas)
 
+    # distinct tenant keys tracked per controller; past the bound new
+    # keys aggregate under the overflow key (mirrors the instrument's
+    # max_series=128 — high-cardinality / one-shot tenant keys must
+    # not grow process memory without bound)
+    MAX_TENANT_KEYS = 128
+    OVERFLOW_TENANT = "<other>"
+
     def _tenant_count(self, tenant, key, n=1):
         if tenant is None:
             return
         with self._lock:
-            c = self._tenant_counters.setdefault(tenant, {})
+            c = self._tenant_counters.get(tenant)
+            if c is None:
+                if len(self._tenant_counters) >= self.MAX_TENANT_KEYS:
+                    tenant = self.OVERFLOW_TENANT
+                    c = self._tenant_counters.setdefault(tenant, {})
+                else:
+                    c = self._tenant_counters[tenant] = {}
             c[key] = c.get(key, 0) + n
         _M_TENANT.inc(n, tenant=str(tenant), outcome=key)
 
@@ -353,76 +366,99 @@ class AdmissionController:
                 f"deadline {deadline_s:g}s already expired at submit")
         quota = self._quotas.get(tenant) if tenant is not None \
             else None
-        if quota is not None:
+        reserved = False
+        if quota is not None and quota.max_outstanding is not None:
             # quota sheds happen BEFORE capacity is consumed: an
-            # over-quota tenant cannot displace in-quota traffic
-            if quota.max_outstanding is not None:
-                with self._lock:
-                    over = self._tenant_outstanding.get(tenant, 0) \
-                        >= quota.max_outstanding
-                if over:
-                    self._count("rejected_quota")
-                    self._tenant_count(tenant, "rejected_quota")
-                    raise QuotaExceededError(
-                        f"tenant '{tenant}' at max_outstanding "
-                        f"{quota.max_outstanding}: quota shed")
-            if not quota.try_take_token(now):
+            # over-quota tenant cannot displace in-quota traffic.  The
+            # check RESERVES the outstanding slot in the same locked
+            # section, so concurrent submits for one tenant cannot all
+            # pass the check and exceed the cap; any later rejection
+            # on this path releases the reservation.
+            with self._lock:
+                held = self._tenant_outstanding.get(tenant, 0)
+                if held < quota.max_outstanding:
+                    self._tenant_outstanding[tenant] = held + 1
+                    reserved = True
+            if not reserved:
+                self._count("rejected_quota")
+                self._tenant_count(tenant, "rejected_quota")
+                raise QuotaExceededError(
+                    f"tenant '{tenant}' at max_outstanding "
+                    f"{quota.max_outstanding}: quota shed")
+        try:
+            if quota is not None and not quota.try_take_token(now):
                 self._count("rejected_quota")
                 self._tenant_count(tenant, "rejected_quota")
                 raise QuotaExceededError(
                     f"tenant '{tenant}' QPS token bucket empty "
                     f"(qps {quota.qps:g}): quota shed")
-        rows = None
-        for name, arr in feeds.items():
-            arr = np.asarray(arr)
-            n = arr.shape[0] if arr.ndim else 1
-            if rows is None:
-                rows = n
-            elif n != rows:
-                raise ValueError(
-                    f"feed '{name}' leading dim {n} != {rows} "
-                    "(all feeds of one request share the batch dim)")
-        if not rows:
-            raise ValueError("request with no feeds / zero rows")
-        req = Request(
-            request_id if request_id is not None else next(self._ids),
-            {n: np.asarray(v) for n, v in feeds.items()},
-            rows, now + deadline_s, on_done=self._on_done,
-            tenant=tenant)
-        lane = "" if tenant is None else tenant
-        with self._lock:
-            if self._depth >= self.capacity:
-                self._counters["rejected_overloaded"] += 1
-                full = True
-            else:
-                full = False
-                dq = self._lanes.get(lane)
-                if dq is None:
-                    dq = self._lanes[lane] = deque()
-                if not dq:
-                    # joining lane starts at the current virtual
-                    # clock: idle tenants bank no credit
-                    self._vtime[lane] = max(
-                        self._vtime.get(lane, 0.0), self._vclock)
-                dq.append(req)
-                self._depth += 1
-                self._outstanding[req.id] = req
-                self._counters["admitted"] += 1
-                if tenant is not None:
-                    self._tenant_outstanding[tenant] = \
-                        self._tenant_outstanding.get(tenant, 0) + 1
-                _M_OUTSTANDING.set(len(self._outstanding))
-                self._not_empty.notify()
-        if full:
-            _M_REQS.inc(outcome="rejected_overloaded")
-            self._tenant_count(tenant, "rejected_overloaded")
-            raise OverloadedError(
-                f"admission queue full (capacity {self.capacity}): "
-                "load shed") from None
+            rows = None
+            for name, arr in feeds.items():
+                arr = np.asarray(arr)
+                n = arr.shape[0] if arr.ndim else 1
+                if rows is None:
+                    rows = n
+                elif n != rows:
+                    raise ValueError(
+                        f"feed '{name}' leading dim {n} != {rows} "
+                        "(all feeds of one request share the batch "
+                        "dim)")
+            if not rows:
+                raise ValueError("request with no feeds / zero rows")
+            req = Request(
+                request_id if request_id is not None
+                else next(self._ids),
+                {n: np.asarray(v) for n, v in feeds.items()},
+                rows, now + deadline_s, on_done=self._on_done,
+                tenant=tenant)
+            lane = "" if tenant is None else tenant
+            with self._lock:
+                if self._depth >= self.capacity:
+                    self._counters["rejected_overloaded"] += 1
+                    full = True
+                else:
+                    full = False
+                    dq = self._lanes.get(lane)
+                    if dq is None:
+                        dq = self._lanes[lane] = deque()
+                    if not dq:
+                        # joining lane starts at the current virtual
+                        # clock: idle tenants bank no credit
+                        self._vtime[lane] = max(
+                            self._vtime.get(lane, 0.0), self._vclock)
+                    dq.append(req)
+                    self._depth += 1
+                    self._outstanding[req.id] = req
+                    self._counters["admitted"] += 1
+                    if tenant is not None and not reserved:
+                        self._tenant_outstanding[tenant] = \
+                            self._tenant_outstanding.get(tenant, 0) + 1
+                    _M_OUTSTANDING.set(len(self._outstanding))
+                    self._not_empty.notify()
+            if full:
+                _M_REQS.inc(outcome="rejected_overloaded")
+                self._tenant_count(tenant, "rejected_overloaded")
+                raise OverloadedError(
+                    f"admission queue full (capacity "
+                    f"{self.capacity}): load shed") from None
+        except BaseException:
+            if reserved:
+                self._release_outstanding(tenant)
+            raise
         _M_REQS.inc(outcome="admitted")
         self._tenant_count(tenant, "admitted")
         _M_DEPTH.set(self._depth)
         return req
+
+    def _release_outstanding(self, tenant):
+        """Undo a reserved outstanding slot for a submit that was
+        rejected after the reservation."""
+        with self._lock:
+            n = self._tenant_outstanding.get(tenant, 1) - 1
+            if n <= 0:
+                self._tenant_outstanding.pop(tenant, None)
+            else:
+                self._tenant_outstanding[tenant] = n
 
     def _lane_weight(self, lane):
         q = self._quotas.get(lane if lane != "" else None)
@@ -437,10 +473,19 @@ class AdmissionController:
                 best = lane
         if best is None:
             return None
-        req = self._lanes[best].popleft()
+        dq = self._lanes[best]
+        req = dq.popleft()
         self._depth -= 1
         self._vclock = self._vtime[best]
-        self._vtime[best] += 1.0 / self._lane_weight(best)
+        if dq:
+            self._vtime[best] += 1.0 / self._lane_weight(best)
+        else:
+            # prune the emptied lane and its virtual time: lane state
+            # is bounded by the CURRENT backlog, not by every tenant
+            # key ever seen (a rejoining lane re-enters at the virtual
+            # clock anyway — idle tenants bank no credit)
+            del self._lanes[best]
+            self._vtime.pop(best, None)
         return req
 
     # -- batcher side -------------------------------------------------------

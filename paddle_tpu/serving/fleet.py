@@ -229,8 +229,45 @@ class RolloutController:
                 reason = ("slo burn firing mid-rollout: %s"
                           % ",".join(firing))
                 break
+        # 3. catch-up: a replica the autoscaler added MID-rollout is
+        # not in the snapshot (it still serves the pre-rollout
+        # program) — prewarm-and-swap late joiners before the
+        # straggler check, so a concurrent scale-up cannot force a
+        # spurious full rollback.  Bounded passes: if scale-ups outrun
+        # the catch-up, the straggler check below still rolls back.
+        for _ in range(3):
+            if reason:
+                break
+            late = [r.index for r in pool.replicas
+                    if r.alive and not r.retired
+                    and r.predictor.program_fingerprint() != target_fp]
+            if not late:
+                break
+            for idx in late:
+                try:
+                    rep = pool.replica(idx)
+                    prior_fp = rep.predictor.program_fingerprint()
+                    if prior_fp == target_fp:
+                        continue
+                    prior_state, prior_version = pool.swap_predictor(
+                        idx, target.prewarm(
+                            buckets=self.server.config.buckets),
+                        version=target, timeout=self.swap_timeout_s)
+                except KeyError:
+                    continue     # scaled away again: nothing to swap
+                except (TimeoutError, PrewarmFailedError) as e:
+                    reason = f"late replica {idx} swap failed: {e}"
+                    break
+                swapped.append((idx, prior_state, prior_fp,
+                                prior_version))
+                _M_FLEET.inc(event="replica_swapped")
+                firing = self._burn_firing()
+                if firing:
+                    reason = ("slo burn firing mid-rollout: %s"
+                              % ",".join(firing))
+                    break
         if not reason:
-            # 3. converged: every live replica must carry the target
+            # 4. converged: every live replica must carry the target
             # fingerprint (a replica relaunched mid-rollout kept its
             # swapped predictor object, so this holds by construction)
             stragglers = [
@@ -244,6 +281,12 @@ class RolloutController:
         with self._lock:
             self.state = "converged"
         self.server.model_version = target
+        # future scale-ups must serve what their version tag claims:
+        # point the pool factory at the converged version (prewarmed
+        # through the same compile cache the rollout used)
+        buckets = self.server.config.buckets
+        pool.set_factory(
+            lambda i, _v=target, _b=buckets: _v.prewarm(buckets=_b))
         _G_VERSION.set(target.version, model=str(name))
         _M_FLEET.inc(event="rollout_converged")
         _flight.record("fleet", "rollout_converged", model=str(name),
@@ -373,11 +416,26 @@ class SLOAutoscaler:
         live = self._live()
         if hot and self._hot_streak >= self.up_consecutive \
                 and live < self.max_replicas:
+            ver = getattr(self.server, "model_version", None)
+            buckets = getattr(getattr(self.server, "config", None),
+                              "buckets", None)
             n = min(self.step, self.max_replicas - live)
             for _ in range(n):
-                self.pool.add_replica(
-                    version=getattr(self.server, "model_version",
-                                    None))
+                # the new replica must SERVE the version its tag
+                # claims: build it from the registry version (prewarmed
+                # through the compile cache, off the serving path), not
+                # from a possibly pre-rollout factory
+                pred = None
+                if hasattr(ver, "prewarm"):
+                    try:
+                        pred = ver.prewarm(buckets=buckets) \
+                            if buckets else ver.prewarm()
+                    except PrewarmFailedError as e:
+                        _flight.record(
+                            "fleet", "scale_up_prewarm_failed",
+                            version=str(ver), error=str(e)[:200])
+                        return None   # never add a broken replica
+                self.pool.add_replica(version=ver, predictor=pred)
             return self._acted("up", now, burn_fast=fast,
                                burn_slow=slow)
         if cold and self._cold_streak >= self.down_consecutive \

@@ -382,14 +382,27 @@ class ReplicaPool:
         finally:
             rep.paused = False
 
-    def add_replica(self, version=None):
-        """Scale up: build a new replica from the predictor factory
-        (fresh index, never reused) and start its worker.  Returns the
-        new replica's index."""
+    def set_factory(self, predictor_factory):
+        """Replace the predictor factory future ``add_replica`` calls
+        build from.  The rollout controller points it at the converged
+        registry version so post-rollout scale-ups serve the program
+        their ``version`` tag claims."""
+        self._factory = predictor_factory
+
+    def add_replica(self, version=None, predictor=None):
+        """Scale up: start a new replica worker (fresh index, never
+        reused) and return its index.  The predictor comes from, in
+        order: ``predictor`` (a prebuilt/prewarmed one), the
+        ``version``'s own loader when it has one (a registry
+        ModelVersion — the tag must describe the program actually
+        served, never a stale factory), else the pool factory."""
         with self._lock:
             idx = self._next_index
             self._next_index += 1
-        rep = Replica(idx, self._factory(idx),
+        if predictor is None:
+            make = getattr(version, "make_predictor", None)
+            predictor = make() if callable(make) else self._factory(idx)
+        rep = Replica(idx, predictor,
                       breaker_threshold=self._breaker_threshold,
                       breaker_cooldown_s=self._breaker_cooldown)
         rep.version = version
@@ -469,29 +482,38 @@ class ReplicaPool:
                         batch = self.dispatch.get(timeout=0.01)
                     except queue_mod.Empty:
                         continue
+                # busy is raised BEFORE the paused re-check: a quiesce
+                # that sets paused concurrently either sees busy and
+                # waits, or set paused early enough that this re-check
+                # observes it and requeues — swap_program can never
+                # overlap run() (the TOCTOU the old post-take order
+                # left open)
+                rep.busy = True
                 if rep.paused or rep.retired:
                     # pause raced the take: hand the batch on rather
                     # than run it — the quiesce contract is "no NEW
                     # batch starts after pause"
+                    rep.busy = False
                     self._retry.put(batch)
                     continue
                 if not rep.available():
                     # breaker open: hand the batch to a healthier
                     # replica; brief sleep avoids a requeue spin when
                     # every breaker is open
+                    rep.busy = False
                     self._retry.put(batch)
                     time.sleep(0.005)
                     continue
                 if batch.all_expired():
                     # every rider's deadline passed while queued: shed
                     # without compute, typed replies
+                    rep.busy = False
                     self._count(shed_expired_batches=1)
                     batch.fail_all(DeadlineExpiredError(
                         "batch expired before execution"))
                     continue
                 with self._lock:
                     self._in_flight += 1
-                rep.busy = True
                 t0 = time.perf_counter()
                 try:
                     outs = rep.run(batch)

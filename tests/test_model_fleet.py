@@ -244,6 +244,92 @@ def test_weighted_fair_dequeue_shares_and_no_starvation():
     _ = hot, light
 
 
+def test_quota_max_outstanding_atomic_under_concurrent_submits():
+    """The outstanding-slot check RESERVES atomically: a burst of
+    concurrent submits for one tenant can never exceed the cap (the
+    old check-then-increment ran under separate lock acquisitions)."""
+    ac = serving.AdmissionController(
+        capacity=64, default_deadline_s=10.0,
+        quotas={"t": serving.TenantQuota(max_outstanding=4)})
+    feeds = {"x": np.zeros((1, 2), np.float32)}
+    admitted, shed = [], []
+    barrier = threading.Barrier(16)
+
+    def submit_one():
+        barrier.wait()
+        try:
+            admitted.append(ac.submit(feeds, tenant="t"))
+        except serving.QuotaExceededError:
+            shed.append(1)
+
+    threads = [threading.Thread(target=submit_one)
+               for _ in range(16)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=5.0)
+    assert len(admitted) == 4 and len(shed) == 12
+    assert ac._tenant_outstanding["t"] == 4
+    for r in admitted:
+        r.complete([np.zeros((1, 1))])
+    assert "t" not in ac._tenant_outstanding
+
+
+def test_quota_reservation_released_on_later_rejection():
+    """A submit that passes the max_outstanding reservation but is
+    rejected later (QPS bucket empty, queue full, malformed feeds)
+    releases the reserved slot — rejected requests never consume the
+    tenant's outstanding budget."""
+    feeds = {"x": np.zeros((1, 2), np.float32)}
+    # QPS-token rejection after the slot was reserved
+    ac = serving.AdmissionController(
+        capacity=8, default_deadline_s=10.0,
+        quotas={"t": serving.TenantQuota(max_outstanding=2,
+                                         qps=0.001, burst=1)})
+    r1 = ac.submit(feeds, tenant="t")        # takes slot + the token
+    with pytest.raises(serving.QuotaExceededError):
+        ac.submit(feeds, tenant="t")         # token empty
+    assert ac._tenant_outstanding["t"] == 1  # reservation released
+    r1.complete([np.zeros((1, 1))])
+    assert "t" not in ac._tenant_outstanding
+    # queue-full and malformed-feeds rejections after the reservation
+    ac2 = serving.AdmissionController(
+        capacity=1, default_deadline_s=10.0,
+        quotas={"t": serving.TenantQuota(max_outstanding=4)})
+    r2 = ac2.submit(feeds, tenant="t")
+    with pytest.raises(serving.OverloadedError):
+        ac2.submit(feeds, tenant="t")        # queue full (capacity 1)
+    assert ac2._tenant_outstanding["t"] == 1
+    with pytest.raises(ValueError):
+        ac2.submit({}, tenant="t")           # malformed: zero feeds
+    assert ac2._tenant_outstanding["t"] == 1
+    r2.complete([np.zeros((1, 1))])
+    assert "t" not in ac2._tenant_outstanding
+
+
+def test_wfq_lane_state_bounded_by_backlog():
+    """Emptied lanes (and their virtual-time entries) are pruned on
+    pop, and the per-tenant counter dict is bounded: past
+    MAX_TENANT_KEYS new tenant keys aggregate under the overflow key
+    instead of growing process memory per one-shot tenant."""
+    ac = serving.AdmissionController(capacity=256,
+                                     default_deadline_s=30.0)
+    feeds = {"x": np.zeros((1, 2), np.float32)}
+    n_tenants = serving.AdmissionController.MAX_TENANT_KEYS + 40
+    reqs = [ac.submit(feeds, tenant="t%03d" % i)
+            for i in range(n_tenants)]
+    while ac.take(timeout=0.05) is not None:
+        pass
+    assert ac._lanes == {} and ac._vtime == {}       # lanes pruned
+    tc = ac.tenant_counters()
+    assert len(tc) <= serving.AdmissionController.MAX_TENANT_KEYS + 1
+    over = tc[serving.AdmissionController.OVERFLOW_TENANT]
+    assert over["submitted"] == 40                   # overflow lumped
+    for r in reqs:
+        r.complete([np.zeros((1, 1))])
+    assert ac._tenant_outstanding == {}
+
+
 def test_default_lane_fifo_unchanged():
     """Without tenants the controller is exact FIFO — the pre-fleet
     contract."""
@@ -423,6 +509,146 @@ def test_rollout_rollback_under_chaos_plan(tmp_path):
             assert st["accounted"] and st["outstanding"] == 0
             assert {r.index: r.predictor.program_fingerprint()
                     for r in srv.pool.replicas} == old_fps
+
+
+def test_rollout_converges_with_replica_added_mid_rollout(tmp_path):
+    """A replica the autoscaler adds MID-rollout (not in the snapshot,
+    still serving the OLD program) is caught up — prewarm-and-swapped
+    — instead of forcing a spurious full rollback."""
+    d1 = _build_model(tmp_path, hidden=16)
+    d2 = _build_model(tmp_path, hidden=24)
+    reg = serving.ModelRegistry()
+    reg.register("m", d1)
+    v2 = reg.register("m", d2)
+    cfg = serving.ServingConfig(n_replicas=2, max_batch=4,
+                                default_deadline_s=10.0)
+    with serving.InferenceServer(_factory(d1), cfg) as srv:
+
+        class AddsReplicaOnFirstPoll:
+            """Simulates a concurrent autoscaler scale-up: the burn
+            poll after the FIRST swap adds an old-version replica."""
+
+            def __init__(self):
+                self.added = False
+
+            def observe(self):
+                if not self.added:
+                    self.added = True
+                    srv.pool.add_replica()     # pre-rollout factory
+                return {}
+
+            def firing(self):
+                return []
+
+        rc = serving.RolloutController(srv, reg,
+                                       monitor=AddsReplicaOnFirstPoll())
+        res = rc.rollout("m")
+        assert res.converged
+        assert res.swapped == 3        # 2 snapshotted + 1 late joiner
+        live = [r for r in srv.pool.replicas
+                if r.alive and not r.retired]
+        assert len(live) == 3
+        for r in live:
+            assert r.predictor.program_fingerprint() == \
+                v2.serving_fingerprint
+            assert r.version is v2
+        srv.infer({"x": np.ones((1, 8), np.float32)})
+
+
+def test_scale_up_after_rollout_serves_new_version(tmp_path):
+    """A post-rollout scale-up builds the replica FROM the converged
+    registry version (not the pre-rollout factory): its program
+    fingerprint matches the version its tag claims — never a
+    mixed-version fleet."""
+    d1 = _build_model(tmp_path, hidden=16)
+    d2 = _build_model(tmp_path, hidden=24)
+    reg = serving.ModelRegistry()
+    reg.register("m", d1)
+    v2 = reg.register("m", d2)
+    cfg = serving.ServingConfig(n_replicas=1, max_batch=4,
+                                default_deadline_s=10.0)
+    with serving.InferenceServer(_factory(d1), cfg) as srv:
+        res = serving.RolloutController(srv, reg).rollout("m")
+        assert res.converged
+        sc = serving.SLOAutoscaler(
+            srv, _EvalMonitor([_hot()]), min_replicas=1,
+            max_replicas=3, up_consecutive=1, down_consecutive=8,
+            cooldown_s=0.0)
+        assert sc.evaluate() == "up"
+        new_rep = srv.pool.replicas[-1]
+        assert new_rep.version is v2
+        assert new_rep.predictor.program_fingerprint() == \
+            v2.serving_fingerprint
+        # the bare pool-level path (no autoscaler prewarm in hand)
+        # resolves the predictor from the version tag too
+        idx = srv.pool.add_replica(version=v2)
+        assert srv.pool.replica(idx).predictor \
+            .program_fingerprint() == v2.serving_fingerprint
+        oracle, = v2.prewarm(buckets=(1,)).run(
+            [np.ones((1, 8), np.float32)])
+        out, = srv.infer({"x": np.ones((1, 8), np.float32)})
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(oracle))
+
+
+def test_quiesce_never_overlaps_inflight_run(tmp_path):
+    """swap_program's 'no run() in flight' contract holds under
+    repeated swaps with live traffic: the worker raises ``busy``
+    BEFORE its post-take pause re-check, so a quiesce can never
+    observe busy==False while a batch is about to execute."""
+    d1 = _build_model(tmp_path, hidden=16)
+    d2 = _build_model(tmp_path, hidden=24)
+    cfg = serving.ServingConfig(n_replicas=1, max_batch=2,
+                                default_deadline_s=20.0,
+                                queue_capacity=64)
+    with serving.InferenceServer(_factory(d1), cfg) as srv:
+        rep = srv.pool.replicas[0]
+        flag = {"running": False, "overlaps": 0}
+        orig_run = rep.predictor.run
+        orig_swap = rep.predictor.swap_program
+
+        def run(feeds):
+            flag["running"] = True
+            try:
+                time.sleep(0.001)
+                return orig_run(feeds)
+            finally:
+                flag["running"] = False
+
+        def swap_program(source):
+            if flag["running"]:
+                flag["overlaps"] += 1
+            return orig_swap(source)
+
+        rep.predictor.run = run
+        rep.predictor.swap_program = swap_program
+        other = inference.create_predictor(inference.Config(d2))
+        stop = threading.Event()
+        futures = []
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    futures.append(
+                        srv.submit({"x": np.ones((1, 8), np.float32)}))
+                except serving.ServingError:
+                    pass
+                time.sleep(0.001)
+
+        th = threading.Thread(target=pump, daemon=True)
+        th.start()
+        try:
+            source = other
+            for _ in range(30):
+                source, _ = srv.pool.swap_predictor(0, source)
+        finally:
+            stop.set()
+            th.join(timeout=5.0)
+        assert flag["overlaps"] == 0
+        for f in futures:
+            f.result(timeout=20.0)
+        st = srv.stats()
+        assert st["accounted"] and st["outstanding"] == 0
 
 
 # ---------------------------------------------------------------------------
