@@ -106,7 +106,7 @@ def _fresh_programs():
     # (the lowering gate builds several workloads per process); a
     # fresh build must never inherit them
     penv.reset()
-    set_flags({"gspmd": False})
+    set_flags({"gspmd": False, "serving_sharded": False})
 
 
 def _resnet50_train_flops_per_image():
@@ -1079,11 +1079,98 @@ def bench_longctx_train(batch=1, heads=8, seq=32768, head_dim=64,
     return res
 
 
+def _build_serving_tp_sharded(batch=8, in_dim=256, hidden=1024,
+                              depth=3, out_dim=256, tp=2):
+    """Build the tp-sharded serving-inference step (ISSUE 14): an fc
+    chain annotated COLUMN-parallel over a dp1 x tp mesh slice
+    (parallel/gspmd.annotate_tp_inference — every weight dim-sharded
+    on its output dim, contractions full-width so sharded output is
+    bit-identical to unsharded) compiled as ONE jit with in/out
+    NamedShardings through CompiledProgram.with_sharding_rules — the
+    exact graph a mesh-sliced ReplicaPool replica serves.  Returns
+    (fn, state, feed, aux); shared with tools/tpu_lowering_check.py
+    so the gate cross-lowers exactly the program the bench times.
+    tp clamps to the device count (1-device degrade keeps the leg an
+    honest liveness check everywhere)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, layers
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.parallel.gspmd import (MeshPlan,
+                                           annotate_tp_inference,
+                                           partition_spec_of)
+
+    _fresh_programs()
+    set_flags({"serving_sharded": True})
+    try:
+        x = layers.data("x", shape=[in_dim], dtype="float32")
+        h = x
+        for _ in range(int(depth)):
+            h = layers.fc(h, size=hidden, act="relu")
+        pred = layers.fc(h, size=out_dim)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(framework.default_startup_program())
+        infer_prog = framework.default_main_program().clone(
+            for_test=True)
+        ndev = len(jax.devices())
+        tp_eff = max(1, min(int(tp), ndev))
+        plan = MeshPlan(dp=1, tp=tp_eff)
+        annotated = annotate_tp_inference(infer_prog, plan)
+        mesh = plan.build_mesh(devices=jax.devices()[:tp_eff])
+        compiled = fluid.CompiledProgram(infer_prog) \
+            .with_inference_optimize()
+
+        def rule(name, shape):
+            var = infer_prog.global_block().vars.get(name)
+            if var is None:
+                return None
+            return partition_spec_of(var, plan, shape=shape)
+
+        compiled.with_sharding_rules(rule, mesh=mesh)
+        rng = np.random.RandomState(0)
+        feed = {"x": jnp.asarray(
+            rng.rand(batch, in_dim).astype(np.float32))}
+        fn, state = _build_compiled_fn(compiled, feed, [pred.name])
+        aux = {"annotated": annotated, "tp": tp_eff,
+               "fetch": pred.name}
+        return fn, state, feed, aux
+    finally:
+        set_flags({"serving_sharded": False})
+
+
+def bench_serving_tp_sharded(batch=8, in_dim=256, hidden=1024,
+                             depth=3, out_dim=256, tp=2, chain=30):
+    """Mesh-sliced serving replica leg (ISSUE 14): latency of the
+    tp-sharded inference step — every fc weight dim-sharded
+    column-parallel across the slice, activations all-gathered
+    between layers by the XLA SPMD partitioner.  On a single chip the
+    mesh degrades to tp1 (the row then prices the sharded compile
+    path ≈ parity); a multi-chip window banks the real above-one-HBM
+    serving row.  Compare against the unsharded serving_load
+    time-per-batch at the same shape: the per-layer all-gather is
+    the price of fitting the model, the verdict is how small it is."""
+    import jax
+
+    fn, state, feed, aux = _build_serving_tp_sharded(
+        batch=batch, in_dim=in_dim, hidden=hidden, depth=depth,
+        out_dim=out_dim, tp=tp)
+    sec_per_step, _ = _chain_timed(fn, state, feed, aux["fetch"],
+                                   chain)
+    return {"ms_per_batch": round(sec_per_step * 1e3, 3),
+            "batch": batch, "in_dim": in_dim, "hidden": hidden,
+            "depth": depth, "out_dim": out_dim,
+            "tp": aux["tp"], "devices": len(jax.devices()),
+            "serving_sharded": True,
+            "annotated_params": len(aux["annotated"])}
+
+
 def _build_llm_decode(streams=8, prefill_len=128, gen_tokens=64,
                       heads=8, head_dim=128, page_size=128,
                       vocab=32000, kv_int8=False, head_pack=False,
                       dtype=None, seed=0, impl=None, spec_k=0,
-                      prefix_share=0):
+                      prefix_share=0, disagg=False):
     """Build ONE jitted continuous-decode step (ISSUE 7): token embed +
     qkv projections + the paged KV append scatter + flash_decode over
     the block-table page pool + the output projection + greedy argmax —
@@ -1111,7 +1198,17 @@ def _build_llm_decode(streams=8, prefill_len=128, gen_tokens=64,
     one page set, written once, in every block table — the
     serving-side radix-tree outcome expressed as static tables), so
     the pool holds shared + per-stream-tail pages instead of
-    streams x full-length (rounded down to full pages)."""
+    streams x full-length (rounded down to full pages).
+
+    disagg=True (ISSUE 14) lays the block tables out the way the
+    DISAGGREGATED prefill tier leaves them: pages allocated in
+    prefill-completion order, round-robin ACROSS streams, so each
+    stream's page list is strided through the pool instead of
+    contiguous — the fragmentation pattern page-list handoff
+    produces.  Same kernel, same shapes; the row prices the decode
+    sweep under handoff-fragmented tables vs the contiguous
+    llm_decode row (expect ~parity: the kernel gathers pages through
+    the table either way — banking that IS the evidence)."""
     import jax
     import jax.numpy as jnp
 
@@ -1132,8 +1229,14 @@ def _build_llm_decode(streams=8, prefill_len=128, gen_tokens=64,
     num_pages = n_sp + streams * mp
     tables_np = np.zeros((streams, n_sp + mp), np.int32)
     tables_np[:, :n_sp] = np.arange(n_sp, dtype=np.int32)[None, :]
-    tables_np[:, n_sp:] = n_sp + np.arange(
-        streams * mp, dtype=np.int32).reshape(streams, mp)
+    if disagg:
+        # handoff fragmentation: stream s owns pages s, s+streams,
+        # s+2*streams, ... (prefill-completion order round-robin)
+        tables_np[:, n_sp:] = n_sp + np.arange(
+            streams * mp, dtype=np.int32).reshape(mp, streams).T
+    else:
+        tables_np[:, n_sp:] = n_sp + np.arange(
+            streams * mp, dtype=np.int32).reshape(streams, mp)
     lens0 = (shared_tokens + rng.randint(
         max(1, prefill_len // 2), prefill_len + 1,
         size=streams)).astype(np.int32)
@@ -1240,6 +1343,7 @@ def _build_llm_decode(streams=8, prefill_len=128, gen_tokens=64,
            "kv_scales": kv_scales, "page_size": page_size,
            "kv_itemsize": jnp.dtype(store).itemsize,
            "num_pages": num_pages, "shared_tokens": shared_tokens,
+           "disagg": bool(disagg),
            # what the pool would need with every stream owning its
            # own copy of the shared prefix
            "unshared_pages": streams * (n_sp + mp)}
@@ -1249,7 +1353,8 @@ def _build_llm_decode(streams=8, prefill_len=128, gen_tokens=64,
 def bench_llm_decode(streams=64, prefill_len=128, gen_tokens=32,
                      heads=8, head_dim=128, page_size=128,
                      vocab=32000, kv_int8=False, head_pack=False,
-                     warmup=2, chain=None, prefix_share=0):
+                     warmup=2, chain=None, prefix_share=0,
+                     disagg=False):
     """LLM continuous-decode leg (ISSUE 7): tokens/s/chip and
     inter-token p50/p99 at `streams` concurrent ragged sequences,
     decoding through the paged KV-cache + flash_decode step.  Every
@@ -1268,7 +1373,7 @@ def bench_llm_decode(streams=64, prefill_len=128, gen_tokens=32,
         gen_tokens=gen_tokens + warmup, heads=heads,
         head_dim=head_dim, page_size=page_size, vocab=vocab,
         kv_int8=kv_int8, head_pack=head_pack,
-        prefix_share=prefix_share)
+        prefix_share=prefix_share, disagg=disagg)
     lens = aux["lens0"].copy()
     tables_np = aux["tables_np"]
     tables_dev = feed["tables"]
@@ -1323,6 +1428,11 @@ def bench_llm_decode(streams=64, prefill_len=128, gen_tokens=32,
         res["kv_int8"] = True
     if head_pack:
         res["head_pack"] = True
+    if disagg:
+        # ISSUE 14: decode throughput under handoff-fragmented block
+        # tables (pages strided across the pool in prefill-completion
+        # order) — the disaggregated tier's steady state
+        res["disagg"] = True
     if prefix_share:
         # the capacity win of prefix sharing (ISSUE 11b): one shared
         # page set in every table instead of per-stream copies —
@@ -1616,6 +1726,11 @@ _LEG_FUNCS = {
     # 1-device mesh — still the gspmd compile path, so the leg stays
     # an honest liveness check everywhere
     "tf_train_gspmd": "bench_transformer_train_gspmd",
+    # ISSUE 14: the tp-sharded serving-inference step (MeshPlan slice,
+    # column-parallel fc weights, one jit with in/out NamedShardings)
+    # — the graph a mesh-sliced ReplicaPool replica serves; degrades
+    # to tp1 on a single chip like tf_train_gspmd
+    "serving_tp_sharded": "bench_serving_tp_sharded",
     "bert_train": "bench_bert_train",
     "dfm_train": "bench_deepfm_train",
     "infer": "bench_resnet50_infer",
@@ -1663,6 +1778,10 @@ _TINY = {
     # degraded CPU runs see 1 virtual device -> a 1x1 mesh; the leg
     # still exercises annotate/transpile/pjit-build liveness
     "tf_train_gspmd": dict(batch=2, seq=128, chain=2),
+    # degraded CPU runs see 1 device -> a tp1 mesh; the leg still
+    # exercises annotate/rule/sharded-jit-build liveness
+    "serving_tp_sharded": dict(batch=2, in_dim=16, hidden=32,
+                               depth=2, out_dim=16, chain=2),
     "bert_train": dict(batch=1, seq=128, chain=1),
     "dfm_train": dict(batch=256, chain=3),
     "infer": dict(batch=8, chain=3),
@@ -1757,7 +1876,7 @@ def _workload_sig(key, row):
     fam = re.sub(r"_(?:mb|seq|h|d|blk|str|spec_k)\d+", "", fam)
     fam = re.sub(r"_(?:s2d|convep|convbnstats|cmp_pool|bn1p|fastpath|"
                  r"packed|hp2|fusedadam|interlayer|int8kv|gspmd|"
-                 r"prefix_shared|chunked_join|tp\d+)(?=_|$)",
+                 r"prefix_shared|chunked_join|disagg|tp\d+)(?=_|$)",
                  "", fam)
     return (fam, row.get("batch"), row.get("seq"), row.get("heads"),
             row.get("head_dim"), bool(row.get("s2d_stem")),
@@ -1773,7 +1892,9 @@ def _workload_sig(key, row):
             row.get("spec_k"), row.get("prefix_shared"),
             bool(row.get("chunked_join")),
             bool(row.get("gspmd")), row.get("dp"), row.get("tp"),
-            row.get("devices"))
+            row.get("devices"),
+            bool(row.get("serving_sharded")),
+            bool(row.get("disagg")))
 
 
 def main():

@@ -132,6 +132,12 @@ def _program_fingerprint(program):
             return tuple(sorted((k, attr_key(x)) for k, x in v.items()))
         return v
 
+    def dtype_key(dt):
+        try:
+            return str(_np.dtype(dt))
+        except TypeError:
+            return str(dt)
+
     h = 0
     for b in program.blocks:
         # sharding annotations change the jitted step's in/out
@@ -141,6 +147,20 @@ def _program_fingerprint(program):
         for v in b.vars.values():
             if v.sharding is not None:
                 h = hash((h, "__sharding__", v.name, v.sharding))
+        # declared var shapes/dtypes are part of the program identity:
+        # two MLPs differing only in a layer WIDTH have identical op
+        # lists (the width lives on the VarDescs), and the model
+        # registry dedupes/verifies by this hash — a resized weight
+        # must read as a different program (ISSUE 14 registry
+        # persistence; found by the manifest-mismatch test)
+        for name in sorted(b.vars):
+            v = b.vars[name]
+            h = hash((
+                h, "__var__", name,
+                None if v.shape is None
+                else tuple(int(d) for d in v.shape),
+                None if v.dtype is None else dtype_key(v.dtype),
+                bool(v.persistable)))
         for op in b.ops:
             h = hash((
                 h, op.type, op.stage,

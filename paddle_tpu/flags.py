@@ -245,6 +245,38 @@ define_flag("tracing", False,
             "kernel entries and executor steps also emit "
             "jax.profiler annotations carrying the trace id "
             "(observability/device_trace.py, docs/OBSERVABILITY.md)")
+define_flag("serving_sharded", False,
+            "mesh-sliced serving replicas (ISSUE 14): False = every "
+            "serving replica is one whole-model predictor on one "
+            "device (default; the validated PR-6..13 pool, zero "
+            "behavior change — Predictor.shard() is a no-op and "
+            "ReplicaPool ignores its mesh_plan), True = a MeshPlan "
+            "describes an INFERENCE replica: ReplicaPool carves the "
+            "device set into plan-sized slices, each replica's "
+            "predictor tp-shards its fc weights COLUMN-parallel over "
+            "the slice (parallel/gspmd.py annotate_tp_inference -> "
+            "CompiledProgram.with_sharding_rules), so one pool serves "
+            "a model that doesn't fit one chip's HBM.  Column-only "
+            "(output-dim) splits keep every contraction full-width — "
+            "the sharded replica's outputs are bit-identical "
+            "(array_equal) to the unsharded predictor, asserted on "
+            "the tp2 CPU mesh (docs/SERVING.md, docs/GSPMD.md)")
+define_flag("disagg_prefill", False,
+            "disaggregated prefill/decode serving tiers (ISSUE 14): "
+            "False = the validated single-tier continuous-decode "
+            "engine (default; each decode replica prefills its own "
+            "joins — zero behavior change), True = "
+            "serving.DecodeServer splits into a PREFILL pool "
+            "(compute-bound: prompt projections + page writes) and a "
+            "DECODE pool (BW-bound iteration loop) behind ONE "
+            "admission plane; a finished prefill hands its sequence "
+            "to the decode tier as a PAGE-LIST transfer — block-table "
+            "entries + per-page refcounts through "
+            "PagedKVCache.detach/adopt, never a full-KV tensor copy — "
+            "with typed HandoffError, deadline propagation across the "
+            "tier boundary, and exactly-once accounting when a "
+            "replica on either side dies mid-handoff "
+            "(docs/SERVING.md handoff state machine)")
 define_flag("int8_conv_algo", "conv",
             "conv2d_int8 lowering: 'conv' = integer "
             "conv_general_dilated; 'im2col' = pad/slice/concat + one "

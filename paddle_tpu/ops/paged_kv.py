@@ -65,6 +65,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import threading
 from collections import Counter
 
 import jax
@@ -104,6 +105,10 @@ _G_FRAG = _obs_metrics.gauge(
     "paddle_tpu_paged_kv_internal_frag_pct",
     "tail slack of live sequences' last pages as % of owned capacity, "
     "by cache index", max_series=64)
+_G_TRANSIT = _obs_metrics.gauge(
+    "paddle_tpu_paged_kv_pages_in_transit",
+    "pages held by detached handoff handles (prefill -> decode tier "
+    "transfer, ISSUE 14), by cache index", max_series=64)
 
 _INT8_BOUND = 127.0  # mirrors ops/quant.py _quantize bit_length=8
 
@@ -228,6 +233,17 @@ class PagedKVCache:
         self._radix_cursor = {}
         self._peak_in_use = 0
         self._peak_shared = 0
+        # detached page-list handoffs (ISSUE 14): handle id ->
+        # {"pages": [...], "length": n}.  Pages in transit are OWNED
+        # (never on the free list) but belong to no slot — the
+        # disaggregated prefill->decode transfer window.  The
+        # accounting invariant counts them as in-use.
+        self._in_transit = {}
+        self._handoff_ids = itertools.count(1)
+        # tier-shared pools (disaggregated serving) mutate this cache
+        # from prefill AND decode workers; single-tier callers pay one
+        # uncontended RLock acquire per op
+        self.lock = threading.RLock()
         self._label = str(next(_CACHE_INDEX))
 
     # -- geometry -----------------------------------------------------------
@@ -344,9 +360,11 @@ class PagedKVCache:
         self._export_gauges()
 
     def reset(self):
-        """Drop every sequence (replica relaunch path)."""
+        """Drop every sequence AND in-transit handoff (replica
+        relaunch path)."""
         for slot in list(self._live):
             self.free(slot)
+        self.release_in_transit()
 
     def fork(self, slot):
         """Beam fork (ISSUE 11b): a NEW slot sharing every page of
@@ -401,6 +419,102 @@ class PagedKVCache:
         if dropped:
             _M_PAGES.inc(len(dropped), event="rewind")
         self._export_gauges()
+
+    # -- page-list handoff (disaggregated prefill -> decode, ISSUE 14) ------
+    def detach(self, slot):
+        """Detach a live sequence into a PAGE-LIST handoff handle: the
+        slot id is released but its pages stay owned (refcounts
+        unchanged — the handle holds the slot's references), parked in
+        the in-transit set until ``adopt`` re-attaches them to a new
+        slot or ``release_in_transit`` frees them.
+
+        This is the disaggregated prefill->decode transfer: the handle
+        carries ONLY host metadata — the physical page ids (the
+        block-table entries) and the token length — never K/V bytes.
+        Zero device copies on this path (the pool arrays are untouched;
+        asserted by the handoff tests via array identity)."""
+        if slot not in self._live:
+            raise KeyError("slot %r is not live" % (slot,))
+        pages = self._pages_of.pop(slot)
+        length = int(self._lens[slot])
+        self._live.discard(slot)
+        self._tables[slot, :] = 0
+        self._lens[slot] = 0
+        self._radix_cursor.pop(slot, None)
+        self._free_slots.append(slot)
+        hid = next(self._handoff_ids)
+        self._in_transit[hid] = {"pages": list(pages),
+                                 "length": length}
+        handle = {"id": hid, "pages": list(pages), "length": length}
+        _M_PAGES.inc(len(pages), event="detach")
+        _flight.record("paged_kv", "detach", slot=int(slot),
+                       handoff=hid, pages=len(pages), tokens=length)
+        self._export_gauges()
+        return handle
+
+    def adopt(self, handle):
+        """Adopt an in-transit page list onto a fresh slot (the decode
+        tier's side of the handoff): block-table entries reinstated,
+        length restored, refcounts untouched — the handle's references
+        become the slot's.  Raises OutOfPagesError (handle STAYS in
+        transit — the caller may retry or release) when no sequence
+        slot is free or the list exceeds the table width; KeyError for
+        an unknown/already-settled handle."""
+        hid = handle["id"] if isinstance(handle, dict) else int(handle)
+        ent = self._in_transit.get(hid)
+        if ent is None:
+            raise KeyError("handoff %r is not in transit" % (hid,))
+        pages = ent["pages"]
+        if len(pages) > self.max_pages_per_seq:
+            _M_OOP.inc()
+            raise OutOfPagesError(
+                "handoff of %d pages exceeds max_pages_per_seq=%d"
+                % (len(pages), self.max_pages_per_seq))
+        if not self._free_slots:
+            _M_OOP.inc()
+            raise OutOfPagesError("no free sequence slot (max_seqs=%d)"
+                                  % self.max_seqs)
+        del self._in_transit[hid]
+        slot = self._take_slot()
+        self._pages_of[slot] = list(pages)
+        self._tables[slot, :len(pages)] = np.asarray(pages, np.int32)
+        self._lens[slot] = ent["length"]
+        _M_PAGES.inc(len(pages), event="adopt")
+        _flight.record("paged_kv", "adopt", slot=int(slot),
+                       handoff=hid, pages=len(pages),
+                       tokens=ent["length"])
+        self._export_gauges()
+        return slot
+
+    def release_in_transit(self, handle=None):
+        """Drop an in-transit handle's page references (the
+        kill-mid-handoff / expiry abort path) — pages whose refcount
+        reaches zero return to the free list, exactly like ``free``.
+        With no argument, releases EVERY in-transit handle (server
+        stop sweep).  Returns the number of pages freed."""
+        if handle is None:
+            n = 0
+            for hid in list(self._in_transit):
+                n += self.release_in_transit(hid)
+            return n
+        hid = handle["id"] if isinstance(handle, dict) else int(handle)
+        ent = self._in_transit.pop(hid, None)
+        if ent is None:
+            return 0
+        n_freed = 0
+        for pid in ent["pages"]:
+            if self._deref_page(pid):
+                n_freed += 1
+        _M_PAGES.inc(n_freed, event="free")
+        _flight.record("paged_kv", "handoff_released", handoff=hid,
+                       pages=len(ent["pages"]))
+        self._export_gauges()
+        return n_freed
+
+    def in_transit_pages(self):
+        """Pages currently held by detached handoff handles."""
+        return sum(len(e["pages"])
+                   for e in self._in_transit.values())
 
     # -- prefix sharing (radix tree over full pages) ------------------------
     @staticmethod
@@ -779,10 +893,16 @@ class PagedKVCache:
         independently from the tables."""
         return self.num_pages - len(self._free_pages)
 
+    def _holder_page_lists(self):
+        """Every holder's page list: live slots + in-transit handoff
+        handles (a handle holds references exactly like a slot)."""
+        return list(self._pages_of.values()) + \
+            [e["pages"] for e in self._in_transit.values()]
+
     def in_use_pages(self):
-        """UNIQUE pages owned by live sequences (the generalized
-        invariant counts each shared page once)."""
-        return len({p for pages in self._pages_of.values()
+        """UNIQUE pages owned by live sequences or in-transit handoffs
+        (the generalized invariant counts each shared page once)."""
+        return len({p for pages in self._holder_page_lists()
                     for p in pages})
 
     def shared_pages(self):
@@ -804,6 +924,7 @@ class PagedKVCache:
         _G_FRAG.set(
             round(100.0 * (cap - live_tokens) / cap, 2) if cap
             else 0.0, cache=self._label)
+        _G_TRANSIT.set(self.in_transit_pages(), cache=self._label)
         del owned
 
     def stats(self):
@@ -812,10 +933,12 @@ class PagedKVCache:
         every pool page is either free or held by >= 1 live sequence,
         each shared page counted ONCE, and every page's refcount
         equals the number of holding sequences."""
-        owned = [p for pages in self._pages_of.values() for p in pages]
+        owned = [p for pages in self._holder_page_lists()
+                 for p in pages]
         cnt = Counter(owned)
         in_use = len(cnt)
-        live_tokens = int(sum(self._lens[s] for s in self._live))
+        live_tokens = int(sum(self._lens[s] for s in self._live)) \
+            + sum(e["length"] for e in self._in_transit.values())
         capacity = len(owned) * self.page_size
         ref_ok = all(int(self._ref[p]) == c for p, c in cnt.items()) \
             and int((self._ref > 0).sum()) == in_use
@@ -824,6 +947,8 @@ class PagedKVCache:
             "page_size": self.page_size,
             "free_pages": self.free_pages(),
             "in_use_pages": in_use,
+            "in_transit_pages": self.in_transit_pages(),
+            "in_transit_handoffs": len(self._in_transit),
             "shared_pages": sum(1 for c in cnt.values() if c > 1),
             "logical_pages": len(owned),
             "peak_in_use_pages": self._peak_in_use,
@@ -852,7 +977,8 @@ class PagedKVCache:
                               st["num_pages"],
                               st["free_pages"] + st["in_use_pages"]
                               == st["num_pages"]))
-        owned = {p for pages in self._pages_of.values() for p in pages}
+        owned = {p for pages in self._holder_page_lists()
+                 for p in pages}
         both = owned & set(self._free_pages)
         if both:
             return False, "pages both free and owned: %s" % sorted(both)

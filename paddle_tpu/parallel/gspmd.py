@@ -38,8 +38,8 @@ from __future__ import annotations
 from typing import Optional
 
 __all__ = ["MeshPlan", "annotate_var", "annotate_zero3",
-           "annotate_tp_transformer", "tag_attention_ops",
-           "partition_spec_of"]
+           "annotate_tp_transformer", "annotate_tp_inference",
+           "tag_attention_ops", "partition_spec_of", "carve_slices"]
 
 
 class MeshPlan:
@@ -309,6 +309,162 @@ def annotate_tp_transformer(program, plan, axis="tp"):
                 var.set_sharding((axis,))
                 out["column"].append(name)
     return out
+
+
+def carve_slices(devices, slice_size):
+    """Partition a flat device list into consecutive ``slice_size``
+    groups — the mesh slices a sharded ReplicaPool hands one replica
+    each (ISSUE 14).  Consecutive carving matters on real topologies:
+    jax.devices() orders by (host, chip) so a slice stays within one
+    host/ICI domain whenever the size divides it.  Leftover devices
+    (len % slice_size) are unused — a partial slice can't hold the
+    plan.  Raises when not even one slice fits."""
+    devices = list(devices)
+    slice_size = int(slice_size)
+    if slice_size < 1:
+        raise ValueError(f"slice_size {slice_size} < 1")
+    n = len(devices) // slice_size
+    if n < 1:
+        raise ValueError(
+            f"{len(devices)} devices cannot hold one slice of "
+            f"{slice_size} (size the MeshPlan to the fleet)")
+    return [devices[i * slice_size:(i + 1) * slice_size]
+            for i in range(n)]
+
+
+# IR ops whose output carries its input's feature sharding unchanged
+# (elementwise / shape-preserving): the column-parallel chain analysis
+# may look THROUGH them.  Anything else consuming a feature-sharded
+# activation (softmax over the sharded dim, pooling, reshapes) is a
+# gather point and de-annotates its producer.
+_TP_INFER_PASSTHROUGH = ("relu", "tanh", "sigmoid", "elementwise_add",
+                         "fused_elemwise_activation", "scale",
+                         "dropout")
+# ops that consume activations against a 2-D persistable weight
+_TP_INFER_MATMUL = ("mul", "matmul", "fc")
+
+
+def _infer_fc_nodes(block):
+    """(op, weight_var, bias_var_or_None, out_name) per fc-shaped op
+    in the block — both the raw mul(+elementwise_add bias) form and
+    the ir_optim-fused ``fc`` op."""
+    nodes = []
+    for i, op in enumerate(block.ops):
+        if op.type in ("mul", "matmul"):
+            wname = op.inputs.get("Y", [None])[0]
+        elif op.type == "fc":
+            wname = op.inputs.get("W", [None])[0]
+        else:
+            continue
+        if wname is None:
+            continue
+        w = block.vars.get(wname)
+        if w is None or not w.persistable or w.shape is None or \
+                len(w.shape) != 2:
+            continue
+        out = op.outputs["Out"][0]
+        bias = None
+        if op.type == "fc":
+            bnames = op.inputs.get("Bias", [])
+            bias = block.vars.get(bnames[0]) if bnames else None
+        else:
+            # the raw form: a following elementwise_add with a 1-D
+            # persistable Y of the weight's output width is the bias
+            for later in block.ops[i + 1:]:
+                if later.type == "elementwise_add" and \
+                        later.inputs.get("X", [None])[0] == out:
+                    cand = block.vars.get(
+                        later.inputs.get("Y", [None])[0])
+                    if cand is not None and cand.persistable and \
+                            cand.shape is not None and \
+                            len(cand.shape) == 1 and \
+                            int(cand.shape[0]) == int(w.shape[1]):
+                        bias = cand
+                    break
+        nodes.append((op, w, bias, out))
+    return nodes
+
+
+def annotate_tp_inference(program, plan, axis="tp"):
+    """Column-parallel tp PartitionSpecs on an INFERENCE program's fc
+    layers (ISSUE 14 — the sharded serving replica): every fc-shaped
+    weight (raw ``mul`` or ir_optim-fused ``fc``) whose output dim
+    divides the tp axis gets ``(None, axis)`` and its bias ``(axis,)``.
+
+    Column-ONLY on purpose: an output-dim split keeps every matmul's
+    contraction full-width (XLA all-gathers the activation between
+    sharded layers instead of summing partial products), so the
+    sharded replica's outputs are BIT-IDENTICAL (array_equal) to the
+    unsharded predictor — the serving parity contract.  The Megatron
+    column/row interleave (fewer gathers, partial-sum all-reduce,
+    allclose-tight) stays opt-in via ``annotate_tp_transformer``.
+
+    The bit-exactness guarantee needs the whole downstream chain to
+    hold: a sharded activation reaching an UNSHARDED matmul would make
+    XLA sum partial products over the sharded contraction.  So after
+    the greedy pass, any annotated weight whose output chain (through
+    elementwise pass-through ops) reaches an unannotated matmul — or
+    any non-pass-through consumer — is DE-annotated, to a fixpoint.
+    Returns the annotated weight/bias names."""
+    nshard = plan.axis_size(axis)
+    if nshard <= 1:
+        return []
+    block = program.global_block()
+    nodes = _infer_fc_nodes(block)
+    sharded = {}           # weight name -> (w, bias, out)
+    for op, w, bias, out in nodes:
+        if int(w.shape[1]) % nshard == 0 and \
+                (bias is None or int(bias.shape[0]) % nshard == 0):
+            sharded[w.name] = (w, bias, out)
+    matmul_weight_of = {}  # activation name -> consuming weight name
+    for op, w, bias, out in nodes:
+        xkey = "Input" if op.type == "fc" else "X"
+        xin = op.inputs.get(xkey, [None])[0]
+        if xin is not None:
+            matmul_weight_of.setdefault(xin, []).append(w.name)
+    consumers = {}         # var name -> [op]
+    for op in block.ops:
+        for names in op.inputs.values():
+            for n in names:
+                consumers.setdefault(n, []).append(op)
+
+    def chain_ok(out_name, seen):
+        """True iff every consumer of a feature-sharded activation is
+        a sharded matmul or a pass-through whose own chain holds."""
+        if out_name in seen:
+            return True
+        seen.add(out_name)
+        for op in consumers.get(out_name, ()):
+            if op.type in _TP_INFER_MATMUL:
+                wkey = "W" if op.type == "fc" else "Y"
+                wn = op.inputs.get(wkey, [None])[0]
+                if wn not in sharded:
+                    return False
+            elif op.type in _TP_INFER_PASSTHROUGH:
+                for onames in op.outputs.values():
+                    for on in onames:
+                        if not chain_ok(on, seen):
+                            return False
+            else:
+                return False       # unknown consumer = gather point
+        return True
+
+    changed = True
+    while changed:
+        changed = False
+        for wn in list(sharded):
+            _, _, out = sharded[wn]
+            if not chain_ok(out, set()):
+                del sharded[wn]
+                changed = True
+    annotated = []
+    for wn, (w, bias, _) in sorted(sharded.items()):
+        w.set_sharding((None, axis))
+        annotated.append(wn)
+        if bias is not None:
+            bias.set_sharding((axis,))
+            annotated.append(bias.name)
+    return annotated
 
 
 def tag_attention_ops(program, plan, batch_axis=None, head_axis=None):

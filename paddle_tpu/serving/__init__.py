@@ -18,7 +18,11 @@ Pieces (each its own module):
                    compile-once bucket cache, max-wait timer
   replica_pool.py  N predictor replicas, health probes, per-replica
                    circuit breakers, failover/requeue, NamedSharding
-                   param replication helper
+                   param replication helper; with a MeshPlan (flag
+                   ``serving_sharded``, ISSUE 14) the pool carves
+                   devices into mesh SLICES and each replica
+                   tp-shards its predictor across one slice — one
+                   pool serves a model above single-chip HBM
   server.py        InferenceServer / ServingConfig / drain()
   registry.py      ModelRegistry (ISSUE 13): named, versioned
                    programs riding the ProgramDesc serialization,
@@ -39,15 +43,22 @@ Pieces (each its own module):
                    decoding (spec_k) — with deadline-aware preemption
                    (docs/DECODE.md)
 
+                   Disaggregated prefill/decode tiers (flag
+                   ``disagg_prefill``, ISSUE 14) split DecodeServer
+                   into a prefill pool and a decode pool over ONE
+                   shared page pool, handing sequences across as
+                   page-list transfers (PagedKVCache.detach/adopt)
+
 Design + contracts: docs/SERVING.md.  Fault semantics are driven by
 distributed/faultinject.py (msg types ``serving_infer`` /
-``serving_health`` / ``serving_decode``) so every failure mode is
-seeded and replayable.
+``serving_health`` / ``serving_decode`` / ``serving_prefill``) so
+every failure mode is seeded and replayable.
 """
 
 from paddle_tpu.serving.admission import (
     AdmissionController,
     DeadlineExpiredError,
+    HandoffError,
     OverloadedError,
     QuotaExceededError,
     ReplicaFailedError,
@@ -71,12 +82,14 @@ from paddle_tpu.serving.replica_pool import (
 )
 from paddle_tpu.serving.decode_engine import (
     MSG_DECODE,
+    MSG_PREFILL,
     DecodeConfig,
     DecodeServer,
     TinyDecodeLM,
 )
 from paddle_tpu.serving.server import InferenceServer, ServingConfig
 from paddle_tpu.serving.registry import (
+    ManifestMismatchError,
     ModelNotFoundError,
     ModelRegistry,
     ModelVersion,
@@ -93,8 +106,9 @@ from paddle_tpu.serving.fleet import (
 
 __all__ = [
     "AdmissionController", "Batch", "DeadlineExpiredError",
-    "DecodeConfig", "DecodeServer", "InferenceServer", "MSG_DECODE",
-    "MSG_HEALTH", "MSG_INFER", "ModelNotFoundError", "ModelRegistry",
+    "DecodeConfig", "DecodeServer", "HandoffError", "InferenceServer",
+    "MSG_DECODE", "MSG_HEALTH", "MSG_INFER", "MSG_PREFILL",
+    "ManifestMismatchError", "ModelNotFoundError", "ModelRegistry",
     "ModelVersion", "OverloadedError", "PrewarmFailedError",
     "QuotaExceededError", "RegistryError", "Replica",
     "ReplicaFailedError", "ReplicaPool", "Request",

@@ -68,7 +68,7 @@ _M_TENANT = _obs_metrics.counter(
 __all__ = [
     "ServingError", "OverloadedError", "DeadlineExpiredError",
     "ShutdownError", "ReplicaFailedError", "QuotaExceededError",
-    "TenantQuota", "Request", "AdmissionController",
+    "HandoffError", "TenantQuota", "Request", "AdmissionController",
 ]
 
 
@@ -105,6 +105,17 @@ class ReplicaFailedError(ServingError):
     failover attempts exhausted)."""
 
     code = "failed"
+
+
+class HandoffError(ServingError):
+    """The prefill->decode page-list handoff failed terminally
+    (ISSUE 14): the transfer was lost/aborted more times than the
+    retry budget allows, or adoption found the handle gone.  A lost
+    handoff normally re-prefills transparently; this code surfaces
+    only when that fallback is exhausted — exactly-once still holds
+    (the reply is this typed error, never silence)."""
+
+    code = "handoff"
 
 
 class QuotaExceededError(ServingError):

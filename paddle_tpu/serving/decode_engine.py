@@ -61,6 +61,25 @@ default-off typed flag with the repo's bit-parity discipline:
     through the atomic free path — speculative greedy output is
     token-for-token identical to non-speculative greedy (asserted).
 
+Disaggregated prefill/decode tiers (ISSUE 14, flag
+``disagg_prefill``): the server splits into a PREFILL pool
+(compute-bound prompt projections + page writes;
+``n_prefill_replicas`` workers) and the decode pool behind the SAME
+admission plane, every decode replica reading ONE shared page pool.
+A finished prefill reaches the decode tier as a PAGE-LIST handoff
+(``PagedKVCache.detach``/``adopt`` — block-table entries + per-page
+refcounts, zero K/V device bytes moved), with a typed
+``HandoffError`` terminal code, deadline propagation across the tier
+boundary (expiry in transit releases the pages and answers typed),
+and exactly-once accounting when a replica on EITHER side dies
+mid-handoff: a prefill kill after allocation aborts the handoff and
+re-prefills on a survivor; a decode kill after adoption frees only
+its slots on the shared pool (never a wholesale reset) and the
+prefill tier re-prefills from token history.  Fault point
+``serving_prefill`` sits exactly in the post-allocation /
+pre-adoption window (``chaos_soak --mode disagg`` pins kills in both
+windows).  docs/SERVING.md has the handoff state machine.
+
 Model adapter protocol (duck-typed; ``TinyDecodeLM`` is the built-in
 used by tests, the load generator and the bench):
 
@@ -90,14 +109,19 @@ from paddle_tpu.observability.export import (MetricsHTTPServer,
 from paddle_tpu.ops.paged_kv import OutOfPagesError, PagedKVCache
 from paddle_tpu.serving.admission import (AdmissionController,
                                           DeadlineExpiredError,
+                                          HandoffError,
                                           ReplicaFailedError,
                                           ShutdownError)
 from paddle_tpu.serving.replica_pool import ReplicaKilled, ReplyLost
 
-__all__ = ["MSG_DECODE", "TinyDecodeLM", "DecodeConfig",
-           "DecodeServer"]
+__all__ = ["MSG_DECODE", "MSG_PREFILL", "TinyDecodeLM",
+           "DecodeConfig", "DecodeServer"]
 
 MSG_DECODE = "serving_decode"
+# disaggregated prefill tier (ISSUE 14): one faultinject decision per
+# prefill, consulted AFTER the pages are allocated and detached into
+# the handoff — the kill-mid-handoff window the chaos soak seeds
+MSG_PREFILL = "serving_prefill"
 
 _M_DECODE = _obs_metrics.counter(
     "paddle_tpu_decode_events_total",
@@ -115,6 +139,26 @@ _M_ACTIVE = _obs_metrics.gauge(
     "paddle_tpu_decode_active_seqs",
     "sequences in the running batch, by replica index",
     max_series=64)
+# disaggregated-tier instruments (ISSUE 14 satellite): handoff
+# outcomes + latency (exemplar-capable per PR 12 — the p99 bucket
+# names a sampled trace) + per-tier replica/page gauges, all embedded
+# in the serving_load / chaos_soak one-JSON-line outputs
+_M_HANDOFFS = _obs_metrics.counter(
+    "paddle_tpu_disagg_handoffs_total",
+    "prefill->decode page-list handoffs by outcome (offered / "
+    "adopted / lost / expired / orphaned / killed)")
+_M_HANDOFF_SECONDS = _obs_metrics.histogram(
+    "paddle_tpu_disagg_handoff_seconds",
+    "prefill-complete -> decode-adoption latency of page-list "
+    "handoffs")
+_G_TIER_REPLICAS = _obs_metrics.gauge(
+    "paddle_tpu_disagg_tier_replicas",
+    "live replicas per disaggregated tier (prefill / decode)",
+    max_series=8)
+_G_TIER_PAGES = _obs_metrics.gauge(
+    "paddle_tpu_disagg_pages",
+    "shared-pool page occupancy of the disaggregated server "
+    "(in_use / in_transit / free)", max_series=8)
 
 
 class TinyDecodeLM:
@@ -186,7 +230,8 @@ class DecodeConfig:
                  impl=None, metrics_port=None, trace_sample=None,
                  prefill_chunk=None, kv_share=None, spec_k=None,
                  draft_factory=None, preempt_slack_s=0.25,
-                 collector=None):
+                 collector=None, disagg_prefill=None,
+                 n_prefill_replicas=1):
         from paddle_tpu.flags import get_flag
 
         self.max_batch = int(max_batch)
@@ -250,6 +295,24 @@ class DecodeConfig:
 
             collector = collector_endpoint()
         self.collector = collector
+        # disaggregated prefill/decode tiers (ISSUE 14): None defers
+        # to the typed flag.  Off = the validated single-tier engine
+        # (zero behavior change).  On: every decode replica reads ONE
+        # shared page pool, prompt prefill runs on a separate
+        # compute-bound pool of n_prefill_replicas workers, and a
+        # finished prefill reaches the decode tier as a page-list
+        # handoff (PagedKVCache.detach/adopt — block-table entries +
+        # refcounts, zero K/V bytes moved)
+        self.disagg_prefill = bool(get_flag("disagg_prefill")) \
+            if disagg_prefill is None else bool(disagg_prefill)
+        self.n_prefill_replicas = int(n_prefill_replicas)
+        if self.n_prefill_replicas < 1:
+            raise ValueError("n_prefill_replicas must be >= 1")
+        if self.disagg_prefill and self.spec_k:
+            raise ValueError(
+                "disagg_prefill and spec_k are mutually exclusive "
+                "(the speculative verify window stays single-tier "
+                "for now — docs/SERVING.md)")
 
 
 class _Seq:
@@ -278,16 +341,54 @@ class _Seq:
         return self.prompt + self.generated
 
 
+class _PrefillReplica:
+    """One prefill-tier worker (ISSUE 14): a model adapter computing
+    prompt projections + page writes into the SHARED pool — the
+    compute-bound half of disaggregated serving.  No decode state; a
+    kill loses only the handoff in flight (aborted, pages freed,
+    sequence re-prefilled by a survivor)."""
+
+    __slots__ = ("index", "model", "alive", "busy", "prefills",
+                 "handoffs")
+
+    def __init__(self, index, model):
+        self.index = index
+        self.model = model
+        self.alive = True
+        self.busy = False
+        self.prefills = 0
+        self.handoffs = 0
+
+
+class _Handoff:
+    """One in-flight prefill->decode transfer: the sequence, the
+    detached page-list handle (host metadata only — physical page ids
+    + token length), and the offer timestamp the adoption-latency
+    histogram reads."""
+
+    __slots__ = ("seq", "handle", "offered_t")
+
+    def __init__(self, seq, handle, offered_t):
+        self.seq = seq
+        self.handle = handle
+        self.offered_t = offered_t
+
+
 class _DecodeReplica:
     """Model + paged cache (+ draft model and ITS paged cache under
-    spec_k) + the sequences currently riding it."""
+    spec_k) + the sequences currently riding it.  Under disaggregated
+    serving every decode replica shares ONE pool (``cache`` injected,
+    ``owns_cache`` False) so a prefill-tier page list is adoptable by
+    any of them with zero byte movement."""
 
-    def __init__(self, index, model, cfg, draft_model=None):
+    def __init__(self, index, model, cfg, draft_model=None,
+                 cache=None):
         self.index = index
         self.model = model
         self.cfg = cfg
         self.alive = True
-        self.cache = PagedKVCache(
+        self.owns_cache = cache is None
+        self.cache = cache if cache is not None else PagedKVCache(
             num_pages=cfg.num_pages, page_size=cfg.page_size,
             num_heads=model.num_heads, head_dim=model.head_dim,
             kv_int8=cfg.kv_int8, kv_share=cfg.kv_share)
@@ -324,9 +425,25 @@ class DecodeServer:
         # single-survivor-deadlock lesson (total sequences stay bounded
         # by admission capacity + max_batch * n_replicas)
         self._retry = BoundedQueue()
+        # disaggregated tiers (ISSUE 14): ONE shared page pool all
+        # decode replicas read and the prefill tier writes, so the
+        # handoff is a pure page-list move; the handoff queue is the
+        # tier boundary (unbounded — sequences in it already consumed
+        # admission capacity)
+        self._disagg = bool(cfg.disagg_prefill)
+        self._shared_cache = None
+        self._handoff_q = BoundedQueue()
+        if self._disagg:
+            probe_model = factory(0)
+            self._shared_cache = PagedKVCache(
+                num_pages=cfg.num_pages, page_size=cfg.page_size,
+                num_heads=probe_model.num_heads,
+                head_dim=probe_model.head_dim,
+                kv_int8=cfg.kv_int8, kv_share=cfg.kv_share)
         self.replicas = []
         for i in range(cfg.n_replicas):
-            model = factory(i)
+            model = probe_model if self._disagg and i == 0 \
+                else factory(i)
             draft = None
             if cfg.spec_k > 0:
                 # replicas must agree on the draft too: a failed-over
@@ -335,11 +452,24 @@ class DecodeServer:
                     else TinyDecodeLM(vocab=model.vocab, d_model=32,
                                       num_heads=2, head_dim=16,
                                       seed=0)
-            self.replicas.append(_DecodeReplica(i, model, cfg, draft))
+            self.replicas.append(_DecodeReplica(
+                i, model, cfg, draft, cache=self._shared_cache))
+        # prefill tier: model adapters at offset indices (the factory
+        # contract — same-seed TinyDecodeLM defaults agree with the
+        # decode tier, which failover re-prefill depends on)
+        self.prefill_replicas = []
+        if self._disagg:
+            self.prefill_replicas = [
+                _PrefillReplica(i, factory(cfg.n_replicas + i))
+                for i in range(cfg.n_prefill_replicas)]
         self._sup = Supervisor(restart_backoff=0.02, max_backoff=0.5)
         for rep in self.replicas:
             self._sup.add_worker("decode-%d" % rep.index,
                                  self._make_worker(rep),
+                                 restart=cfg.restart_dead)
+        for prep in self.prefill_replicas:
+            self._sup.add_worker("prefill-%d" % prep.index,
+                                 self._make_prefill_worker(prep),
                                  restart=cfg.restart_dead)
         self._meta = {}             # req.id -> max_new
         self._lock = threading.Lock()
@@ -347,7 +477,10 @@ class DecodeServer:
                           "prefills": 0, "prefill_chunks": 0,
                           "kills": 0, "step_faults": 0,
                           "failovers": 0, "preemptions": 0,
-                          "spec_proposed": 0, "spec_accepted": 0}
+                          "spec_proposed": 0, "spec_accepted": 0,
+                          "handoffs_offered": 0, "handoffs_adopted": 0,
+                          "handoffs_lost": 0, "handoffs_expired": 0,
+                          "prefill_kills": 0}
         self._step_ms = []          # bounded rolling inter-token record
         self.metrics_server = None
         self.collector_pusher = None
@@ -373,6 +506,7 @@ class DecodeServer:
                 self.collector_pusher = CollectorPusher(
                     self.config.collector, role="decode").start()
             self._sup.start()
+            self._export_tier_gauges()
         return self
 
     def __enter__(self):
@@ -474,22 +608,31 @@ class DecodeServer:
 
         return loop
 
+    def _next_seq(self):
+        """Pop the next sequence needing (re-)prefill: the failover /
+        preemption lane first, then fresh admissions."""
+        try:
+            return self._retry.get_nowait()
+        except queue_mod.Empty:
+            req = self.admission.take(timeout=0.0005)
+            if req is None:
+                return None
+            with self._lock:
+                max_new = self._meta.get(req.id,
+                                         self.config.max_new_tokens)
+            return _Seq(req, np.asarray(req.feeds["ids"]), max_new)
+
     def _admit(self, rep):
         """Join new + failed-over sequences into this replica's batch
-        (iteration-level batching: called every step)."""
+        (iteration-level batching: called every step).  Under
+        disaggregated serving the decode tier joins ONLY adopted
+        handoffs — raw admissions and re-prefills belong to the
+        prefill tier."""
+        if self._disagg:
+            return self._admit_handoffs(rep)
         cfg = self.config
         while len(rep.active) + len(rep.prefilling) < cfg.max_batch:
-            seq = None
-            try:
-                seq = self._retry.get_nowait()
-            except queue_mod.Empty:
-                req = self.admission.take(timeout=0.0005)
-                if req is not None:
-                    with self._lock:
-                        max_new = self._meta.get(
-                            req.id, cfg.max_new_tokens)
-                    seq = _Seq(req, np.asarray(req.feeds["ids"]),
-                               max_new)
+            seq = self._next_seq()
             if seq is None:
                 return
             now = time.monotonic()
@@ -527,6 +670,222 @@ class DecodeServer:
                            chunked=not ready)
             (rep.active if ready else rep.prefilling).append(seq)
 
+    # -- disaggregated tiers (ISSUE 14) -------------------------------------
+    def _admit_handoffs(self, rep):
+        """Decode-tier join: adopt offered page-list handoffs into
+        this replica's running batch.  Adoption is pure bookkeeping on
+        the shared pool (PagedKVCache.adopt — block-table entries
+        reinstated on a fresh slot, zero device bytes moved).  The
+        deadline PROPAGATES across the tier boundary: a handoff whose
+        request expired in transit is released (pages freed) and
+        answered with the typed expiry, never silently parked."""
+        cfg = self.config
+        while len(rep.active) < cfg.max_batch:
+            try:
+                h = self._handoff_q.get_nowait()
+            except queue_mod.Empty:
+                return
+            seq = h.seq
+            now = time.monotonic()
+            with rep.cache.lock:
+                if seq.req.done():
+                    rep.cache.release_in_transit(h.handle)
+                    self._count_handoff("orphaned")
+                    continue
+                if seq.req.expired(now):
+                    rep.cache.release_in_transit(h.handle)
+                    self._count_handoff("expired")
+                    self._count(handoffs_expired=1)
+                    seq.req.fail(DeadlineExpiredError(
+                        "request %s: deadline passed in the "
+                        "prefill->decode handoff" % seq.req.id))
+                    continue
+                try:
+                    seq.slot = rep.cache.adopt(h.handle)
+                except OutOfPagesError:
+                    # no free sequence slot right now: the handle
+                    # stays in transit, re-offered for a later
+                    # iteration / another replica
+                    self._handoff_q.put(h)
+                    return
+            seq.last_token = int(seq.history()[-1])
+            seq.last_emit_t = now
+            self._count(handoffs_adopted=1)
+            self._count_handoff("adopted", latency_s=now - h.offered_t,
+                                trace=seq.trace)
+            if _trace._tracer is not None:
+                sp = _trace._tracer.instant(
+                    "decode.adopt", parent=seq.trace,
+                    request_id=seq.req.id, replica=rep.index,
+                    pages=len(h.handle["pages"]),
+                    handoff_ms=round((now - h.offered_t) * 1e3, 3))
+                if seq.trace is not None:
+                    seq.trace = sp.ctx
+            _flight.record("decode", "handoff_adopted",
+                           request_id=seq.req.id, replica=rep.index,
+                           pages=len(h.handle["pages"]))
+            rep.active.append(seq)
+
+    def _make_prefill_worker(self, prep):
+        """Prefill-tier worker loop (ISSUE 14): take a sequence from
+        the retry lane / admission, write its prompt K/V into the
+        shared pool, detach the pages into a handoff, offer it to the
+        decode tier."""
+        def loop():
+            if not prep.alive and self.config.restart_dead:
+                prep.alive = True
+            while self._sup.running:
+                if not prep.alive:
+                    return
+                seq = self._next_seq()
+                if seq is None:
+                    time.sleep(0.001)
+                    continue
+                now = time.monotonic()
+                if seq.req.done():
+                    continue            # answered elsewhere
+                if seq.req.expired(now):
+                    seq.req.fail(DeadlineExpiredError(
+                        "request %s: deadline passed before prefill"
+                        % seq.req.id))
+                    continue
+                if seq.attempts >= self.config.max_attempts:
+                    seq.req.fail(HandoffError(
+                        "request %s: handoff/prefill failed after %d "
+                        "attempts" % (seq.req.id, seq.attempts)))
+                    continue
+                prep.busy = True
+                try:
+                    self._prefill_handoff(prep, seq)
+                finally:
+                    prep.busy = False
+        return loop
+
+    def _prefill_handoff(self, prep, seq):
+        """ONE prefill: project the prompt prefix, write it into the
+        shared pool, detach the page list, consult the fault plan
+        (MSG_PREFILL — the after-allocation/before-adoption window),
+        offer the handoff.  Raises ReplicaKilled on an injected kill
+        (the worker dies; the sequence re-prefills elsewhere)."""
+        cache = self._shared_cache
+        hist = seq.history()
+        prefix = hist[:-1]
+        # projections OUTSIDE the pool lock (the compute-bound half);
+        # page writes + detach inside it
+        if prefix:
+            shared = cache.shared_prefix_tokens(prefix)
+            tail = prefix[shared:]
+            if tail:
+                k, v = self._proj_pow2(prep.model, tail)
+            else:
+                k = v = np.zeros((0, prep.model.num_heads,
+                                  prep.model.head_dim), np.float32)
+        try:
+            with cache.lock:
+                if prefix:
+                    slot = cache.prefill(
+                        k, v,
+                        tokens=prefix if cache.kv_share else None)
+                else:
+                    slot = cache.alloc(1)
+                handle = cache.detach(slot)
+        except OutOfPagesError:
+            # pool pressure: nothing allocated (prefill is atomic) —
+            # back on the lane until decode retires free pages
+            self._retry.put(seq)
+            time.sleep(0.002)
+            return
+        except ValueError:
+            # kv_share race: another prefill registered more shared
+            # pages between our radix walk and the locked write, so
+            # our projected tail no longer matches — recompute
+            self._retry.put(seq)
+            return
+        prep.prefills += 1
+        self._count(prefills=1)
+        # seeded fault point: pages are allocated and in transit, the
+        # decode tier has NOT adopted — the exact window the chaos
+        # soak kills (ISSUE 14 satellite)
+        inj = faultinject.maybe_injector()
+        if inj is not None:
+            act = inj.decide(MSG_PREFILL)
+            if act is not None:
+                for kind, arg in faultinject.steps_of(act):
+                    if kind == "delay":
+                        time.sleep(arg)
+                        continue
+                    with cache.lock:
+                        cache.release_in_transit(handle)
+                    seq.attempts += 1
+                    if kind == "kill":
+                        prep.alive = False
+                        self._count(kills=1, prefill_kills=1)
+                        self._count_handoff("killed")
+                        self._requeue_or_fail_handoff(seq)
+                        self._export_tier_gauges()
+                        _flight.record(
+                            "decode", "prefill_replica_killed",
+                            replica=prep.index,
+                            request_id=seq.req.id)
+                        _flight.dump(reason="prefill_replica_death")
+                        raise ReplicaKilled(
+                            "prefill replica %d killed mid-handoff "
+                            "(fault injection)" % prep.index)
+                    # close / drop / truncate: the handoff is LOST in
+                    # transit — pages freed, the sequence re-prefills
+                    # (the re-prefill fallback; exactly-once holds
+                    # because only the Request future answers)
+                    self._count(handoffs_lost=1)
+                    self._count_handoff("lost")
+                    self._requeue_or_fail_handoff(seq)
+                    return
+        h = _Handoff(seq, handle, time.monotonic())
+        prep.handoffs += 1
+        self._count(handoffs_offered=1)
+        self._count_handoff("offered")
+        _flight.record("decode", "handoff_offered",
+                       request_id=seq.req.id, replica=prep.index,
+                       pages=len(handle["pages"]),
+                       tokens=handle["length"])
+        self._handoff_q.put(h)
+        self._export_tier_gauges()
+
+    def _requeue_or_fail_handoff(self, seq):
+        """Re-prefill fallback bookkeeping: the sequence goes back on
+        the lane unless its attempt budget is spent (typed
+        HandoffError — never silence)."""
+        if seq.req.done():
+            return
+        if seq.attempts >= self.config.max_attempts:
+            seq.req.fail(HandoffError(
+                "request %s: handoff lost %d times; giving up"
+                % (seq.req.id, seq.attempts)))
+        else:
+            self._count(failovers=1)
+            self._retry.put(seq)
+
+    def _count_handoff(self, outcome, latency_s=None, trace=None):
+        _M_HANDOFFS.inc(outcome=outcome)
+        if latency_s is not None:
+            exemplar = None
+            if _trace._tracer is not None and trace is not None \
+                    and _trace._tracer._verdict(trace[0]):
+                exemplar = trace[0]
+            _M_HANDOFF_SECONDS.observe(latency_s, exemplar=exemplar)
+
+    def _export_tier_gauges(self):
+        if not self._disagg:
+            return
+        _G_TIER_REPLICAS.set(
+            sum(1 for p in self.prefill_replicas if p.alive),
+            tier="prefill")
+        _G_TIER_REPLICAS.set(
+            sum(1 for r in self.replicas if r.alive), tier="decode")
+        c = self._shared_cache
+        _G_TIER_PAGES.set(c.in_use_pages(), kind="in_use")
+        _G_TIER_PAGES.set(c.in_transit_pages(), kind="in_transit")
+        _G_TIER_PAGES.set(c.free_pages(), kind="free")
+
     @staticmethod
     def _proj_pow2(model, toks):
         """Whole-prefill projections: pow2-pad the span (ragged
@@ -555,10 +914,12 @@ class DecodeServer:
     def _release_seq(self, rep, seq):
         """Free whatever cache state the sequence holds on this
         replica (both caches under spec_k); resets the chunk cursor so
-        a re-prefill starts clean."""
-        if seq.slot is not None:
-            rep.cache.free(seq.slot)
-            seq.slot = None
+        a re-prefill starts clean.  Runs under the cache lock — the
+        disaggregated tiers share one pool across worker threads."""
+        with rep.cache.lock:
+            if seq.slot is not None:
+                rep.cache.free(seq.slot)
+                seq.slot = None
         if seq.draft_slot is not None and rep.draft_cache is not None:
             rep.draft_cache.free(seq.draft_slot)
         seq.draft_slot = None
@@ -799,17 +1160,19 @@ class DecodeServer:
             q, k, v = rep.model.qkv(tokens)
             slots = [s.slot for s in rep.active]
             try:
-                rep.cache.append(slots, k, v)
+                with rep.cache.lock:
+                    rep.cache.append(slots, k, v)
                 break
             except OutOfPagesError:
                 # paging backpressure: preempt (deadline-aware) and
                 # retry the step
                 if not self._preempt_one(rep):
                     return
-        mp = self._table_bucket(rep.cache, slots)
-        tables = rep.cache.tables_for(slots, max_pages=mp,
-                                      pad_to=n_pad)
-        lens = rep.cache.lens_for(slots, pad_to=n_pad)
+        with rep.cache.lock:
+            mp = self._table_bucket(rep.cache, slots)
+            tables = rep.cache.tables_for(slots, max_pages=mp,
+                                          pad_to=n_pad)
+            lens = rep.cache.lens_for(slots, pad_to=n_pad)
         out = flash_decode(
             q, rep.cache.k_pages, rep.cache.v_pages, tables, lens,
             impl=cfg.impl, head_pack=cfg.head_pack,
@@ -1006,15 +1369,24 @@ class DecodeServer:
 
     def _fail_over(self, rep):
         """Kill path: every live sequence — full token history — onto
-        the retry lane; the cache resets (all pages freed, accounting
-        intact)."""
+        the retry lane; the dead replica's cache state is released
+        (all its pages freed, accounting intact).  A replica that OWNS
+        its cache resets it wholesale; a disaggregated replica shares
+        the pool with live tiers, so only ITS sequences' slots are
+        freed — a decode kill right after adoption frees the adopted
+        pages and the prefill tier re-prefills from token history."""
         rep.alive = False
         moved = rep.active + rep.prefilling
         rep.active = []
         rep.prefilling = []
-        rep.cache.reset()
+        if rep.owns_cache:
+            rep.cache.reset()
+        else:
+            for s in moved:
+                self._release_seq(rep, s)
         if rep.draft_cache is not None:
             rep.draft_cache.reset()
+        self._export_tier_gauges()
         _flight.record("decode", "replica_killed", replica=rep.index,
                        live_seqs=len(moved))
         # post-mortem: the ring holds the chaos action + the kill +
@@ -1052,6 +1424,8 @@ class DecodeServer:
             busy = any(r.active or r.prefilling
                        for r in self.replicas) \
                 or not self._retry.empty() \
+                or not self._handoff_q.empty() \
+                or any(p.busy for p in self.prefill_replicas) \
                 or self.admission.outstanding_count() > 0
             if not busy:
                 break
@@ -1079,6 +1453,21 @@ class DecodeServer:
                 self._release_seq(rep, s)
             rep.active = []
             rep.prefilling = []
+        # disagg sweep: handoffs never adopted (their requests were
+        # shutdown-failed by the drain above) still hold pages —
+        # release every queued offer and any in-transit straggler so
+        # the zero-leak invariant holds post-stop
+        if self._shared_cache is not None:
+            while True:
+                try:
+                    h = self._handoff_q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                with self._shared_cache.lock:
+                    self._shared_cache.release_in_transit(h.handle)
+            with self._shared_cache.lock:
+                self._shared_cache.release_in_transit()
+            self._export_tier_gauges()
         if self.collector_pusher is not None:
             self.collector_pusher.stop(final_push=True)
             self.collector_pusher = None
@@ -1140,8 +1529,27 @@ class DecodeServer:
         if counters.get("spec_proposed"):
             acceptance = round(counters["spec_accepted"]
                                / counters["spec_proposed"], 4)
+        disagg = None
+        if self._disagg:
+            sc = self._shared_cache
+            disagg = {
+                "prefill_replicas": {
+                    p.index: {"alive": p.alive,
+                              "prefills": p.prefills,
+                              "handoffs": p.handoffs}
+                    for p in self.prefill_replicas},
+                "handoff_queue": self._handoff_q.qsize(),
+                "handoffs_offered": counters["handoffs_offered"],
+                "handoffs_adopted": counters["handoffs_adopted"],
+                "handoffs_lost": counters["handoffs_lost"],
+                "handoffs_expired": counters["handoffs_expired"],
+                "prefill_kills": counters["prefill_kills"],
+                "in_transit_pages": sc.in_transit_pages(),
+                "shared_pool": sc.stats(),
+            }
         return {
             "spec_acceptance_rate": acceptance,
+            "disagg": disagg,
             "admission": c,
             "outstanding": self.admission.outstanding_count(),
             "answered": answered,

@@ -35,7 +35,7 @@ from paddle_tpu.serving.admission import ServingError
 
 __all__ = ["RegistryError", "ModelNotFoundError",
            "VersionNotFoundError", "PrewarmFailedError",
-           "ModelVersion", "ModelRegistry"]
+           "ManifestMismatchError", "ModelVersion", "ModelRegistry"]
 
 
 class RegistryError(ServingError):
@@ -62,6 +62,17 @@ class PrewarmFailedError(RegistryError):
     old version serving)."""
 
     code = "prewarm_failed"
+
+
+class ManifestMismatchError(RegistryError):
+    """Registry re-adoption (ISSUE 14 satellite) found a manifest
+    entry whose recorded program fingerprint does not match the
+    on-disk ProgramDesc — the model dir was rewritten (or the
+    manifest corrupted) since the fleet last ran.  A relaunched fleet
+    must not silently serve different bytes under an old version
+    number, so adoption fails typed instead."""
+
+    code = "manifest_mismatch"
 
 
 def _dir_fingerprint(model_dir, model_filename=None):
@@ -170,16 +181,94 @@ class ModelRegistry:
     (dedupe — rollout to "the same bytes" is a no-op by construction).
     """
 
+    MANIFEST = "REGISTRY_MANIFEST.json"
+
     def __init__(self, root=None):
         self.root = root
         self._models: dict = {}       # name -> [ModelVersion]
         self._lock = threading.Lock()
+        # persistence across restarts (ISSUE 14 satellite; closes the
+        # PR-13 ROADMAP remaining item): a registry built over a root
+        # dir RE-ADOPTS the versions its manifest recorded, so a
+        # relaunched fleet recovers its catalog without re-registering
+        # — each adopted dir's ProgramDesc is re-fingerprinted and
+        # must match the manifest (typed ManifestMismatchError
+        # otherwise: never silently serve different bytes under an
+        # old version number)
+        self.adopted = 0
+        if root is not None:
+            self.adopted = self._adopt_manifest()
+
+    # -- persistence --------------------------------------------------------
+    def _manifest_path(self):
+        return os.path.join(self.root, self.MANIFEST)
+
+    def _write_manifest_locked(self):
+        """Serialize the catalog (atomic rename — a crash mid-write
+        must never leave a half manifest for the next launch)."""
+        if self.root is None:
+            return
+        os.makedirs(self.root, exist_ok=True)
+        data = {"models": {n: [v.to_dict() for v in vs]
+                           for n, vs in self._models.items()}}
+        tmp = self._manifest_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, self._manifest_path())
+
+    def _adopt_manifest(self):
+        """Re-adopt every manifest entry, verifying each model dir's
+        on-disk ProgramDesc still hashes to the recorded fingerprint.
+        Returns the number of versions adopted (0 when no manifest
+        exists — a fresh root)."""
+        path = self._manifest_path()
+        if not os.path.exists(path):
+            return 0
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            models = data["models"]
+        except (OSError, ValueError, KeyError) as e:
+            raise RegistryError(
+                f"cannot read registry manifest at {path!r}: "
+                f"{type(e).__name__}: {e}") from e
+        n = 0
+        for name, entries in sorted(models.items()):
+            versions = []
+            for ent in sorted(entries, key=lambda e: e["version"]):
+                fp = _dir_fingerprint(ent["model_dir"])
+                if str(fp) != str(ent["fingerprint"]):
+                    raise ManifestMismatchError(
+                        "%s@v%s: on-disk ProgramDesc fingerprint %s "
+                        "!= manifest fingerprint %s (model dir %r "
+                        "rewritten since the manifest was banked)"
+                        % (name, ent["version"], fp,
+                           ent["fingerprint"], ent["model_dir"]))
+                v = ModelVersion(name, ent["version"],
+                                 ent["model_dir"], fp)
+                v.registered_t = ent.get("registered_t",
+                                         v.registered_t)
+                # prewarm state is NOT adopted: a relaunched process
+                # has a cold jit cache (the persistent compile cache
+                # makes re-prewarm cheap); serving_fingerprint rides
+                # along as a hint for convergence checks
+                v.serving_fingerprint = ent.get("serving_fingerprint")
+                versions.append(v)
+                n += 1
+            if versions:
+                self._models[str(name)] = versions
+        from paddle_tpu.observability import flight_recorder as _flight
+
+        _flight.record("fleet", "registry_adopted",
+                       root=str(self.root), versions=n)
+        return n
 
     # -- registration -------------------------------------------------------
     def register(self, name, model_dir, model_filename=None):
         """Register a saved inference model dir as the next version of
         ``name`` (or return the existing version with the same program
-        fingerprint)."""
+        fingerprint).  With a registry root, the manifest persists the
+        catalog for re-adoption after a restart."""
         fp = _dir_fingerprint(model_dir, model_filename)
         with self._lock:
             versions = self._models.setdefault(str(name), [])
@@ -188,6 +277,7 @@ class ModelRegistry:
                     return v              # dedupe by fingerprint
             v = ModelVersion(name, len(versions) + 1, model_dir, fp)
             versions.append(v)
+            self._write_manifest_locked()
         from paddle_tpu.observability import flight_recorder as _flight
 
         _flight.record("fleet", "version_registered", model=str(name),
@@ -245,6 +335,13 @@ class ModelRegistry:
             if v.fingerprint == fingerprint:
                 return v
         return None
+
+    def save(self):
+        """Re-bank the manifest now (e.g. after a prewarm recorded a
+        serving_fingerprint worth persisting).  No-op without a
+        root."""
+        with self._lock:
+            self._write_manifest_locked()
 
     def to_dict(self):
         with self._lock:

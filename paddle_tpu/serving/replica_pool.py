@@ -93,6 +93,9 @@ class Replica:
         self.busy = False
         self.retired = False
         self.version = None
+        # mesh-sliced serving (ISSUE 14): the devices of this
+        # replica's slice (None = whole-model single-device replica)
+        self.devices = None
         self._consec_fails = 0
         self._open_until = 0.0
         self._threshold = int(breaker_threshold)
@@ -249,7 +252,8 @@ class ReplicaPool:
                  dispatch_capacity=8, breaker_threshold=3,
                  breaker_cooldown_s=0.5, health_interval_s=None,
                  restart_dead=True, max_batch_attempts=None,
-                 restart_backoff=0.05, health_failures=None):
+                 restart_backoff=0.05, health_failures=None,
+                 mesh_plan=None, devices=None):
         """predictor_factory(i) -> a Predictor for replica i (each
         replica owns its predictor: private scope + compile cache).
         restart_dead=False leaves a killed replica down — pure
@@ -257,10 +261,44 @@ class ReplicaPool:
         the probe-flake tolerance: a replica's breaker only sees a
         probe failure after this many CONSECUTIVE probe failures
         (default PADDLE_TPU_HEALTH_FAILURES or 2 — one seeded delayed
-        probe must not kill a healthy replica)."""
+        probe must not kill a healthy replica).
+
+        ``mesh_plan`` (ISSUE 14, behind the typed ``serving_sharded``
+        flag): a parallel.gspmd.MeshPlan describing ONE inference
+        replica — the pool carves ``devices`` (default: all local)
+        into plan-sized slices and each replica's predictor tp-shards
+        its params across its slice (Predictor.shard), so the pool
+        manages mesh slices instead of devices and one pool serves a
+        model above single-chip HBM.  ``n_replicas=None`` means one
+        replica per carved slice.  Health probes, breakers,
+        kill-mid-batch failover, drain and swap_predictor/rollout all
+        keep working per SLICE — a replica IS its slice.  Flag-off
+        the plan is ignored (zero behavior change)."""
         import os
 
+        from paddle_tpu.flags import get_flag
+
         self._factory = predictor_factory
+        self._mesh_plan = None
+        self._slices = None
+        if mesh_plan is not None and get_flag("serving_sharded"):
+            import jax
+
+            from paddle_tpu.parallel.gspmd import carve_slices
+
+            devs = list(devices) if devices is not None \
+                else jax.devices()
+            self._slices = carve_slices(devs, mesh_plan.size())
+            self._mesh_plan = mesh_plan
+            if n_replicas is None:
+                n_replicas = len(self._slices)
+            elif int(n_replicas) > len(self._slices):
+                raise ValueError(
+                    f"n_replicas={n_replicas} > {len(self._slices)} "
+                    f"carved slices of {mesh_plan!r} over "
+                    f"{len(devs)} devices")
+        elif n_replicas is None:
+            n_replicas = 2
         self._restart_dead = bool(restart_dead)
         self._max_attempts = int(max_batch_attempts) \
             if max_batch_attempts is not None else 2 * n_replicas + 1
@@ -279,11 +317,13 @@ class ReplicaPool:
         # system stay bounded by the admission queue's capacity, so
         # this lane cannot grow without bound.
         self._retry = BoundedQueue()
-        self.replicas = [
-            Replica(i, predictor_factory(i),
-                    breaker_threshold=breaker_threshold,
-                    breaker_cooldown_s=breaker_cooldown_s)
-            for i in range(int(n_replicas))]
+        self.replicas = []
+        for i in range(int(n_replicas)):
+            rep = Replica(i, predictor_factory(i),
+                          breaker_threshold=breaker_threshold,
+                          breaker_cooldown_s=breaker_cooldown_s)
+            self._assign_slice(rep)
+            self.replicas.append(rep)
         self._next_index = int(n_replicas)
         self._sup = Supervisor(restart_backoff=restart_backoff,
                                max_backoff=1.0)
@@ -332,6 +372,29 @@ class ReplicaPool:
         with self._lock:
             return dict(self._counters)
 
+    # -- mesh slices (ISSUE 14) ---------------------------------------------
+    def _assign_slice(self, rep):
+        """Give the replica its mesh slice and tp-shard its predictor
+        across it (no-op for an unsharded pool).  Re-run after every
+        predictor swap — a swapped-in program must serve sharded from
+        the same slice its replica owns."""
+        if self._mesh_plan is None:
+            return
+        if rep.devices is None:
+            rep.devices = self._slices[rep.index % len(self._slices)]
+        rep.predictor.shard(self._mesh_plan, devices=rep.devices)
+
+    def mesh_stats(self):
+        """Slice-carving summary (None for an unsharded pool)."""
+        if self._mesh_plan is None:
+            return None
+        return {"plan": self._mesh_plan.to_dict(),
+                "slice_size": self._mesh_plan.size(),
+                "slices": len(self._slices),
+                "replica_slices": {
+                    r.index: [str(d) for d in (r.devices or ())]
+                    for r in self.replicas}}
+
     # -- fleet operations (ISSUE 13) ----------------------------------------
     def replica(self, index):
         for r in self.replicas:
@@ -373,6 +436,11 @@ class ReplicaPool:
         rep = self.quiesce_replica(index, timeout=timeout)
         try:
             prior = rep.predictor.swap_program(source)
+            # mesh-sliced pool (ISSUE 14): the incoming program was
+            # prewarmed UNsharded (or sharded for another slice);
+            # re-shard it onto THIS replica's slice before it takes
+            # traffic — the rollout contract holds per slice
+            self._assign_slice(rep)
             prior_version, rep.version = rep.version, version
             self._count(swaps=1)
             _flight.record("fleet", "replica_swapped", replica=index,
@@ -406,6 +474,10 @@ class ReplicaPool:
                       breaker_threshold=self._breaker_threshold,
                       breaker_cooldown_s=self._breaker_cooldown)
         rep.version = version
+        # scale-up on a sharded pool reuses slices round-robin (the
+        # index modulo): on the CPU harness slices may overlap; a real
+        # fleet sizes max_replicas to its slice count
+        self._assign_slice(rep)
         self.replicas.append(rep)
         self._sup.add_worker("replica-%d" % idx,
                              self._make_worker(rep),
@@ -450,6 +522,7 @@ class ReplicaPool:
               "dispatch_depth": self.dispatch.qsize(),
               "retry_depth": self._retry.qsize(),
               "in_flight": self.in_flight(),
+              "mesh": self.mesh_stats(),
               "restarts": self.restarts()}
         st.update(self.counters())
         return st

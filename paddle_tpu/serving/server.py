@@ -48,7 +48,8 @@ class ServingConfig:
                  health_interval_s=None, restart_dead=True,
                  max_batch_attempts=None, drain_timeout_s=30.0,
                  prewarm=None, metrics_port=None, trace_sample=None,
-                 collector=None, quotas=None, health_failures=None):
+                 collector=None, quotas=None, health_failures=None,
+                 mesh_plan=None, devices=None):
         self.max_batch = int(max_batch)
         self.buckets = tuple(buckets) if buckets is not None \
             else default_buckets(self.max_batch)
@@ -59,9 +60,20 @@ class ServingConfig:
         self.queue_capacity = int(queue_capacity) \
             if queue_capacity is not None else 4 * self.max_batch
         self.default_deadline_s = float(default_deadline_s)
-        self.n_replicas = int(n_replicas)
+        # mesh-sliced serving (ISSUE 14, flag serving_sharded): the
+        # pool carves devices into mesh_plan-sized slices and each
+        # replica tp-shards its predictor across one slice;
+        # n_replicas=None then means one replica per carved slice
+        self.mesh_plan = mesh_plan
+        self.devices = devices
+        if n_replicas is None and mesh_plan is None:
+            n_replicas = 2
+        self.n_replicas = None if n_replicas is None \
+            else int(n_replicas)
+        _eff_reps = self.n_replicas if self.n_replicas is not None \
+            else 2
         self.dispatch_capacity = int(dispatch_capacity) \
-            if dispatch_capacity is not None else 2 * self.n_replicas
+            if dispatch_capacity is not None else 2 * _eff_reps
         self.breaker_threshold = int(breaker_threshold)
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self.health_interval_s = health_interval_s
@@ -146,7 +158,8 @@ class InferenceServer:
             health_interval_s=cfg.health_interval_s,
             restart_dead=cfg.restart_dead,
             max_batch_attempts=cfg.max_batch_attempts,
-            health_failures=cfg.health_failures)
+            health_failures=cfg.health_failures,
+            mesh_plan=cfg.mesh_plan, devices=cfg.devices)
         # the registry version currently serving (set by the fleet
         # RolloutController; None for a single anonymous model)
         self.model_version = None

@@ -31,6 +31,11 @@ _ROOT = str(pathlib.Path(__file__).resolve().parents[1])
     # shard_map (per-shard B/dp x H/tp block shapes the single-device
     # lowering never sees)
     "transformer_train_gspmd",
+    # ISSUE 14: the tp-sharded serving-inference graph (column-
+    # parallel weights + SPMD inter-layer gathers) and the disagg
+    # decode graph (handoff-fragmented block tables)
+    "serving_tp_sharded",
+    "llm_decode_disagg",
 ])
 def test_bench_workload_lowers_for_tpu(workload):
     if _ROOT not in sys.path:

@@ -83,6 +83,13 @@ TASK_KEYS = {
         "transformer_base_train_gspmd_mb32", None),
     "tf_train_gspmd_mb64": (
         "transformer_base_train_gspmd_mb64", None),
+    # ISSUE 14: sharded serving rows.  serving_tp_sharded /
+    # disagg markers ride in the rows so bench._workload_sig keys
+    # them apart from the plain serving/decode rows (the re-key rule:
+    # a sharding/tier flip must never read as a same-graph perf
+    # change).  Flip neither flag before these bank.
+    "serving_tp_sharded": ("serving_tp_sharded_mb8_tp2", None),
+    "llm_decode_disagg": ("llm_decode_flash_str64_disagg", None),
     # DeepFM roofline re-key (VERDICT r5 #7): same primary key — the
     # re-banked row carries mfu_pct/hbm_bw_pct so the CTR leg is
     # judged like the others

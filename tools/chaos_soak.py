@@ -19,6 +19,14 @@ fault point — kill-mid-step replica failover must answer every
 admitted sequence exactly once AND leak zero KV pages (page
 accounting asserted after drain: free + in_use == pool, in_use == 0).
 
+--mode disagg (ISSUE 14): disaggregated prefill/decode tiers under a
+seeded random plan over ``serving_prefill`` AND ``serving_decode``
+PLUS two pinned kills in the exact mid-handoff windows (a prefill
+replica after page allocation / before adoption; a decode replica
+right after adoption) — exactly-once answers, the re-prefill
+fallback firing, and ZERO page leaks on the shared pool including
+in-transit handoff handles.
+
 Each iteration's plan is fully determined by its seed, so any failure
 replays exactly:
 
@@ -402,6 +410,117 @@ def run_decode_iteration(seed, rate, max_faults, timeout,
         return False, f"seed={seed}: {type(e).__name__}: {e}", 0
 
 
+def run_disagg_iteration(seed, rate, max_faults, timeout,
+                         n_requests=24):
+    """One faulted DISAGGREGATED prefill/decode run (ISSUE 14
+    acceptance shape): a seeded random plan over ``serving_prefill``
+    AND ``serving_decode`` plus two PINNED kills in the exact
+    mid-handoff windows the tentpole names — a prefill replica killed
+    AFTER page allocation but BEFORE the decode tier adopts the pages
+    (rule serving_prefill@1:kill — the fault point sits between
+    detach and offer), and a decode replica killed right AFTER
+    adoption (serving_decode fires only once a replica has an active
+    batch, i.e. post-adopt).  Asserts exactly-once answers, the
+    re-prefill fallback actually firing (offers > adoptions needed /
+    failovers recorded), and ZERO page leaks on BOTH tiers' pool
+    views under the generalized invariant (free + unique(in_use) ==
+    num_pages including in-transit handles, in_use == 0 and
+    in_transit == 0 after drain).  Returns (ok, detail, n_faults)."""
+    import numpy as np
+
+    from paddle_tpu import serving
+    from paddle_tpu.distributed import faultinject
+    from paddle_tpu.distributed.faultinject import FaultPlan
+
+    plan = FaultPlan(seed=seed, rate=rate,
+                     actions=("kill", "close", "drop", "delay=0.02",
+                              "delay=0.01+drop"),
+                     max_faults=max_faults)
+    plan.on("serving_prefill", 1, "kill")
+    plan.on("serving_decode", 2, "kill")
+    rng = np.random.RandomState(seed)
+    shared_prefix = rng.randint(2, 128, size=18)
+    deadline = time.monotonic() + timeout
+    try:
+        with faultinject.installed(plan) as inj:
+            srv = serving.DecodeServer(
+                config=serving.DecodeConfig(
+                    max_batch=4, max_new_tokens=8, page_size=16,
+                    num_pages=96, n_replicas=2,
+                    default_deadline_s=60.0,
+                    restart_dead=True, kv_share=True,
+                    disagg_prefill=True,
+                    n_prefill_replicas=2)).start()
+            try:
+                futures, rejected = [], 0
+                for _ in range(n_requests):
+                    prompt = rng.randint(
+                        2, 128, size=int(rng.randint(1, 12)))
+                    if rng.rand() < 0.5:
+                        prompt = np.concatenate([shared_prefix,
+                                                 prompt])
+                    try:
+                        futures.append(srv.submit(prompt))
+                    except serving.ServingError:
+                        rejected += 1
+                    time.sleep(0.002)
+                answered = 0
+                for f in futures:
+                    try:
+                        f.result(timeout=max(
+                            0.1, deadline - time.monotonic()))
+                    except serving.ServingError:
+                        pass    # typed rejection: answered, counted
+                    except TimeoutError:
+                        return (False, f"seed={seed}: disagg request "
+                                f"{f.id} unanswered (silent drop?)",
+                                len(inj.log))
+                    answered += 1
+                srv.stop()
+                st = srv.stats()
+                c = st["admission"]
+                dis = st["disagg"]
+                pages_ok, pages_detail = srv.page_accounting()
+                if answered != len(futures):
+                    return (False, f"seed={seed}: disagg answered "
+                            f"{answered}/{len(futures)}",
+                            len(inj.log))
+                if not st["accounted"] or st["outstanding"]:
+                    return (False, f"seed={seed}: disagg accounting "
+                            f"broken {c} outstanding="
+                            f"{st['outstanding']}", len(inj.log))
+                if not pages_ok:
+                    return (False, f"seed={seed}: KV-PAGE LEAK "
+                            f"(disagg): {pages_detail}",
+                            len(inj.log))
+                sc = srv._shared_cache
+                if sc.in_use_pages() or sc.in_transit_pages():
+                    return (False, f"seed={seed}: shared pool not "
+                            f"empty after drain: in_use="
+                            f"{sc.in_use_pages()} in_transit="
+                            f"{sc.in_transit_pages()}", len(inj.log))
+                if c["answered_ok"] == 0:
+                    return (False, f"seed={seed}: no disagg request "
+                            "ever succeeded", len(inj.log))
+                if dis["prefill_kills"] < 1:
+                    return (False, f"seed={seed}: the pinned "
+                            "prefill-kill never fired: %r" % dis,
+                            len(inj.log))
+                if dis["handoffs_adopted"] == 0:
+                    return (False, f"seed={seed}: no handoff ever "
+                            "adopted: %r" % dis, len(inj.log))
+                if st["decode"]["failovers"] == 0 and \
+                        dis["handoffs_lost"] == 0:
+                    return (False, f"seed={seed}: re-prefill "
+                            "fallback never exercised: %r" % dis,
+                            len(inj.log))
+                return True, "", len(inj.log)
+            finally:
+                srv.stop()
+    except Exception as e:   # noqa: BLE001 — verdict, not crash
+        return False, f"seed={seed}: {type(e).__name__}: {e}", 0
+
+
 _rollout_model_dirs = None
 
 
@@ -638,10 +757,11 @@ def main(argv=None):
     ap.add_argument("--timeout", type=float, default=240.0,
                     help="per-iteration trainer timeout (s)")
     ap.add_argument("--mode",
-                    choices=["cluster", "serving", "rollout"],
+                    choices=["cluster", "serving", "rollout",
+                             "disagg"],
                     default="cluster")
     args = ap.parse_args(argv)
-    if args.mode in ("serving", "rollout"):
+    if args.mode in ("serving", "rollout", "disagg"):
         # in-process serving soak: pin the platform before jax loads
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         import jax
@@ -655,6 +775,9 @@ def main(argv=None):
     # verdict windows over the WHOLE run (burn rates need a delta)
     soak_monitor = None
     collector_srv = None
+    if args.mode == "disagg":
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
     if args.mode == "serving":
         try:
             sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -710,6 +833,11 @@ def main(argv=None):
                 ok = False
                 detail = (detail + "; " if detail else "") + \
                     "decode: " + detail2
+        elif args.mode == "disagg":
+            # ISSUE 14: disaggregated prefill/decode under seeded
+            # kill-mid-handoff chaos (pinned kills in both windows)
+            ok, detail, n_faults = run_disagg_iteration(
+                seed, args.rate, args.max_faults, args.timeout)
         elif args.mode == "rollout":
             # ISSUE 13: rolling version swap under kill-a-replica-
             # mid-rollout chaos, then the SLO-autoscaler overload leg

@@ -50,6 +50,26 @@ TASKS = [
      {"batch": 32, "chain": 15}),
     ("tf_train_gspmd_mb64", "tf_train_gspmd",
      {"batch": 64, "chain": 10}),
+    # ---- ISSUE 14 HEAD: sharded serving.  (1) serving_tp_sharded —
+    # the tp-sharded inference step (column-parallel fc weights, one
+    # jit with in/out NamedShardings over a mesh slice).  On the
+    # 1-chip tunnel the mesh degrades to tp1: the row then prices the
+    # sharded compile path vs the plain serving graph (expect
+    # ~parity, the flag-clearing A/B); a multi-chip window banks the
+    # real above-one-HBM serving row — the model the pool serves that
+    # one chip cannot.  Cross-lowered in CI (serving_tp_sharded)
+    # before any window is spent.  (2) llm_decode_disagg — decode
+    # tokens/s under handoff-FRAGMENTED block tables (pages strided
+    # across the pool in prefill-completion order, the disaggregated
+    # tier's steady state) vs the banked contiguous llm_decode rows:
+    # expect ~parity (the kernel gathers pages through the table
+    # either way) — banking that parity IS the evidence the
+    # page-list handoff is free at decode time.  Flip neither flag
+    # (serving_sharded / disagg_prefill) before both bank.
+    ("serving_tp_sharded", "serving_tp_sharded",
+     {"batch": 8, "tp": 2, "chain": 30}),
+    ("llm_decode_disagg", "llm_decode",
+     {"streams": 64, "chain": 32, "disagg": True}),
     # ---- PR-7 HEAD: LLM continuous decode (ISSUE 7) — the paged
     # KV-cache + flash_decode step, tokens/s/chip + inter-token
     # p50/p99 vs concurrent streams.  Decode is K/V-streaming bound:

@@ -311,6 +311,67 @@ print("rollout smoke OK: %d converged / %d rolled back, "
          r["final_version"]))
 PY
 
+echo "== 5g/8 disaggregated serving gate (page-list handoff + zero-leak) =="
+# ISSUE 14: one short decode run with the disaggregated prefill tier
+# on — the one-JSON-line contract grows the handoff block (offered /
+# adopted / lost / latency percentiles) and the verdict must show
+# zero in-transit pages at rest and the generalized zero-leak
+# invariant holding on the shared pool
+JAX_PLATFORMS=cpu python tools/serving_load.py --mode decode \
+  --seconds 2 --qps 30 --seed 7 --deadline-ms 5000 \
+  --disagg-prefill 2 > /tmp/_serving_load_disagg.json
+cat /tmp/_serving_load_disagg.json
+python - <<'PY'
+import json
+lines = [ln for ln in
+         open("/tmp/_serving_load_disagg.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, (
+    "serving_load --disagg-prefill stdout must be exactly ONE JSON "
+    "line — got %d" % len(lines))
+rec = json.loads(lines[0])
+missing = {"metric", "value", "unit", "tokens_per_sec",
+           "disagg_prefill", "handoff", "pages_accounted",
+           "accounted", "metrics", "slo"} - set(rec)
+assert not missing, "disagg JSON missing fields: %s" % (
+    sorted(missing),)
+assert rec["disagg_prefill"] is True
+h = rec["handoff"]
+assert {"offered", "adopted", "lost", "expired", "in_transit_pages",
+        "p50_ms", "p99_ms", "prefill_replicas"} <= set(h), h
+assert h["adopted"] > 0, "no handoff ever adopted: %r" % h
+assert h["in_transit_pages"] == 0, (
+    "pages stuck in transit after drain: %r" % h)
+assert rec["pages_accounted"] is True, (
+    "generalized zero-leak invariant broken (disagg): %r" % rec)
+assert rec["accounted"] is True and rec["ok"] > 0, rec
+# the handoff instruments ride the metrics embed
+m = rec["metrics"]
+for g in ("paddle_tpu_disagg_handoffs_total",
+          "paddle_tpu_disagg_handoff_seconds",
+          "paddle_tpu_paged_kv_pages_in_transit"):
+    assert g in m, (g, sorted(m)[:12])
+print("disagg serving gate OK: %.1f tok/s, %d/%d handoffs adopted, "
+      "0 in transit" % (rec["tokens_per_sec"], h["adopted"],
+                        h["offered"]))
+PY
+# the disagg row joins the machine-gated CPU-harness trajectory
+# (baseline re-banked with this PR; disagg_prefill rides the row sig
+# so the tiered run never pairs with the single-tier decode row)
+JAX_PLATFORMS=cpu python tools/perf_sentinel.py --mode serving \
+  --fresh /tmp/_serving_load_disagg.json \
+  --baseline docs/perf_baseline_cpu.json > /tmp/_sentinel_disagg.json
+cat /tmp/_sentinel_disagg.json
+python - <<'PY'
+import json
+rec = json.loads(open("/tmp/_sentinel_disagg.json").read())
+assert rec["metric"] == "perf_sentinel" and rec["ok"] is True, (
+    "PERF REGRESSION flagged on the disagg row: %r"
+    % rec.get("flagged"))
+assert rec["checked"] >= 3, rec
+print("disagg perf sentinel OK: %d metrics checked" % rec["checked"])
+PY
+
 echo "== 6/8 per-op regression gate (hot ops vs committed CPU baseline) =="
 # 3x tolerance absorbs machine load; catches order-of-magnitude
 # per-op regressions (reference op_tester role) before they surface
@@ -339,8 +400,8 @@ python tools/tpu_lowering_check.py \
   resnet50_train resnet50_train_convbnstats bert_train resnet50_infer \
   resnet50_infer_int8_interlayer vgg16_infer longctx_train \
   llm_decode llm_decode_d64_hp2 llm_decode_int8kv llm_decode_bf16 \
-  llm_decode_spec_k4 llm_decode_spec_k8 \
-  transformer_train_gspmd
+  llm_decode_spec_k4 llm_decode_spec_k8 llm_decode_disagg \
+  transformer_train_gspmd serving_tp_sharded
 
 echo "== 8/8 chaos soak (deterministic seed; both transports) =="
 # short fault-injection leg of the distributed stack: a seeded random
@@ -360,5 +421,11 @@ JAX_PLATFORMS=cpu python tools/chaos_soak.py \
 # smoke so the soak explores a second chaos schedule
 JAX_PLATFORMS=cpu python tools/chaos_soak.py \
   --mode rollout --iterations 1 --seed 3141 --rate 0.06
+# disaggregated-tier leg (ISSUE 14): seeded kill-mid-handoff chaos —
+# a prefill replica dies after page allocation / before adoption and
+# a decode replica dies right after adoption (pinned rules) plus the
+# random schedule; exactly-once + zero page leaks asserted
+JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+  --mode disagg --iterations 2 --seed 2726 --rate 0.05
 
 echo "ALL CHECKS PASSED"

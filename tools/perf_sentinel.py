@@ -98,7 +98,7 @@ def serving_sig(rec):
         "dl%s" % rec.get("deadline_ms"),
     ]
     for k in ("spec_k", "prefix_shared", "prefill_chunk",
-              "mean_prompt", "max_new"):
+              "mean_prompt", "max_new", "disagg_prefill"):
         if rec.get(k):
             parts.append("%s%s" % (k, rec[k]))
     return ":".join(parts)

@@ -27,6 +27,11 @@ Modes:
                        KV-cache; reports tokens/s goodput and
                        inter-token p99 NEXT TO the request-level rows,
                        plus the zero-page-leak accounting verdict.
+                       --disagg-prefill N (ISSUE 14) adds N
+                       disaggregated prefill-tier replicas and the
+                       JSON line grows the page-list handoff block
+                       (offered/adopted/lost/latency + in-transit
+                       zero verdict; ci.sh 5g gates it).
 
 Cold-start metrics (ROADMAP item 5): every mode's JSON line carries
 ``time_to_first_batch_s`` (server start -> first completed request,
@@ -382,11 +387,38 @@ def run_decode_open_loop(srv, qps, seconds, seed=0, deadline_s=None,
     pages_ok, pages_detail = srv.page_accounting()
     peak_shared = max(rep_st["cache"].get("peak_shared_pages", 0)
                       for rep_st in st["replicas"].values())
+    # disaggregated-tier evidence (ISSUE 14): handoff outcome counts
+    # + latency percentiles from the registry histogram + the
+    # in-transit page count (must be 0 at rest — part of the
+    # zero-leak verdict ci.sh 5g gates)
+    dis = st.get("disagg")
+    handoff = None
+    if dis is not None:
+        from paddle_tpu.observability import metrics as obs_metrics
+
+        snap = obs_metrics.registry().snapshot().get(
+            "paddle_tpu_disagg_handoff_seconds", {})
+        series = (snap.get("series") or [{}])[0]
+        handoff = {
+            "offered": dis["handoffs_offered"],
+            "adopted": dis["handoffs_adopted"],
+            "lost": dis["handoffs_lost"],
+            "expired": dis["handoffs_expired"],
+            "prefill_kills": dis["prefill_kills"],
+            "prefill_replicas": len(dis["prefill_replicas"]),
+            "in_transit_pages": dis["in_transit_pages"],
+            "p50_ms": None if series.get("p50") is None
+            else round(1e3 * series["p50"], 3),
+            "p99_ms": None if series.get("p99") is None
+            else round(1e3 * series["p99"], 3),
+        }
     return {
         # decode act II (ISSUE 11): the one-JSON-line contract grows
         # acceptance-rate / sharing / chunking evidence (5b-gated)
         "spec_k": srv.config.spec_k,
         "acceptance_rate": st["spec_acceptance_rate"],
+        "disagg_prefill": bool(srv.config.disagg_prefill),
+        "handoff": handoff,
         "prefix_shared": int(prefix_shared),
         "peak_shared_pages": int(peak_shared),
         "prefill_chunk": srv.config.prefill_chunk,
@@ -495,6 +527,13 @@ def main(argv=None):
                     help="decode mode (ISSUE 11a): prompts longer "
                          "than this prefill in fixed chunks "
                          "interleaved with decode iterations")
+    ap.add_argument("--disagg-prefill", type=int, default=0,
+                    help="decode mode (ISSUE 14): run N disaggregated "
+                         "prefill-tier replicas next to the decode "
+                         "tier — prompt prefill hands off to decode "
+                         "as a page-list transfer; the JSON line "
+                         "grows handoff counts/latency and the "
+                         "in-transit zero-leak verdict")
     ap.add_argument("--tenants", type=str, default=None,
                     help="ISSUE 13: per-tenant traffic mix "
                          "'a:0.7,b:0.3' — the JSON line grows "
@@ -548,7 +587,9 @@ def main(argv=None):
             queue_capacity=args.capacity,
             kv_share=bool(args.prefix_shared) or None,
             spec_k=args.spec_k,
-            prefill_chunk=args.prefill_chunk)).start()
+            prefill_chunk=args.prefill_chunk,
+            disagg_prefill=bool(args.disagg_prefill) or None,
+            n_prefill_replicas=max(1, args.disagg_prefill))).start()
         try:
             # cold first-token probe (1-token request, nothing
             # compiled yet): the decode-side time_to_first_batch_s

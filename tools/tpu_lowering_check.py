@@ -31,8 +31,18 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+# the sharded workloads (transformer_train_gspmd, serving_tp_sharded)
+# need a real multi-device mesh to expose their per-shard Mosaic/SPMD
+# surface — force the same virtual 8-device CPU mesh the test suite
+# uses, so the standalone gate checks what the pytest gate checks
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
 
 import jax
 
@@ -97,6 +107,22 @@ def _workloads():
         # arrays committed to the CPU mesh can trip platform/memory-
         # kind checks when lowering for tpu.
         "transformer_train_gspmd": lambda: _gspmd_specs(bench),
+        # ISSUE 14: the tp-sharded serving-INFERENCE graph — one jit
+        # with in/out NamedShardings over a dp1 x tp2 slice mesh,
+        # column-parallel fc weights + the inter-layer all-gathers
+        # the SPMD partitioner inserts: SPMD surface the unsharded
+        # predictor lowering never sees — cross-lower BEFORE the
+        # chaser spends a window on the serving_tp_sharded row.
+        # Avals only, like the gspmd workload.
+        "serving_tp_sharded": lambda: _serving_sharded_specs(bench),
+        # ISSUE 14: the disagg decode graph — the flash_decode step
+        # over handoff-fragmented block tables (pages strided across
+        # the pool in prefill-completion order).  The kernel walks
+        # the table through scalar prefetch either way, but the row
+        # must not spend a window before its exact graph lowers.
+        "llm_decode_disagg": lambda: bench._build_llm_decode(
+            streams=8, prefill_len=64, heads=8, head_dim=128,
+            page_size=128, disagg=True)[:3],
         "bert_train": lambda: bench._build_bert_train(8, 512)[:3],
         "deepfm_train": lambda: bench._build_deepfm_train(2048)[:3],
         "resnet50_infer_int8": lambda:
@@ -152,6 +178,16 @@ def _gspmd_specs(bench):
         8, 512, gspmd=True, tp=2)
     sds = lambda d: {k: jax.ShapeDtypeStruct(  # noqa: E731
         tuple(v.shape), v.dtype) for k, v in d.items()}
+    return fn, sds(state), sds(feed)
+
+
+def _serving_sharded_specs(bench):
+    import jax
+    import numpy as np
+
+    fn, state, feed, _ = bench._build_serving_tp_sharded(tp=2)
+    sds = lambda d: {k: jax.ShapeDtypeStruct(  # noqa: E731
+        tuple(np.shape(v)), np.asarray(v).dtype) for k, v in d.items()}
     return fn, sds(state), sds(feed)
 
 
@@ -229,7 +265,7 @@ def check_workload(name, build):
     from paddle_tpu.flags import set_flags
 
     set_flags({"flash_packed_stats": "off", "flash_head_pack": "off",
-               "gspmd": False})
+               "gspmd": False, "serving_sharded": False})
     try:
         fn, state, feed = build()
         export.export(fn, platforms=("tpu",))(state, feed)
