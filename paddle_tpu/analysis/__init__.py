@@ -1,0 +1,31 @@
+"""Static program analysis: IR verifier + shape/dtype/sharding checker.
+
+MLIR-style always-verifiable IR (Lattner et al., 2021) applied to the
+Program/Block/Op IR: every transpiler pass can be bracketed by a
+structural verify (``checked_pass``), whole programs get a static
+shape/dtype inference pass built on ``core.registry.infer_shapes``,
+and GSPMD sharding annotations are validated against a ``MeshPlan``
+before any compile spends chip time on them.  All diagnostics are
+typed and name block / op-index / var (docs/ANALYSIS.md).
+
+Everything is gated by the typed flag ``ir_verify`` (default "off" —
+zero behavior change; "on" = structural verify before+after every
+transpiler pass; "full" = "on" plus the static shape check after each
+pass).  The test suite forces "on" (tests/conftest.py) so every parity
+test doubles as a verifier soak.
+"""
+
+from paddle_tpu.analysis.verifier import (  # noqa: F401
+    Diagnostic, VerifierError, verify, verify_roundtrip)
+from paddle_tpu.analysis.shape_check import (  # noqa: F401
+    ShapeCheckError, ShardingCheckError, check_shapes, check_sharding,
+    infer_program_shapes)
+from paddle_tpu.analysis.passes import (  # noqa: F401
+    checked_pass, verify_enabled, verify_level)
+
+__all__ = [
+    "Diagnostic", "VerifierError", "verify", "verify_roundtrip",
+    "ShapeCheckError", "ShardingCheckError", "check_shapes",
+    "check_sharding", "infer_program_shapes",
+    "checked_pass", "verify_enabled", "verify_level",
+]

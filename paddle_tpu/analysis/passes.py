@@ -1,0 +1,112 @@
+"""``checked_pass`` — verify-before/verify-after around every
+transpiler pass, behind the typed flag ``ir_verify``.
+
+Levels (flag values):
+  * "off"  — default: the wrapper is ONE flag read + one IR-mutation-
+             counter bump per pass call and the pass runs untouched
+             (flag-off graph bit-identical, asserted in
+             tests/test_ir_verifier.py; the bump invalidates the
+             program-fingerprint memo at the pass boundary — passes
+             that edit op dicts in place otherwise leave a stale
+             cached fingerprint behind, see checked_pass);
+  * "on"   — structural ``verifier.verify`` runs over every Program
+             argument before AND after the pass; a pass that receives
+             broken IR raises ``VerifierError`` labeled
+             ``<pass>:before``, a pass that breaks IR raises labeled
+             ``<pass>:after`` — so the diagnostic names the guilty
+             pass, not the next consumer;
+  * "full" — "on" plus the static shape/dtype check
+             (shape_check.check_shapes) after the pass.
+
+The test suite forces "on" (tests/conftest.py) so every parity test
+doubles as a verifier soak; tools/verifier_sweep.py runs the gate
+workloads under "full".
+"""
+
+from __future__ import annotations
+
+import functools
+
+from paddle_tpu import flags
+
+_LEVELS = ("off", "on", "full")
+
+
+def verify_level() -> str:
+    """Current ir_verify level, normalized ('off'|'on'|'full')."""
+    v = str(flags.get_flag("ir_verify")).lower()
+    if v in ("1", "true", "yes"):
+        return "on"
+    return v if v in _LEVELS else "off"
+
+
+def verify_enabled() -> bool:
+    return verify_level() != "off"
+
+
+def _programs_in(args, kwargs):
+    from paddle_tpu.core.program import Program
+
+    out = []
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, Program) and a not in out:
+            out.append(a)
+    return out
+
+
+def checked_pass(name):
+    """Decorator bracketing an IR-mutating pass entry point with the
+    structural verifier (and, at level "full", the static shape
+    check).  Every ``Program`` found in the call's arguments is
+    verified before the pass and re-verified after it."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            # Pass boundary = fingerprint-memo boundary, at EVERY
+            # level including "off": several passes legally edit op
+            # dicts in place (layout attrs, memory-opt renames) below
+            # the granularity the memo token sees, so a fingerprint
+            # cached before the pass would be served stale after it —
+            # the jit cache / model registry would key on pre-pass IR.
+            # (Found by the ISSUE 15 round-trip property test; the
+            # bump only invalidates a private memo, recomputed values
+            # are unchanged, so flag-off behavior stays bit-identical.)
+            from paddle_tpu.core.program import _bump_ir_mutation
+
+            level = verify_level()
+            if level == "off":
+                try:
+                    return fn(*args, **kwargs)
+                finally:
+                    _bump_ir_mutation()
+            from paddle_tpu.analysis import shape_check, verifier
+
+            programs = _programs_in(args, kwargs)
+            for p in programs:
+                verifier.verify(p, label=f"{name}:before")
+            try:
+                out = fn(*args, **kwargs)
+            finally:
+                _bump_ir_mutation()
+            # passes that BUILD programs (pserver/trainer program
+            # factories) return them: verify those too, labeled so
+            # the diagnostic names the producing pass
+            out_programs = _programs_in(
+                out if isinstance(out, (list, tuple)) else (out,), {})
+            for p in programs:
+                verifier.verify(p, label=f"{name}:after")
+                if level == "full":
+                    shape_check.check_shapes(p, label=f"{name}:after")
+            for p in out_programs:
+                if p in programs:
+                    continue
+                verifier.verify(p, label=f"{name}:output")
+                if level == "full":
+                    shape_check.check_shapes(p, label=f"{name}:output")
+            return out
+
+        wrapper.__wrapped_pass__ = name
+        return wrapper
+
+    return deco

@@ -16,9 +16,12 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from paddle_tpu.analysis.passes import checked_pass
+
 __all__ = ["bf16_transpile", "float16_transpile"]
 
 
+@checked_pass("bf16_transpile")
 def bf16_transpile(program, place=None, scope=None):
     """Cast every float32 var of `program` (and its scope values) to
     bfloat16.  Returns the program (modified in place).

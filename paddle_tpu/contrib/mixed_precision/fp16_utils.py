@@ -9,6 +9,7 @@ them into the consuming matmul/conv (free on the MXU's bf16 multiply path).
 
 from __future__ import annotations
 
+from paddle_tpu.analysis.passes import checked_pass
 from paddle_tpu.core.program import OpDesc
 from paddle_tpu.contrib.mixed_precision.fp16_lists import follow_x_list \
     as _FOLLOW_X
@@ -34,6 +35,7 @@ _WHITE_LOWP_OUT = {
 }
 
 
+@checked_pass("amp_rewrite")
 def rewrite_program(program, amp_lists, dest_dtype="bfloat16"):
     """Rewrite the global block in place.  White-list ops get their float
     inputs cast to ``dest_dtype``; black-list (and unknown) ops get

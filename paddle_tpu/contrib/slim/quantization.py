@@ -14,6 +14,7 @@ reference round-trips through IrGraph).
 
 from __future__ import annotations
 
+from paddle_tpu.analysis.passes import checked_pass
 import numpy as np
 
 from paddle_tpu.core.program import OpDesc
@@ -170,6 +171,7 @@ class QuantizationFreezePass:
         return out
 
 
+@checked_pass("quant_aware")
 def quant_aware(program, scope=None, weight_bits=8, activation_bits=8,
                 activation_quantize_type="moving_average_abs_max",
                 startup_program=None):
@@ -262,6 +264,7 @@ def post_training_quantize(program, scope, executor, feed_batches,
     return scales, weights
 
 
+@checked_pass("int8_inference")
 def convert_to_int8_inference(program, scope, quant_weights,
                               weight_bits=8):
     """Rewrite a frozen inference program to EXECUTE from int8 weights
@@ -318,6 +321,7 @@ _INT8_EXEC_WSLOT = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
                     "mul": "Y"}
 
 
+@checked_pass("int8_execution")
 def convert_to_int8_execution(program, scope, quant_weights,
                               weight_bits=8, act_scales=None,
                               out_dtype="float32",

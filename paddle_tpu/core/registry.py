@@ -34,6 +34,39 @@ import numpy as np
 GRAD_SUFFIX = "@GRAD"
 
 
+class UnknownOpTypeError(KeyError):
+    """Typed lookup failure naming the op type (ISSUE 15 satellite:
+    the bare KeyError propagated from arbitrary depths was opaque).
+    Subclasses KeyError so existing ``except KeyError`` callers keep
+    working."""
+
+    def __init__(self, type):
+        self.op_type = type
+        super().__init__(f"op '{type}' is not registered")
+
+    def __str__(self):
+        return self.args[0]
+
+
+class InferShapeError(RuntimeError):
+    """Typed shape-inference failure naming op type, slot, and (when
+    the caller provides names) the var — instead of a KeyError from
+    inside the op's compute or a silent None."""
+
+    def __init__(self, op_type, slot=None, var=None, reason=""):
+        self.op_type = op_type
+        self.slot = slot
+        self.var = var
+        msg = f"shape inference for op '{op_type}' failed"
+        if slot is not None:
+            msg += f" on input slot '{slot}'"
+        if var is not None:
+            msg += f" (var '{var}')"
+        if reason:
+            msg += f": {reason}"
+        super().__init__(msg)
+
+
 @dataclasses.dataclass
 class OpDef:
     type: str
@@ -123,7 +156,7 @@ def get_op_def(type: str) -> OpDef:
     except KeyError:
         if type.endswith("_grad") and type[: -len("_grad")] in _REGISTRY:
             return _generic_grad_def(type[: -len("_grad")])
-        raise KeyError(f"op '{type}' is not registered") from None
+        raise UnknownOpTypeError(type) from None
 
 
 def has_op_def(type: str) -> bool:
@@ -226,7 +259,7 @@ def _generic_grad_def(fwd_type: str) -> OpDef:
 # ---------------------------------------------------------------------------
 
 def infer_shapes(op_def: OpDef, ins_specs: dict, attrs: dict,
-                 strict: bool = True):
+                 strict: bool = True, var_names: Optional[dict] = None):
     """ins_specs: slot -> ShapeDtypeStruct or list thereof (shapes may have -1).
 
     Unknown dims (-1) all get the SAME dummy extent (so broadcasting between
@@ -234,6 +267,13 @@ def infer_shapes(op_def: OpDef, ins_specs: dict, attrs: dict,
     different dummies identifies symbolic output dims: any dim that changes
     between the runs depends on an unknown input dim and is reported as -1.
     Returns {out_slot: ShapeDtypeStruct-or-list} or None if inference failed.
+
+    Failures on fully-known input shapes (strict mode) raise the typed
+    ``InferShapeError`` naming the op type — and, when the failure is
+    a missing input-slot spec, the slot and (when the caller passes
+    ``var_names``: slot -> [var name, ...]) the var.  The ISSUE 15
+    satellite replacing the opaque KeyError/RuntimeError that used to
+    surface from inside the op's compute.
     """
     had_unknown = [False]
 
@@ -268,9 +308,20 @@ def infer_shapes(op_def: OpDef, ins_specs: dict, attrs: dict,
             # Callers appending into control-flow sub-blocks pass
             # strict=False: their recorded var shapes are the
             # scan-sliced per-step views, not the execution shapes.
-            raise RuntimeError(
-                f"shape inference for op '{op_def.type}' failed on "
-                f"fully-known input shapes: {e}") from e
+            slot = None
+            var = None
+            if isinstance(e, KeyError) and e.args and \
+                    e.args[0] in op_def.inputs:
+                # the compute indexed a slot the caller never fed:
+                # name the slot (and the var behind it, when known)
+                # instead of surfacing a bare KeyError
+                slot = e.args[0]
+                names = (var_names or {}).get(slot) or [None]
+                var = names[0]
+            raise InferShapeError(
+                op_def.type, slot=slot, var=var,
+                reason=f"on fully-known input shapes: "
+                       f"{type(e).__name__}: {e}") from e
         # dummy extents substituted for unknown dims can legitimately
         # mislead shape arithmetic (e.g. reshape) — treat as unknown
         return None

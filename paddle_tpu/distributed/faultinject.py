@@ -145,6 +145,28 @@ def _action_text(action):
                     for kind, arg in steps)
 
 
+# ---------------------------------------------------------------------------
+# msg-type registry (ISSUE 15 satellite).  Every injectable fault
+# point — RPC wire types (RPCServer.register_handler registers them
+# here automatically) and local serving fault points (their MSG_*
+# constants are defined as register_msg_type(...) calls) — lands in
+# this advisory set, so tools/repo_lint.py can statically check that
+# every msg type consulted at a decide() site is a REAL fault point
+# (a typo'd plan rule otherwise just never fires).  Advisory on
+# purpose at runtime: plans legally install before any server
+# registers its handlers.
+# ---------------------------------------------------------------------------
+
+KNOWN_MSG_TYPES: set = set()
+
+
+def register_msg_type(name: str) -> str:
+    """Record ``name`` as an injectable fault point; returns it (so
+    ``MSG_X = register_msg_type("x")`` reads as a declaration)."""
+    KNOWN_MSG_TYPES.add(str(name))
+    return str(name)
+
+
 class FaultPlan:
     """Explicit rules keyed by (msg_type, call_index) plus an optional
     seeded random component.  Build programmatically with .on() / knob

@@ -370,6 +370,7 @@ class RPCServer:
                 "msg_types": sorted(self._handlers)}
 
     def register_handler(self, msg_type: str, fn):
+        faultinject.register_msg_type(msg_type)
         self._handlers[msg_type] = fn
 
     # -- barrier support (reference rpc_server.h RegisterBarrier) -----------

@@ -277,6 +277,22 @@ define_flag("disagg_prefill", False,
             "tier boundary, and exactly-once accounting when a "
             "replica on either side dies mid-handoff "
             "(docs/SERVING.md handoff state machine)")
+define_flag("ir_verify", "off",
+            "IR verifier gating every transpiler pass (ISSUE 15, "
+            "paddle_tpu/analysis/, docs/ANALYSIS.md): 'off' = default "
+            "(zero behavior change — checked_pass is one flag read "
+            "and the wrapped pass runs untouched, bit-identity "
+            "asserted in tests/test_ir_verifier.py); 'on' = the "
+            "structural Program/Block/Op verifier runs before AND "
+            "after every transpiler pass (def-before-use, registered "
+            "op types with their attr schemas, slot validity, "
+            "dangling/duplicate vars, grad-op pairing) raising typed "
+            "VerifierError diagnostics that name block/op-index/var "
+            "and the guilty pass; 'full' = 'on' plus the static "
+            "shape/dtype inference check after each pass.  The test "
+            "suite forces 'on' (tests/conftest.py) so every parity "
+            "test doubles as a verifier soak; ci.sh runs the gate "
+            "workloads under 'full' via tools/verifier_sweep.py")
 define_flag("int8_conv_algo", "conv",
             "conv2d_int8 lowering: 'conv' = integer "
             "conv_general_dilated; 'im2col' = pad/slice/concat + one "

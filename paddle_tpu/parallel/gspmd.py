@@ -464,6 +464,16 @@ def annotate_tp_inference(program, plan, axis="tp"):
         if bias is not None:
             bias.set_sharding((axis,))
             annotated.append(bias.name)
+    # static legality check at annotate time (ISSUE 15): the pass
+    # above only writes divisible specs, but composed annotations
+    # (a pre-annotated program re-annotated for a different plan)
+    # surface here instead of at predictor trace time
+    from paddle_tpu.analysis.passes import verify_enabled
+
+    if verify_enabled():
+        from paddle_tpu.analysis.shape_check import check_sharding
+
+        check_sharding(program, plan, label="annotate_tp_inference")
     return annotated
 
 

@@ -117,11 +117,11 @@ from paddle_tpu.serving.replica_pool import ReplicaKilled, ReplyLost
 __all__ = ["MSG_DECODE", "MSG_PREFILL", "TinyDecodeLM",
            "DecodeConfig", "DecodeServer"]
 
-MSG_DECODE = "serving_decode"
+MSG_DECODE = faultinject.register_msg_type("serving_decode")
 # disaggregated prefill tier (ISSUE 14): one faultinject decision per
 # prefill, consulted AFTER the pages are allocated and detached into
 # the handoff — the kill-mid-handoff window the chaos soak seeds
-MSG_PREFILL = "serving_prefill"
+MSG_PREFILL = faultinject.register_msg_type("serving_prefill")
 
 _M_DECODE = _obs_metrics.counter(
     "paddle_tpu_decode_events_total",

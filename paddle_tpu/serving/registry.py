@@ -91,6 +91,24 @@ def _dir_fingerprint(model_dir, model_filename=None):
         raise RegistryError(
             f"cannot read inference model at {model_dir!r}: "
             f"{type(e).__name__}: {e}") from e
+    # ISSUE 15: with ir_verify on, a malformed program is refused AT
+    # REGISTRATION (typed, naming block/op/var) instead of surfacing
+    # as a prewarm compile failure — or worse, serving garbage.  The
+    # declared feed/fetch targets are part of the checked contract.
+    from paddle_tpu.analysis.passes import verify_enabled
+
+    if verify_enabled():
+        from paddle_tpu.analysis import VerifierError, verify
+
+        try:
+            verify(program,
+                   feeds=meta.get("feed_names") or (),
+                   fetches=meta.get("fetch_names") or (),
+                   roundtrip=True, label=f"register:{model_dir}")
+        except VerifierError as e:
+            raise RegistryError(
+                f"refusing malformed inference model at "
+                f"{model_dir!r}: {e}") from e
     return program_fingerprint(program)
 
 
@@ -151,6 +169,21 @@ class ModelVersion:
 
         p = predictor if predictor is not None \
             else self.make_predictor()
+        # ISSUE 15: re-verify the post-load IR (ir_optim fusions have
+        # run by now) BEFORE spending compile time on it — a pass that
+        # broke the IR at load time surfaces typed here, not as an
+        # opaque trace failure mid-prewarm
+        from paddle_tpu.analysis.passes import verify_enabled
+
+        if verify_enabled():
+            from paddle_tpu.analysis import VerifierError, verify
+
+            try:
+                verify(p._program, label=f"prewarm:{self}")
+            except VerifierError as e:
+                raise PrewarmFailedError(
+                    f"{self}: post-load IR failed verification: "
+                    f"{e}") from e
         try:
             specs = p.feed_specs()
             for b in buckets:

@@ -59,8 +59,8 @@ _M_BATCH_SECONDS = _obs_metrics.histogram(
 __all__ = ["MSG_INFER", "MSG_HEALTH", "ReplicaKilled", "ReplyLost",
            "Replica", "ReplicaPool", "replicate_predictor_params"]
 
-MSG_INFER = "serving_infer"
-MSG_HEALTH = "serving_health"
+MSG_INFER = faultinject.register_msg_type("serving_infer")
+MSG_HEALTH = faultinject.register_msg_type("serving_health")
 
 
 class ReplicaKilled(RuntimeError):

@@ -16,6 +16,7 @@ params every k steps.
 
 from __future__ import annotations
 
+from paddle_tpu.analysis.passes import checked_pass
 from paddle_tpu.core.program import BACKWARD, OPTIMIZE, OpDesc
 
 
@@ -25,6 +26,7 @@ class Collective:
     def __init__(self, nrings=1):
         self.nrings = nrings
 
+    @checked_pass("collective_transpile")
     def transpile(self, startup_program, main_program, rank, endpoints,
                   current_endpoint, wait_port=True):
         self.rank = rank

@@ -22,6 +22,8 @@ fuse_conv_epilogue.
 
 from __future__ import annotations
 
+from paddle_tpu.analysis.passes import checked_pass
+
 from paddle_tpu.core.program import OpDesc
 from paddle_tpu.transpiler.inference_transpiler import (_consumers,
                                                         _first_consumer)
@@ -43,6 +45,7 @@ class FuseConvBnTrainTranspiler:
     verbatim on the fused op (running-stat wiring and any Saved*
     consumers keep working)."""
 
+    @checked_pass("fuse_conv_bn_train")
     def transpile(self, program, protected=None):
         self._protected = frozenset(protected or ())
         block = program.global_block()

@@ -21,6 +21,7 @@ before append_backward/minimize, like the other forward rewrites.
 
 from __future__ import annotations
 
+from paddle_tpu.analysis.passes import checked_pass
 from paddle_tpu.core.program import OpDesc
 from paddle_tpu.transpiler.inference_transpiler import (_consumers,
                                                         _first_consumer)
@@ -37,6 +38,7 @@ class FuseConvEpilogueTranspiler:
     the residual add's other operand must be a 4-D var of the conv
     output's shape (a true skip connection, not a broadcast)."""
 
+    @checked_pass("fuse_conv_epilogue")
     def transpile(self, program, protected=None):
         self._protected = frozenset(protected or ())
         block = program.global_block()

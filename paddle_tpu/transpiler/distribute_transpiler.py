@@ -21,6 +21,7 @@ only send/recv/barrier host ops sit outside it.
 
 from __future__ import annotations
 
+from paddle_tpu.analysis.passes import checked_pass
 import numpy as np
 
 from paddle_tpu.core.program import OPTIMIZE, OpDesc, BlockRef, Program
@@ -70,6 +71,7 @@ class DistributeTranspiler:
         self.config = config or DistributeTranspilerConfig()
 
     # ------------------------------------------------------------------ public
+    @checked_pass("distribute_transpile")
     def transpile(self, trainer_id, program=None, pservers="", trainers=1,
                   sync_mode=None, startup_program=None):
         from paddle_tpu import framework
@@ -100,6 +102,7 @@ class DistributeTranspiler:
     def get_trainer_startup_program(self):
         return self.trainer_startup
 
+    @checked_pass("pserver_program")
     def get_pserver_program(self, endpoint):
         return self._build_pserver_program(endpoint)
 

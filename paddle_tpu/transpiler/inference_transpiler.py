@@ -14,6 +14,8 @@ save_inference_model.
 
 from __future__ import annotations
 
+from paddle_tpu.analysis.passes import checked_pass
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -48,6 +50,7 @@ def _first_consumer(block, name, after_idx):
 class InferenceTranspiler:
     """reference inference_transpiler.py:25."""
 
+    @checked_pass("inference_transpile")
     def transpile(self, program, place=None, scope=None,
                   protected=None):
         """Fold conv2d (+ optional elementwise_add bias) -> batch_norm
@@ -184,6 +187,7 @@ class FuseFCTranspiler:
 
     _ACTS = ("relu", "tanh", "sigmoid")
 
+    @checked_pass("fuse_elewise_add_act")
     def transpile(self, program, protected=None):
         self._protected = frozenset(protected or ())
         block = program.global_block()
@@ -264,6 +268,7 @@ class FuseElewiseAddActTranspiler:
 
     _ACTS = ("relu", "tanh", "sigmoid")
 
+    @checked_pass("fuse_fc")
     def transpile(self, program, protected=None):
         self._protected = frozenset(protected or ())
         block = program.global_block()

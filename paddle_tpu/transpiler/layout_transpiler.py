@@ -29,6 +29,7 @@ training step stays NHWC.
 
 from __future__ import annotations
 
+from paddle_tpu.analysis.passes import checked_pass
 from paddle_tpu.core.program import BACKWARD, OPTIMIZE, OpDesc
 
 # ops whose compute honors a layout attr
@@ -204,6 +205,7 @@ def _assert_forward_only(program, pass_name):
                     % (pass_name, op.op_role, op.type))
 
 
+@checked_pass("nhwc_transpile")
 def nhwc_transpile(program):
     """Rewrite `program` (in place) so conv/pool/norm chains run NHWC.
 
@@ -259,6 +261,7 @@ def _stem_candidates(block):
     return out
 
 
+@checked_pass("space_to_depth_stem")
 def space_to_depth_stem(program):
     """Rewrite 7x7/s2/p3 image stems as space-to-depth + 4x4/s1 conv.
 

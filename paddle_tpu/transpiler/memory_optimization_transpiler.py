@@ -20,6 +20,7 @@ working set.  Semantics match the reference:
 
 from __future__ import annotations
 
+from paddle_tpu.analysis.passes import checked_pass
 import numpy as np
 
 __all__ = ["memory_optimize", "release_memory"]
@@ -89,6 +90,7 @@ def _rename_in_op(op, old, new):
         op.outputs[slot] = [new if n == old else n for n in names]
 
 
+@checked_pass("memory_optimize")
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
                     level=0):
     """Reuse dead non-persistable vars' storage by renaming later vars onto
@@ -171,6 +173,7 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False,
     return input_program
 
 
+@checked_pass("release_memory")
 def release_memory(input_program, skip_opt_set=None):
     """Insert delete_var ops after each non-persistable var's last use
     (reference memory_optimization_transpiler.py:595; maps to the eager

@@ -26,6 +26,7 @@ alone and attaches a mesh plan the compiler consumes — the
 
 from __future__ import annotations
 
+from paddle_tpu.analysis.passes import checked_pass
 from paddle_tpu.parallel.gspmd import (MeshPlan, annotate_tp_transformer,
                                        annotate_zero3, partition_spec_of,
                                        tag_attention_ops)
@@ -46,6 +47,7 @@ class ShardingTranspiler:
         self.plan = plan
         self.summary = {}
 
+    @checked_pass("sharding_annotate")
     def transpile(self, program, zero3=True, tp=True,
                   tag_attention=True, min_size=2 ** 12):
         """Annotate ``program`` per the plan; returns a summary dict
@@ -69,6 +71,17 @@ class ShardingTranspiler:
         if tag_attention:
             summary["attention_ops"] = tag_attention_ops(
                 program, self.plan)
+        # static sharding legality check at annotate time (ISSUE 15):
+        # an indivisible tp/dp split or an untagged grad op is a typed
+        # diagnostic HERE instead of a silent trace-time fallback or a
+        # Mosaic partitioner rejection at the export gate
+        from paddle_tpu.analysis.passes import verify_enabled
+
+        if verify_enabled():
+            from paddle_tpu.analysis.shape_check import check_sharding
+
+            check_sharding(program, self.plan,
+                           label="sharding_annotate")
         self.summary = summary
         return summary
 

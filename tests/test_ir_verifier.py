@@ -1,0 +1,648 @@
+"""IR verifier + static shape/dtype/sharding checker + repo-lint tests
+(ISSUE 15, paddle_tpu/analysis/, docs/ANALYSIS.md).
+
+Every verifier / shape / sharding rule gets an intentionally-broken IR
+fixture proving its typed diagnostic fires — including the acceptance
+pair: a statically-caught tp-indivisible annotation and an
+unregistered-attr rewrite.  The `checked_pass` wrapper is proven
+default-off bit-identical (flag-off graph untouched; a broken program
+flows through a wrapped pass unverified) and on-labelled (the
+diagnostic names the guilty pass: `<pass>:before` / `:after` /
+`:output`).
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, layers, optimizer
+from paddle_tpu.analysis import (ShapeCheckError, ShardingCheckError,
+                                 VerifierError, check_shapes,
+                                 check_sharding, checked_pass, verify,
+                                 verify_roundtrip)
+from paddle_tpu.core import registry
+from paddle_tpu.core.program import (BACKWARD, FORWARD, BlockRef,
+                                     OpDesc, Program)
+from paddle_tpu.flags import get_flag, set_flags
+from paddle_tpu.parallel.gspmd import MeshPlan
+
+
+def _tools_mod(name):
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# one throwaway op with a REQUIRED attr, so the missing-required-attr
+# fixture doesn't depend on which real ops happen to use REQUIRED
+@registry.register_op("_vtest_reqattr", inputs=("X",),
+                      outputs=("Out",),
+                      attrs={"knob": registry.REQUIRED},
+                      differentiable=False)
+def _vtest_reqattr(ins, attrs):  # pragma: no cover - never executed
+    return {"Out": ins["X"]}
+
+
+def _small_net(with_backward=False):
+    """fc+relu+mean on the default main program; returns (program,
+    loss var)."""
+    x = layers.data(name="x", shape=[8, 16], dtype="float32",
+                    append_batch_size=False)
+    y = layers.fc(input=x, size=4, act="relu")
+    loss = layers.reduce_mean(y)
+    if with_backward:
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return framework.default_main_program(), loss
+
+
+def _rules(diags):
+    return sorted({d.rule for d in diags})
+
+
+def _raises_rule(program, rule, **verify_kw):
+    with pytest.raises(VerifierError) as ei:
+        verify(program, **verify_kw)
+    assert rule in _rules(ei.value.diagnostics), ei.value
+    return ei.value
+
+
+# ---------------------------------------------------------------------------
+# legal programs verify green
+# ---------------------------------------------------------------------------
+
+def test_verify_green_forward_and_backward():
+    prog, loss = _small_net(with_backward=True)
+    assert verify(prog, fetches=[loss], roundtrip=True) == []
+    assert check_shapes(prog) == []
+    assert verify(framework.default_startup_program()) == []
+
+
+def test_diagnostic_names_block_op_var():
+    prog, _ = _small_net()
+    op = prog.global_block().ops[0]
+    op.attrs["made_up_attr"] = 1
+    e = _raises_rule(prog, "unregistered-attr")
+    d = [d for d in e.diagnostics if d.rule == "unregistered-attr"][0]
+    assert d.block_idx == 0 and d.op_idx == 0 and d.op_type == op.type
+    s = str(d)
+    assert "block 0" in s and "op 0" in s and "made_up_attr" in s
+
+
+# ---------------------------------------------------------------------------
+# broken-IR fixtures: one per structural rule
+# ---------------------------------------------------------------------------
+
+def test_unknown_op_fires():
+    prog, _ = _small_net()
+    prog.global_block().ops.append(
+        OpDesc("totally_unregistered_op", {}, {}, {}))
+    _raises_rule(prog, "unknown-op")
+
+
+def test_unregistered_attr_rewrite_fires():
+    """THE acceptance fixture: a rewrite inventing an attr outside the
+    registered schema (the kernel would silently never read it)."""
+    prog, _ = _small_net()
+    prog.global_block().ops[0].attrs["fuse_mystery"] = True
+    _raises_rule(prog, "unregistered-attr")
+
+
+def test_required_attr_missing_fires():
+    prog, _ = _small_net()
+    b = prog.global_block()
+    b.create_var(name="ra_out", shape=(8, 16), dtype="float32")
+    op = b.append_op("_vtest_reqattr", {"X": "x"}, {"Out": "ra_out"},
+                     attrs={"knob": 3}, infer_shape=False)
+    del op.attrs["knob"]
+    e = _raises_rule(prog, "unregistered-attr")
+    assert any("required attr 'knob' missing" in str(d)
+               for d in e.diagnostics)
+
+
+def test_unknown_slot_fires():
+    prog, _ = _small_net()
+    op = prog.global_block().ops[0]
+    op.inputs["BogusSlot"] = ["x"]
+    _raises_rule(prog, "unknown-slot")
+
+
+def test_undefined_input_fires():
+    prog, _ = _small_net()
+    op = prog.global_block().ops[0]
+    slot = next(iter(op.inputs))
+    op.inputs[slot] = ["never_declared_anywhere"]
+    e = _raises_rule(prog, "undefined-input")
+    d = [d for d in e.diagnostics if d.rule == "undefined-input"][0]
+    assert d.var == "never_declared_anywhere"
+
+
+def test_use_before_def_fires():
+    prog, _ = _small_net()
+    b = prog.global_block()
+    # move the last op (mean over relu's output) to the front: it now
+    # consumes a non-persistable intermediate produced later
+    b.ops.insert(0, b.ops.pop())
+    _raises_rule(prog, "use-before-def")
+
+
+def test_duplicate_output_fires():
+    prog, _ = _small_net()
+    op = prog.global_block().ops[0]
+    slot = next(iter(op.outputs))
+    op.outputs[slot] = op.outputs[slot] + op.outputs[slot]
+    _raises_rule(prog, "duplicate-output")
+
+
+def test_misparented_var_fires():
+    prog, _ = _small_net()
+    b = prog.global_block()
+    v = b.vars["x"]
+    b.vars["not_x"] = v          # table key != VarDesc.name
+    _raises_rule(prog, "misparented-var")
+
+
+def test_grad_pairing_nondifferentiable_fires():
+    prog, _ = _small_net()
+    nd_type = next(t for t, d in sorted(registry._REGISTRY.items())
+                   if not d.differentiable)
+    prog.global_block().ops.append(
+        OpDesc(nd_type + "_grad", {}, {}, {}, op_role=BACKWARD))
+    _raises_rule(prog, "grad-pairing")
+
+
+def test_grad_role_warning_does_not_raise():
+    prog, _ = _small_net(with_backward=True)
+    gops = [op for op in prog.global_block().ops
+            if op.type.endswith("_grad")]
+    assert gops, "backward must have appended grad ops"
+    gops[0].op_role = FORWARD
+    diags = verify(prog)       # warning severity: returns, no raise
+    assert "grad-pairing" in _rules(diags)
+
+
+def test_block_ref_out_of_range_fires():
+    prog, _ = _small_net()
+    prog.global_block().ops[0].attrs["sub_block"] = BlockRef(99)
+    e = _raises_rule(prog, "block-ref")
+    # the bogus attr also trips the schema rule; both must name op 0
+    assert all(d.op_idx == 0 for d in e.diagnostics
+               if d.severity == "error")
+
+
+def test_feed_fetch_missing_fire():
+    prog, _ = _small_net()
+    e = _raises_rule(prog, "feed-missing", feeds=["no_such_feed"])
+    assert any(d.var == "no_such_feed" for d in e.diagnostics)
+    _raises_rule(prog, "fetch-missing", fetches=["no_such_fetch"])
+
+
+def test_orphan_var_is_warning_only():
+    prog, _ = _small_net()
+    prog.global_block().create_var(name="stranded", shape=(4,),
+                                   dtype="float32")
+    diags = verify(prog)
+    assert any(d.rule == "orphan-var" and d.var == "stranded" and
+               d.severity == "warning" for d in diags)
+
+
+def test_roundtrip_unserializable_attr_fires():
+    prog, _ = _small_net()
+    prog.global_block().ops[0].attrs["axis"] = {1, 2}   # not JSON-able
+    diags = verify_roundtrip(prog, raise_=False)
+    assert any(d.rule == "roundtrip" for d in diags), diags
+
+
+def test_roundtrip_green_and_fingerprint_stable():
+    from paddle_tpu.core.compiler import program_fingerprint
+
+    prog, _ = _small_net(with_backward=True)
+    fp = program_fingerprint(prog)
+    assert verify_roundtrip(prog) == []
+    assert program_fingerprint(
+        Program.parse_from_bytes(prog.to_bytes())) == fp
+
+
+# ---------------------------------------------------------------------------
+# static shape/dtype inference
+# ---------------------------------------------------------------------------
+
+def test_shape_mismatch_fires():
+    prog, _ = _small_net()
+    b = prog.global_block()
+    # stale rewrite: the declared VarDesc shape no longer matches what
+    # the op chain actually produces
+    b.vars["fc_0.tmp_0"].shape = (8, 999)
+    with pytest.raises(ShapeCheckError) as ei:
+        check_shapes(prog)
+    d = [d for d in ei.value.diagnostics
+         if d.rule == "shape-mismatch"][0]
+    assert d.var == "fc_0.tmp_0" and "(8, 999)" in d.message
+
+
+def test_dtype_mismatch_fires():
+    prog, _ = _small_net()
+    prog.global_block().vars["fc_0.tmp_0"].dtype = "int32"
+    with pytest.raises(ShapeCheckError) as ei:
+        check_shapes(prog)
+    assert "dtype-mismatch" in _rules(ei.value.diagnostics)
+
+
+def test_infer_failure_is_warning_with_typed_cause():
+    prog, _ = _small_net()
+    # make the matmul's declared operand shapes incompatible: inference
+    # fails (typed InferShapeError under the hood) -> warning, no raise
+    prog.global_block().vars["x"].shape = (8, 3)
+    diags = check_shapes(prog)
+    assert any(d.rule == "infer-failed" and d.op_type == "mul"
+               for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# sharding checker
+# ---------------------------------------------------------------------------
+
+def _annotated_net(spec, var="fc_0.w_0"):
+    prog, _ = _small_net()
+    prog.global_block().vars[var].set_sharding(spec)
+    return prog
+
+
+def test_sharding_green():
+    prog = _annotated_net((None, "tp"))       # (16, 4) across tp2
+    assert check_sharding(prog, MeshPlan(dp=2, tp=2)) == []
+
+
+def test_sharding_tp_indivisible_fires():
+    """THE acceptance fixture: a tp-indivisible annotation caught
+    statically at annotate time (the shard_map fallback is silent)."""
+    prog = _annotated_net((None, "tp"))       # dim 4 vs tp=3
+    with pytest.raises(ShardingCheckError) as ei:
+        check_sharding(prog, MeshPlan(dp=1, tp=3))
+    d = [d for d in ei.value.diagnostics
+         if d.rule == "sharding-indivisible"][0]
+    assert d.var == "fc_0.w_0" and "not divisible" in d.message
+
+
+def test_sharding_zero_x_tp_composition():
+    # ("tp","dp") composed dim must divide by tp*dp
+    prog = _annotated_net((("tp", "dp"), None))   # dim 16 / (2*4)=8 ok
+    assert check_sharding(prog, MeshPlan(dp=4, tp=2)) == []
+    prog2 = _annotated_net((("tp", "dp"), None))  # 16 % (3*2) != 0
+    with pytest.raises(ShardingCheckError):
+        check_sharding(prog2, MeshPlan(dp=2, tp=3))
+
+
+def test_sharding_unknown_axis_and_reuse_fire():
+    with pytest.raises(ShardingCheckError) as ei:
+        check_sharding(_annotated_net((None, "ep")), MeshPlan(tp=2))
+    assert "sharding-unknown-axis" in _rules(ei.value.diagnostics)
+    with pytest.raises(ShardingCheckError) as ei:
+        check_sharding(_annotated_net(("tp", "tp")),
+                       MeshPlan(dp=1, tp=2))
+    assert "sharding-axis-reuse" in _rules(ei.value.diagnostics)
+
+
+def test_sharding_rank_overflow_fires():
+    with pytest.raises(ShardingCheckError) as ei:
+        check_sharding(_annotated_net(("dp", None, "tp")),
+                       MeshPlan(dp=2, tp=2))
+    assert "sharding-rank" in _rules(ei.value.diagnostics)
+
+
+def _attention_program(batch=4, heads=6, tag_grad=True,
+                       batch_axis="dp", head_axis="tp"):
+    prog = Program()
+    b = prog.global_block()
+    for n in ("q", "k", "v"):
+        b.create_var(name=n, shape=(batch, heads, 128, 64),
+                     dtype="float32", is_data=True)
+    b.create_var(name="o", shape=(batch, heads, 128, 64),
+                 dtype="float32")
+    attrs = {"gspmd_batch_axis": batch_axis,
+             "gspmd_head_axis": head_axis}
+    b.append_op("flash_attention", {"Q": "q", "K": "k", "V": "v"},
+                {"Out": "o"}, attrs=attrs, infer_shape=False)
+    b.create_var(name="q@GRAD", shape=(batch, heads, 128, 64),
+                 dtype="float32")
+    b.append_op("flash_attention_grad",
+                {"Q": "q", "K": "k", "V": "v", "Out@GRAD": "o"},
+                {"Q@GRAD": "q@GRAD"},
+                attrs=attrs if tag_grad else {},
+                op_role=BACKWARD, infer_shape=False)
+    return prog
+
+
+def test_attention_tags_green():
+    prog = _attention_program()
+    assert check_sharding(prog, MeshPlan(dp=2, tp=2)) == []
+
+
+def test_attention_indivisible_tag_fires_statically():
+    # 6 heads over tp4: shard_map would fall back SILENTLY at trace
+    # time — here it is a typed diagnostic at annotate time
+    prog = _attention_program(heads=6)
+    with pytest.raises(ShardingCheckError) as ei:
+        check_sharding(prog, MeshPlan(dp=2, tp=4))
+    d = [d for d in ei.value.diagnostics
+         if d.rule == "sharding-indivisible"][0]
+    assert "SILENTLY" in d.message and d.op_type == "flash_attention"
+
+
+def test_untagged_grad_escape_fires():
+    prog = _attention_program(tag_grad=False)
+    with pytest.raises(ShardingCheckError) as ei:
+        check_sharding(prog, MeshPlan(dp=2, tp=2))
+    d = [d for d in ei.value.diagnostics
+         if d.rule == "sharding-untagged-grad"][0]
+    assert d.op_type == "flash_attention_grad"
+
+
+# ---------------------------------------------------------------------------
+# checked_pass: default-off bit-identity + guilty-pass labeling
+# ---------------------------------------------------------------------------
+
+@checked_pass("vtest_noop")
+def _noop_pass(program):
+    return program
+
+
+@checked_pass("vtest_breaker")
+def _breaking_pass(program):
+    program.global_block().ops[0].attrs["invented_by_pass"] = 1
+    return program
+
+
+@checked_pass("vtest_factory")
+def _factory_pass(program):
+    out = Program()
+    out.global_block().ops.append(OpDesc("nonexistent_op", {}, {}, {}))
+    return out
+
+
+def test_flag_default_is_off_outside_tests():
+    # the conftest forces "on" for the suite; the flag's registered
+    # default must stay "off" (repo_lint's flag-default-off rule also
+    # AST-enforces this at the define_flag site)
+    import ast
+
+    import paddle_tpu.flags as flags_mod
+
+    tree = ast.parse(open(flags_mod.__file__.rstrip("c")).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                getattr(node.func, "id", "") == "define_flag" and \
+                node.args and \
+                getattr(node.args[0], "value", "") == "ir_verify":
+            assert node.args[1].value == "off"
+            break
+    else:
+        raise AssertionError("ir_verify define_flag site not found")
+
+
+def test_flag_off_pass_untouched_and_broken_ir_flows():
+    prog, _ = _small_net()
+    prog.global_block().ops[0].attrs["invented"] = 1   # broken IR
+    set_flags({"ir_verify": "off"})
+    assert _noop_pass(prog) is prog       # no verify, no raise
+    set_flags({"ir_verify": "on"})
+    with pytest.raises(VerifierError):
+        _noop_pass(prog)
+
+
+def test_flag_off_graph_bit_identical():
+    from paddle_tpu.transpiler.memory_optimization_transpiler import \
+        memory_optimize
+
+    prog, _ = _small_net(with_backward=True)
+    p_off, p_on = prog.clone(), prog.clone()
+    set_flags({"ir_verify": "off"})
+    memory_optimize(p_off)
+    set_flags({"ir_verify": "on"})
+    memory_optimize(p_on)
+    assert p_off.to_bytes() == p_on.to_bytes()
+
+
+def test_checked_pass_labels_guilty_side():
+    prog, _ = _small_net()
+    set_flags({"ir_verify": "on"})
+    with pytest.raises(VerifierError, match="vtest_breaker:after"):
+        _breaking_pass(prog)
+    # the IR is now broken: the NEXT pass blames its input
+    with pytest.raises(VerifierError, match="vtest_noop:before"):
+        _noop_pass(prog)
+
+
+def test_checked_pass_verifies_output_programs():
+    prog, _ = _small_net()
+    set_flags({"ir_verify": "on"})
+    with pytest.raises(VerifierError, match="vtest_factory:output"):
+        _factory_pass(prog)
+
+
+def test_full_level_runs_shape_check():
+    prog, _ = _small_net()
+    prog.global_block().vars["fc_0.tmp_0"].shape = (8, 999)
+    set_flags({"ir_verify": "full"})
+    try:
+        with pytest.raises(ShapeCheckError):
+            _noop_pass(prog)
+        # level "on" does NOT shape-check: same program passes
+        set_flags({"ir_verify": "on"})
+        _noop_pass(prog)
+    finally:
+        set_flags({"ir_verify": "on"})
+
+
+def test_real_transpilers_are_wrapped():
+    from paddle_tpu.transpiler import (conv_bn_train_transpiler,
+                                       conv_epilogue_transpiler,
+                                       inference_transpiler,
+                                       layout_transpiler,
+                                       memory_optimization_transpiler,
+                                       sharding_transpiler)
+    from paddle_tpu.transpiler.distribute_transpiler import \
+        DistributeTranspiler
+
+    wrapped = [
+        inference_transpiler.InferenceTranspiler.transpile,
+        inference_transpiler.FuseFCTranspiler.transpile,
+        inference_transpiler.FuseElewiseAddActTranspiler.transpile,
+        conv_epilogue_transpiler.FuseConvEpilogueTranspiler.transpile,
+        conv_bn_train_transpiler.FuseConvBnTrainTranspiler.transpile,
+        layout_transpiler.nhwc_transpile,
+        layout_transpiler.space_to_depth_stem,
+        memory_optimization_transpiler.memory_optimize,
+        memory_optimization_transpiler.release_memory,
+        sharding_transpiler.ShardingTranspiler.transpile,
+        DistributeTranspiler.transpile,
+        DistributeTranspiler.get_pserver_program,
+    ]
+    for fn in wrapped:
+        assert getattr(fn, "__wrapped_pass__", None), fn
+
+
+def test_broken_rewrite_caught_at_real_pass_boundary():
+    """End to end: a transpiler pass handed IR that a previous rewrite
+    broke raises the typed error naming THAT pass's boundary."""
+    from paddle_tpu.transpiler.memory_optimization_transpiler import \
+        memory_optimize
+
+    prog, _ = _small_net(with_backward=True)
+    prog.global_block().ops[0].attrs["stale_rewrite_attr"] = 7
+    set_flags({"ir_verify": "on"})
+    with pytest.raises(VerifierError,
+                       match="memory_optimize:before") as ei:
+        memory_optimize(prog)
+    assert "unregistered-attr" in _rules(ei.value.diagnostics)
+
+
+# ---------------------------------------------------------------------------
+# registry typed failure diagnostics (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+def test_unknown_op_type_error_is_typed_and_keyerror():
+    with pytest.raises(registry.UnknownOpTypeError) as ei:
+        registry.get_op_def("definitely_not_an_op")
+    assert isinstance(ei.value, KeyError)       # legacy callers
+    assert ei.value.op_type == "definitely_not_an_op"
+    assert "is not registered" in str(ei.value)
+
+
+def test_infer_shapes_missing_slot_names_slot_and_var():
+    import jax
+
+    op_def = registry.get_op_def("mul")
+    attrs = op_def.canonical_attrs({})
+    with pytest.raises(registry.InferShapeError) as ei:
+        registry.infer_shapes(
+            op_def,
+            {"X": jax.ShapeDtypeStruct((4, 8), np.float32)},
+            attrs, strict=True, var_names={"Y": ["fc_0.w_0"]})
+    e = ei.value
+    assert e.op_type == "mul" and e.slot == "Y"
+    assert e.var == "fc_0.w_0"
+    assert "input slot 'Y'" in str(e) and "fc_0.w_0" in str(e)
+
+
+def test_infer_shapes_incompatible_shapes_typed():
+    import jax
+
+    op_def = registry.get_op_def("mul")
+    attrs = op_def.canonical_attrs({})
+    with pytest.raises(registry.InferShapeError) as ei:
+        registry.infer_shapes(
+            op_def,
+            {"X": jax.ShapeDtypeStruct((4, 3), np.float32),
+             "Y": jax.ShapeDtypeStruct((8, 2), np.float32)},
+            attrs, strict=True)
+    assert ei.value.op_type == "mul"
+
+
+# ---------------------------------------------------------------------------
+# repo-discipline linter (tools/repo_lint.py)
+# ---------------------------------------------------------------------------
+
+_BAD_TREE = {
+    "paddle_tpu/flags.py": (
+        'def define_flag(n, d, h=""):\n    pass\n'
+        'define_flag("good_flag", False, "ok")\n'
+        'define_flag("dark_launch", True, "ships live!")\n'),
+    "paddle_tpu/serving/errors.py": (
+        'class ServingError(Exception):\n    code = "serving"\n'
+        'class GoodError(ServingError):\n    code = "good"\n'
+        'class AliasedError(ServingError):\n    pass\n'
+        'class GrandchildError(GoodError):\n    pass\n'),
+    "paddle_tpu/metrics_use.py": (
+        'def counter(n):\n    return n\n'
+        'ok = counter("paddle_tpu_good_total")\n'
+        'bad = counter("WrongCase-Name")\n'
+        'unprefixed = counter("some_other_total")\n'),
+    "paddle_tpu/faults.py": (
+        'def decide(t, i):\n    return None\n'
+        'def register_msg_type(n):\n    return n\n'
+        'MSG_OK = register_msg_type("real_point")\n'
+        'decide("real_point", 0)\n'
+        'decide("typod_point", 0)\n'),
+    "paddle_tpu/knobs.py": (
+        'import os\n'
+        'a = os.environ.get("PADDLE_TPU_DOCUMENTED_KNOB")\n'
+        'b = os.environ.get("PADDLE_TPU_SECRET_KNOB")\n'),
+    "paddle_tpu/excepts.py": (
+        'try:\n    x = 1\nexcept:\n    pass\n'),
+    "docs/KNOBS.md": "| `PADDLE_TPU_DOCUMENTED_KNOB` | documented |\n",
+}
+
+
+@pytest.fixture
+def lint_tree(tmp_path):
+    for rel, src in _BAD_TREE.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    (tmp_path / "tools").mkdir(exist_ok=True)
+    mod = _tools_mod("repo_lint")
+    mod.ROOT = str(tmp_path)
+    return mod
+
+
+def test_repo_lint_rules_fire(lint_tree):
+    findings = lint_tree.lint()
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.id)
+    assert by_rule["flag-default-off"] == ["flag:dark_launch"]
+    # both the direct subclass without a code AND the grandchild of a
+    # coded subclass must be flagged
+    assert sorted(by_rule["serving-error-code"]) == [
+        "class:AliasedError", "class:GrandchildError"]
+    assert sorted(by_rule["metric-name-grammar"]) == [
+        "metric:WrongCase-Name", "metric:some_other_total"]
+    assert by_rule["fault-type-registered"] == ["msgtype:typod_point"]
+    assert by_rule["env-knob-documented"] == [
+        "env:PADDLE_TPU_SECRET_KNOB"]
+    assert len(by_rule["no-bare-except"]) == 1
+
+
+def test_repo_lint_allowlist_and_stale_entry(lint_tree):
+    allow = {"allow": [
+        {"rule": "flag-default-off", "id": "flag:dark_launch",
+         "reason": "test"},
+        {"rule": "no-bare-except", "id": "bare-except:gone.py:1",
+         "reason": "stale on purpose"},
+    ]}
+    (os.path.join(lint_tree.ROOT, "tools"))
+    with open(os.path.join(lint_tree.ROOT, "tools",
+                           "repo_lint_allowlist.json"), "w") as f:
+        json.dump(allow, f)
+    findings, used = lint_tree.apply_allowlist(lint_tree.lint())
+    ids = [f.id for f in findings]
+    assert used == 1
+    assert "flag:dark_launch" not in ids          # allowlisted away
+    # the unmatched entry is itself a finding: the list only shrinks
+    assert any(f.rule == "stale-allowlist" for f in findings)
+
+
+def test_repo_lint_repo_is_clean():
+    """The committed tree passes its own linter (satellite: first-run
+    findings were fixed or allowlisted with reasons)."""
+    mod = _tools_mod("repo_lint")
+    findings, allowed = mod.apply_allowlist(mod.lint())
+    assert findings == [], "\n".join(str(f) for f in findings)
+    assert allowed >= 1       # the strategy-selector flags
+
+
+def test_repo_lint_json_contract(capsys):
+    mod = _tools_mod("repo_lint")
+    assert mod.main(["--json"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    rec = json.loads(out[0])
+    assert rec["metric"] == "repo_lint" and rec["ok"] is True
+    assert rec["findings"] == []

@@ -14,6 +14,27 @@ echo "== 1/8 test suite (virtual 8-device CPU mesh; two lanes) =="
 python -m pytest tests/ -q -m "not slow"
 python -m pytest tests/ -q -m "slow" || { rc=$?; [ "$rc" -eq 5 ]; }
 
+echo "== 1b/8 repo-discipline lint (tools/repo_lint.py) =="
+# ISSUE 15: the written disciplines (flags default off, ServingError
+# subclasses carry stable codes, metric-name grammar, registered
+# faultinject msg types, documented PADDLE_TPU_* knobs, no bare
+# except) are AST-enforced; intentional exceptions live in
+# tools/repo_lint_allowlist.json with a one-line reason each, and a
+# stale allowlist entry is itself a failure (docs/ANALYSIS.md)
+python tools/repo_lint.py --json > /tmp/_repo_lint.json
+cat /tmp/_repo_lint.json
+python - <<'PY'
+import json
+lines = [ln for ln in open("/tmp/_repo_lint.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, "repo_lint stdout must be ONE JSON line"
+rec = json.loads(lines[0])
+assert rec["metric"] == "repo_lint"
+assert rec["ok"] is True, (
+    "repo discipline violated: %r" % rec["findings"])
+print("repo_lint OK: 0 findings, %d allowlisted" % rec["allowed"])
+PY
+
 echo "== 2/8 op inventory audit vs reference REGISTER_OPERATOR =="
 JAX_PLATFORMS=cpu python tools/op_coverage.py
 
@@ -402,6 +423,36 @@ python tools/tpu_lowering_check.py \
   llm_decode llm_decode_d64_hp2 llm_decode_int8kv llm_decode_bf16 \
   llm_decode_spec_k4 llm_decode_spec_k8 llm_decode_disagg \
   transformer_train_gspmd serving_tp_sharded
+
+echo "== 7b/8 IR verifier sweep (ir_verify=full over gate workloads) =="
+# ISSUE 15: every gate workload builds with the verifier forced to
+# "full" — the structural Program/Block/Op verifier plus the static
+# shape/dtype check bracket EVERY transpiler pass the build runs, and
+# the final program must round-trip through to_bytes/parse_from_bytes
+# with an unchanged program_fingerprint.  Zero error diagnostics on
+# legal programs is the acceptance bar (docs/ANALYSIS.md); the
+# pytest suite (step 1) already soaks level "on" via conftest.
+JAX_PLATFORMS=cpu python tools/verifier_sweep.py \
+  > /tmp/_verifier_sweep.json
+cat /tmp/_verifier_sweep.json
+python - <<'PY'
+import json
+lines = [ln for ln in
+         open("/tmp/_verifier_sweep.json").read().splitlines()
+         if ln.strip()]
+assert len(lines) == 1, "verifier_sweep stdout must be ONE JSON line"
+rec = json.loads(lines[0])
+assert rec["metric"] == "verifier_sweep" and rec["level"] == "full"
+assert rec["ok"] is True, (
+    "verifier sweep found broken IR: %r"
+    % {k: v["errors"] for k, v in rec["workloads"].items()
+       if not v["ok"]})
+assert rec["value"] >= 9, (
+    "sweep must cover the gate workload families: %r"
+    % sorted(rec["workloads"]))
+print("verifier sweep OK: %d workloads clean at level=full"
+      % rec["value"])
+PY
 
 echo "== 8/8 chaos soak (deterministic seed; both transports) =="
 # short fault-injection leg of the distributed stack: a seeded random
