@@ -305,7 +305,7 @@ def _transformer_n_params(seq, vocab, d_model, n_layer, d_inner,
 
 
 def _build_transformer_train(batch, seq, amp=True, fused_adam=False,
-                             gspmd=False, tp=2):
+                             gspmd=False, tp=2, fc_epilogue=False):
     """Build + init the bench transformer train step; returns
     (fn, state, feed, loss_name) — the exact path bench and profiler
     share.  amp=True rewrites activations to bf16 with fp32 master
@@ -337,7 +337,8 @@ def _build_transformer_train(batch, seq, amp=True, fused_adam=False,
 
     _fresh_programs()
     # flag hygiene: always set explicitly (same rule as conv_epilogue)
-    set_flags({"gspmd": bool(gspmd)})
+    set_flags({"gspmd": bool(gspmd),
+               "fc_epilogue": "on" if fc_epilogue else "off"})
     c = TRANSFORMER_BASE
     model = transformer_encoder_model(
         vocab_size=c["vocab"], max_len=seq, d_model=c["d_model"],
@@ -347,6 +348,16 @@ def _build_transformer_train(batch, seq, amp=True, fused_adam=False,
         # the gspmd variant opts in so the baseline program is
         # byte-identical to every previous round's
         param_prefix="tfm" if gspmd else None)
+    if fc_epilogue:
+        from paddle_tpu.transpiler import fuse_epilogue
+
+        # fuse BEFORE minimize (same ordering rule as the resnet
+        # bench's conv fusions): the fc+bias+act chains of every ffn
+        # and the attention projections collapse onto fc_epilogue ops,
+        # and the backward derives from the fused graph
+        fuse_epilogue(framework.default_main_program(),
+                      protected=[model["loss"].name],
+                      anchors=("fc",))
     opt = optimizer.Adam(learning_rate=1e-4, fuse=fused_adam)
     if amp:
         from paddle_tpu.contrib.mixed_precision import decorate
@@ -379,10 +390,10 @@ def _build_transformer_train(batch, seq, amp=True, fused_adam=False,
 
 
 def bench_transformer_train(batch=32, seq=512, chain=30,
-                            fused_adam=False):
+                            fused_adam=False, fc_epilogue=False):
     """Transformer-base LM (d=512, 6L, 8H, ffn 2048), seq 512."""
     fn, state, feed, loss_name = _build_transformer_train(
-        batch, seq, fused_adam=fused_adam)
+        batch, seq, fused_adam=fused_adam, fc_epilogue=fc_epilogue)
     sec_per_step, _ = _chain_timed(fn, state, feed, loss_name, chain)
     toks_per_sec = batch * seq / sec_per_step
     c = TRANSFORMER_BASE
@@ -402,7 +413,22 @@ def bench_transformer_train(batch=32, seq=512, chain=30,
     }
     if fused_adam:
         res["fused_adam"] = True
+    if fc_epilogue:
+        # canonical epilogue-workload marker (see _workload_sig): the
+        # fused anchor name, not a per-flag bool
+        res["epilogue"] = "fc"
     return res
+
+
+def bench_transformer_train_fcep(**kw):
+    """The fused fc-epilogue A/B leg (ISSUE 17): identical workload to
+    tf_train (same shapes, same analytic MFU numerator) with the ffn
+    and projection fc+bias+act chains IR-fused onto fc_epilogue ops
+    (transpiler/epilogue_transpiler.py) and routed through the Pallas
+    fused matmul kernel (ops/epilogue.py).  Separate leg so the ladder
+    banks both sides of the A/B."""
+    kw.setdefault("fc_epilogue", True)
+    return bench_transformer_train(**kw)
 
 
 def bench_transformer_train_gspmd(batch=32, seq=512, chain=30, tp=2):
@@ -1720,6 +1746,11 @@ _LEG_FUNCS = {
     # the convep pair so a window banks the full A/B/C set together
     "rn_train_convbnstats": "bench_resnet50_train_convbnstats",
     "tf_train": "bench_transformer_train",
+    # ISSUE 17: the fc-epilogue A/B — same workload with the ffn and
+    # projection fc+bias+act chains fused onto the Pallas fc_epilogue
+    # kernel; rides right after the baseline leg so an on-chip window
+    # banks the A/B pair together (the convep precedent)
+    "tf_train_fcep": "bench_transformer_train_fcep",
     # ISSUE 8: the same transformer step as ONE pjit program over
     # every attached device (dp x tp MeshPlan, ZeRO-3 + tp specs,
     # flash under shard_map); on a single chip this degrades to a
@@ -1775,6 +1806,10 @@ _TINY = {
     # fused train graph, not the kernels
     "rn_train_convbnstats": dict(batch=8, chain=2),
     "tf_train": dict(batch=2, seq=128, chain=2),
+    # off-TPU the fc_epilogue=on auto-impl is the XLA composite, so
+    # the degraded leg checks fuse-pass/build/dispatch liveness of the
+    # fused train graph, not the kernel
+    "tf_train_fcep": dict(batch=2, seq=128, chain=2),
     # degraded CPU runs see 1 virtual device -> a 1x1 mesh; the leg
     # still exercises annotate/transpile/pjit-build liveness
     "tf_train_gspmd": dict(batch=2, seq=128, chain=2),
@@ -1859,6 +1894,28 @@ def _run_leg(leg, kwargs, cpu, timeout_s):
     return None, "no LEGRESULT in output"
 
 
+def _epilogue_marker(row):
+    """Canonical epilogue-workload marker of a bench row (ISSUE 17).
+
+    New rows carry the fused-anchor list in row["epilogue"] (e.g.
+    "fc"); legacy banked rows carry the per-flag bools
+    (conv_epilogue / conv_bn_stats / int8_interlayer) that predate the
+    unified pass — this derives the SAME canonical string from either
+    spelling, so banked baselines keep matching their reruns across
+    the marker migration."""
+    ep = row.get("epilogue")
+    if ep:
+        return str(ep)
+    parts = []
+    if row.get("conv_epilogue"):
+        parts.append("conv")
+    if row.get("conv_bn_stats"):
+        parts.append("conv_bn")
+    if row.get("int8_interlayer"):
+        parts.append("int8")
+    return "+".join(parts)
+
+
 def _workload_sig(key, row):
     """Workload identity of a bench row, independent of key spelling.
 
@@ -1867,26 +1924,28 @@ def _workload_sig(key, row):
     _fastpath) and _DEGRADED decoration stripped; the shape and the
     graph variant are then re-keyed from the row's OWN metadata
     (batch/seq/heads/head_dim + the variant marker fields every
-    variant leg records).  Two rows with equal signatures are the
-    same measurement slot: a fresh live one always supersedes a
-    banked one, however either key happens to be spelled."""
+    variant leg records).  The three epilogue-fusion flags collapse
+    into ONE canonical marker (_epilogue_marker) so old per-flag rows
+    and new stage-list rows land in the same slot.  Two rows with
+    equal signatures are the same measurement slot: a fresh live one
+    always supersedes a banked one, however either key happens to be
+    spelled."""
     import re
 
     fam = re.sub(r"_DEGRADED.*$", "", key)
     fam = re.sub(r"_(?:mb|seq|h|d|blk|str|spec_k)\d+", "", fam)
-    fam = re.sub(r"_(?:s2d|convep|convbnstats|cmp_pool|bn1p|fastpath|"
-                 r"packed|hp2|fusedadam|interlayer|int8kv|gspmd|"
-                 r"prefix_shared|chunked_join|disagg|tp\d+)(?=_|$)",
+    fam = re.sub(r"_(?:s2d|convep|convbnstats|fcep|cmp_pool|bn1p|"
+                 r"fastpath|packed|hp2|fusedadam|interlayer|int8kv|"
+                 r"gspmd|prefix_shared|chunked_join|disagg|tp\d+)"
+                 r"(?=_|$)",
                  "", fam)
     return (fam, row.get("batch"), row.get("seq"), row.get("heads"),
             row.get("head_dim"), bool(row.get("s2d_stem")),
-            bool(row.get("conv_epilogue")),
-            bool(row.get("conv_bn_stats")),
+            _epilogue_marker(row),
             row.get("maxpool_grad") or "",
             bool(row.get("conv_bn_folded")),
             bool(row.get("packed_stats")), bool(row.get("head_pack")),
             bool(row.get("fused_adam")),
-            bool(row.get("int8_interlayer")),
             row.get("streams"), bool(row.get("kv_int8")),
             bool(row.get("paged")),
             row.get("spec_k"), row.get("prefix_shared"),

@@ -171,6 +171,24 @@ def _check_block(block, diags):
                         "unregistered-attr",
                         f"required attr {aname!r} missing",
                         block_idx=bidx, op_idx=i, op_type=op.type))
+            # -- epilogue stage list ------------------------------------
+            # ops carrying a fused stage list (conv2d_epilogue,
+            # conv2d_bn_train, fc_epilogue, conv2d_int8, ...) declare
+            # an "epilogue" attr; a non-empty value must parse against
+            # the stage grammar (ops/epilogue.py) — transpilers build
+            # it via spec_attr so this only fires on hand-edited IR
+            ep = op.attrs.get("epilogue", "")
+            if ep:
+                from paddle_tpu.ops.epilogue import EpilogueSpec
+
+                try:
+                    EpilogueSpec.from_attr(ep).validate()
+                except ValueError as e:
+                    diags.append(Diagnostic(
+                        "epilogue-spec",
+                        f"attr 'epilogue' {ep!r} is not a valid stage "
+                        f"list: {e}",
+                        block_idx=bidx, op_idx=i, op_type=op.type))
             # -- slot validity ------------------------------------------
             for slot in op.inputs:
                 if slot not in op_def.inputs:

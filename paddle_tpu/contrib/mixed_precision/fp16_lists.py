@@ -30,6 +30,9 @@ white_list = {
     # (Scale/BNBias/Mean/Variance) are pinned fp32 by fp16_utils
     # (_WHITE_KEEP_FP32), matching batch_norm's gray-list treatment
     "conv2d_bn_train",
+    # fused mul+bias+residual+act (ops/epilogue.py): bf16 operands,
+    # f32 accumulation on the MXU — same story as the mul it replaces
+    "fc_epilogue",
 }
 
 # numerically sensitive: keep fp32

@@ -456,143 +456,17 @@ def _fold_int8_interlayer(program, block, out_dtype, weight_bits,
     """ISSUE-5 stage 2: fold quantized-op -> quantized-op edges so the
     inter-layer tensor is int8.
 
-    For each ``conv2d_int8`` producer with a calibrated InScale, walk
-    its epilogue chain — optional per-channel bias ``elementwise_add``
-    (the folded-BN shift: Y 1-D persistable; a residual add never
-    matches) then optional ``relu`` — each link sole-consumed.  If
-    EVERY consumer of the chain tail is a converted int8 op reading it
-    as its activation with a calibrated InScale (and no per-input-row
-    mul scale, which folds into the activation pre-quantization), the
-    FULL fold applies: the requantize epilogue rides inside the
-    producer op (Bias + fuse_relu + OutScale = the consumers' shared
-    calibrated scale), the chain ops are deleted, and the producer
-    emits the tail var as int8 — one byte per element crosses the op
-    boundary, and the consumers' int8-in path skips re-quantization.
+    Since ISSUE 17 the walk lives in the unified epilogue pass
+    (transpiler/epilogue_transpiler.py::fold_int8_interlayer) — the
+    requantize arm of the one stage grammar, now also folding residual
+    edges — and this name delegates there.  Same producers, same
+    guards, same emitted in-op epilogue, same statistics keys (plus
+    ``n_residual_folds``).  See that module for the full contract."""
+    from paddle_tpu.transpiler.epilogue_transpiler import \
+        fold_int8_interlayer
 
-    Edges whose tail feeds a non-quantized consumer (residual adds,
-    pools, fetch targets) get the PARTIAL fold instead: bias and a
-    sole-consumed tail ReLU still fold into the producer (no OutScale,
-    float out) — fewer op boundaries, identical values.
-
-    The in-op epilogue mirrors the unfused chain's op order, dtypes
-    (out_dtype stays the unfused inter-layer dtype) and rounding
-    points exactly, so fused and unfused graphs produce bit-identical
-    logits.  The standalone ``requantize`` op implements the same
-    contract for raw-int32-accumulator producers and anchors the
-    parity tests.  Returns fold statistics."""
-    del weight_bits  # the epilogue reuses the producer's max_range
-    consumers = {}
-    for op in block.ops:
-        for slot, names in op.inputs.items():
-            for n in names:
-                consumers.setdefault(n, []).append((op, slot))
-    sub_read = set()
-    for blk in program.blocks:
-        if blk is block:
-            continue
-        for op in blk.ops:
-            for names in op.inputs.values():
-                sub_read.update(names)
-
-    def _is_bias_add(op):
-        if op.type != "elementwise_add":
-            return False
-        y = op.inputs.get("Y", [None])[0]
-        v = block.vars.get(y)
-        return (v is not None and v.persistable and v.shape is not None
-                and len(v.shape) == 1)
-
-    def _quantized_consumer(op, slot, tail):
-        """True when (op, slot) is an int8 op consuming `tail` as its
-        activation with a calibrated InScale on that exact tensor."""
-        scale_name = tail + "@ACT_SCALE"
-        if op.inputs.get("InScale", [None])[0] != scale_name:
-            return False
-        if op.type == "conv2d_int8":
-            return slot == "Input"
-        if op.type == "mul_int8":
-            if slot != "X":
-                return False
-            sv = block.vars.get(op.inputs["Scale"][0])
-            if sv is None or sv.shape is None:
-                return False
-            shp = tuple(sv.shape)
-            # per-input-row scales ((K,1...) or 1-D of length K) fold
-            # into the activation pre-quantization: reject (mirrors
-            # mul_int8's runtime guard)
-            if len(shp) >= 2 and int(np.prod(shp[1:])) == 1 and \
-                    shp[0] != 1:
-                return False
-            yv = block.vars.get(op.inputs["Y"][0])
-            k = yv.shape[0] if yv is not None and yv.shape else None
-            if len(shp) == 1 and shp[0] == k and shp[0] != 1:
-                return False
-            return True
-        return False
-
-    stats = {"n_producers": 0, "n_edges_folded": 0,
-             "n_partial_folds": 0, "n_rejected": 0}
-    n_int8_in = 0
-    for P in list(block.ops):
-        if P.type != "conv2d_int8" or not P.inputs.get("InScale"):
-            continue
-        if P.attrs.get("out_dtype") == "int32" or \
-                P.inputs.get("OutScale"):
-            continue
-        stats["n_producers"] += 1
-        t0 = P.outputs["Output"][0]
-        chain = []          # epilogue ops to delete, in order
-        bias_op = relu_op = None
-        cur = t0
-        cons = consumers.get(cur, [])
-        if len(cons) == 1 and _is_bias_add(cons[0][0]) and \
-                cons[0][1] == "X" and cur not in sub_read and \
-                cur not in protected:
-            bias_op = cons[0][0]
-            chain.append(bias_op)
-            cur = bias_op.outputs["Out"][0]
-            cons = consumers.get(cur, [])
-        if len(cons) == 1 and cons[0][0].type == "relu" and \
-                cur not in sub_read and cur not in protected:
-            relu_op = cons[0][0]
-            chain.append(relu_op)
-            cur = relu_op.outputs["Out"][0]
-            cons = consumers.get(cur, [])
-        tail = cur
-        if not chain and not cons:
-            continue        # nothing to fold, nowhere to quantize into
-        full = (bool(cons)
-                and all(_quantized_consumer(op, slot, tail)
-                        for op, slot in cons)
-                and tail not in protected and tail not in sub_read
-                and (tail + "@ACT_SCALE") in block.vars)
-        if not full and not chain:
-            stats["n_rejected"] += 1
-            continue
-        # both fold flavors attach the chain to the producer op:
-        # Bias/fuse_relu (and OutScale for the full fold) become the
-        # conv's in-op epilogue; chain ops leave the graph
-        if bias_op is not None:
-            P.inputs["Bias"] = list(bias_op.inputs["Y"])
-            P.set_attr("bias_axis", bias_op.attrs.get("axis", -1))
-        # set_attr (not a raw attrs write) on every fold so the
-        # compiled-program fingerprint always sees the rewrite — the
-        # no-chain full fold otherwise only touches op.inputs
-        P.set_attr("fuse_relu", relu_op is not None)
-        if chain:
-            P.outputs["Output"] = [tail]
-            block.ops = [o for o in block.ops if o not in chain]
-        if full:
-            P.inputs["OutScale"] = [tail + "@ACT_SCALE"]
-            tv = block.vars.get(tail)
-            if tv is not None:
-                tv.dtype = "int8"
-            n_int8_in += len(cons)
-            stats["n_edges_folded"] += 1
-        else:
-            stats["n_partial_folds"] += 1
-    stats["n_int8_inputs"] = n_int8_in
-    return stats
+    return fold_int8_interlayer(program, block, out_dtype, weight_bits,
+                                protected)
 
 
 def quantize_weights_abs_max(program, scope, weight_bits=8,

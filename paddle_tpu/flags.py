@@ -104,6 +104,19 @@ define_flag("conv_bn_stats", "off",
             "ride out of the conv kernel as sibling outputs and ONE "
             "fused normalize+residual+ReLU pass finishes the chain "
             "(ROADMAP rn50 >=50% MFU item, ISSUE 4)")
+define_flag("fc_epilogue", "off",
+            "fused matmul+bias+residual+act Pallas kernel "
+            "(ops/epilogue.py fc_epilogue) for the fc/mul chains the "
+            "unified epilogue transpiler rewrites (ISSUE 17): 'off' = "
+            "the exact unfused composite (default; zero behavior "
+            "change — mul, elementwise_add, act as discrete ops), "
+            "'on' = Pallas kernel on TPU / unfused composite "
+            "elsewhere, 'pallas' / 'interpret' / 'xla' force one impl "
+            "('interpret' runs the kernel under the Pallas interpreter "
+            "for CPU parity tests).  The matmul sibling of "
+            "conv_epilogue — covers the transformer train graph's "
+            "fc+bias+relu/gelu tails (the Adam-tail diagnosis's "
+            "missing A/B leg)")
 define_flag("flash_packed_stats", "off",
             "flash-attention row-stats layout: 'off' = the validated "
             "lane-replicated [B*H, T, 128] f32 log-sum-exp (plus two "

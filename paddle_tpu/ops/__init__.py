@@ -20,6 +20,7 @@ from paddle_tpu.ops import parallel_ops  # noqa: F401
 from paddle_tpu.ops import quant  # noqa: F401
 from paddle_tpu.ops import pallas_kernels  # noqa: F401
 from paddle_tpu.ops import pallas_conv  # noqa: F401
+from paddle_tpu.ops import epilogue  # noqa: F401
 from paddle_tpu.ops import ps_ops  # noqa: F401
 from paddle_tpu.ops import loss_ops  # noqa: F401
 from paddle_tpu.ops import vision  # noqa: F401

@@ -80,13 +80,9 @@ def _conv_core(x, w, strides, padding):
 
 
 def _epilogue_xla(y, bias, residual, act):
-    if bias is not None:
-        y = y + bias.astype(y.dtype)
-    if residual is not None:
-        y = y + residual.astype(y.dtype)
-    if act == "relu":
-        y = jnp.maximum(y, 0)
-    return y
+    from paddle_tpu.ops.epilogue import apply_chain_stages
+
+    return apply_chain_stages(y, bias=bias, residual=residual, act=act)
 
 
 def _reference(x, w, bias, residual, strides, padding, act):
@@ -144,12 +140,15 @@ def _conv_ep_kernel(*refs, kh, kw, sh, sw, oh, ow, act, has_bias,
                 w_ref[ti, tj].astype(ct),
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-    if has_bias:
-        acc = acc + b_ref[0].astype(jnp.float32)[None, :]
-    if has_res:
-        acc = acc + r_ref[0].reshape(oh * ow, bco).astype(jnp.float32)
-    if act == "relu":
-        acc = jnp.maximum(acc, 0.0)
+    # the accumulator-order epilogue (ops/epilogue.py): bias/residual
+    # in f32 on the resident accumulator, act, ONE cast at the end
+    from paddle_tpu.ops.epilogue import apply_acc_stages
+
+    acc = apply_acc_stages(
+        acc,
+        bias=b_ref[0][None, :] if has_bias else None,
+        residual=r_ref[0].reshape(oh * ow, bco) if has_res else None,
+        act=act)
     o_ref[0] = acc.reshape(oh, ow, bco).astype(o_ref.dtype)
 
 
@@ -342,8 +341,10 @@ def _conv_stats_kernel(*refs, kh, kw, sh, sw, oh, ow, has_bias):
                 w_ref[ti, tj].astype(ct),
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-    if has_bias:
-        acc = acc + b_ref[0].astype(jnp.float32)[None, :]
+    from paddle_tpu.ops.epilogue import apply_acc_stages
+
+    acc = apply_acc_stages(
+        acc, bias=b_ref[0][None, :] if has_bias else None)
     y = acc.reshape(oh, ow, bco).astype(o_ref.dtype)
     o_ref[0] = y
     yf = y.reshape(oh * ow, bco).astype(jnp.float32)
@@ -491,15 +492,13 @@ def _bn_apply_kernel(*refs, act, has_res):
     y_ref, m_ref, r_ref, s_ref, b_ref = refs[:5]
     res_ref = refs[5] if has_res else None
     o_ref = refs[-1]
+    from paddle_tpu.ops.epilogue import apply_bn_tail
+
     yf = y_ref[0].astype(jnp.float32)              # [bh, OW, bc]
     t = (yf - m_ref[0][None, None, :]) * r_ref[0][None, None, :]
     t = t * s_ref[0][None, None, :] + b_ref[0][None, None, :]
-    t = t.astype(o_ref.dtype)
-    if has_res:
-        t = t + res_ref[0].astype(o_ref.dtype)
-    if act == "relu":
-        t = jnp.maximum(t, 0)
-    o_ref[0] = t
+    o_ref[0] = apply_bn_tail(t, o_ref.dtype,
+                             res_ref[0] if has_res else None, act)
 
 
 def _bn_apply_rows(oh, ow, bc, itemsize, n_bufs):
@@ -549,18 +548,15 @@ def _bn_apply_pallas(y, mean, rstd, scale, shift, residual, act,
 def _bn_apply_xla(y, mean, rstd, scale, shift, residual, act):
     """The unfused chain's exact op order: normalize in f32, cast to
     y.dtype, add the residual in that dtype, relu last."""
+    from paddle_tpu.ops.epilogue import apply_bn_tail
+
     f32 = jnp.float32
     shape = (1, 1, 1, y.shape[-1])
     t = (y.astype(f32) - mean.astype(f32).reshape(shape)) \
         * rstd.astype(f32).reshape(shape)
     t = t * scale.astype(f32).reshape(shape) \
         + shift.astype(f32).reshape(shape)
-    t = t.astype(y.dtype)
-    if residual is not None:
-        t = t + residual.astype(y.dtype)
-    if act == "relu":
-        t = jnp.maximum(t, 0)
-    return t
+    return apply_bn_tail(t, y.dtype, residual, act)
 
 
 def bn_normalize_epilogue(y, mean, var, scale, shift, residual=None, *,
@@ -781,7 +777,8 @@ from paddle_tpu.core.registry import register_op  # noqa: E402
              outputs=("Output",),
              optional=("Bias", "Residual"),
              attrs={"strides": [1, 1], "paddings": [0, 0], "act": "",
-                    "groups": 1, "data_format": "NCHW"})
+                    "groups": 1, "data_format": "NCHW",
+                    "epilogue": ""})
 def _conv2d_epilogue_op(ins, attrs):
     """conv2d + channel bias + residual add + activation as ONE op.
     NCHW programs are normalized to NHWC internally (the layout
@@ -828,7 +825,7 @@ def _bn_impl_from_flag():
              optional=("Bias", "Residual"),
              attrs={"strides": [1, 1], "paddings": [0, 0], "act": "",
                     "groups": 1, "epsilon": 1e-5, "momentum": 0.9,
-                    "data_format": "NCHW"})
+                    "data_format": "NCHW", "epilogue": ""})
 def _conv2d_bn_train_op(ins, attrs):
     """conv2d + train-mode batch_norm + residual add + activation as
     ONE op — the target of transpiler.fuse_conv_bn_train.  Outputs

@@ -179,11 +179,9 @@ def requantize(ins, attrs):
         y = y + _bcast_y(y, ins["Bias"], attrs.get("bias_axis", -1))
     if attrs.get("fuse_relu"):
         y = jax.nn.relu(y)
-    so = jnp.maximum(ins["OutScale"].reshape(()).astype(jnp.float32),
-                     1e-8)
-    y8 = jnp.clip(jnp.round(y.astype(jnp.float32) / so * bnd),
-                  -bnd, bnd).astype(jnp.int8)
-    return {"Output": y8}
+    from paddle_tpu.ops.epilogue import quantize_tail
+
+    return {"Output": quantize_tail(y, ins["OutScale"], bnd)}
 
 
 @register_op("dequantize_weight", inputs=("X", "Scale"),
@@ -251,14 +249,15 @@ def _int8_conv_im2col(x8, q, strides, pads, dils, groups, fmt):
 
 
 @register_op("conv2d_int8", inputs=("Input", "Filter", "FilterScale",
-                                    "InScale", "Bias", "OutScale"),
+                                    "InScale", "Bias", "Residual",
+                                    "OutScale"),
              outputs=("Output",),
-             optional=("InScale", "Bias", "OutScale"),
+             optional=("InScale", "Bias", "Residual", "OutScale"),
              attrs={"strides": [1, 1], "paddings": [0, 0],
                     "dilations": [1, 1], "groups": 1,
                     "data_format": "NCHW", "max_range": 127.0,
                     "out_dtype": "float32", "fuse_relu": False,
-                    "bias_axis": -1},
+                    "bias_axis": -1, "epilogue": ""},
              differentiable=False)
 def conv2d_int8(ins, attrs):
     """True-int8 convolution (reference int8 execution path,
@@ -287,6 +286,10 @@ def conv2d_int8(ins, attrs):
         ReLU ride inside the conv op, mirroring the unfused
         elementwise_add/relu chain's op order, dtypes and broadcast
         (bias_axis) bit-exactly;
+      * Residual: the skip-connection add between bias and ReLU
+        (ISSUE 17's residual-edge fold: the epilogue stage grammar's
+        ``residual`` stage riding the existing kernel — mirrors the
+        unfused elementwise_add's op order and dtype promotion);
       * OutScale: quantize the epilogue result to the CONSUMER's
         calibrated scale and emit int8 — the int8-out variant; the
         tensor crossing the op boundary is 1 byte/elem;
@@ -352,13 +355,17 @@ def conv2d_int8(ins, attrs):
         # dtype promotion (bf16 out + f32 bias -> f32) — bit-parity
         # with the never-folded chain is the contract
         y = y + _bcast_y(y, ins["Bias"], attrs.get("bias_axis", -1))
+    if "Residual" in ins:
+        # the residual stage: same-shape skip add between bias and
+        # ReLU, with elementwise_add's promotion — exactly the op the
+        # fold erased
+        y = y + _bcast_y(y, ins["Residual"], -1)
     if attrs.get("fuse_relu"):
         y = jax.nn.relu(y)
     if "OutScale" in ins:
-        so = jnp.maximum(
-            ins["OutScale"].reshape(()).astype(jnp.float32), 1e-8)
-        y = jnp.clip(jnp.round(y.astype(jnp.float32) / so * bnd),
-                     -bnd, bnd).astype(jnp.int8)
+        from paddle_tpu.ops.epilogue import quantize_tail
+
+        y = quantize_tail(y, ins["OutScale"], bnd)
     return {"Output": y}
 
 
@@ -367,7 +374,8 @@ def conv2d_int8(ins, attrs):
              outputs=("Out",), optional=("InScale", "Bias", "OutScale"),
              attrs={"x_num_col_dims": 1, "y_num_col_dims": 1,
                     "max_range": 127.0, "out_dtype": "float32",
-                    "fuse_relu": False, "bias_axis": -1},
+                    "fuse_relu": False, "bias_axis": -1,
+                    "epilogue": ""},
              differentiable=False)
 def mul_int8(ins, attrs):
     """True-int8 mul: int8 x int8 matmul with int32 accumulation.
@@ -467,10 +475,9 @@ def mul_int8(ins, attrs):
     if attrs.get("fuse_relu"):
         y = jax.nn.relu(y)
     if "OutScale" in ins:
-        so = jnp.maximum(
-            ins["OutScale"].reshape(()).astype(jnp.float32), 1e-8)
-        y = jnp.clip(jnp.round(y.astype(jnp.float32) / so * bnd),
-                     -bnd, bnd).astype(jnp.int8)
+        from paddle_tpu.ops.epilogue import quantize_tail
+
+        y = quantize_tail(y, ins["OutScale"], bnd)
     return {"Out": y}
 
 

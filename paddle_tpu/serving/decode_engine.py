@@ -106,6 +106,7 @@ from paddle_tpu.observability import metrics as _obs_metrics
 from paddle_tpu.observability import tracing as _trace
 from paddle_tpu.observability.export import (MetricsHTTPServer,
                                              metrics_port_from_env)
+from paddle_tpu.ops.epilogue import greedy_logits_tail
 from paddle_tpu.ops.paged_kv import OutOfPagesError, PagedKVCache
 from paddle_tpu.serving.admission import (AdmissionController,
                                           DeadlineExpiredError,
@@ -1179,7 +1180,10 @@ class DecodeServer:
             kv_scales=rep.cache.kv_scales() if rep.cache.kv_int8
             else None)
         logits = rep.model.logits(out)
-        next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
+        # the greedy head is the logits-tail `argmax` stage of the
+        # epilogue grammar — one definition for engine, draft and
+        # verify sweeps
+        next_tokens = np.asarray(greedy_logits_tail(logits))
         t_emit = time.monotonic()
         rep.iterations += 1
         still = []
@@ -1290,7 +1294,7 @@ class DecodeServer:
                 impl=cfg.impl, head_pack=cfg.head_pack,
                 kv_scales=dcache.kv_scales() if dcache.kv_int8
                 else None)
-            cur = np.asarray(jnp.argmax(draft.logits(out), axis=-1)) \
+            cur = np.asarray(greedy_logits_tail(draft.logits(out))) \
                 .astype(np.int32)
             proposals[:, j] = cur
         # --- verify phase: ONE batched q-len-(k+1) target sweep over
@@ -1317,7 +1321,7 @@ class DecodeServer:
             kv_scales=rep.cache.kv_scales() if rep.cache.kv_int8
             else None)
         logits = rep.model.logits(jnp.reshape(out, (n_pad * r, h, d)))
-        targets = np.asarray(jnp.argmax(logits, axis=-1)) \
+        targets = np.asarray(greedy_logits_tail(logits)) \
             .reshape(n_pad, r)
         # --- acceptance + cache rewind (still abortable: seq
         # bookkeeping is untouched until the commit loop below)

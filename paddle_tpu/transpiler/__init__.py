@@ -10,6 +10,8 @@ from paddle_tpu.transpiler.conv_bn_train_transpiler import (  # noqa: F401
     FuseConvBnTrainTranspiler, fuse_conv_bn_train)
 from paddle_tpu.transpiler.conv_epilogue_transpiler import (  # noqa: F401
     FuseConvEpilogueTranspiler, fuse_conv_epilogue)
+from paddle_tpu.transpiler.epilogue_transpiler import (  # noqa: F401
+    EpilogueFusionTranspiler, fold_int8_interlayer, fuse_epilogue)
 from paddle_tpu.transpiler.inference_transpiler import (  # noqa: F401
     FuseElewiseAddActTranspiler, FuseFCTranspiler, InferenceTranspiler)
 from paddle_tpu.transpiler.layout_transpiler import (  # noqa: F401

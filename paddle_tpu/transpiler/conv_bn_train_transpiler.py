@@ -1,19 +1,14 @@
 """Fuse conv2d + train-mode batch_norm (+residual add) (+ReLU) IR
 chains onto the ``conv2d_bn_train`` op (ops/pallas_conv.py).
 
-The TRAIN-side sibling of ``fuse_conv_epilogue``: on the inference
-graph the conv-bn fold turns a ResNet block into conv+bias+add+relu
-and the epilogue pass fuses the whole chain, but on the train graph BN
-*batch* statistics sit between the conv and the residual add, so the
-epilogue pass finds nothing to fuse and the step re-reads the full
-conv output twice (once for the moments reduction, once for the
-normalize).  This pass collapses
-
-    conv2d -> batch_norm(train) [-> elementwise_add(skip)] [-> relu]
-
-into one op whose kernel pair (conv with per-channel Σy/Σy² sibling
-outputs + a single fused normalize+residual+ReLU pass, flag
-``conv_bn_stats``) touches the activation exactly once per kernel.
+Since ISSUE 17 this file is a compatibility wrapper: the matching and
+rewrite live in the unified epilogue pass
+(transpiler/epilogue_transpiler.py), run here with anchors restricted
+to ``conv_bn``.  Same guards, same matched chains, same emitted op —
+plus the registered ``epilogue`` stage-list attr the unified pass
+stamps.  The BN's MeanOut/VarianceOut/SavedMean/SavedVariance outputs
+are preserved verbatim on the fused op (running-stat wiring and any
+Saved* consumers keep working).
 
 Run BEFORE nhwc_transpile (the layout transpiler knows how to carry
 conv2d_bn_train to NHWC) and before append_backward/minimize, like
@@ -23,170 +18,20 @@ fuse_conv_epilogue.
 from __future__ import annotations
 
 from paddle_tpu.analysis.passes import checked_pass
-
-from paddle_tpu.core.program import OpDesc
-from paddle_tpu.transpiler.inference_transpiler import (_consumers,
-                                                        _first_consumer)
+from paddle_tpu.transpiler.epilogue_transpiler import \
+    EpilogueFusionTranspiler
 
 
-class FuseConvBnTrainTranspiler:
+class FuseConvBnTrainTranspiler(EpilogueFusionTranspiler):
     """conv2d (+channel bias add) + batch_norm(train) (+residual add)
-    (+relu) -> conv2d_bn_train.
-
-    Guards: groups==1, dilations==1 (the kernel's support envelope);
-    the batch_norm must be in TRAIN mode (is_test=False,
+    (+relu) -> conv2d_bn_train.  See EpilogueFusionTranspiler for the
+    guards; the batch_norm must be in TRAIN mode (is_test=False,
     use_global_stats=False — eval-mode BN normalizes with running
-    stats and belongs to the conv-bn FOLD, not this fusion) and share
-    the conv's layout; every erased intermediate (the conv output and
-    the BN Y) must be sole-consumed and unprotected; the residual
-    add's other operand must be a 4-D var of the BN output's exact
-    shape; only a relu that is the chain TAIL is absorbed.  The BN's
-    MeanOut/VarianceOut/SavedMean/SavedVariance outputs are preserved
-    verbatim on the fused op (running-stat wiring and any Saved*
-    consumers keep working)."""
+    stats and belongs to the conv-bn FOLD, not this fusion)."""
 
     @checked_pass("fuse_conv_bn_train")
     def transpile(self, program, protected=None):
-        self._protected = frozenset(protected or ())
-        block = program.global_block()
-        changed = True
-        n = 0
-        while changed:
-            changed = self._fuse_one(block)
-            n += int(changed)
-        return n
-
-    # ------------------------------------------------------------ internals
-    def _sole_consumer(self, block, name, idx):
-        if _consumers(block, name) != 1 or name in self._protected:
-            return None, None
-        return _first_consumer(block, name, idx)
-
-    def _fuse_one(self, block):
-        for i, op in enumerate(block.ops):
-            if op.type != "conv2d":
-                continue
-            a = op.attrs
-            if a.get("groups", 1) != 1 or \
-                    list(a.get("dilations", [1, 1])) != [1, 1]:
-                continue
-            fmt = a.get("data_format", "NCHW")
-            c_axis = 1 if fmt == "NCHW" else -1
-            out = op.outputs["Output"][0]
-            out_var = block.var(out)
-            if out_var.shape is None or len(out_var.shape) != 4:
-                continue
-            cout = out_var.shape[c_axis]
-
-            consumed = []
-            bias_name = None
-            cur, j = out, i
-
-            nj, nxt = self._sole_consumer(block, cur, j)
-            # optional channel-bias add between conv and BN (rare: BN's
-            # shift subsumes it, but a hand-built graph may carry one)
-            if nxt is not None and nxt.type == "elementwise_add" and \
-                    nxt.inputs["X"][0] == cur:
-                y = nxt.inputs["Y"][0]
-                try:
-                    y_var = block.var(y)
-                except KeyError:
-                    y_var = None
-                ax_ok = nxt.attrs.get("axis", -1) in (
-                    (1,) if fmt == "NCHW" else (-1, 3))
-                if (y_var is not None and y_var.persistable
-                        and y_var.shape is not None
-                        and len(y_var.shape) == 1
-                        and int(y_var.shape[0]) == int(cout) and ax_ok):
-                    bias_name = y
-                    consumed.append(nxt)
-                    cur, j = nxt.outputs["Out"][0], nj
-                    nj, nxt = self._sole_consumer(block, cur, j)
-            # the anchor: a TRAIN-mode batch_norm consuming the conv
-            if nxt is None or nxt.type != "batch_norm" or \
-                    nxt.inputs["X"][0] != cur:
-                continue
-            bn = nxt
-            ba = bn.attrs
-            if ba.get("is_test", False) or \
-                    ba.get("use_global_stats", False):
-                continue            # eval BN: the fold's job, not ours
-            if ba.get("data_layout", "NCHW") != fmt:
-                continue
-            if "BatchMean" in bn.inputs or "BatchVariance" in bn.inputs:
-                continue            # stats already supplied externally
-            scale_v = block.var(bn.inputs["Scale"][0])
-            if scale_v.shape is None or len(scale_v.shape) != 1 or \
-                    int(scale_v.shape[0]) != int(cout):
-                continue
-            bn_y = bn.outputs["Y"][0]
-            bn_y_var = block.var(bn_y)
-            consumed.append(bn)
-            cur, j = bn_y, nj
-            nj, nxt = self._sole_consumer(block, cur, j)
-
-            res_name = None
-            act = ""
-            # optional residual add: the other operand is a 4-D var of
-            # the BN output's exact shape (a true skip connection)
-            if nxt is not None and nxt.type == "elementwise_add":
-                xs, ys = nxt.inputs["X"][0], nxt.inputs["Y"][0]
-                other = ys if xs == cur else xs if ys == cur else None
-                if other is not None:
-                    try:
-                        o_var = block.var(other)
-                    except KeyError:
-                        o_var = None
-                    if (o_var is not None and o_var.shape is not None
-                            and bn_y_var.shape is not None
-                            and tuple(o_var.shape)
-                            == tuple(bn_y_var.shape)):
-                        res_name = other
-                        consumed.append(nxt)
-                        cur, j = nxt.outputs["Out"][0], nj
-                        nj, nxt = self._sole_consumer(block, cur, j)
-            # optional trailing relu — tail position only (a relu whose
-            # output feeds back into the chain interior never matches)
-            if nxt is not None and nxt.type == "relu":
-                act = "relu"
-                consumed.append(nxt)
-                cur = nxt.outputs["Out"][0]
-
-            inputs = {"Input": list(op.inputs["Input"]),
-                      "Filter": list(op.inputs["Filter"]),
-                      "Scale": list(bn.inputs["Scale"]),
-                      "BNBias": list(bn.inputs["Bias"]),
-                      "Mean": list(bn.inputs["Mean"]),
-                      "Variance": list(bn.inputs["Variance"])}
-            if bias_name is not None:
-                inputs["Bias"] = [bias_name]
-            if res_name is not None:
-                inputs["Residual"] = [res_name]
-            outputs = {"Output": [cur],
-                       "MeanOut": list(bn.outputs["MeanOut"]),
-                       "VarianceOut": list(bn.outputs["VarianceOut"]),
-                       "SavedMean": list(bn.outputs["SavedMean"]),
-                       "SavedVariance":
-                           list(bn.outputs["SavedVariance"])}
-            fused = OpDesc(
-                "conv2d_bn_train", inputs, outputs,
-                {"strides": list(a.get("strides", [1, 1])),
-                 "paddings": list(a.get("paddings", [0, 0])),
-                 "act": act, "groups": 1,
-                 "epsilon": ba.get("epsilon", 1e-5),
-                 "momentum": ba.get("momentum", 0.9),
-                 "data_format": fmt},
-                op.op_role)
-            # replace the chain TAIL (the residual operand may be
-            # produced between the conv and the tail, e.g. the shortcut
-            # branch); every erased intermediate is sole-consumed
-            # inside the chain, so sinking the conv is order-safe
-            block.ops[block.ops.index(consumed[-1])] = fused
-            block.ops.remove(op)
-            for c in consumed[:-1]:
-                block.ops.remove(c)
-            return True
-        return False
+        return self._run(program, protected, ("conv_bn",))
 
 
 def fuse_conv_bn_train(program, protected=None):

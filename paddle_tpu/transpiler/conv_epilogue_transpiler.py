@@ -1,18 +1,11 @@
 """Fuse conv2d + bias-add + residual-add + ReLU IR chains onto the
 ``conv2d_epilogue`` op (ops/pallas_conv.py).
 
-The IR-level companion of the Pallas fused conv-epilogue kernel: the
-rewrites the reference does in C++ analysis passes (conv_bn_fuse,
-conv_elementwise_add_act_fuse_pass.cc) exist here as Python
-transpilers, and this one targets the rn50 hot path the round-5
-roofline named — residual-add/ReLU glue around convolutions that XLA
-will not fuse into its conv custom-calls.  After the conv-bn fold
-(InferenceTranspiler) an inference ResNet block is exactly
-
-    conv2d -> elementwise_add(bias) -> elementwise_add(skip) -> relu
-
-which this pass collapses into one op; the Pallas kernel then runs the
-whole chain in a single VMEM-resident pass (flag ``conv_epilogue``).
+Since ISSUE 17 this file is a compatibility wrapper: the matching and
+rewrite live in the unified epilogue pass
+(transpiler/epilogue_transpiler.py), run here with anchors restricted
+to ``conv``.  Same guards, same matched chains, same emitted op — plus
+the registered ``epilogue`` stage-list attr the unified pass stamps.
 
 Run BEFORE nhwc_transpile (the pass matches on the NCHW-built program;
 the layout transpiler knows how to carry conv2d_epilogue to NHWC) and
@@ -22,133 +15,17 @@ before append_backward/minimize, like the other forward rewrites.
 from __future__ import annotations
 
 from paddle_tpu.analysis.passes import checked_pass
-from paddle_tpu.core.program import OpDesc
-from paddle_tpu.transpiler.inference_transpiler import (_consumers,
-                                                        _first_consumer)
+from paddle_tpu.transpiler.epilogue_transpiler import \
+    EpilogueFusionTranspiler
 
 
-class FuseConvEpilogueTranspiler:
+class FuseConvEpilogueTranspiler(EpilogueFusionTranspiler):
     """conv2d (+channel bias add) (+residual add) (+relu) ->
-    conv2d_epilogue.
-
-    Guards: groups==1, dilations==1 (the kernel's support envelope);
-    every fused intermediate must have exactly one consumer and must
-    not be protected (a fetch target the fold would erase); the bias
-    add must be a 1-D persistable channel bias on the channel axis;
-    the residual add's other operand must be a 4-D var of the conv
-    output's shape (a true skip connection, not a broadcast)."""
+    conv2d_epilogue.  See EpilogueFusionTranspiler for the guards."""
 
     @checked_pass("fuse_conv_epilogue")
     def transpile(self, program, protected=None):
-        self._protected = frozenset(protected or ())
-        block = program.global_block()
-        changed = True
-        n = 0
-        while changed:
-            changed = self._fuse_one(block)
-            n += int(changed)
-        return n
-
-    # ------------------------------------------------------------ internals
-    def _sole_consumer(self, block, name, idx):
-        """The single consumer op of `name` after idx, or (None, None)
-        when `name` has other consumers or is protected."""
-        if _consumers(block, name) != 1 or name in self._protected:
-            return None, None
-        return _first_consumer(block, name, idx)
-
-    def _channel_axis(self, op):
-        return 1 if op.attrs.get("data_format", "NCHW") == "NCHW" else -1
-
-    def _fuse_one(self, block):
-        for i, op in enumerate(block.ops):
-            if op.type != "conv2d":
-                continue
-            a = op.attrs
-            if a.get("groups", 1) != 1 or \
-                    list(a.get("dilations", [1, 1])) != [1, 1]:
-                continue
-            fmt = a.get("data_format", "NCHW")
-            c_axis = 1 if fmt == "NCHW" else -1
-            out = op.outputs["Output"][0]
-            out_var = block.var(out)
-            if out_var.shape is None or len(out_var.shape) != 4:
-                continue
-            cout = out_var.shape[c_axis]
-
-            consumed = []        # ops the fusion erases
-            bias_name = None
-            res_name = None
-            act = ""
-            cur, j = out, i
-
-            nj, nxt = self._sole_consumer(block, cur, j)
-            # optional channel-bias add (the conv2d layer's bias op)
-            if nxt is not None and nxt.type == "elementwise_add" and \
-                    nxt.inputs["X"][0] == cur:
-                y = nxt.inputs["Y"][0]
-                try:
-                    y_var = block.var(y)
-                except KeyError:
-                    y_var = None
-                ax_ok = nxt.attrs.get("axis", -1) in (
-                    (1,) if fmt == "NCHW" else (-1, 3))
-                if (y_var is not None and y_var.persistable
-                        and y_var.shape is not None
-                        and len(y_var.shape) == 1
-                        and int(y_var.shape[0]) == int(cout) and ax_ok):
-                    bias_name = y
-                    consumed.append(nxt)
-                    cur, j = nxt.outputs["Out"][0], nj
-                    nj, nxt = self._sole_consumer(block, cur, j)
-            # optional residual add: the other operand is a 4-D var of
-            # the conv output's shape
-            if nxt is not None and nxt.type == "elementwise_add":
-                xs, ys = nxt.inputs["X"][0], nxt.inputs["Y"][0]
-                other = ys if xs == cur else xs if ys == cur else None
-                if other is not None:
-                    try:
-                        o_var = block.var(other)
-                    except KeyError:
-                        o_var = None
-                    if (o_var is not None and o_var.shape is not None
-                            and tuple(o_var.shape)
-                            == tuple(out_var.shape)):
-                        res_name = other
-                        consumed.append(nxt)
-                        cur, j = nxt.outputs["Out"][0], nj
-                        nj, nxt = self._sole_consumer(block, cur, j)
-            # optional trailing relu
-            if nxt is not None and nxt.type == "relu":
-                act = "relu"
-                consumed.append(nxt)
-                cur = nxt.outputs["Out"][0]
-            if not consumed:
-                continue            # nothing to fuse onto this conv
-
-            inputs = {"Input": list(op.inputs["Input"]),
-                      "Filter": list(op.inputs["Filter"])}
-            if bias_name is not None:
-                inputs["Bias"] = [bias_name]
-            if res_name is not None:
-                inputs["Residual"] = [res_name]
-            fused = OpDesc(
-                "conv2d_epilogue", inputs, {"Output": [cur]},
-                {"strides": list(a.get("strides", [1, 1])),
-                 "paddings": list(a.get("paddings", [0, 0])),
-                 "act": act, "groups": 1, "data_format": fmt},
-                op.op_role)
-            # the fused op replaces the chain TAIL, not the conv: the
-            # residual operand may be produced between the conv and
-            # the tail (e.g. the shortcut conv), and every erased
-            # intermediate is sole-consumed inside the chain, so
-            # sinking the conv to the tail position is order-safe
-            block.ops[block.ops.index(consumed[-1])] = fused
-            block.ops.remove(op)
-            for c in consumed[:-1]:
-                block.ops.remove(c)
-            return True
-        return False
+        return self._run(program, protected, ("conv",))
 
 
 def fuse_conv_epilogue(program, protected=None):

@@ -1,0 +1,607 @@
+"""ONE epilogue-fusion pass for every producer op (ISSUE 17).
+
+The repo's three bespoke fusion transpilers — conv-epilogue (PR 1),
+conv+BN-train (PR 4), and the int8 interlayer fold walk (PR 5) — all
+implement the same shape: anchor on a producing op, walk its
+sole-consumed tail chain against a fixed stage vocabulary
+(bias / residual / act / requantize), and collapse the chain into the
+producer carrying the stages as op attrs.  This module is that walk
+written ONCE, parameterized by the stage grammar in
+``paddle_tpu/ops/epilogue.py``:
+
+* anchor ``conv``     — conv2d (+bias)(+residual)(+relu)
+                        -> ``conv2d_epilogue``
+* anchor ``conv_bn``  — conv2d (+bias) + batch_norm(train)
+                        (+residual)(+relu) -> ``conv2d_bn_train``
+* anchor ``fc``       — mul (+bias)(+residual)(+relu/gelu)
+                        -> ``fc_epilogue``  (NEW: the transformer train
+                        graph's fc+bias+act tails)
+* ``fold_int8_interlayer`` — the conv2d_int8 producer walk
+                        (+bias)(+residual)(+relu)(+requantize), now
+                        including residual edges (NEW: the
+                        residual-edge int8 fold, a pure stage insertion
+                        on the existing kernel)
+
+Every emitted op carries the matched stage list in its registered
+``epilogue`` attr (``spec_attr`` builds it, so it is valid by
+construction; the IR verifier's ``epilogue-spec`` rule re-checks it on
+every pass boundary).  The legacy entry points
+(``fuse_conv_epilogue`` / ``fuse_conv_bn_train`` /
+``_fold_int8_interlayer``) remain as thin wrappers over this pass —
+same names, same signatures, same matched chains, byte-identical
+flag-off graphs.
+
+Run BEFORE nhwc_transpile and before append_backward/minimize, like
+the passes it replaces.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.analysis.passes import checked_pass
+from paddle_tpu.core.program import OpDesc
+from paddle_tpu.ops.epilogue import spec_attr
+from paddle_tpu.transpiler.inference_transpiler import (_consumers,
+                                                        _first_consumer)
+
+# anchor name -> the activation stages its kernel implements
+_ANCHOR_ACTS = {"conv": ("relu",), "conv_bn": ("relu",),
+                "fc": ("relu", "gelu")}
+
+
+class EpilogueFusionTranspiler:
+    """Pattern-match producer+tail chains against the epilogue stage
+    grammar and fuse them onto the ``*_epilogue`` ops.
+
+    Guards (generalized from the passes this replaces): every fused
+    intermediate is sole-consumed and unprotected; a bias add is a 1-D
+    persistable channel bias on the producer's channel axis; a residual
+    add's other operand is a var of the producer output's exact shape
+    (a true skip connection, not a broadcast); only a tail-position
+    activation is absorbed; conv anchors additionally require
+    groups==1 and dilations==1 (the kernel envelope)."""
+
+    ANCHORS = ("conv", "conv_bn", "fc")
+
+    @checked_pass("fuse_epilogue")
+    def transpile(self, program, protected=None, anchors=ANCHORS):
+        return self._run(program, protected, anchors)
+
+    # ------------------------------------------------------------ driver
+    def _run(self, program, protected, anchors):
+        """Undecorated body — the legacy wrappers enter here so their
+        own ``checked_pass`` names keep bracketing the rewrite."""
+        self._protected = frozenset(protected or ())
+        block = program.global_block()
+        n = 0
+        changed = True
+        while changed:
+            changed = False
+            for anchor in anchors:
+                if self._fuse_one(block, anchor):
+                    changed = True
+                    n += 1
+                    break
+        return n
+
+    def _fuse_one(self, block, anchor):
+        if anchor == "conv":
+            return self._fuse_one_conv(block)
+        if anchor == "conv_bn":
+            return self._fuse_one_conv_bn(block)
+        if anchor == "fc":
+            return self._fuse_one_fc(block)
+        raise ValueError(f"unknown epilogue anchor {anchor!r}")
+
+    # ------------------------------------------------------------ helpers
+    def _sole_consumer(self, block, name, idx):
+        """The single consumer op of `name` after idx, or (None, None)
+        when `name` has other consumers or is protected."""
+        if _consumers(block, name) != 1 or name in self._protected:
+            return None, None
+        return _first_consumer(block, name, idx)
+
+    def _match_bias(self, block, nxt, cur, cout, axes_ok):
+        """``nxt`` is a channel-bias elementwise_add on ``cur``: X is
+        the chain, Y a 1-D persistable [cout] var, axis on the channel
+        axis.  Returns the bias var name or None."""
+        if nxt is None or nxt.type != "elementwise_add" or \
+                nxt.inputs["X"][0] != cur:
+            return None
+        y = nxt.inputs["Y"][0]
+        try:
+            y_var = block.var(y)
+        except KeyError:
+            return None
+        if nxt.attrs.get("axis", -1) not in axes_ok:
+            return None
+        if (y_var.persistable and y_var.shape is not None
+                and len(y_var.shape) == 1
+                and int(y_var.shape[0]) == int(cout)):
+            return y
+        return None
+
+    def _match_residual(self, block, nxt, cur, out_shape):
+        """``nxt`` is a same-shape skip add on ``cur`` (either slot).
+        Returns the residual var name or None."""
+        if nxt is None or nxt.type != "elementwise_add" or \
+                out_shape is None:
+            return None
+        xs, ys = nxt.inputs["X"][0], nxt.inputs["Y"][0]
+        other = ys if xs == cur else xs if ys == cur else None
+        if other is None:
+            return None
+        try:
+            o_var = block.var(other)
+        except KeyError:
+            return None
+        if o_var.shape is not None and \
+                tuple(o_var.shape) == tuple(out_shape):
+            return other
+        return None
+
+    # ------------------------------------------------------------ conv
+    def _fuse_one_conv(self, block):
+        for i, op in enumerate(block.ops):
+            if op.type != "conv2d":
+                continue
+            a = op.attrs
+            if a.get("groups", 1) != 1 or \
+                    list(a.get("dilations", [1, 1])) != [1, 1]:
+                continue
+            fmt = a.get("data_format", "NCHW")
+            c_axis = 1 if fmt == "NCHW" else -1
+            out = op.outputs["Output"][0]
+            out_var = block.var(out)
+            if out_var.shape is None or len(out_var.shape) != 4:
+                continue
+            cout = out_var.shape[c_axis]
+            bias_axes = (1,) if fmt == "NCHW" else (-1, 3)
+
+            consumed = []
+            bias_name = None
+            res_name = None
+            act = ""
+            cur, j = out, i
+
+            nj, nxt = self._sole_consumer(block, cur, j)
+            bias_name = self._match_bias(block, nxt, cur, cout,
+                                         bias_axes)
+            if bias_name is not None:
+                consumed.append(nxt)
+                cur, j = nxt.outputs["Out"][0], nj
+                nj, nxt = self._sole_consumer(block, cur, j)
+            res_name = self._match_residual(block, nxt, cur,
+                                            out_var.shape)
+            if res_name is not None:
+                consumed.append(nxt)
+                cur, j = nxt.outputs["Out"][0], nj
+                nj, nxt = self._sole_consumer(block, cur, j)
+            if nxt is not None and nxt.type in _ANCHOR_ACTS["conv"]:
+                act = nxt.type
+                consumed.append(nxt)
+                cur = nxt.outputs["Out"][0]
+            if not consumed:
+                continue            # nothing to fuse onto this conv
+
+            inputs = {"Input": list(op.inputs["Input"]),
+                      "Filter": list(op.inputs["Filter"])}
+            if bias_name is not None:
+                inputs["Bias"] = [bias_name]
+            if res_name is not None:
+                inputs["Residual"] = [res_name]
+            fused = OpDesc(
+                "conv2d_epilogue", inputs, {"Output": [cur]},
+                {"strides": list(a.get("strides", [1, 1])),
+                 "paddings": list(a.get("paddings", [0, 0])),
+                 "act": act, "groups": 1, "data_format": fmt,
+                 "epilogue": spec_attr(bias=bias_name is not None,
+                                       residual=res_name is not None,
+                                       act=act)},
+                op.op_role)
+            # the fused op replaces the chain TAIL, not the conv: the
+            # residual operand may be produced between the conv and
+            # the tail (e.g. the shortcut conv), and every erased
+            # intermediate is sole-consumed inside the chain, so
+            # sinking the conv to the tail position is order-safe
+            self._splice(block, op, consumed, fused)
+            return True
+        return False
+
+    # ------------------------------------------------------------ conv+BN
+    def _fuse_one_conv_bn(self, block):
+        for i, op in enumerate(block.ops):
+            if op.type != "conv2d":
+                continue
+            a = op.attrs
+            if a.get("groups", 1) != 1 or \
+                    list(a.get("dilations", [1, 1])) != [1, 1]:
+                continue
+            fmt = a.get("data_format", "NCHW")
+            c_axis = 1 if fmt == "NCHW" else -1
+            out = op.outputs["Output"][0]
+            out_var = block.var(out)
+            if out_var.shape is None or len(out_var.shape) != 4:
+                continue
+            cout = out_var.shape[c_axis]
+            bias_axes = (1,) if fmt == "NCHW" else (-1, 3)
+
+            consumed = []
+            bias_name = None
+            cur, j = out, i
+
+            nj, nxt = self._sole_consumer(block, cur, j)
+            # optional channel-bias add between conv and BN (rare: BN's
+            # shift subsumes it, but a hand-built graph may carry one)
+            bias_name = self._match_bias(block, nxt, cur, cout,
+                                         bias_axes)
+            if bias_name is not None:
+                consumed.append(nxt)
+                cur, j = nxt.outputs["Out"][0], nj
+                nj, nxt = self._sole_consumer(block, cur, j)
+            # the anchor: a TRAIN-mode batch_norm consuming the conv
+            if nxt is None or nxt.type != "batch_norm" or \
+                    nxt.inputs["X"][0] != cur:
+                continue
+            bn = nxt
+            ba = bn.attrs
+            if ba.get("is_test", False) or \
+                    ba.get("use_global_stats", False):
+                continue            # eval BN: the fold's job, not ours
+            if ba.get("data_layout", "NCHW") != fmt:
+                continue
+            if "BatchMean" in bn.inputs or "BatchVariance" in bn.inputs:
+                continue            # stats already supplied externally
+            scale_v = block.var(bn.inputs["Scale"][0])
+            if scale_v.shape is None or len(scale_v.shape) != 1 or \
+                    int(scale_v.shape[0]) != int(cout):
+                continue
+            bn_y = bn.outputs["Y"][0]
+            bn_y_var = block.var(bn_y)
+            consumed.append(bn)
+            cur, j = bn_y, nj
+            nj, nxt = self._sole_consumer(block, cur, j)
+
+            res_name = self._match_residual(block, nxt, cur,
+                                            bn_y_var.shape)
+            act = ""
+            if res_name is not None:
+                consumed.append(nxt)
+                cur, j = nxt.outputs["Out"][0], nj
+                nj, nxt = self._sole_consumer(block, cur, j)
+            # optional trailing relu — tail position only (a relu whose
+            # output feeds back into the chain interior never matches)
+            if nxt is not None and nxt.type in _ANCHOR_ACTS["conv_bn"]:
+                act = nxt.type
+                consumed.append(nxt)
+                cur = nxt.outputs["Out"][0]
+
+            inputs = {"Input": list(op.inputs["Input"]),
+                      "Filter": list(op.inputs["Filter"]),
+                      "Scale": list(bn.inputs["Scale"]),
+                      "BNBias": list(bn.inputs["Bias"]),
+                      "Mean": list(bn.inputs["Mean"]),
+                      "Variance": list(bn.inputs["Variance"])}
+            if bias_name is not None:
+                inputs["Bias"] = [bias_name]
+            if res_name is not None:
+                inputs["Residual"] = [res_name]
+            outputs = {"Output": [cur],
+                       "MeanOut": list(bn.outputs["MeanOut"]),
+                       "VarianceOut": list(bn.outputs["VarianceOut"]),
+                       "SavedMean": list(bn.outputs["SavedMean"]),
+                       "SavedVariance":
+                           list(bn.outputs["SavedVariance"])}
+            fused = OpDesc(
+                "conv2d_bn_train", inputs, outputs,
+                {"strides": list(a.get("strides", [1, 1])),
+                 "paddings": list(a.get("paddings", [0, 0])),
+                 "act": act, "groups": 1,
+                 "epsilon": ba.get("epsilon", 1e-5),
+                 "momentum": ba.get("momentum", 0.9),
+                 "data_format": fmt,
+                 "epilogue": spec_attr(bias=bias_name is not None,
+                                       stats_tap=True, bn_apply=True,
+                                       residual=res_name is not None,
+                                       act=act)},
+                op.op_role)
+            self._splice(block, op, consumed, fused)
+            return True
+        return False
+
+    # ------------------------------------------------------------ fc
+    def _fuse_one_fc(self, block):
+        for i, op in enumerate(block.ops):
+            if op.type != "mul":
+                continue
+            a = op.attrs
+            xnc = a.get("x_num_col_dims", 1)
+            if a.get("y_num_col_dims", 1) != 1:
+                continue
+            out = op.outputs["Out"][0]
+            try:
+                out_var = block.var(out)
+                w_var = block.var(op.inputs["Y"][0])
+            except KeyError:
+                continue
+            if out_var.shape is None or w_var.shape is None or \
+                    len(w_var.shape) != 2:
+                continue
+            n_out = int(w_var.shape[1])
+            # the fc layer's bias rides on axis=num_flatten_dims (the
+            # output's trailing axis — y_num_col_dims==1 means rank is
+            # xnc+1), so -1 is the same broadcast
+            bias_axes = (xnc, -1)
+
+            consumed = []
+            bias_name = None
+            res_name = None
+            act = ""
+            approx = False
+            cur, j = out, i
+
+            nj, nxt = self._sole_consumer(block, cur, j)
+            bias_name = self._match_bias(block, nxt, cur, n_out,
+                                         bias_axes)
+            if bias_name is not None:
+                consumed.append(nxt)
+                cur, j = nxt.outputs["Out"][0], nj
+                nj, nxt = self._sole_consumer(block, cur, j)
+            res_name = self._match_residual(block, nxt, cur,
+                                            out_var.shape)
+            if res_name is not None:
+                consumed.append(nxt)
+                cur, j = nxt.outputs["Out"][0], nj
+                nj, nxt = self._sole_consumer(block, cur, j)
+            if nxt is not None and nxt.type in _ANCHOR_ACTS["fc"]:
+                act = nxt.type
+                approx = bool(nxt.attrs.get("approximate", False))
+                consumed.append(nxt)
+                cur = nxt.outputs["Out"][0]
+            if not consumed:
+                continue
+
+            inputs = {"X": list(op.inputs["X"]),
+                      "Y": list(op.inputs["Y"])}
+            if bias_name is not None:
+                inputs["Bias"] = [bias_name]
+            if res_name is not None:
+                inputs["Residual"] = [res_name]
+            fused = OpDesc(
+                "fc_epilogue", inputs, {"Out": [cur]},
+                {"x_num_col_dims": xnc, "y_num_col_dims": 1,
+                 "act": act, "approximate": approx,
+                 "epilogue": spec_attr(bias=bias_name is not None,
+                                       residual=res_name is not None,
+                                       act=act)},
+                op.op_role)
+            self._splice(block, op, consumed, fused)
+            return True
+        return False
+
+    @staticmethod
+    def _splice(block, anchor_op, consumed, fused):
+        """Replace the chain TAIL with the fused op and erase the
+        anchor + interior ops (sinking the anchor to the tail position
+        is order-safe: every erased intermediate is sole-consumed
+        inside the chain)."""
+        block.ops[block.ops.index(consumed[-1])] = fused
+        block.ops.remove(anchor_op)
+        for c in consumed[:-1]:
+            block.ops.remove(c)
+
+
+def fuse_epilogue(program, protected=None,
+                  anchors=EpilogueFusionTranspiler.ANCHORS):
+    """Functional wrapper (the nhwc_transpile idiom): fuse every
+    epilogue chain in `program` in place, over the given anchors.
+    Returns the number of chains fused."""
+    return EpilogueFusionTranspiler().transpile(program,
+                                                protected=protected,
+                                                anchors=anchors)
+
+
+# ---------------------------------------------------------------------------
+# int8 interlayer fold — the requantize-stage arm of the grammar
+# ---------------------------------------------------------------------------
+
+def fold_int8_interlayer(program, block, out_dtype, weight_bits,
+                         protected):
+    """Fold quantized-op -> quantized-op edges so the inter-layer
+    tensor is int8 (ISSUE 5, rehosted on the stage grammar by ISSUE
+    17 — contrib/slim/quantization.py delegates here).
+
+    For each ``conv2d_int8`` producer with a calibrated InScale, walk
+    its epilogue chain: optional per-channel bias ``elementwise_add``
+    (Y 1-D persistable), optional same-shape residual add (NEW: the
+    residual-edge fold — previously any skip add stopped the walk and
+    the edge stayed float), then optional ``relu`` — each link
+    sole-consumed and unprotected.  If EVERY consumer of the chain
+    tail is a converted int8 op reading it as its activation with a
+    calibrated InScale, the FULL fold applies: the requantize epilogue
+    rides inside the producer op (Bias + Residual + fuse_relu +
+    OutScale), the chain ops are deleted, and the tail var crosses the
+    boundary as int8.  Otherwise the PARTIAL fold keeps the float
+    output but still absorbs the chain.  The matched stage list is
+    stamped on the producer's ``epilogue`` attr.
+
+    The in-op epilogue mirrors the unfused chain's op order, dtypes
+    and rounding points exactly (ops/epilogue.py's ordering contract),
+    so fused and unfused graphs produce bit-identical logits.  Returns
+    fold statistics (the PR-5 keys plus ``n_residual_folds``)."""
+    import numpy as np
+
+    del weight_bits  # the epilogue reuses the producer's max_range
+
+    sub_read = set()
+    for blk in program.blocks:
+        if blk is block:
+            continue
+        for op in blk.ops:
+            for names in op.inputs.values():
+                sub_read.update(names)
+
+    def _build_consumers():
+        consumers = {}
+        for op in block.ops:
+            for slot, names in op.inputs.items():
+                for n in names:
+                    consumers.setdefault(n, []).append((op, slot))
+        return consumers
+
+    def _is_bias_add(op):
+        if op.type != "elementwise_add":
+            return False
+        y = op.inputs.get("Y", [None])[0]
+        v = block.vars.get(y)
+        return (v is not None and v.persistable and v.shape is not None
+                and len(v.shape) == 1)
+
+    def _residual_operand(op, cur):
+        """The same-shape float skip operand of elementwise_add `op`
+        (either slot), or None.  int8 operands are rejected: a
+        previously folded edge's tensor lives on the int8 lattice and
+        cannot join a float add."""
+        if op.type != "elementwise_add" or _is_bias_add(op):
+            return None
+        xs, ys = op.inputs["X"][0], op.inputs["Y"][0]
+        other = ys if xs == cur else xs if ys == cur else None
+        if other is None:
+            return None
+        ov, tv = block.vars.get(other), block.vars.get(cur)
+        if (ov is None or tv is None or ov.shape is None
+                or tv.shape is None
+                or tuple(ov.shape) != tuple(tv.shape)
+                or str(ov.dtype) == "int8"):
+            return None
+        return other
+
+    def _quantized_consumer(op, slot, tail, consumers):
+        """True when (op, slot) is an int8 op consuming `tail` as its
+        activation with a calibrated InScale on that exact tensor."""
+        del consumers
+        scale_name = tail + "@ACT_SCALE"
+        if op.inputs.get("InScale", [None])[0] != scale_name:
+            return False
+        if op.type == "conv2d_int8":
+            return slot == "Input"
+        if op.type == "mul_int8":
+            if slot != "X":
+                return False
+            sv = block.vars.get(op.inputs["Scale"][0])
+            if sv is None or sv.shape is None:
+                return False
+            shp = tuple(sv.shape)
+            # per-input-row scales ((K,1...) or 1-D of length K) fold
+            # into the activation pre-quantization: reject (mirrors
+            # mul_int8's runtime guard)
+            if len(shp) >= 2 and int(np.prod(shp[1:])) == 1 and \
+                    shp[0] != 1:
+                return False
+            yv = block.vars.get(op.inputs["Y"][0])
+            k = yv.shape[0] if yv is not None and yv.shape else None
+            if len(shp) == 1 and shp[0] == k and shp[0] != 1:
+                return False
+            return True
+        return False
+
+    stats = {"n_producers": 0, "n_edges_folded": 0,
+             "n_partial_folds": 0, "n_rejected": 0,
+             "n_residual_folds": 0}
+    n_int8_in = 0
+    done = set()
+    while True:
+        # rebuild the consumer map each round: a residual fold rewires
+        # a SECOND producer's tail (the skip operand moves from the
+        # erased add onto the fused op's Residual slot), so a map built
+        # once would hand later producers erased ops to match against
+        consumers = _build_consumers()
+        P = next((op for op in block.ops
+                  if op.type == "conv2d_int8" and id(op) not in done
+                  and op.inputs.get("InScale")), None)
+        if P is None:
+            break
+        done.add(id(P))
+        if P.attrs.get("out_dtype") == "int32" or \
+                P.inputs.get("OutScale"):
+            continue
+        stats["n_producers"] += 1
+        t0 = P.outputs["Output"][0]
+        chain = []          # epilogue ops to delete, in order
+        bias_op = res_op = relu_op = None
+        res_name = None
+        cur = t0
+        cons = consumers.get(cur, [])
+        if len(cons) == 1 and _is_bias_add(cons[0][0]) and \
+                cons[0][1] == "X" and cur not in sub_read and \
+                cur not in protected:
+            bias_op = cons[0][0]
+            chain.append(bias_op)
+            cur = bias_op.outputs["Out"][0]
+            cons = consumers.get(cur, [])
+        if len(cons) == 1 and cur not in sub_read and \
+                cur not in protected:
+            rn = _residual_operand(cons[0][0], cur)
+            if rn is not None:
+                res_op, res_name = cons[0][0], rn
+                chain.append(res_op)
+                cur = res_op.outputs["Out"][0]
+                cons = consumers.get(cur, [])
+        if len(cons) == 1 and cons[0][0].type == "relu" and \
+                cur not in sub_read and cur not in protected:
+            relu_op = cons[0][0]
+            chain.append(relu_op)
+            cur = relu_op.outputs["Out"][0]
+            cons = consumers.get(cur, [])
+        tail = cur
+        if not chain and not cons:
+            continue        # nothing to fold, nowhere to quantize into
+        full = (bool(cons)
+                and all(_quantized_consumer(op, slot, tail, consumers)
+                        for op, slot in cons)
+                and tail not in protected and tail not in sub_read
+                and (tail + "@ACT_SCALE") in block.vars)
+        if not full and not chain:
+            stats["n_rejected"] += 1
+            continue
+        # both fold flavors attach the chain to the producer op:
+        # Bias/Residual/fuse_relu (and OutScale for the full fold)
+        # become the conv's in-op epilogue; chain ops leave the graph
+        if bias_op is not None:
+            P.inputs["Bias"] = list(bias_op.inputs["Y"])
+            P.set_attr("bias_axis", bias_op.attrs.get("axis", -1))
+        if res_op is not None:
+            P.inputs["Residual"] = [res_name]
+            stats["n_residual_folds"] += 1
+        # set_attr (not a raw attrs write) on every fold so the
+        # compiled-program fingerprint always sees the rewrite — the
+        # no-chain full fold otherwise only touches op.inputs
+        P.set_attr("fuse_relu", relu_op is not None)
+        P.set_attr("epilogue", spec_attr(
+            bias=bias_op is not None, residual=res_op is not None,
+            act="relu" if relu_op is not None else "",
+            requantize=full))
+        if chain:
+            P.outputs["Output"] = [tail]
+            if res_op is not None:
+                # the skip operand may be produced between P and the
+                # residual add (the shortcut branch): sink P to the
+                # chain-tail position, exactly like the conv fusions —
+                # every erased link is sole-consumed, so it is
+                # order-safe
+                i_p = block.ops.index(P)
+                block.ops[block.ops.index(chain[-1])] = P
+                del block.ops[i_p]
+                block.ops = [o for o in block.ops if o not in chain]
+            else:
+                block.ops = [o for o in block.ops if o not in chain]
+        if full:
+            P.inputs["OutScale"] = [tail + "@ACT_SCALE"]
+            tv = block.vars.get(tail)
+            if tv is not None:
+                tv.dtype = "int8"
+            n_int8_in += len(cons)
+            stats["n_edges_folded"] += 1
+        else:
+            stats["n_partial_folds"] += 1
+    stats["n_int8_inputs"] = n_int8_in
+    return stats

@@ -1,0 +1,433 @@
+"""Unified epilogue-fusion framework tests (ISSUE 17).
+
+The parity tests are derived FROM the stage grammar: every legal stage
+subset of an anchor (ops/epilogue.py enumerate_specs) gets a
+fused-vs-unfused check, so adding a stage to the grammar automatically
+widens the matrix — parity by construction, not by hand-picked cases.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, layers
+from paddle_tpu.core.program import OpDesc, Program
+from paddle_tpu.flags import get_flag, set_flags
+from paddle_tpu.ops import epilogue as ep
+
+
+def _fresh():
+    from paddle_tpu import unique_name
+
+    framework.switch_main_program(Program())
+    framework.switch_startup_program(Program())
+    unique_name.switch({})
+
+
+# ---------------------------------------------------------------------------
+# the grammar itself
+# ---------------------------------------------------------------------------
+
+def test_spec_grammar_accepts_legal_and_rejects_illegal():
+    s = ep.EpilogueSpec.from_attr("bias+residual+relu")
+    s.validate()
+    assert "bias" in s and "relu" in s and s.act == "relu"
+    assert s.to_attr() == "bias+residual+relu"
+    # empty spec is legal (an all-default fused chain)
+    ep.EpilogueSpec.from_attr("").validate()
+    with pytest.raises(ValueError):           # unknown stage
+        ep.EpilogueSpec.from_attr("bias+banana").validate()
+    with pytest.raises(ValueError):           # duplicate stage
+        ep.EpilogueSpec.from_attr("bias+bias").validate()
+    with pytest.raises(ValueError):           # out of canonical order
+        ep.EpilogueSpec.from_attr("relu+bias").validate()
+    with pytest.raises(ValueError):           # two activations
+        ep.EpilogueSpec.from_attr("relu+gelu").validate()
+    with pytest.raises(ValueError):           # terminal not last
+        ep.EpilogueSpec.from_attr("argmax+requantize").validate()
+
+
+def test_spec_attr_builder_matches_grammar():
+    assert ep.spec_attr(bias=True, act="relu") == "bias+relu"
+    assert ep.spec_attr() == ""
+    assert ep.spec_attr(bias=True, stats_tap=True, bn_apply=True,
+                        residual=True, act="relu") == \
+        "bias+stats_tap+bn_apply+residual+relu"
+    with pytest.raises(ValueError):
+        ep.spec_attr(act="banana")
+
+
+def test_enumerate_specs_every_subset_validates():
+    sizes = {"conv": 8, "conv_bn": 8, "fc": 12, "int8": 16}
+    for anchor, n in sizes.items():
+        specs = list(ep.enumerate_specs(anchor))
+        assert len(specs) == n, anchor
+        assert len({s.to_attr() for s in specs}) == n  # all distinct
+        for s in specs:
+            s.validate()
+
+
+# ---------------------------------------------------------------------------
+# fc kernel: stage-matrix parity derived from the grammar
+# ---------------------------------------------------------------------------
+
+def _fc_operands(spec, dtype):
+    rng = np.random.RandomState(7)
+    x = rng.randn(12, 24).astype(np.float32)
+    w = rng.randn(24, 16).astype(np.float32)
+    b = rng.randn(16).astype(np.float32) if "bias" in spec else None
+    r = rng.randn(12, 16).astype(np.float32) \
+        if "residual" in spec else None
+    import jax.numpy as jnp
+
+    cast = lambda a: None if a is None else jnp.asarray(a).astype(dtype)
+    return cast(x), cast(w), cast(b), cast(r)
+
+
+def _fc_unfused(x, w, b, r, act):
+    """The exact op chain the transpiler consumes: mul -> add -> add
+    -> act, each in the running dtype (ops/epilogue.py CHAIN order)."""
+    return ep.apply_chain_stages(x @ w, bias=b, residual=r, act=act)
+
+
+@pytest.mark.parametrize(
+    "attr", [s.to_attr() for s in ep.enumerate_specs("fc")])
+def test_fc_kernel_stage_matrix_f32_bitwise(attr):
+    """Every legal fc stage subset: the Pallas kernel (interpret) and
+    the XLA fallback are both bit-identical to the unfused chain in
+    f32 — the repo's fused-kernel parity convention."""
+    spec = ep.EpilogueSpec.from_attr(attr)
+    x, w, b, r = _fc_operands(spec, "float32")
+    act = spec.act or ""
+    ref = np.asarray(_fc_unfused(x, w, b, r, act))
+    for impl in ("interpret", "xla"):
+        got = np.asarray(ep.fc_epilogue(x, w, b, r, act=act or None,
+                                        impl=impl))
+        np.testing.assert_array_equal(ref, got, err_msg=impl)
+
+
+@pytest.mark.parametrize(
+    "attr", [s.to_attr() for s in ep.enumerate_specs("fc")
+             if s.to_attr()])
+def test_fc_kernel_stage_matrix_grads_bitwise(attr):
+    """Backward = jax.vjp of the exact unfused composite, so grads are
+    bit-identical to the flag-off graph for every stage subset."""
+    import jax
+
+    spec = ep.EpilogueSpec.from_attr(attr)
+    x, w, b, r = _fc_operands(spec, "float32")
+    act = spec.act or ""
+
+    def fused(*args):
+        xx, ww = args[0], args[1]
+        rest = list(args[2:])
+        bb = rest.pop(0) if b is not None else None
+        rr = rest.pop(0) if r is not None else None
+        return ep.fc_epilogue(xx, ww, bb, rr, act=act or None,
+                              impl="interpret").sum()
+
+    def unfused(*args):
+        xx, ww = args[0], args[1]
+        rest = list(args[2:])
+        bb = rest.pop(0) if b is not None else None
+        rr = rest.pop(0) if r is not None else None
+        return _fc_unfused(xx, ww, bb, rr, act).sum()
+
+    args = tuple(a for a in (x, w, b, r) if a is not None)
+    gf = jax.grad(fused, argnums=tuple(range(len(args))))(*args)
+    gu = jax.grad(unfused, argnums=tuple(range(len(args))))(*args)
+    for a, b_ in zip(gf, gu):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_fc_kernel_bf16_close_to_f32():
+    """bf16 fused is NOT bitwise vs the bf16 chain (the kernel adds
+    bias/residual in the f32 accumulator, the chain in bf16) — the
+    convention, as for the conv kernel, is closeness to the f32
+    reference."""
+    spec = ep.EpilogueSpec.from_attr("bias+residual+relu")
+    x32, w32, b32, r32 = _fc_operands(spec, "float32")
+    ref = np.asarray(_fc_unfused(x32, w32, b32, r32, "relu"))
+    x, w, b, r = _fc_operands(spec, "bfloat16")
+    got = np.asarray(ep.fc_epilogue(x, w, b, r, act="relu",
+                                    impl="interpret")).astype(np.float32)
+    np.testing.assert_allclose(ref, got, rtol=5e-2, atol=5e-2)
+
+
+# ---------------------------------------------------------------------------
+# the unified transpiler
+# ---------------------------------------------------------------------------
+
+def _build_fc_net(act, residual):
+    """mul -> bias -> [residual] -> [act] -> fc: the canonical stage
+    order (a residual AFTER the act is a different graph and must NOT
+    fuse — test_fc_transpiler_skips_nonfusable's sibling guard)."""
+    _fresh()
+    x = layers.data("x", shape=[24], dtype="float32")
+    h = layers.fc(x, size=24, act=None if residual else act,
+                  bias_attr=True)
+    if residual:
+        h = layers.elementwise_add(h, x)
+        if act == "relu":
+            h = layers.relu(h)
+        elif act == "gelu":
+            h = layers.gelu(h)
+    pred = layers.fc(h, size=4, bias_attr=True)
+    return pred
+
+
+@pytest.mark.parametrize("act,residual", [("relu", False),
+                                          ("gelu", False),
+                                          (None, True),
+                                          ("relu", True)])
+def test_fc_transpiler_executor_bitwise(act, residual):
+    """fuse_epilogue(anchors=fc) + fc_epilogue flag on is bit-identical
+    to the unfused graph through the executor, and the fused op
+    carries the stage list the chain actually had."""
+    from paddle_tpu.transpiler import fuse_epilogue
+
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.randn(6, 24).astype(np.float32)}
+    try:
+        set_flags({"fc_epilogue": "off"})
+        pred = _build_fc_net(act, residual)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(framework.default_startup_program())
+        prog = framework.default_main_program()
+        (ref,) = exe.run(prog, feed=feed, fetch_list=[pred])
+
+        # fuse the SAME initialized program: params live in the scope
+        # by name and the rewrite renames none of them, so the fused
+        # run reads the exact weights the unfused run read
+        n = fuse_epilogue(prog, protected=[pred.name], anchors=("fc",))
+        assert n == 2
+        fused = [op for op in prog.global_block().ops
+                 if op.type == "fc_epilogue"]
+        assert len(fused) == 2
+        want = ep.spec_attr(bias=True, residual=residual,
+                            act=act or "")
+        assert fused[0].attrs["epilogue"] == want
+        assert fused[1].attrs["epilogue"] == ep.spec_attr(bias=True)
+        for mode in ("xla", "interpret"):
+            set_flags({"fc_epilogue": mode})
+            (got,) = exe.run(prog, feed=feed, fetch_list=[pred])
+            np.testing.assert_array_equal(np.asarray(ref),
+                                          np.asarray(got),
+                                          err_msg=mode)
+    finally:
+        set_flags({"fc_epilogue": "off"})
+
+
+def test_fc_transpiler_skips_nonfusable():
+    """No bias, no residual, no act -> nothing to fuse; a multi-
+    consumer intermediate never fuses (the sole-consumer guard)."""
+    from paddle_tpu.transpiler import fuse_epilogue
+
+    _fresh()
+    x = layers.data("x", shape=[8], dtype="float32")
+    h = layers.fc(x, size=8, bias_attr=False)        # bare mul
+    a = layers.relu(h)
+    b = layers.sigmoid(h)                            # second consumer
+    pred = layers.elementwise_add(a, b)
+    prog = framework.default_main_program()
+    assert fuse_epilogue(prog, protected=[pred.name],
+                         anchors=("fc",)) == 0
+    assert all(op.type != "fc_epilogue"
+               for op in prog.global_block().ops)
+
+
+def test_legacy_conv_wrappers_emit_stage_attrs():
+    """The legacy entry points (public names and signatures unchanged)
+    now route through the unified pass and stamp the stage list on the
+    ops they emit — same chains matched as before."""
+    from paddle_tpu.transpiler import (fuse_conv_bn_train,
+                                       fuse_conv_epilogue)
+
+    _fresh()
+    x = layers.data("x", shape=[3, 8, 8], dtype="float32")
+    c = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                      bias_attr=True)
+    sk = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                       bias_attr=False)
+    y = layers.relu(layers.elementwise_add(c, sk))
+    prog = framework.default_main_program()
+    assert fuse_conv_epilogue(prog, protected=[y.name]) == 1
+    fused = [op for op in prog.global_block().ops
+             if op.type == "conv2d_epilogue"]
+    assert len(fused) == 1
+    assert fused[0].attrs["epilogue"] == "bias+residual+relu"
+
+    _fresh()
+    x = layers.data("x", shape=[3, 8, 8], dtype="float32")
+    c = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                      bias_attr=False)
+    bn = layers.batch_norm(c, act="relu")
+    prog = framework.default_main_program()
+    assert fuse_conv_bn_train(prog, protected=[bn.name]) == 1
+    fused = [op for op in prog.global_block().ops
+             if op.type == "conv2d_bn_train"]
+    assert len(fused) == 1
+    assert fused[0].attrs["epilogue"] == "stats_tap+bn_apply+relu"
+
+
+def test_unified_pass_fuses_across_anchors():
+    """One fuse_epilogue call over a mixed graph fuses the conv chain
+    AND the fc chain."""
+    from paddle_tpu.transpiler import fuse_epilogue
+
+    _fresh()
+    x = layers.data("x", shape=[3, 8, 8], dtype="float32")
+    c = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                      act="relu", bias_attr=True)
+    pred = layers.fc(c, size=4, act="relu", bias_attr=True)
+    prog = framework.default_main_program()
+    n = fuse_epilogue(prog, protected=[pred.name])
+    assert n == 2
+    types = [op.type for op in prog.global_block().ops]
+    assert "conv2d_epilogue" in types and "fc_epilogue" in types
+
+
+def test_flag_off_builds_no_fused_ops():
+    """Default flags: nothing fuses, nothing changes — the flag-off
+    graph is the plain op chain."""
+    assert get_flag("fc_epilogue") == "off"
+    _fresh()
+    pred = _build_fc_net("relu", residual=True)
+    types = [op.type for op in
+             framework.default_main_program().global_block().ops]
+    assert "fc_epilogue" not in types
+    assert types.count("mul") == 2
+    del pred
+
+
+# ---------------------------------------------------------------------------
+# verifier: the epilogue-spec rule
+# ---------------------------------------------------------------------------
+
+def test_verifier_rejects_malformed_epilogue_attr():
+    from paddle_tpu.analysis import verify
+
+    _fresh()
+    x = layers.data("x", shape=[24], dtype="float32")
+    pred = layers.fc(x, size=4, act="relu", bias_attr=True)
+    prog = framework.default_main_program()
+    from paddle_tpu.transpiler import fuse_epilogue
+
+    fuse_epilogue(prog, protected=[pred.name], anchors=("fc",))
+    diags = verify(prog, raise_=False)
+    assert not [d for d in diags if d.rule == "epilogue-spec"]
+    # corrupt the stamped attr: the rule must fire
+    fused = [op for op in prog.global_block().ops
+             if op.type == "fc_epilogue"][0]
+    fused.set_attr("epilogue", "relu+bias")      # out of order
+    diags = verify(prog, raise_=False)
+    assert [d for d in diags if d.rule == "epilogue-spec"]
+
+
+# ---------------------------------------------------------------------------
+# int8: the residual-edge fold (the new capability — zero new kernels)
+# ---------------------------------------------------------------------------
+
+def _convert_residual_int8_net(int8_acts):
+    """conv(+bias,relu) -> conv(+bias) -> +skip -> relu -> conv
+    (+bias,relu) -> fc: the middle edge crosses a residual add."""
+    from paddle_tpu.contrib.slim.quantization import (
+        convert_to_int8_execution, post_training_quantize,
+        quantize_weights_abs_max)
+    from paddle_tpu.core.scope import global_scope
+
+    _fresh()
+    np.random.seed(0)
+    xin = layers.data("x", shape=[2, 8, 8], dtype="float32")
+    c1 = layers.conv2d(xin, num_filters=4, filter_size=3, padding=1,
+                       act="relu", bias_attr=True)
+    c2 = layers.conv2d(c1, num_filters=4, filter_size=3, padding=1,
+                       bias_attr=True)
+    s = layers.elementwise_add(c2, c1)           # the skip edge
+    r = layers.relu(s)
+    c3 = layers.conv2d(r, num_filters=4, filter_size=3, padding=1,
+                       act="relu", bias_attr=True)
+    pred = layers.fc(c3, size=4, bias_attr=False)
+
+    prog = framework.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(framework.default_startup_program())
+    infer = prog.clone(for_test=True)
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.rand(4, 2, 8, 8).astype(np.float32)}
+    scales, _ = post_training_quantize(
+        infer, global_scope(), exe, [dict(feed)], fetch_list=[pred],
+        fold_boundaries=True)
+    qw = quantize_weights_abs_max(infer, global_scope())
+    convert_to_int8_execution(infer, global_scope(), qw,
+                              act_scales=scales,
+                              out_dtype="bfloat16",
+                              int8_activations=int8_acts,
+                              protected=[pred.name])
+    (out,) = exe.run(fluid.CompiledProgram(infer), feed=feed,
+                     fetch_list=[pred])
+    stats = getattr(infer, "_int8_interlayer_stats", None)
+    return np.asarray(out), stats, infer
+
+
+def test_int8_residual_edge_fold_bit_identical():
+    """The residual-edge fold: the skip add between the producer and
+    its quantized consumer folds INTO the producer (Residual input +
+    requantize tail), the boundary tensor crosses as int8, and the
+    logits stay bit-identical to the unfused graph."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    with scope_guard(Scope()):
+        ref, stats_off, _ = _convert_residual_int8_net(False)
+    with scope_guard(Scope()):
+        got, stats, infer = _convert_residual_int8_net(True)
+    assert stats_off is None
+    assert stats["n_residual_folds"] == 1
+    assert stats["n_edges_folded"] >= 1
+    convs = [op for op in infer.global_block().ops
+             if op.type == "conv2d_int8"]
+    folded = [op for op in convs if op.inputs.get("Residual")]
+    assert len(folded) == 1
+    # the fold stamped the stage list it actually matched
+    assert folded[0].attrs["epilogue"] == \
+        "bias+residual+relu+requantize"
+    # the boundary tensor is int8 (the whole point of the fold)
+    tail = folded[0].outputs["Output"][0]
+    assert infer.global_block().vars[tail].dtype == "int8"
+    # the residual add and relu left the graph
+    types = [op.type for op in infer.global_block().ops]
+    assert "elementwise_add" not in types
+    assert "relu" not in types
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_int8_residual_fold_rejects_int8_operand():
+    """A skip operand that is itself an int8 boundary tensor cannot
+    join the float add — the guard keeps that edge unfused rather
+    than mixing lattices."""
+    from paddle_tpu.core.program import Program as _P
+    from paddle_tpu.transpiler.epilogue_transpiler import \
+        fold_int8_interlayer
+
+    _fresh()
+    x = layers.data("x", shape=[2, 8, 8], dtype="float32")
+    c = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                      bias_attr=False)
+    prog = framework.default_main_program()
+    block = prog.global_block()
+    conv_op = [op for op in block.ops if op.type == "conv2d"][0]
+    conv_op.type = "conv2d_int8"
+    conv_op.inputs["InScale"] = [c.name + "@ACT_SCALE"]
+    block.create_var(name=c.name + "@ACT_SCALE", shape=[1],
+                     dtype="float32", persistable=True)
+    # a same-shape int8 operand for the skip add
+    other = block.create_var(name="skip_int8", shape=c.shape,
+                             dtype="int8")
+    block.ops.append(OpDesc("elementwise_add",
+                            {"X": [c.name], "Y": [other.name]},
+                            {"Out": ["sum0"]}, {"axis": -1}))
+    block.create_var(name="sum0", shape=c.shape, dtype="float32")
+    stats = fold_int8_interlayer(prog, block, "bfloat16", 8,
+                                 frozenset())
+    assert stats["n_residual_folds"] == 0
+    assert not conv_op.inputs.get("Residual")

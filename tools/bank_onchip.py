@@ -131,6 +131,17 @@ TASK_KEYS = {
     # change); joins the int8 best-variant promotion below
     "rn_infer_int8_interlayer": (
         "resnet50_infer_int8_interlayer_mb128", None),
+    # ISSUE 17: the unified epilogue pass folds THROUGH the skip adds
+    # now — same leg, deeper graph; the deeper-folded row replaces the
+    # ISSUE-5 row under the same artifact key on re-bank (the newest
+    # run wins, like the longctx blk1024 re-benches)
+    "rn_train_int8_residual_fold": (
+        "resnet50_infer_int8_interlayer_mb128", None),
+    # ISSUE 17: the fc-epilogue A/B — the transformer-side sibling of
+    # the rn convep pair; its `epilogue` marker keys it apart from the
+    # plain tf_train rows in bench._workload_sig
+    "tf_train_fc_epilogue": (
+        "transformer_base_train_mb32_fcep", None),
     "longctx_seq131072_d128": (
         "longctx_flash_train_mb1_seq131072_d128", None),
     "longctx_seq262144": ("longctx_flash_train_mb1_seq262144", None),

@@ -33,6 +33,19 @@ BENCH = os.path.join(REPO, "bench.py")
 # sweep and anchors sit at the tail for fresh-results-file runs; int8
 # (the known 2026-07-31 tunnel-wedger) stays last.
 TASKS = [
+    # ---- ISSUE 17 HEAD: unified epilogue fusion.  (1) tf_train_fcep
+    # — the transformer train step with every ffn/projection
+    # fc+bias+act chain IR-fused onto the Pallas fc_epilogue kernel
+    # (18 fused ops in the 6-layer bench graph: fc1 bias+relu, fc2
+    # bias+residual, out-proj residual).  The A/B vs the banked
+    # tf_train rows at the same shape prices the fused matmul's one
+    # VMEM pass against XLA's mul+add+act chain — the fc analog of
+    # the banked rn_train convep win.  CPU array_equal parity and
+    # Mosaic cross-lowering (transformer_train_fcep workload) are
+    # green in CI before any window is spent.  Flip no default before
+    # banking.
+    ("tf_train_fc_epilogue", "tf_train_fcep",
+     {"batch": 32, "chain": 15}),
     # ---- PR-8 HEAD: GSPMD pjit train step (ISSUE 8) — the whole
     # transformer fwd+bwd+Adam as ONE jit with in/out NamedShardings
     # over a dp x tp mesh (ZeRO-3 + Megatron tp as PartitionSpecs,
@@ -247,6 +260,16 @@ TASKS = [
     ("hlo_traffic_int8_interlayer",
      "script:tools/hlo_traffic.py --int8-interlayer --batch 128", {},
      1800),
+    # ---- ISSUE 17: residual-edge int8 folds.  The unified epilogue
+    # pass now walks THROUGH the ResNet skip adds (previously any
+    # residual add stopped the fold and the edge stayed float), so
+    # block-exit tensors cross to the next block as s8 too.  The A/B
+    # vs the rn_infer_int8_interlayer row above prices the extra
+    # folded edges (the rn50 graph has 16 skip adds); rides the same
+    # leg — the flag path is identical, the graph just folds deeper.
+    # Same tail position, same wedge-risk reasoning as every int8 row.
+    ("rn_train_int8_residual_fold", "infer_i8",
+     {"batch": 128, "chain": 20, "int8_activations": True}),
     # d128 at seq 128k: at 32k, d128 doubled MFU at the same wall time
     # (MXU contractions full-width); expect the same here
     ("longctx_seq131072_d128", "longctx",

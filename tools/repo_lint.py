@@ -30,6 +30,16 @@ id, file:line, and a one-line message):
                        code appears in a docs/*.md env-knob table.
   no-bare-except       no ``except:`` — it eats KeyboardInterrupt and
                        SystemExit; ``except Exception`` at minimum.
+  epilogue-stage-names every literal ``epilogue`` attr string — a
+                       ``{"epilogue": "<...>"}`` dict entry or a
+                       ``set_attr("epilogue", "<...>")`` site — parses
+                       and validates against the stage grammar in
+                       ops/epilogue.py (ISSUE 17): a typo'd or
+                       mis-ordered stage list would otherwise only
+                       explode when the verifier meets the op at
+                       runtime.  spec_attr()-built values are checked
+                       at build time by construction and are not
+                       literals, so they don't reach this rule.
 
 Intentional exceptions live in tools/repo_lint_allowlist.json as
 {"rule", "id", "reason"} entries; an allowlist entry that no longer
@@ -293,6 +303,39 @@ def lint():
             "no docs/*.md env-knob table"))
 
     # ---------------------------------------------------------- rule 6
+    # epilogue-stage-names: literal epilogue attr strings must parse
+    # against the ops/epilogue.py stage grammar.  Sites are collected
+    # first; the (jax-heavy) grammar import only happens if any exist.
+    ep_sites = []   # (value, path, line)
+    for s in scans:
+        for node in ast.walk(s.tree):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if _str_const(k) == "epilogue" and \
+                            _str_const(v) is not None:
+                        ep_sites.append(
+                            (_str_const(v), s.path, v.lineno))
+            elif isinstance(node, ast.Call) and \
+                    _call_name(node) == "set_attr" and \
+                    len(node.args) >= 2 and \
+                    _str_const(node.args[0]) == "epilogue" and \
+                    _str_const(node.args[1]) is not None:
+                ep_sites.append((_str_const(node.args[1]), s.path,
+                                 node.lineno))
+    if ep_sites:
+        sys.path.insert(0, ROOT)
+        from paddle_tpu.ops.epilogue import EpilogueSpec
+        for value, path, line in ep_sites:
+            try:
+                EpilogueSpec.from_attr(value).validate()
+            except ValueError as e:
+                findings.append(Finding(
+                    "epilogue-stage-names", f"epilogue:{value}", path,
+                    line,
+                    f"epilogue attr literal {value!r} is not a valid "
+                    f"stage list: {e}"))
+
+    # ---------------------------------------------------------- rule 7
     # no-bare-except
     for s in scans:
         for node in ast.walk(s.tree):

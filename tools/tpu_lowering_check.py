@@ -96,6 +96,19 @@ def _workloads():
         # for tpu before the batch-slide A/B leg runs
         "transformer_train_fusedadam": lambda:
             bench._build_transformer_train(8, 512, fused_adam=True)[:3],
+        # ISSUE 17: the unified-epilogue fc anchor — the fused
+        # matmul+bias+residual+act kernel's (bm, bn) output blocks and
+        # full-K operand blocks are new Mosaic surface the plain mul
+        # lowering never sees (the conv workloads above gate the conv
+        # anchors of the same stage grammar); cross-lower BEFORE the
+        # chaser spends a window on the tf_train_fcep leg
+        "transformer_train_fcep": lambda:
+            bench._build_transformer_train(8, 512,
+                                           fc_epilogue=True)[:3],
+        # ISSUE 17: the greedy logits tail (the epilogue grammar's
+        # terminal argmax stage, shared by the decode engine's step,
+        # draft and verify sweeps) over a vocab-width bf16 row block
+        "decode_greedy_tail": lambda: _decode_greedy_tail(),
         # ISSUE 8: the gspmd-sharded train step — ONE jit with in/out
         # NamedShardings over a dp x tp mesh, ZeRO-3/tp specs on the
         # weights and the flash kernels under shard_map.  shard_map
@@ -191,6 +204,18 @@ def _serving_sharded_specs(bench):
     return fn, sds(state), sds(feed)
 
 
+def _decode_greedy_tail():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.epilogue import greedy_logits_tail
+
+    fn = jax.jit(lambda state, feed: greedy_logits_tail(
+        feed["logits"]))
+    feed = {"logits": jax.ShapeDtypeStruct((8, 32000), jnp.bfloat16)}
+    return fn, {}, feed
+
+
 def _llm_decode_bf16(bench):
     import jax.numpy as jnp
 
@@ -265,7 +290,8 @@ def check_workload(name, build):
     from paddle_tpu.flags import set_flags
 
     set_flags({"flash_packed_stats": "off", "flash_head_pack": "off",
-               "gspmd": False, "serving_sharded": False})
+               "fc_epilogue": "off", "gspmd": False,
+               "serving_sharded": False})
     try:
         fn, state, feed = build()
         export.export(fn, platforms=("tpu",))(state, feed)

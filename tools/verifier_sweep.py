@@ -83,6 +83,12 @@ def _workloads():
             bench._build_transformer_train(2, 64),
         "transformer_train_fusedadam": lambda:
             bench._build_transformer_train(2, 64, fused_adam=True),
+        # ISSUE 17: the unified epilogue pass (fc anchor) under full
+        # verification — the fuse rewrite, the stamped epilogue attrs
+        # (the epilogue-spec rule re-parses every one) and the derived
+        # fc_epilogue_grad ops all sweep
+        "transformer_train_fcep": lambda:
+            bench._build_transformer_train(2, 64, fc_epilogue=True),
         "transformer_train_gspmd": lambda:
             bench._build_transformer_train(2, 64, gspmd=True, tp=2),
         "deepfm_train": lambda: bench._build_deepfm_train(64),
